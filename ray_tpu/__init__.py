@@ -1,0 +1,70 @@
+"""ray_tpu: a TPU-native distributed task/actor framework.
+
+Dynamic task graphs, stateful actors, a shared-memory object store with
+ownership-based distributed reference counting, locality/hybrid
+scheduling with a batched JAX scheduling backend, placement groups, fault
+tolerance (retries, actor restarts, lineage reconstruction, spilling), and
+a library stack (collective/train/data/tune/serve/workflow) — built
+TPU-first (JAX/XLA/pjit/Pallas for the compute path) with the capabilities
+of the reference Ray snapshot (see SURVEY.md).
+
+Public API parity target: reference python/ray/__init__.py.
+"""
+
+__version__ = "0.1.0"
+
+from ray_tpu import exceptions  # noqa: F401
+from ray_tpu._private.object_ref import ObjectRef  # noqa: F401
+from ray_tpu.actor import get_actor, list_named_actors  # noqa: F401
+from ray_tpu.remote_function import make_remote
+from ray_tpu.worker import (  # noqa: F401
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_runtime_context,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    shutdown,
+    timeline,
+    wait,
+)
+
+
+def remote(*args, **kwargs):
+    """``@ray_tpu.remote`` decorator for functions and actor classes.
+
+    Usable bare or with options::
+
+        @ray_tpu.remote
+        def f(x): ...
+
+        @ray_tpu.remote(num_cpus=2, max_retries=5)
+        def g(x): ...
+    """
+    if len(args) == 1 and not kwargs and callable(args[0]):
+        return make_remote(args[0])
+    if args:
+        raise TypeError("@remote options must be keyword arguments")
+    return make_remote(None, **kwargs)
+
+
+def method(num_returns: int = 1):
+    """``@ray_tpu.method(num_returns=N)`` on actor methods."""
+    def decorator(fn):
+        fn.__rtpu_num_returns__ = num_returns
+        return fn
+    return decorator
+
+
+from ray_tpu._private.task_executor import exit_actor  # noqa: E402,F401
+
+__all__ = [
+    "ObjectRef", "available_resources", "cancel", "cluster_resources",
+    "exceptions", "exit_actor", "get", "get_actor", "get_runtime_context",
+    "init", "is_initialized", "kill", "list_named_actors", "method", "nodes",
+    "put", "remote", "shutdown", "timeline", "wait",
+]

@@ -1,0 +1,130 @@
+"""Typed runtime config registry.
+
+Equivalent of the reference's RAY_CONFIG flag registry
+(reference: src/ray/common/ray_config_def.h): every tunable is a typed entry
+with a default, overridable by (priority order) an explicit
+``_system_config`` dict passed to ``init()``/process argv, then the
+``RAY_TPU_<NAME>`` environment variable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict
+
+
+def _env_override(name: str, typ, default):
+    raw = os.environ.get(f"RAY_TPU_{name.upper()}")
+    if raw is None:
+        return default
+    if typ is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    if typ is int:
+        return int(raw)
+    if typ is float:
+        return float(raw)
+    return raw
+
+
+@dataclass
+class RayTpuConfig:
+    # --- object plane ---
+    # Values at or below this size are returned/passed inline through the
+    # owner's in-process memory store rather than the shared-memory store
+    # (reference: max_direct_call_object_size, ray_config_def.h).
+    max_direct_call_object_size: int = 100 * 1024
+    # Size of the shared-memory object store arena per node, bytes.
+    object_store_memory: int = 512 * 1024 * 1024
+    # Fraction of the store that may be used before create requests block.
+    object_store_full_delay_ms: int = 10
+    # Enable spilling objects to disk when the store fills.
+    object_spilling_enabled: bool = True
+    spill_path: str = ""
+    # Chunk size for node-to-node object transfer.
+    object_manager_chunk_size: int = 1024 * 1024
+
+    # --- scheduling ---
+    # Pipeline depth for pushing tasks to a leased worker before waiting
+    # for replies (reference: max_tasks_in_flight_per_worker).
+    max_tasks_in_flight_per_worker: int = 10
+    # Hybrid policy: prefer the local/first node until its utilization
+    # exceeds this threshold, then spread (reference: scheduler_spread_threshold).
+    scheduler_spread_threshold: float = 0.5
+    # Which scheduler backend the raylet uses: "host" (dict/heap reference
+    # implementation) or "tpu_batched" (JAX batched frontier/scoring kernel).
+    scheduler_backend: str = "host"
+    # Max tasks the batched backend scores per tick.
+    scheduler_batch_size: int = 4096
+    # Lease reuse: keep an idle leased worker this long before returning it.
+    idle_worker_lease_timeout_ms: int = 2000
+
+    # --- worker pool ---
+    # Hard cap on workers started per node (0 = num_cpus).
+    max_workers_per_node: int = 0
+    # Workers prestarted at node boot.
+    num_prestart_workers: int = 0
+    worker_register_timeout_s: float = 30.0
+
+    # --- liveness / fault tolerance ---
+    raylet_heartbeat_period_ms: int = 250
+    num_heartbeats_timeout: int = 20
+    task_max_retries_default: int = 3
+    actor_max_restarts_default: int = 0
+    # Enable lineage-based reconstruction of lost shared-memory objects.
+    lineage_reconstruction_enabled: bool = True
+    lineage_max_bytes: int = 64 * 1024 * 1024
+
+    # --- rpc ---
+    rpc_connect_timeout_s: float = 10.0
+    rpc_frame_max_bytes: int = 1 << 31
+    gcs_port: int = 0
+
+    # --- observability ---
+    event_log_enabled: bool = True
+    metrics_report_period_ms: int = 2000
+    profiling_enabled: bool = True
+    debug_dump_period_ms: int = 10000
+
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def create(cls, system_config: Dict[str, Any] | None = None) -> "RayTpuConfig":
+        cfg = cls()
+        for f in fields(cls):
+            if f.name == "extra":
+                continue
+            setattr(cfg, f.name, _env_override(f.name, f.type if isinstance(f.type, type) else type(getattr(cfg, f.name)), getattr(cfg, f.name)))
+        if system_config:
+            known = {f.name for f in fields(cls)}
+            for k, v in system_config.items():
+                if k in known:
+                    setattr(cfg, k, v)
+                else:
+                    cfg.extra[k] = v
+        return cfg
+
+    def to_json(self) -> str:
+        d = {f.name: getattr(self, f.name) for f in fields(self) if f.name != "extra"}
+        d.update(self.extra)
+        return json.dumps(d)
+
+    @classmethod
+    def from_json(cls, s: str) -> "RayTpuConfig":
+        return cls.create(json.loads(s))
+
+
+_global_config: RayTpuConfig | None = None
+
+
+def get_config() -> RayTpuConfig:
+    global _global_config
+    if _global_config is None:
+        _global_config = RayTpuConfig.create()
+    return _global_config
+
+
+def set_config(cfg: RayTpuConfig) -> None:
+    global _global_config
+    _global_config = cfg

@@ -1,0 +1,1087 @@
+"""Core worker: the per-process ownership facade.
+
+Role parity: reference CoreWorker (src/ray/core_worker/core_worker.h) —
+embedded in every driver and worker process. Owns:
+
+  * the in-process memory store (small objects) and the shm-store client
+  * the reference counter (ownership + borrowing)
+  * the task manager (pending tasks, retries, error objects)
+  * the direct task submitter (leases from raylets, pipelined pushes
+    straight to leased workers — reference: transport/direct_task_transport.h)
+  * the direct actor submitter (per-actor ordered queues with sequence
+    numbers — reference: transport/direct_actor_transport.h)
+  * the owner RPC services other processes call: GetObject,
+    GetObjectLocations, AddBorrower/RemoveBorrower
+
+The synchronous public API (get/put/wait/submit) marshals onto a dedicated
+asyncio IO loop, the analog of the reference core worker's io_service.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private import rpc
+from ray_tpu._private.config import RayTpuConfig
+from ray_tpu._private.function_manager import FunctionManager
+from ray_tpu._private.ids import (
+    ActorID, JobID, ObjectID, TaskID, WorkerID,
+)
+from ray_tpu._private.memory_store import IN_PLASMA, MemoryStore
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.reference_count import ReferenceCounter
+from ray_tpu._private.serialization import (
+    META_ERROR, SerializationContext, SerializedObject,
+)
+from ray_tpu._private.shm_store import AttachedObject, write_segment
+from ray_tpu._private.task_spec import (
+    ARG_REF, ARG_VALUE, TASK_ACTOR, TASK_ACTOR_CREATION, TASK_NORMAL,
+    TaskArg, TaskSpec,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class PendingTaskEntry:
+    """Owner-side record of one submitted task (reference: TaskManager's
+    pending-task table, src/ray/core_worker/task_manager.h)."""
+
+    __slots__ = ("spec", "num_retries_left", "return_ids", "dep_ids",
+                 "submitted_at", "lineage_pinned")
+
+    def __init__(self, spec: TaskSpec, return_ids: List[ObjectID]):
+        self.spec = spec
+        self.num_retries_left = spec.max_retries
+        self.return_ids = return_ids
+        self.dep_ids = [ObjectID(b) for b in spec.dependency_ids()]
+        self.submitted_at = time.time()
+        self.lineage_pinned = False
+
+
+class LeasedWorker:
+    __slots__ = ("address", "lease_id", "node_id", "conn", "inflight",
+                 "raylet_address", "worker_id")
+
+    def __init__(self, address, lease_id, node_id, conn, raylet_address, worker_id):
+        self.address = address
+        self.lease_id = lease_id
+        self.node_id = node_id
+        self.conn = conn
+        self.raylet_address = raylet_address
+        self.worker_id = worker_id
+        self.inflight = 0
+
+
+class SchedulingKeyState:
+    """Per scheduling-class submission state (reference: SchedulingKey
+    queues in direct_task_transport.h)."""
+
+    __slots__ = ("queue", "workers", "pending_lease", "resources")
+
+    def __init__(self, resources):
+        self.queue: List[TaskSpec] = []
+        self.workers: List[LeasedWorker] = []
+        self.pending_lease = 0
+        self.resources = resources
+
+
+class ActorQueueState:
+    """Per-actor client-side queue (reference:
+    CoreWorkerDirectActorTaskSubmitter per-actor state)."""
+
+    __slots__ = ("actor_id", "seqno", "conn", "address", "state", "buffer",
+                 "inflight", "resolving", "incarnation", "death_cause",
+                 "max_pending", "creation_arg_holds")
+
+    def __init__(self, actor_id: bytes):
+        self.actor_id = actor_id
+        self.seqno = 0
+        self.conn: Optional[rpc.Connection] = None
+        self.address = ""
+        self.state = "UNRESOLVED"
+        self.buffer: List[Tuple[TaskSpec, int]] = []   # (spec, seqno) awaiting send
+        self.inflight: Dict[int, Tuple[TaskSpec, int]] = {}  # seqno -> (spec, retries)
+        self.resolving = False
+        self.incarnation = -1
+        self.death_cause = ""
+        self.max_pending = -1
+
+
+class CoreWorker:
+    def __init__(self, mode: str, config: RayTpuConfig,
+                 gcs_address: str, raylet_address: str,
+                 session_dir: str, job_id: bytes = b"",
+                 worker_id: bytes = b"", node_id: bytes = b"",
+                 loop: Optional[asyncio.AbstractEventLoop] = None):
+        assert mode in ("driver", "worker")
+        self.mode = mode
+        self.config = config
+        self.gcs_address = gcs_address
+        self.raylet_address = raylet_address
+        self.session_dir = session_dir
+        self.worker_id = worker_id or WorkerID.from_random().binary()
+        self.node_id = node_id
+        self.job_id = job_id
+
+        if loop is None:
+            self._loop_thread = rpc.EventLoopThread(f"rtpu-{mode}-io")
+            self.loop = self._loop_thread.loop
+        else:
+            self._loop_thread = None
+            self.loop = loop
+
+        self.memory_store = MemoryStore()
+        self.reference_counter = ReferenceCounter()
+        self.serialization_context = SerializationContext()
+        self.serialization_context.set_object_ref_reducer(
+            self._serialize_ref, self._deserialize_ref)
+        self.serialization_context.set_actor_handle_reducer(
+            self._serialize_actor_handle, self._deserialize_actor_handle)
+
+        self.pending_tasks: Dict[bytes, PendingTaskEntry] = {}
+        self.scheduling_keys: Dict[int, SchedulingKeyState] = {}
+        self.actor_queues: Dict[bytes, ActorQueueState] = {}
+        self.actor_handles: Dict[bytes, Any] = {}
+
+        self.gcs_conn: Optional[rpc.Connection] = None
+        self.raylet_conn: Optional[rpc.Connection] = None
+        self._server = rpc.RpcServer(self._owner_handlers(), name=f"cw-{mode}")
+        self.address = ""
+        self._owner_conns: Dict[str, rpc.Connection] = {}
+        self._attached: Dict[ObjectID, AttachedObject] = {}
+        self._attached_lock = threading.Lock()
+        self.function_manager = FunctionManager(self._kv_put_sync, self._kv_get_sync)
+        self._task_counter = itertools.count(1)
+        self._put_counter = itertools.count(1)
+        self._current_task_id: bytes = b""
+        self._shutdown = False
+        self.task_executor = None   # set in worker mode by worker_main
+        self._task_events: List[dict] = []
+        self._profile_flush_task = None
+        # Set by the actor module so the core worker can build handles
+        # without import cycles.
+        self._actor_handle_factory: Optional[Callable] = None
+
+        self.stats = {"tasks_submitted": 0, "tasks_finished": 0,
+                      "tasks_retried": 0, "actor_tasks_submitted": 0,
+                      "puts": 0, "gets": 0}
+
+    # ------------------------------------------------------------ lifecycle
+
+    def connect(self):
+        self._run(self._connect_async())
+
+    async def _connect_async(self):
+        sock_dir = os.path.join(self.session_dir, "sockets")
+        os.makedirs(sock_dir, exist_ok=True)
+        self.address = await self._server.listen(
+            f"unix://{sock_dir}/cw-{WorkerID(self.worker_id).hex()[:12]}")
+        self.reference_counter.own_address = self.address
+        self.reference_counter.add_release_callback(self._on_object_released)
+        self.reference_counter.add_borrow_removed_callback(self._on_borrow_removed)
+        self.gcs_conn = await rpc.connect(
+            self.gcs_address,
+            handlers={"Published": self._handle_published},
+            peer_name="gcs")
+        if self.mode == "driver":
+            reply, _ = await self.gcs_conn.call("AddJob", {
+                "driver_address": self.address})
+            self.job_id = reply["job_id"]
+        # Share the server's handler dict: the raylet pushes CreateActor /
+        # PushTask over this connection (workers), and the TaskExecutor
+        # registers its handlers into the same dict.
+        self.raylet_conn = await rpc.connect(
+            self.raylet_address, handlers=self._server.handlers,
+            peer_name="raylet")
+        await self.gcs_conn.call("Subscribe", {"channel": "ACTOR"})
+        self._driver_task_id = TaskID.for_driver(JobID(self.job_id)) \
+            if self.job_id else TaskID.from_random()
+        if self.config.profiling_enabled:
+            self._profile_flush_task = self.loop.create_task(
+                self._profile_flush_loop())
+
+    def shutdown(self):
+        if self._shutdown:
+            return
+        self._shutdown = True
+        try:
+            self._run(self._shutdown_async(), timeout=5)
+        except Exception:
+            pass
+        if self._loop_thread is not None:
+            self._loop_thread.stop()
+
+    async def _shutdown_async(self):
+        if self._profile_flush_task:
+            self._profile_flush_task.cancel()
+        if self.mode == "driver" and self.gcs_conn and not self.gcs_conn.closed:
+            try:
+                await self.gcs_conn.call("MarkJobFinished",
+                                         {"job_id": self.job_id}, timeout=2)
+            except Exception:
+                pass
+        for key_state in self.scheduling_keys.values():
+            for lw in key_state.workers:
+                try:
+                    await self._return_lease(lw)
+                except Exception:
+                    pass
+        await self._server.close()
+        for conn in list(self._owner_conns.values()):
+            await conn.close()
+        if self.gcs_conn:
+            await self.gcs_conn.close()
+        if self.raylet_conn:
+            await self.raylet_conn.close()
+        with self._attached_lock:
+            for att in self._attached.values():
+                att.close()
+            self._attached.clear()
+
+    def _run(self, coro, timeout=None):
+        """Run a coroutine on the IO loop from any thread (never from the
+        loop thread itself — that would deadlock)."""
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is self.loop:
+            coro.close()
+            raise RuntimeError("sync API called from the IO loop thread")
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    # ------------------------------------------------------------ KV helpers
+
+    def _kv_put_sync(self, key: bytes, value: bytes):
+        self._run(self.gcs_conn.call("KVPut", {"key": key}, bufs=[value]))
+
+    def _kv_get_sync(self, key: bytes) -> Optional[bytes]:
+        header, bufs = self._run(self.gcs_conn.call("KVGet", {"key": key}))
+        return bufs[0] if header.get("found") else None
+
+    # --------------------------------------------------------- ref reducers
+
+    def _serialize_ref(self, ref: ObjectRef):
+        owner = ref.owner_address or \
+            self.reference_counter.owner_address_of(ref.object_id) or self.address
+        return (ref.object_id.binary(), owner)
+
+    def _deserialize_ref(self, state):
+        oid_b, owner = state
+        oid = ObjectID(oid_b)
+        ref = ObjectRef(oid, owner_address=owner, worker=self)
+        if owner and owner != self.address:
+            first = self.reference_counter.add_borrowed_object(oid, owner)
+            if first:
+                self._fire_and_forget(self._notify_add_borrower(oid, owner))
+        return ref
+
+    def _serialize_actor_handle(self, handle):
+        return handle._serialization_state()
+
+    def _deserialize_actor_handle(self, state):
+        if self._actor_handle_factory is None:
+            raise RuntimeError("actor handle factory not registered")
+        return self._actor_handle_factory(self, state)
+
+    async def _notify_add_borrower(self, oid: ObjectID, owner: str):
+        try:
+            conn = await self._get_owner_conn(owner)
+            await conn.call("AddBorrower", {"object_id": oid.binary(),
+                                            "borrower": self.address})
+        except ConnectionError:
+            pass
+
+    def _fire_and_forget(self, coro):
+        if self.loop.is_running():
+            asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    async def _get_owner_conn(self, address: str) -> rpc.Connection:
+        if address == self.address:
+            raise RuntimeError("attempted self-connection for owner RPC")
+        conn = self._owner_conns.get(address)
+        if conn is None or conn.closed:
+            conn = await rpc.connect(address, peer_name=f"owner@{address}")
+            self._owner_conns[address] = conn
+        return conn
+
+    # --------------------------------------------------------- owner services
+
+    def _owner_handlers(self):
+        handlers = {
+            "GetObject": self._handle_get_object,
+            "GetObjectLocations": self._handle_get_object_locations,
+            "AddBorrower": self._handle_add_borrower,
+            "RemoveBorrower": self._handle_remove_borrower,
+            "Ping": self._handle_ping,
+        }
+        return handlers
+
+    async def _handle_ping(self, conn, header, bufs):
+        return {"ok": True, "mode": self.mode}
+
+    async def _handle_get_object(self, conn, header, bufs):
+        oid = ObjectID(header["object_id"])
+        timeout = header.get("timeout", 60.0)
+        try:
+            obj = await self.memory_store.get(oid, timeout=timeout)
+        except asyncio.TimeoutError:
+            return {"found": False}
+        if obj is IN_PLASMA:
+            return {"found": True, "in_plasma": True,
+                    "locations": sorted(
+                        self.reference_counter.get_locations(oid))}
+        assert isinstance(obj, SerializedObject)
+        meta, frames = obj.to_wire()
+        return {"found": True, "in_plasma": False, "metadata": meta,
+                "contained": [r.binary() for r in obj.contained_refs]}, frames
+
+    async def _handle_get_object_locations(self, conn, header, bufs):
+        oid = ObjectID(header["object_id"])
+        return {"locations": sorted(self.reference_counter.get_locations(oid))}
+
+    async def _handle_add_borrower(self, conn, header, bufs):
+        self.reference_counter.add_borrower(
+            ObjectID(header["object_id"]), header["borrower"])
+        return {"ok": True}
+
+    async def _handle_remove_borrower(self, conn, header, bufs):
+        self.reference_counter.remove_borrower(
+            ObjectID(header["object_id"]), header["borrower"])
+        return {"ok": True}
+
+    # -------------------------------------------------------- release paths
+
+    def _on_object_released(self, oid: ObjectID):
+        """Last reference anywhere dropped: delete the value everywhere."""
+        self.memory_store.delete(oid)
+        with self._attached_lock:
+            att = self._attached.pop(oid, None)
+        if att is not None:
+            att.close()
+        locations = self.reference_counter.get_locations(oid)
+        if self.reference_counter.is_owned(oid) or locations:
+            self._fire_and_forget(self._free_remote(oid, locations))
+
+    async def _free_remote(self, oid: ObjectID, locations):
+        # Primary copy lives on our local raylet or remotes; tell them all.
+        try:
+            if self.raylet_conn and not self.raylet_conn.closed:
+                await self.raylet_conn.call("FreeObject",
+                                            {"object_id": oid.binary()})
+        except ConnectionError:
+            pass
+
+    def _on_borrow_removed(self, oid: ObjectID, owner_address: str):
+        async def _notify():
+            try:
+                conn = await self._get_owner_conn(owner_address)
+                await conn.call("RemoveBorrower", {
+                    "object_id": oid.binary(), "borrower": self.address})
+            except (ConnectionError, RuntimeError):
+                pass
+        self._fire_and_forget(_notify())
+
+    # ---------------------------------------------------------------- put
+
+    def put(self, value: Any, _owner_ref: Optional[ObjectRef] = None) -> ObjectRef:
+        serialized = self.serialization_context.serialize(value)
+        oid = self._next_put_id()
+        self.stats["puts"] += 1
+        self._run(self._put_serialized(oid, serialized))
+        return ObjectRef(oid, owner_address=self.address, worker=self,
+                         call_site="put")
+
+    def _next_put_id(self) -> ObjectID:
+        # Put ids live in the current task's index space after returns
+        # (reference: ObjectID::FromIndex with put_index offset).
+        base = TaskID(self._current_task_id) if self._current_task_id \
+            else self._driver_task_id
+        return base.object_id(100_000 + next(self._put_counter))
+
+    async def _put_serialized(self, oid: ObjectID, serialized: SerializedObject,
+                              pin: bool = True):
+        self.reference_counter.add_owned_object(oid)
+        if serialized.contained_refs:
+            self.reference_counter.add_contained_refs(
+                oid, serialized.contained_refs)
+        if serialized.total_bytes() <= self.config.max_direct_call_object_size:
+            self.memory_store.put(oid, serialized)
+            return
+        segment, size = write_segment(serialized)
+        reply, _ = await self.raylet_conn.call("SealObject", {
+            "object_id": oid.binary(), "segment": segment, "size": size,
+            "pin": pin})
+        if not reply.get("ok"):
+            raise exc.ObjectStoreFullError(
+                f"object {oid.hex()} ({size} bytes) does not fit in the store")
+        self.reference_counter.add_location(oid, reply["node_id"])
+        self.memory_store.put(oid, IN_PLASMA)
+
+    # ---------------------------------------------------------------- get
+
+    def get(self, refs: Sequence[ObjectRef], timeout: float | None = None):
+        self.stats["gets"] += len(refs)
+        return self._run(self.get_objects_async(refs, timeout=timeout))
+
+    def get_async(self, ref: ObjectRef) -> asyncio.Future:
+        """Future on the IO loop (for ``await ref`` inside async actors)."""
+        return asyncio.run_coroutine_threadsafe(
+            self._get_one(ref, None), self.loop)
+
+    # concurrent.futures alias used by ObjectRef.future().
+    get_future = get_async
+
+    async def get_objects_async(self, refs: Sequence[ObjectRef],
+                                timeout: float | None = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for ref in refs:
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                raise exc.GetTimeoutError(
+                    f"get() timed out waiting for {ref.hex()}")
+            out.append(await self._get_one(ref, remaining))
+        return out
+
+    async def _get_one(self, ref: ObjectRef, timeout: float | None):
+        oid = ref.object_id
+        owned = self.reference_counter.is_owned(oid)
+        if owned or self.memory_store.contains(oid):
+            try:
+                obj = await self.memory_store.get(oid, timeout=timeout)
+            except asyncio.TimeoutError:
+                raise exc.GetTimeoutError(
+                    f"get() timed out waiting for {oid.hex()}") from None
+            if obj is IN_PLASMA:
+                return await self._get_from_plasma(oid, ref.owner_address)
+            return self._deserialize_obj(obj)
+        # Borrowed: ask the owner.
+        owner = ref.owner_address or self.reference_counter.owner_address_of(oid)
+        if not owner:
+            raise exc.ObjectLostError(oid.hex(), "no owner known")
+        try:
+            conn = await self._get_owner_conn(owner)
+            header, frames = await conn.call(
+                "GetObject", {"object_id": oid.binary(),
+                              "timeout": timeout if timeout is not None else 3600.0},
+                timeout=timeout)
+        except ConnectionError:
+            raise exc.ObjectLostError(
+                oid.hex(), f"owner {owner} unreachable") from None
+        except asyncio.TimeoutError:
+            raise exc.GetTimeoutError(
+                f"get() timed out waiting for {oid.hex()}") from None
+        if not header.get("found"):
+            raise exc.ObjectLostError(oid.hex(), "owner no longer has object")
+        if header.get("in_plasma"):
+            return await self._get_from_plasma(oid, owner)
+        obj = SerializedObject(header["metadata"], frames)
+        # Cache small borrowed values locally for repeat gets.
+        self.memory_store.put(oid, obj)
+        return self._deserialize_obj(obj)
+
+    async def _get_from_plasma(self, oid: ObjectID, owner_address: str):
+        with self._attached_lock:
+            att = self._attached.get(oid)
+        if att is None:
+            reply, _ = await self.raylet_conn.call(
+                "EnsureObjectLocal",
+                {"object_id": oid.binary(), "owner_address": owner_address})
+            if not reply.get("ok") and not reply.get("segment"):
+                recovered = await self._try_recover(oid)
+                if not recovered:
+                    raise exc.ObjectLostError(
+                        oid.hex(), reply.get("reason", "pull failed"))
+                reply, _ = await self.raylet_conn.call(
+                    "EnsureObjectLocal",
+                    {"object_id": oid.binary(), "owner_address": owner_address})
+                if not reply.get("segment"):
+                    raise exc.ObjectLostError(oid.hex(), "recovery failed")
+            att = await asyncio.get_running_loop().run_in_executor(
+                None, AttachedObject, reply["segment"])
+            with self._attached_lock:
+                self._attached[oid] = att
+        obj = SerializedObject(att.metadata, att.frames)
+        return self._deserialize_obj(obj)
+
+    def _deserialize_obj(self, obj: SerializedObject):
+        return self.serialization_context.deserialize(obj.metadata, obj.frames)
+
+    async def _try_recover(self, oid: ObjectID) -> bool:
+        """Lineage reconstruction: resubmit the creating task (reference:
+        ObjectRecoveryManager, src/ray/core_worker/object_recovery_manager.h)."""
+        if not self.config.lineage_reconstruction_enabled:
+            return False
+        entry = self.pending_tasks.get(oid.task_id().binary())
+        if entry is None:
+            return False
+        logger.info("reconstructing %s by resubmitting task %s",
+                    oid.hex()[:16], entry.spec.name)
+        self.stats["tasks_retried"] += 1
+        await self._submit_to_key(entry.spec)
+        # Wait for the resubmitted task to complete again.
+        for _ in range(600):
+            await asyncio.sleep(0.05)
+            obj = self.memory_store.get_if_exists(oid)
+            if obj is not None:
+                return True
+        return False
+
+    # ---------------------------------------------------------------- wait
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: float | None = None, fetch_local: bool = True):
+        return self._run(self._wait_async(refs, num_returns, timeout))
+
+    async def _wait_async(self, refs, num_returns, timeout):
+        pending = list(refs)
+        ready: List[ObjectRef] = []
+
+        async def _await_ready(ref):
+            try:
+                await self._object_available(ref)
+            except Exception:
+                pass  # errored objects count as ready (get will raise)
+            return ref
+
+        tasks = {asyncio.ensure_future(_await_ready(r)): r for r in pending}
+        deadline = None if timeout is None else time.monotonic() + timeout
+        try:
+            while len(ready) < num_returns and tasks:
+                remaining = None if deadline is None else \
+                    max(0.0, deadline - time.monotonic())
+                done, _ = await asyncio.wait(
+                    tasks.keys(), timeout=remaining,
+                    return_when=asyncio.FIRST_COMPLETED)
+                if not done:
+                    break
+                for d in done:
+                    ready.append(tasks.pop(d))
+        finally:
+            for t in tasks:
+                t.cancel()
+        ready_set = set(ready)
+        ready_in_order = [r for r in refs if r in ready_set][:num_returns]
+        in_order_set = set(ready_in_order)
+        not_ready = [r for r in refs if r not in in_order_set]
+        return ready_in_order, not_ready
+
+    async def _object_available(self, ref: ObjectRef):
+        oid = ref.object_id
+        if self.reference_counter.is_owned(oid) or self.memory_store.contains(oid):
+            await self.memory_store.get(oid)
+            return
+        owner = ref.owner_address
+        conn = await self._get_owner_conn(owner)
+        await conn.call("GetObject", {"object_id": oid.binary(),
+                                      "timeout": 3600.0})
+
+    # ------------------------------------------------------- task submission
+
+    def submit_task(self, fn_key: str, name: str, args: List[Any],
+                    num_returns: int = 1, resources: Dict[str, float] | None = None,
+                    max_retries: int | None = None,
+                    retry_exceptions: bool = False,
+                    placement_group_id: bytes = b"",
+                    placement_group_bundle_index: int = -1,
+                    scheduling_strategy: str = "DEFAULT",
+                    runtime_env: Dict | None = None) -> List[ObjectRef]:
+        task_id = TaskID.of(ActorID(self._driver_task_id.actor_id().binary())) \
+            if self.mode == "driver" else TaskID.of(
+                TaskID(self._current_task_id or self._driver_task_id.binary())
+                .actor_id())
+        prepared_args, arg_holds = self._prepare_args(args)
+        spec = TaskSpec(
+            task_id=task_id.binary(), job_id=self.job_id,
+            task_type=TASK_NORMAL, name=name, fn_key=fn_key,
+            args=prepared_args,
+            num_returns=num_returns,
+            resources=resources or {"CPU": 1.0},
+            max_retries=self.config.task_max_retries_default
+            if max_retries is None else max_retries,
+            retry_exceptions=retry_exceptions,
+            owner_address=self.address, owner_worker_id=self.worker_id,
+            placement_group_id=placement_group_id,
+            placement_group_bundle_index=placement_group_bundle_index,
+            scheduling_strategy=scheduling_strategy,
+            runtime_env=runtime_env)
+        return self._register_and_submit(spec, arg_holds)
+
+    def _register_and_submit(self, spec: TaskSpec,
+                             arg_holds: Optional[List[ObjectRef]] = None
+                             ) -> List[ObjectRef]:
+        task_id = TaskID(spec.task_id)
+        return_ids = [task_id.object_id(i + 1) for i in range(spec.num_returns)]
+        refs = []
+        for oid in return_ids:
+            self.reference_counter.add_owned_object(oid, pin_lineage=True)
+            refs.append(ObjectRef(oid, owner_address=self.address, worker=self,
+                                  call_site=spec.name))
+        entry = PendingTaskEntry(spec, return_ids)
+        self.pending_tasks[spec.task_id] = entry
+        arg_oids = [ObjectID(b) for b in spec.dependency_ids()]
+        self.reference_counter.update_submitted_task_references(arg_oids)
+        del arg_holds  # promoted args now pinned by submitted-ref counts
+        self.stats["tasks_submitted"] += 1
+        self._fire_and_forget(self._submit_when_ready(spec))
+        return refs
+
+    def _prepare_args(self, args: List[Any]):
+        """Inline small values; pass ObjectRefs and big values by reference
+        (reference: prepare_args in _raylet.pyx — the
+        max_direct_call_object_size threshold). Returns (task_args, holds):
+        ``holds`` keeps promoted-arg ObjectRefs alive until the caller has
+        registered submitted-task references for them."""
+        holds: List[ObjectRef] = []
+        out: List[TaskArg] = []
+        for a in args:
+            if isinstance(a, ObjectRef):
+                owner = a.owner_address or \
+                    self.reference_counter.owner_address_of(a.object_id) or \
+                    self.address
+                out.append(TaskArg(ARG_REF, object_id=a.object_id.binary(),
+                                   owner_address=owner))
+                continue
+            serialized = self.serialization_context.serialize(a)
+            if serialized.total_bytes() <= self.config.max_direct_call_object_size \
+                    and not serialized.contained_refs:
+                meta, frames = serialized.to_wire()
+                out.append(TaskArg(ARG_VALUE, metadata=meta, frames=frames))
+            else:
+                # Too big (or carries refs needing ownership tracking):
+                # promote to a put + by-reference arg.
+                ref = self.put(a)
+                out.append(TaskArg(ARG_REF, object_id=ref.object_id.binary(),
+                                   owner_address=self.address))
+                holds.append(ref)
+        return out, holds
+
+    async def _submit_when_ready(self, spec: TaskSpec):
+        """Local dependency resolution (reference: LocalDependencyResolver):
+        wait until every owned arg is available before asking for a lease;
+        borrowed args resolve at the executing worker."""
+        for dep in spec.dependency_ids():
+            oid = ObjectID(dep)
+            if self.reference_counter.is_owned(oid):
+                try:
+                    await self.memory_store.get(oid)
+                except Exception:
+                    pass
+        await self._submit_to_key(spec)
+
+    async def _submit_to_key(self, spec: TaskSpec):
+        sc = spec.scheduling_class
+        state = self.scheduling_keys.get(sc)
+        if state is None:
+            state = self.scheduling_keys[sc] = SchedulingKeyState(spec.resources)
+        state.queue.append(spec)
+        await self._pump_scheduling_key(sc, state)
+
+    async def _pump_scheduling_key(self, sc: int, state: SchedulingKeyState):
+        cap = self.config.max_tasks_in_flight_per_worker
+        while state.queue:
+            worker = min((w for w in state.workers if w.inflight < cap),
+                         key=lambda w: w.inflight, default=None)
+            if worker is None:
+                if state.pending_lease < 1 + len(state.queue) // (cap * 4):
+                    state.pending_lease += 1
+                    asyncio.get_running_loop().create_task(
+                        self._request_lease(sc, state, self.raylet_address))
+                return
+            spec = state.queue.pop(0)
+            worker.inflight += 1
+            asyncio.get_running_loop().create_task(
+                self._push_task(sc, state, worker, spec))
+
+    async def _request_lease(self, sc: int, state: SchedulingKeyState,
+                             raylet_address: str, depth: int = 0):
+        try:
+            if raylet_address == self.raylet_address:
+                conn = self.raylet_conn
+            else:
+                conn = await self._get_owner_conn(raylet_address)
+            sample = state.queue[0] if state.queue else None
+            summary = sample.lease_summary() if sample is not None else {
+                "task_id": b"", "scheduling_class": sc,
+                "resources": state.resources, "deps": [],
+                "strategy": "DEFAULT", "pg_id": b"", "pg_bundle": -1,
+                "runtime_env": None, "depth": 0, "name": ""}
+            reply, _ = await conn.call("RequestWorkerLease", {"summary": summary})
+        except (ConnectionError, asyncio.CancelledError):
+            state.pending_lease -= 1
+            return
+        if reply.get("granted"):
+            try:
+                wconn = await rpc.connect(reply["worker_address"],
+                                          peer_name="leased-worker")
+            except ConnectionError:
+                state.pending_lease -= 1
+                return
+            lw = LeasedWorker(reply["worker_address"], reply["lease_id"],
+                              reply["node_id"], wconn, raylet_address,
+                              reply["worker_id"])
+            state.workers.append(lw)
+            state.pending_lease -= 1
+            wconn.on_disconnect.append(
+                lambda c: self._on_leased_worker_died(sc, state, lw))
+            await self._pump_scheduling_key(sc, state)
+        elif reply.get("spill") and depth < 4:
+            await self._request_lease(sc, state, reply["spill"], depth + 1)
+        elif reply.get("infeasible"):
+            state.pending_lease -= 1
+            self._fail_queued_tasks(state, exc.RaySystemError(
+                f"task requires infeasible resources {state.resources}"))
+        else:
+            state.pending_lease -= 1
+
+    def _fail_queued_tasks(self, state: SchedulingKeyState, error: BaseException):
+        for spec in state.queue:
+            self._store_error_for_task(spec, error)
+        state.queue.clear()
+
+    def _on_leased_worker_died(self, sc, state, lw: LeasedWorker):
+        if lw in state.workers:
+            state.workers.remove(lw)
+        self._fire_and_forget(self._return_lease(lw, worker_died=True))
+
+    async def _return_lease(self, lw: LeasedWorker, worker_died: bool = False):
+        try:
+            if lw.raylet_address == self.raylet_address:
+                conn = self.raylet_conn
+            else:
+                conn = await self._get_owner_conn(lw.raylet_address)
+            await conn.call("ReturnWorker", {
+                "lease_id": lw.lease_id, "worker_died": worker_died})
+        except ConnectionError:
+            pass
+        if not lw.conn.closed:
+            await lw.conn.close()
+
+    async def _push_task(self, sc: int, state: SchedulingKeyState,
+                         lw: LeasedWorker, spec: TaskSpec):
+        header, frames = spec.to_wire()
+        try:
+            reply, rbufs = await lw.conn.call("PushTask", header, bufs=frames)
+        except ConnectionError:
+            lw.inflight -= 1
+            entry = self.pending_tasks.get(spec.task_id)
+            if entry is not None and entry.num_retries_left != 0:
+                if entry.num_retries_left > 0:
+                    entry.num_retries_left -= 1
+                self.stats["tasks_retried"] += 1
+                logger.info("retrying task %s after worker death", spec.name)
+                await self._submit_to_key(spec)
+            else:
+                self._store_error_for_task(
+                    spec, exc.WorkerCrashedError(
+                        f"worker died executing {spec.name}"))
+            return
+        lw.inflight -= 1
+        self._complete_task(spec, reply, rbufs)
+        # Reuse or return the lease.
+        if state.queue:
+            await self._pump_scheduling_key(sc, state)
+        elif lw.inflight == 0:
+            if lw in state.workers:
+                state.workers.remove(lw)
+            await self._return_lease(lw)
+
+    def _complete_task(self, spec: TaskSpec, reply: dict, rbufs: List[bytes]):
+        """Handle a task reply: land return values in the memory store /
+        record plasma locations (reference: TaskManager::CompletePendingTask)."""
+        entry = self.pending_tasks.get(spec.task_id)
+        if entry is None:
+            return
+        if reply.get("status") == "error" and spec.retry_exceptions and \
+                entry.num_retries_left != 0:
+            if entry.num_retries_left > 0:
+                entry.num_retries_left -= 1
+            self.stats["tasks_retried"] += 1
+            self._fire_and_forget(self._submit_to_key(spec))
+            return
+        returns = reply.get("returns", [])
+        for ret in returns:
+            oid = ObjectID(ret["object_id"])
+            if ret.get("in_plasma"):
+                self.reference_counter.add_location(oid, ret["node_id"])
+                self.memory_store.put(oid, IN_PLASMA)
+            else:
+                start, n = ret["frame_start"], ret["num_frames"]
+                obj = SerializedObject(ret["metadata"], rbufs[start:start + n])
+                contained = [ObjectID(b) for b in ret.get("contained", [])]
+                if contained:
+                    self.reference_counter.add_contained_refs(oid, contained)
+                    obj.contained_refs = contained
+                self.memory_store.put(oid, obj)
+        self.stats["tasks_finished"] += 1
+        if not spec.is_actor_task():
+            self.reference_counter.update_finished_task_references(
+                [ObjectID(b) for b in spec.dependency_ids()])
+        # Lineage stays for reconstruction; drop spec args to bound memory.
+        if not self.config.lineage_reconstruction_enabled:
+            self.pending_tasks.pop(spec.task_id, None)
+
+    def _store_error_for_task(self, spec: TaskSpec, error: BaseException):
+        serialized = self.serialization_context.serialize_error(error)
+        task_id = TaskID(spec.task_id)
+        for i in range(spec.num_returns):
+            self.memory_store.put(task_id.object_id(i + 1), serialized)
+        self.reference_counter.update_finished_task_references(
+            [ObjectID(b) for b in spec.dependency_ids()])
+
+    # ------------------------------------------------------------- actors
+
+    def register_actor_handle_factory(self, factory):
+        self._actor_handle_factory = factory
+
+    def create_actor(self, fn_key: str, name: str, args: List[Any],
+                     actor_name: str = "", namespace: str = "",
+                     max_restarts: int = 0, max_concurrency: int = 1,
+                     resources: Dict[str, float] | None = None,
+                     is_asyncio: bool = False,
+                     placement_group_id: bytes = b"",
+                     placement_group_bundle_index: int = -1,
+                     max_pending_calls: int = -1) -> bytes:
+        actor_id = ActorID.of(JobID(self.job_id)).binary()
+        prepared_args, arg_holds = self._prepare_args(args)
+        spec = TaskSpec(
+            task_id=TaskID.of(ActorID(actor_id)).binary(), job_id=self.job_id,
+            task_type=TASK_ACTOR_CREATION, name=name, fn_key=fn_key,
+            args=prepared_args, num_returns=0,
+            resources=resources or {"CPU": 1.0},
+            owner_address=self.address, owner_worker_id=self.worker_id,
+            actor_id=actor_id,
+            actor_creation={"max_restarts": max_restarts,
+                            "max_concurrency": max_concurrency,
+                            "is_asyncio": is_asyncio,
+                            "name": actor_name, "namespace": namespace},
+            placement_group_id=placement_group_id,
+            placement_group_bundle_index=placement_group_bundle_index)
+        header, frames = spec.to_wire()
+        header["resources"] = spec.resources
+        header["pg_id"] = placement_group_id
+        header["pg_bundle"] = placement_group_bundle_index
+        self._run(self.gcs_conn.call("RegisterActor", {
+            "actor_id": actor_id, "spec": header,
+            "name": actor_name, "namespace": namespace,
+            "max_restarts": max_restarts, "job_id": self.job_id,
+        }, bufs=frames))
+        q = ActorQueueState(actor_id)
+        q.max_pending = max_pending_calls
+        self.actor_queues[actor_id] = q
+        # Actor-creation args stay pinned for the actor's restarts: keep the
+        # holds on the queue state (freed when the queue is dropped).
+        q.creation_arg_holds = arg_holds  # type: ignore[attr-defined]
+        return actor_id
+
+    def submit_actor_task(self, actor_id: bytes, fn_key: str, name: str,
+                          args: List[Any], num_returns: int = 1,
+                          max_task_retries: int = 0) -> List[ObjectRef]:
+        # (4) backpressure: enforce max_pending_calls before queueing.
+        q = self.actor_queues.get(actor_id)
+        if q is not None and q.max_pending >= 0 and \
+                len(q.buffer) + len(q.inflight) >= q.max_pending:
+            raise exc.PendingCallsLimitExceeded(
+                f"actor has {len(q.buffer) + len(q.inflight)} pending calls "
+                f"(max_pending_calls={q.max_pending})")
+        task_id = TaskID.of(ActorID(actor_id))
+        prepared_args, arg_holds = self._prepare_args(args)
+        spec = TaskSpec(
+            task_id=task_id.binary(), job_id=self.job_id,
+            task_type=TASK_ACTOR, name=name, fn_key=fn_key,
+            args=prepared_args, num_returns=num_returns,
+            resources={}, max_retries=max_task_retries,
+            owner_address=self.address, owner_worker_id=self.worker_id,
+            actor_id=actor_id)
+        return_ids = [task_id.object_id(i + 1) for i in range(num_returns)]
+        refs = []
+        for oid in return_ids:
+            self.reference_counter.add_owned_object(oid)
+            refs.append(ObjectRef(oid, owner_address=self.address, worker=self,
+                                  call_site=name))
+        self.pending_tasks[spec.task_id] = PendingTaskEntry(spec, return_ids)
+        arg_oids = [ObjectID(b) for b in spec.dependency_ids()]
+        self.reference_counter.update_submitted_task_references(arg_oids)
+        del arg_holds
+        self.stats["actor_tasks_submitted"] += 1
+        self._fire_and_forget(self._submit_actor_task_async(spec))
+        return refs
+
+    async def _submit_actor_task_async(self, spec: TaskSpec):
+        q = self.actor_queues.get(spec.actor_id)
+        if q is None:
+            q = self.actor_queues[spec.actor_id] = ActorQueueState(spec.actor_id)
+        if q.state == "DEAD":
+            self._store_error_for_task(
+                spec, exc.ActorDiedError(q.death_cause or "actor is dead"))
+            return
+        # Dependency resolution mirrors normal tasks.
+        for dep in spec.dependency_ids():
+            oid = ObjectID(dep)
+            if self.reference_counter.is_owned(oid):
+                try:
+                    await self.memory_store.get(oid)
+                except Exception:
+                    pass
+        seqno = q.seqno
+        q.seqno += 1
+        q.buffer.append((spec, seqno))
+        await self._pump_actor_queue(q)
+
+    async def _pump_actor_queue(self, q: ActorQueueState):
+        if q.state == "DEAD":
+            for spec, _ in q.buffer:
+                self._store_error_for_task(
+                    spec, exc.ActorDiedError(q.death_cause or "actor is dead"))
+            q.buffer.clear()
+            return
+        if q.conn is None or q.conn.closed:
+            if not q.resolving:
+                q.resolving = True
+                asyncio.get_running_loop().create_task(self._resolve_actor(q))
+            return
+        while q.buffer:
+            spec, seqno = q.buffer.pop(0)
+            q.inflight[seqno] = (spec, 0)
+            asyncio.get_running_loop().create_task(
+                self._push_actor_task(q, spec, seqno))
+
+    async def _resolve_actor(self, q: ActorQueueState):
+        try:
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                if q.conn is not None and not q.conn.closed and \
+                        q.state == "ALIVE":
+                    return  # a concurrent resolve already connected
+                if self.gcs_conn is None or self.gcs_conn.closed:
+                    return
+                reply, _ = await self.gcs_conn.call(
+                    "GetActorInfo", {"actor_id": q.actor_id})
+                if not reply.get("found"):
+                    await asyncio.sleep(0.05)
+                    continue
+                if reply["state"] == "ALIVE" and \
+                        reply["incarnation"] != q.incarnation:
+                    try:
+                        q.conn = await rpc.connect(
+                            reply["address"], peer_name="actor")
+                    except ConnectionError:
+                        await asyncio.sleep(0.05)
+                        continue
+                    q.address = reply["address"]
+                    q.state = "ALIVE"
+                    restarted = q.incarnation != -1
+                    q.incarnation = reply["incarnation"]
+                    if restarted:
+                        # Fresh worker expects seqno 0: renumber the stream
+                        # (reference: the submitter resets sequence state on
+                        # actor restart, direct_actor_transport.h).
+                        q.buffer = [(spec, i)
+                                    for i, (spec, _) in enumerate(q.buffer)]
+                        q.seqno = len(q.buffer)
+                    q.conn.on_disconnect.append(
+                        lambda c: self._on_actor_conn_lost(q))
+                    await self._pump_actor_queue(q)
+                    return
+                if reply["state"] == "DEAD":
+                    q.state = "DEAD"
+                    q.death_cause = reply.get("death_cause", "actor died")
+                    await self._pump_actor_queue(q)
+                    return
+                await asyncio.sleep(0.05)
+            q.state = "DEAD"
+            q.death_cause = "timed out resolving actor location"
+            await self._pump_actor_queue(q)
+        finally:
+            q.resolving = False
+
+    def _on_actor_conn_lost(self, q: ActorQueueState):
+        """Actor worker connection dropped: requeue retryable inflight tasks
+        and re-resolve (the actor may be restarting). Tasks without retries
+        fail with ActorDiedError (reference: max_task_retries semantics in
+        direct_actor_transport.h)."""
+        q.conn = None
+        q.state = "RESOLVING"
+        inflight = sorted(q.inflight.items())
+        q.inflight.clear()
+        requeue = []
+        for seqno, (spec, _) in inflight:
+            entry = self.pending_tasks.get(spec.task_id)
+            retries_left = entry.num_retries_left if entry else 0
+            if retries_left != 0:
+                if entry and entry.num_retries_left > 0:
+                    entry.num_retries_left -= 1
+                self.stats["tasks_retried"] += 1
+                requeue.append((spec, seqno))
+            else:
+                self._store_error_for_task(spec, exc.ActorDiedError(
+                    "actor worker died before the call completed"))
+        q.buffer = requeue + q.buffer
+        self._fire_and_forget(self._pump_actor_queue(q))
+
+    async def _push_actor_task(self, q: ActorQueueState, spec: TaskSpec,
+                               seqno: int):
+        header, frames = spec.to_wire()
+        header["seqno"] = seqno
+        header["incarnation"] = q.incarnation
+        try:
+            reply, rbufs = await q.conn.call("PushActorTask", header, bufs=frames)
+        except ConnectionError:
+            # Conn-lost handler requeues; nothing to do here.
+            return
+        q.inflight.pop(seqno, None)
+        if reply.get("status") == "actor_restarting":
+            q.buffer.insert(0, (spec, seqno))
+            return
+        self._complete_task(spec, reply, rbufs)
+        self.reference_counter.update_finished_task_references(
+            [ObjectID(b) for b in spec.dependency_ids()])
+
+    def kill_actor(self, actor_id: bytes, no_restart: bool = True):
+        self._run(self.gcs_conn.call("KillActor", {
+            "actor_id": actor_id, "no_restart": no_restart}))
+
+    async def _handle_published(self, conn, header, bufs):
+        if header["channel"] == "ACTOR":
+            msg = header["msg"]
+            q = self.actor_queues.get(msg["actor_id"])
+            if q is None:
+                return {}
+            if msg["state"] == "ALIVE" and msg["incarnation"] != q.incarnation:
+                if not q.resolving:
+                    q.resolving = True
+                    asyncio.get_running_loop().create_task(self._resolve_actor(q))
+            elif msg["state"] == "DEAD":
+                q.state = "DEAD"
+                q.death_cause = msg.get("reason", "actor died")
+                await self._pump_actor_queue(q)
+            elif msg["state"] == "RESTARTING":
+                q.state = "RESOLVING"
+        return {}
+
+    # ------------------------------------------------------------ profiling
+
+    def add_task_event(self, event: dict):
+        if self.config.profiling_enabled:
+            self._task_events.append(event)
+
+    async def _profile_flush_loop(self):
+        period = self.config.metrics_report_period_ms / 1000.0
+        while not self._shutdown:
+            await asyncio.sleep(period)
+            if self._task_events and self.gcs_conn and not self.gcs_conn.closed:
+                events, self._task_events = self._task_events, []
+                try:
+                    await self.gcs_conn.call("AddProfileEvents",
+                                             {"events": events})
+                except ConnectionError:
+                    return

@@ -1,0 +1,69 @@
+"""Function/actor-class distribution via the GCS KV store.
+
+Role parity: reference FunctionActorManager + ImportThread
+(python/ray/_private/function_manager.py, _private/import_thread.py): the
+driver pickles the function/class once, exports it to the GCS KV under a
+content-hash key; workers fetch-and-cache on first execution of a task
+naming that key (pull-based instead of the reference's push/import-thread —
+no work for functions a worker never runs).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import cloudpickle
+
+FN_KV_PREFIX = b"fn:"
+
+
+class FunctionManager:
+    def __init__(self, kv_put, kv_get):
+        """kv_put(key: bytes, value: bytes) / kv_get(key: bytes) -> bytes are
+        synchronous callables bound to the GCS client."""
+        self._kv_put = kv_put
+        self._kv_get = kv_get
+        self._lock = threading.Lock()
+        self._exported: set[str] = set()
+        self._cache: Dict[str, Any] = {}
+        self._pickled_cache: Dict[str, bytes] = {}
+
+    def export(self, fn: Any) -> str:
+        """Pickle and export; returns the content-hash key."""
+        pickled = cloudpickle.dumps(fn)
+        key = hashlib.sha1(pickled).hexdigest()
+        self.export_prepickled(key, pickled, fn)
+        return key
+
+    def prepare(self, fn: Any):
+        """Pickle once; returns (key, pickled) for caching by the caller."""
+        pickled = cloudpickle.dumps(fn)
+        return hashlib.sha1(pickled).hexdigest(), pickled
+
+    def export_prepickled(self, key: str, pickled: bytes, fn: Any = None) -> None:
+        """Idempotent per-cluster export. The ``_exported`` set lives on this
+        core worker, so a decorated function reused across clusters
+        re-exports to each new GCS."""
+        with self._lock:
+            if key in self._exported:
+                return
+        self._kv_put(FN_KV_PREFIX + key.encode(), pickled)
+        with self._lock:
+            self._exported.add(key)
+            if fn is not None:
+                self._cache[key] = fn
+            self._pickled_cache[key] = pickled
+
+    def fetch(self, key: str) -> Any:
+        with self._lock:
+            if key in self._cache:
+                return self._cache[key]
+        pickled = self._kv_get(FN_KV_PREFIX + key.encode())
+        if pickled is None:
+            raise RuntimeError(f"function {key} not found in GCS KV")
+        fn = cloudpickle.loads(pickled)
+        with self._lock:
+            self._cache[key] = fn
+        return fn

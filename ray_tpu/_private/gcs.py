@@ -1,0 +1,606 @@
+"""GCS: the global control service (head process).
+
+Role parity: reference GCS server (src/ray/gcs/gcs_server/) — node table +
+liveness (GcsHeartbeatManager), actor table + scheduling + restart policy
+(GcsActorManager/GcsActorScheduler), job table (GcsJobManager), KV store
+(GcsKvManager / internal KV), pubsub fanout (C27 long-poll pubsub; here:
+push messages over persistent subscriber connections), placement groups
+(GcsPlacementGroupManager, 2PC reserve/commit against raylets), and a
+resource view for scheduling (GcsResourceManager).
+
+State is kept in process memory with an optional append-only journal for
+restart recovery (the analog of GcsTableStorage over the in-memory store
+client; Redis is deliberately not a dependency).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private import rpc
+from ray_tpu._private.config import RayTpuConfig
+from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID
+
+logger = logging.getLogger(__name__)
+
+# Actor states (reference: rpc::ActorTableData states in gcs.proto).
+ACTOR_PENDING = "PENDING_CREATION"
+ACTOR_ALIVE = "ALIVE"
+ACTOR_RESTARTING = "RESTARTING"
+ACTOR_DEAD = "DEAD"
+
+PG_PENDING = "PENDING"
+PG_CREATED = "CREATED"
+PG_REMOVED = "REMOVED"
+
+
+class NodeEntry:
+    def __init__(self, node_id: bytes, address: str, resources: Dict[str, float],
+                 node_name: str = ""):
+        self.node_id = node_id
+        self.address = address
+        self.node_name = node_name
+        self.resources_total = dict(resources)
+        self.resources_available = dict(resources)
+        self.last_heartbeat = time.time()
+        self.alive = True
+        self.conn: Optional[rpc.Connection] = None
+
+
+class ActorEntry:
+    def __init__(self, actor_id: bytes, spec_header: dict, spec_frames: List[bytes],
+                 name: str = "", namespace: str = "", max_restarts: int = 0,
+                 job_id: bytes = b""):
+        self.actor_id = actor_id
+        self.spec_header = spec_header
+        self.spec_frames = spec_frames
+        self.name = name
+        self.namespace = namespace
+        self.max_restarts = max_restarts
+        self.num_restarts = 0
+        self.job_id = job_id
+        self.state = ACTOR_PENDING
+        self.address = ""          # actor worker's RPC address once alive
+        self.node_id = b""
+        self.death_cause = ""
+        self.incarnation = 0
+
+
+class GcsServer:
+    def __init__(self, config: RayTpuConfig):
+        self.config = config
+        self.nodes: Dict[bytes, NodeEntry] = {}
+        self.actors: Dict[bytes, ActorEntry] = {}
+        self.named_actors: Dict[Tuple[str, str], bytes] = {}
+        self.jobs: Dict[bytes, dict] = {}
+        self.kv: Dict[bytes, bytes] = {}
+        self.placement_groups: Dict[bytes, dict] = {}
+        self._job_counter = itertools.count(1)
+        self._subscribers: Dict[str, List[rpc.Connection]] = {}
+        self._server = rpc.RpcServer(self._handlers(), name="gcs")
+        self._node_rr = 0
+        self._monitor_task: Optional[asyncio.Task] = None
+        self._profile_events: List[dict] = []
+        self._cluster_events: List[dict] = []
+
+    # ------------------------------------------------------------------ wiring
+
+    def _handlers(self):
+        return {
+            "RegisterNode": self.handle_register_node,
+            "Heartbeat": self.handle_heartbeat,
+            "GetAllNodeInfo": self.handle_get_all_node_info,
+            "DrainNode": self.handle_drain_node,
+            "RegisterActor": self.handle_register_actor,
+            "ReportActorAlive": self.handle_report_actor_alive,
+            "ReportActorDeath": self.handle_report_actor_death,
+            "GetActorInfo": self.handle_get_actor_info,
+            "GetNamedActor": self.handle_get_named_actor,
+            "ListNamedActors": self.handle_list_named_actors,
+            "KillActor": self.handle_kill_actor,
+            "AddJob": self.handle_add_job,
+            "MarkJobFinished": self.handle_mark_job_finished,
+            "GetAllJobInfo": self.handle_get_all_job_info,
+            "KVPut": self.handle_kv_put,
+            "KVGet": self.handle_kv_get,
+            "KVDel": self.handle_kv_del,
+            "KVKeys": self.handle_kv_keys,
+            "Subscribe": self.handle_subscribe,
+            "Publish": self.handle_publish,
+            "CreatePlacementGroup": self.handle_create_placement_group,
+            "RemovePlacementGroup": self.handle_remove_placement_group,
+            "GetPlacementGroup": self.handle_get_placement_group,
+            "ReportResourceUsage": self.handle_report_resource_usage,
+            "GetClusterResources": self.handle_get_cluster_resources,
+            "AddProfileEvents": self.handle_add_profile_events,
+            "GetProfileEvents": self.handle_get_profile_events,
+            "AddClusterEvent": self.handle_add_cluster_event,
+            "GetClusterEvents": self.handle_get_cluster_events,
+        }
+
+    async def start(self, address: str = "") -> str:
+        addr = await self._server.listen(address)
+        self._monitor_task = asyncio.get_running_loop().create_task(
+            self._liveness_monitor())
+        logger.info("GCS listening at %s", addr)
+        return addr
+
+    async def stop(self):
+        if self._monitor_task:
+            self._monitor_task.cancel()
+        await self._server.close()
+
+    # --------------------------------------------------------------- pubsub
+
+    async def _publish(self, channel: str, message: Any):
+        dead = []
+        for conn in self._subscribers.get(channel, []):
+            try:
+                await conn.push("Published", {"channel": channel, "msg": message})
+            except ConnectionError:
+                dead.append(conn)
+        for conn in dead:
+            self._subscribers[channel].remove(conn)
+
+    async def handle_subscribe(self, conn, header, bufs):
+        channel = header["channel"]
+        subs = self._subscribers.setdefault(channel, [])
+        if conn not in subs:
+            subs.append(conn)
+            conn.on_disconnect.append(
+                lambda c: subs.remove(c) if c in subs else None)
+        return {"ok": True}
+
+    async def handle_publish(self, conn, header, bufs):
+        await self._publish(header["channel"], header["msg"])
+        return {"ok": True}
+
+    # --------------------------------------------------------------- nodes
+
+    async def handle_register_node(self, conn, header, bufs):
+        entry = NodeEntry(header["node_id"], header["address"],
+                          header["resources"], header.get("node_name", ""))
+        entry.conn = conn
+        self.nodes[entry.node_id] = entry
+        conn.tags["node_id"] = entry.node_id
+        conn.on_disconnect.append(
+            lambda c: asyncio.get_event_loop().create_task(
+                self._on_node_connection_lost(entry.node_id)))
+        await self._publish("NODE", {"event": "alive",
+                                     "node_id": entry.node_id,
+                                     "address": entry.address,
+                                     "resources": entry.resources_total})
+        return {"ok": True, "num_nodes": len(self.nodes)}
+
+    async def handle_heartbeat(self, conn, header, bufs):
+        entry = self.nodes.get(header["node_id"])
+        if entry is None:
+            return {"ok": False, "reason": "unknown node"}
+        entry.last_heartbeat = time.time()
+        if "resources_available" in header:
+            entry.resources_available = header["resources_available"]
+        return {"ok": True}
+
+    async def handle_report_resource_usage(self, conn, header, bufs):
+        entry = self.nodes.get(header["node_id"])
+        if entry is not None:
+            entry.resources_available = header["resources_available"]
+        return {"ok": True}
+
+    async def handle_get_all_node_info(self, conn, header, bufs):
+        return {"nodes": [{
+            "node_id": n.node_id, "address": n.address, "alive": n.alive,
+            "node_name": n.node_name,
+            "resources_total": n.resources_total,
+            "resources_available": n.resources_available,
+        } for n in self.nodes.values()]}
+
+    async def handle_get_cluster_resources(self, conn, header, bufs):
+        total: Dict[str, float] = {}
+        avail: Dict[str, float] = {}
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            for k, v in n.resources_total.items():
+                total[k] = total.get(k, 0.0) + v
+            for k, v in n.resources_available.items():
+                avail[k] = avail.get(k, 0.0) + v
+        return {"total": total, "available": avail}
+
+    async def handle_drain_node(self, conn, header, bufs):
+        await self._mark_node_dead(header["node_id"], "drained")
+        return {"ok": True}
+
+    async def _on_node_connection_lost(self, node_id: bytes):
+        await self._mark_node_dead(node_id, "connection lost")
+
+    async def _mark_node_dead(self, node_id: bytes, reason: str):
+        entry = self.nodes.get(node_id)
+        if entry is None or not entry.alive:
+            return
+        entry.alive = False
+        logger.warning("node %s marked dead: %s", node_id.hex()[:8], reason)
+        await self._publish("NODE", {"event": "dead", "node_id": node_id,
+                                     "reason": reason})
+        # Actors on the dead node die / restart (reference:
+        # GcsActorManager::OnNodeDead).
+        for actor in list(self.actors.values()):
+            if actor.node_id == node_id and actor.state == ACTOR_ALIVE:
+                await self._on_actor_failure(actor, f"node died: {reason}")
+
+    async def _liveness_monitor(self):
+        period = self.config.raylet_heartbeat_period_ms / 1000.0
+        timeout = period * self.config.num_heartbeats_timeout
+        while True:
+            await asyncio.sleep(period)
+            now = time.time()
+            for node in list(self.nodes.values()):
+                if node.alive and now - node.last_heartbeat > timeout:
+                    await self._mark_node_dead(node.node_id, "heartbeat timeout")
+
+    # --------------------------------------------------------------- actors
+
+    def _pick_node_for_actor(self, resources: Dict[str, float]) -> Optional[NodeEntry]:
+        """Resource-feasible round robin (the GcsBased strategy's spirit:
+        GCS picks the node using its resource view, reference:
+        gcs_actor_distribution.h)."""
+        alive = [n for n in self.nodes.values() if n.alive]
+        if not alive:
+            return None
+        feasible = [n for n in alive
+                    if all(n.resources_total.get(k, 0.0) >= v
+                           for k, v in resources.items() if v > 0)]
+        if not feasible:
+            return None
+        best = sorted(
+            feasible,
+            key=lambda n: sum(n.resources_available.get(k, 0.0)
+                              for k in ("CPU",)),
+            reverse=True)
+        self._node_rr += 1
+        return best[self._node_rr % max(1, min(2, len(best)))] \
+            if len(best) > 1 else best[0]
+
+    async def handle_register_actor(self, conn, header, bufs):
+        actor = ActorEntry(
+            actor_id=header["actor_id"],
+            spec_header=header["spec"],
+            spec_frames=list(bufs),
+            name=header.get("name") or "",
+            namespace=header.get("namespace") or "",
+            max_restarts=header.get("max_restarts", 0),
+            job_id=header.get("job_id", b""),
+        )
+        if actor.name:
+            key = (actor.namespace, actor.name)
+            if key in self.named_actors:
+                existing_id = self.named_actors[key]
+                existing = self.actors.get(existing_id)
+                if existing is not None and existing.state != ACTOR_DEAD:
+                    raise ValueError(
+                        f"actor name {actor.name!r} already taken in "
+                        f"namespace {actor.namespace!r}")
+            self.named_actors[key] = actor.actor_id
+        self.actors[actor.actor_id] = actor
+        asyncio.get_running_loop().create_task(self._schedule_actor(actor))
+        return {"ok": True}
+
+    async def _schedule_actor(self, actor: ActorEntry):
+        resources = actor.spec_header.get("resources", {"CPU": 1.0})
+        deadline = time.time() + 60.0
+        while time.time() < deadline:
+            node = self._pick_node_for_actor(resources)
+            if node is not None and node.conn is not None and not node.conn.closed:
+                try:
+                    reply, _ = await node.conn.call(
+                        "ScheduleActorCreation",
+                        {"actor_id": actor.actor_id,
+                         "spec": actor.spec_header,
+                         "incarnation": actor.incarnation},
+                        bufs=actor.spec_frames)
+                    if reply.get("ok"):
+                        actor.node_id = node.node_id
+                        # Raylet reports ReportActorAlive when the worker has
+                        # the instance constructed.
+                        return
+                    logger.warning("actor scheduling on node %s rejected: %s",
+                                   node.node_id.hex()[:8], reply.get("reason"))
+                except ConnectionError:
+                    pass
+            await asyncio.sleep(0.2)
+        await self._fail_actor(actor, "no feasible node for actor")
+
+    async def handle_report_actor_alive(self, conn, header, bufs):
+        actor = self.actors.get(header["actor_id"])
+        if actor is None:
+            return {"ok": False}
+        actor.state = ACTOR_ALIVE
+        actor.address = header["address"]
+        actor.node_id = header.get("node_id", actor.node_id)
+        await self._publish("ACTOR", {
+            "actor_id": actor.actor_id, "state": ACTOR_ALIVE,
+            "address": actor.address, "incarnation": actor.incarnation})
+        return {"ok": True}
+
+    async def handle_report_actor_death(self, conn, header, bufs):
+        actor = self.actors.get(header["actor_id"])
+        if actor is None:
+            return {"ok": False}
+        if header.get("expected"):
+            # Graceful exit (actor_exit / job teardown): no restart.
+            actor.max_restarts = actor.num_restarts
+        await self._on_actor_failure(actor, header.get("reason", "worker died"))
+        return {"ok": True}
+
+    async def _on_actor_failure(self, actor: ActorEntry, reason: str):
+        if actor.state == ACTOR_DEAD:
+            return
+        if actor.max_restarts == -1 or actor.num_restarts < actor.max_restarts:
+            actor.num_restarts += 1
+            actor.incarnation += 1
+            actor.state = ACTOR_RESTARTING
+            actor.address = ""
+            await self._publish("ACTOR", {
+                "actor_id": actor.actor_id, "state": ACTOR_RESTARTING,
+                "incarnation": actor.incarnation})
+            logger.info("restarting actor %s (%d/%s)", actor.actor_id.hex()[:8],
+                        actor.num_restarts,
+                        "inf" if actor.max_restarts == -1 else actor.max_restarts)
+            asyncio.get_running_loop().create_task(self._schedule_actor(actor))
+        else:
+            await self._fail_actor(actor, reason)
+
+    async def _fail_actor(self, actor: ActorEntry, reason: str):
+        actor.state = ACTOR_DEAD
+        actor.death_cause = reason
+        await self._publish("ACTOR", {
+            "actor_id": actor.actor_id, "state": ACTOR_DEAD, "reason": reason,
+            "incarnation": actor.incarnation})
+
+    async def handle_get_actor_info(self, conn, header, bufs):
+        actor = self.actors.get(header["actor_id"])
+        if actor is None:
+            return {"found": False}
+        return {"found": True, "state": actor.state, "address": actor.address,
+                "name": actor.name, "incarnation": actor.incarnation,
+                "death_cause": actor.death_cause, "node_id": actor.node_id}
+
+    async def handle_get_named_actor(self, conn, header, bufs):
+        key = (header.get("namespace") or "", header["name"])
+        actor_id = self.named_actors.get(key)
+        if actor_id is None:
+            return {"found": False}
+        actor = self.actors.get(actor_id)
+        if actor is None or actor.state == ACTOR_DEAD:
+            return {"found": False}
+        return {"found": True, "actor_id": actor_id, "state": actor.state,
+                "address": actor.address,
+                "spec": actor.spec_header}
+
+    async def handle_list_named_actors(self, conn, header, bufs):
+        ns = header.get("namespace")
+        out = []
+        for (namespace, name), actor_id in self.named_actors.items():
+            actor = self.actors.get(actor_id)
+            if actor is None or actor.state == ACTOR_DEAD:
+                continue
+            if ns is None or ns == namespace:
+                out.append({"namespace": namespace, "name": name,
+                            "actor_id": actor_id})
+        return {"actors": out}
+
+    async def handle_kill_actor(self, conn, header, bufs):
+        actor = self.actors.get(header["actor_id"])
+        if actor is None:
+            return {"ok": False}
+        no_restart = header.get("no_restart", True)
+        if no_restart:
+            actor.max_restarts = actor.num_restarts
+        node = self.nodes.get(actor.node_id)
+        if node is not None and node.conn is not None and not node.conn.closed:
+            try:
+                await node.conn.call("KillActorWorker",
+                                     {"actor_id": actor.actor_id})
+            except ConnectionError:
+                pass
+        if actor.state != ACTOR_DEAD and no_restart:
+            await self._fail_actor(actor, "killed via KillActor")
+        return {"ok": True}
+
+    # --------------------------------------------------------------- jobs
+
+    async def handle_add_job(self, conn, header, bufs):
+        job_id = JobID.from_int(next(self._job_counter)).binary()
+        self.jobs[job_id] = {
+            "job_id": job_id, "driver_address": header.get("driver_address", ""),
+            "start_time": time.time(), "finished": False,
+            "namespace": header.get("namespace", ""),
+            "metadata": header.get("metadata", {}),
+        }
+        return {"job_id": job_id}
+
+    async def handle_mark_job_finished(self, conn, header, bufs):
+        job = self.jobs.get(header["job_id"])
+        if job:
+            job["finished"] = True
+            job["end_time"] = time.time()
+        await self._publish("JOB", {"event": "finished",
+                                    "job_id": header["job_id"]})
+        return {"ok": True}
+
+    async def handle_get_all_job_info(self, conn, header, bufs):
+        return {"jobs": list(self.jobs.values())}
+
+    # --------------------------------------------------------------- KV
+
+    async def handle_kv_put(self, conn, header, bufs):
+        overwrite = header.get("overwrite", True)
+        key = header["key"]
+        if not overwrite and key in self.kv:
+            return {"added": False}
+        self.kv[key] = bufs[0] if bufs else b""
+        return {"added": True}
+
+    async def handle_kv_get(self, conn, header, bufs):
+        val = self.kv.get(header["key"])
+        if val is None:
+            return {"found": False}
+        return {"found": True}, [val]
+
+    async def handle_kv_del(self, conn, header, bufs):
+        existed = self.kv.pop(header["key"], None) is not None
+        return {"deleted": existed}
+
+    async def handle_kv_keys(self, conn, header, bufs):
+        prefix = header.get("prefix", b"")
+        return {"keys": [k for k in self.kv if k.startswith(prefix)]}
+
+    # ------------------------------------------------------- placement groups
+
+    async def handle_create_placement_group(self, conn, header, bufs):
+        """2PC: Prepare bundle resources on chosen nodes, then Commit
+        (reference: GcsPlacementGroupScheduler's prepare/commit RPC pair)."""
+        pg_id = header["pg_id"]
+        bundles = header["bundles"]          # list of {resource: amount}
+        strategy = header.get("strategy", "PACK")
+        pg = {"pg_id": pg_id, "bundles": bundles, "strategy": strategy,
+              "state": PG_PENDING, "bundle_nodes": [], "name": header.get("name", "")}
+        self.placement_groups[pg_id] = pg
+        placement = self._place_bundles(bundles, strategy)
+        if placement is None:
+            pg["state"] = PG_PENDING
+            return {"ok": False, "reason": "infeasible"}
+        prepared: List[Tuple[NodeEntry, int]] = []
+        ok = True
+        for bundle_idx, node in placement:
+            try:
+                reply, _ = await node.conn.call("PreparePGBundle", {
+                    "pg_id": pg_id, "bundle_index": bundle_idx,
+                    "resources": bundles[bundle_idx]})
+                if not reply.get("ok"):
+                    ok = False
+                    break
+                prepared.append((node, bundle_idx))
+            except ConnectionError:
+                ok = False
+                break
+        if not ok:
+            for node, bundle_idx in prepared:
+                try:
+                    await node.conn.call("ReturnPGBundle", {
+                        "pg_id": pg_id, "bundle_index": bundle_idx})
+                except ConnectionError:
+                    pass
+            return {"ok": False, "reason": "prepare failed"}
+        for node, bundle_idx in prepared:
+            await node.conn.call("CommitPGBundle", {
+                "pg_id": pg_id, "bundle_index": bundle_idx})
+        pg["state"] = PG_CREATED
+        pg["bundle_nodes"] = [node.node_id for node, _ in
+                              sorted(prepared, key=lambda p: p[1])]
+        await self._publish("PG", {"pg_id": pg_id, "state": PG_CREATED})
+        return {"ok": True, "bundle_nodes": pg["bundle_nodes"]}
+
+    def _place_bundles(self, bundles, strategy):
+        alive = [n for n in self.nodes.values() if n.alive and n.conn]
+        if not alive:
+            return None
+        placement = []
+        avail = {n.node_id: dict(n.resources_available) for n in alive}
+
+        def fits(node, req):
+            a = avail[node.node_id]
+            return all(a.get(k, 0.0) >= v for k, v in req.items())
+
+        def take(node, req):
+            a = avail[node.node_id]
+            for k, v in req.items():
+                a[k] = a.get(k, 0.0) - v
+
+        if strategy in ("STRICT_PACK",):
+            for n in alive:
+                trial = {n.node_id: dict(avail[n.node_id])}
+                ok = True
+                for b in bundles:
+                    if all(trial[n.node_id].get(k, 0.0) >= v for k, v in b.items()):
+                        for k, v in b.items():
+                            trial[n.node_id][k] -= v
+                    else:
+                        ok = False
+                        break
+                if ok:
+                    for b_idx, b in enumerate(bundles):
+                        take(n, b)
+                        placement.append((b_idx, n))
+                    return placement
+            return None
+        if strategy in ("STRICT_SPREAD",):
+            if len(bundles) > len(alive):
+                return None
+            used: Set[bytes] = set()
+            for b_idx, b in enumerate(bundles):
+                cand = [n for n in alive if n.node_id not in used and fits(n, b)]
+                if not cand:
+                    return None
+                n = cand[0]
+                used.add(n.node_id)
+                take(n, b)
+                placement.append((b_idx, n))
+            return placement
+        # PACK / SPREAD: best-effort ordering preference.
+        order = alive if strategy == "PACK" else sorted(
+            alive, key=lambda n: -sum(avail[n.node_id].values()))
+        for b_idx, b in enumerate(bundles):
+            cand = [n for n in order if fits(n, b)]
+            if not cand:
+                return None
+            n = cand[0] if strategy == "PACK" else cand[b_idx % len(cand)]
+            take(n, b)
+            placement.append((b_idx, n))
+        return placement
+
+    async def handle_remove_placement_group(self, conn, header, bufs):
+        pg = self.placement_groups.get(header["pg_id"])
+        if pg is None:
+            return {"ok": False}
+        for bundle_idx, node_id in enumerate(pg.get("bundle_nodes", [])):
+            node = self.nodes.get(node_id)
+            if node and node.conn and not node.conn.closed:
+                try:
+                    await node.conn.call("ReturnPGBundle", {
+                        "pg_id": pg["pg_id"], "bundle_index": bundle_idx})
+                except ConnectionError:
+                    pass
+        pg["state"] = PG_REMOVED
+        await self._publish("PG", {"pg_id": pg["pg_id"], "state": PG_REMOVED})
+        return {"ok": True}
+
+    async def handle_get_placement_group(self, conn, header, bufs):
+        pg = self.placement_groups.get(header["pg_id"])
+        if pg is None:
+            return {"found": False}
+        return {"found": True, **pg}
+
+    # --------------------------------------------------------------- events
+
+    async def handle_add_profile_events(self, conn, header, bufs):
+        self._profile_events.extend(header["events"])
+        if len(self._profile_events) > 100_000:
+            self._profile_events = self._profile_events[-50_000:]
+        return {"ok": True}
+
+    async def handle_get_profile_events(self, conn, header, bufs):
+        return {"events": self._profile_events}
+
+    async def handle_add_cluster_event(self, conn, header, bufs):
+        self._cluster_events.append(header["event"])
+        if len(self._cluster_events) > 10_000:
+            self._cluster_events = self._cluster_events[-5_000:]
+        return {"ok": True}
+
+    async def handle_get_cluster_events(self, conn, header, bufs):
+        return {"events": self._cluster_events}

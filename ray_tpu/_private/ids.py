@@ -1,0 +1,174 @@
+"""Binary IDs with embedded lineage.
+
+Mirrors the reference ID scheme (reference: src/ray/common/id.h) without
+copying it: fixed-width byte IDs where an ObjectID embeds the TaskID that
+creates it plus a return/put index, and a TaskID embeds the ActorID/JobID it
+belongs to.  This embedding is what makes lineage reconstruction and
+ownership bookkeeping cheap: given an ObjectID you can always recover the
+creating task and the owning job without a directory lookup.
+
+Sizes: JobID 4B, ActorID 16B (job + 12 unique), TaskID 24B (actor + 8
+unique), ObjectID 28B (task + 4B little-endian index), NodeID/WorkerID 28B
+random, PlacementGroupID 16B.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+JOB_ID_SIZE = 4
+ACTOR_ID_SIZE = 16
+TASK_ID_SIZE = 24
+OBJECT_ID_SIZE = 28
+UNIQUE_ID_SIZE = 28
+PLACEMENT_GROUP_ID_SIZE = 16
+
+_MAX_INDEX = 2**32 - 1
+
+
+class BaseID:
+    SIZE = 0
+    __slots__ = ("_bytes", "_hash")
+
+    def __init__(self, id_bytes: bytes):
+        if len(id_bytes) != self.SIZE:
+            raise ValueError(
+                f"{type(self).__name__} requires {self.SIZE} bytes, got {len(id_bytes)}"
+            )
+        self._bytes = bytes(id_bytes)
+        self._hash = None
+
+    @classmethod
+    def from_random(cls):
+        return cls(os.urandom(cls.SIZE))
+
+    @classmethod
+    def from_hex(cls, hex_str: str):
+        return cls(bytes.fromhex(hex_str))
+
+    @classmethod
+    def nil(cls):
+        return cls(b"\xff" * cls.SIZE)
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\xff" * self.SIZE
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def __hash__(self):
+        if self._hash is None:
+            self._hash = hash((type(self).__name__, self._bytes))
+        return self._hash
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __lt__(self, other):
+        return self._bytes < other._bytes
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class JobID(BaseID):
+    SIZE = JOB_ID_SIZE
+
+    @classmethod
+    def from_int(cls, value: int):
+        return cls(value.to_bytes(JOB_ID_SIZE, "little"))
+
+    def int_value(self) -> int:
+        return int.from_bytes(self._bytes, "little")
+
+
+class ActorID(BaseID):
+    SIZE = ACTOR_ID_SIZE
+
+    @classmethod
+    def of(cls, job_id: JobID):
+        return cls(job_id.binary() + os.urandom(ACTOR_ID_SIZE - JOB_ID_SIZE))
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[:JOB_ID_SIZE])
+
+
+class TaskID(BaseID):
+    SIZE = TASK_ID_SIZE
+
+    @classmethod
+    def of(cls, actor_id: ActorID):
+        """A task within an actor's (or the job's driver "actor") lineage."""
+        return cls(actor_id.binary() + os.urandom(TASK_ID_SIZE - ACTOR_ID_SIZE))
+
+    @classmethod
+    def for_driver(cls, job_id: JobID):
+        return cls.of(ActorID(job_id.binary() + b"\x00" * (ACTOR_ID_SIZE - JOB_ID_SIZE)))
+
+    def actor_id(self) -> ActorID:
+        return ActorID(self._bytes[:ACTOR_ID_SIZE])
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[:JOB_ID_SIZE])
+
+    def object_id(self, index: int) -> "ObjectID":
+        if not 0 <= index <= _MAX_INDEX:
+            raise ValueError(f"object index out of range: {index}")
+        return ObjectID(self._bytes + index.to_bytes(4, "little"))
+
+
+class ObjectID(BaseID):
+    SIZE = OBJECT_ID_SIZE
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._bytes[:TASK_ID_SIZE])
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[:JOB_ID_SIZE])
+
+    def index(self) -> int:
+        return int.from_bytes(self._bytes[TASK_ID_SIZE:], "little")
+
+
+class NodeID(BaseID):
+    SIZE = UNIQUE_ID_SIZE
+
+
+class WorkerID(BaseID):
+    SIZE = UNIQUE_ID_SIZE
+
+
+class PlacementGroupID(BaseID):
+    SIZE = PLACEMENT_GROUP_ID_SIZE
+
+    @classmethod
+    def of(cls, job_id: JobID):
+        return cls(job_id.binary() + os.urandom(PLACEMENT_GROUP_ID_SIZE - JOB_ID_SIZE))
+
+
+class PutIndexAllocator:
+    """Allocates monotonically increasing put/return indices for one task.
+
+    Return objects use indices [1, num_returns]; ``put`` objects continue
+    the sequence after them, so every ObjectID created by a task is unique
+    and lineage-addressable (reference: ObjectID::FromIndex semantics in
+    src/ray/common/id.h).
+    """
+
+    def __init__(self, task_id: TaskID, first_free_index: int):
+        self._task_id = task_id
+        self._lock = threading.Lock()
+        self._next = first_free_index
+
+    def next_object_id(self) -> ObjectID:
+        with self._lock:
+            idx = self._next
+            self._next += 1
+        return self._task_id.object_id(idx)

@@ -1,0 +1,89 @@
+"""In-process memory store for small / direct-return objects.
+
+Role-equivalent of the reference's CoreWorkerMemoryStore (reference:
+src/ray/core_worker/store_provider/memory_store/memory_store.h): every
+owner keeps its tasks' small return values here; ``get`` blocks on the
+owner's event loop until the value lands (the task reply delivers it), and
+object-available callbacks feed dependency resolution.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Dict, List, Optional
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.serialization import SerializedObject
+
+
+class InPlasmaSentinel:
+    """Marker stored when the real value lives in the shared-memory store;
+    readers must fetch from the object plane instead."""
+
+    __slots__ = ()
+
+
+IN_PLASMA = InPlasmaSentinel()
+
+
+class MemoryStore:
+    """Async object table with waiters. Must only be touched from the owner
+    process's event loop (single-threaded, like the reference's
+    instrumented_io_context confinement)."""
+
+    def __init__(self):
+        self._objects: Dict[ObjectID, object] = {}  # SerializedObject | IN_PLASMA
+        self._waiters: Dict[ObjectID, List[asyncio.Future]] = {}
+        self._object_added_callbacks: List[Callable[[ObjectID], None]] = []
+
+    def add_object_added_callback(self, cb: Callable[[ObjectID], None]):
+        self._object_added_callbacks.append(cb)
+
+    def put(self, object_id: ObjectID, obj) -> None:
+        self._objects[object_id] = obj
+        for fut in self._waiters.pop(object_id, []):
+            if not fut.done():
+                fut.set_result(obj)
+        for cb in self._object_added_callbacks:
+            cb(object_id)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return object_id in self._objects
+
+    def get_if_exists(self, object_id: ObjectID):
+        return self._objects.get(object_id)
+
+    async def get(self, object_id: ObjectID, timeout: float | None = None):
+        obj = self._objects.get(object_id)
+        if obj is not None:
+            return obj
+        fut = asyncio.get_running_loop().create_future()
+        self._waiters.setdefault(object_id, []).append(fut)
+        try:
+            if timeout is not None:
+                return await asyncio.wait_for(fut, timeout)
+            return await fut
+        finally:
+            lst = self._waiters.get(object_id)
+            if lst and fut in lst:
+                lst.remove(fut)
+                if not lst:
+                    del self._waiters[object_id]
+
+    def delete(self, object_id: ObjectID) -> None:
+        self._objects.pop(object_id, None)
+
+    def fail_waiters(self, object_id: ObjectID, error: BaseException) -> None:
+        for fut in self._waiters.pop(object_id, []):
+            if not fut.done():
+                fut.set_exception(error)
+
+    def size(self) -> int:
+        return len(self._objects)
+
+    def used_bytes(self) -> int:
+        total = 0
+        for obj in self._objects.values():
+            if isinstance(obj, SerializedObject):
+                total += obj.total_bytes()
+        return total

@@ -1,0 +1,74 @@
+"""ObjectRef: a first-class future naming an object in the cluster.
+
+Parity: reference ObjectRef (python/ray/includes/object_ref.pxi) — hashable,
+awaitable, refcounted on construction/destruction so the owner can release
+the value when the last reference anywhere drops.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Optional
+
+from ray_tpu._private.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("object_id", "owner_address", "_worker", "call_site", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_address: str = "",
+                 worker=None, skip_adding_local_ref: bool = False,
+                 call_site: str = ""):
+        self.object_id = object_id
+        self.owner_address = owner_address
+        self._worker = worker
+        self.call_site = call_site
+        if worker is not None and not skip_adding_local_ref:
+            worker.reference_counter.add_local_reference(object_id)
+
+    def binary(self) -> bytes:
+        return self.object_id.binary()
+
+    def hex(self) -> str:
+        return self.object_id.hex()
+
+    def task_id(self):
+        return self.object_id.task_id()
+
+    def __hash__(self):
+        return hash(self.object_id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.object_id == self.object_id
+
+    def __repr__(self):
+        return f"ObjectRef({self.object_id.hex()})"
+
+    def __del__(self):
+        worker = self._worker
+        if worker is not None:
+            try:
+                worker.reference_counter.remove_local_reference(self.object_id)
+            except Exception:
+                pass
+
+    def __reduce__(self):
+        # Bare pickling (outside the SerializationContext) drops ownership
+        # info; the context's reducer_override path is the supported one.
+        return (ObjectRef, (self.object_id, self.owner_address, None, True))
+
+    # -- asyncio integration ------------------------------------------------
+
+    def as_future(self) -> "asyncio.Future":
+        if self._worker is None:
+            raise RuntimeError("ObjectRef is detached from a worker")
+        return self._worker.get_async(self)
+
+    def __await__(self):
+        return self.as_future().__await__()
+
+    def future(self):
+        """concurrent.futures-style Future resolving to the value."""
+        if self._worker is None:
+            raise RuntimeError("ObjectRef is detached from a worker")
+        return self._worker.get_future(self)

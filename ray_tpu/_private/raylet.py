@@ -1,0 +1,712 @@
+"""Raylet: per-node control — worker pool, leases, scheduling, object plane.
+
+Role parity: reference raylet (src/ray/raylet/node_manager.h NodeManager,
+worker_pool.h WorkerPool, scheduling/cluster_task_manager.h) plus the
+node-local shared-memory store it hosts (the plasma thread in the reference,
+src/ray/object_manager/object_manager.cc ObjectStoreRunner) and the
+node-to-node object transfer path (src/ray/object_manager/object_manager.h
+Push/Pull).
+
+Protocol surface (all framed-msgpack RPC, see rpc.py):
+  workers   : RegisterWorker, ActorExited, SealObject, GetObjectInfo,
+              EnsureObjectLocal, PinObject, FreeObject
+  clients   : RequestWorkerLease, ReturnWorker (lease pipelining is
+              client-side, reference: direct_task_transport.h)
+  GCS       : ScheduleActorCreation, KillActorWorker, PreparePGBundle,
+              CommitPGBundle, ReturnPGBundle, DrainSelf
+  raylets   : FetchObject (remote pull)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private import rpc
+from ray_tpu._private.config import RayTpuConfig
+from ray_tpu._private.ids import NodeID, ObjectID, WorkerID
+from ray_tpu._private.scheduler import (
+    GRANT, INFEASIBLE, SPILL, WAIT, NodeView, PendingRequest, make_backend,
+)
+from ray_tpu._private.shm_store import ShmStoreServer
+
+logger = logging.getLogger(__name__)
+
+WORKER_IDLE = "idle"
+WORKER_LEASED = "leased"
+WORKER_ACTOR = "actor"
+WORKER_STARTING = "starting"
+WORKER_DEAD = "dead"
+
+
+class WorkerHandle:
+    def __init__(self, worker_id: bytes, pid: int, proc: Optional[subprocess.Popen]):
+        self.worker_id = worker_id
+        self.pid = pid
+        self.proc = proc
+        self.address = ""
+        self.conn: Optional[rpc.Connection] = None
+        self.state = WORKER_STARTING
+        self.lease_id: Optional[int] = None
+        self.actor_id: bytes = b""
+        self.job_id: bytes = b""
+        self.started_at = time.time()
+
+
+class LeaseEntry:
+    def __init__(self, lease_id: int, worker: WorkerHandle,
+                 resources: Dict[str, float], client: rpc.Connection):
+        self.lease_id = lease_id
+        self.worker = worker
+        self.resources = resources
+        self.client = client
+
+
+class Raylet:
+    def __init__(self, config: RayTpuConfig, num_cpus: float,
+                 custom_resources: Optional[Dict[str, float]] = None,
+                 session_dir: str = "/tmp/ray_tpu", node_name: str = ""):
+        self.config = config
+        self.node_id = NodeID.from_random()
+        self.node_name = node_name or f"node-{self.node_id.hex()[:8]}"
+        self.session_dir = session_dir
+        self.resources_total: Dict[str, float] = {"CPU": float(num_cpus)}
+        if custom_resources:
+            self.resources_total.update(custom_resources)
+        self.resources_available = dict(self.resources_total)
+
+        self.store = ShmStoreServer(
+            capacity_bytes=config.object_store_memory,
+            spill_dir=os.path.join(session_dir, "spill", self.node_id.hex()[:8]),
+            spilling_enabled=config.object_spilling_enabled)
+
+        self.workers: Dict[bytes, WorkerHandle] = {}
+        self.leases: Dict[int, LeaseEntry] = {}
+        self._lease_counter = itertools.count(1)
+        self._req_counter = itertools.count(1)
+        self.max_workers = int(config.max_workers_per_node or max(1, int(num_cpus)))
+        self._num_starting = 0
+
+        # Pending lease requests in arrival order: req_id -> (PendingRequest,
+        # reply future). The scheduler seam consumes this queue each tick.
+        self._pending: Dict[int, Tuple[PendingRequest, asyncio.Future]] = {}
+        self.backend = make_backend(config.scheduler_backend)
+
+        # Cluster view for spillback (fed by GCS NODE pubsub + polling).
+        self.remote_nodes: Dict[bytes, dict] = {}
+
+        # Placement group reservations: (pg_id, bundle_idx) -> resources.
+        self._pg_prepared: Dict[Tuple[bytes, int], Dict[str, float]] = {}
+        self._pg_committed: Set[Tuple[bytes, int]] = set()
+        # Per-bundle remaining capacity for leases inside a PG.
+        self._pg_available: Dict[Tuple[bytes, int], Dict[str, float]] = {}
+
+        self.gcs_conn: Optional[rpc.Connection] = None
+        self._server = rpc.RpcServer(self._handlers(), name="raylet")
+        self.address = ""
+        self._peer_raylets: Dict[str, rpc.Connection] = {}
+        self._owner_conns: Dict[str, rpc.Connection] = {}
+        self._hb_task: Optional[asyncio.Task] = None
+        self._tick_scheduled = False
+        self._closing = False
+        self.num_leases_granted = 0
+        self.num_spillbacks = 0
+
+    def _handlers(self):
+        return {
+            "RegisterWorker": self.handle_register_worker,
+            "RequestWorkerLease": self.handle_request_worker_lease,
+            "ReturnWorker": self.handle_return_worker,
+            "ScheduleActorCreation": self.handle_schedule_actor_creation,
+            "KillActorWorker": self.handle_kill_actor_worker,
+            "ActorExited": self.handle_actor_exited,
+            "SealObject": self.handle_seal_object,
+            "GetObjectInfo": self.handle_get_object_info,
+            "EnsureObjectLocal": self.handle_ensure_object_local,
+            "FetchObject": self.handle_fetch_object,
+            "PinObject": self.handle_pin_object,
+            "FreeObject": self.handle_free_object,
+            "PreparePGBundle": self.handle_prepare_pg_bundle,
+            "CommitPGBundle": self.handle_commit_pg_bundle,
+            "ReturnPGBundle": self.handle_return_pg_bundle,
+            "GetNodeStats": self.handle_get_node_stats,
+            "Published": self.handle_published,
+        }
+
+    # ------------------------------------------------------------- lifecycle
+
+    async def start(self, gcs_address: str, listen_address: str = "") -> str:
+        sock_dir = os.path.join(self.session_dir, "sockets")
+        os.makedirs(sock_dir, exist_ok=True)
+        if not listen_address:
+            listen_address = f"unix://{sock_dir}/raylet-{self.node_id.hex()[:12]}"
+        self.address = await self._server.listen(listen_address)
+        self.gcs_address = gcs_address
+        # Full handler map on the GCS connection too: the GCS issues
+        # requests (actor scheduling, PG 2PC, kills) back over this pipe.
+        self.gcs_conn = await rpc.connect(
+            gcs_address, handlers=self._handlers(), peer_name="gcs")
+        await self.gcs_conn.call("RegisterNode", {
+            "node_id": self.node_id.binary(),
+            "address": self.address,
+            "resources": self.resources_total,
+            "node_name": self.node_name,
+        })
+        await self.gcs_conn.call("Subscribe", {"channel": "NODE"})
+        self._hb_task = asyncio.get_running_loop().create_task(self._heartbeat_loop())
+        for _ in range(self.config.num_prestart_workers):
+            self._start_worker_process()
+        logger.info("raylet %s listening at %s (%s)",
+                    self.node_id.hex()[:8], self.address, self.resources_total)
+        return self.address
+
+    async def stop(self):
+        self._closing = True
+        if self._hb_task:
+            self._hb_task.cancel()
+        for w in list(self.workers.values()):
+            self._kill_worker(w)
+        await self._server.close()
+        if self.gcs_conn:
+            await self.gcs_conn.close()
+        self.store.shutdown()
+
+    async def _heartbeat_loop(self):
+        period = self.config.raylet_heartbeat_period_ms / 1000.0
+        while not self._closing:
+            try:
+                await self.gcs_conn.call("Heartbeat", {
+                    "node_id": self.node_id.binary(),
+                    "resources_available": self.resources_available,
+                })
+            except ConnectionError:
+                logger.warning("GCS connection lost; raylet exiting heartbeat")
+                return
+            await asyncio.sleep(period)
+
+    async def handle_published(self, conn, header, bufs):
+        msg = header["msg"]
+        if header["channel"] == "NODE":
+            nid = msg["node_id"]
+            if nid == self.node_id.binary():
+                return {}
+            if msg["event"] == "alive":
+                self.remote_nodes[nid] = {
+                    "address": msg["address"],
+                    "resources_total": msg["resources"],
+                    "resources_available": dict(msg["resources"]),
+                }
+            elif msg["event"] == "dead":
+                self.remote_nodes.pop(nid, None)
+        return {}
+
+    # ----------------------------------------------------------- worker pool
+
+    def _start_worker_process(self) -> None:
+        if self._num_starting + self._alive_worker_count() >= self.max_workers:
+            return
+        self._num_starting += 1
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        worker_id = WorkerID.from_random()
+        out = open(os.path.join(
+            log_dir, f"worker-{worker_id.hex()[:12]}.log"), "ab")
+        env = dict(os.environ)
+        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
+        env.setdefault("JAX_PLATFORMS", env.get("RAY_TPU_WORKER_JAX_PLATFORMS", "cpu"))
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main",
+             "--raylet-address", self.address,
+             "--gcs-address", self.gcs_address,
+             "--node-id", self.node_id.hex(),
+             "--worker-id", worker_id.hex(),
+             "--session-dir", self.session_dir],
+            stdout=out, stderr=subprocess.STDOUT, env=env,
+            start_new_session=True)
+        handle = WorkerHandle(worker_id.binary(), proc.pid, proc)
+        self.workers[worker_id.binary()] = handle
+
+    def _alive_worker_count(self) -> int:
+        return sum(1 for w in self.workers.values()
+                   if w.state not in (WORKER_DEAD,))
+
+    async def handle_register_worker(self, conn, header, bufs):
+        wid = header["worker_id"]
+        handle = self.workers.get(wid)
+        if handle is None:
+            # Externally started worker (tests / manual): adopt it.
+            handle = WorkerHandle(wid, header.get("pid", 0), None)
+            self.workers[wid] = handle
+        else:
+            self._num_starting = max(0, self._num_starting - 1)
+        handle.address = header["address"]
+        handle.conn = conn
+        handle.state = WORKER_IDLE
+        conn.tags["worker_id"] = wid
+        conn.on_disconnect.append(lambda c: self._on_worker_disconnect(wid))
+        self._schedule_tick()
+        return {"ok": True, "node_id": self.node_id.binary(),
+                "config": self.config.to_json()}
+
+    def _on_worker_disconnect(self, worker_id: bytes):
+        handle = self.workers.get(worker_id)
+        if handle is None or handle.state == WORKER_DEAD:
+            return
+        prev_state = handle.state
+        handle.state = WORKER_DEAD
+        logger.warning("worker %s (%s) disconnected", worker_id.hex()[:8], prev_state)
+        if handle.lease_id is not None and handle.lease_id in self.leases:
+            self._release_lease(handle.lease_id)
+        if prev_state == WORKER_ACTOR:
+            # Return the actor's resources (they're not lease-tracked).
+            self._give_back(getattr(handle, "actor_resources", {}),
+                            getattr(handle, "actor_pg_key", None))
+            handle.actor_resources = {}
+        if prev_state == WORKER_ACTOR and handle.actor_id and not self._closing:
+            async def _report():
+                try:
+                    await self.gcs_conn.call("ReportActorDeath", {
+                        "actor_id": handle.actor_id,
+                        "reason": "worker process died",
+                        "expected": False})
+                except ConnectionError:
+                    pass
+            asyncio.get_event_loop().create_task(_report())
+        self.workers.pop(worker_id, None)
+        self._schedule_tick()
+
+    def _pop_idle_worker(self) -> Optional[WorkerHandle]:
+        for w in self.workers.values():
+            if w.state == WORKER_IDLE and w.conn is not None and not w.conn.closed:
+                return w
+        return None
+
+    def _kill_worker(self, handle: WorkerHandle):
+        handle.state = WORKER_DEAD
+        if handle.proc is not None:
+            try:
+                os.killpg(os.getpgid(handle.proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError, OSError):
+                try:
+                    handle.proc.kill()
+                except Exception:
+                    pass
+
+    # -------------------------------------------------------------- leases
+
+    async def handle_request_worker_lease(self, conn, header, bufs):
+        summary = header["summary"]
+        req = PendingRequest(
+            req_id=next(self._req_counter),
+            scheduling_class=summary["scheduling_class"],
+            resources=summary["resources"],
+            strategy=summary.get("strategy", "DEFAULT"),
+            pg_id=summary.get("pg_id") or b"",
+            pg_bundle=summary.get("pg_bundle", -1),
+        )
+        fut = asyncio.get_running_loop().create_future()
+        fut.client = conn  # type: ignore[attr-defined]
+        self._pending[req.req_id] = (req, fut)
+
+        def _on_drop(c, rid=req.req_id):
+            self._cancel_pending(rid)
+
+        conn.on_disconnect.append(_on_drop)
+        self._schedule_tick()
+        try:
+            return await fut
+        finally:
+            # Don't accumulate one closure per lease on a long-lived conn.
+            if _on_drop in conn.on_disconnect:
+                conn.on_disconnect.remove(_on_drop)
+
+    def _cancel_pending(self, req_id: int):
+        entry = self._pending.pop(req_id, None)
+        if entry and not entry[1].done():
+            entry[1].cancel()
+
+    def _schedule_tick(self):
+        if self._tick_scheduled or self._closing:
+            return
+        self._tick_scheduled = True
+        asyncio.get_event_loop().call_soon(self._run_tick)
+
+    def _run_tick(self):
+        self._tick_scheduled = False
+        if self._closing or not self._pending:
+            return
+        # PG-targeted requests bypass node scoring: the bundle's node was
+        # fixed at PG creation (reference: placement-group scheduling
+        # resources are node-local labels).
+        nodes = self._node_views()
+        ordered = sorted(self._pending.keys())
+        reqs = []
+        pg_grants = []
+        for rid in ordered:
+            req, fut = self._pending[rid]
+            if req.pg_id:
+                pg_grants.append((rid, req, fut))
+            else:
+                reqs.append(req)
+        decisions = self.backend.schedule(
+            reqs, nodes, self.config.scheduler_spread_threshold) if reqs else []
+        for d in decisions:
+            req, fut = self._pending.get(d.req_id, (None, None))
+            if req is None or fut.done():
+                self._pending.pop(d.req_id, None)
+                continue
+            if d.action == GRANT:
+                self._try_grant(d.req_id, req, fut)
+            elif d.action == SPILL:
+                self.num_spillbacks += 1
+                self._pending.pop(d.req_id, None)
+                fut.set_result(({"granted": False, "spill": d.spill_address}, ()))
+            elif d.action == INFEASIBLE:
+                self._pending.pop(d.req_id, None)
+                fut.set_result(({"granted": False, "infeasible": True}, ()))
+            # WAIT: stays pending.
+        for rid, req, fut in pg_grants:
+            self._try_grant_pg(rid, req, fut)
+
+    def _node_views(self) -> List[NodeView]:
+        views = [NodeView(
+            node_id=self.node_id.binary(), address=self.address,
+            total=self.resources_total,
+            available=dict(self.resources_available), is_local=True)]
+        for nid, info in self.remote_nodes.items():
+            views.append(NodeView(
+                node_id=nid, address=info["address"],
+                total=info["resources_total"],
+                available=dict(info["resources_available"]), is_local=False))
+        return views
+
+    def _try_grant(self, req_id: int, req: PendingRequest, fut: asyncio.Future):
+        worker = self._pop_idle_worker()
+        if worker is None:
+            if self._alive_worker_count() + self._num_starting < self.max_workers:
+                self._start_worker_process()
+            return  # stays pending until a worker registers/frees
+        self._pending.pop(req_id, None)
+        lease_id = next(self._lease_counter)
+        for k, v in req.resources.items():
+            self.resources_available[k] = self.resources_available.get(k, 0.0) - v
+        worker.state = WORKER_LEASED
+        worker.lease_id = lease_id
+        client = getattr(fut, "client", None)
+        self.leases[lease_id] = LeaseEntry(lease_id, worker, req.resources, client)
+        self.num_leases_granted += 1
+        fut.set_result(({"granted": True, "lease_id": lease_id,
+                         "worker_address": worker.address,
+                         "worker_id": worker.worker_id,
+                         "node_id": self.node_id.binary()}, ()))
+
+    def _try_grant_pg(self, req_id: int, req: PendingRequest, fut: asyncio.Future):
+        key = (req.pg_id, req.pg_bundle)
+        bundle_avail = self._pg_available.get(key)
+        if bundle_avail is None:
+            self._pending.pop(req_id, None)
+            fut.set_result(({"granted": False, "infeasible": True,
+                             "reason": "no such placement group bundle here"}, ()))
+            return
+        if not all(bundle_avail.get(k, 0.0) + 1e-9 >= v
+                   for k, v in req.resources.items() if v > 0):
+            return  # wait for bundle capacity
+        worker = self._pop_idle_worker()
+        if worker is None:
+            if self._alive_worker_count() + self._num_starting < self.max_workers:
+                self._start_worker_process()
+            return
+        self._pending.pop(req_id, None)
+        for k, v in req.resources.items():
+            bundle_avail[k] = bundle_avail.get(k, 0.0) - v
+        lease_id = next(self._lease_counter)
+        worker.state = WORKER_LEASED
+        worker.lease_id = lease_id
+        lease = LeaseEntry(lease_id, worker, req.resources,
+                           getattr(fut, "client", None))
+        lease.pg_key = key  # type: ignore[attr-defined]
+        self.leases[lease_id] = lease
+        self.num_leases_granted += 1
+        fut.set_result(({"granted": True, "lease_id": lease_id,
+                         "worker_address": worker.address,
+                         "worker_id": worker.worker_id,
+                         "node_id": self.node_id.binary()}, ()))
+
+    async def handle_return_worker(self, conn, header, bufs):
+        self._release_lease(header["lease_id"],
+                            worker_alive=not header.get("worker_died", False))
+        return {"ok": True}
+
+    def _release_lease(self, lease_id: int, worker_alive: bool = True):
+        lease = self.leases.pop(lease_id, None)
+        if lease is None:
+            return
+        pg_key = getattr(lease, "pg_key", None)
+        if pg_key is not None and pg_key in self._pg_available:
+            for k, v in lease.resources.items():
+                self._pg_available[pg_key][k] = \
+                    self._pg_available[pg_key].get(k, 0.0) + v
+        elif pg_key is None:
+            for k, v in lease.resources.items():
+                self.resources_available[k] = \
+                    self.resources_available.get(k, 0.0) + v
+        w = lease.worker
+        w.lease_id = None
+        if worker_alive and w.state == WORKER_LEASED:
+            w.state = WORKER_IDLE
+        self._schedule_tick()
+
+    # -------------------------------------------------------------- actors
+
+    async def handle_schedule_actor_creation(self, conn, header, bufs):
+        spec = header["spec"]
+        resources = spec.get("resources", {"CPU": 1.0})
+        pg_key = None
+        if spec.get("pg_id"):
+            pg_key = (spec["pg_id"], spec.get("pg_bundle", 0))
+            bundle_avail = self._pg_available.get(pg_key)
+            if bundle_avail is None or not all(
+                    bundle_avail.get(k, 0.0) + 1e-9 >= v
+                    for k, v in resources.items() if v > 0):
+                return {"ok": False, "reason": "pg bundle unavailable"}
+        elif not all(self.resources_available.get(k, 0.0) + 1e-9 >= v
+                     for k, v in resources.items() if v > 0):
+            return {"ok": False, "reason": "insufficient resources"}
+        worker = self._pop_idle_worker()
+        if worker is None:
+            if self._alive_worker_count() + self._num_starting < self.max_workers:
+                self._start_worker_process()
+            deadline = time.time() + self.config.worker_register_timeout_s
+            while worker is None and time.time() < deadline:
+                await asyncio.sleep(0.02)
+                worker = self._pop_idle_worker()
+            if worker is None:
+                return {"ok": False, "reason": "no worker available"}
+        if pg_key is not None:
+            for k, v in resources.items():
+                self._pg_available[pg_key][k] = \
+                    self._pg_available[pg_key].get(k, 0.0) - v
+        else:
+            for k, v in resources.items():
+                self.resources_available[k] = \
+                    self.resources_available.get(k, 0.0) - v
+        worker.state = WORKER_ACTOR
+        worker.actor_id = header["actor_id"]
+        worker.actor_resources = resources  # type: ignore[attr-defined]
+        worker.actor_pg_key = pg_key        # type: ignore[attr-defined]
+        try:
+            reply, _ = await worker.conn.call(
+                "CreateActor",
+                {"actor_id": header["actor_id"], "spec": spec,
+                 "incarnation": header.get("incarnation", 0)},
+                bufs=bufs)
+        except ConnectionError:
+            return {"ok": False, "reason": "worker died during actor creation"}
+        if not reply.get("ok"):
+            worker.state = WORKER_IDLE
+            worker.actor_id = b""
+            self._give_back(resources, pg_key)
+            # Creation raised in __init__: actor is DEAD with the error.
+            await self.gcs_conn.call("ReportActorDeath", {
+                "actor_id": header["actor_id"],
+                "reason": reply.get("error", "actor constructor failed"),
+                "expected": True})
+            return {"ok": True}
+        await self.gcs_conn.call("ReportActorAlive", {
+            "actor_id": header["actor_id"],
+            "address": worker.address,
+            "node_id": self.node_id.binary()})
+        return {"ok": True}
+
+    def _give_back(self, resources, pg_key):
+        if pg_key is not None and pg_key in self._pg_available:
+            for k, v in resources.items():
+                self._pg_available[pg_key][k] = \
+                    self._pg_available[pg_key].get(k, 0.0) + v
+        else:
+            for k, v in resources.items():
+                self.resources_available[k] = \
+                    self.resources_available.get(k, 0.0) + v
+
+    async def handle_kill_actor_worker(self, conn, header, bufs):
+        actor_id = header["actor_id"]
+        for w in list(self.workers.values()):
+            if w.actor_id == actor_id and w.state == WORKER_ACTOR:
+                self._give_back(getattr(w, "actor_resources", {}),
+                                getattr(w, "actor_pg_key", None))
+                w.actor_resources = {}
+                self._kill_worker(w)
+                self.workers.pop(w.worker_id, None)
+                return {"ok": True}
+        return {"ok": False, "reason": "actor worker not found"}
+
+    async def handle_actor_exited(self, conn, header, bufs):
+        """Graceful actor exit from the worker itself."""
+        wid = conn.tags.get("worker_id")
+        handle = self.workers.get(wid) if wid else None
+        if handle is not None:
+            self._give_back(getattr(handle, "actor_resources", {}),
+                            getattr(handle, "actor_pg_key", None))
+            handle.actor_resources = {}
+        try:
+            await self.gcs_conn.call("ReportActorDeath", {
+                "actor_id": header["actor_id"],
+                "reason": header.get("reason", "actor exited"),
+                "expected": True})
+        except ConnectionError:
+            pass
+        return {"ok": True}
+
+    # --------------------------------------------------------- object plane
+
+    async def handle_seal_object(self, conn, header, bufs):
+        oid = ObjectID(header["object_id"])
+        ok = self.store.seal(oid, header["segment"], header["size"])
+        if ok and header.get("pin", False):
+            self.store.pin(oid)
+        return {"ok": ok, "node_id": self.node_id.binary()}
+
+    async def handle_get_object_info(self, conn, header, bufs):
+        oid = ObjectID(header["object_id"])
+        segment = self.store.lookup(oid)
+        if segment is None:
+            return {"found": False}
+        return {"found": True, "segment": segment}
+
+    async def handle_pin_object(self, conn, header, bufs):
+        self.store.pin(ObjectID(header["object_id"]))
+        return {"ok": True}
+
+    async def handle_free_object(self, conn, header, bufs):
+        self.store.free(ObjectID(header["object_id"]))
+        return {"ok": True}
+
+    async def handle_fetch_object(self, conn, header, bufs):
+        """Serve a remote raylet's pull: return the raw segment bytes."""
+        oid = ObjectID(header["object_id"])
+        segment = self.store.lookup(oid)
+        if segment is None:
+            return {"found": False}
+        from multiprocessing import shared_memory
+        shm = shared_memory.SharedMemory(name=segment)
+        entry = self.store._objects.get(oid)  # noqa: SLF001
+        size = entry[1] if entry is not None else shm.size
+        data = bytes(shm.buf[:size])
+        shm.close()
+        return {"found": True}, [data]
+
+    async def handle_ensure_object_local(self, conn, header, bufs):
+        """Pull an object into the local store from wherever it lives
+        (reference: PullManager admission + ObjectManager::Pull)."""
+        oid = ObjectID(header["object_id"])
+        if self.store.contains(oid):
+            return {"ok": True, "segment": self.store.lookup(oid)}
+        owner_address = header.get("owner_address", "")
+        locations: List[bytes] = []
+        if owner_address:
+            try:
+                owner = await self._owner_conn(owner_address)
+                reply, _ = await owner.call("GetObjectLocations",
+                                            {"object_id": oid.binary()})
+                locations = reply.get("locations", [])
+            except ConnectionError:
+                pass
+        for nid in locations:
+            if nid == self.node_id.binary():
+                continue
+            info = self.remote_nodes.get(nid)
+            if info is None:
+                continue
+            try:
+                peer = await self._peer_conn(info["address"])
+                reply, rbufs = await peer.call("FetchObject",
+                                               {"object_id": oid.binary()})
+                if reply.get("found"):
+                    data = rbufs[0]
+                    from multiprocessing import shared_memory
+                    import secrets as _secrets
+                    from ray_tpu._private.shm_store import _untrack
+                    name = f"rtpu_{_secrets.token_hex(8)}"
+                    shm = shared_memory.SharedMemory(
+                        name=name, create=True, size=max(len(data), 1))
+                    _untrack(shm)  # created here; store owns its lifetime
+                    shm.buf[:len(data)] = data
+                    shm.close()
+                    if self.store.seal(oid, name, len(data)):
+                        return {"ok": True, "segment": name}
+            except ConnectionError:
+                continue
+        return {"ok": False, "reason": "object not found at any location"}
+
+    async def _peer_conn(self, address: str) -> rpc.Connection:
+        conn = self._peer_raylets.get(address)
+        if conn is None or conn.closed:
+            conn = await rpc.connect(address, peer_name=f"raylet@{address}")
+            self._peer_raylets[address] = conn
+        return conn
+
+    async def _owner_conn(self, address: str) -> rpc.Connection:
+        conn = self._owner_conns.get(address)
+        if conn is None or conn.closed:
+            conn = await rpc.connect(address, peer_name=f"owner@{address}")
+            self._owner_conns[address] = conn
+        return conn
+
+    # ----------------------------------------------------- placement groups
+
+    async def handle_prepare_pg_bundle(self, conn, header, bufs):
+        key = (header["pg_id"], header["bundle_index"])
+        resources = header["resources"]
+        if not all(self.resources_available.get(k, 0.0) + 1e-9 >= v
+                   for k, v in resources.items() if v > 0):
+            return {"ok": False, "reason": "insufficient resources"}
+        for k, v in resources.items():
+            self.resources_available[k] = self.resources_available.get(k, 0.0) - v
+        self._pg_prepared[key] = dict(resources)
+        return {"ok": True}
+
+    async def handle_commit_pg_bundle(self, conn, header, bufs):
+        key = (header["pg_id"], header["bundle_index"])
+        if key not in self._pg_prepared:
+            return {"ok": False}
+        self._pg_committed.add(key)
+        self._pg_available[key] = dict(self._pg_prepared[key])
+        return {"ok": True}
+
+    async def handle_return_pg_bundle(self, conn, header, bufs):
+        key = (header["pg_id"], header["bundle_index"])
+        resources = self._pg_prepared.pop(key, None)
+        self._pg_committed.discard(key)
+        self._pg_available.pop(key, None)
+        if resources:
+            for k, v in resources.items():
+                self.resources_available[k] = \
+                    self.resources_available.get(k, 0.0) + v
+        self._schedule_tick()
+        return {"ok": True}
+
+    # -------------------------------------------------------------- stats
+
+    async def handle_get_node_stats(self, conn, header, bufs):
+        return {
+            "node_id": self.node_id.binary(),
+            "address": self.address,
+            "resources_total": self.resources_total,
+            "resources_available": self.resources_available,
+            "num_workers": self._alive_worker_count(),
+            "workers": [{
+                "worker_id": w.worker_id, "pid": w.pid, "state": w.state,
+                "actor_id": w.actor_id,
+            } for w in self.workers.values()],
+            "num_pending_leases": len(self._pending),
+            "num_leases_granted": self.num_leases_granted,
+            "num_spillbacks": self.num_spillbacks,
+            "store": self.store.stats(),
+        }

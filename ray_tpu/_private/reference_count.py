@@ -1,0 +1,247 @@
+"""Distributed ownership-based reference counting.
+
+Role parity: reference ReferenceCounter (src/ray/core_worker/
+reference_count.h) — the process that creates an object (by ``put`` or by
+submitting the task that returns it) is its *owner* and tracks:
+
+  * local refs     — live ObjectRef instances in this process
+  * submitted refs — uses of the object as args of not-yet-finished tasks
+  * contained-in   — refs serialized inside other owned values
+  * borrowers      — remote processes holding deserialized copies of the ref
+
+The object is freeable when all four are empty. Borrower processes report
+themselves to the owner (AddBorrower) on first deserialization and notify
+it (RemoveBorrower) when their own count drops to zero — the RPC analog of
+the reference's WaitForRefRemoved long-poll protocol. Lineage (the creating
+TaskSpec) stays pinned while the object may still need reconstruction.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set
+
+from ray_tpu._private.ids import ObjectID
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class Reference:
+    owned: bool = False
+    owner_address: str = ""
+    local_refs: int = 0
+    submitted_refs: int = 0
+    contained_in: Set[ObjectID] = field(default_factory=set)
+    contains: Set[ObjectID] = field(default_factory=set)
+    borrowers: Set[str] = field(default_factory=set)
+    # Object data locations (node ids) — owner-resident location index,
+    # the analog of OwnershipBasedObjectDirectory.
+    locations: Set[bytes] = field(default_factory=set)
+    in_plasma: bool = False
+    pinned_lineage: bool = False
+    freed: bool = False
+
+    def is_releasable(self) -> bool:
+        return (self.local_refs == 0 and self.submitted_refs == 0
+                and not self.borrowers and not self.contained_in)
+
+
+class ReferenceCounter:
+    """Thread-safe; mutations come from both the API threads (ObjectRef
+    ctor/dtor) and the IO loop (task completions, borrower RPCs)."""
+
+    def __init__(self, own_address: str = ""):
+        self._lock = threading.RLock()
+        self._refs: Dict[ObjectID, Reference] = {}
+        self.own_address = own_address
+        # Fired when an owned object becomes releasable: storage layers
+        # delete data; lineage unpins.
+        self._on_release: List[Callable[[ObjectID], None]] = []
+        # Fired to tell a remote owner we dropped a borrowed ref.
+        self._on_borrow_removed: List[Callable[[ObjectID, str], None]] = []
+
+    def add_release_callback(self, cb: Callable[[ObjectID], None]):
+        self._on_release.append(cb)
+
+    def add_borrow_removed_callback(self, cb: Callable[[ObjectID, str], None]):
+        self._on_borrow_removed.append(cb)
+
+    # -- ownership ----------------------------------------------------------
+
+    def add_owned_object(self, object_id: ObjectID, in_plasma: bool = False,
+                         pin_lineage: bool = False) -> None:
+        with self._lock:
+            ref = self._refs.setdefault(object_id, Reference())
+            ref.owned = True
+            ref.owner_address = self.own_address
+            ref.in_plasma = in_plasma
+            ref.pinned_lineage = pin_lineage
+
+    def add_borrowed_object(self, object_id: ObjectID, owner_address: str) -> bool:
+        """Returns True if this is the first borrow (caller should notify
+        the owner)."""
+        with self._lock:
+            ref = self._refs.get(object_id)
+            first = ref is None or (not ref.owned and not ref.local_refs
+                                    and not ref.submitted_refs)
+            if ref is None:
+                ref = self._refs[object_id] = Reference()
+            if not ref.owned:
+                ref.owner_address = owner_address
+            return first
+
+    def owner_address_of(self, object_id: ObjectID) -> str:
+        with self._lock:
+            ref = self._refs.get(object_id)
+            return ref.owner_address if ref else ""
+
+    def is_owned(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            ref = self._refs.get(object_id)
+            return bool(ref and ref.owned)
+
+    # -- local refs ---------------------------------------------------------
+
+    def add_local_reference(self, object_id: ObjectID) -> None:
+        with self._lock:
+            ref = self._refs.setdefault(object_id, Reference())
+            ref.local_refs += 1
+
+    def remove_local_reference(self, object_id: ObjectID) -> None:
+        self._decrement(object_id, "local")
+
+    # -- submitted-task refs ------------------------------------------------
+
+    def update_submitted_task_references(self, arg_ids: List[ObjectID]) -> None:
+        with self._lock:
+            for oid in arg_ids:
+                ref = self._refs.setdefault(oid, Reference())
+                ref.submitted_refs += 1
+
+    def update_finished_task_references(self, arg_ids: List[ObjectID]) -> None:
+        for oid in arg_ids:
+            self._decrement(oid, "submitted")
+
+    # -- containment --------------------------------------------------------
+
+    def add_contained_refs(self, outer: ObjectID, inner: List[ObjectID]) -> None:
+        with self._lock:
+            outer_ref = self._refs.setdefault(outer, Reference())
+            for oid in inner:
+                inner_ref = self._refs.setdefault(oid, Reference())
+                inner_ref.contained_in.add(outer)
+                outer_ref.contains.add(oid)
+
+    # -- borrowers (owner side) ---------------------------------------------
+
+    def add_borrower(self, object_id: ObjectID, borrower_address: str) -> None:
+        with self._lock:
+            ref = self._refs.setdefault(object_id, Reference())
+            if borrower_address != self.own_address:
+                ref.borrowers.add(borrower_address)
+
+    def remove_borrower(self, object_id: ObjectID, borrower_address: str) -> None:
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is None:
+                return
+            ref.borrowers.discard(borrower_address)
+        self._maybe_release(object_id)
+
+    # -- locations (owner-resident object directory) ------------------------
+
+    def add_location(self, object_id: ObjectID, node_id: bytes) -> None:
+        with self._lock:
+            ref = self._refs.setdefault(object_id, Reference())
+            ref.locations.add(node_id)
+            ref.in_plasma = True
+
+    def remove_location(self, object_id: ObjectID, node_id: bytes) -> None:
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref:
+                ref.locations.discard(node_id)
+
+    def get_locations(self, object_id: ObjectID) -> Set[bytes]:
+        with self._lock:
+            ref = self._refs.get(object_id)
+            return set(ref.locations) if ref else set()
+
+    # -- internals ----------------------------------------------------------
+
+    def _decrement(self, object_id: ObjectID, kind: str) -> None:
+        notify_owner = None
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is None:
+                return
+            if kind == "local":
+                ref.local_refs = max(0, ref.local_refs - 1)
+            else:
+                ref.submitted_refs = max(0, ref.submitted_refs - 1)
+            if (not ref.owned and ref.local_refs == 0
+                    and ref.submitted_refs == 0 and ref.owner_address):
+                notify_owner = ref.owner_address
+        if notify_owner:
+            for cb in self._on_borrow_removed:
+                try:
+                    cb(object_id, notify_owner)
+                except Exception:
+                    logger.exception("borrow-removed callback failed")
+        self._maybe_release(object_id)
+
+    def _maybe_release(self, object_id: ObjectID) -> None:
+        to_release: List[ObjectID] = []
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is None or ref.freed or not ref.is_releasable():
+                return
+            ref.freed = True
+            to_release.append(object_id)
+            # Releasing an outer object drops containment edges on inner ones.
+            for inner in list(ref.contains):
+                iref = self._refs.get(inner)
+                if iref is not None:
+                    iref.contained_in.discard(object_id)
+                    if iref.is_releasable() and not iref.freed:
+                        iref.freed = True
+                        to_release.append(inner)
+            for oid in to_release:
+                self._refs.pop(oid, None)
+        for oid in to_release:
+            for cb in self._on_release:
+                try:
+                    cb(oid)
+                except Exception:
+                    logger.exception("release callback failed")
+
+    # -- introspection ------------------------------------------------------
+
+    def num_tracked(self) -> int:
+        with self._lock:
+            return len(self._refs)
+
+    def debug_summary(self) -> dict:
+        with self._lock:
+            return {
+                "tracked": len(self._refs),
+                "owned": sum(1 for r in self._refs.values() if r.owned),
+                "borrowed": sum(1 for r in self._refs.values()
+                                if not r.owned and r.owner_address),
+            }
+
+    def all_refs(self) -> Dict[str, dict]:
+        with self._lock:
+            return {
+                oid.hex(): {
+                    "owned": r.owned,
+                    "local_refs": r.local_refs,
+                    "submitted_refs": r.submitted_refs,
+                    "borrowers": sorted(r.borrowers),
+                    "in_plasma": r.in_plasma,
+                }
+                for oid, r in self._refs.items()
+            }

@@ -1,0 +1,326 @@
+"""Asyncio RPC transport: framed msgpack control plane with raw byte frames.
+
+Role-equivalent of the reference's templated gRPC layer (reference:
+src/ray/rpc/grpc_server.h, client_call.h): every control-plane service
+(GCS, raylet, core worker) is an ``RpcServer`` with named async handlers,
+and clients hold one multiplexed connection per peer. Large payloads travel
+as separate length-prefixed raw frames after the msgpack envelope so object
+data is never re-encoded by msgpack.
+
+Wire format per message:
+    [u32 body_len][msgpack body][u64 buf_len + raw bytes] * nbufs
+    body = [kind, seq, method, header, nbufs]
+kinds: 0=request 1=reply 2=error 3=push (one-way).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import os
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+import msgpack
+
+logger = logging.getLogger(__name__)
+
+KIND_REQUEST = 0
+KIND_REPLY = 1
+KIND_ERROR = 2
+KIND_PUSH = 3
+
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+Handler = Callable[["Connection", Any, List[bytes]], Awaitable[Any]]
+
+
+def _pack_msg(kind: int, seq: int, method: str, header: Any,
+              bufs: Sequence[bytes]) -> List[bytes]:
+    body = msgpack.packb([kind, seq, method, header, len(bufs)],
+                         use_bin_type=True)
+    parts = [_U32.pack(len(body)), body]
+    for b in bufs:
+        parts.append(_U64.pack(len(b)))
+        parts.append(b)
+    return parts
+
+
+async def _read_msg(reader: asyncio.StreamReader):
+    hdr = await reader.readexactly(4)
+    (body_len,) = _U32.unpack(hdr)
+    body = await reader.readexactly(body_len)
+    kind, seq, method, header, nbufs = msgpack.unpackb(body, raw=False)
+    bufs = []
+    for _ in range(nbufs):
+        (blen,) = _U64.unpack(await reader.readexactly(8))
+        bufs.append(await reader.readexactly(blen))
+    return kind, seq, method, header, bufs
+
+
+class Connection:
+    """One duplex connection. Used symmetrically: either side can issue
+    requests and pushes once established (workers serve PushTask on the same
+    connection they used to register, like the reference's bidirectional
+    core-worker channels)."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+                 handlers: Dict[str, Handler], peer_name: str = "?"):
+        self.reader = reader
+        self.writer = writer
+        self.handlers = handlers
+        self.peer_name = peer_name
+        self._seq = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._send_lock = asyncio.Lock()
+        self._closed = False
+        self.on_disconnect: List[Callable[["Connection"], None]] = []
+        # Arbitrary per-connection state stamped by services (worker id etc).
+        self.tags: Dict[str, Any] = {}
+        self._recv_task: Optional[asyncio.Task] = None
+
+    def start(self):
+        self._recv_task = asyncio.get_running_loop().create_task(self._recv_loop())
+
+    async def _send(self, parts: List[bytes]):
+        async with self._send_lock:
+            self.writer.writelines(parts)
+            await self.writer.drain()
+
+    async def call(self, method: str, header: Any = None,
+                   bufs: Sequence[bytes] = (), timeout: float | None = None):
+        if self._closed:
+            raise ConnectionError(f"connection to {self.peer_name} is closed")
+        seq = next(self._seq)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[seq] = fut
+        try:
+            await self._send(_pack_msg(KIND_REQUEST, seq, method, header, bufs))
+            if timeout is not None:
+                return await asyncio.wait_for(fut, timeout)
+            return await fut
+        finally:
+            self._pending.pop(seq, None)
+
+    async def push(self, method: str, header: Any = None,
+                   bufs: Sequence[bytes] = ()):
+        """One-way message; no reply expected."""
+        if self._closed:
+            raise ConnectionError(f"connection to {self.peer_name} is closed")
+        await self._send(_pack_msg(KIND_PUSH, 0, method, header, bufs))
+
+    async def _recv_loop(self):
+        try:
+            while True:
+                kind, seq, method, header, bufs = await _read_msg(self.reader)
+                if kind == KIND_REQUEST:
+                    asyncio.get_running_loop().create_task(
+                        self._handle(seq, method, header, bufs))
+                elif kind == KIND_PUSH:
+                    handler = self.handlers.get(method)
+                    if handler is None:
+                        logger.warning("no handler for push %s", method)
+                    else:
+                        asyncio.get_running_loop().create_task(
+                            self._run_push(handler, header, bufs))
+                elif kind == KIND_REPLY:
+                    fut = self._pending.get(seq)
+                    if fut is not None and not fut.done():
+                        fut.set_result((header, bufs))
+                elif kind == KIND_ERROR:
+                    fut = self._pending.get(seq)
+                    if fut is not None and not fut.done():
+                        fut.set_exception(pickle.loads(bufs[0]))
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        except Exception:
+            logger.exception("rpc recv loop error (peer %s)", self.peer_name)
+        finally:
+            self._mark_closed()
+
+    async def _run_push(self, handler, header, bufs):
+        try:
+            await handler(self, header, bufs)
+        except Exception:
+            logger.exception("push handler error")
+
+    async def _handle(self, seq: int, method: str, header, bufs):
+        handler = self.handlers.get(method)
+        try:
+            if handler is None:
+                raise RuntimeError(f"no handler for method {method!r}")
+            result = await handler(self, header, bufs)
+            if isinstance(result, tuple) and len(result) == 2 and \
+                    isinstance(result[1], (list, tuple)):
+                rheader, rbufs = result
+            else:
+                rheader, rbufs = result, ()
+            await self._send(_pack_msg(KIND_REPLY, seq, method, rheader, rbufs))
+        except (ConnectionError, OSError):
+            self._mark_closed()
+        except Exception as e:  # noqa: BLE001 — propagate to caller
+            try:
+                payload = cloudpickle.dumps(e)
+            except Exception:
+                payload = cloudpickle.dumps(RuntimeError(repr(e)))
+            try:
+                await self._send(_pack_msg(KIND_ERROR, seq, method, None, [payload]))
+            except (ConnectionError, OSError):
+                self._mark_closed()
+
+    def _mark_closed(self):
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError(
+                    f"connection to {self.peer_name} lost"))
+        self._pending.clear()
+        for cb in self.on_disconnect:
+            try:
+                cb(self)
+            except Exception:
+                logger.exception("on_disconnect callback failed")
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    async def close(self):
+        self._mark_closed()
+
+
+class RpcServer:
+    """Listens on tcp://host:port or unix://path; spawns a Connection per
+    client, dispatching to ``handlers``."""
+
+    def __init__(self, handlers: Dict[str, Handler], name: str = "server"):
+        self.handlers = handlers
+        self.name = name
+        self.address: str = ""
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.connections: List[Connection] = []
+        self.on_connect: List[Callable[[Connection], None]] = []
+
+    async def _on_client(self, reader, writer):
+        conn = Connection(reader, writer, self.handlers,
+                          peer_name=f"client-of-{self.name}")
+        self.connections.append(conn)
+        conn.on_disconnect.append(lambda c: self.connections.remove(c)
+                                  if c in self.connections else None)
+        for cb in self.on_connect:
+            cb(conn)
+        conn.start()
+
+    async def listen(self, address: str = "") -> str:
+        if address.startswith("unix://"):
+            path = address[len("unix://"):]
+            if os.path.exists(path):
+                os.unlink(path)
+            self._server = await asyncio.start_unix_server(self._on_client, path=path)
+            self.address = address
+        else:
+            host, port = "127.0.0.1", 0
+            if address.startswith("tcp://"):
+                hp = address[len("tcp://"):]
+                host, _, p = hp.rpartition(":")
+                port = int(p)
+            self._server = await asyncio.start_server(
+                self._on_client, host=host, port=port,
+                family=socket.AF_INET)
+            port = self._server.sockets[0].getsockname()[1]
+            self.address = f"tcp://{host}:{port}"
+        return self.address
+
+    async def close(self):
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for conn in list(self.connections):
+            await conn.close()
+
+
+async def connect(address: str, handlers: Dict[str, Handler] | None = None,
+                  timeout: float = 10.0, retry_interval: float = 0.05,
+                  peer_name: str = "") -> Connection:
+    """Dial an RpcServer, retrying until ``timeout`` (the server process may
+    still be booting)."""
+    deadline = asyncio.get_running_loop().time() + timeout
+    last_err: Exception | None = None
+    while True:
+        try:
+            if address.startswith("unix://"):
+                reader, writer = await asyncio.open_unix_connection(
+                    address[len("unix://"):])
+            else:
+                hp = address[len("tcp://"):] if address.startswith("tcp://") else address
+                host, _, p = hp.rpartition(":")
+                reader, writer = await asyncio.open_connection(host, int(p))
+            break
+        except (ConnectionError, OSError, FileNotFoundError) as e:
+            last_err = e
+            if asyncio.get_running_loop().time() > deadline:
+                raise ConnectionError(
+                    f"could not connect to {address}: {last_err}") from last_err
+            await asyncio.sleep(retry_interval)
+    conn = Connection(reader, writer, handlers or {},
+                      peer_name=peer_name or address)
+    conn.start()
+    return conn
+
+
+class EventLoopThread:
+    """A dedicated asyncio loop on a daemon thread.
+
+    The public API (``get``/``put``/``remote``) is synchronous like the
+    reference's; all IO runs on this loop (the analog of the core worker's
+    internal io_service, reference: src/ray/core_worker/core_worker.h
+    io_service_ member).
+    """
+
+    def __init__(self, name: str = "ray-tpu-io"):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def run(self, coro, timeout: float | None = None):
+        """Run a coroutine on the loop from a foreign thread, blocking."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def call_soon(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self):
+        async def _drain():
+            tasks = [t for t in asyncio.all_tasks(self.loop)
+                     if t is not asyncio.current_task()]
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+        if self.loop.is_running():
+            try:
+                asyncio.run_coroutine_threadsafe(
+                    _drain(), self.loop).result(timeout=3)
+            except Exception:
+                pass
+            self.loop.call_soon_threadsafe(self.loop.stop)
+            self._thread.join(timeout=5)
+        if not self.loop.is_closed():
+            self.loop.close()

@@ -1,0 +1,71 @@
+"""Scheduling backends behind one seam.
+
+The raylet delegates every scheduling tick to a backend implementing
+``SchedulingBackend``. Role parity: reference ClusterTaskManager +
+ClusterResourceScheduler + HybridPolicy behind the ISchedulingPolicy /
+ClusterTaskManagerInterface seams (src/ray/raylet/scheduling/
+cluster_task_manager_interface.h, scheduling_policy.h). Two backends:
+
+  * host        — dict/heap reference implementation (correctness oracle)
+  * tpu_batched — JAX batched kernel: pending lease requests and the
+                  cluster resource table become arrays; (task × node)
+                  feasibility+scoring runs as one vmapped step (the
+                  north-star backend; see BASELINE.json)
+
+Both see the same inputs and must produce identical placements for
+identical state (differentially tested in tests/test_scheduler_diff.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+GRANT = "grant"
+SPILL = "spill"
+WAIT = "wait"
+INFEASIBLE = "infeasible"
+
+
+@dataclass
+class PendingRequest:
+    """One queued lease request, in arrival order."""
+    req_id: int
+    scheduling_class: int
+    resources: Dict[str, float]
+    strategy: str = "DEFAULT"
+    pg_id: bytes = b""
+    pg_bundle: int = -1
+    # Bytes of task args already local per candidate node (locality term).
+    locality: Dict[bytes, int] = field(default_factory=dict)
+
+
+@dataclass
+class NodeView:
+    node_id: bytes
+    address: str
+    total: Dict[str, float]
+    available: Dict[str, float]
+    is_local: bool = False
+
+
+@dataclass
+class Decision:
+    req_id: int
+    action: str                     # GRANT | SPILL | WAIT | INFEASIBLE
+    spill_address: str = ""
+
+
+class SchedulingBackend:
+    def schedule(self, pending: List[PendingRequest],
+                 nodes: List[NodeView],
+                 spread_threshold: float) -> List[Decision]:
+        raise NotImplementedError
+
+
+def make_backend(name: str) -> SchedulingBackend:
+    if name == "tpu_batched":
+        from ray_tpu._private.scheduler.tpu_batched import TpuBatchedBackend
+        return TpuBatchedBackend()
+    from ray_tpu._private.scheduler.host_backend import HostBackend
+    return HostBackend()

@@ -1,0 +1,178 @@
+"""tpu_batched scheduling backend: the decision path as one JAX kernel.
+
+The north-star design (BASELINE.json): instead of per-task callback chains
+(reference: ClusterTaskManager::DispatchScheduledTasksToWorkers,
+src/ray/raylet/scheduling/cluster_task_manager.cc), the whole tick is a
+single jit-compiled program over arrays:
+
+  * demands  [T, R]  — resource demand per pending lease request
+  * totals   [N, R]  / avail [N, R] — cluster resource table
+  * locality [T, N]  — bytes of each task's args already on each node
+  * is_local [N]
+
+One ``lax.scan`` over tasks (grants must see earlier grants' resource
+consumption — inherently sequential) with fully vectorized per-node
+feasibility + fixed-point scoring inside each step; XLA fuses the scan body
+into one kernel, so a tick over thousands of pending tasks is one device
+launch instead of thousands of callback invocations. Sizes are bucketed to
+keep retraces rare.
+
+Placements are bit-identical to the host backend (shared fixed-point score,
+scheduler/scoring.py); tests/test_scheduler_diff.py enforces it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List
+
+from ray_tpu._private.scheduler import (
+    GRANT, INFEASIBLE, SPILL, WAIT, Decision, NodeView, PendingRequest,
+    SchedulingBackend,
+)
+from ray_tpu._private.scheduler.scoring import (
+    HI_LOC_SHIFT, LO_LOC_MASK, LOC_MAX, UTIL_MAX, UTIL_SCALE,
+    spread_threshold_fp,
+)
+
+ACTION_WAIT = -1
+ACTION_INFEASIBLE = -2
+
+
+def _bucket(n: int) -> int:
+    """Pad to power-of-two-ish buckets so jit retraces stay rare."""
+    b = 8
+    while b < n:
+        b *= 2
+    return b
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_kernel(t_bucket: int, n_bucket: int, r_bucket: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def kernel(demands, totals, avail0, locality, is_local, valid_task,
+               valid_node, spread_fp):
+        # demands [T,R] f32, totals/avail0 [N,R] f32, locality [T,N] i32,
+        # is_local [N] bool, valid_* masks, spread_fp scalar i64.
+        inv_totals = jnp.where(totals > 0, 1.0 / jnp.maximum(totals, 1e-9), 0.0)
+        local_idx = jnp.argmax(is_local)
+
+        def step(avail, inp):
+            d, loc, tvalid = inp
+            feasible = jnp.all(totals + 1e-9 >= d[None, :], axis=1) & valid_node
+            ready = jnp.all(avail + 1e-9 >= d[None, :], axis=1) & feasible
+            used = (totals - avail) + d[None, :]
+            # Fixed-point critical-resource utilization, ceil semantics.
+            frac = used * inv_totals
+            fp = jnp.ceil(frac * UTIL_SCALE).astype(jnp.int32)
+            fp = jnp.clip(jnp.where(totals > 0, fp, 0), 0, UTIL_MAX)
+            util_fp = jnp.max(fp, axis=1)                       # [N] i32
+            anti_loc = (1 << 20) - jnp.minimum(
+                loc.astype(jnp.int32) >> 10, LOC_MAX)
+            node_idx = jnp.arange(n_bucket, dtype=jnp.int32)
+            remote = jnp.where(is_local, 0, 1).astype(jnp.int32)
+            # 58-bit key carried as (hi, lo) int32 pair (see scoring.py).
+            hi = (util_fp << 10) | (anti_loc >> HI_LOC_SHIFT)
+            lo = ((anti_loc & LO_LOC_MASK) << 16) | (remote << 15) | node_idx
+            imax = jnp.int32(2**31 - 1)
+            hi = jnp.where(ready, hi, imax)
+            min_hi = jnp.min(hi)
+            lo = jnp.where(ready & (hi == min_hi), lo, imax)
+            best = jnp.argmin(lo)
+            # Hybrid rule: local node wins while under the spread threshold.
+            local_ready = ready[local_idx] & (util_fp[local_idx] <= spread_fp)
+            chosen = jnp.where(local_ready, local_idx, best)
+            any_ready = jnp.any(ready)
+            any_feasible = jnp.any(feasible)
+            action = jnp.where(
+                ~tvalid, ACTION_WAIT,
+                jnp.where(~any_feasible, ACTION_INFEASIBLE,
+                          jnp.where(any_ready, chosen, ACTION_WAIT)))
+            take = (action >= 0)
+            delta = jnp.where(
+                (jnp.arange(n_bucket) == action)[:, None] & take, d[None, :], 0.0)
+            return avail - delta, action.astype(jnp.int32)
+
+        _, actions = lax.scan(step, avail0, (demands, locality, valid_task))
+        return actions
+
+    return jax.jit(kernel, static_argnames=())
+
+
+class TpuBatchedBackend(SchedulingBackend):
+    """Drop-in for HostBackend behind the scheduler seam."""
+
+    def __init__(self):
+        import jax.numpy as jnp  # noqa: F401 — fail fast if jax is missing
+        self._resource_names: List[str] = []
+
+    def schedule(self, pending: List[PendingRequest],
+                 nodes: List[NodeView],
+                 spread_threshold: float) -> List[Decision]:
+        import numpy as np
+
+        if not pending:
+            return []
+        # Stable resource-kind interning across ticks (reference:
+        # scheduling_ids.h string->int interning).
+        kinds = list(self._resource_names)
+        known = set(kinds)
+        for req in pending:
+            for k in req.resources:
+                if k not in known:
+                    kinds.append(k)
+                    known.add(k)
+        for n in nodes:
+            for k in n.total:
+                if k not in known:
+                    kinds.append(k)
+                    known.add(k)
+        self._resource_names = kinds
+
+        T, N, R = len(pending), len(nodes), max(len(kinds), 1)
+        tb, nb, rb = _bucket(T), _bucket(N), _bucket(R)
+        demands = np.zeros((tb, rb), dtype=np.float32)
+        locality = np.zeros((tb, nb), dtype=np.int32)
+        totals = np.zeros((nb, rb), dtype=np.float32)
+        avail = np.zeros((nb, rb), dtype=np.float32)
+        is_local = np.zeros((nb,), dtype=bool)
+        valid_task = np.zeros((tb,), dtype=bool)
+        valid_node = np.zeros((nb,), dtype=bool)
+        kidx = {k: i for i, k in enumerate(kinds)}
+        for ti, req in enumerate(pending):
+            valid_task[ti] = True
+            for k, v in req.resources.items():
+                if v > 0:
+                    demands[ti, kidx[k]] = v
+            for ni, n in enumerate(nodes):
+                locality[ti, ni] = min(req.locality.get(n.node_id, 0), 2**31 - 1)
+        for ni, n in enumerate(nodes):
+            valid_node[ni] = True
+            is_local[ni] = n.is_local
+            for k, v in n.total.items():
+                totals[ni, kidx[k]] = v
+            for k, v in n.available.items():
+                avail[ni, kidx[k]] = v
+
+        kernel = _compiled_kernel(tb, nb, rb)
+        actions = np.asarray(kernel(
+            demands, totals, avail, locality, is_local, valid_task, valid_node,
+            np.int32(min(spread_threshold_fp(spread_threshold), 2**31 - 1))))
+
+        decisions: List[Decision] = []
+        local = next((n for n in nodes if n.is_local), None)
+        for ti, req in enumerate(pending):
+            a = int(actions[ti])
+            if a == ACTION_INFEASIBLE:
+                decisions.append(Decision(req.req_id, INFEASIBLE))
+            elif a == ACTION_WAIT or a >= N:
+                decisions.append(Decision(req.req_id, WAIT))
+            elif local is not None and nodes[a].node_id == local.node_id:
+                decisions.append(Decision(req.req_id, GRANT))
+            else:
+                decisions.append(Decision(req.req_id, SPILL,
+                                          spill_address=nodes[a].address))
+        return decisions

@@ -1,0 +1,215 @@
+"""Value serialization: cloudpickle envelope with out-of-band buffers.
+
+Equivalent role to the reference's msgpack+pickle5 SerializationContext
+(reference: python/ray/serialization.py): values are pickled with protocol 5
+so large contiguous buffers (numpy / jax host arrays) travel out-of-band and
+can be mapped zero-copy out of the shared-memory store on the receive side.
+ObjectRefs and actor handles embedded in values are intercepted so the
+ownership layer can record borrows.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import traceback
+from typing import Any, Callable, List, Sequence, Tuple
+
+import cloudpickle
+
+from ray_tpu import exceptions as exc
+
+# Metadata tags. The first frame of a serialized object is the pickle
+# payload; metadata describes how to interpret it.
+META_PICKLE = b"py"          # cloudpickle protocol-5 payload
+META_RAW = b"raw"            # raw bytes payload (zero-copy passthrough)
+META_ERROR = b"err"          # pickled exception; get() raises it
+META_ACTOR_HANDLE = b"actor"
+META_INLINE_REF = b"inref"   # value is an object ref forwarded inline
+
+
+class SerializedObject:
+    """A serialized value: metadata tag + list of byte frames.
+
+    frames[0] is the pickle (or raw) payload; frames[1:] are out-of-band
+    pickle-5 buffers. ``contained_refs`` lists ObjectIDs of refs embedded in
+    the value (for borrow tracking by the reference counter).
+    """
+
+    __slots__ = ("metadata", "frames", "contained_refs")
+
+    def __init__(self, metadata: bytes, frames: Sequence[Any], contained_refs=None):
+        self.metadata = metadata
+        self.frames = list(frames)
+        self.contained_refs = contained_refs or []
+
+    def total_bytes(self) -> int:
+        total = 0
+        for f in self.frames:
+            if isinstance(f, (bytes, bytearray)):
+                total += len(f)
+            elif isinstance(f, pickle.PickleBuffer):
+                total += f.raw().nbytes
+            else:
+                total += f.nbytes
+        return total
+
+    def to_wire(self) -> Tuple[bytes, List[bytes]]:
+        """Flatten to (metadata, [bytes...]) for the RPC layer."""
+        out = []
+        for f in self.frames:
+            if isinstance(f, pickle.PickleBuffer):
+                out.append(f.raw().tobytes())
+            elif isinstance(f, memoryview):
+                out.append(f.tobytes())
+            elif isinstance(f, bytearray):
+                out.append(bytes(f))
+            else:
+                out.append(f)
+        return self.metadata, out
+
+
+class SerializationContext:
+    """Per-process serializer. Hooks let the core worker observe refs that
+    cross the boundary (ownership / borrowing bookkeeping)."""
+
+    def __init__(self):
+        # Called with ObjectRef during pickling -> returns reducible state.
+        self._ref_serializer: Callable | None = None
+        # Called with the reduced state during unpickling -> ObjectRef.
+        self._ref_deserializer: Callable | None = None
+        self._actor_serializer: Callable | None = None
+        self._actor_deserializer: Callable | None = None
+        self._custom_reducers = {}
+
+    def set_object_ref_reducer(self, serializer, deserializer):
+        self._ref_serializer = serializer
+        self._ref_deserializer = deserializer
+
+    def set_actor_handle_reducer(self, serializer, deserializer):
+        self._actor_serializer = serializer
+        self._actor_deserializer = deserializer
+
+    def register_custom_serializer(self, cls, reducer):
+        """reducer(obj) -> (reconstruct_fn, args)."""
+        self._custom_reducers[cls] = reducer
+
+    # -- serialize ----------------------------------------------------------
+
+    def serialize(self, value: Any) -> SerializedObject:
+        from ray_tpu._private.object_ref import ObjectRef  # cycle-free at call time
+        from ray_tpu.actor import ActorHandle
+
+        if isinstance(value, bytes):
+            # Fast path for raw byte payloads.
+            return SerializedObject(META_RAW, [value])
+
+        contained: List = []
+        buffers: List[pickle.PickleBuffer] = []
+        ctx = self
+
+        class _Pickler(cloudpickle.CloudPickler):
+            def reducer_override(self, obj):
+                if isinstance(obj, ObjectRef):
+                    if ctx._ref_serializer is not None:
+                        contained.append(obj)
+                        state = ctx._ref_serializer(obj)
+                        return (_deserialize_ref_placeholder, (state,))
+                elif isinstance(obj, ActorHandle):
+                    if ctx._actor_serializer is not None:
+                        state = ctx._actor_serializer(obj)
+                        return (_deserialize_actor_placeholder, (state,))
+                elif type(obj) in ctx._custom_reducers:
+                    return ctx._custom_reducers[type(obj)](obj)
+                return NotImplemented
+
+        f = io.BytesIO()
+        p = _Pickler(f, protocol=5, buffer_callback=buffers.append)
+        p.dump(value)
+        frames: List[Any] = [f.getvalue()]
+        frames.extend(buffers)
+        meta = META_PICKLE
+        if isinstance(value, BaseException):
+            meta = META_ERROR
+        return SerializedObject(meta, frames,
+                                contained_refs=[r.object_id for r in contained])
+
+    def serialize_error(self, error: BaseException) -> SerializedObject:
+        try:
+            so = self.serialize(error)
+        except Exception:
+            # Unpicklable exception: degrade to a RayTaskError with the repr.
+            so = self.serialize(exc.RayTaskError(
+                function_name=getattr(error, "function_name", ""),
+                traceback_str=repr(error)))
+        so.metadata = META_ERROR
+        return so
+
+    # -- deserialize --------------------------------------------------------
+
+    def deserialize(self, metadata: bytes, frames: Sequence[Any]) -> Any:
+        if metadata == META_RAW:
+            f = frames[0]
+            return bytes(f) if not isinstance(f, bytes) else f
+        payload, bufs = frames[0], [pickle.PickleBuffer(b) for b in frames[1:]]
+        token = _DeserCtx.push(self)
+        try:
+            value = pickle.loads(payload, buffers=bufs)
+        finally:
+            _DeserCtx.pop(token)
+        if metadata == META_ERROR:
+            if isinstance(value, exc.RayTaskError):
+                raise value.as_instanceof_cause()
+            if isinstance(value, BaseException):
+                raise value
+            raise exc.RaySystemError(f"malformed error object: {value!r}")
+        return value
+
+
+class _DeserCtx:
+    """Thread-local stack of active deserialization contexts so the module-
+    level placeholder reconstructors can find the right hooks."""
+
+    import threading
+    _local = threading.local()
+
+    @classmethod
+    def push(cls, ctx):
+        stack = getattr(cls._local, "stack", None)
+        if stack is None:
+            stack = cls._local.stack = []
+        stack.append(ctx)
+        return len(stack) - 1
+
+    @classmethod
+    def pop(cls, token):
+        cls._local.stack.pop()
+
+    @classmethod
+    def current(cls) -> SerializationContext:
+        stack = getattr(cls._local, "stack", None)
+        if not stack:
+            raise RuntimeError("no active deserialization context")
+        return stack[-1]
+
+
+def _deserialize_ref_placeholder(state):
+    ctx = _DeserCtx.current()
+    if ctx._ref_deserializer is None:
+        raise RuntimeError("ObjectRef deserializer not registered")
+    return ctx._ref_deserializer(state)
+
+
+def _deserialize_actor_placeholder(state):
+    ctx = _DeserCtx.current()
+    if ctx._actor_deserializer is None:
+        raise RuntimeError("ActorHandle deserializer not registered")
+    return ctx._actor_deserializer(state)
+
+
+def format_task_error(function_name: str, e: BaseException) -> exc.RayTaskError:
+    return exc.RayTaskError(
+        function_name=function_name,
+        traceback_str=traceback.format_exc(),
+        cause=e,
+    )

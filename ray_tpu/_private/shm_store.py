@@ -1,0 +1,293 @@
+"""Node-local shared-memory object store (plasma equivalent).
+
+Role parity: reference plasma store (src/ray/object_manager/plasma/store.h,
+client.h) — large objects live in POSIX shared memory, mapped zero-copy by
+every worker on the node. Differences by design: instead of a dlmalloc arena
+with fd-passing, each object is one named shm segment created by the
+*writing* client and registered (sealed) with the node's store server (the
+raylet), which owns eviction, pinning, spill-to-disk and unlink. Readers
+attach by name — no data ever crosses a socket intra-node.
+
+Segment layout: [u32 header_len][msgpack [metadata, [frame_len...]]]
+[frame bytes...] with each frame 8-byte aligned so numpy/jax views are
+aligned.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import secrets
+import struct
+import time
+from multiprocessing import resource_tracker, shared_memory
+from typing import Dict, List, Optional, Tuple
+
+import msgpack
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.serialization import SerializedObject
+
+logger = logging.getLogger(__name__)
+
+_U32 = struct.Struct("<I")
+
+
+def _align8(n: int) -> int:
+    return (n + 7) & ~7
+
+
+def _untrack(shm: shared_memory.SharedMemory) -> None:
+    """Detach from the resource tracker: segment lifetime is owned by the
+    store server, not whichever client process happened to create it."""
+    try:
+        resource_tracker.unregister(shm._name, "shared_memory")  # noqa: SLF001
+    except Exception:
+        pass
+
+
+def write_segment(serialized: SerializedObject) -> Tuple[str, int]:
+    """Create + fill a segment; returns (segment_name, total_size)."""
+    meta, frames = serialized.metadata, serialized.frames
+    raw_frames: List[memoryview] = []
+    for f in frames:
+        if hasattr(f, "raw"):  # PickleBuffer
+            raw_frames.append(f.raw())
+        else:
+            raw_frames.append(memoryview(f))
+    header = msgpack.packb(
+        [meta, [f.nbytes for f in raw_frames]], use_bin_type=True)
+    offset0 = _align8(4 + len(header))
+    total = offset0
+    offsets = []
+    for f in raw_frames:
+        offsets.append(total)
+        total = _align8(total + f.nbytes)
+    name = f"rtpu_{secrets.token_hex(8)}"
+    shm = shared_memory.SharedMemory(name=name, create=True, size=max(total, 1))
+    _untrack(shm)
+    buf = shm.buf
+    buf[0:4] = _U32.pack(len(header))
+    buf[4:4 + len(header)] = header
+    for off, f in zip(offsets, raw_frames):
+        buf[off:off + f.nbytes] = f.cast("B") if f.format != "B" or f.ndim != 1 else f
+    shm.close()
+    return name, total
+
+
+class AttachedObject:
+    """A reader-side mapping. Keeps the SharedMemory alive while any
+    deserialized view of the data is alive."""
+
+    __slots__ = ("shm", "metadata", "frames")
+
+    def __init__(self, name: str):
+        # Attach-only: python 3.12 does not resource-track attachments, so
+        # no _untrack here (an unmatched unregister trips the tracker).
+        self.shm = shared_memory.SharedMemory(name=name)
+        buf = self.shm.buf
+        (header_len,) = _U32.unpack(bytes(buf[0:4]))
+        meta, frame_lens = msgpack.unpackb(bytes(buf[4:4 + header_len]), raw=False)
+        self.metadata = meta
+        self.frames = []
+        off = _align8(4 + header_len)
+        for ln in frame_lens:
+            self.frames.append(buf[off:off + ln])
+            off = _align8(off + ln)
+
+    def close(self):
+        self.frames = []
+        try:
+            self.shm.close()
+        except Exception:
+            pass
+
+
+class ShmStoreServer:
+    """Runs inside the raylet. Tracks sealed segments, enforces the store
+    capacity with LRU eviction of unpinned objects, spills evicted-but-
+    needed primaries to disk and restores them on demand (reference:
+    LocalObjectManager, src/ray/raylet/local_object_manager.h)."""
+
+    def __init__(self, capacity_bytes: int, spill_dir: str = "",
+                 spilling_enabled: bool = True):
+        self.capacity = capacity_bytes
+        self.spill_dir = spill_dir
+        self.spilling_enabled = spilling_enabled and bool(spill_dir)
+        if self.spill_dir:
+            os.makedirs(self.spill_dir, exist_ok=True)
+        # oid -> (segment_name, size, created_ts)
+        self._objects: Dict[ObjectID, Tuple[str, int, float]] = {}
+        self._pinned: Dict[ObjectID, int] = {}
+        self._last_access: Dict[ObjectID, float] = {}
+        self._spilled: Dict[ObjectID, Tuple[str, int]] = {}  # oid -> (path, size)
+        self.used = 0
+        self.num_evictions = 0
+        self.num_spills = 0
+        self.num_restores = 0
+
+    # -- write path ---------------------------------------------------------
+
+    def seal(self, object_id: ObjectID, segment_name: str, size: int) -> bool:
+        if object_id in self._objects:
+            # Duplicate seal (e.g. task retry): drop the new segment.
+            self._unlink(segment_name)
+            return True
+        if self.used + size > self.capacity:
+            self._evict(self.used + size - self.capacity)
+        if self.used + size > self.capacity:
+            self._unlink(segment_name)
+            return False
+        self._objects[object_id] = (segment_name, size, time.time())
+        self._last_access[object_id] = time.time()
+        self.used += size
+        return True
+
+    # -- read path ----------------------------------------------------------
+
+    def lookup(self, object_id: ObjectID) -> Optional[str]:
+        entry = self._objects.get(object_id)
+        if entry is not None:
+            self._last_access[object_id] = time.time()
+            return entry[0]
+        if object_id in self._spilled:
+            return self._restore(object_id)
+        return None
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return object_id in self._objects or object_id in self._spilled
+
+    # -- pinning (primary copies; owner-driven) ------------------------------
+
+    def pin(self, object_id: ObjectID) -> None:
+        self._pinned[object_id] = self._pinned.get(object_id, 0) + 1
+
+    def unpin(self, object_id: ObjectID) -> None:
+        n = self._pinned.get(object_id, 0) - 1
+        if n <= 0:
+            self._pinned.pop(object_id, None)
+        else:
+            self._pinned[object_id] = n
+
+    # -- free / eviction / spilling -----------------------------------------
+
+    def free(self, object_id: ObjectID) -> None:
+        entry = self._objects.pop(object_id, None)
+        self._pinned.pop(object_id, None)
+        self._last_access.pop(object_id, None)
+        if entry is not None:
+            name, size, _ = entry
+            self.used -= size
+            self._unlink(name)
+        spilled = self._spilled.pop(object_id, None)
+        if spilled is not None:
+            try:
+                os.unlink(spilled[0])
+            except OSError:
+                pass
+
+    def _evict(self, need_bytes: int) -> None:
+        """Evict LRU unpinned objects; pinned primaries are spilled to disk
+        instead of dropped when spilling is on."""
+        victims = sorted(
+            (oid for oid in self._objects if oid not in self._pinned),
+            key=lambda o: self._last_access.get(o, 0.0))
+        freed = 0
+        for oid in victims:
+            if freed >= need_bytes:
+                break
+            name, size, _ = self._objects.pop(oid)
+            self._last_access.pop(oid, None)
+            self.used -= size
+            freed += size
+            self.num_evictions += 1
+            self._unlink(name)
+        if freed < need_bytes and self.spilling_enabled:
+            pinned_victims = sorted(
+                (oid for oid in self._objects),
+                key=lambda o: self._last_access.get(o, 0.0))
+            for oid in pinned_victims:
+                if freed >= need_bytes:
+                    break
+                freed += self._spill(oid)
+
+    def _spill(self, object_id: ObjectID) -> int:
+        name, size, _ = self._objects.pop(object_id)
+        self._last_access.pop(object_id, None)
+        path = os.path.join(self.spill_dir, object_id.hex())
+        try:
+            shm = shared_memory.SharedMemory(name=name)
+            with open(path, "wb") as f:
+                f.write(shm.buf[:size])
+            shm.close()
+        except Exception:
+            logger.exception("spill of %s failed", object_id)
+            self._objects[object_id] = (name, size, time.time())
+            return 0
+        self.used -= size
+        self.num_spills += 1
+        self._spilled[object_id] = (path, size)
+        self._unlink(name)
+        return size
+
+    def _restore(self, object_id: ObjectID) -> Optional[str]:
+        path, size = self._spilled[object_id]
+        if self.used + size > self.capacity:
+            self._evict(self.used + size - self.capacity)
+        name = f"rtpu_{secrets.token_hex(8)}"
+        try:
+            shm = shared_memory.SharedMemory(name=name, create=True,
+                                             size=max(size, 1))
+            _untrack(shm)
+            with open(path, "rb") as f:
+                data = f.read()
+            shm.buf[:len(data)] = data
+            shm.close()
+        except Exception:
+            logger.exception("restore of %s failed", object_id)
+            return None
+        del self._spilled[object_id]
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        self._objects[object_id] = (name, size, time.time())
+        self._last_access[object_id] = time.time()
+        self.used += size
+        self.num_restores += 1
+        return name
+
+    @staticmethod
+    def _unlink(segment_name: str) -> None:
+        try:
+            shm = shared_memory.SharedMemory(name=segment_name)
+            shm.close()
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+        except Exception:
+            logger.exception("unlink of %s failed", segment_name)
+
+    def shutdown(self) -> None:
+        for name, _, _ in self._objects.values():
+            self._unlink(name)
+        self._objects.clear()
+        for path, _ in self._spilled.values():
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        self._spilled.clear()
+        self.used = 0
+
+    def stats(self) -> dict:
+        return {
+            "used_bytes": self.used,
+            "capacity_bytes": self.capacity,
+            "num_objects": len(self._objects),
+            "num_pinned": len(self._pinned),
+            "num_spilled": len(self._spilled),
+            "num_evictions": self.num_evictions,
+            "num_spills": self.num_spills,
+            "num_restores": self.num_restores,
+        }

@@ -1,0 +1,344 @@
+"""Worker-side task execution.
+
+Role parity: reference task execution path (_raylet.pyx execute_task +
+CoreWorkerDirectTaskReceiver / ActorSchedulingQueue in
+src/ray/core_worker/transport/direct_actor_transport.h): normal tasks run
+serially off a FIFO; actor tasks are reordered by client sequence number and
+executed in order, with max_concurrency threads for threaded actors and an
+asyncio path for async actors (the analog of the reference's boost::fiber
+actors). Return values small enough go back inline in the RPC reply into
+the owner's memory store; large ones are sealed into the node's shm store
+and the reply carries only the location.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import logging
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private import rpc
+from ray_tpu._private.core_worker import CoreWorker
+from ray_tpu._private.ids import ObjectID, TaskID
+from ray_tpu._private.memory_store import IN_PLASMA
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.serialization import SerializedObject, format_task_error
+from ray_tpu._private.shm_store import write_segment
+from ray_tpu._private.task_spec import ARG_REF, ARG_VALUE, TaskSpec
+
+logger = logging.getLogger(__name__)
+
+_task_ctx = threading.local()
+
+
+def current_task_id() -> bytes:
+    return getattr(_task_ctx, "task_id", b"")
+
+
+class TaskExecutor:
+    def __init__(self, core: CoreWorker):
+        self.core = core
+        # Normal tasks execute serially, like a reference worker.
+        self._task_pool = ThreadPoolExecutor(max_workers=1,
+                                             thread_name_prefix="rtpu-exec")
+        self._actor_instance: Any = None
+        self._actor_id: bytes = b""
+        self._actor_is_asyncio = False
+        self._actor_sema: Optional[asyncio.Semaphore] = None
+        self._actor_pool: Optional[ThreadPoolExecutor] = None
+        self._actor_expected_seqno = 0
+        self._actor_reorder: Dict[int, Tuple[dict, List[bytes], asyncio.Future]] = {}
+        self._actor_exec_queue: Optional[asyncio.Queue] = None
+        self._actor_consumer: Optional[asyncio.Task] = None
+        core._server.handlers.update({
+            "PushTask": self.handle_push_task,
+            "CreateActor": self.handle_create_actor,
+            "PushActorTask": self.handle_push_actor_task,
+            "CancelTask": self.handle_cancel_task,
+            "Exit": self.handle_exit,
+        })
+        self._cancelled: set[bytes] = set()
+
+    # ------------------------------------------------------------ normal tasks
+
+    async def handle_push_task(self, conn, header, bufs):
+        spec = TaskSpec.from_wire(header, bufs)
+        if spec.task_id in self._cancelled:
+            self._cancelled.discard(spec.task_id)
+            return self._error_reply(spec, exc.TaskCancelledError(spec.name))
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._task_pool, self._execute_task_sync, spec)
+
+    def _execute_task_sync(self, spec: TaskSpec):
+        _task_ctx.task_id = spec.task_id
+        self.core._current_task_id = spec.task_id
+        try:
+            fn = self.core.function_manager.fetch(spec.fn_key)
+            args, kwargs = self._resolve_args(spec)
+            t0 = _now()
+            result = fn(*args, **kwargs)
+            self.core.add_task_event({
+                "event": "task:execute", "name": spec.name,
+                "task_id": spec.task_id.hex(), "start": t0, "end": _now(),
+                "worker_id": self.core.worker_id.hex()})
+            return self._build_reply(spec, result)
+        except Exception as e:  # noqa: BLE001
+            logger.info("task %s failed:\n%s", spec.name, traceback.format_exc())
+            return self._error_reply(spec, format_task_error(spec.name, e))
+        finally:
+            _task_ctx.task_id = b""
+            self.core._current_task_id = b""
+
+    def _resolve_args(self, spec: TaskSpec) -> Tuple[list, dict]:
+        args: List[Any] = []
+        for a in spec.args:
+            if a.kind == ARG_VALUE:
+                obj = SerializedObject(a.metadata, a.frames)
+                args.append(self.core.serialization_context.deserialize(
+                    obj.metadata, obj.frames))
+            else:
+                ref = ObjectRef(ObjectID(a.object_id),
+                                owner_address=a.owner_address,
+                                worker=self.core, skip_adding_local_ref=True)
+                value = self.core._run(self.core._get_one(ref, 600.0))
+                args.append(value)
+        # kwargs travel as a trailing marker dict (see remote_function).
+        kwargs = {}
+        if args and isinstance(args[-1], dict) and args[-1].get("__rtpu_kwargs__"):
+            kwargs = args.pop()["kwargs"]
+        return args, kwargs
+
+    def _build_reply(self, spec: TaskSpec, result: Any):
+        if spec.num_returns == 0:
+            return {"status": "ok", "task_id": spec.task_id, "returns": []}, []
+        if spec.num_returns == 1:
+            results = [result]
+        else:
+            results = list(result) if result is not None else []
+            if len(results) != spec.num_returns:
+                return self._error_reply(spec, format_task_error(
+                    spec.name, ValueError(
+                        f"task declared {spec.num_returns} returns but "
+                        f"produced {len(results)}")))
+        returns = []
+        frames_out: List[bytes] = []
+        task_id = TaskID(spec.task_id)
+        for i, value in enumerate(results):
+            oid = task_id.object_id(i + 1)
+            serialized = self.core.serialization_context.serialize(value)
+            if serialized.total_bytes() <= \
+                    self.core.config.max_direct_call_object_size:
+                meta, frames = serialized.to_wire()
+                start = len(frames_out)
+                frames_out.extend(frames)
+                returns.append({
+                    "object_id": oid.binary(), "in_plasma": False,
+                    "metadata": meta, "frame_start": start,
+                    "num_frames": len(frames),
+                    "contained": [r.binary() for r in serialized.contained_refs]})
+            else:
+                segment, size = write_segment(serialized)
+                reply, _ = self.core._run(self.core.raylet_conn.call(
+                    "SealObject", {"object_id": oid.binary(),
+                                   "segment": segment, "size": size,
+                                   "pin": True}))
+                if not reply.get("ok"):
+                    return self._error_reply(spec, exc.ObjectStoreFullError(
+                        f"return {i} of {spec.name} ({size}B) doesn't fit"))
+                returns.append({
+                    "object_id": oid.binary(), "in_plasma": True,
+                    "node_id": reply["node_id"],
+                    "contained": [r.binary() for r in serialized.contained_refs]})
+        return {"status": "ok", "task_id": spec.task_id,
+                "returns": returns}, frames_out
+
+    def _error_reply(self, spec: TaskSpec, error: BaseException):
+        serialized = self.core.serialization_context.serialize_error(error)
+        returns = []
+        frames_out: List[bytes] = []
+        task_id = TaskID(spec.task_id)
+        meta, frames = serialized.to_wire()
+        for i in range(max(spec.num_returns, 1)):
+            start = len(frames_out)
+            frames_out.extend(frames)
+            returns.append({"object_id": task_id.object_id(i + 1).binary(),
+                            "in_plasma": False, "metadata": meta,
+                            "frame_start": start, "num_frames": len(frames),
+                            "contained": []})
+        return {"status": "error", "task_id": spec.task_id,
+                "returns": returns}, frames_out
+
+    async def handle_cancel_task(self, conn, header, bufs):
+        self._cancelled.add(header["task_id"])
+        return {"ok": True}
+
+    async def handle_exit(self, conn, header, bufs):
+        loop = asyncio.get_running_loop()
+        loop.call_later(0.05, loop.stop)
+        return {"ok": True}
+
+    # ------------------------------------------------------------- actors
+
+    async def handle_create_actor(self, conn, header, bufs):
+        spec = TaskSpec.from_wire(header["spec"], bufs)
+        creation = spec.actor_creation or {}
+        try:
+            loop = asyncio.get_running_loop()
+            instance = await loop.run_in_executor(
+                self._task_pool, self._construct_actor, spec)
+        except Exception as e:  # noqa: BLE001
+            logger.info("actor %s constructor failed:\n%s", spec.name,
+                        traceback.format_exc())
+            return {"ok": False,
+                    "error": f"{type(e).__name__}: {e}\n{traceback.format_exc()}"}
+        self._actor_instance = instance
+        self._actor_id = header["actor_id"]
+        self._actor_is_asyncio = creation.get("is_asyncio", False)
+        max_concurrency = creation.get("max_concurrency", 1)
+        if self._actor_is_asyncio:
+            self._actor_sema = asyncio.Semaphore(max(max_concurrency, 1000)
+                                                 if max_concurrency == 1
+                                                 else max_concurrency)
+        else:
+            self._actor_pool = ThreadPoolExecutor(
+                max_workers=max_concurrency,
+                thread_name_prefix="rtpu-actor")
+        self._actor_exec_queue = asyncio.Queue()
+        self._actor_consumer = asyncio.get_running_loop().create_task(
+            self._actor_consume_loop())
+        return {"ok": True}
+
+    def _construct_actor(self, spec: TaskSpec):
+        _task_ctx.task_id = spec.task_id
+        self.core._current_task_id = spec.task_id
+        try:
+            cls = self.core.function_manager.fetch(spec.fn_key)
+            args, kwargs = self._resolve_args(spec)
+            return cls(*args, **kwargs)
+        finally:
+            _task_ctx.task_id = b""
+            self.core._current_task_id = b""
+
+    async def handle_push_actor_task(self, conn, header, bufs):
+        """Receiver-side ordering: execute strictly in client seqno order,
+        buffering out-of-order arrivals (reference: ActorSchedulingQueue)."""
+        seqno = header["seqno"]
+        fut = asyncio.get_running_loop().create_future()
+        self._actor_reorder[seqno] = (header, list(bufs), fut)
+        self._drain_reorder_buffer()
+        return await fut
+
+    def _drain_reorder_buffer(self):
+        while self._actor_expected_seqno in self._actor_reorder:
+            seqno = self._actor_expected_seqno
+            header, bufs, fut = self._actor_reorder.pop(seqno)
+            self._actor_expected_seqno += 1
+            self._actor_exec_queue.put_nowait((header, bufs, fut))
+
+    async def _actor_consume_loop(self):
+        while True:
+            header, bufs, fut = await self._actor_exec_queue.get()
+            try:
+                spec = TaskSpec.from_wire(header, bufs)
+                if self._actor_is_asyncio:
+                    await self._actor_sema.acquire()
+                    asyncio.get_running_loop().create_task(
+                        self._run_async_actor_task(spec, fut))
+                else:
+                    loop = asyncio.get_running_loop()
+
+                    def _runner(spec=spec, fut=fut):
+                        # Bind spec/fut as defaults: the enclosing loop
+                        # rebinds them before the pool thread runs.
+                        try:
+                            res = self._execute_actor_task_sync(spec)
+                        except BaseException as e:  # noqa: BLE001
+                            logger.exception("actor task runner crashed")
+                            res = self._error_reply(spec, exc.RaySystemError(
+                                f"actor task runner crashed: {e!r}"))
+
+                        def _set():
+                            if not fut.done():
+                                fut.set_result(res)
+
+                        loop.call_soon_threadsafe(_set)
+
+                    self._actor_pool.submit(_runner)
+            except BaseException as e:  # noqa: BLE001
+                logger.exception("actor consume loop error")
+                if not fut.done():
+                    fut.set_exception(e)
+
+    def _execute_actor_task_sync(self, spec: TaskSpec):
+        _task_ctx.task_id = spec.task_id
+        try:
+            method = self._lookup_method(spec.name)
+            args, kwargs = self._resolve_args(spec)
+            result = method(*args, **kwargs)
+            return self._build_reply(spec, result)
+        except _ActorExitSignal:
+            self._request_exit("actor exited via exit_actor()")
+            return self._build_reply(spec, None)
+        except Exception as e:  # noqa: BLE001
+            return self._error_reply(spec, format_task_error(spec.name, e))
+        finally:
+            _task_ctx.task_id = b""
+
+    async def _run_async_actor_task(self, spec: TaskSpec, fut: asyncio.Future):
+        try:
+            method = self._lookup_method(spec.name)
+            args, kwargs = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: self._resolve_args(spec))
+            result = method(*args, **kwargs)
+            if inspect.isawaitable(result):
+                result = await result
+            reply = self._build_reply(spec, result)
+        except _ActorExitSignal:
+            self._request_exit("actor exited via exit_actor()")
+            reply = self._build_reply(spec, None)
+        except Exception as e:  # noqa: BLE001
+            reply = self._error_reply(spec, format_task_error(spec.name, e))
+        finally:
+            self._actor_sema.release()
+        if not fut.done():
+            fut.set_result(reply)
+
+    def _lookup_method(self, name: str):
+        method_name = name.rsplit(".", 1)[-1]
+        method = getattr(self._actor_instance, method_name, None)
+        if method is None:
+            raise AttributeError(
+                f"actor {type(self._actor_instance).__name__} has no method "
+                f"{method_name!r}")
+        return method
+
+    def _request_exit(self, reason: str):
+        async def _notify():
+            try:
+                await self.core.raylet_conn.call("ActorExited", {
+                    "actor_id": self._actor_id, "reason": reason})
+            except ConnectionError:
+                pass
+            asyncio.get_event_loop().stop()
+        asyncio.run_coroutine_threadsafe(_notify(), self.core.loop)
+
+
+class _ActorExitSignal(BaseException):
+    pass
+
+
+def exit_actor():
+    """Public helper: gracefully terminate the current actor after the
+    in-flight call completes (reference: ray.actor.exit_actor)."""
+    raise _ActorExitSignal()
+
+
+def _now() -> float:
+    import time
+    return time.time()

@@ -1,0 +1,195 @@
+"""Wire task representation + scheduling class interning.
+
+Role parity: reference TaskSpecification (src/ray/common/task/task_spec.h)
+— a self-contained, serializable description of one task invocation,
+including inline small args, references for large args, resource demands,
+retry policy and the owner's address. ``scheduling_class`` interns the
+(resources, function) pair to a small int so scheduler queues can be
+per-class arrays (reference: TaskSpecification::GetSchedulingClass).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import msgpack
+
+TASK_NORMAL = 0
+TASK_ACTOR_CREATION = 1
+TASK_ACTOR = 2
+
+# Arg encodings on the wire.
+ARG_VALUE = 0  # inline serialized value: (ARG_VALUE, metadata, nframes) + frames
+ARG_REF = 1    # by-reference: (ARG_REF, object_id_bytes, owner_address)
+
+
+class TaskArg:
+    __slots__ = ("kind", "metadata", "frames", "object_id", "owner_address",
+                 "contained_refs")
+
+    def __init__(self, kind, metadata=b"", frames=(), object_id=b"",
+                 owner_address="", contained_refs=()):
+        self.kind = kind
+        self.metadata = metadata
+        self.frames = list(frames)
+        self.object_id = object_id
+        self.owner_address = owner_address
+        self.contained_refs = list(contained_refs)
+
+
+_sched_class_lock = threading.Lock()
+_sched_class_table: Dict[Tuple, int] = {}
+_sched_class_rev: List[Tuple] = []
+
+
+def scheduling_class_of(resources: Dict[str, float], fn_key: str) -> int:
+    key = (tuple(sorted(resources.items())), fn_key)
+    with _sched_class_lock:
+        sc = _sched_class_table.get(key)
+        if sc is None:
+            sc = len(_sched_class_rev)
+            _sched_class_table[key] = sc
+            _sched_class_rev.append(key)
+        return sc
+
+
+class TaskSpec:
+    __slots__ = (
+        "task_id", "job_id", "task_type", "name", "fn_key", "args",
+        "num_returns", "resources", "max_retries", "retry_exceptions",
+        "owner_address", "owner_worker_id", "actor_id", "actor_counter",
+        "actor_creation", "runtime_env", "placement_group_id",
+        "placement_group_bundle_index", "scheduling_strategy", "depth",
+    )
+
+    def __init__(self, task_id: bytes, job_id: bytes, task_type: int,
+                 name: str, fn_key: str, args: List[TaskArg],
+                 num_returns: int = 1, resources: Optional[Dict[str, float]] = None,
+                 max_retries: int = 0, retry_exceptions: bool = False,
+                 owner_address: str = "", owner_worker_id: bytes = b"",
+                 actor_id: bytes = b"", actor_counter: int = 0,
+                 actor_creation: Optional[Dict[str, Any]] = None,
+                 runtime_env: Optional[Dict[str, Any]] = None,
+                 placement_group_id: bytes = b"",
+                 placement_group_bundle_index: int = -1,
+                 scheduling_strategy: str = "DEFAULT",
+                 depth: int = 0):
+        self.task_id = task_id
+        self.job_id = job_id
+        self.task_type = task_type
+        self.name = name
+        self.fn_key = fn_key
+        self.args = args
+        self.num_returns = num_returns
+        self.resources = resources or {"CPU": 1.0}
+        self.max_retries = max_retries
+        self.retry_exceptions = retry_exceptions
+        self.owner_address = owner_address
+        self.owner_worker_id = owner_worker_id
+        self.actor_id = actor_id
+        self.actor_counter = actor_counter
+        self.actor_creation = actor_creation
+        self.runtime_env = runtime_env
+        self.placement_group_id = placement_group_id
+        self.placement_group_bundle_index = placement_group_bundle_index
+        self.scheduling_strategy = scheduling_strategy
+        self.depth = depth
+
+    @property
+    def scheduling_class(self) -> int:
+        return scheduling_class_of(self.resources, self.fn_key)
+
+    def is_actor_task(self) -> bool:
+        return self.task_type == TASK_ACTOR
+
+    def is_actor_creation(self) -> bool:
+        return self.task_type == TASK_ACTOR_CREATION
+
+    def dependency_ids(self) -> List[bytes]:
+        return [a.object_id for a in self.args if a.kind == ARG_REF]
+
+    # -- wire ---------------------------------------------------------------
+
+    def to_wire(self) -> Tuple[dict, List[bytes]]:
+        """(header, frames): arg value frames are hoisted into the RPC raw
+        frame list so msgpack never copies object payloads."""
+        frames: List[bytes] = []
+        args_wire = []
+        for a in self.args:
+            if a.kind == ARG_VALUE:
+                start = len(frames)
+                frames.extend(a.frames)
+                args_wire.append([ARG_VALUE, a.metadata, start, len(a.frames),
+                                  a.contained_refs])
+            else:
+                args_wire.append([ARG_REF, a.object_id, a.owner_address])
+        header = {
+            "task_id": self.task_id,
+            "job_id": self.job_id,
+            "task_type": self.task_type,
+            "name": self.name,
+            "fn_key": self.fn_key,
+            "args": args_wire,
+            "num_returns": self.num_returns,
+            "resources": self.resources,
+            "max_retries": self.max_retries,
+            "retry_exceptions": self.retry_exceptions,
+            "owner_address": self.owner_address,
+            "owner_worker_id": self.owner_worker_id,
+            "actor_id": self.actor_id,
+            "actor_counter": self.actor_counter,
+            "actor_creation": self.actor_creation,
+            "runtime_env": self.runtime_env,
+            "pg_id": self.placement_group_id,
+            "pg_bundle": self.placement_group_bundle_index,
+            "strategy": self.scheduling_strategy,
+            "depth": self.depth,
+        }
+        return header, frames
+
+    @classmethod
+    def from_wire(cls, header: dict, frames: List[bytes]) -> "TaskSpec":
+        args: List[TaskArg] = []
+        for aw in header["args"]:
+            if aw[0] == ARG_VALUE:
+                _, metadata, start, n, contained = aw
+                args.append(TaskArg(ARG_VALUE, metadata=metadata,
+                                    frames=frames[start:start + n],
+                                    contained_refs=contained))
+            else:
+                args.append(TaskArg(ARG_REF, object_id=aw[1], owner_address=aw[2]))
+        return cls(
+            task_id=header["task_id"], job_id=header["job_id"],
+            task_type=header["task_type"], name=header["name"],
+            fn_key=header["fn_key"], args=args,
+            num_returns=header["num_returns"], resources=header["resources"],
+            max_retries=header["max_retries"],
+            retry_exceptions=header["retry_exceptions"],
+            owner_address=header["owner_address"],
+            owner_worker_id=header["owner_worker_id"],
+            actor_id=header["actor_id"], actor_counter=header["actor_counter"],
+            actor_creation=header["actor_creation"],
+            runtime_env=header["runtime_env"],
+            placement_group_id=header.get("pg_id", b""),
+            placement_group_bundle_index=header.get("pg_bundle", -1),
+            scheduling_strategy=header.get("strategy", "DEFAULT"),
+            depth=header.get("depth", 0),
+        )
+
+    def lease_summary(self) -> dict:
+        """The light subset the raylet needs for a lease decision (no arg
+        payloads — the raylet never sees task data, matching the reference's
+        lease-based dispatch)."""
+        return {
+            "task_id": self.task_id,
+            "scheduling_class": self.scheduling_class,
+            "resources": self.resources,
+            "deps": self.dependency_ids(),
+            "strategy": self.scheduling_strategy,
+            "pg_id": self.placement_group_id,
+            "pg_bundle": self.placement_group_bundle_index,
+            "runtime_env": self.runtime_env,
+            "depth": self.depth,
+            "name": self.name,
+        }

@@ -1,0 +1,83 @@
+"""Worker process entrypoint.
+
+Role parity: reference python/ray/workers/default_worker.py — boots a core
+worker in worker mode, registers with the local raylet, then serves task
+pushes until told to exit or the raylet connection drops.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import sys
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--raylet-address", required=True)
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--worker-id", required=True)
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--log-level", default="INFO")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=args.log_level,
+        format=f"[worker {args.worker_id[:8]}] %(levelname)s %(name)s: %(message)s")
+
+    from ray_tpu._private import rpc
+    from ray_tpu._private.config import RayTpuConfig, set_config
+    from ray_tpu._private.core_worker import CoreWorker
+    from ray_tpu._private.task_executor import TaskExecutor
+    import ray_tpu.actor  # registers the actor-handle factory hook
+    import ray_tpu.worker as worker_mod
+
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+
+    async def boot():
+        config = RayTpuConfig.create()
+        core = CoreWorker(
+            mode="worker", config=config,
+            gcs_address=args.gcs_address,
+            raylet_address=args.raylet_address,
+            session_dir=args.session_dir,
+            worker_id=bytes.fromhex(args.worker_id),
+            node_id=bytes.fromhex(args.node_id),
+            loop=loop)
+        executor = TaskExecutor(core)
+        core.task_executor = executor
+        await core._connect_async()
+        ray_tpu.actor.register_with_core_worker(core)
+        worker_mod.global_worker.core = core
+        worker_mod.global_worker.mode = "worker"
+        set_config(config)
+        reply, _ = await core.raylet_conn.call("RegisterWorker", {
+            "worker_id": core.worker_id,
+            "address": core.address,
+            "pid": os.getpid(),
+        })
+        core.node_id = reply["node_id"]
+        # Adopt the cluster's config (raylet forwards the canonical one).
+        set_config(RayTpuConfig.from_json(reply["config"]))
+        core.config = RayTpuConfig.from_json(reply["config"])
+        # Exit when the raylet goes away.
+        core.raylet_conn.on_disconnect.append(lambda c: loop.stop())
+        return core
+
+    core = loop.run_until_complete(boot())
+    try:
+        loop.run_forever()
+    finally:
+        try:
+            core.shutdown()
+        except Exception:
+            pass
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,215 @@
+"""Actor classes, handles and methods.
+
+Role parity: reference python/ray/actor.py — ``@remote`` on a class yields
+an ``ActorClass`` whose ``.remote(...)`` registers the actor with the GCS
+and returns an ``ActorHandle``; method calls go through ``ActorMethod`` to
+the core worker's ordered per-actor submission queue. Handles serialize
+into tasks/objects and reconstruct on any process (borrowed handles).
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Any, Dict, Optional
+
+from ray_tpu import worker as worker_mod
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def remote(self, *args, **kwargs):
+        return self._handle._submit(self._method_name, args, kwargs,
+                                    num_returns=self._num_returns)
+
+    def options(self, num_returns: int = 1):
+        return ActorMethod(self._handle, self._method_name, num_returns)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor method {self._method_name} cannot be called directly; "
+            f"use .remote()")
+
+
+class ActorHandle:
+    def __init__(self, core, actor_id: bytes, class_name: str, fn_key: str,
+                 max_task_retries: int = 0, method_num_returns=None):
+        self._core = core
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._fn_key = fn_key
+        self._max_task_retries = max_task_retries
+        self._method_num_returns = method_num_returns or {}
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return ActorMethod(self, item,
+                           self._method_num_returns.get(item, 1))
+
+    def _submit(self, method_name: str, args, kwargs, num_returns: int = 1):
+        call_args = list(args)
+        if kwargs:
+            call_args.append({"__rtpu_kwargs__": True, "kwargs": kwargs})
+        refs = self._core.submit_actor_task(
+            self._actor_id, self._fn_key,
+            f"{self._class_name}.{method_name}", call_args,
+            num_returns=num_returns,
+            max_task_retries=self._max_task_retries)
+        if num_returns == 0:
+            return None
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+    def _serialization_state(self):
+        return {"actor_id": self._actor_id, "class_name": self._class_name,
+                "fn_key": self._fn_key,
+                "max_task_retries": self._max_task_retries,
+                "method_num_returns": self._method_num_returns}
+
+    def __repr__(self):
+        from ray_tpu._private.ids import ActorID
+        return f"ActorHandle({self._class_name}, {ActorID(self._actor_id).hex()[:12]})"
+
+    def __reduce__(self):
+        raise RuntimeError(
+            "ActorHandle can only be serialized through the runtime "
+            "(pass it to a task or put it in an object)")
+
+
+def _handle_factory(core, state) -> ActorHandle:
+    return ActorHandle(core, state["actor_id"], state["class_name"],
+                       state["fn_key"],
+                       max_task_retries=state.get("max_task_retries", 0),
+                       method_num_returns=state.get("method_num_returns"))
+
+
+def register_with_core_worker(core):
+    core.register_actor_handle_factory(_handle_factory)
+
+
+class ActorClass:
+    def __init__(self, cls, num_cpus=None, num_tpus=None, resources=None,
+                 max_restarts=0, max_task_retries=0, max_concurrency=None,
+                 num_returns=1, runtime_env=None, name=None, namespace=None,
+                 lifetime=None, placement_group=None,
+                 placement_group_bundle_index=-1, max_pending_calls=-1,
+                 scheduling_strategy="DEFAULT", max_retries=None,
+                 retry_exceptions=False):
+        self._cls = cls
+        self._class_name = cls.__name__
+        self._num_cpus = num_cpus
+        self._num_tpus = num_tpus
+        self._resources = resources or {}
+        self._max_restarts = max_restarts
+        self._max_task_retries = max_task_retries
+        self._is_asyncio = any(
+            inspect.iscoroutinefunction(m)
+            for _, m in inspect.getmembers(cls, inspect.isfunction))
+        self._max_concurrency = max_concurrency if max_concurrency is not None \
+            else (1000 if self._is_asyncio else 1)
+        self._runtime_env = runtime_env
+        self._name = name
+        self._namespace = namespace
+        self._lifetime = lifetime
+        self._placement_group = placement_group
+        self._placement_group_bundle_index = placement_group_bundle_index
+        self._max_pending_calls = max_pending_calls
+        self._fn_key: Optional[str] = None
+        self._pickled: Optional[bytes] = None
+        # @ray_tpu.method(num_returns=N) annotations on the class's methods.
+        self._method_num_returns = {
+            mname: getattr(m, "__rtpu_num_returns__")
+            for mname, m in inspect.getmembers(cls, callable)
+            if hasattr(m, "__rtpu_num_returns__")}
+        functools.update_wrapper(self, cls, updated=[])
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor class {self._class_name} cannot be instantiated directly;"
+            f" use {self._class_name}.remote()")
+
+    def _resource_demand(self) -> Dict[str, float]:
+        demand = dict(self._resources)
+        demand["CPU"] = float(self._num_cpus if self._num_cpus is not None else 1)
+        if self._num_tpus:
+            demand["TPU"] = float(self._num_tpus)
+        return demand
+
+    def remote(self, *args, **kwargs):
+        w = worker_mod._require_connected()
+        if self._fn_key is None:
+            self._fn_key, self._pickled = \
+                w.core.function_manager.prepare(self._cls)
+        w.core.function_manager.export_prepickled(
+            self._fn_key, self._pickled, self._cls)
+        call_args = list(args)
+        if kwargs:
+            call_args.append({"__rtpu_kwargs__": True, "kwargs": kwargs})
+        pg = self._placement_group
+        actor_id = w.core.create_actor(
+            fn_key=self._fn_key, name=self._class_name, args=call_args,
+            actor_name=self._name or "",
+            namespace=self._namespace or worker_mod.global_worker.namespace,
+            max_restarts=self._max_restarts,
+            max_concurrency=self._max_concurrency,
+            resources=self._resource_demand(),
+            is_asyncio=self._is_asyncio,
+            placement_group_id=pg.id.binary() if pg is not None else b"",
+            placement_group_bundle_index=self._placement_group_bundle_index,
+            max_pending_calls=self._max_pending_calls)
+        return ActorHandle(w.core, actor_id, self._class_name, self._fn_key,
+                           max_task_retries=self._max_task_retries,
+                           method_num_returns=self._method_num_returns)
+
+    def options(self, **overrides):
+        allowed = {"num_cpus", "num_tpus", "resources", "max_restarts",
+                   "max_task_retries", "max_concurrency", "runtime_env",
+                   "name", "namespace", "lifetime", "placement_group",
+                   "placement_group_bundle_index", "max_pending_calls",
+                   "scheduling_strategy", "num_returns"}
+        bad = set(overrides) - allowed
+        if bad:
+            raise ValueError(f"unknown actor options: {sorted(bad)}")
+        base = {
+            "num_cpus": self._num_cpus, "num_tpus": self._num_tpus,
+            "resources": self._resources, "max_restarts": self._max_restarts,
+            "max_task_retries": self._max_task_retries,
+            "max_concurrency": self._max_concurrency,
+            "runtime_env": self._runtime_env, "name": self._name,
+            "namespace": self._namespace, "lifetime": self._lifetime,
+            "placement_group": self._placement_group,
+            "placement_group_bundle_index": self._placement_group_bundle_index,
+            "max_pending_calls": self._max_pending_calls,
+        }
+        base.update(overrides)
+        clone = ActorClass(self._cls, **base)
+        clone._fn_key = self._fn_key
+        clone._pickled = self._pickled
+        return clone
+
+
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    """Look up a named actor (reference: ray.get_actor)."""
+    w = worker_mod._require_connected()
+    reply, _ = w.core._run(w.core.gcs_conn.call("GetNamedActor", {
+        "name": name,
+        "namespace": namespace if namespace is not None
+        else worker_mod.global_worker.namespace}))
+    if not reply.get("found"):
+        raise ValueError(f"no actor named {name!r}")
+    spec = reply["spec"]
+    return ActorHandle(w.core, reply["actor_id"], spec["name"], spec["fn_key"])
+
+
+def list_named_actors(namespace: Optional[str] = None):
+    w = worker_mod._require_connected()
+    reply, _ = w.core._run(w.core.gcs_conn.call(
+        "ListNamedActors", {"namespace": namespace}))
+    return [a["name"] for a in reply["actors"]]

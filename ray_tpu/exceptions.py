@@ -1,0 +1,110 @@
+"""Public exception types raised by the runtime.
+
+Parity targets (reference: python/ray/exceptions.py): RayError,
+RayTaskError, WorkerCrashedError, ActorDiedError / RayActorError,
+ObjectLostError, GetTimeoutError, TaskCancelledError, ObjectStoreFullError,
+RuntimeEnvSetupError.
+"""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class RayTaskError(RayTpuError):
+    """A task raised an exception on a remote worker.
+
+    The remote traceback is captured as a string and re-raised at every
+    ``get`` of any object whose lineage includes the failed task.
+    """
+
+    def __init__(self, function_name: str = "", traceback_str: str = "",
+                 cause: Exception | None = None):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(function_name, traceback_str)
+
+    def __str__(self):
+        msg = f"task {self.function_name} failed"
+        if self.traceback_str:
+            msg += f"\n{self.traceback_str}"
+        return msg
+
+    def as_instanceof_cause(self) -> Exception:
+        """Return an exception that is also an instance of the cause's type,
+        so ``except UserError`` works across process boundaries."""
+        cause = self.cause
+        if cause is None or isinstance(cause, RayTaskError):
+            return self
+        cause_cls = type(cause)
+        if cause_cls is RayTaskError:
+            return self
+        try:
+            derived = type(
+                "RayTaskError(" + cause_cls.__name__ + ")",
+                (RayTaskError, cause_cls),
+                {"__init__": RayTaskError.__init__, "__str__": RayTaskError.__str__},
+            )
+            err = derived(self.function_name, self.traceback_str, cause)
+            return err
+        except TypeError:
+            return self
+
+
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled before or during execution."""
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died unexpectedly."""
+
+
+class ActorDiedError(RayTpuError):
+    """The actor is dead: creation failed, it exhausted restarts, or its
+    node/worker died and max_restarts was 0."""
+
+    def __init__(self, reason: str = "actor died"):
+        self.reason = reason
+        super().__init__(reason)
+
+
+# Alias matching the reference's name.
+RayActorError = ActorDiedError
+
+
+class ObjectLostError(RayTpuError):
+    """All copies of the object were lost and reconstruction failed or was
+    disabled."""
+
+    def __init__(self, object_id_hex: str = "", reason: str = ""):
+        self.object_id_hex = object_id_hex
+        self.reason = reason
+        super().__init__(f"object {object_id_hex} lost: {reason}")
+
+
+class ObjectStoreFullError(RayTpuError):
+    """The shared-memory object store cannot fit the object even after
+    eviction and spilling."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """``get`` timed out before the object was available."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Setting up the task/actor runtime environment failed."""
+
+
+class RaySystemError(RayTpuError):
+    """Internal system failure (e.g. a control-plane process died)."""
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    """Actor max_pending_calls exceeded."""
+
+
+class AsyncioActorExit(RayTpuError):
+    """Raised inside an async actor to exit it gracefully."""

@@ -1,0 +1,120 @@
+"""@remote functions.
+
+Role parity: reference python/ray/remote_function.py RemoteFunction —
+decoration captures the function plus default task options; ``.remote()``
+exports once via the function manager and submits through the core worker;
+``.options()`` creates a shallow override.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from ray_tpu import worker as worker_mod
+
+
+class RemoteFunction:
+    def __init__(self, fn, num_returns=1, num_cpus=None, num_tpus=None,
+                 resources=None, max_retries=None, retry_exceptions=False,
+                 runtime_env=None, scheduling_strategy="DEFAULT",
+                 placement_group=None, placement_group_bundle_index=-1,
+                 name=None):
+        self._function = fn
+        self._name = name or getattr(fn, "__qualname__", fn.__name__)
+        self._num_returns = num_returns
+        self._num_cpus = num_cpus
+        self._num_tpus = num_tpus
+        self._resources = resources or {}
+        self._max_retries = max_retries
+        self._retry_exceptions = retry_exceptions
+        self._runtime_env = runtime_env
+        self._scheduling_strategy = scheduling_strategy
+        self._placement_group = placement_group
+        self._placement_group_bundle_index = placement_group_bundle_index
+        self._fn_key: Optional[str] = None
+        self._pickled: Optional[bytes] = None
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self._name} cannot be called directly; use "
+            f"{self._name}.remote()")
+
+    def _resource_demand(self) -> Dict[str, float]:
+        demand = dict(self._resources)
+        demand["CPU"] = float(self._num_cpus if self._num_cpus is not None else 1)
+        if self._num_tpus:
+            demand["TPU"] = float(self._num_tpus)
+        return demand
+
+    def remote(self, *args, **kwargs):
+        w = worker_mod._require_connected()
+        if self._fn_key is None:
+            self._fn_key, self._pickled = \
+                w.core.function_manager.prepare(self._function)
+        w.core.function_manager.export_prepickled(
+            self._fn_key, self._pickled, self._function)
+        call_args = list(args)
+        if kwargs:
+            call_args.append({"__rtpu_kwargs__": True, "kwargs": kwargs})
+        pg = self._placement_group
+        pg_id = pg.id.binary() if pg is not None else b""
+        refs = w.core.submit_task(
+            fn_key=self._fn_key, name=self._name, args=call_args,
+            num_returns=self._num_returns,
+            resources=self._resource_demand(),
+            max_retries=self._max_retries,
+            retry_exceptions=self._retry_exceptions,
+            placement_group_id=pg_id,
+            placement_group_bundle_index=self._placement_group_bundle_index,
+            scheduling_strategy=self._scheduling_strategy,
+            runtime_env=self._runtime_env)
+        if self._num_returns == 0:
+            return None
+        if self._num_returns == 1:
+            return refs[0]
+        return refs
+
+    def options(self, **overrides):
+        """Return a copy with per-call option overrides (reference:
+        RemoteFunction.options)."""
+        allowed = {"num_returns", "num_cpus", "num_tpus", "resources",
+                   "max_retries", "retry_exceptions", "runtime_env",
+                   "scheduling_strategy", "placement_group",
+                   "placement_group_bundle_index", "name"}
+        bad = set(overrides) - allowed
+        if bad:
+            raise ValueError(f"unknown options: {sorted(bad)}")
+        base = {
+            "num_returns": self._num_returns, "num_cpus": self._num_cpus,
+            "num_tpus": self._num_tpus, "resources": self._resources,
+            "max_retries": self._max_retries,
+            "retry_exceptions": self._retry_exceptions,
+            "runtime_env": self._runtime_env,
+            "scheduling_strategy": self._scheduling_strategy,
+            "placement_group": self._placement_group,
+            "placement_group_bundle_index": self._placement_group_bundle_index,
+            "name": self._name,
+        }
+        base.update(overrides)
+        clone = RemoteFunction(self._function, **base)
+        clone._fn_key = self._fn_key
+        clone._pickled = self._pickled
+        return clone
+
+
+def make_remote(fn_or_class=None, **options):
+    """Implementation of the @remote decorator (functions and classes)."""
+    import inspect
+
+    from ray_tpu.actor import ActorClass
+
+    def decorate(target):
+        if inspect.isclass(target):
+            return ActorClass(target, **options)
+        return RemoteFunction(target, **options)
+
+    if fn_or_class is not None:
+        return decorate(fn_or_class)
+    return decorate

@@ -1,0 +1,308 @@
+"""Driver/worker global state and the public init/get/put/wait API.
+
+Role parity: reference python/ray/worker.py — a process-wide ``Worker``
+singleton holding the core worker, plus the module-level API surface
+(`init`, `shutdown`, `get`, `put`, `wait`, `kill`, `cancel`,
+`get_runtime_context`, `cluster_resources`, ...).
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private.config import RayTpuConfig, get_config, set_config
+from ray_tpu._private.ids import ActorID, JobID, NodeID, TaskID, WorkerID
+from ray_tpu._private.object_ref import ObjectRef
+
+logger = logging.getLogger(__name__)
+
+
+class Worker:
+    """Process-global connection state."""
+
+    def __init__(self):
+        self.core = None            # CoreWorker
+        self.node = None            # in-process head Node, if we started one
+        self.mode: Optional[str] = None
+        self.namespace: str = ""
+
+    @property
+    def connected(self) -> bool:
+        return self.core is not None
+
+
+global_worker = Worker()
+_init_lock = threading.Lock()
+
+
+def _require_connected() -> Worker:
+    if not global_worker.connected:
+        raise RuntimeError(
+            "ray_tpu.init() must be called before using the API")
+    return global_worker
+
+
+def init(address: Optional[str] = None, *, num_cpus: Optional[int] = None,
+         num_tpus: Optional[int] = None,
+         resources: Optional[Dict[str, float]] = None,
+         object_store_memory: Optional[int] = None,
+         namespace: str = "", ignore_reinit_error: bool = False,
+         _system_config: Optional[Dict[str, Any]] = None,
+         log_to_driver: bool = True) -> Dict[str, Any]:
+    """Start (or connect to) a cluster and attach this process as a driver.
+
+    Without ``address`` a head node (GCS + raylet + shm store) is started
+    in-process and torn down at exit — reference: ray.init() auto-start
+    (python/ray/worker.py init).
+    """
+    with _init_lock:
+        if global_worker.connected:
+            if ignore_reinit_error:
+                return {"address": global_worker.core.gcs_address}
+            raise RuntimeError("ray_tpu.init() called twice")
+
+        from ray_tpu._private.core_worker import CoreWorker
+        from ray_tpu._private.node import Node
+        import ray_tpu.actor as actor_mod
+
+        config = RayTpuConfig.create(_system_config)
+        if object_store_memory:
+            config.object_store_memory = object_store_memory
+        set_config(config)
+
+        if address is None:
+            node = Node(config=config,
+                        num_cpus=num_cpus if num_cpus is not None
+                        else max(1, os.cpu_count() or 1),
+                        num_tpus=num_tpus,
+                        custom_resources=resources)
+            node.start_head()
+            global_worker.node = node
+            gcs_address = node.gcs_address
+            raylet_address = node.raylet_address
+            session_dir = node.session_dir
+        else:
+            gcs_address = address
+            raylet_address, session_dir = _find_raylet(gcs_address, config)
+
+        core = CoreWorker(mode="driver", config=config,
+                          gcs_address=gcs_address,
+                          raylet_address=raylet_address,
+                          session_dir=session_dir)
+        core.connect()
+        actor_mod.register_with_core_worker(core)
+        global_worker.core = core
+        global_worker.mode = "driver"
+        global_worker.namespace = namespace
+        atexit.register(shutdown)
+        return {"address": gcs_address, "session_dir": session_dir,
+                "job_id": core.job_id}
+
+
+def _find_raylet(gcs_address: str, config: RayTpuConfig):
+    """Connect via GCS and pick a raylet for this driver (prefer one on this
+    host — all nodes in tests are local)."""
+    import asyncio
+
+    from ray_tpu._private import rpc
+
+    async def _lookup():
+        conn = await rpc.connect(gcs_address, peer_name="gcs-bootstrap")
+        try:
+            deadline = time.time() + config.rpc_connect_timeout_s
+            while time.time() < deadline:
+                reply, _ = await conn.call("GetAllNodeInfo", {})
+                alive = [n for n in reply["nodes"] if n["alive"]]
+                if alive:
+                    return alive[0]["address"]
+                await asyncio.sleep(0.1)
+            raise RuntimeError("no alive nodes in cluster")
+        finally:
+            await conn.close()
+
+    raylet_address = asyncio.run(_lookup())
+    if raylet_address.startswith("unix://"):
+        session_dir = os.path.dirname(os.path.dirname(
+            raylet_address[len("unix://"):]))
+    else:
+        session_dir = os.path.join("/tmp/ray_tpu", "client-session")
+    return raylet_address, session_dir
+
+
+def shutdown():
+    with _init_lock:
+        w = global_worker
+        if w.core is not None:
+            try:
+                w.core.shutdown()
+            except Exception:
+                pass
+            w.core = None
+        if w.node is not None:
+            try:
+                w.node.stop()
+            except Exception:
+                pass
+            w.node = None
+        w.mode = None
+
+
+def is_initialized() -> bool:
+    return global_worker.connected
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None):
+    w = _require_connected()
+    single = isinstance(refs, ObjectRef)
+    try:
+        ref_list = [refs] if single else list(refs)
+    except TypeError:
+        raise TypeError(
+            f"get() expects an ObjectRef or a sequence of them, got "
+            f"{type(refs).__name__}") from None
+    for r in ref_list:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
+    values = w.core.get(ref_list, timeout=timeout)
+    return values[0] if single else values
+
+
+def put(value: Any) -> ObjectRef:
+    w = _require_connected()
+    if isinstance(value, ObjectRef):
+        raise TypeError("put() of an ObjectRef is not allowed")
+    return w.core.put(value)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True):
+    w = _require_connected()
+    refs = list(refs)
+    if len(set(refs)) != len(refs):
+        raise ValueError("wait() got duplicate ObjectRefs")
+    if num_returns > len(refs):
+        raise ValueError("num_returns exceeds number of refs")
+    return w.core.wait(refs, num_returns=num_returns, timeout=timeout,
+                       fetch_local=fetch_local)
+
+
+def kill(actor_handle, *, no_restart: bool = True):
+    from ray_tpu.actor import ActorHandle
+    w = _require_connected()
+    if not isinstance(actor_handle, ActorHandle):
+        raise TypeError("kill() expects an ActorHandle")
+    w.core.kill_actor(actor_handle._actor_id, no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    w = _require_connected()
+    w.core._run(_cancel_async(w.core, ref))
+
+
+async def _cancel_async(core, ref: ObjectRef):
+    spec_entry = core.pending_tasks.get(ref.object_id.task_id().binary())
+    if spec_entry is None:
+        return
+    # Best effort: mark cancelled at every leased worker of the class.
+    sc = spec_entry.spec.scheduling_class
+    state = core.scheduling_keys.get(sc)
+    if state is None:
+        return
+    if spec_entry.spec in state.queue:
+        state.queue.remove(spec_entry.spec)
+        core._store_error_for_task(spec_entry.spec,
+                                   exc.TaskCancelledError(spec_entry.spec.name))
+        return
+    for lw in state.workers:
+        try:
+            await lw.conn.call("CancelTask",
+                               {"task_id": spec_entry.spec.task_id})
+        except ConnectionError:
+            pass
+
+
+def cluster_resources() -> Dict[str, float]:
+    w = _require_connected()
+    reply, _ = w.core._run(w.core.gcs_conn.call("GetClusterResources", {}))
+    return reply["total"]
+
+
+def available_resources() -> Dict[str, float]:
+    w = _require_connected()
+    reply, _ = w.core._run(w.core.gcs_conn.call("GetClusterResources", {}))
+    return reply["available"]
+
+
+def nodes() -> List[dict]:
+    w = _require_connected()
+    reply, _ = w.core._run(w.core.gcs_conn.call("GetAllNodeInfo", {}))
+    out = []
+    for n in reply["nodes"]:
+        out.append({
+            "NodeID": n["node_id"].hex(), "Alive": n["alive"],
+            "NodeName": n["node_name"], "Address": n["address"],
+            "Resources": n["resources_total"],
+        })
+    return out
+
+
+class RuntimeContext:
+    """Reference: python/ray/runtime_context.py."""
+
+    def __init__(self, worker: Worker):
+        self._worker = worker
+
+    @property
+    def job_id(self):
+        return JobID(self._worker.core.job_id)
+
+    @property
+    def node_id(self):
+        nid = self._worker.core.node_id
+        return NodeID(nid) if nid else None
+
+    @property
+    def worker_id(self):
+        return WorkerID(self._worker.core.worker_id)
+
+    @property
+    def current_actor_id(self):
+        ex = self._worker.core.task_executor
+        if ex is None or not ex._actor_id:
+            return None
+        return ActorID(ex._actor_id)
+
+    @property
+    def was_current_actor_reconstructed(self) -> bool:
+        return False
+
+    def get(self):
+        return {"job_id": self.job_id, "node_id": self.node_id,
+                "worker_id": self.worker_id}
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext(_require_connected())
+
+
+def timeline() -> List[dict]:
+    """Chrome-tracing events collected from all workers (reference:
+    ray.timeline / state.chrome_tracing_dump)."""
+    w = _require_connected()
+    reply, _ = w.core._run(w.core.gcs_conn.call("GetProfileEvents", {}))
+    events = []
+    for e in reply["events"]:
+        events.append({
+            "cat": e.get("event", "task"), "name": e.get("name", ""),
+            "pid": e.get("worker_id", "")[:8], "tid": 0, "ph": "X",
+            "ts": e.get("start", 0) * 1e6,
+            "dur": (e.get("end", 0) - e.get("start", 0)) * 1e6,
+        })
+    return events

@@ -1,0 +1,42 @@
+"""Test fixtures.
+
+Mirrors the reference's fixture strategy (reference:
+python/ray/tests/conftest.py): ``ray_start_regular`` boots a small
+single-node cluster per test; ``ray_start_shared`` is module-scoped for
+cheap read-only tests. JAX-based tests force an 8-device virtual CPU mesh
+so multi-chip sharding logic runs without TPU hardware.
+"""
+
+import os
+
+# Must be set before any jax import anywhere in the test process.
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Keep worker processes on CPU jax too (they inherit the env).
+os.environ.setdefault("RAY_TPU_WORKER_JAX_PLATFORMS", "cpu")
+
+import pytest  # noqa: E402
+
+import ray_tpu  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    info = ray_tpu.init(num_cpus=2)
+    yield info
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_4cpu():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def ray_start_shared():
+    info = ray_tpu.init(num_cpus=2)
+    yield info
+    ray_tpu.shutdown()

@@ -1,0 +1,149 @@
+"""Actor tests: lifecycle, ordering, named actors, async actors, failures.
+
+Parity model: reference python/ray/tests/test_actor.py, test_async.py,
+test_actor_failures.py.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def incr(self, k=1):
+        self.n += k
+        return self.n
+
+    def read(self):
+        return self.n
+
+
+def test_actor_basic(ray_start_regular):
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote()) == 1
+    assert ray_tpu.get(c.incr.remote(5)) == 6
+    assert ray_tpu.get(c.read.remote()) == 6
+
+
+def test_actor_constructor_args(ray_start_regular):
+    c = Counter.remote(start=100)
+    assert ray_tpu.get(c.read.remote()) == 100
+
+
+def test_actor_method_ordering(ray_start_regular):
+    c = Counter.remote()
+    refs = [c.incr.remote() for _ in range(20)]
+    assert ray_tpu.get(refs) == list(range(1, 21))
+
+
+def test_two_actors_independent_state(ray_start_regular):
+    a, b = Counter.remote(), Counter.remote(start=50)
+    ray_tpu.get([a.incr.remote(), b.incr.remote()])
+    assert ray_tpu.get(a.read.remote()) == 1
+    assert ray_tpu.get(b.read.remote()) == 51
+
+
+def test_actor_handle_passed_to_task(ray_start_4cpu):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def bump(counter):
+        return ray_tpu.get(counter.incr.remote())
+
+    assert sorted(ray_tpu.get([bump.remote(c) for _ in range(3)])) == [1, 2, 3]
+
+
+def test_named_actor(ray_start_regular):
+    Counter.options(name="counter1").remote()
+    time.sleep(0.5)
+    h = ray_tpu.get_actor("counter1")
+    assert ray_tpu.get(h.incr.remote()) == 1
+    assert "counter1" in ray_tpu.list_named_actors()
+
+
+def test_named_actor_duplicate_rejected(ray_start_regular):
+    Counter.options(name="dup").remote()
+    time.sleep(0.3)
+    with pytest.raises(Exception):
+        Counter.options(name="dup").remote()
+        time.sleep(0.5)
+        # Registration error surfaces on the RegisterActor RPC.
+
+
+def test_actor_constructor_failure(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def __init__(self):
+            raise RuntimeError("ctor boom")
+
+        def ping(self):
+            return "pong"
+
+    b = Bad.remote()
+    with pytest.raises(exc.ActorDiedError):
+        ray_tpu.get(b.ping.remote(), timeout=20)
+
+
+def test_actor_method_error(ray_start_regular):
+    @ray_tpu.remote
+    class Fragile:
+        def explode(self):
+            raise ValueError("method boom")
+
+        def ok(self):
+            return 1
+
+    f = Fragile.remote()
+    with pytest.raises(exc.RayTaskError):
+        ray_tpu.get(f.explode.remote())
+    # Actor survives a method error.
+    assert ray_tpu.get(f.ok.remote()) == 1
+
+
+def test_kill_actor(ray_start_regular):
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote()) == 1
+    ray_tpu.kill(c)
+    with pytest.raises(exc.ActorDiedError):
+        ray_tpu.get(c.incr.remote(), timeout=20)
+
+
+def test_async_actor(ray_start_regular):
+    @ray_tpu.remote
+    class AsyncActor:
+        async def slow_echo(self, x):
+            await asyncio.sleep(0.2)
+            return x
+
+    a = AsyncActor.remote()
+    ray_tpu.get(a.slow_echo.remote(-1))  # warm up (actor creation latency)
+    t0 = time.time()
+    refs = [a.slow_echo.remote(i) for i in range(5)]
+    assert ray_tpu.get(refs) == list(range(5))
+    # Concurrent execution: 5 x 0.2s sleeps must overlap.
+    assert time.time() - t0 < 0.9
+
+
+def test_exit_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Quitter:
+        def quit(self):
+            ray_tpu.exit_actor()
+
+        def ping(self):
+            return "pong"
+
+    q = Quitter.remote()
+    assert ray_tpu.get(q.ping.remote()) == "pong"
+    q.quit.remote()
+    time.sleep(1.0)
+    with pytest.raises(exc.ActorDiedError):
+        ray_tpu.get(q.ping.remote(), timeout=20)

@@ -1,0 +1,190 @@
+"""Core API tests: tasks, objects, errors, wait.
+
+Parity model: reference python/ray/tests/test_basic.py / test_basic_2.py.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+def test_put_get(ray_start_regular):
+    for value in (1, "x", [1, 2, {"a": (3, 4)}], None, b"bytes",
+                  np.arange(10)):
+        ref = ray_tpu.put(value)
+        out = ray_tpu.get(ref)
+        if isinstance(value, np.ndarray):
+            np.testing.assert_array_equal(out, value)
+        else:
+            assert out == value
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def f(a, b):
+        return a + b
+
+    assert ray_tpu.get(f.remote(1, 2)) == 3
+
+
+def test_task_kwargs_and_options(ray_start_regular):
+    @ray_tpu.remote
+    def g(a, b=10, *, c=100):
+        return a + b + c
+
+    assert ray_tpu.get(g.remote(1)) == 111
+    assert ray_tpu.get(g.remote(1, b=2, c=3)) == 6
+    assert ray_tpu.get(g.options(num_cpus=2).remote(1)) == 111
+
+
+def test_many_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def sq(i):
+        return i * i
+
+    refs = [sq.remote(i) for i in range(50)]
+    assert ray_tpu.get(refs) == [i * i for i in range(50)]
+
+
+def test_multiple_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_chain_dependencies(ray_start_regular):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(4):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref) == 5
+
+
+def test_large_object_roundtrip(ray_start_regular):
+    arr = np.random.rand(500_000)  # ~4MB > inline threshold
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(out, arr)
+
+    @ray_tpu.remote
+    def total(x):
+        return float(np.sum(x))
+
+    assert abs(ray_tpu.get(total.remote(ref)) - float(np.sum(arr))) < 1e-6
+
+
+def test_large_return_value(ray_start_regular):
+    @ray_tpu.remote
+    def big():
+        return np.ones(1_000_000, dtype=np.float64)
+
+    out = ray_tpu.get(big.remote())
+    assert out.shape == (1_000_000,)
+    assert out[0] == 1.0
+
+
+def test_task_error_propagation(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(exc.RayTaskError) as ei:
+        ray_tpu.get(boom.remote())
+    assert "kaboom" in str(ei.value)
+    # The raised error is also an instance of the original exception type.
+    with pytest.raises(ValueError):
+        ray_tpu.get(boom.remote())
+
+
+def test_error_propagates_through_dependencies(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def bad():
+        raise RuntimeError("first failure")
+
+    @ray_tpu.remote
+    def passthrough(x):
+        return x
+
+    ref = passthrough.remote(bad.remote())
+    with pytest.raises(exc.RayTaskError):
+        ray_tpu.get(ref)
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=4)
+    assert ready == [f]
+    assert not_ready == [s]
+
+
+def test_wait_timeout_none_ready(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(10)
+
+    ref = slow.remote()
+    ready, not_ready = ray_tpu.wait([ref], timeout=0.5)
+    assert ready == []
+    assert not_ready == [ref]
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(30)
+
+    with pytest.raises(exc.GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.5)
+
+
+def test_nested_object_refs(ray_start_regular):
+    inner = ray_tpu.put("inner-value")
+
+    @ray_tpu.remote
+    def unwrap(wrapped):
+        return ray_tpu.get(wrapped[0])
+
+    assert ray_tpu.get(unwrap.remote([inner])) == "inner-value"
+
+
+def test_nested_task_submission(ray_start_4cpu):
+    @ray_tpu.remote
+    def child(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def parent(x):
+        return ray_tpu.get(child.remote(x)) + 1
+
+    assert ray_tpu.get(parent.remote(10)) == 21
+
+
+def test_cluster_resources(ray_start_regular):
+    total = ray_tpu.cluster_resources()
+    assert total.get("CPU") == 2.0
+    assert ray_tpu.is_initialized()
+
+
+def test_runtime_context(ray_start_regular):
+    ctx = ray_tpu.get_runtime_context()
+    assert ctx.job_id is not None
+    assert ctx.worker_id is not None

@@ -1,0 +1,115 @@
+"""Fault-tolerance tests: task retries, worker death, actor restarts.
+
+Parity model: reference python/ray/tests/test_failure.py,
+test_actor_failures.py, test_component_failures.py.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+def test_task_retry_on_worker_death(ray_start_regular):
+    @ray_tpu.remote(max_retries=2)
+    def die_once(marker_path):
+        if not os.path.exists(marker_path):
+            with open(marker_path, "w") as f:
+                f.write("x")
+            os._exit(1)  # hard-kill the worker mid-task
+        return "survived"
+
+    marker = f"/tmp/rtpu_die_once_{os.getpid()}_{time.time_ns()}"
+    try:
+        assert ray_tpu.get(die_once.remote(marker), timeout=60) == "survived"
+    finally:
+        if os.path.exists(marker):
+            os.unlink(marker)
+
+
+def test_task_retries_exhausted(ray_start_regular):
+    @ray_tpu.remote(max_retries=1)
+    def always_dies():
+        os._exit(1)
+
+    with pytest.raises(exc.WorkerCrashedError):
+        ray_tpu.get(always_dies.remote(), timeout=60)
+
+
+def test_retry_exceptions(ray_start_regular):
+    @ray_tpu.remote(max_retries=3, retry_exceptions=True)
+    def flaky(marker_path):
+        if not os.path.exists(marker_path):
+            with open(marker_path, "w") as f:
+                f.write("x")
+            raise RuntimeError("transient")
+        return "ok"
+
+    marker = f"/tmp/rtpu_flaky_{os.getpid()}_{time.time_ns()}"
+    try:
+        assert ray_tpu.get(flaky.remote(marker), timeout=60) == "ok"
+    finally:
+        if os.path.exists(marker):
+            os.unlink(marker)
+
+
+def test_actor_restart(ray_start_regular):
+    @ray_tpu.remote(max_restarts=1, max_task_retries=2)
+    class Phoenix:
+        def __init__(self):
+            self.calls = 0
+
+        def call(self, marker_path=""):
+            self.calls += 1
+            # Crash exactly once across incarnations (the retried call must
+            # not kill the restarted actor too).
+            if marker_path and not os.path.exists(marker_path):
+                with open(marker_path, "w") as f:
+                    f.write("x")
+                os._exit(1)
+            return self.calls
+
+    marker = f"/tmp/rtpu_phoenix_{os.getpid()}_{time.time_ns()}"
+    p = Phoenix.remote()
+    try:
+        assert ray_tpu.get(p.call.remote(), timeout=30) == 1
+        assert ray_tpu.get(p.call.remote(), timeout=30) == 2
+        # Crashes incarnation 0; max_task_retries resubmits it on the
+        # restarted incarnation, where it succeeds (seqno renumbering).
+        assert ray_tpu.get(p.call.remote(marker), timeout=60) == 1
+        # Fresh instance state: counts restarted from 1.
+        assert ray_tpu.get(p.call.remote(), timeout=30) == 2
+    finally:
+        if os.path.exists(marker):
+            os.unlink(marker)
+
+
+def test_actor_no_restart_dies(ray_start_regular):
+    @ray_tpu.remote(max_restarts=0)
+    class Mortal:
+        def die(self):
+            os._exit(1)
+
+        def ping(self):
+            return "pong"
+
+    m = Mortal.remote()
+    assert ray_tpu.get(m.ping.remote(), timeout=30) == "pong"
+    m.die.remote()
+    with pytest.raises(exc.ActorDiedError):
+        ray_tpu.get(m.ping.remote(), timeout=60)
+
+
+def test_method_num_returns(ray_start_regular):
+    @ray_tpu.remote
+    class Splitter:
+        @ray_tpu.method(num_returns=2)
+        def split(self, pair):
+            return pair[0], pair[1]
+
+    s = Splitter.remote()
+    a, b = s.split.remote((10, 20))
+    assert ray_tpu.get([a, b]) == [10, 20]
