@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Driver benchmark: task throughput microbenchmark, one JSON line to stdout.
+
+Mirrors the reference's `ray microbenchmark` harness
+(reference: python/ray/_private/ray_perf.py, CLI scripts.py:1421).
+Primary metric: single-client async no-arg task throughput, vs the
+reference's published 13,546.95 tasks/s on a 64-vCPU m5.16xlarge
+(BASELINE.md, release/release_logs/1.6.0/microbenchmark.txt:10).
+
+Output: {"metric": ..., "value": N, "unit": "tasks/s", "vs_baseline": N}
+"""
+import json
+import os
+import sys
+import time
+
+# Workers stay on CPU jax; the head's batched scheduler may use the TPU.
+os.environ.setdefault("RAY_TPU_WORKER_JAX_PLATFORMS", "cpu")
+
+BASELINE_TASKS_ASYNC = 13546.95  # reference microbenchmark.txt:10
+BASELINE_ACTOR_ASYNC = 5904.3    # reference microbenchmark.txt:13
+BASELINE_PUT_PER_S = 37315.16    # reference microbenchmark.txt:2
+
+
+def timeit(fn, warmup=1, repeat=3):
+    for _ in range(warmup):
+        fn()
+    best = 0.0
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        n = fn()
+        dt = time.perf_counter() - t0
+        best = max(best, n / dt)
+    return best
+
+
+def main():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=max(4, (os.cpu_count() or 4) // 2))
+
+    @ray_tpu.remote
+    def small_task():
+        return b"ok"
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def ping(self):
+            self.n += 1
+            return self.n
+
+    n_tasks = int(os.environ.get("BENCH_NUM_TASKS", "3000"))
+
+    def bench_tasks_async():
+        ray_tpu.get([small_task.remote() for _ in range(n_tasks)])
+        return n_tasks
+
+    counter = Counter.remote()
+    ray_tpu.get(counter.ping.remote())
+
+    def bench_actor_async():
+        ray_tpu.get([counter.ping.remote() for _ in range(n_tasks)])
+        return n_tasks
+
+    def bench_puts():
+        refs = [ray_tpu.put(i) for i in range(n_tasks)]
+        ray_tpu.get(refs[-1])
+        return n_tasks
+
+    tasks_per_s = timeit(bench_tasks_async)
+    actor_per_s = timeit(bench_actor_async)
+    puts_per_s = timeit(bench_puts)
+
+    ray_tpu.shutdown()
+
+    result = {
+        "metric": "single_client_tasks_async",
+        "value": round(tasks_per_s, 1),
+        "unit": "tasks/s",
+        "vs_baseline": round(tasks_per_s / BASELINE_TASKS_ASYNC, 4),
+        "extras": {
+            "actor_calls_async_per_s": round(actor_per_s, 1),
+            "actor_vs_baseline": round(actor_per_s / BASELINE_ACTOR_ASYNC, 4),
+            "puts_per_s": round(puts_per_s, 1),
+            "puts_vs_baseline": round(puts_per_s / BASELINE_PUT_PER_S, 4),
+        },
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

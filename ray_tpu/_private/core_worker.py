@@ -277,12 +277,14 @@ class CoreWorker:
     def _deserialize_ref(self, state):
         oid_b, owner = state
         oid = ObjectID(oid_b)
-        ref = ObjectRef(oid, owner_address=owner, worker=self)
+        # Record the borrow BEFORE constructing the ObjectRef: the ctor
+        # increments local_refs, which would defeat add_borrowed_object's
+        # first-borrow detection and the AddBorrower RPC would never fire.
         if owner and owner != self.address:
             first = self.reference_counter.add_borrowed_object(oid, owner)
             if first:
                 self._fire_and_forget(self._notify_add_borrower(oid, owner))
-        return ref
+        return ObjectRef(oid, owner_address=owner, worker=self)
 
     def _serialize_actor_handle(self, handle):
         return handle._serialization_state()
@@ -319,6 +321,7 @@ class CoreWorker:
         handlers = {
             "GetObject": self._handle_get_object,
             "GetObjectLocations": self._handle_get_object_locations,
+            "AddObjectLocation": self._handle_add_object_location,
             "AddBorrower": self._handle_add_borrower,
             "RemoveBorrower": self._handle_remove_borrower,
             "Ping": self._handle_ping,
@@ -348,6 +351,13 @@ class CoreWorker:
         oid = ObjectID(header["object_id"])
         return {"locations": sorted(self.reference_counter.get_locations(oid))}
 
+    async def _handle_add_object_location(self, conn, header, bufs):
+        """A raylet pulled a replica: keep the owner's location index
+        complete so release-time frees reach every copy."""
+        self.reference_counter.add_location(
+            ObjectID(header["object_id"]), header["node_id"])
+        return {"ok": True}
+
     async def _handle_add_borrower(self, conn, header, bufs):
         self.reference_counter.add_borrower(
             ObjectID(header["object_id"]), header["borrower"])
@@ -372,11 +382,14 @@ class CoreWorker:
             self._fire_and_forget(self._free_remote(oid, locations))
 
     async def _free_remote(self, oid: ObjectID, locations):
-        # Primary copy lives on our local raylet or remotes; tell them all.
+        # Primary copy may live on remote nodes too: the local raylet frees
+        # its own copy and forwards FreeObject to every listed location
+        # (reference: ReferenceCounter release → plasma delete on all nodes).
         try:
             if self.raylet_conn and not self.raylet_conn.closed:
-                await self.raylet_conn.call("FreeObject",
-                                            {"object_id": oid.binary()})
+                await self.raylet_conn.call("FreeObject", {
+                    "object_id": oid.binary(),
+                    "locations": sorted(locations) if locations else []})
         except ConnectionError:
             pass
 
@@ -924,14 +937,11 @@ class CoreWorker:
             self._store_error_for_task(
                 spec, exc.ActorDiedError(q.death_cause or "actor is dead"))
             return
-        # Dependency resolution mirrors normal tasks.
-        for dep in spec.dependency_ids():
-            oid = ObjectID(dep)
-            if self.reference_counter.is_owned(oid):
-                try:
-                    await self.memory_store.get(oid)
-                except Exception:
-                    pass
+        # Sequence numbers are assigned before any await so actor calls keep
+        # submission order (the receiver executes strictly by seqno). By-ref
+        # args resolve at the executing worker — the owner's GetObject blocks
+        # until the value exists — so no client-side dependency wait is
+        # needed, and ordering can't be inverted by slow dependencies.
         seqno = q.seqno
         q.seqno += 1
         q.buffer.append((spec, seqno))
@@ -989,7 +999,7 @@ class CoreWorker:
                                     for i, (spec, _) in enumerate(q.buffer)]
                         q.seqno = len(q.buffer)
                     q.conn.on_disconnect.append(
-                        lambda c: self._on_actor_conn_lost(q))
+                        lambda c, q=q: self._on_actor_conn_lost(q, c))
                     await self._pump_actor_queue(q)
                     return
                 if reply["state"] == "DEAD":
@@ -1004,11 +1014,14 @@ class CoreWorker:
         finally:
             q.resolving = False
 
-    def _on_actor_conn_lost(self, q: ActorQueueState):
+    def _on_actor_conn_lost(self, q: ActorQueueState,
+                            conn: Optional[rpc.Connection] = None):
         """Actor worker connection dropped: requeue retryable inflight tasks
         and re-resolve (the actor may be restarting). Tasks without retries
         fail with ActorDiedError (reference: max_task_retries semantics in
         direct_actor_transport.h)."""
+        if conn is not None and q.conn is not conn:
+            return  # stale disconnect from a pre-restart connection
         q.conn = None
         q.state = "RESOLVING"
         inflight = sorted(q.inflight.items())

@@ -291,8 +291,14 @@ class GcsServer:
 
     async def _schedule_actor(self, actor: ActorEntry):
         resources = actor.spec_header.get("resources", {"CPU": 1.0})
+        # Pin the incarnation this scheduling attempt serves: a concurrent
+        # kill/restart bumps it (or marks DEAD), and this attempt must then
+        # abandon rather than create a duplicate live incarnation.
+        incarnation = actor.incarnation
         deadline = time.time() + 60.0
         while time.time() < deadline:
+            if actor.state == ACTOR_DEAD or actor.incarnation != incarnation:
+                return
             node = self._pick_node_for_actor(resources)
             if node is not None and node.conn is not None and not node.conn.closed:
                 try:
@@ -300,7 +306,7 @@ class GcsServer:
                         "ScheduleActorCreation",
                         {"actor_id": actor.actor_id,
                          "spec": actor.spec_header,
-                         "incarnation": actor.incarnation},
+                         "incarnation": incarnation},
                         bufs=actor.spec_frames)
                     if reply.get("ok"):
                         actor.node_id = node.node_id
@@ -318,6 +324,12 @@ class GcsServer:
         actor = self.actors.get(header["actor_id"])
         if actor is None:
             return {"ok": False}
+        # Reject stale reports (a superseded incarnation, or a worker that
+        # finished constructing after the actor was killed): the raylet
+        # tears that worker down on a not-ok reply.
+        if actor.state == ACTOR_DEAD or \
+                header.get("incarnation", actor.incarnation) != actor.incarnation:
+            return {"ok": False, "reason": "stale incarnation"}
         actor.state = ACTOR_ALIVE
         actor.address = header["address"]
         actor.node_id = header.get("node_id", actor.node_id)
@@ -339,6 +351,8 @@ class GcsServer:
     async def _on_actor_failure(self, actor: ActorEntry, reason: str):
         if actor.state == ACTOR_DEAD:
             return
+        if actor.state == ACTOR_RESTARTING:
+            return  # a restart is already in flight; don't double-schedule
         if actor.max_restarts == -1 or actor.num_restarts < actor.max_restarts:
             actor.num_restarts += 1
             actor.incarnation += 1
@@ -407,8 +421,14 @@ class GcsServer:
                                      {"actor_id": actor.actor_id})
             except ConnectionError:
                 pass
-        if actor.state != ACTOR_DEAD and no_restart:
-            await self._fail_actor(actor, "killed via KillActor")
+        # The raylet pops the worker handle before the process dies, so no
+        # death report arrives for kills — drive the state change here:
+        # fail outright, or go through the restart path when allowed.
+        if actor.state != ACTOR_DEAD:
+            if no_restart:
+                await self._fail_actor(actor, "killed via KillActor")
+            else:
+                await self._on_actor_failure(actor, "killed via KillActor")
         return {"ok": True}
 
     # --------------------------------------------------------------- jobs

@@ -469,6 +469,8 @@ class Raylet:
         spec = header["spec"]
         resources = spec.get("resources", {"CPU": 1.0})
         pg_key = None
+        # Reserve resources BEFORE any await: concurrent creations must not
+        # both pass the availability check and oversubscribe the node.
         if spec.get("pg_id"):
             pg_key = (spec["pg_id"], spec.get("pg_bundle", 0))
             bundle_avail = self._pg_available.get(pg_key)
@@ -476,9 +478,15 @@ class Raylet:
                     bundle_avail.get(k, 0.0) + 1e-9 >= v
                     for k, v in resources.items() if v > 0):
                 return {"ok": False, "reason": "pg bundle unavailable"}
-        elif not all(self.resources_available.get(k, 0.0) + 1e-9 >= v
-                     for k, v in resources.items() if v > 0):
-            return {"ok": False, "reason": "insufficient resources"}
+            for k, v in resources.items():
+                bundle_avail[k] = bundle_avail.get(k, 0.0) - v
+        else:
+            if not all(self.resources_available.get(k, 0.0) + 1e-9 >= v
+                       for k, v in resources.items() if v > 0):
+                return {"ok": False, "reason": "insufficient resources"}
+            for k, v in resources.items():
+                self.resources_available[k] = \
+                    self.resources_available.get(k, 0.0) - v
         worker = self._pop_idle_worker()
         if worker is None:
             if self._alive_worker_count() + self._num_starting < self.max_workers:
@@ -488,15 +496,8 @@ class Raylet:
                 await asyncio.sleep(0.02)
                 worker = self._pop_idle_worker()
             if worker is None:
+                self._give_back(resources, pg_key)
                 return {"ok": False, "reason": "no worker available"}
-        if pg_key is not None:
-            for k, v in resources.items():
-                self._pg_available[pg_key][k] = \
-                    self._pg_available[pg_key].get(k, 0.0) - v
-        else:
-            for k, v in resources.items():
-                self.resources_available[k] = \
-                    self.resources_available.get(k, 0.0) - v
         worker.state = WORKER_ACTOR
         worker.actor_id = header["actor_id"]
         worker.actor_resources = resources  # type: ignore[attr-defined]
@@ -519,21 +520,34 @@ class Raylet:
                 "reason": reply.get("error", "actor constructor failed"),
                 "expected": True})
             return {"ok": True}
-        await self.gcs_conn.call("ReportActorAlive", {
+        alive_reply, _ = await self.gcs_conn.call("ReportActorAlive", {
             "actor_id": header["actor_id"],
             "address": worker.address,
-            "node_id": self.node_id.binary()})
+            "node_id": self.node_id.binary(),
+            "incarnation": header.get("incarnation", 0)})
+        if not alive_reply.get("ok"):
+            # Superseded incarnation or killed-while-constructing: tear the
+            # instance down instead of leaving a duplicate live actor.
+            self._give_back(resources, pg_key)
+            worker.actor_resources = {}
+            self._kill_worker(worker)
+            self.workers.pop(worker.worker_id, None)
         return {"ok": True}
 
     def _give_back(self, resources, pg_key):
-        if pg_key is not None and pg_key in self._pg_available:
-            for k, v in resources.items():
-                self._pg_available[pg_key][k] = \
-                    self._pg_available[pg_key].get(k, 0.0) + v
-        else:
-            for k, v in resources.items():
-                self.resources_available[k] = \
-                    self.resources_available.get(k, 0.0) + v
+        if pg_key is not None:
+            # Bundle-scoped resources return to the bundle; if the PG was
+            # removed meanwhile, ReturnPGBundle already returned the whole
+            # bundle to the node pool — crediting it again would inflate
+            # node capacity.
+            if pg_key in self._pg_available:
+                for k, v in resources.items():
+                    self._pg_available[pg_key][k] = \
+                        self._pg_available[pg_key].get(k, 0.0) + v
+            return
+        for k, v in resources.items():
+            self.resources_available[k] = \
+                self.resources_available.get(k, 0.0) + v
 
     async def handle_kill_actor_worker(self, conn, header, bufs):
         actor_id = header["actor_id"]
@@ -585,7 +599,25 @@ class Raylet:
         return {"ok": True}
 
     async def handle_free_object(self, conn, header, bufs):
-        self.store.free(ObjectID(header["object_id"]))
+        oid = ObjectID(header["object_id"])
+        self.store.free(oid)
+
+        # Owner-supplied location list: forward the free to every other node
+        # holding a copy (the owner has no raylet connections of its own).
+        async def _free_on(nid: bytes):
+            info = self.remote_nodes.get(nid)
+            if info is None:
+                return
+            try:
+                peer = await self._peer_conn(info["address"])
+                await peer.call("FreeObject", {"object_id": oid.binary()})
+            except Exception:  # noqa: BLE001 — best-effort per peer
+                pass
+
+        peers = [nid for nid in header.get("locations", [])
+                 if nid != self.node_id.binary()]
+        if peers:
+            await asyncio.gather(*[_free_on(nid) for nid in peers])
         return {"ok": True}
 
     async def handle_fetch_object(self, conn, header, bufs):
@@ -640,6 +672,19 @@ class Raylet:
                     shm.buf[:len(data)] = data
                     shm.close()
                     if self.store.seal(oid, name, len(data)):
+                        # Report the replica to the owner so its location
+                        # index stays complete and FreeObject reaches this
+                        # node too (reference: ObjectDirectory location adds).
+                        if owner_address:
+                            async def _report(addr=owner_address):
+                                try:
+                                    owner = await self._owner_conn(addr)
+                                    await owner.call("AddObjectLocation", {
+                                        "object_id": oid.binary(),
+                                        "node_id": self.node_id.binary()})
+                                except Exception:  # noqa: BLE001
+                                    pass
+                            asyncio.get_running_loop().create_task(_report())
                         return {"ok": True, "segment": name}
             except ConnectionError:
                 continue

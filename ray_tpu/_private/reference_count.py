@@ -199,16 +199,23 @@ class ReferenceCounter:
             ref = self._refs.get(object_id)
             if ref is None or ref.freed or not ref.is_releasable():
                 return
-            ref.freed = True
-            to_release.append(object_id)
-            # Releasing an outer object drops containment edges on inner ones.
-            for inner in list(ref.contains):
-                iref = self._refs.get(inner)
-                if iref is not None:
-                    iref.contained_in.discard(object_id)
+            # Transitive containment walk: releasing an outer object drops
+            # the containment edges on its inner objects, which may free
+            # them — and their own contained objects, to any depth.
+            stack = [(object_id, ref)]
+            while stack:
+                oid, r = stack.pop()
+                if r.freed:
+                    continue
+                r.freed = True
+                to_release.append(oid)
+                for inner in list(r.contains):
+                    iref = self._refs.get(inner)
+                    if iref is None:
+                        continue
+                    iref.contained_in.discard(oid)
                     if iref.is_releasable() and not iref.freed:
-                        iref.freed = True
-                        to_release.append(inner)
+                        stack.append((inner, iref))
             for oid in to_release:
                 self._refs.pop(oid, None)
         for oid in to_release:

@@ -298,7 +298,10 @@ class TaskExecutor:
             result = method(*args, **kwargs)
             if inspect.isawaitable(result):
                 result = await result
-            reply = self._build_reply(spec, result)
+            # _build_reply may seal large returns via the sync raylet RPC
+            # path (core._run), which must not run on the IO loop thread.
+            reply = await asyncio.get_running_loop().run_in_executor(
+                None, self._build_reply, spec, result)
         except _ActorExitSignal:
             self._request_exit("actor exited via exit_actor()")
             reply = self._build_reply(spec, None)
