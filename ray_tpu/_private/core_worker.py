@@ -353,10 +353,12 @@ class CoreWorker:
 
     async def _handle_add_object_location(self, conn, header, bufs):
         """A raylet pulled a replica: keep the owner's location index
-        complete so release-time frees reach every copy."""
-        self.reference_counter.add_location(
+        complete so release-time frees reach every copy. Replies
+        ok=False if the ref was already released (the report lost the
+        race with the final release) so the raylet frees its copy."""
+        ok = self.reference_counter.add_location_if_tracked(
             ObjectID(header["object_id"]), header["node_id"])
-        return {"ok": True}
+        return {"ok": ok}
 
     async def _handle_add_borrower(self, conn, header, bufs):
         self.reference_counter.add_borrower(

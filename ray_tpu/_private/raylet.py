@@ -679,9 +679,15 @@ class Raylet:
                             async def _report(addr=owner_address):
                                 try:
                                     owner = await self._owner_conn(addr)
-                                    await owner.call("AddObjectLocation", {
-                                        "object_id": oid.binary(),
-                                        "node_id": self.node_id.binary()})
+                                    r, _ = await owner.call(
+                                        "AddObjectLocation", {
+                                            "object_id": oid.binary(),
+                                            "node_id":
+                                                self.node_id.binary()})
+                                    if not r.get("ok"):
+                                        # owner already released the
+                                        # object — drop our replica
+                                        self.store.free(oid)
                                 except Exception:  # noqa: BLE001
                                     pass
                             asyncio.get_running_loop().create_task(_report())

@@ -159,6 +159,19 @@ class ReferenceCounter:
             ref.locations.add(node_id)
             ref.in_plasma = True
 
+    def add_location_if_tracked(self, object_id: ObjectID,
+                                node_id: bytes) -> bool:
+        """Like ``add_location`` but refuses to resurrect a released
+        ref (a late replica report racing the owner's final release
+        must not re-create the entry — the replica would leak)."""
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is None:
+                return False
+            ref.locations.add(node_id)
+            ref.in_plasma = True
+            return True
+
     def remove_location(self, object_id: ObjectID, node_id: bytes) -> None:
         with self._lock:
             ref = self._refs.get(object_id)

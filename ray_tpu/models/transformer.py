@@ -1,0 +1,301 @@
+"""Decoder-only transformer (Llama-style) over a dp/pp/sp/tp mesh.
+
+One model definition, two execution modes sharing every line of math:
+
+* **oracle** — ``ParallelConfig()`` with all axes ``None``: plain
+  single-device forward (the differential-test reference).
+* **SPMD** — inside ``jax.shard_map`` over the 4-axis mesh
+  (``ray_tpu.parallel.mesh``): Megatron-style tensor parallelism on
+  ``tp`` (column-parallel QKV/gate/up, row-parallel O/down + ``psum``;
+  backward fixed up by ``tp_copy``), ring or Ulysses attention on
+  ``sp``, a GPipe microbatch pipeline on ``pp``
+  (``parallel.pipeline_spmd``), and gradient ``psum`` over the data
+  axes (``dp``/``sp``).
+
+Design notes for TPU: params live in bf16 MXU-aligned blocks, layers
+are stacked on a leading dim and scanned (one compiled layer body),
+fp32 accumulation everywhere that matters, optional per-layer
+``jax.checkpoint`` to trade FLOPs for HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.ops.attention import attention
+from ray_tpu.ops.norms import rmsnorm
+from ray_tpu.ops.rotary import apply_rotary, rope_frequencies
+from ray_tpu.parallel.collectives import tp_allreduce, tp_copy
+from ray_tpu.parallel.pipeline import pipeline_spmd
+from ray_tpu.parallel.ring_attention import ring_attention
+from ray_tpu.parallel.ulysses import ulysses_attention
+
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 4
+    d_ff: int = 512
+    max_seq: int = 256
+    rope_theta: float = 10000.0
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelConfig:
+    """Mesh axis names (None = that parallelism disabled)."""
+    dp: Optional[str] = None
+    pp: Optional[str] = None
+    sp: Optional[str] = None
+    tp: Optional[str] = None
+    attn: str = "auto"          # auto | local | ring | ulysses
+    remat: bool = False
+    num_microbatches: Optional[int] = None
+
+    def data_axes(self):
+        return tuple(a for a in (self.dp, self.sp) if a)
+
+
+def init_params(key, cfg: TransformerConfig):
+    """Pytree of params; layer weights stacked on a leading L dim."""
+    k = jax.random.split(key, 8)
+    D, H, Dh, F, L, V = (cfg.d_model, cfg.n_heads, cfg.head_dim,
+                         cfg.d_ff, cfg.n_layers, cfg.vocab)
+    dt = cfg.dtype
+    init = jax.nn.initializers.normal(0.02)
+
+    def w(kk, shape):
+        return init(kk, shape, jnp.float32).astype(dt)
+
+    return {
+        "embed": w(k[0], (V, D)),
+        "layers": {
+            "attn_norm": jnp.ones((L, D), dt),
+            "wq": w(k[1], (L, D, H * Dh)),
+            "wk": w(k[2], (L, D, H * Dh)),
+            "wv": w(k[3], (L, D, H * Dh)),
+            "wo": w(k[4], (L, H * Dh, D)),
+            "mlp_norm": jnp.ones((L, D), dt),
+            "w_gate": w(k[5], (L, D, F)),
+            "w_up": w(k[6], (L, D, F)),
+            "w_down": w(k[7], (L, F, D)),
+        },
+        "final_norm": jnp.ones((D,), dt),
+    }
+
+
+def param_specs(pcfg: ParallelConfig):
+    """PartitionSpec pytree matching ``init_params`` output."""
+    pp, tp = pcfg.pp, pcfg.tp
+    return {
+        "embed": P(None, None),
+        "layers": {
+            "attn_norm": P(pp, None),
+            "wq": P(pp, None, tp),
+            "wk": P(pp, None, tp),
+            "wv": P(pp, None, tp),
+            "wo": P(pp, tp, None),
+            "mlp_norm": P(pp, None),
+            "w_gate": P(pp, None, tp),
+            "w_up": P(pp, None, tp),
+            "w_down": P(pp, tp, None),
+        },
+        "final_norm": P(None),
+    }
+
+
+def _attend(q, k, v, pcfg: ParallelConfig):
+    impl = pcfg.attn
+    if impl == "auto":
+        impl = "ring" if pcfg.sp else "local"
+    if impl == "local" or not pcfg.sp:
+        return attention(q, k, v, causal=True)
+    if impl == "ring":
+        return ring_attention(q, k, v, axis=pcfg.sp, causal=True)
+    if impl == "ulysses":
+        return ulysses_attention(q, k, v, axis=pcfg.sp, causal=True)
+    raise ValueError(f"unknown attn impl {impl!r}")
+
+
+def _layer(lp, x, cos, sin, positions, cfg: TransformerConfig,
+           pcfg: ParallelConfig):
+    """One block on local shards. x: [B_l, T_l, D] (tp-replicated)."""
+    B, T, D = x.shape
+    Dh = cfg.head_dim
+
+    h = rmsnorm(x, lp["attn_norm"])
+    if pcfg.tp:
+        h = tp_copy(h, pcfg.tp)
+    q = (h @ lp["wq"]).reshape(B, T, -1, Dh)      # H_local heads
+    k = (h @ lp["wk"]).reshape(B, T, -1, Dh)
+    v = (h @ lp["wv"]).reshape(B, T, -1, Dh)
+    q = apply_rotary(q, cos, sin, positions=positions)
+    k = apply_rotary(k, cos, sin, positions=positions)
+    o = _attend(q, k, v, pcfg).reshape(B, T, -1)
+    o = o @ lp["wo"]                               # row-parallel
+    if pcfg.tp:
+        o = tp_allreduce(o, pcfg.tp)
+    x = x + o.astype(x.dtype)
+
+    h = rmsnorm(x, lp["mlp_norm"])
+    if pcfg.tp:
+        h = tp_copy(h, pcfg.tp)
+    g = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32))
+    u = (h @ lp["w_up"]).astype(jnp.float32)
+    d = (g * u).astype(x.dtype) @ lp["w_down"]     # row-parallel
+    if pcfg.tp:
+        d = tp_allreduce(d, pcfg.tp)
+    return x + d.astype(x.dtype)
+
+
+def _stack_fn(cfg, pcfg, cos, sin, positions):
+    """Scan the (locally held) layer stack over one activation."""
+    def run(layers, x):
+        layer = functools.partial(_layer, cos=cos, sin=sin,
+                                  positions=positions, cfg=cfg, pcfg=pcfg)
+        if pcfg.remat:
+            layer = jax.checkpoint(layer)
+
+        def body(h, lp):
+            return layer(lp, h), None
+
+        out, _ = lax.scan(body, x, layers)
+        return out
+    return run
+
+
+def forward(params, tokens, cfg: TransformerConfig,
+            pcfg: ParallelConfig = ParallelConfig()):
+    """tokens: [B_local, T_local] int32 → logits [B_l, T_l, V] (fp32).
+
+    Call directly for the oracle, or inside shard_map for SPMD.
+    """
+    T = tokens.shape[1]
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq,
+                                theta=cfg.rope_theta)
+    if pcfg.sp:
+        positions = lax.axis_index(pcfg.sp) * T + jnp.arange(T)
+    else:
+        positions = jnp.arange(T)
+
+    x = params["embed"][tokens]                    # [B,T,D]
+    stack = _stack_fn(cfg, pcfg, cos, sin, positions)
+    if pcfg.pp:
+        x = pipeline_spmd(stack, params["layers"], x, axis=pcfg.pp,
+                          num_microbatches=pcfg.num_microbatches)
+    else:
+        x = stack(params["layers"], x)
+    x = rmsnorm(x, params["final_norm"])
+    # tied unembed; logits fp32 for a stable softmax-xent
+    return (x @ params["embed"].T.astype(x.dtype)).astype(jnp.float32)
+
+
+def loss_fn(params, batch, cfg: TransformerConfig,
+            pcfg: ParallelConfig = ParallelConfig()):
+    """Mean next-token cross-entropy over the GLOBAL batch.
+
+    batch: dict(tokens=[B_l, T_l], targets=[B_l, T_l]); inside
+    shard_map the per-rank mean is pmean'd over the data axes.
+    """
+    logits = forward(params, batch["tokens"], cfg, pcfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(
+        logp, batch["targets"][..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)  # LOCAL mean; train step reduces over axes
+
+
+def make_train_step(cfg: TransformerConfig, pcfg: ParallelConfig,
+                    mesh=None, optimizer=None):
+    """Build a jitted ``step(params, opt_state, batch) → (params,
+    opt_state, loss)``. With a mesh, wraps the per-rank step in
+    shard_map over all four axes with real param/batch shardings."""
+    import optax
+
+    optimizer = optimizer or optax.adamw(3e-4)
+
+    pspecs_for_grads = param_specs(pcfg)
+
+    def local_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg,
+                                                  pcfg)
+        # Gradient calculus under shard_map AD (lax.psum transposes to
+        # psum, i.e. per-rank grads equal ∂(Σ_ranks loss_r)/∂leaf):
+        # * tp — the layer uses tp_copy/tp_allreduce (Megatron f/g with
+        #   JAX-correct transposes), so every tp rank's grads are
+        #   already the true single-counted gradient: no reduction.
+        # * pp — the pipeline's output broadcast sums the n_pp
+        #   redundant loss copies' cotangents into every path, so
+        #   divide by n_pp; pp-replicated leaves (embed, final_norm)
+        #   then need their per-rank halves psum'd over pp.
+        # * dp/sp — distinct data shards: pmean.
+        redundancy = float(lax.axis_size(pcfg.pp)) if pcfg.pp else 1.0
+
+        def reduce_leaf(g, spec):
+            g = g / redundancy
+            sharded = set(a for a in spec if a)
+            if pcfg.pp and pcfg.pp not in sharded:
+                g = lax.psum(g, axis_name=pcfg.pp)
+            for ax in pcfg.data_axes():
+                g = lax.pmean(g, axis_name=ax)
+            return g
+
+        grads = jax.tree.map(
+            reduce_leaf, grads, pspecs_for_grads,
+            is_leaf=lambda x: isinstance(x, jax.Array))
+        for ax in pcfg.data_axes():
+            loss = lax.pmean(loss, axis_name=ax)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    if mesh is None:
+        return jax.jit(local_step), optimizer
+
+    pspecs = param_specs(pcfg)
+    opt_specs = _opt_state_specs(optimizer, cfg, pspecs)
+    batch_spec = {"tokens": P(pcfg.dp, pcfg.sp),
+                  "targets": P(pcfg.dp, pcfg.sp)}
+    step = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(pspecs, opt_specs, batch_spec),
+        out_specs=(pspecs, opt_specs, P()),
+        check_vma=False)
+    return jax.jit(step), optimizer
+
+
+def _opt_state_specs(optimizer, cfg: TransformerConfig, pspecs):
+    """Opt-state PartitionSpecs: any subtree shaped like the param tree
+    (adam's mu/nu, etc.) shards like the params; scalars replicate."""
+    param_shapes = jax.eval_shape(
+        functools.partial(init_params, cfg=cfg), jax.random.key(0))
+    param_treedef = jax.tree.structure(param_shapes)
+    state_shapes = jax.eval_shape(optimizer.init, param_shapes)
+
+    def walk(st):
+        if jax.tree.structure(st) == param_treedef:
+            return pspecs
+        if isinstance(st, tuple):
+            mapped = tuple(walk(s) for s in st)
+            return (type(st)(*mapped) if hasattr(st, "_fields")
+                    else mapped)
+        if isinstance(st, list):
+            return [walk(s) for s in st]
+        if isinstance(st, dict):
+            return {kk: walk(vv) for kk, vv in st.items()}
+        return P()
+
+    return walk(state_shapes)

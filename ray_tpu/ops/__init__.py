@@ -1,0 +1,12 @@
+"""TPU compute kernels (Pallas) with pure-JAX fallbacks.
+
+The reference has no tensor compute of its own (it schedules Python
+functions; GPU math lives in user torch/TF code). Here the hot ops of
+the flagship models are first-class: MXU-shaped, bfloat16-friendly,
+Pallas where fusion beats XLA, pure JAX elsewhere. Every op has a
+reference implementation that runs on CPU for differential testing.
+"""
+
+from ray_tpu.ops.attention import attention, flash_attention  # noqa: F401
+from ray_tpu.ops.norms import rmsnorm  # noqa: F401
+from ray_tpu.ops.rotary import apply_rotary, rope_frequencies  # noqa: F401

@@ -1,0 +1,104 @@
+"""Attention: pure-JAX reference and a Pallas TPU kernel.
+
+``attention`` is the XLA-fused reference (differential-test oracle and
+CPU path). ``flash_attention`` tiles Q into MXU-aligned blocks with the
+K/V panel resident in VMEM — scores never round-trip to HBM. On
+non-TPU backends it transparently falls back to ``attention``.
+
+Shapes everywhere: [batch, seq, heads, head_dim].
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def attention(q, k, v, *, causal: bool = True,
+              sm_scale: float | None = None):
+    """Reference softmax attention (fp32 accumulation)."""
+    D = q.shape[-1]
+    sm_scale = sm_scale if sm_scale is not None else D ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        Tq, Tk = q.shape[1], k.shape[1]
+        # allow Tq != Tk (decode: q at the tail of the kv sequence)
+        qpos = jnp.arange(Tq) + (Tk - Tq)
+        mask = qpos[:, None] >= jnp.arange(Tk)[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover - no backend at all
+        return False
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal,
+                  block_q):
+    # q_ref [1,1,bq,D]; k_ref/v_ref [1,1,T,D]; o_ref [1,1,bq,D]
+    import jax.experimental.pallas as pl
+
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)          # [bq, D]
+    k = k_ref[0, 0].astype(jnp.float32)          # [T, D]
+    v = v_ref[0, 0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale  # [bq, T]
+    if causal:
+        T = k.shape[0]
+        qpos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0)
+        kpos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(qpos >= kpos, s, -1e30)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(
+        (p / l), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    o_ref[0, 0] = o.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "sm_scale",
+                                             "block_q", "interpret"))
+def flash_attention(q, k, v, *, causal: bool = True,
+                    sm_scale: float | None = None, block_q: int = 128,
+                    interpret: bool = False):
+    """Pallas blockwise attention; falls back to ``attention`` off-TPU."""
+    B, T, H, D = q.shape
+    sm_scale = sm_scale if sm_scale is not None else D ** -0.5
+    if ((not interpret and not _on_tpu()) or T % block_q or T < block_q
+            or k.shape[1] != T):  # decode (Tq != Tk) → reference path
+        return attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    import jax.experimental.pallas as pl
+
+    # [B,T,H,D] → [B,H,T,D] so the MXU dims (T, D) are trailing.
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    grid = (B, H, T // block_q)
+    kernel = functools.partial(_flash_kernel, sm_scale=sm_scale,
+                               causal=causal, block_q=block_q)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, T, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, T, D), lambda b, h, i: (b, h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D),
+                               lambda b, h, i: (b, h, i, 0)),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)

@@ -1,0 +1,15 @@
+"""Normalization ops."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rmsnorm(x, weight, *, eps: float = 1e-6):
+    """RMSNorm in fp32, cast back to input dtype (XLA fuses this into
+    the adjacent matmul; no Pallas needed — it is bandwidth-bound and
+    fusion already eliminates the HBM round-trip)."""
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    y = x32 * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (y * weight.astype(jnp.float32)).astype(x.dtype)

@@ -1,0 +1,30 @@
+"""Rotary position embeddings (RoPE)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_seq: int, *,
+                     theta: float = 10000.0):
+    """Precompute cos/sin tables [max_seq, head_dim//2] (fp32)."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                      dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    ang = jnp.outer(t, inv)
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rotary(x, cos, sin, *, positions=None):
+    """x: [B, T, H, D]; cos/sin: [max_seq, D//2]. positions: [T] global
+    token positions (for sequence-parallel shards / decode offsets)."""
+    T = x.shape[1]
+    if positions is None:
+        c, s = cos[:T], sin[:T]
+    else:
+        c, s = cos[positions], sin[positions]
+    c = c[None, :, None, :]
+    s = s[None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,79 @@
+"""Device mesh construction.
+
+TPU-native replacement for the reference's NCCL/Gloo group bootstrap
+(reference: python/ray/util/collective/collective.py:39 GroupManager,
+collective_group/nccl_collective_group.py): instead of rendezvous'ing
+communicators, we build a named ``jax.sharding.Mesh`` over the devices
+and let XLA compile collectives onto ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis order: outermost (slowest, DCN-friendly) → innermost
+# (fastest, wants contiguous ICI neighbors). tp innermost so MXU-dim
+# collectives ride nearest-neighbor ICI links.
+AXES = ("dp", "pp", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Sizes for each named axis; -1 on at most one axis means 'rest'."""
+
+    dp: int = 1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def sizes(self) -> tuple:
+        return (self.dp, self.pp, self.sp, self.tp)
+
+    def resolve(self, n_devices: int) -> "MeshConfig":
+        sizes = list(self.sizes())
+        if -1 in sizes:
+            i = sizes.index(-1)
+            known = math.prod(s for s in sizes if s != -1)
+            if n_devices % known:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by {known}")
+            sizes[i] = n_devices // known
+        if math.prod(sizes) != n_devices:
+            raise ValueError(
+                f"mesh {dict(zip(AXES, sizes))} != {n_devices} devices")
+        return MeshConfig(*sizes)
+
+
+def default_mesh_shape(n_devices: int) -> MeshConfig:
+    """Factorize n_devices over (dp, pp, sp, tp), giving every axis ≥2
+    when possible (powers of two first), so all four parallelism kinds
+    are exercised on any mesh of ≥16 devices (≥3 kinds on 8)."""
+    sizes = [1, 1, 1, 1]
+    rest = n_devices
+    # Deal factors of two round-robin across axes, tp first (innermost
+    # gets the fastest links), then dp (batch scales best), then sp, pp.
+    order = [3, 0, 2, 1]
+    i = 0
+    while rest % 2 == 0 and rest > 1:
+        sizes[order[i % 4]] *= 2
+        rest //= 2
+        i += 1
+    sizes[0] *= rest  # odd remainder onto dp
+    return MeshConfig(*sizes)
+
+
+def build_mesh(config: Optional[MeshConfig] = None,
+               devices: Optional[Sequence] = None) -> Mesh:
+    """Build a 4-axis named Mesh; singleton axes are kept so sharding
+    rules can always name all of dp/pp/sp/tp."""
+    devices = list(devices if devices is not None else jax.devices())
+    config = (config or default_mesh_shape(len(devices))).resolve(
+        len(devices))
+    arr = np.array(devices).reshape(config.sizes())
+    return Mesh(arr, AXES)

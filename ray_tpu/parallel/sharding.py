@@ -1,0 +1,56 @@
+"""GSPMD sharding rules for transformer training.
+
+The scaling recipe: pick a mesh, annotate param/activation shardings
+with ``PartitionSpec``, let XLA insert the collectives. This module maps
+*logical* tensor axis names to mesh axes — the seam where tp/dp/sp/pp
+layout policy lives.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# logical axis name → mesh axis (None = replicated).
+# Weights are sharded over tp (MXU dim) and optionally fsdp-style over dp.
+DEFAULT_RULES = {
+    "batch": "dp",
+    "seq": "sp",          # sequence parallelism for activations
+    "kv_seq": None,
+    "embed": None,        # residual stream replicated across tp
+    "mlp": "tp",          # ffn hidden sharded over tp
+    "heads": "tp",        # attention heads sharded over tp
+    "head_dim": None,
+    "vocab": "tp",
+    "layers": None,       # stacked-layer leading dim (pp shards it)
+    "stages": "pp",
+    "expert": "tp",       # experts ride the tp axis by default
+}
+
+
+def transformer_rules(**overrides) -> dict:
+    rules = dict(DEFAULT_RULES)
+    rules.update(overrides)
+    return rules
+
+
+def logical_to_mesh(logical_axes: Sequence[Optional[str]],
+                    rules: Optional[dict] = None) -> P:
+    """('batch','seq','embed') → PartitionSpec('dp','sp',None)."""
+    rules = rules or DEFAULT_RULES
+    return P(*[rules.get(a) if a else None for a in logical_axes])
+
+
+def with_sharding(mesh: Mesh, x, logical_axes: Sequence[Optional[str]],
+                  rules: Optional[dict] = None):
+    """Constrain ``x`` to the sharding implied by its logical axes."""
+    spec = logical_to_mesh(logical_axes, rules)
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, spec))
+
+
+def named_sharding(mesh: Mesh, logical_axes: Sequence[Optional[str]],
+                   rules: Optional[dict] = None) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_mesh(logical_axes, rules))

@@ -18,6 +18,14 @@ os.environ.setdefault("RAY_TPU_WORKER_JAX_PLATFORMS", "cpu")
 
 import pytest  # noqa: E402
 
+# The axon sitecustomize force-registers the TPU platform regardless of
+# JAX_PLATFORMS; pin the test process to the 8-device virtual CPU mesh
+# (TPU fp32 matmuls round through bf16 and would break the differential
+# oracles).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import ray_tpu  # noqa: E402
 
 
