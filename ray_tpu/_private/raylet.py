@@ -209,8 +209,13 @@ class Raylet:
 
     # ----------------------------------------------------------- worker pool
 
-    def _start_worker_process(self) -> None:
-        if self._num_starting + self._alive_worker_count() >= self.max_workers:
+    def _start_worker_process(self, force: bool = False) -> None:
+        # The pool cap tracks CPU slots for task workers. Actor leases
+        # pass force=True: their admission is governed by the resource
+        # accounting (a zero-cpu actor must not starve on the process
+        # cap — reference: dedicated workers per actor, worker_pool.cc).
+        if not force and (self._num_starting + self._alive_worker_count()
+                          >= self.max_workers):
             return
         self._num_starting += 1
         log_dir = os.path.join(self.session_dir, "logs")
@@ -489,8 +494,7 @@ class Raylet:
                     self.resources_available.get(k, 0.0) - v
         worker = self._pop_idle_worker()
         if worker is None:
-            if self._alive_worker_count() + self._num_starting < self.max_workers:
-                self._start_worker_process()
+            self._start_worker_process(force=True)
             deadline = time.time() + self.config.worker_register_timeout_s
             while worker is None and time.time() < deadline:
                 await asyncio.sleep(0.02)

@@ -121,7 +121,9 @@ class SerializationContext:
                         return (_deserialize_actor_placeholder, (state,))
                 elif type(obj) in ctx._custom_reducers:
                     return ctx._custom_reducers[type(obj)](obj)
-                return NotImplemented
+                # delegate to CloudPickler's reducer_override — it is
+                # what pickles local functions/classes by value
+                return super().reducer_override(obj)
 
         f = io.BytesIO()
         p = _Pickler(f, protocol=5, buffer_callback=buffers.append)

@@ -51,8 +51,14 @@ class TaskExecutor:
         self._actor_is_asyncio = False
         self._actor_sema: Optional[asyncio.Semaphore] = None
         self._actor_pool: Optional[ThreadPoolExecutor] = None
-        self._actor_expected_seqno = 0
-        self._actor_reorder: Dict[int, Tuple[dict, List[bytes], asyncio.Future]] = {}
+        # Receiver-side ordering state is PER CALLER: every submitting
+        # worker numbers its own stream from 0 (reference: per-caller
+        # sequence_number in direct_actor_transport.h) — a global
+        # counter would deadlock the second caller of a shared actor.
+        self._actor_expected_seqno: Dict[bytes, int] = {}
+        self._actor_reorder: Dict[
+            bytes, Dict[int, Tuple[dict, List[bytes],
+                                   asyncio.Future]]] = {}
         self._actor_exec_queue: Optional[asyncio.Queue] = None
         self._actor_consumer: Optional[asyncio.Task] = None
         core._server.handlers.update({
@@ -78,6 +84,10 @@ class TaskExecutor:
     def _execute_task_sync(self, spec: TaskSpec):
         _task_ctx.task_id = spec.task_id
         self.core._current_task_id = spec.task_id
+        if not self.core.job_id and spec.job_id:
+            # adopt the submitting job: nested task/actor creation from
+            # this worker needs a job id for ID derivation
+            self.core.job_id = spec.job_id
         try:
             fn = self.core.function_manager.fetch(spec.fn_key)
             args, kwargs = self._resolve_args(spec)
@@ -217,6 +227,8 @@ class TaskExecutor:
     def _construct_actor(self, spec: TaskSpec):
         _task_ctx.task_id = spec.task_id
         self.core._current_task_id = spec.task_id
+        if not self.core.job_id and spec.job_id:
+            self.core.job_id = spec.job_id  # see _execute_task_sync
         try:
             cls = self.core.function_manager.fetch(spec.fn_key)
             args, kwargs = self._resolve_args(spec)
@@ -229,17 +241,21 @@ class TaskExecutor:
         """Receiver-side ordering: execute strictly in client seqno order,
         buffering out-of-order arrivals (reference: ActorSchedulingQueue)."""
         seqno = header["seqno"]
+        caller = header.get("owner_worker_id", b"")
         fut = asyncio.get_running_loop().create_future()
-        self._actor_reorder[seqno] = (header, list(bufs), fut)
-        self._drain_reorder_buffer()
+        self._actor_reorder.setdefault(caller, {})[seqno] = (
+            header, list(bufs), fut)
+        self._drain_reorder_buffer(caller)
         return await fut
 
-    def _drain_reorder_buffer(self):
-        while self._actor_expected_seqno in self._actor_reorder:
-            seqno = self._actor_expected_seqno
-            header, bufs, fut = self._actor_reorder.pop(seqno)
-            self._actor_expected_seqno += 1
+    def _drain_reorder_buffer(self, caller: bytes):
+        reorder = self._actor_reorder.get(caller, {})
+        expected = self._actor_expected_seqno.setdefault(caller, 0)
+        while expected in reorder:
+            header, bufs, fut = reorder.pop(expected)
+            expected += 1
             self._actor_exec_queue.put_nowait((header, bufs, fut))
+        self._actor_expected_seqno[caller] = expected
 
     async def _actor_consume_loop(self):
         while True:
@@ -277,6 +293,8 @@ class TaskExecutor:
 
     def _execute_actor_task_sync(self, spec: TaskSpec):
         _task_ctx.task_id = spec.task_id
+        if not self.core.job_id and spec.job_id:
+            self.core.job_id = spec.job_id  # see _execute_task_sync
         try:
             method = self._lookup_method(spec.name)
             args, kwargs = self._resolve_args(spec)

@@ -28,6 +28,12 @@ def main(argv=None):
         level=args.log_level,
         format=f"[worker {args.worker_id[:8]}] %(levelname)s %(name)s: %(message)s")
 
+    # Debug aid: periodic all-thread stack dumps to the worker log.
+    dump_s = float(os.environ.get("RAY_TPU_WORKER_STACK_DUMP_S", "0"))
+    if dump_s > 0:
+        import faulthandler
+        faulthandler.dump_traceback_later(dump_s, repeat=True)
+
     from ray_tpu._private import rpc
     from ray_tpu._private.config import RayTpuConfig, set_config
     from ray_tpu._private.core_worker import CoreWorker

@@ -101,7 +101,7 @@ class ActorClass:
                  lifetime=None, placement_group=None,
                  placement_group_bundle_index=-1, max_pending_calls=-1,
                  scheduling_strategy="DEFAULT", max_retries=None,
-                 retry_exceptions=False):
+                 retry_exceptions=False, get_if_exists=False):
         self._cls = cls
         self._class_name = cls.__name__
         self._num_cpus = num_cpus
@@ -121,6 +121,7 @@ class ActorClass:
         self._placement_group = placement_group
         self._placement_group_bundle_index = placement_group_bundle_index
         self._max_pending_calls = max_pending_calls
+        self._get_if_exists = get_if_exists
         self._fn_key: Optional[str] = None
         self._pickled: Optional[bytes] = None
         # @ray_tpu.method(num_returns=N) annotations on the class's methods.
@@ -143,6 +144,22 @@ class ActorClass:
         return demand
 
     def remote(self, *args, **kwargs):
+        if self._get_if_exists and self._name:
+            # race-free named-actor rendezvous (reference parity:
+            # ray 1.x used bare name= + try/except; modern get_if_exists)
+            try:
+                return get_actor(self._name, self._namespace)
+            except ValueError:
+                pass
+            try:
+                return self._do_create(args, kwargs)
+            except Exception as e:  # noqa: BLE001 - name race only
+                if "already taken" in str(e):
+                    return get_actor(self._name, self._namespace)
+                raise
+        return self._do_create(args, kwargs)
+
+    def _do_create(self, args, kwargs):
         w = worker_mod._require_connected()
         if self._fn_key is None:
             self._fn_key, self._pickled = \
@@ -173,7 +190,7 @@ class ActorClass:
                    "max_task_retries", "max_concurrency", "runtime_env",
                    "name", "namespace", "lifetime", "placement_group",
                    "placement_group_bundle_index", "max_pending_calls",
-                   "scheduling_strategy", "num_returns"}
+                   "scheduling_strategy", "num_returns", "get_if_exists"}
         bad = set(overrides) - allowed
         if bad:
             raise ValueError(f"unknown actor options: {sorted(bad)}")
@@ -187,6 +204,7 @@ class ActorClass:
             "placement_group": self._placement_group,
             "placement_group_bundle_index": self._placement_group_bundle_index,
             "max_pending_calls": self._max_pending_calls,
+            "get_if_exists": self._get_if_exists,
         }
         base.update(overrides)
         clone = ActorClass(self._cls, **base)

@@ -1,0 +1,12 @@
+from ray_tpu.train.trainer import (  # noqa: F401
+    Trainer,
+    TrainingCallback,
+    collective_group_name,
+    load_checkpoint,
+    local_rank,
+    report,
+    save_checkpoint,
+    world_rank,
+    world_size,
+)
+from ray_tpu.train.worker_group import WorkerGroup  # noqa: F401

@@ -1,0 +1,62 @@
+"""Group of training worker actors.
+
+API parity with the reference's ``ray.util.sgd.v2.WorkerGroup``
+(reference: python/ray/util/sgd/v2/worker_group.py): N actors, execute
+a function on all (or one) of them, sync or async.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List
+
+import ray_tpu
+
+
+class _ExecutableWorker:
+    """Generic executor actor; also carries a per-worker state dict so
+    train backends can stash context (rank, collective group, etc.)."""
+
+    def __init__(self):
+        self.state: dict = {}
+
+    def execute(self, fn: Callable, *args, **kwargs):
+        return fn(*args, **kwargs)
+
+    def execute_with_state(self, fn: Callable, *args, **kwargs):
+        return fn(self.state, *args, **kwargs)
+
+
+class WorkerGroup:
+    def __init__(self, num_workers: int = 1, num_cpus_per_worker: float = 1,
+                 num_tpus_per_worker: float = 0,
+                 resources_per_worker: dict | None = None):
+        if num_workers <= 0:
+            raise ValueError("num_workers must be positive")
+        cls = ray_tpu.remote(_ExecutableWorker).options(
+            num_cpus=num_cpus_per_worker,
+            num_tpus=num_tpus_per_worker or None,
+            resources=resources_per_worker)
+        self.workers = [cls.remote() for _ in range(num_workers)]
+
+    def __len__(self) -> int:
+        return len(self.workers)
+
+    def execute_async(self, fn: Callable, *args, **kwargs) -> List:
+        return [w.execute.remote(fn, *args, **kwargs)
+                for w in self.workers]
+
+    def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        return ray_tpu.get(self.execute_async(fn, *args, **kwargs))
+
+    def execute_single_async(self, rank: int, fn: Callable, *args,
+                             **kwargs):
+        return self.workers[rank].execute.remote(fn, *args, **kwargs)
+
+    def execute_single(self, rank: int, fn: Callable, *args, **kwargs):
+        return ray_tpu.get(
+            self.execute_single_async(rank, fn, *args, **kwargs))
+
+    def shutdown(self) -> None:
+        for w in self.workers:
+            ray_tpu.kill(w)
+        self.workers = []

@@ -1,0 +1,16 @@
+"""Utility libraries over the core task/actor API.
+
+Parity targets (reference python/ray/util/): ActorPool
+(util/actor_pool.py), distributed Queue (util/queue.py),
+ParallelIterator (util/iter.py), collective groups
+(util/collective/), plus `ray_tpu.train` as the sgd/v2 equivalent.
+"""
+
+from ray_tpu.util.actor_pool import ActorPool  # noqa: F401
+from ray_tpu.util.queue import Empty, Full, Queue  # noqa: F401
+from ray_tpu.util.iter import (  # noqa: F401
+    ParallelIterator,
+    from_items,
+    from_iterators,
+    from_range,
+)

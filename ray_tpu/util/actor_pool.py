@@ -1,0 +1,97 @@
+"""Pool of actor handles with pipelined task submission.
+
+API parity with the reference's ``ray.util.ActorPool``
+(reference: python/ray/util/actor_pool.py): map/map_unordered/submit/
+get_next/get_next_unordered/has_next/has_free/push/pop_idle.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: Iterable[Any]):
+        self._idle_actors: List[Any] = list(actors)
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits = []
+
+    def map(self, fn: Callable, values: Iterable):
+        """fn(actor, value) → ObjectRef; yields results in order."""
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def submit(self, fn: Callable, value):
+        if self._idle_actors:
+            actor = self._idle_actors.pop()
+            future = fn(actor, value)
+            self._future_to_actor[future] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor)
+
+    def has_free(self) -> bool:
+        return bool(self._idle_actors) and not self._pending_submits
+
+    def get_next(self, timeout: float | None = None):
+        """Next result in submission order."""
+        if not self.has_next():
+            raise StopIteration("no more results to get")
+        future = self._index_to_future[self._next_return_index]
+        if timeout is not None:
+            ready, _ = ray_tpu.wait([future], timeout=timeout)
+            if not ready:
+                raise TimeoutError("timed out waiting for result")
+        # bookkeeping before get(): a raising task must still return its
+        # actor to the pool (reference: ray.util.actor_pool does the same)
+        del self._index_to_future[self._next_return_index]
+        self._next_return_index += 1
+        self._return_actor(self._future_to_actor.pop(future)[1])
+        return ray_tpu.get(future)
+
+    def get_next_unordered(self, timeout: float | None = None):
+        if not self.has_next():
+            raise StopIteration("no more results to get")
+        ready, _ = ray_tpu.wait(list(self._future_to_actor),
+                                num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("timed out waiting for result")
+        future = ready[0]
+        i, actor = self._future_to_actor.pop(future)
+        del self._index_to_future[i]
+        self._return_actor(actor)
+        return ray_tpu.get(future)
+
+    def _return_actor(self, actor):
+        self._idle_actors.append(actor)
+        if self._pending_submits:
+            self.submit(*self._pending_submits.pop(0))
+
+    def push(self, actor):
+        """Add an idle actor to the pool."""
+        self._idle_actors.append(actor)
+        if self._pending_submits:
+            self.submit(*self._pending_submits.pop(0))
+
+    def pop_idle(self):
+        """Remove and return an idle actor, or None."""
+        if self.has_free():
+            return self._idle_actors.pop()
+        return None

@@ -1,0 +1,14 @@
+from ray_tpu.util.collective.collective import (  # noqa: F401
+    ReduceOp,
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    destroy_collective_group,
+    get_collective_group_size,
+    get_rank,
+    init_collective_group,
+    recv,
+    reducescatter,
+    send,
+)

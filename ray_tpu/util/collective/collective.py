@@ -1,0 +1,225 @@
+"""Collective communication groups between tasks/actors.
+
+API parity with the reference's ``ray.util.collective``
+(reference: python/ray/util/collective/collective.py —
+init_collective_group :111, allreduce :244, broadcast :358,
+allgather :409, reducescatter :457, send/recv :514+, GroupManager :39).
+
+TPU-native stance (SURVEY.md §5.8): *device* collectives are XLA
+collectives over the ICI mesh (``ray_tpu.parallel``) — compiled, not a
+runtime service. This module is the **host** backend (the reference's
+gloo path): rendezvous through a named coordinator actor, data moving
+through the object store. Use it for control-plane sync, param
+broadcast between actor trainers, and CPU tensors.
+
+Ordering contract (same as NCCL's): every rank must issue the same
+collectives in the same order; each op gets a sequence number and the
+coordinator matches contributions by (group, seq).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+_POLL_S = 0.002
+
+
+class ReduceOp:
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+
+def _reduce(arrays: List[np.ndarray], op: str) -> np.ndarray:
+    out = np.asarray(arrays[0]).copy()
+    for a in arrays[1:]:
+        a = np.asarray(a)
+        if op == ReduceOp.SUM:
+            out = out + a
+        elif op == ReduceOp.PRODUCT:
+            out = out * a
+        elif op == ReduceOp.MIN:
+            out = np.minimum(out, a)
+        elif op == ReduceOp.MAX:
+            out = np.maximum(out, a)
+        else:
+            raise ValueError(f"unknown reduce op {op!r}")
+    return out
+
+
+class _Coordinator:
+    """Named actor holding per-group rendezvous state."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self.rounds: Dict[int, Dict[int, Any]] = {}
+        self.fetched: Dict[int, int] = {}
+        self.mailbox: Dict[tuple, Any] = {}   # (seq, src, dst) → payload
+
+    def contribute(self, seq: int, rank: int, payload) -> None:
+        self.rounds.setdefault(seq, {})[rank] = payload
+
+    def fetch(self, seq: int):
+        """All contributions once complete, else None. Garbage-collects
+        the round after every rank has fetched it."""
+        rnd = self.rounds.get(seq)
+        if rnd is None or len(rnd) < self.world_size:
+            return None
+        n = self.fetched.get(seq, 0) + 1
+        if n >= self.world_size:
+            self.rounds.pop(seq, None)
+            self.fetched.pop(seq, None)
+        else:
+            self.fetched[seq] = n
+        return rnd
+
+    def p2p_put(self, seq: int, src: int, dst: int, payload) -> None:
+        self.mailbox[(seq, src, dst)] = payload
+
+    def p2p_take(self, seq: int, src: int, dst: int):
+        if (seq, src, dst) in self.mailbox:
+            return [self.mailbox.pop((seq, src, dst))]
+        return None
+
+
+class _Group:
+    def __init__(self, name: str, rank: int, world_size: int, coordinator):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.coord = coordinator
+        self.seq = 0
+        self.p2p_seq: Dict[tuple, int] = {}
+
+    def _next_seq(self) -> int:
+        self.seq += 1
+        return self.seq
+
+    def _exchange(self, payload) -> Dict[int, Any]:
+        seq = self._next_seq()
+        ray_tpu.get(self.coord.contribute.remote(seq, self.rank, payload))
+        while True:
+            rnd = ray_tpu.get(self.coord.fetch.remote(seq))
+            if rnd is not None:
+                return rnd
+            time.sleep(_POLL_S)
+
+
+# per-process registry: group name → _Group
+_groups: Dict[str, _Group] = {}
+
+_COORD_PREFIX = "rtpu_collective:"
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "host",
+                          group_name: str = "default") -> None:
+    """Declare membership; rank 0's process may pre-create the
+    coordinator, otherwise whoever arrives first creates it."""
+    if backend not in ("host", "object_store"):
+        raise ValueError(
+            f"backend {backend!r} not supported; device collectives are "
+            "XLA collectives — see ray_tpu.parallel")
+    if group_name in _groups:
+        raise RuntimeError(f"group {group_name!r} already initialized")
+    name = _COORD_PREFIX + group_name
+    coord_cls = ray_tpu.remote(_Coordinator).options(
+        num_cpus=0, name=name, get_if_exists=True, lifetime="detached")
+    coord = coord_cls.remote(world_size)
+    _groups[group_name] = _Group(group_name, rank, world_size, coord)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    """Drop the local membership and kill the (detached) coordinator —
+    otherwise it leaks and a later same-named group with a different
+    world size would attach to the stale one."""
+    g = _groups.pop(group_name, None)
+    coord = g.coord if g is not None else None
+    if coord is None:
+        try:
+            coord = ray_tpu.get_actor(_COORD_PREFIX + group_name)
+        except Exception:  # noqa: BLE001 - not found / not connected
+            coord = None
+    if coord is not None:
+        try:
+            ray_tpu.kill(coord)
+        except Exception:  # noqa: BLE001 - already dead
+            pass
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _groups[group_name].rank if group_name in _groups else -1
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return (_groups[group_name].world_size
+            if group_name in _groups else -1)
+
+
+def _group(group_name: str) -> _Group:
+    if group_name not in _groups:
+        raise RuntimeError(
+            f"collective group {group_name!r} is not initialized in this "
+            "process; call init_collective_group() first")
+    return _groups[group_name]
+
+
+def allreduce(tensor, group_name: str = "default",
+              op: str = ReduceOp.SUM) -> np.ndarray:
+    g = _group(group_name)
+    rnd = g._exchange(np.asarray(tensor))
+    return _reduce([rnd[r] for r in sorted(rnd)], op)
+
+
+def allgather(tensor, group_name: str = "default") -> List[np.ndarray]:
+    g = _group(group_name)
+    rnd = g._exchange(np.asarray(tensor))
+    return [np.asarray(rnd[r]) for r in sorted(rnd)]
+
+
+def broadcast(tensor, src_rank: int = 0,
+              group_name: str = "default") -> np.ndarray:
+    g = _group(group_name)
+    payload = np.asarray(tensor) if g.rank == src_rank else None
+    rnd = g._exchange(payload)
+    return np.asarray(rnd[src_rank])
+
+
+def reducescatter(tensor, group_name: str = "default",
+                  op: str = ReduceOp.SUM) -> np.ndarray:
+    """Reduce then return this rank's 1/world_size slice (dim 0)."""
+    g = _group(group_name)
+    rnd = g._exchange(np.asarray(tensor))
+    full = _reduce([rnd[r] for r in sorted(rnd)], op)
+    return np.array_split(full, g.world_size, axis=0)[g.rank]
+
+
+def barrier(group_name: str = "default") -> None:
+    _group(group_name)._exchange(None)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default") -> None:
+    g = _group(group_name)
+    key = (g.rank, dst_rank)
+    seq = g.p2p_seq.get(key, 0) + 1
+    g.p2p_seq[key] = seq
+    ray_tpu.get(g.coord.p2p_put.remote(seq, g.rank, dst_rank,
+                                       np.asarray(tensor)))
+
+
+def recv(src_rank: int, group_name: str = "default") -> np.ndarray:
+    g = _group(group_name)
+    key = (src_rank, g.rank)
+    seq = g.p2p_seq.get(key, 0) + 1
+    g.p2p_seq[key] = seq
+    while True:
+        got = ray_tpu.get(g.coord.p2p_take.remote(seq, src_rank, g.rank))
+        if got is not None:
+            return np.asarray(got[0])
+        time.sleep(_POLL_S)

@@ -1,0 +1,205 @@
+"""ParallelIterator: lazy sharded iterators over actors.
+
+API parity with the reference's ``ray.util.iter``
+(reference: python/ray/util/iter.py — ParallelIterator :118,
+from_items :30, from_range :54, from_iterators :77): each shard is a
+worker actor producing items; transformations (for_each/filter/batch/
+flatten) compose lazily per shard; ``gather_sync``/``gather_async``
+merge shards on the driver; ``union`` concatenates iterators.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+from typing import Any, Callable, Iterable, List
+
+import ray_tpu
+
+_SENTINEL = "__rtpu_iter_end__"
+
+
+class _ShardWorker:
+    def __init__(self, make_iter):
+        self._make = make_iter
+        self._ops: List = []
+        self._it = None
+
+    def reset(self, ops) -> None:
+        """Install this gather's op chain and restart the source.
+        Ops live on the ParallelIterator object (not the actor) so
+        transformations never mutate iterators sharing these shards."""
+        self._ops = list(ops)
+        self._it = None
+
+    def _build(self):
+        it = iter(self._make())
+        for op, fn in self._ops:
+            if op == "for_each":
+                it = map(fn, it)
+            elif op == "filter":
+                it = filter(fn, it)
+            elif op == "batch":
+                it = _batched(it, fn)
+            elif op == "flatten":
+                it = itertools.chain.from_iterable(it)
+        return it
+
+    def next_batch(self, n: int = 1):
+        """Pull up to n items; appends the sentinel when exhausted."""
+        if self._it is None:
+            self._it = self._build()
+        out = []
+        for _ in range(n):
+            try:
+                out.append(next(self._it))
+            except StopIteration:
+                out.append(_SENTINEL)
+                break
+        return out
+
+
+def _batched(it, n):
+    buf = []
+    for x in it:
+        buf.append(x)
+        if len(buf) >= n:
+            yield buf
+            buf = []
+    if buf:
+        yield buf
+
+
+class ParallelIterator:
+    """Transformations are LAZY and local to this object: each
+    for_each/filter/... returns a new iterator carrying the op chain;
+    the chain is shipped to the shard actors only when a gather starts
+    (so sibling iterators over the same shards stay independent —
+    concurrent gathers over shared shards are not supported)."""
+
+    def __init__(self, actors: List[Any], name: str = "iter",
+                 ops: List | None = None,
+                 per_actor_ops: List[List] | None = None):
+        self._actors = actors
+        self.name = name
+        # per_actor_ops[i] = ops baked in before a union; self._ops
+        # apply after (to every shard).
+        self._per_actor_ops = (per_actor_ops
+                               if per_actor_ops is not None
+                               else [[] for _ in actors])
+        self._ops = list(ops or [])
+
+    @property
+    def num_shards(self) -> int:
+        return len(self._actors)
+
+    def _apply(self, op: str, fn, name: str) -> "ParallelIterator":
+        return ParallelIterator(self._actors, f"{self.name}.{name}",
+                                ops=self._ops + [(op, fn)],
+                                per_actor_ops=self._per_actor_ops)
+
+    def for_each(self, fn: Callable) -> "ParallelIterator":
+        return self._apply("for_each", fn, "for_each()")
+
+    def filter(self, fn: Callable) -> "ParallelIterator":
+        return self._apply("filter", fn, "filter()")
+
+    def batch(self, n: int) -> "ParallelIterator":
+        return self._apply("batch", n, f"batch({n})")
+
+    def flatten(self) -> "ParallelIterator":
+        return self._apply("flatten", None, "flatten()")
+
+    def union(self, other: "ParallelIterator") -> "ParallelIterator":
+        return ParallelIterator(
+            self._actors + other._actors, f"{self.name}+{other.name}",
+            per_actor_ops=(
+                [po + self._ops for po in self._per_actor_ops]
+                + [po + other._ops for po in other._per_actor_ops]))
+
+    def _reset_all(self):
+        return ray_tpu.get([
+            a.reset.remote(self._per_actor_ops[i] + self._ops)
+            for i, a in enumerate(self._actors)])
+
+    def gather_sync(self, batch: int = 16):
+        """Round-robin over shards, in deterministic shard order."""
+        self._reset_all()
+        live = list(self._actors)
+        while live:
+            done = []
+            for a in live:
+                items = ray_tpu.get(a.next_batch.remote(batch))
+                for x in items:
+                    if isinstance(x, str) and x == _SENTINEL:
+                        done.append(a)
+                        break
+                    yield x
+            live = [a for a in live if a not in done]
+
+    def gather_async(self, batch: int = 16):
+        """Yield items from whichever shard returns first."""
+        self._reset_all()
+        pending = {a.next_batch.remote(batch): a for a in self._actors}
+        while pending:
+            ready, _ = ray_tpu.wait(list(pending), num_returns=1)
+            fut = ready[0]
+            a = pending.pop(fut)
+            items = ray_tpu.get(fut)
+            ended = False
+            for x in items:
+                if isinstance(x, str) and x == _SENTINEL:
+                    ended = True
+                    break
+                yield x
+            if not ended:
+                pending[a.next_batch.remote(batch)] = a
+
+    def take(self, n: int) -> List[Any]:
+        return list(itertools.islice(self.gather_sync(), n))
+
+    def __iter__(self):
+        return self.gather_sync()
+
+    def __repr__(self):
+        return f"ParallelIterator[{self.name}, shards={self.num_shards}]"
+
+
+def _make_shards(per_shard_factories, name) -> ParallelIterator:
+    worker = ray_tpu.remote(_ShardWorker).options(num_cpus=0)
+    actors = [worker.remote(f) for f in per_shard_factories]
+    return ParallelIterator(actors, name)
+
+
+# module-level factories: nested lambdas from an importable module don't
+# pickle by value; functools.partial over these does.
+def _iter_items(shard):
+    return iter(shard)
+
+
+def _iter_range(i, n, step):
+    return iter(range(i, n, step))
+
+
+def _iter_gen(g):
+    return iter(g() if callable(g) else g)
+
+
+def from_items(items: List[Any], num_shards: int = 2) -> ParallelIterator:
+    shards = [items[i::num_shards] for i in range(num_shards)]
+    return _make_shards(
+        [functools.partial(_iter_items, s) for s in shards],
+        f"from_items[{len(items)}]")
+
+
+def from_range(n: int, num_shards: int = 2) -> ParallelIterator:
+    return _make_shards(
+        [functools.partial(_iter_range, i, n, num_shards)
+         for i in range(num_shards)],
+        f"from_range[{n}]")
+
+
+def from_iterators(generators: List[Iterable],
+                   name: str = "from_iterators") -> ParallelIterator:
+    return _make_shards(
+        [functools.partial(_iter_gen, g) for g in generators], name)

@@ -1,0 +1,120 @@
+"""Distributed FIFO queue backed by a single actor.
+
+API parity with the reference's ``ray.util.queue.Queue``
+(reference: python/ray/util/queue.py): put/get with block+timeout,
+*_nowait, *_nowait_batch, qsize/empty/full, Empty/Full exceptions.
+The queue actor is polled rather than long-blocked so a sync actor
+suffices; poll interval 5 ms.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, List, Optional
+
+import ray_tpu
+
+_POLL_S = 0.005
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self.maxsize = maxsize
+        self.items: deque = deque()
+
+    def qsize(self) -> int:
+        return len(self.items)
+
+    def put(self, items: List[Any]) -> int:
+        """Append as many as fit; returns how many were accepted."""
+        accepted = 0
+        for it in items:
+            if self.maxsize > 0 and len(self.items) >= self.maxsize:
+                break
+            self.items.append(it)
+            accepted += 1
+        return accepted
+
+    def get(self, n: int = 1) -> List[Any]:
+        out = []
+        while self.items and len(out) < n:
+            out.append(self.items.popleft())
+        return out
+
+    def get_exact(self, n: int):
+        """All-or-nothing batch take (atomic server-side)."""
+        if len(self.items) < n:
+            return None
+        return [self.items.popleft() for _ in range(n)]
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
+        opts = dict(actor_options or {})
+        opts.setdefault("num_cpus", 0)
+        self.maxsize = maxsize
+        self.actor = ray_tpu.remote(_QueueActor).options(**opts).remote(
+            maxsize)
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self.actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def full(self) -> bool:
+        return self.maxsize > 0 and self.qsize() >= self.maxsize
+
+    def put(self, item: Any, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if ray_tpu.get(self.actor.put.remote([item])) == 1:
+                return
+            if not block:
+                raise Full
+            if deadline is not None and time.monotonic() >= deadline:
+                raise Full
+            time.sleep(_POLL_S)
+
+    def put_nowait(self, item: Any) -> None:
+        self.put(item, block=False)
+
+    def put_nowait_batch(self, items: List[Any]) -> None:
+        accepted = ray_tpu.get(self.actor.put.remote(list(items)))
+        if accepted != len(items):
+            raise Full(f"only {accepted}/{len(items)} items fit")
+
+    def get(self, block: bool = True,
+            timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            got = ray_tpu.get(self.actor.get.remote(1))
+            if got:
+                return got[0]
+            if not block:
+                raise Empty
+            if deadline is not None and time.monotonic() >= deadline:
+                raise Empty
+            time.sleep(_POLL_S)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def get_nowait_batch(self, num_items: int) -> List[Any]:
+        got = ray_tpu.get(self.actor.get_exact.remote(num_items))
+        if got is None:
+            raise Empty(f"queue has fewer than {num_items} items")
+        return got
+
+    def shutdown(self) -> None:
+        ray_tpu.kill(self.actor)

@@ -1,0 +1,215 @@
+"""Tests for ray_tpu.util: ActorPool, Queue, ParallelIterator,
+collective groups, and ray_tpu.train.
+
+Mirrors reference test coverage: python/ray/tests/test_actor_pool.py,
+test_queue.py, test_iter.py, util/collective/tests/,
+util/sgd/v2/tests/test_trainer.py.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool, Empty, ParallelIterator, Queue
+from ray_tpu.util import from_items, from_range
+
+
+@ray_tpu.remote
+class _PoolWorker:
+    def double(self, v):
+        return 2 * v
+
+
+def test_actor_pool_map_ordered(ray_start_4cpu):
+    pool = ActorPool([_PoolWorker.remote() for _ in range(2)])
+    got = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert got == [2 * i for i in range(8)]
+
+
+def test_actor_pool_map_unordered(ray_start_4cpu):
+    pool = ActorPool([_PoolWorker.remote() for _ in range(2)])
+    got = sorted(pool.map_unordered(
+        lambda a, v: a.double.remote(v), range(8)))
+    assert got == sorted(2 * i for i in range(8))
+
+
+def test_actor_pool_submit_get(ray_start_4cpu):
+    pool = ActorPool([_PoolWorker.remote()])
+    pool.submit(lambda a, v: a.double.remote(v), 3)
+    assert pool.has_next()
+    assert pool.get_next() == 6
+    assert not pool.has_next()
+    assert pool.pop_idle() is not None
+
+
+def test_queue_basic(ray_start_regular):
+    q = Queue(maxsize=3)
+    assert q.empty()
+    q.put(1)
+    q.put(2)
+    assert q.qsize() == 2
+    assert q.get() == 1
+    with pytest.raises(Empty):
+        Queue().get_nowait()
+    q.put_nowait_batch([7, 8])
+    assert q.get_nowait_batch(3) == [2, 7, 8]
+
+
+def test_queue_full(ray_start_regular):
+    from ray_tpu.util import Full
+
+    q = Queue(maxsize=1)
+    q.put("a")
+    assert q.full()
+    with pytest.raises(Full):
+        q.put_nowait("b")
+    with pytest.raises(Full):
+        q.put("b", timeout=0.05)
+
+
+def test_parallel_iterator_sync(ray_start_4cpu):
+    it = from_items(list(range(10)), num_shards=2)
+    out = sorted(it.for_each(lambda x: x * 10).gather_sync())
+    assert out == [x * 10 for x in range(10)]
+
+
+def test_parallel_iterator_chain(ray_start_4cpu):
+    it = (from_range(12, num_shards=3)
+          .filter(lambda x: x % 2 == 0)
+          .batch(2))
+    batches = list(it.gather_sync())
+    flat = sorted(x for b in batches for x in b)
+    assert flat == [0, 2, 4, 6, 8, 10]
+    assert all(len(b) <= 2 for b in batches)
+
+
+def test_parallel_iterator_transforms_are_local(ray_start_4cpu):
+    """for_each on a derived iterator must not corrupt the source."""
+    it = from_items([1, 2, 3, 4], num_shards=2)
+    it2 = it.for_each(lambda x: x * 10)
+    assert sorted(it2.gather_sync()) == [10, 20, 30, 40]
+    assert sorted(it.gather_sync()) == [1, 2, 3, 4]
+
+
+def test_parallel_iterator_async_and_union(ray_start_4cpu):
+    a = from_items([1, 2], num_shards=1)
+    b = from_items([3, 4], num_shards=1)
+    out = sorted(a.union(b).gather_async())
+    assert out == [1, 2, 3, 4]
+
+
+def test_collective_group(ray_start_4cpu):
+    from ray_tpu.util import collective  # noqa: F401
+
+    @ray_tpu.remote
+    class Rank:
+        def __init__(self, rank, world):
+            from ray_tpu.util import collective as col
+            col.init_collective_group(world, rank, group_name="g1")
+            self.rank = rank
+
+        def do_allreduce(self):
+            from ray_tpu.util import collective as col
+            return col.allreduce(np.ones(4) * (self.rank + 1),
+                                 group_name="g1")
+
+        def do_allgather(self):
+            from ray_tpu.util import collective as col
+            return col.allgather(np.array([self.rank]), group_name="g1")
+
+        def do_broadcast(self):
+            from ray_tpu.util import collective as col
+            return col.broadcast(np.array([42.0 + self.rank]),
+                                 src_rank=1, group_name="g1")
+
+        def do_reducescatter(self):
+            from ray_tpu.util import collective as col
+            return col.reducescatter(np.arange(4.0), group_name="g1")
+
+    world = 2
+    actors = [Rank.remote(r, world) for r in range(world)]
+    res = ray_tpu.get([a.do_allreduce.remote() for a in actors])
+    np.testing.assert_allclose(res[0], np.ones(4) * 3)
+    np.testing.assert_allclose(res[1], np.ones(4) * 3)
+
+    res = ray_tpu.get([a.do_allgather.remote() for a in actors])
+    assert [int(x[0]) for x in res[0]] == [0, 1]
+
+    res = ray_tpu.get([a.do_broadcast.remote() for a in actors])
+    assert float(res[0][0]) == 43.0 and float(res[1][0]) == 43.0
+
+    res = ray_tpu.get([a.do_reducescatter.remote() for a in actors])
+    np.testing.assert_allclose(np.concatenate(res), np.arange(4.0) * 2)
+
+
+def test_collective_send_recv(ray_start_4cpu):
+    @ray_tpu.remote
+    class Rank:
+        def __init__(self, rank, world):
+            from ray_tpu.util import collective as col
+            col.init_collective_group(world, rank, group_name="g2")
+            self.rank = rank
+
+        def sender(self):
+            from ray_tpu.util import collective as col
+            col.send(np.array([123.0]), dst_rank=1, group_name="g2")
+            return True
+
+        def receiver(self):
+            from ray_tpu.util import collective as col
+            return col.recv(src_rank=0, group_name="g2")
+
+    a0, a1 = Rank.remote(0, 2), Rank.remote(1, 2)
+    r = a1.receiver.remote()
+    ray_tpu.get(a0.sender.remote())
+    assert float(ray_tpu.get(r)[0]) == 123.0
+
+
+def test_trainer_reports_and_allreduce(ray_start_4cpu):
+    from ray_tpu import train
+
+    def train_func(config):
+        from ray_tpu import train as t
+        from ray_tpu.util import collective as col
+        rank = t.world_rank()
+        for step in range(2):
+            g = np.ones(3) * (rank + 1)
+            if t.world_size() > 1:
+                g = col.allreduce(g, group_name=t.collective_group_name())
+            t.report(step=step, gsum=float(g.sum()))
+        return rank
+
+    collected = []
+
+    class Cb(train.TrainingCallback):
+        def handle_result(self, batch, **info):
+            collected.append(batch)
+
+    trainer = train.Trainer(num_workers=2)
+    results = trainer.run(train_func, config={}, callbacks=[Cb()])
+    trainer.shutdown()
+    assert sorted(results) == [0, 1]
+    assert len(collected) == 2
+    # allreduce of (1+2)*ones(3) → gsum 9 on both ranks
+    assert all(m["gsum"] == 9.0 for batch in collected for m in batch)
+
+
+def test_trainer_checkpoint(ray_start_4cpu, tmp_path):
+    from ray_tpu import train
+
+    def train_func(config):
+        from ray_tpu import train as t
+        ck = t.load_checkpoint()
+        start = ck["step"] + 1 if ck else 0
+        t.save_checkpoint(step=start + 1)
+        return start
+
+    trainer = train.Trainer(num_workers=1,
+                            checkpoint_dir=str(tmp_path))
+    first = trainer.run(train_func, config={})
+    trainer.shutdown()
+    trainer = train.Trainer(num_workers=1,
+                            checkpoint_dir=str(tmp_path))
+    second = trainer.run(train_func, config={})
+    trainer.shutdown()
+    assert first == [0] and second == [2]
