@@ -1,0 +1,23 @@
+"""Distributed datasets over the object store.
+
+Parity target: the reference's ``ray.data`` (reference:
+python/ray/data/dataset.py — Dataset :49, map_batches :131,
+repartition :305, sort :612; impl/shuffle.py simple_shuffle :16).
+Blocks are ObjectRefs to plain lists (rows) or numpy struct-dicts;
+``to_jax``/``iter_batches`` feed device-ready arrays.
+"""
+
+from ray_tpu.data.dataset import Dataset  # noqa: F401
+from ray_tpu.data.pipeline import DatasetPipeline  # noqa: F401
+from ray_tpu.data.read_api import (  # noqa: F401
+    from_items,
+    from_numpy,
+    range as range_,  # "range" shadows the builtin; exported as both
+    read_binary_files,
+    read_csv,
+    read_json,
+    read_numpy,
+    read_text,
+)
+
+range = range_  # noqa: A001 - mirror ray.data.range

@@ -1,0 +1,323 @@
+"""Dataset: a list of block ObjectRefs + per-block task transforms.
+
+Reference: python/ray/data/dataset.py (Dataset :49). Each transform
+launches one task per block; blocks stay in the object store between
+stages (zero-copy for numpy payloads via the shm plane).
+"""
+
+from __future__ import annotations
+
+import builtins
+import functools
+import random
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+# ---- block-level helpers (run inside tasks; module-level = picklable) --
+
+
+def _block_map(fn, block):
+    return [fn(r) for r in block]
+
+
+def _block_map_batches(fn, block, fmt):
+    if fmt == "numpy":
+        batch = np.array(block)
+    else:
+        batch = block
+    out = fn(batch)
+    if isinstance(out, np.ndarray):
+        return list(out)
+    return list(out)
+
+
+def _block_filter(fn, block):
+    return [r for r in block if fn(r)]
+
+
+def _block_flat_map(fn, block):
+    out = []
+    for r in block:
+        out.extend(fn(r))
+    return out
+
+
+def _block_sort(block, key, descending):
+    return sorted(block, key=key, reverse=descending)
+
+
+def _block_partition(block, boundaries, key):
+    """Range-partition a sorted-input block for distributed sort."""
+    parts: List[List] = [[] for _ in range(len(boundaries) + 1)]
+    for r in block:
+        k = key(r) if key else r
+        lo = 0
+        for i, b in enumerate(boundaries):
+            if k < b:
+                break
+            lo = i + 1
+        parts[lo].append(r)
+    return parts
+
+
+def _block_shuffle_split(block, n, seed):
+    rng = random.Random(seed)
+    parts: List[List] = [[] for _ in range(n)]
+    for r in block:
+        parts[rng.randrange(n)].append(r)
+    return parts
+
+
+def _block_shuffle(block, seed):
+    block = list(block)
+    random.Random(seed).shuffle(block)
+    return block
+
+
+def _merge_blocks(*parts):
+    out = []
+    for p in parts:
+        out.extend(p)
+    return out
+
+
+def _merge_sorted(key, descending, *parts):
+    return sorted(_merge_blocks(*parts),
+                  key=key, reverse=descending)
+
+
+def _block_len(block):
+    return len(block)
+
+
+def _block_agg(agg, on, block):
+    vals = [on(r) if on else r for r in block]
+    if not vals:
+        return None
+    if agg == "sum":
+        return builtins.sum(vals)
+    if agg == "min":
+        return builtins.min(vals)
+    if agg == "max":
+        return builtins.max(vals)
+    raise ValueError(agg)
+
+
+_remote_cache: dict = {}
+
+
+def _remote(fn, num_returns=1):
+    key = (fn, num_returns)
+    if key not in _remote_cache:
+        _remote_cache[key] = ray_tpu.remote(fn).options(
+            num_returns=num_returns)
+    return _remote_cache[key]
+
+
+class Dataset:
+    def __init__(self, blocks: List):
+        self._blocks = list(blocks)
+
+    # ------------------------------------------------------------ meta
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self._blocks)
+
+    def count(self) -> int:
+        return builtins.sum(
+            ray_tpu.get([_remote(_block_len).remote(b)
+                         for b in self._blocks]))
+
+    def __repr__(self):
+        return f"Dataset(num_blocks={self.num_blocks})"
+
+    # ------------------------------------------------------ transforms
+
+    def map(self, fn: Callable) -> "Dataset":
+        r = _remote(_block_map)
+        return Dataset([r.remote(fn, b) for b in self._blocks])
+
+    def map_batches(self, fn: Callable,
+                    batch_format: str = "native") -> "Dataset":
+        r = _remote(_block_map_batches)
+        return Dataset([r.remote(fn, b, batch_format)
+                        for b in self._blocks])
+
+    def filter(self, fn: Callable) -> "Dataset":
+        r = _remote(_block_filter)
+        return Dataset([r.remote(fn, b) for b in self._blocks])
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        r = _remote(_block_flat_map)
+        return Dataset([r.remote(fn, b) for b in self._blocks])
+
+    # ------------------------------------------------- reorganization
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        """Rebalance into num_blocks blocks (full rebuild, like the
+        reference's shuffle=True path)."""
+        rows = self.take_all()
+        step, rem = divmod(len(rows), num_blocks)
+        blocks, i = [], 0
+        for b in range(num_blocks):
+            n = step + (1 if b < rem else 0)
+            blocks.append(ray_tpu.put(rows[i:i + n]))
+            i += n
+        return Dataset(blocks)
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        """Distributed 2-stage shuffle (reference: simple_shuffle,
+        data/impl/shuffle.py:16): map splits each block into N random
+        partitions; reduce merges partition j of every block."""
+        n = max(1, self.num_blocks)
+        seed = seed if seed is not None else random.randrange(2 ** 31)
+        if n == 1:
+            r = _remote(_block_shuffle)
+            return Dataset([r.remote(b, seed) for b in self._blocks])
+        split = _remote(_block_shuffle_split, num_returns=n)
+        parts = [split.remote(b, n, seed + i)
+                 for i, b in enumerate(self._blocks)]
+        merge = _remote(_merge_blocks)
+        shuf = _remote(_block_shuffle)
+        out = [shuf.remote(
+                   merge.remote(*[parts[i][j]
+                                  for i in range(len(parts))]),
+                   seed + 7919 * j)
+               for j in range(n)]
+        return Dataset(out)
+
+    def sort(self, key: Optional[Callable] = None,
+             descending: bool = False) -> "Dataset":
+        """Distributed range-partitioned sort (reference:
+        data/impl/sort.py): sample boundaries, partition each block,
+        merge-sort each range."""
+        n = max(1, self.num_blocks)
+        if n == 1:
+            r = _remote(_block_sort)
+            return Dataset([r.remote(self._blocks[0], key, descending)])
+        # sample boundaries from the data
+        sample = self.take(min(1000, self.count()))
+        keys = sorted((key(r) if key else r) for r in sample)
+        boundaries = [keys[min(len(keys) - 1,
+                               int(len(keys) * (i + 1) / n))]
+                      for i in range(n - 1)] if keys else []
+        part = _remote(_block_partition, num_returns=n)
+        parts = [part.remote(b, boundaries, key) for b in self._blocks]
+        merge = _remote(functools.partial(_merge_sorted, key, descending))
+        out = [merge.remote(*[parts[i][j] for i in range(len(parts))])
+               for j in range(n)]
+        if descending:
+            out = out[::-1]
+        return Dataset(out)
+
+    def split(self, n: int) -> List["Dataset"]:
+        """Split into n datasets by whole blocks (repartitions first if
+        fewer blocks than splits)."""
+        ds = self if self.num_blocks >= n else self.repartition(n)
+        shards: List[List] = [[] for _ in range(n)]
+        for i, b in enumerate(ds._blocks):
+            shards[i % n].append(b)
+        return [Dataset(s) for s in shards]
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        blocks = list(self._blocks)
+        for o in others:
+            blocks.extend(o._blocks)
+        return Dataset(blocks)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        def _zip_blocks(a, b):
+            return list(zip(a, b))
+        if self.num_blocks != other.num_blocks:
+            raise ValueError("zip requires equal block counts")
+        r = _remote(_zip_blocks)
+        return Dataset([r.remote(a, b)
+                        for a, b in zip(self._blocks, other._blocks)])
+
+    # ---------------------------------------------------- consumption
+
+    def take(self, n: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for b in self._blocks:
+            out.extend(ray_tpu.get(b))
+            if len(out) >= n:
+                return out[:n]
+        return out
+
+    def take_all(self) -> List[Any]:
+        out: List[Any] = []
+        for block in ray_tpu.get(list(self._blocks)):
+            out.extend(block)
+        return out
+
+    def show(self, n: int = 20) -> None:
+        for r in self.take(n):
+            print(r)
+
+    def sum(self, on: Optional[Callable] = None):
+        vals = [v for v in ray_tpu.get(
+            [_remote(_block_agg).remote("sum", on, b)
+             for b in self._blocks]) if v is not None]
+        return builtins.sum(vals) if vals else 0
+
+    def min(self, on: Optional[Callable] = None):
+        vals = [v for v in ray_tpu.get(
+            [_remote(_block_agg).remote("min", on, b)
+             for b in self._blocks]) if v is not None]
+        return builtins.min(vals)
+
+    def max(self, on: Optional[Callable] = None):
+        vals = [v for v in ray_tpu.get(
+            [_remote(_block_agg).remote("max", on, b)
+             for b in self._blocks]) if v is not None]
+        return builtins.max(vals)
+
+    def mean(self, on: Optional[Callable] = None):
+        return self.sum(on) / max(1, self.count())
+
+    def iter_rows(self):
+        for b in self._blocks:
+            yield from ray_tpu.get(b)
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "native"):
+        buf: List[Any] = []
+        for b in self._blocks:
+            buf.extend(ray_tpu.get(b))
+            while len(buf) >= batch_size:
+                batch, buf = buf[:batch_size], buf[batch_size:]
+                yield (np.array(batch) if batch_format == "numpy"
+                       else batch)
+        if buf:
+            yield np.array(buf) if batch_format == "numpy" else buf
+
+    def to_numpy(self) -> np.ndarray:
+        return np.array(self.take_all())
+
+    def to_jax(self, *, batch_size: Optional[int] = None):
+        """Device-ready arrays: the whole dataset (batch_size=None) or
+        an iterator of jnp batches."""
+        import jax.numpy as jnp
+
+        if batch_size is None:
+            return jnp.asarray(self.to_numpy())
+        return (jnp.asarray(b) for b in self.iter_batches(
+            batch_size=batch_size, batch_format="numpy"))
+
+    # ------------------------------------------------------- pipeline
+
+    def window(self, *, blocks_per_window: int = 2):
+        from ray_tpu.data.pipeline import DatasetPipeline
+
+        windows = [Dataset(self._blocks[i:i + blocks_per_window])
+                   for i in range(0, self.num_blocks, blocks_per_window)]
+        return DatasetPipeline(windows)
+
+    def repeat(self, times: int):
+        from ray_tpu.data.pipeline import DatasetPipeline
+
+        return DatasetPipeline([self] * times)

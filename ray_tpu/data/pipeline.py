@@ -1,0 +1,66 @@
+"""DatasetPipeline: windowed, lazily-executed dataset sequences.
+
+Reference: python/ray/data/dataset_pipeline.py — a pipeline is a
+sequence of Datasets (windows); transforms apply per window as it is
+consumed, overlapping stage execution with consumption.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List
+
+
+class DatasetPipeline:
+    def __init__(self, windows: List):
+        self._windows = list(windows)
+        self._stages: List[Callable] = []
+
+    def _apply(self, stage: Callable) -> "DatasetPipeline":
+        p = DatasetPipeline(self._windows)
+        p._stages = self._stages + [stage]
+        return p
+
+    def map(self, fn):
+        return self._apply(lambda ds: ds.map(fn))
+
+    def map_batches(self, fn, batch_format: str = "native"):
+        return self._apply(lambda ds: ds.map_batches(fn, batch_format))
+
+    def filter(self, fn):
+        return self._apply(lambda ds: ds.filter(fn))
+
+    def random_shuffle_each_window(self, *, seed=None):
+        return self._apply(lambda ds: ds.random_shuffle(seed=seed))
+
+    def repeat(self, times: int) -> "DatasetPipeline":
+        p = DatasetPipeline(self._windows * times)
+        p._stages = list(self._stages)
+        return p
+
+    def iter_datasets(self) -> Iterator:
+        for w in self._windows:
+            ds = w
+            for stage in self._stages:
+                ds = stage(ds)
+            yield ds
+
+    def iter_rows(self):
+        for ds in self.iter_datasets():
+            yield from ds.iter_rows()
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "native"):
+        for ds in self.iter_datasets():
+            yield from ds.iter_batches(batch_size=batch_size,
+                                       batch_format=batch_format)
+
+    def take(self, n: int = 20):
+        out = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def count(self) -> int:
+        return sum(ds.count() for ds in self.iter_datasets())

@@ -1,0 +1,117 @@
+"""Dataset creation APIs.
+
+Reference: python/ray/data/read_api.py (from_items, range, read_csv,
+read_json, read_numpy, read_binary_files) + data/datasource/. Reads
+are tasks: one per file (or per range shard), so loading scales with
+the cluster.
+"""
+
+from __future__ import annotations
+
+import builtins
+import csv as _csv
+import functools
+import glob as _glob
+import json as _json
+from typing import Any, List, Optional, Union
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.dataset import Dataset, _remote
+
+
+def _expand(paths: Union[str, List[str]]) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        hits = sorted(_glob.glob(p))
+        out.extend(hits if hits else [p])
+    return out
+
+
+def from_items(items: List[Any], parallelism: int = 8) -> Dataset:
+    n = max(1, min(parallelism, len(items) or 1))
+    step, rem = divmod(len(items), n)
+    blocks, i = [], 0
+    for b in builtins.range(n):  # module defines its own range()
+        cnt = step + (1 if b < rem else 0)
+        blocks.append(ray_tpu.put(items[i:i + cnt]))
+        i += cnt
+    return Dataset(blocks)
+
+
+def _gen_range(start, stop):
+    return list(builtins.range(start, stop))
+
+
+def range(n: int, parallelism: int = 8) -> Dataset:  # noqa: A001
+    k = max(1, min(parallelism, n or 1))
+    step, rem = divmod(n, k)
+    blocks, i = [], 0
+    r = _remote(_gen_range)
+    for b in builtins.range(k):
+        cnt = step + (1 if b < rem else 0)
+        blocks.append(r.remote(i, i + cnt))
+        i += cnt
+    return Dataset(blocks)
+
+
+def from_numpy(arr: np.ndarray, parallelism: int = 8) -> Dataset:
+    return from_items(list(arr), parallelism)
+
+
+# per-file readers (module-level for pickling)
+
+def _read_csv_file(path):
+    with open(path, newline="") as f:
+        return list(_csv.DictReader(f))
+
+
+def _read_json_file(path):
+    with open(path) as f:
+        first = f.read(1)
+        f.seek(0)
+        if first == "[":
+            return _json.load(f)
+        return [_json.loads(line) for line in f if line.strip()]
+
+
+def _read_numpy_file(path):
+    return list(np.load(path))
+
+
+def _read_text_file(path):
+    with open(path) as f:
+        return [line.rstrip("\n") for line in f]
+
+
+def _read_binary_file(path):
+    with open(path, "rb") as f:
+        return [f.read()]
+
+
+def _read(paths, reader) -> Dataset:
+    r = _remote(reader)
+    return Dataset([r.remote(p) for p in _expand(paths)])
+
+
+def read_csv(paths) -> Dataset:
+    return _read(paths, _read_csv_file)
+
+
+def read_json(paths) -> Dataset:
+    return _read(paths, _read_json_file)
+
+
+def read_numpy(paths) -> Dataset:
+    return _read(paths, _read_numpy_file)
+
+
+def read_text(paths) -> Dataset:
+    return _read(paths, _read_text_file)
+
+
+def read_binary_files(paths) -> Dataset:
+    return _read(paths, _read_binary_file)

@@ -21,7 +21,11 @@ class RemoteFunction:
                  placement_group=None, placement_group_bundle_index=-1,
                  name=None):
         self._function = fn
-        self._name = name or getattr(fn, "__qualname__", fn.__name__)
+        self._name = (name or getattr(fn, "__qualname__", None)
+                      or getattr(fn, "__name__", None)
+                      or getattr(getattr(fn, "func", None), "__qualname__",
+                                 None)  # functools.partial
+                      or type(fn).__name__)
         self._num_returns = num_returns
         self._num_cpus = num_cpus
         self._num_tpus = num_tpus

@@ -1,0 +1,26 @@
+"""Hyperparameter tuning over trial actors.
+
+Parity target: the reference's Tune (reference: python/ray/tune/ —
+TrialRunner trial_runner.py:147, Trial trial.py:187, RayTrialExecutor
+ray_trial_executor.py:149, schedulers/, suggest/). Trials run as
+actors; the driver loop polls results, consults the scheduler
+(ASHA/HyperBand/PBT/median) and searcher (grid/random), and
+checkpoints to the experiment dir.
+"""
+
+from ray_tpu.tune.tune import run  # noqa: F401
+from ray_tpu.tune.trial import Trial  # noqa: F401
+from ray_tpu.tune.sample import (  # noqa: F401
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
+from ray_tpu.tune.schedulers import (  # noqa: F401
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+)
+from ray_tpu.tune.result import ExperimentAnalysis  # noqa: F401

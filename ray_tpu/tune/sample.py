@@ -1,0 +1,98 @@
+"""Search space primitives (reference: python/ray/tune/sample.py)."""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List
+
+
+class Domain:
+    def sample(self, rng: random.Random):
+        raise NotImplementedError
+
+
+class Uniform(Domain):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(Domain):
+    def __init__(self, low, high):
+        import math
+        self._lo, self._hi = math.log(low), math.log(high)
+
+    def sample(self, rng):
+        import math
+        return math.exp(rng.uniform(self._lo, self._hi))
+
+
+class RandInt(Domain):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class Choice(Domain):
+    def __init__(self, categories):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class GridSearch:
+    def __init__(self, values):
+        self.values = list(values)
+
+
+def uniform(low, high) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low, high) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low, high) -> RandInt:
+    return RandInt(low, high)
+
+
+def choice(categories) -> Choice:
+    return Choice(categories)
+
+
+def grid_search(values) -> GridSearch:
+    return GridSearch(values)
+
+
+def generate_configs(space: Dict[str, Any], num_samples: int,
+                     seed: int | None = None) -> List[Dict[str, Any]]:
+    """Expand grid axes (cartesian) × num_samples draws of the random
+    axes (reference: suggest/variant_generator.py)."""
+    import itertools
+
+    rng = random.Random(seed)
+    grid_keys = [k for k, v in space.items()
+                 if isinstance(v, GridSearch)]
+    grid_values = [space[k].values for k in grid_keys]
+    combos = list(itertools.product(*grid_values)) if grid_keys else [()]
+    configs = []
+    for _ in range(num_samples):
+        for combo in combos:
+            cfg = {}
+            for k, v in space.items():
+                if isinstance(v, GridSearch):
+                    cfg[k] = combo[grid_keys.index(k)]
+                elif isinstance(v, Domain):
+                    cfg[k] = v.sample(rng)
+                elif callable(v):
+                    cfg[k] = v()
+                else:
+                    cfg[k] = v
+            configs.append(cfg)
+    return configs

@@ -1,0 +1,150 @@
+"""Trial state + the trial-hosting actor.
+
+Reference: python/ray/tune/trial.py (Trial :187) and
+tune/function_runner.py: a trainable is either a function
+``f(config)`` that calls ``tune.report(**metrics)`` (possibly many
+times) or a class with setup/step/save/load. Function trainables run
+stepwise here too: the actor runs the function on a thread and parks
+each report until the driver asks for the next result.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import pickle
+import queue as _q
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import ray_tpu
+
+# trial status (reference: trial.py Trial.PENDING/RUNNING/...)
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+PAUSED = "PAUSED"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+_DONE = "__trial_done__"
+
+# set inside trial actors while the trainable runs (tune.report target)
+_report_queue: Optional[_q.Queue] = None
+
+
+def report(**metrics):
+    """Called by function trainables to emit an intermediate result."""
+    if _report_queue is not None:
+        _report_queue.put(metrics)
+
+
+class _TrialActor:
+    """Hosts one trainable; driver polls ``next_result``."""
+
+    def __init__(self, trainable: Callable, config: Dict[str, Any]):
+        global _report_queue
+        self._config = config
+        self._queue: _q.Queue = _q.Queue()
+        self._step_iter = None
+        self._error: Optional[BaseException] = None
+        if isinstance(trainable, type):
+            # class API: setup/step/save/load
+            self._instance = trainable()
+            if hasattr(self._instance, "setup"):
+                self._instance.setup(config)
+        else:
+            self._instance = None
+            _report_queue = self._queue
+
+            def _run():
+                global _report_queue
+                try:
+                    out = trainable(config)
+                    if isinstance(out, dict):
+                        self._queue.put(out)
+                except BaseException as e:  # noqa: BLE001
+                    self._error = e
+                finally:
+                    self._queue.put(_DONE)
+
+            self._thread = threading.Thread(target=_run, daemon=True)
+            self._thread.start()
+
+    def next_result(self, timeout: float = 30.0):
+        """One (metrics, done) pair; class API steps synchronously."""
+        if self._instance is not None:
+            metrics = self._instance.step()
+            done = bool(metrics.get("done", False))
+            return metrics, done
+        item = self._queue.get(timeout=timeout)
+        if isinstance(item, str) and item == _DONE:
+            if self._error is not None:
+                raise self._error
+            return None, True
+        return item, False
+
+    def save_checkpoint(self, path: str):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        if self._instance is not None and hasattr(self._instance, "save"):
+            self._instance.save(path)
+        return path
+
+    def restore_checkpoint(self, path: str):
+        if self._instance is not None and hasattr(self._instance, "load"):
+            self._instance.load(path)
+
+    def get_config(self):
+        return self._config
+
+    def stop(self):
+        if self._instance is not None and \
+                hasattr(self._instance, "cleanup"):
+            self._instance.cleanup()
+        return True
+
+
+class Trial:
+    _ids = itertools.count()
+
+    def __init__(self, trainable, config: Dict[str, Any],
+                 experiment_dir: str = ""):
+        self.trial_id = f"trial_{next(Trial._ids):05d}"
+        self.trainable = trainable
+        self.config = config
+        self.status = PENDING
+        self.actor = None
+        self.pending_result = None
+        self.last_result: Dict[str, Any] = {}
+        self.results: list = []
+        self.iteration = 0
+        self.error: Optional[str] = None
+        self.experiment_dir = experiment_dir
+
+    def start(self, resources: Optional[dict] = None):
+        opts = dict(resources or {})
+        cls = ray_tpu.remote(_TrialActor)
+        if opts:
+            cls = cls.options(**opts)
+        self.actor = cls.remote(self.trainable, self.config)
+        self.status = RUNNING
+
+    def fetch_next(self):
+        self.pending_result = self.actor.next_result.remote()
+        return self.pending_result
+
+    def stop(self, status: str = TERMINATED):
+        if self.actor is not None:
+            try:
+                ray_tpu.kill(self.actor)
+            except Exception:  # noqa: BLE001
+                pass
+            self.actor = None
+        self.status = status
+
+    def checkpoint_path(self) -> str:
+        return os.path.join(self.experiment_dir, self.trial_id,
+                            f"checkpoint_{self.iteration:06d}")
+
+    def __repr__(self):
+        return (f"Trial({self.trial_id}, {self.status}, "
+                f"it={self.iteration})")

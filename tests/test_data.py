@@ -1,0 +1,117 @@
+"""Tests for ray_tpu.data (reference: python/ray/data/tests)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+def test_from_items_count_take(ray_start_4cpu):
+    ds = rd.from_items(list(range(25)), parallelism=4)
+    assert ds.num_blocks == 4
+    assert ds.count() == 25
+    assert ds.take(5) == [0, 1, 2, 3, 4]
+    assert ds.take_all() == list(range(25))
+
+
+def test_range_map_filter(ray_start_4cpu):
+    ds = rd.range(20, parallelism=3)
+    out = ds.map(lambda x: x * 2).filter(lambda x: x % 4 == 0)
+    assert sorted(out.take_all()) == [x * 2 for x in range(20)
+                                      if (x * 2) % 4 == 0]
+
+
+def test_map_batches_and_flat_map(ray_start_4cpu):
+    ds = rd.range(8, parallelism=2)
+    doubled = ds.map_batches(lambda b: [x * 10 for x in b])
+    assert sorted(doubled.take_all()) == [x * 10 for x in range(8)]
+    dup = ds.flat_map(lambda x: [x, x])
+    assert dup.count() == 16
+
+
+def test_aggregates(ray_start_4cpu):
+    ds = rd.range(10, parallelism=3)
+    assert ds.sum() == 45
+    assert ds.min() == 0
+    assert ds.max() == 9
+    assert ds.mean() == 4.5
+
+
+def test_repartition_split_union(ray_start_4cpu):
+    ds = rd.range(12, parallelism=2).repartition(4)
+    assert ds.num_blocks == 4
+    assert sorted(ds.take_all()) == list(range(12))
+    shards = ds.split(2)
+    assert len(shards) == 2
+    got = sorted(shards[0].take_all() + shards[1].take_all())
+    assert got == list(range(12))
+    u = shards[0].union(shards[1])
+    assert sorted(u.take_all()) == list(range(12))
+
+
+def test_random_shuffle(ray_start_4cpu):
+    ds = rd.range(50, parallelism=4)
+    sh = ds.random_shuffle(seed=7)
+    got = sh.take_all()
+    assert sorted(got) == list(range(50))
+    assert got != list(range(50))  # astronomically unlikely to be sorted
+
+
+def test_sort(ray_start_4cpu):
+    import random as pyrandom
+
+    vals = list(range(40))
+    pyrandom.Random(3).shuffle(vals)
+    ds = rd.from_items(vals, parallelism=4).sort()
+    assert ds.take_all() == sorted(vals)
+    desc = rd.from_items(vals, parallelism=3).sort(descending=True)
+    assert desc.take_all() == sorted(vals, reverse=True)
+    keyed = rd.from_items(vals, parallelism=3).sort(key=lambda x: -x)
+    assert keyed.take_all() == sorted(vals, reverse=True)
+
+
+def test_zip_and_iter_batches(ray_start_4cpu):
+    a = rd.range(6, parallelism=2)
+    b = a.map(lambda x: x * x)
+    z = a.zip(b)
+    assert z.take_all() == [(i, i * i) for i in range(6)]
+    batches = list(a.iter_batches(batch_size=4, batch_format="numpy"))
+    assert all(isinstance(x, np.ndarray) for x in batches)
+    assert sum(len(x) for x in batches) == 6
+
+
+def test_to_jax(ray_start_4cpu):
+    ds = rd.from_items([1.0, 2.0, 3.0], parallelism=2)
+    arr = ds.to_jax()
+    assert float(arr.sum()) == 6.0
+
+
+def test_read_csv_json_text(ray_start_4cpu, tmp_path):
+    csvp = tmp_path / "a.csv"
+    csvp.write_text("x,y\n1,2\n3,4\n")
+    ds = rd.read_csv(str(csvp))
+    assert ds.take_all() == [{"x": "1", "y": "2"}, {"x": "3", "y": "4"}]
+
+    jsonp = tmp_path / "b.jsonl"
+    jsonp.write_text('{"v": 1}\n{"v": 2}\n')
+    assert rd.read_json(str(jsonp)).take_all() == [{"v": 1}, {"v": 2}]
+
+    txtp = tmp_path / "c.txt"
+    txtp.write_text("hello\nworld\n")
+    assert rd.read_text(str(txtp)).take_all() == ["hello", "world"]
+
+
+def test_read_numpy(ray_start_4cpu, tmp_path):
+    p = tmp_path / "arr.npy"
+    np.save(p, np.arange(5))
+    ds = rd.read_numpy(str(p))
+    assert [int(x) for x in ds.take_all()] == [0, 1, 2, 3, 4]
+
+
+def test_pipeline_window_repeat(ray_start_4cpu):
+    ds = rd.range(8, parallelism=4)
+    pipe = ds.window(blocks_per_window=2).map(lambda x: x + 100)
+    assert sorted(pipe.take(100)) == [x + 100 for x in range(8)]
+    rep = ds.repeat(2)
+    assert rep.count() == 16
