@@ -37,7 +37,10 @@ def timeit(fn, warmup=1, repeat=3):
 def main():
     import ray_tpu
 
-    ray_tpu.init(num_cpus=max(4, (os.cpu_count() or 4) // 2))
+    # Size the worker pool to the machine like the reference harness does
+    # (ray_perf.py runs on all cores); on a small box extra worker
+    # processes only add context-switch thrash.
+    ray_tpu.init(num_cpus=max(1, os.cpu_count() or 1))
 
     @ray_tpu.remote
     def small_task():

@@ -25,6 +25,7 @@ import logging
 import os
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu import exceptions as exc
@@ -86,7 +87,7 @@ class SchedulingKeyState:
     __slots__ = ("queue", "workers", "pending_lease", "resources")
 
     def __init__(self, resources):
-        self.queue: List[TaskSpec] = []
+        self.queue: deque[TaskSpec] = deque()
         self.workers: List[LeasedWorker] = []
         self.pending_lease = 0
         self.resources = resources
@@ -106,7 +107,8 @@ class ActorQueueState:
         self.conn: Optional[rpc.Connection] = None
         self.address = ""
         self.state = "UNRESOLVED"
-        self.buffer: List[Tuple[TaskSpec, int]] = []   # (spec, seqno) awaiting send
+        # (spec, seqno) awaiting send
+        self.buffer: deque[Tuple[TaskSpec, int]] = deque()
         self.inflight: Dict[int, Tuple[TaskSpec, int]] = {}  # seqno -> (spec, retries)
         self.resolving = False
         self.incarnation = -1
@@ -160,6 +162,12 @@ class CoreWorker:
         self.function_manager = FunctionManager(self._kv_put_sync, self._kv_get_sync)
         self._task_counter = itertools.count(1)
         self._put_counter = itertools.count(1)
+        # Submission batching: the caller thread appends specs here and
+        # schedules ONE loop wakeup per burst instead of one
+        # run_coroutine_threadsafe per task (the round-1 hot-path cost).
+        self._submit_buffer: deque = deque()
+        self._submit_lock = threading.Lock()
+        self._submit_scheduled = False
         self._current_task_id: bytes = b""
         self._shutdown = False
         self.task_executor = None   # set in worker mode by worker_main
@@ -411,7 +419,15 @@ class CoreWorker:
         serialized = self.serialization_context.serialize(value)
         oid = self._next_put_id()
         self.stats["puts"] += 1
-        self._run(self._put_serialized(oid, serialized))
+        if serialized.total_bytes() <= self.config.max_direct_call_object_size:
+            # Small object: entirely in-process — no IO-loop round trip.
+            self.reference_counter.add_owned_object(oid)
+            if serialized.contained_refs:
+                self.reference_counter.add_contained_refs(
+                    oid, serialized.contained_refs)
+            self.memory_store.put(oid, serialized)
+        else:
+            self._run(self._put_serialized(oid, serialized))
         return ObjectRef(oid, owner_address=self.address, worker=self,
                          call_site="put")
 
@@ -445,6 +461,17 @@ class CoreWorker:
 
     def get(self, refs: Sequence[ObjectRef], timeout: float | None = None):
         self.stats["gets"] += len(refs)
+        # Fast path: every value already local and in-process — deserialize
+        # on the caller thread, skipping the IO-loop round trip.
+        objs = []
+        for ref in refs:
+            obj = self.memory_store.get_if_exists(ref.object_id)
+            if obj is None or obj is IN_PLASMA:
+                objs = None
+                break
+            objs.append(obj)
+        if objs is not None:
+            return [self._deserialize_obj(o) for o in objs]
         return self._run(self.get_objects_async(refs, timeout=timeout))
 
     def get_async(self, ref: ObjectRef) -> asyncio.Future:
@@ -542,7 +569,7 @@ class CoreWorker:
         logger.info("reconstructing %s by resubmitting task %s",
                     oid.hex()[:16], entry.spec.name)
         self.stats["tasks_retried"] += 1
-        await self._submit_to_key(entry.spec)
+        self._queue_spec(entry.spec)
         # Wait for the resubmitted task to complete again.
         for _ in range(600):
             await asyncio.sleep(0.05)
@@ -647,8 +674,62 @@ class CoreWorker:
         self.reference_counter.update_submitted_task_references(arg_oids)
         del arg_holds  # promoted args now pinned by submitted-ref counts
         self.stats["tasks_submitted"] += 1
-        self._fire_and_forget(self._submit_when_ready(spec))
+        self._enqueue_submit("task", spec)
         return refs
+
+    def _enqueue_submit(self, kind: str, spec: TaskSpec):
+        """Queue a spec for submission and wake the IO loop at most once
+        per burst (reference analog: the submitter queue pump in
+        direct_task_transport.cc, but batched for the caller thread)."""
+        with self._submit_lock:
+            self._submit_buffer.append((kind, spec))
+            if self._submit_scheduled:
+                return
+            self._submit_scheduled = True
+        self.loop.call_soon_threadsafe(self._drain_submit_buffer)
+
+    def _drain_submit_buffer(self):
+        """Loop thread: move buffered submissions into per-key / per-actor
+        queues, then pump each touched queue once."""
+        with self._submit_lock:
+            items = list(self._submit_buffer)
+            self._submit_buffer.clear()
+            self._submit_scheduled = False
+        touched_keys: Dict[int, SchedulingKeyState] = {}
+        touched_actors: Dict[bytes, ActorQueueState] = {}
+        for kind, spec in items:
+            if kind == "task":
+                if spec.dependency_ids():
+                    # Owned args may be pending: resolve asynchronously.
+                    self.loop.create_task(self._submit_when_ready(spec))
+                    continue
+                sc = spec.scheduling_class
+                state = self.scheduling_keys.get(sc)
+                if state is None:
+                    state = self.scheduling_keys[sc] = \
+                        SchedulingKeyState(spec.resources)
+                state.queue.append(spec)
+                touched_keys[sc] = state
+            else:
+                q = self.actor_queues.get(spec.actor_id)
+                if q is None:
+                    q = self.actor_queues[spec.actor_id] = \
+                        ActorQueueState(spec.actor_id)
+                if q.state == "DEAD":
+                    self._store_error_for_task(
+                        spec, exc.ActorDiedError(
+                            q.death_cause or "actor is dead"))
+                    continue
+                # Seqnos assigned in buffer order == submission order (the
+                # receiver executes strictly by seqno per caller).
+                seqno = q.seqno
+                q.seqno += 1
+                q.buffer.append((spec, seqno))
+                touched_actors[spec.actor_id] = q
+        for sc, state in touched_keys.items():
+            self._pump_scheduling_key(sc, state)
+        for q in touched_actors.values():
+            self._pump_actor_queue(q)
 
     def _prepare_args(self, args: List[Any]):
         """Inline small values; pass ObjectRefs and big values by reference
@@ -691,17 +772,18 @@ class CoreWorker:
                     await self.memory_store.get(oid)
                 except Exception:
                     pass
-        await self._submit_to_key(spec)
+        self._queue_spec(spec)
 
-    async def _submit_to_key(self, spec: TaskSpec):
+    def _queue_spec(self, spec: TaskSpec):
+        """Loop thread: queue a dependency-free spec and pump."""
         sc = spec.scheduling_class
         state = self.scheduling_keys.get(sc)
         if state is None:
             state = self.scheduling_keys[sc] = SchedulingKeyState(spec.resources)
         state.queue.append(spec)
-        await self._pump_scheduling_key(sc, state)
+        self._pump_scheduling_key(sc, state)
 
-    async def _pump_scheduling_key(self, sc: int, state: SchedulingKeyState):
+    def _pump_scheduling_key(self, sc: int, state: SchedulingKeyState):
         cap = self.config.max_tasks_in_flight_per_worker
         while state.queue:
             worker = min((w for w in state.workers if w.inflight < cap),
@@ -709,13 +791,12 @@ class CoreWorker:
             if worker is None:
                 if state.pending_lease < 1 + len(state.queue) // (cap * 4):
                     state.pending_lease += 1
-                    asyncio.get_running_loop().create_task(
+                    self.loop.create_task(
                         self._request_lease(sc, state, self.raylet_address))
                 return
-            spec = state.queue.pop(0)
+            spec = state.queue.popleft()
             worker.inflight += 1
-            asyncio.get_running_loop().create_task(
-                self._push_task(sc, state, worker, spec))
+            self._push_task_nowait(sc, state, worker, spec)
 
     async def _request_lease(self, sc: int, state: SchedulingKeyState,
                              raylet_address: str, depth: int = 0):
@@ -735,6 +816,17 @@ class CoreWorker:
             state.pending_lease -= 1
             return
         if reply.get("granted"):
+            if not state.queue:
+                # Stale grant: the queue drained while this request was
+                # pending at the raylet. Hand the worker straight back —
+                # keeping it starves other scheduling classes.
+                state.pending_lease -= 1
+                try:
+                    await conn.call("ReturnWorker", {
+                        "lease_id": reply["lease_id"], "worker_died": False})
+                except ConnectionError:
+                    pass
+                return
             try:
                 wconn = await rpc.connect(reply["worker_address"],
                                           peer_name="leased-worker")
@@ -748,7 +840,7 @@ class CoreWorker:
             state.pending_lease -= 1
             wconn.on_disconnect.append(
                 lambda c: self._on_leased_worker_died(sc, state, lw))
-            await self._pump_scheduling_key(sc, state)
+            self._pump_scheduling_key(sc, state)
         elif reply.get("spill") and depth < 4:
             await self._request_lease(sc, state, reply["spill"], depth + 1)
         elif reply.get("infeasible"):
@@ -781,34 +873,50 @@ class CoreWorker:
         if not lw.conn.closed:
             await lw.conn.close()
 
-    async def _push_task(self, sc: int, state: SchedulingKeyState,
-                         lw: LeasedWorker, spec: TaskSpec):
+    def _push_task_nowait(self, sc: int, state: SchedulingKeyState,
+                          lw: LeasedWorker, spec: TaskSpec):
+        """Loop thread: write the PushTask frame and attach completion
+        handling to the reply future — no per-task coroutine."""
         header, frames = spec.to_wire()
         try:
-            reply, rbufs = await lw.conn.call("PushTask", header, bufs=frames)
+            fut = lw.conn.call_nowait("PushTask", header, bufs=frames)
         except ConnectionError:
             lw.inflight -= 1
-            entry = self.pending_tasks.get(spec.task_id)
-            if entry is not None and entry.num_retries_left != 0:
-                if entry.num_retries_left > 0:
-                    entry.num_retries_left -= 1
-                self.stats["tasks_retried"] += 1
-                logger.info("retrying task %s after worker death", spec.name)
-                await self._submit_to_key(spec)
-            else:
-                self._store_error_for_task(
-                    spec, exc.WorkerCrashedError(
-                        f"worker died executing {spec.name}"))
+            self._retry_or_fail_after_worker_death(spec)
             return
+        fut.add_done_callback(
+            lambda f: self._on_push_task_done(f, sc, state, lw, spec))
+
+    def _retry_or_fail_after_worker_death(self, spec: TaskSpec):
+        entry = self.pending_tasks.get(spec.task_id)
+        if entry is not None and entry.num_retries_left != 0:
+            if entry.num_retries_left > 0:
+                entry.num_retries_left -= 1
+            self.stats["tasks_retried"] += 1
+            logger.info("retrying task %s after worker death", spec.name)
+            self._queue_spec(spec)
+        else:
+            self._store_error_for_task(
+                spec, exc.WorkerCrashedError(
+                    f"worker died executing {spec.name}"))
+
+    def _on_push_task_done(self, fut: asyncio.Future, sc: int,
+                           state: SchedulingKeyState, lw: LeasedWorker,
+                           spec: TaskSpec):
         lw.inflight -= 1
+        err = fut.exception() if not fut.cancelled() else None
+        if fut.cancelled() or err is not None:
+            self._retry_or_fail_after_worker_death(spec)
+            return
+        reply, rbufs = fut.result()
         self._complete_task(spec, reply, rbufs)
         # Reuse or return the lease.
         if state.queue:
-            await self._pump_scheduling_key(sc, state)
+            self._pump_scheduling_key(sc, state)
         elif lw.inflight == 0:
             if lw in state.workers:
                 state.workers.remove(lw)
-            await self._return_lease(lw)
+            self.loop.create_task(self._return_lease(lw))
 
     def _complete_task(self, spec: TaskSpec, reply: dict, rbufs: List[bytes]):
         """Handle a task reply: land return values in the memory store /
@@ -821,7 +929,7 @@ class CoreWorker:
             if entry.num_retries_left > 0:
                 entry.num_retries_left -= 1
             self.stats["tasks_retried"] += 1
-            self._fire_and_forget(self._submit_to_key(spec))
+            self._queue_spec(spec)
             return
         returns = reply.get("returns", [])
         for ret in returns:
@@ -862,6 +970,7 @@ class CoreWorker:
                      actor_name: str = "", namespace: str = "",
                      max_restarts: int = 0, max_concurrency: int = 1,
                      resources: Dict[str, float] | None = None,
+                     lifetime_resources: Dict[str, float] | None = None,
                      is_asyncio: bool = False,
                      placement_group_id: bytes = b"",
                      placement_group_bundle_index: int = -1,
@@ -883,6 +992,7 @@ class CoreWorker:
             placement_group_bundle_index=placement_group_bundle_index)
         header, frames = spec.to_wire()
         header["resources"] = spec.resources
+        header["lifetime_resources"] = lifetime_resources
         header["pg_id"] = placement_group_id
         header["pg_bundle"] = placement_group_bundle_index
         self._run(self.gcs_conn.call("RegisterActor", {
@@ -928,28 +1038,15 @@ class CoreWorker:
         self.reference_counter.update_submitted_task_references(arg_oids)
         del arg_holds
         self.stats["actor_tasks_submitted"] += 1
-        self._fire_and_forget(self._submit_actor_task_async(spec))
-        return refs
-
-    async def _submit_actor_task_async(self, spec: TaskSpec):
-        q = self.actor_queues.get(spec.actor_id)
-        if q is None:
-            q = self.actor_queues[spec.actor_id] = ActorQueueState(spec.actor_id)
-        if q.state == "DEAD":
-            self._store_error_for_task(
-                spec, exc.ActorDiedError(q.death_cause or "actor is dead"))
-            return
-        # Sequence numbers are assigned before any await so actor calls keep
+        # Seqno assignment happens at drain time in buffer order, which is
         # submission order (the receiver executes strictly by seqno). By-ref
         # args resolve at the executing worker — the owner's GetObject blocks
         # until the value exists — so no client-side dependency wait is
         # needed, and ordering can't be inverted by slow dependencies.
-        seqno = q.seqno
-        q.seqno += 1
-        q.buffer.append((spec, seqno))
-        await self._pump_actor_queue(q)
+        self._enqueue_submit("actor", spec)
+        return refs
 
-    async def _pump_actor_queue(self, q: ActorQueueState):
+    def _pump_actor_queue(self, q: ActorQueueState):
         if q.state == "DEAD":
             for spec, _ in q.buffer:
                 self._store_error_for_task(
@@ -959,13 +1056,22 @@ class CoreWorker:
         if q.conn is None or q.conn.closed:
             if not q.resolving:
                 q.resolving = True
-                asyncio.get_running_loop().create_task(self._resolve_actor(q))
+                self.loop.create_task(self._resolve_actor(q))
             return
         while q.buffer:
-            spec, seqno = q.buffer.pop(0)
+            spec, seqno = q.buffer.popleft()
             q.inflight[seqno] = (spec, 0)
-            asyncio.get_running_loop().create_task(
-                self._push_actor_task(q, spec, seqno))
+            header, frames = spec.to_wire()
+            header["seqno"] = seqno
+            header["incarnation"] = q.incarnation
+            try:
+                fut = q.conn.call_nowait("PushActorTask", header, bufs=frames)
+            except ConnectionError:
+                # Conn-lost handler requeues the inflight entry.
+                return
+            fut.add_done_callback(
+                lambda f, spec=spec, seqno=seqno:
+                self._on_actor_push_done(f, q, spec, seqno))
 
     async def _resolve_actor(self, q: ActorQueueState):
         try:
@@ -997,22 +1103,23 @@ class CoreWorker:
                         # Fresh worker expects seqno 0: renumber the stream
                         # (reference: the submitter resets sequence state on
                         # actor restart, direct_actor_transport.h).
-                        q.buffer = [(spec, i)
-                                    for i, (spec, _) in enumerate(q.buffer)]
+                        q.buffer = deque(
+                            (spec, i)
+                            for i, (spec, _) in enumerate(q.buffer))
                         q.seqno = len(q.buffer)
                     q.conn.on_disconnect.append(
                         lambda c, q=q: self._on_actor_conn_lost(q, c))
-                    await self._pump_actor_queue(q)
+                    self._pump_actor_queue(q)
                     return
                 if reply["state"] == "DEAD":
                     q.state = "DEAD"
                     q.death_cause = reply.get("death_cause", "actor died")
-                    await self._pump_actor_queue(q)
+                    self._pump_actor_queue(q)
                     return
                 await asyncio.sleep(0.05)
             q.state = "DEAD"
             q.death_cause = "timed out resolving actor location"
-            await self._pump_actor_queue(q)
+            self._pump_actor_queue(q)
         finally:
             q.resolving = False
 
@@ -1040,22 +1147,18 @@ class CoreWorker:
             else:
                 self._store_error_for_task(spec, exc.ActorDiedError(
                     "actor worker died before the call completed"))
-        q.buffer = requeue + q.buffer
-        self._fire_and_forget(self._pump_actor_queue(q))
+        q.buffer.extendleft(reversed(requeue))
+        self._pump_actor_queue(q)
 
-    async def _push_actor_task(self, q: ActorQueueState, spec: TaskSpec,
-                               seqno: int):
-        header, frames = spec.to_wire()
-        header["seqno"] = seqno
-        header["incarnation"] = q.incarnation
-        try:
-            reply, rbufs = await q.conn.call("PushActorTask", header, bufs=frames)
-        except ConnectionError:
-            # Conn-lost handler requeues; nothing to do here.
+    def _on_actor_push_done(self, fut: asyncio.Future, q: ActorQueueState,
+                            spec: TaskSpec, seqno: int):
+        if fut.cancelled() or fut.exception() is not None:
+            # Connection lost: the conn-lost handler requeues inflight.
             return
+        reply, rbufs = fut.result()
         q.inflight.pop(seqno, None)
         if reply.get("status") == "actor_restarting":
-            q.buffer.insert(0, (spec, seqno))
+            q.buffer.appendleft((spec, seqno))
             return
         self._complete_task(spec, reply, rbufs)
         self.reference_counter.update_finished_task_references(
@@ -1078,7 +1181,7 @@ class CoreWorker:
             elif msg["state"] == "DEAD":
                 q.state = "DEAD"
                 q.death_cause = msg.get("reason", "actor died")
-                await self._pump_actor_queue(q)
+                self._pump_actor_queue(q)
             elif msg["state"] == "RESTARTING":
                 q.state = "RESOLVING"
         return {}

@@ -15,7 +15,17 @@ random, PlacementGroupID 16B.
 from __future__ import annotations
 
 import os
+import random
 import threading
+
+# ID suffixes only need uniqueness, not cryptographic strength; a urandom-
+# seeded Mersenne twister is ~50x cheaper per draw than os.urandom on this
+# path (each process seeds independently — workers are fresh interpreters).
+_rng = random.Random(int.from_bytes(os.urandom(16), "little"))
+
+
+def _random_bytes(n: int) -> bytes:
+    return _rng.getrandbits(n * 8).to_bytes(n, "little")
 
 JOB_ID_SIZE = 4
 ACTOR_ID_SIZE = 16
@@ -41,7 +51,7 @@ class BaseID:
 
     @classmethod
     def from_random(cls):
-        return cls(os.urandom(cls.SIZE))
+        return cls(_random_bytes(cls.SIZE))
 
     @classmethod
     def from_hex(cls, hex_str: str):
@@ -94,7 +104,7 @@ class ActorID(BaseID):
 
     @classmethod
     def of(cls, job_id: JobID):
-        return cls(job_id.binary() + os.urandom(ACTOR_ID_SIZE - JOB_ID_SIZE))
+        return cls(job_id.binary() + _random_bytes(ACTOR_ID_SIZE - JOB_ID_SIZE))
 
     def job_id(self) -> JobID:
         return JobID(self._bytes[:JOB_ID_SIZE])
@@ -106,7 +116,7 @@ class TaskID(BaseID):
     @classmethod
     def of(cls, actor_id: ActorID):
         """A task within an actor's (or the job's driver "actor") lineage."""
-        return cls(actor_id.binary() + os.urandom(TASK_ID_SIZE - ACTOR_ID_SIZE))
+        return cls(actor_id.binary() + _random_bytes(TASK_ID_SIZE - ACTOR_ID_SIZE))
 
     @classmethod
     def for_driver(cls, job_id: JobID):
@@ -150,7 +160,7 @@ class PlacementGroupID(BaseID):
 
     @classmethod
     def of(cls, job_id: JobID):
-        return cls(job_id.binary() + os.urandom(PLACEMENT_GROUP_ID_SIZE - JOB_ID_SIZE))
+        return cls(job_id.binary() + _random_bytes(PLACEMENT_GROUP_ID_SIZE - JOB_ID_SIZE))
 
 
 class PutIndexAllocator:

@@ -5,11 +5,18 @@ src/ray/core_worker/store_provider/memory_store/memory_store.h): every
 owner keeps its tasks' small return values here; ``get`` blocks on the
 owner's event loop until the value lands (the task reply delivers it), and
 object-available callbacks feed dependency resolution.
+
+Thread model: reads and ``put``/``delete`` may come from any thread (the
+synchronous public API writes small objects without an IO-loop round
+trip); blocking ``get`` runs on an event loop. A small lock closes the
+check-then-register race between a foreign-thread put and a loop-thread
+get, and waiter futures are woken on their own loop.
 """
 
 from __future__ import annotations
 
 import asyncio
+import threading
 from typing import Callable, Dict, List, Optional
 
 from ray_tpu._private.ids import ObjectID
@@ -26,12 +33,19 @@ class InPlasmaSentinel:
 IN_PLASMA = InPlasmaSentinel()
 
 
-class MemoryStore:
-    """Async object table with waiters. Must only be touched from the owner
-    process's event loop (single-threaded, like the reference's
-    instrumented_io_context confinement)."""
+def _set_result_safe(fut: asyncio.Future, obj) -> None:
+    if not fut.done():
+        fut.set_result(obj)
 
+
+def _set_exception_safe(fut: asyncio.Future, err: BaseException) -> None:
+    if not fut.done():
+        fut.set_exception(err)
+
+
+class MemoryStore:
     def __init__(self):
+        self._lock = threading.Lock()
         self._objects: Dict[ObjectID, object] = {}  # SerializedObject | IN_PLASMA
         self._waiters: Dict[ObjectID, List[asyncio.Future]] = {}
         self._object_added_callbacks: List[Callable[[ObjectID], None]] = []
@@ -40,10 +54,20 @@ class MemoryStore:
         self._object_added_callbacks.append(cb)
 
     def put(self, object_id: ObjectID, obj) -> None:
-        self._objects[object_id] = obj
-        for fut in self._waiters.pop(object_id, []):
-            if not fut.done():
-                fut.set_result(obj)
+        with self._lock:
+            self._objects[object_id] = obj
+            waiters = self._waiters.pop(object_id, None)
+        if waiters:
+            try:
+                current = asyncio.get_running_loop()
+            except RuntimeError:
+                current = None
+            for fut in waiters:
+                floop = fut.get_loop()
+                if floop is current:
+                    _set_result_safe(fut, obj)
+                else:
+                    floop.call_soon_threadsafe(_set_result_safe, fut, obj)
         for cb in self._object_added_callbacks:
             cb(object_id)
 
@@ -54,36 +78,52 @@ class MemoryStore:
         return self._objects.get(object_id)
 
     async def get(self, object_id: ObjectID, timeout: float | None = None):
-        obj = self._objects.get(object_id)
-        if obj is not None:
-            return obj
-        fut = asyncio.get_running_loop().create_future()
-        self._waiters.setdefault(object_id, []).append(fut)
+        with self._lock:
+            obj = self._objects.get(object_id)
+            if obj is not None:
+                return obj
+            fut = asyncio.get_running_loop().create_future()
+            self._waiters.setdefault(object_id, []).append(fut)
         try:
             if timeout is not None:
                 return await asyncio.wait_for(fut, timeout)
             return await fut
         finally:
-            lst = self._waiters.get(object_id)
-            if lst and fut in lst:
-                lst.remove(fut)
-                if not lst:
-                    del self._waiters[object_id]
+            with self._lock:
+                lst = self._waiters.get(object_id)
+                if lst and fut in lst:
+                    lst.remove(fut)
+                    if not lst:
+                        del self._waiters[object_id]
 
     def delete(self, object_id: ObjectID) -> None:
-        self._objects.pop(object_id, None)
+        with self._lock:
+            self._objects.pop(object_id, None)
 
     def fail_waiters(self, object_id: ObjectID, error: BaseException) -> None:
-        for fut in self._waiters.pop(object_id, []):
-            if not fut.done():
-                fut.set_exception(error)
+        with self._lock:
+            waiters = self._waiters.pop(object_id, None)
+        if not waiters:
+            return
+        try:
+            current = asyncio.get_running_loop()
+        except RuntimeError:
+            current = None
+        for fut in waiters:
+            floop = fut.get_loop()
+            if floop is current:
+                _set_exception_safe(fut, error)
+            else:
+                floop.call_soon_threadsafe(_set_exception_safe, fut, error)
 
     def size(self) -> int:
         return len(self._objects)
 
     def used_bytes(self) -> int:
+        with self._lock:
+            objs = list(self._objects.values())
         total = 0
-        for obj in self._objects.values():
+        for obj in objs:
             if isinstance(obj, SerializedObject):
                 total += obj.total_bytes()
         return total

@@ -239,8 +239,12 @@ class Raylet:
         self.workers[worker_id.binary()] = handle
 
     def _alive_worker_count(self) -> int:
+        """Workers counted against the task-worker pool cap. Actor workers
+        are excluded: an actor owns a dedicated process for its lifetime
+        (reference: worker_pool.h dedicated workers), so a node with
+        num_cpus task slots can still serve tasks while actors live."""
         return sum(1 for w in self.workers.values()
-                   if w.state not in (WORKER_DEAD,))
+                   if w.state not in (WORKER_DEAD, WORKER_ACTOR))
 
     async def handle_register_worker(self, conn, header, bufs):
         wid = header["worker_id"]
@@ -536,6 +540,19 @@ class Raylet:
             worker.actor_resources = {}
             self._kill_worker(worker)
             self.workers.pop(worker.worker_id, None)
+            return {"ok": True}
+        # Creation done: swap the hold to the actor's *lifetime* resources
+        # (reference parity, python/ray/actor.py — default actors place
+        # their creation with 1 CPU but hold 0 while alive). PG actors keep
+        # the bundle reservation unchanged.
+        lifetime = spec.get("lifetime_resources")
+        if pg_key is None and lifetime is not None and lifetime != resources:
+            self._give_back(resources, None)
+            for k, v in lifetime.items():
+                self.resources_available[k] = \
+                    self.resources_available.get(k, 0.0) - v
+            worker.actor_resources = lifetime
+            self._schedule_tick()
         return {"ok": True}
 
     def _give_back(self, resources, pg_key):

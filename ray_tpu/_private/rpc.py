@@ -40,6 +40,11 @@ _U64 = struct.Struct("<Q")
 
 Handler = Callable[["Connection", Any, List[bytes]], Awaitable[Any]]
 
+# Write-buffer size above which senders apply backpressure by awaiting
+# drain. Below it, writes are fire-and-forget into the transport buffer —
+# one syscall per event-loop flush instead of one drain await per message.
+DRAIN_HIGH_WATER = 4 * 1024 * 1024
+
 
 def _pack_msg(kind: int, seq: int, method: str, header: Any,
               bufs: Sequence[bytes]) -> List[bytes]:
@@ -78,8 +83,16 @@ class Connection:
         self.peer_name = peer_name
         self._seq = itertools.count(1)
         self._pending: Dict[int, asyncio.Future] = {}
-        self._send_lock = asyncio.Lock()
         self._closed = False
+        # Write coalescing: messages buffer here and flush once per loop
+        # iteration — one syscall for a whole burst of small messages
+        # instead of one sendmsg each (~120us apiece on this box).
+        self._loop = asyncio.get_running_loop()
+        self._out: List[bytes] = []
+        self._flush_scheduled = False
+        # Serializes writer.drain(): pre-3.12 FlowControlMixin supports
+        # only ONE drain waiter per transport (single _drain_waiter slot).
+        self._drain_lock = asyncio.Lock()
         self.on_disconnect: List[Callable[["Connection"], None]] = []
         # Arbitrary per-connection state stamped by services (worker id etc).
         self.tags: Dict[str, Any] = {}
@@ -88,31 +101,65 @@ class Connection:
     def start(self):
         self._recv_task = asyncio.get_running_loop().create_task(self._recv_loop())
 
-    async def _send(self, parts: List[bytes]):
-        async with self._send_lock:
-            self.writer.writelines(parts)
-            await self.writer.drain()
-
-    async def call(self, method: str, header: Any = None,
-                   bufs: Sequence[bytes] = (), timeout: float | None = None):
+    def _write_nowait(self, parts: List[bytes]):
+        """Coalescing buffered write (loop thread only): parts land in the
+        out-buffer and flush once per loop iteration."""
         if self._closed:
             raise ConnectionError(f"connection to {self.peer_name} is closed")
+        self._out.extend(parts)
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            self._loop.call_soon(self._flush)
+
+    def _flush(self):
+        self._flush_scheduled = False
+        if self._closed or not self._out:
+            self._out.clear()
+            return
+        out, self._out = self._out, []
+        try:
+            self.writer.writelines(out)
+        except Exception:
+            self._mark_closed()
+
+    def _needs_drain(self) -> bool:
+        transport = self.writer.transport
+        return (transport is not None and
+                transport.get_write_buffer_size() > DRAIN_HIGH_WATER)
+
+    async def _drain(self):
+        async with self._drain_lock:
+            await self.writer.drain()
+
+    async def _send(self, parts: List[bytes]):
+        self._write_nowait(parts)
+        if self._needs_drain():
+            await self._drain()
+
+    def call_nowait(self, method: str, header: Any = None,
+                    bufs: Sequence[bytes] = ()) -> asyncio.Future:
+        """Issue a request without a coroutine round trip (loop thread
+        only). Returns the reply future; the pending entry is dropped by a
+        done callback so abandoned futures don't leak."""
         seq = next(self._seq)
         fut = asyncio.get_running_loop().create_future()
         self._pending[seq] = fut
-        try:
-            await self._send(_pack_msg(KIND_REQUEST, seq, method, header, bufs))
-            if timeout is not None:
-                return await asyncio.wait_for(fut, timeout)
-            return await fut
-        finally:
-            self._pending.pop(seq, None)
+        fut.add_done_callback(lambda f: self._pending.pop(seq, None))
+        self._write_nowait(_pack_msg(KIND_REQUEST, seq, method, header, bufs))
+        return fut
+
+    async def call(self, method: str, header: Any = None,
+                   bufs: Sequence[bytes] = (), timeout: float | None = None):
+        fut = self.call_nowait(method, header, bufs)
+        if self._needs_drain():
+            await self._drain()
+        if timeout is not None:
+            return await asyncio.wait_for(fut, timeout)
+        return await fut
 
     async def push(self, method: str, header: Any = None,
                    bufs: Sequence[bytes] = ()):
         """One-way message; no reply expected."""
-        if self._closed:
-            raise ConnectionError(f"connection to {self.peer_name} is closed")
         await self._send(_pack_msg(KIND_PUSH, 0, method, header, bufs))
 
     async def _recv_loop(self):
@@ -120,6 +167,13 @@ class Connection:
             while True:
                 kind, seq, method, header, bufs = await _read_msg(self.reader)
                 if kind == KIND_REQUEST:
+                    handler = self.handlers.get(method)
+                    if handler is not None and \
+                            getattr(handler, "rpc_sync", False):
+                        # Sync fast path: no per-request asyncio.Task. The
+                        # handler returns a reply tuple or a Future.
+                        self._handle_sync(handler, seq, method, header, bufs)
+                        continue
                     asyncio.get_running_loop().create_task(
                         self._handle(seq, method, header, bufs))
                 elif kind == KIND_PUSH:
@@ -149,6 +203,48 @@ class Connection:
             await handler(self, header, bufs)
         except Exception:
             logger.exception("push handler error")
+
+    def _reply_nowait(self, seq: int, method: str, result):
+        if isinstance(result, tuple) and len(result) == 2 and \
+                isinstance(result[1], (list, tuple)):
+            rheader, rbufs = result
+        else:
+            rheader, rbufs = result, ()
+        try:
+            self._write_nowait(_pack_msg(KIND_REPLY, seq, method, rheader, rbufs))
+        except (ConnectionError, OSError):
+            self._mark_closed()
+
+    def _reply_error_nowait(self, seq: int, method: str, e: BaseException):
+        try:
+            payload = cloudpickle.dumps(e)
+        except Exception:
+            payload = cloudpickle.dumps(RuntimeError(repr(e)))
+        try:
+            self._write_nowait(_pack_msg(KIND_ERROR, seq, method, None, [payload]))
+        except (ConnectionError, OSError):
+            self._mark_closed()
+
+    def _handle_sync(self, handler, seq: int, method: str, header, bufs):
+        """Dispatch a handler marked ``rpc_sync``: called inline on the
+        recv loop; may return a Future for deferred replies."""
+        try:
+            result = handler(self, header, bufs)
+        except Exception as e:  # noqa: BLE001 — propagate to caller
+            self._reply_error_nowait(seq, method, e)
+            return
+        if isinstance(result, asyncio.Future):
+            def _on_done(fut: asyncio.Future):
+                if fut.cancelled():
+                    self._reply_error_nowait(
+                        seq, method, RuntimeError(f"{method} cancelled"))
+                elif fut.exception() is not None:
+                    self._reply_error_nowait(seq, method, fut.exception())
+                else:
+                    self._reply_nowait(seq, method, fut.result())
+            result.add_done_callback(_on_done)
+        else:
+            self._reply_nowait(seq, method, result)
 
     async def _handle(self, seq: int, method: str, header, bufs):
         handler = self.handlers.get(method)

@@ -16,6 +16,7 @@ from __future__ import annotations
 import asyncio
 import inspect
 import logging
+import queue as queue_mod
 import threading
 import traceback
 from concurrent.futures import ThreadPoolExecutor
@@ -43,14 +44,25 @@ def current_task_id() -> bytes:
 class TaskExecutor:
     def __init__(self, core: CoreWorker):
         self.core = core
-        # Normal tasks execute serially, like a reference worker.
+        # Normal tasks execute serially, like a reference worker: one
+        # dedicated execution thread fed by a queue. Batching the dequeue
+        # and the reply delivery costs one loop wakeup per BURST of tasks
+        # instead of one thread-pool hop per task.
         self._task_pool = ThreadPoolExecutor(max_workers=1,
                                              thread_name_prefix="rtpu-exec")
+        self._exec_queue: queue_mod.SimpleQueue = queue_mod.SimpleQueue()
+        self._exec_thread = threading.Thread(
+            target=self._exec_loop, name="rtpu-task-exec", daemon=True)
+        self._exec_thread.start()
         self._actor_instance: Any = None
         self._actor_id: bytes = b""
         self._actor_is_asyncio = False
         self._actor_sema: Optional[asyncio.Semaphore] = None
         self._actor_pool: Optional[ThreadPoolExecutor] = None
+        # Serial (max_concurrency=1, non-async) actors execute on a
+        # dedicated thread with batched dequeue + batched reply delivery,
+        # same as normal tasks.
+        self._actor_serial_queue: Optional[queue_mod.SimpleQueue] = None
         # Receiver-side ordering state is PER CALLER: every submitting
         # worker numbers its own stream from 0 (reference: per-caller
         # sequence_number in direct_actor_transport.h) — a global
@@ -72,14 +84,70 @@ class TaskExecutor:
 
     # ------------------------------------------------------------ normal tasks
 
-    async def handle_push_task(self, conn, header, bufs):
-        spec = TaskSpec.from_wire(header, bufs)
+    def handle_push_task(self, conn, header, bufs):
+        """Sync RPC fast path (rpc_sync): queue for the execution thread
+        and return a Future the RPC layer replies from."""
+        fut = asyncio.get_running_loop().create_future()
+        self._exec_queue.put((header, bufs, fut))
+        return fut
+
+    handle_push_task.rpc_sync = True
+
+    def _exec_loop(self):
+        self._serial_exec_loop(self._exec_queue, self._run_one_task)
+
+    def _run_one_task(self, spec: TaskSpec):
         if spec.task_id in self._cancelled:
             self._cancelled.discard(spec.task_id)
             return self._error_reply(spec, exc.TaskCancelledError(spec.name))
-        loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(
-            self._task_pool, self._execute_task_sync, spec)
+        return self._execute_task_sync(spec)
+
+    def _serial_exec_loop(self, q: queue_mod.SimpleQueue, run_one):
+        """Dedicated execution thread: drain bursts from the queue, run
+        them serially via ``run_one(spec)``, deliver all replies with one
+        loop wakeup."""
+        while True:
+            batch = [q.get()]
+            while True:
+                try:
+                    batch.append(q.get_nowait())
+                except queue_mod.Empty:
+                    break
+            results = []
+            for header, bufs, fut in batch:
+                try:
+                    reply = run_one(TaskSpec.from_wire(header, bufs))
+                except BaseException as e:  # noqa: BLE001 — keep thread alive
+                    logger.exception("task execution loop error")
+                    reply = self._infra_error_reply(header, e)
+                results.append((fut, reply))
+            self.core.loop.call_soon_threadsafe(self._deliver_replies, results)
+
+    def _infra_error_reply(self, header: dict, e: BaseException):
+        """Error reply built from the raw header (the spec may not even
+        deserialize): every declared return gets an error object so the
+        caller's get() raises instead of hanging."""
+        serialized = self.core.serialization_context.serialize_error(
+            exc.RaySystemError(f"task execution failed in the worker: {e!r}"))
+        meta, frames = serialized.to_wire()
+        task_id = TaskID(header["task_id"])
+        returns = []
+        frames_out: List[bytes] = []
+        for i in range(max(header.get("num_returns", 1), 1)):
+            start = len(frames_out)
+            frames_out.extend(frames)
+            returns.append({"object_id": task_id.object_id(i + 1).binary(),
+                            "in_plasma": False, "metadata": meta,
+                            "frame_start": start, "num_frames": len(frames),
+                            "contained": []})
+        return {"status": "error", "task_id": header["task_id"],
+                "returns": returns}, frames_out
+
+    @staticmethod
+    def _deliver_replies(results):
+        for fut, reply in results:
+            if not fut.done():
+                fut.set_result(reply)
 
     def _execute_task_sync(self, spec: TaskSpec):
         _task_ctx.task_id = spec.task_id
@@ -215,13 +283,18 @@ class TaskExecutor:
             self._actor_sema = asyncio.Semaphore(max(max_concurrency, 1000)
                                                  if max_concurrency == 1
                                                  else max_concurrency)
+        elif max_concurrency == 1:
+            self._actor_serial_queue = queue_mod.SimpleQueue()
+            threading.Thread(target=self._actor_serial_loop,
+                             name="rtpu-actor-exec", daemon=True).start()
         else:
             self._actor_pool = ThreadPoolExecutor(
                 max_workers=max_concurrency,
                 thread_name_prefix="rtpu-actor")
-        self._actor_exec_queue = asyncio.Queue()
-        self._actor_consumer = asyncio.get_running_loop().create_task(
-            self._actor_consume_loop())
+        if self._actor_serial_queue is None:
+            self._actor_exec_queue = asyncio.Queue()
+            self._actor_consumer = asyncio.get_running_loop().create_task(
+                self._actor_consume_loop())
         return {"ok": True}
 
     def _construct_actor(self, spec: TaskSpec):
@@ -237,25 +310,37 @@ class TaskExecutor:
             _task_ctx.task_id = b""
             self.core._current_task_id = b""
 
-    async def handle_push_actor_task(self, conn, header, bufs):
+    def handle_push_actor_task(self, conn, header, bufs):
         """Receiver-side ordering: execute strictly in client seqno order,
-        buffering out-of-order arrivals (reference: ActorSchedulingQueue)."""
+        buffering out-of-order arrivals (reference: ActorSchedulingQueue).
+        Sync RPC fast path — returns the reply future."""
         seqno = header["seqno"]
         caller = header.get("owner_worker_id", b"")
         fut = asyncio.get_running_loop().create_future()
         self._actor_reorder.setdefault(caller, {})[seqno] = (
             header, list(bufs), fut)
         self._drain_reorder_buffer(caller)
-        return await fut
+        return fut
+
+    handle_push_actor_task.rpc_sync = True
 
     def _drain_reorder_buffer(self, caller: bytes):
         reorder = self._actor_reorder.get(caller, {})
         expected = self._actor_expected_seqno.setdefault(caller, 0)
         while expected in reorder:
-            header, bufs, fut = reorder.pop(expected)
+            item = reorder.pop(expected)
             expected += 1
-            self._actor_exec_queue.put_nowait((header, bufs, fut))
+            if self._actor_serial_queue is not None:
+                self._actor_serial_queue.put(item)
+            else:
+                self._actor_exec_queue.put_nowait(item)
         self._actor_expected_seqno[caller] = expected
+
+    def _actor_serial_loop(self):
+        """Serial-actor execution thread (max_concurrency=1, non-async):
+        same batched loop as normal tasks."""
+        self._serial_exec_loop(self._actor_serial_queue,
+                               self._execute_actor_task_sync)
 
     async def _actor_consume_loop(self):
         while True:

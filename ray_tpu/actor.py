@@ -143,6 +143,18 @@ class ActorClass:
             demand["TPU"] = float(self._num_tpus)
         return demand
 
+    def _lifetime_resources(self) -> Dict[str, float]:
+        """Resources held while the actor is alive. Reference parity
+        (python/ray/actor.py): an unspecified num_cpus means 1 CPU to
+        schedule the creation task but 0 held for the actor's lifetime —
+        default actors pack onto a node without consuming CPU slots."""
+        lifetime = dict(self._resources)
+        if self._num_cpus is not None:
+            lifetime["CPU"] = float(self._num_cpus)
+        if self._num_tpus:
+            lifetime["TPU"] = float(self._num_tpus)
+        return lifetime
+
     def remote(self, *args, **kwargs):
         if self._get_if_exists and self._name:
             # race-free named-actor rendezvous (reference parity:
@@ -177,6 +189,7 @@ class ActorClass:
             max_restarts=self._max_restarts,
             max_concurrency=self._max_concurrency,
             resources=self._resource_demand(),
+            lifetime_resources=self._lifetime_resources(),
             is_asyncio=self._is_asyncio,
             placement_group_id=pg.id.binary() if pg is not None else b"",
             placement_group_bundle_index=self._placement_group_bundle_index,
