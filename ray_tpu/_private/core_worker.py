@@ -421,13 +421,14 @@ class CoreWorker:
         self.stats["puts"] += 1
         if serialized.total_bytes() <= self.config.max_direct_call_object_size:
             # Small object: entirely in-process — no IO-loop round trip.
-            self.reference_counter.add_owned_object(oid)
+            self.reference_counter.add_owned_with_local_ref(oid)
             if serialized.contained_refs:
                 self.reference_counter.add_contained_refs(
                     oid, serialized.contained_refs)
             self.memory_store.put(oid, serialized)
-        else:
-            self._run(self._put_serialized(oid, serialized))
+            return ObjectRef(oid, owner_address=self.address, worker=self,
+                             call_site="put", skip_adding_local_ref=True)
+        self._run(self._put_serialized(oid, serialized))
         return ObjectRef(oid, owner_address=self.address, worker=self,
                          call_site="put")
 
@@ -665,13 +666,16 @@ class CoreWorker:
         return_ids = [task_id.object_id(i + 1) for i in range(spec.num_returns)]
         refs = []
         for oid in return_ids:
-            self.reference_counter.add_owned_object(oid, pin_lineage=True)
+            self.reference_counter.add_owned_with_local_ref(
+                oid, pin_lineage=True)
             refs.append(ObjectRef(oid, owner_address=self.address, worker=self,
-                                  call_site=spec.name))
+                                  call_site=spec.name,
+                                  skip_adding_local_ref=True))
         entry = PendingTaskEntry(spec, return_ids)
         self.pending_tasks[spec.task_id] = entry
-        arg_oids = [ObjectID(b) for b in spec.dependency_ids()]
-        self.reference_counter.update_submitted_task_references(arg_oids)
+        if entry.dep_ids:
+            self.reference_counter.update_submitted_task_references(
+                entry.dep_ids)
         del arg_holds  # promoted args now pinned by submitted-ref counts
         self.stats["tasks_submitted"] += 1
         self._enqueue_submit("task", spec)
@@ -794,9 +798,12 @@ class CoreWorker:
                     self.loop.create_task(
                         self._request_lease(sc, state, self.raylet_address))
                 return
-            spec = state.queue.popleft()
-            worker.inflight += 1
-            self._push_task_nowait(sc, state, worker, spec)
+            # Fill this worker's pipeline in ONE wire message (the batched
+            # analog of the reference's per-worker pipelining window).
+            n = min(len(state.queue), cap - worker.inflight)
+            batch = [state.queue.popleft() for _ in range(n)]
+            worker.inflight += n
+            self._push_task_batch_nowait(sc, state, worker, batch)
 
     async def _request_lease(self, sc: int, state: SchedulingKeyState,
                              raylet_address: str, depth: int = 0):
@@ -873,19 +880,27 @@ class CoreWorker:
         if not lw.conn.closed:
             await lw.conn.close()
 
-    def _push_task_nowait(self, sc: int, state: SchedulingKeyState,
-                          lw: LeasedWorker, spec: TaskSpec):
-        """Loop thread: write the PushTask frame and attach completion
-        handling to the reply future — no per-task coroutine."""
-        header, frames = spec.to_wire()
+    def _push_task_batch_nowait(self, sc: int, state: SchedulingKeyState,
+                                lw: LeasedWorker, batch: List[TaskSpec]):
+        """Loop thread: write ONE PushTasks frame carrying the whole batch
+        and attach completion handling to the reply future — no per-task
+        coroutine, no per-task syscall."""
+        theaders: List[list] = []
+        frames: List[bytes] = []
+        for spec in batch:
+            tw, tfr = spec.to_wire()
+            theaders.append([tw, len(frames), len(tfr)])
+            frames.extend(tfr)
         try:
-            fut = lw.conn.call_nowait("PushTask", header, bufs=frames)
+            fut = lw.conn.call_nowait("PushTasks", {"tasks": theaders},
+                                      bufs=frames)
         except ConnectionError:
-            lw.inflight -= 1
-            self._retry_or_fail_after_worker_death(spec)
+            lw.inflight -= len(batch)
+            for spec in batch:
+                self._retry_or_fail_after_worker_death(spec)
             return
         fut.add_done_callback(
-            lambda f: self._on_push_task_done(f, sc, state, lw, spec))
+            lambda f: self._on_push_batch_done(f, sc, state, lw, batch))
 
     def _retry_or_fail_after_worker_death(self, spec: TaskSpec):
         entry = self.pending_tasks.get(spec.task_id)
@@ -900,16 +915,18 @@ class CoreWorker:
                 spec, exc.WorkerCrashedError(
                     f"worker died executing {spec.name}"))
 
-    def _on_push_task_done(self, fut: asyncio.Future, sc: int,
-                           state: SchedulingKeyState, lw: LeasedWorker,
-                           spec: TaskSpec):
-        lw.inflight -= 1
+    def _on_push_batch_done(self, fut: asyncio.Future, sc: int,
+                            state: SchedulingKeyState, lw: LeasedWorker,
+                            batch: List[TaskSpec]):
+        lw.inflight -= len(batch)
         err = fut.exception() if not fut.cancelled() else None
         if fut.cancelled() or err is not None:
-            self._retry_or_fail_after_worker_death(spec)
+            for spec in batch:
+                self._retry_or_fail_after_worker_death(spec)
             return
         reply, rbufs = fut.result()
-        self._complete_task(spec, reply, rbufs)
+        for spec, (rheader, fstart, nframes) in zip(batch, reply["replies"]):
+            self._complete_task(spec, rheader, rbufs[fstart:fstart + nframes])
         # Reuse or return the lease.
         if state.queue:
             self._pump_scheduling_key(sc, state)
@@ -990,8 +1007,7 @@ class CoreWorker:
                             "name": actor_name, "namespace": namespace},
             placement_group_id=placement_group_id,
             placement_group_bundle_index=placement_group_bundle_index)
-        header, frames = spec.to_wire()
-        header["resources"] = spec.resources
+        header, frames = spec.to_wire_dict()
         header["lifetime_resources"] = lifetime_resources
         header["pg_id"] = placement_group_id
         header["pg_bundle"] = placement_group_bundle_index
@@ -1030,12 +1046,14 @@ class CoreWorker:
         return_ids = [task_id.object_id(i + 1) for i in range(num_returns)]
         refs = []
         for oid in return_ids:
-            self.reference_counter.add_owned_object(oid)
+            self.reference_counter.add_owned_with_local_ref(oid)
             refs.append(ObjectRef(oid, owner_address=self.address, worker=self,
-                                  call_site=name))
-        self.pending_tasks[spec.task_id] = PendingTaskEntry(spec, return_ids)
-        arg_oids = [ObjectID(b) for b in spec.dependency_ids()]
-        self.reference_counter.update_submitted_task_references(arg_oids)
+                                  call_site=name, skip_adding_local_ref=True))
+        entry = PendingTaskEntry(spec, return_ids)
+        self.pending_tasks[spec.task_id] = entry
+        if entry.dep_ids:
+            self.reference_counter.update_submitted_task_references(
+                entry.dep_ids)
         del arg_holds
         self.stats["actor_tasks_submitted"] += 1
         # Seqno assignment happens at drain time in buffer order, which is
@@ -1058,20 +1076,31 @@ class CoreWorker:
                 q.resolving = True
                 self.loop.create_task(self._resolve_actor(q))
             return
+        if not q.buffer:
+            return
+        # Drain the whole buffer into ONE wire message (same batching as
+        # the normal-task path); seqnos stay per-task for the receiver's
+        # reorder buffer.
+        theaders: List[list] = []
+        frames: List[bytes] = []
+        batch: List[Tuple[TaskSpec, int]] = []
         while q.buffer:
             spec, seqno = q.buffer.popleft()
             q.inflight[seqno] = (spec, 0)
-            header, frames = spec.to_wire()
-            header["seqno"] = seqno
-            header["incarnation"] = q.incarnation
-            try:
-                fut = q.conn.call_nowait("PushActorTask", header, bufs=frames)
-            except ConnectionError:
-                # Conn-lost handler requeues the inflight entry.
-                return
-            fut.add_done_callback(
-                lambda f, spec=spec, seqno=seqno:
-                self._on_actor_push_done(f, q, spec, seqno))
+            tw, tfr = spec.to_wire()
+            theaders.append([tw, seqno, len(frames), len(tfr)])
+            frames.extend(tfr)
+            batch.append((spec, seqno))
+        try:
+            fut = q.conn.call_nowait(
+                "PushActorTasks",
+                {"tasks": theaders, "incarnation": q.incarnation},
+                bufs=frames)
+        except ConnectionError:
+            # Conn-lost handler requeues the inflight entries.
+            return
+        fut.add_done_callback(
+            lambda f, batch=batch: self._on_actor_batch_done(f, q, batch))
 
     async def _resolve_actor(self, q: ActorQueueState):
         try:
@@ -1150,19 +1179,24 @@ class CoreWorker:
         q.buffer.extendleft(reversed(requeue))
         self._pump_actor_queue(q)
 
-    def _on_actor_push_done(self, fut: asyncio.Future, q: ActorQueueState,
-                            spec: TaskSpec, seqno: int):
+    def _on_actor_batch_done(self, fut: asyncio.Future, q: ActorQueueState,
+                             batch: List[Tuple[TaskSpec, int]]):
         if fut.cancelled() or fut.exception() is not None:
             # Connection lost: the conn-lost handler requeues inflight.
             return
         reply, rbufs = fut.result()
-        q.inflight.pop(seqno, None)
-        if reply.get("status") == "actor_restarting":
-            q.buffer.appendleft((spec, seqno))
-            return
-        self._complete_task(spec, reply, rbufs)
-        self.reference_counter.update_finished_task_references(
-            [ObjectID(b) for b in spec.dependency_ids()])
+        requeue: List[Tuple[TaskSpec, int]] = []
+        for (spec, seqno), (rheader, fstart, nframes) in zip(
+                batch, reply["replies"]):
+            q.inflight.pop(seqno, None)
+            if rheader.get("status") == "actor_restarting":
+                requeue.append((spec, seqno))
+                continue
+            self._complete_task(spec, rheader, rbufs[fstart:fstart + nframes])
+            self.reference_counter.update_finished_task_references(
+                [ObjectID(b) for b in spec.dependency_ids()])
+        if requeue:
+            q.buffer.extendleft(reversed(requeue))
 
     def kill_actor(self, actor_id: bytes, no_restart: bool = True):
         self._run(self.gcs_conn.call("KillActor", {

@@ -46,9 +46,10 @@ class FunctionManager:
         """Idempotent per-cluster export. The ``_exported`` set lives on this
         core worker, so a decorated function reused across clusters
         re-exports to each new GCS."""
-        with self._lock:
-            if key in self._exported:
-                return
+        # Lock-free fast path: set membership is atomic under the GIL and
+        # keys are only ever added, so a stale miss just re-checks below.
+        if key in self._exported:
+            return
         self._kv_put(FN_KV_PREFIX + key.encode(), pickled)
         with self._lock:
             self._exported.add(key)

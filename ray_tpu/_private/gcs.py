@@ -223,7 +223,8 @@ class GcsServer:
         if entry is None or not entry.alive:
             return
         entry.alive = False
-        logger.warning("node %s marked dead: %s", node_id.hex()[:8], reason)
+        log = logger.info if reason == "drained" else logger.warning
+        log("node %s marked dead: %s", node_id.hex()[:8], reason)
         await self._publish("NODE", {"event": "dead", "node_id": node_id,
                                      "reason": reason})
         # Actors on the dead node die / restart (reference:

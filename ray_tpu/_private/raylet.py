@@ -174,7 +174,16 @@ class Raylet:
         for w in list(self.workers.values()):
             self._kill_worker(w)
         await self._server.close()
-        if self.gcs_conn:
+        if self.gcs_conn and not self.gcs_conn.closed:
+            # Graceful departure: tell the GCS we're draining so a planned
+            # shutdown isn't reported as a node failure (reference:
+            # NodeManager drain / UnregisterNode path).
+            try:
+                await self.gcs_conn.call(
+                    "DrainNode", {"node_id": self.node_id.binary()},
+                    timeout=2)
+            except (ConnectionError, asyncio.TimeoutError):
+                pass
             await self.gcs_conn.close()
         self.store.shutdown()
 

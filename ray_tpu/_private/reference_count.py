@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import logging
 import threading
-from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Set
 
 from ray_tpu._private.ids import ObjectID
@@ -28,21 +27,30 @@ from ray_tpu._private.ids import ObjectID
 logger = logging.getLogger(__name__)
 
 
-@dataclass
 class Reference:
-    owned: bool = False
-    owner_address: str = ""
-    local_refs: int = 0
-    submitted_refs: int = 0
-    contained_in: Set[ObjectID] = field(default_factory=set)
-    contains: Set[ObjectID] = field(default_factory=set)
-    borrowers: Set[str] = field(default_factory=set)
-    # Object data locations (node ids) — owner-resident location index,
-    # the analog of OwnershipBasedObjectDirectory.
-    locations: Set[bytes] = field(default_factory=set)
-    in_plasma: bool = False
-    pinned_lineage: bool = False
-    freed: bool = False
+    """Per-object refcount record. The set-valued fields start as ``None``
+    and are allocated on first use — one Reference is created per task
+    return on the submit hot path, and most objects never have borrowers,
+    containment edges, or plasma locations."""
+
+    __slots__ = ("owned", "owner_address", "local_refs", "submitted_refs",
+                 "contained_in", "contains", "borrowers", "locations",
+                 "in_plasma", "pinned_lineage", "freed")
+
+    def __init__(self):
+        self.owned = False
+        self.owner_address = ""
+        self.local_refs = 0
+        self.submitted_refs = 0
+        self.contained_in: Optional[Set[ObjectID]] = None
+        self.contains: Optional[Set[ObjectID]] = None
+        self.borrowers: Optional[Set[str]] = None
+        # Object data locations (node ids) — owner-resident location index,
+        # the analog of OwnershipBasedObjectDirectory.
+        self.locations: Optional[Set[bytes]] = None
+        self.in_plasma = False
+        self.pinned_lineage = False
+        self.freed = False
 
     def is_releasable(self) -> bool:
         return (self.local_refs == 0 and self.submitted_refs == 0
@@ -74,10 +82,26 @@ class ReferenceCounter:
     def add_owned_object(self, object_id: ObjectID, in_plasma: bool = False,
                          pin_lineage: bool = False) -> None:
         with self._lock:
-            ref = self._refs.setdefault(object_id, Reference())
+            ref = self._refs.get(object_id)
+            if ref is None:
+                ref = self._refs[object_id] = Reference()
             ref.owned = True
             ref.owner_address = self.own_address
             ref.in_plasma = in_plasma
+            ref.pinned_lineage = pin_lineage
+
+    def add_owned_with_local_ref(self, object_id: ObjectID,
+                                 pin_lineage: bool = False) -> None:
+        """Fused add_owned_object + add_local_reference: ONE lock round
+        trip on the per-task submit path (callers construct the ObjectRef
+        with skip_adding_local_ref=True)."""
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is None:
+                ref = self._refs[object_id] = Reference()
+            ref.owned = True
+            ref.owner_address = self.own_address
+            ref.local_refs += 1
             ref.pinned_lineage = pin_lineage
 
     def add_borrowed_object(self, object_id: ObjectID, owner_address: str) -> bool:
@@ -130,8 +154,12 @@ class ReferenceCounter:
     def add_contained_refs(self, outer: ObjectID, inner: List[ObjectID]) -> None:
         with self._lock:
             outer_ref = self._refs.setdefault(outer, Reference())
+            if outer_ref.contains is None:
+                outer_ref.contains = set()
             for oid in inner:
                 inner_ref = self._refs.setdefault(oid, Reference())
+                if inner_ref.contained_in is None:
+                    inner_ref.contained_in = set()
                 inner_ref.contained_in.add(outer)
                 outer_ref.contains.add(oid)
 
@@ -141,6 +169,8 @@ class ReferenceCounter:
         with self._lock:
             ref = self._refs.setdefault(object_id, Reference())
             if borrower_address != self.own_address:
+                if ref.borrowers is None:
+                    ref.borrowers = set()
                 ref.borrowers.add(borrower_address)
 
     def remove_borrower(self, object_id: ObjectID, borrower_address: str) -> None:
@@ -148,7 +178,8 @@ class ReferenceCounter:
             ref = self._refs.get(object_id)
             if ref is None:
                 return
-            ref.borrowers.discard(borrower_address)
+            if ref.borrowers:
+                ref.borrowers.discard(borrower_address)
         self._maybe_release(object_id)
 
     # -- locations (owner-resident object directory) ------------------------
@@ -156,6 +187,8 @@ class ReferenceCounter:
     def add_location(self, object_id: ObjectID, node_id: bytes) -> None:
         with self._lock:
             ref = self._refs.setdefault(object_id, Reference())
+            if ref.locations is None:
+                ref.locations = set()
             ref.locations.add(node_id)
             ref.in_plasma = True
 
@@ -168,6 +201,8 @@ class ReferenceCounter:
             ref = self._refs.get(object_id)
             if ref is None:
                 return False
+            if ref.locations is None:
+                ref.locations = set()
             ref.locations.add(node_id)
             ref.in_plasma = True
             return True
@@ -175,13 +210,13 @@ class ReferenceCounter:
     def remove_location(self, object_id: ObjectID, node_id: bytes) -> None:
         with self._lock:
             ref = self._refs.get(object_id)
-            if ref:
+            if ref and ref.locations:
                 ref.locations.discard(node_id)
 
     def get_locations(self, object_id: ObjectID) -> Set[bytes]:
         with self._lock:
             ref = self._refs.get(object_id)
-            return set(ref.locations) if ref else set()
+            return set(ref.locations) if ref and ref.locations else set()
 
     # -- internals ----------------------------------------------------------
 
@@ -222,11 +257,12 @@ class ReferenceCounter:
                     continue
                 r.freed = True
                 to_release.append(oid)
-                for inner in list(r.contains):
+                for inner in list(r.contains or ()):
                     iref = self._refs.get(inner)
                     if iref is None:
                         continue
-                    iref.contained_in.discard(oid)
+                    if iref.contained_in:
+                        iref.contained_in.discard(oid)
                     if iref.is_releasable() and not iref.freed:
                         stack.append((inner, iref))
             for oid in to_release:
@@ -260,7 +296,7 @@ class ReferenceCounter:
                     "owned": r.owned,
                     "local_refs": r.local_refs,
                     "submitted_refs": r.submitted_refs,
-                    "borrowers": sorted(r.borrowers),
+                    "borrowers": sorted(r.borrowers or ()),
                     "in_plasma": r.in_plasma,
                 }
                 for oid, r in self._refs.items()

@@ -74,9 +74,9 @@ class TaskExecutor:
         self._actor_exec_queue: Optional[asyncio.Queue] = None
         self._actor_consumer: Optional[asyncio.Task] = None
         core._server.handlers.update({
-            "PushTask": self.handle_push_task,
+            "PushTasks": self.handle_push_tasks,
             "CreateActor": self.handle_create_actor,
-            "PushActorTask": self.handle_push_actor_task,
+            "PushActorTasks": self.handle_push_actor_tasks,
             "CancelTask": self.handle_cancel_task,
             "Exit": self.handle_exit,
         })
@@ -84,14 +84,53 @@ class TaskExecutor:
 
     # ------------------------------------------------------------ normal tasks
 
-    def handle_push_task(self, conn, header, bufs):
-        """Sync RPC fast path (rpc_sync): queue for the execution thread
-        and return a Future the RPC layer replies from."""
-        fut = asyncio.get_running_loop().create_future()
-        self._exec_queue.put((header, bufs, fut))
-        return fut
+    def _batch_reply_aggregator(self, loop, tws: List[list]):
+        """One reply message per pushed batch: returns (batch_fut, per-task
+        futs). Each per-task future resolves to a (reply_header, frames)
+        tuple; once all land, the batch future resolves to
+        ({"replies": [[rheader, fstart, nframes], ...]}, frames)."""
+        batch_fut = loop.create_future()
+        n = len(tws)
+        slots: List[Optional[tuple]] = [None] * n
+        remaining = [n]
 
-    handle_push_task.rpc_sync = True
+        def make_cb(i: int, tw: list):
+            def _cb(f: asyncio.Future):
+                if f.cancelled() or f.exception() is not None:
+                    e = RuntimeError("cancelled") if f.cancelled() \
+                        else f.exception()
+                    slots[i] = self._infra_error_reply(tw, e)
+                else:
+                    slots[i] = f.result()
+                remaining[0] -= 1
+                if remaining[0] == 0 and not batch_fut.done():
+                    rheaders = []
+                    rframes: List[bytes] = []
+                    for rh, rfr in slots:
+                        rheaders.append([rh, len(rframes), len(rfr)])
+                        rframes.extend(rfr)
+                    batch_fut.set_result(({"replies": rheaders}, rframes))
+            return _cb
+
+        futs = []
+        for i, tw in enumerate(tws):
+            fut = loop.create_future()
+            fut.add_done_callback(make_cb(i, tw))
+            futs.append(fut)
+        return batch_fut, futs
+
+    def handle_push_tasks(self, conn, header, bufs):
+        """Sync RPC fast path (rpc_sync): queue the batch for the execution
+        thread and return the batch future the RPC layer replies from."""
+        loop = asyncio.get_running_loop()
+        tasks = header["tasks"]
+        batch_fut, futs = self._batch_reply_aggregator(
+            loop, [t[0] for t in tasks])
+        for (tw, fstart, nframes), fut in zip(tasks, futs):
+            self._exec_queue.put((tw, bufs[fstart:fstart + nframes], fut))
+        return batch_fut
+
+    handle_push_tasks.rpc_sync = True
 
     def _exec_loop(self):
         self._serial_exec_loop(self._exec_queue, self._run_one_task)
@@ -123,24 +162,27 @@ class TaskExecutor:
                 results.append((fut, reply))
             self.core.loop.call_soon_threadsafe(self._deliver_replies, results)
 
-    def _infra_error_reply(self, header: dict, e: BaseException):
-        """Error reply built from the raw header (the spec may not even
+    def _infra_error_reply(self, tw: list, e: BaseException):
+        """Error reply built from the raw wire header (the spec may not even
         deserialize): every declared return gets an error object so the
         caller's get() raises instead of hanging."""
         serialized = self.core.serialization_context.serialize_error(
             exc.RaySystemError(f"task execution failed in the worker: {e!r}"))
         meta, frames = serialized.to_wire()
-        task_id = TaskID(header["task_id"])
+        raw_task_id = tw[TaskSpec.WIRE_TASK_ID] if len(tw) > 0 else b"\0" * 24
+        num_returns = tw[TaskSpec.WIRE_NUM_RETURNS] \
+            if len(tw) > TaskSpec.WIRE_NUM_RETURNS else 1
+        task_id = TaskID(raw_task_id)
         returns = []
         frames_out: List[bytes] = []
-        for i in range(max(header.get("num_returns", 1), 1)):
+        for i in range(max(num_returns, 1)):
             start = len(frames_out)
             frames_out.extend(frames)
             returns.append({"object_id": task_id.object_id(i + 1).binary(),
                             "in_plasma": False, "metadata": meta,
                             "frame_start": start, "num_frames": len(frames),
                             "contained": []})
-        return {"status": "error", "task_id": header["task_id"],
+        return {"status": "error", "task_id": raw_task_id,
                 "returns": returns}, frames_out
 
     @staticmethod
@@ -264,7 +306,7 @@ class TaskExecutor:
     # ------------------------------------------------------------- actors
 
     async def handle_create_actor(self, conn, header, bufs):
-        spec = TaskSpec.from_wire(header["spec"], bufs)
+        spec = TaskSpec.from_wire_dict(header["spec"], bufs)
         creation = spec.actor_creation or {}
         try:
             loop = asyncio.get_running_loop()
@@ -310,19 +352,25 @@ class TaskExecutor:
             _task_ctx.task_id = b""
             self.core._current_task_id = b""
 
-    def handle_push_actor_task(self, conn, header, bufs):
+    def handle_push_actor_tasks(self, conn, header, bufs):
         """Receiver-side ordering: execute strictly in client seqno order,
         buffering out-of-order arrivals (reference: ActorSchedulingQueue).
-        Sync RPC fast path — returns the reply future."""
-        seqno = header["seqno"]
-        caller = header.get("owner_worker_id", b"")
-        fut = asyncio.get_running_loop().create_future()
-        self._actor_reorder.setdefault(caller, {})[seqno] = (
-            header, list(bufs), fut)
-        self._drain_reorder_buffer(caller)
-        return fut
+        Sync RPC fast path — returns the batch reply future."""
+        loop = asyncio.get_running_loop()
+        tasks = header["tasks"]
+        batch_fut, futs = self._batch_reply_aggregator(
+            loop, [t[0] for t in tasks])
+        callers = set()
+        for (tw, seqno, fstart, nframes), fut in zip(tasks, futs):
+            caller = tw[TaskSpec.WIRE_OWNER_WORKER_ID]
+            self._actor_reorder.setdefault(caller, {})[seqno] = (
+                tw, bufs[fstart:fstart + nframes], fut)
+            callers.add(caller)
+        for caller in callers:
+            self._drain_reorder_buffer(caller)
+        return batch_fut
 
-    handle_push_actor_task.rpc_sync = True
+    handle_push_actor_tasks.rpc_sync = True
 
     def _drain_reorder_buffer(self, caller: bytes):
         reorder = self._actor_reorder.get(caller, {})

@@ -61,6 +61,7 @@ class TaskSpec:
         "owner_address", "owner_worker_id", "actor_id", "actor_counter",
         "actor_creation", "runtime_env", "placement_group_id",
         "placement_group_bundle_index", "scheduling_strategy", "depth",
+        "_sched",
     )
 
     def __init__(self, task_id: bytes, job_id: bytes, task_type: int,
@@ -95,10 +96,15 @@ class TaskSpec:
         self.placement_group_bundle_index = placement_group_bundle_index
         self.scheduling_strategy = scheduling_strategy
         self.depth = depth
+        self._sched = -1
 
     @property
     def scheduling_class(self) -> int:
-        return scheduling_class_of(self.resources, self.fn_key)
+        # Cached: interning sorts the resource dict and takes a lock, and
+        # the hot submit path reads this once per task.
+        if self._sched < 0:
+            self._sched = scheduling_class_of(self.resources, self.fn_key)
+        return self._sched
 
     def is_actor_task(self) -> bool:
         return self.task_type == TASK_ACTOR
@@ -111,9 +117,7 @@ class TaskSpec:
 
     # -- wire ---------------------------------------------------------------
 
-    def to_wire(self) -> Tuple[dict, List[bytes]]:
-        """(header, frames): arg value frames are hoisted into the RPC raw
-        frame list so msgpack never copies object payloads."""
+    def _args_wire(self) -> Tuple[list, List[bytes]]:
         frames: List[bytes] = []
         args_wire = []
         for a in self.args:
@@ -124,6 +128,70 @@ class TaskSpec:
                                   a.contained_refs])
             else:
                 args_wire.append([ARG_REF, a.object_id, a.owner_address])
+        return args_wire, frames
+
+    # Positional field order of the compact wire form (hot path).
+    # [task_id, job_id, task_type, name, fn_key, args, num_returns,
+    #  resources, max_retries, retry_exceptions, owner_address,
+    #  owner_worker_id, actor_id, actor_counter, actor_creation,
+    #  runtime_env, pg_id, pg_bundle, strategy, depth]
+    WIRE_OWNER_WORKER_ID = 11  # index used by the actor reorder buffer
+    WIRE_TASK_ID = 0
+    WIRE_NUM_RETURNS = 6
+
+    def to_wire(self) -> Tuple[list, List[bytes]]:
+        """(header, frames): the header is a positional msgpack list (cheaper
+        to pack/unpack than a keyed dict on the per-task hot path); arg value
+        frames are hoisted into the RPC raw frame list so msgpack never
+        copies object payloads."""
+        args_wire, frames = self._args_wire()
+        header = [
+            self.task_id, self.job_id, self.task_type, self.name,
+            self.fn_key, args_wire, self.num_returns, self.resources,
+            self.max_retries, self.retry_exceptions, self.owner_address,
+            self.owner_worker_id, self.actor_id, self.actor_counter,
+            self.actor_creation, self.runtime_env, self.placement_group_id,
+            self.placement_group_bundle_index, self.scheduling_strategy,
+            self.depth,
+        ]
+        return header, frames
+
+    @staticmethod
+    def _args_from_wire(args_wire, frames: List[bytes]) -> List[TaskArg]:
+        args: List[TaskArg] = []
+        for aw in args_wire:
+            if aw[0] == ARG_VALUE:
+                _, metadata, start, n, contained = aw
+                args.append(TaskArg(ARG_VALUE, metadata=metadata,
+                                    frames=frames[start:start + n],
+                                    contained_refs=contained))
+            else:
+                args.append(TaskArg(ARG_REF, object_id=aw[1], owner_address=aw[2]))
+        return args
+
+    @classmethod
+    def from_wire(cls, header: list, frames: List[bytes]) -> "TaskSpec":
+        (task_id, job_id, task_type, name, fn_key, args_wire, num_returns,
+         resources, max_retries, retry_exceptions, owner_address,
+         owner_worker_id, actor_id, actor_counter, actor_creation,
+         runtime_env, pg_id, pg_bundle, strategy, depth) = header
+        return cls(
+            task_id=task_id, job_id=job_id, task_type=task_type, name=name,
+            fn_key=fn_key, args=cls._args_from_wire(args_wire, frames),
+            num_returns=num_returns, resources=resources,
+            max_retries=max_retries, retry_exceptions=retry_exceptions,
+            owner_address=owner_address, owner_worker_id=owner_worker_id,
+            actor_id=actor_id, actor_counter=actor_counter,
+            actor_creation=actor_creation, runtime_env=runtime_env,
+            placement_group_id=pg_id, placement_group_bundle_index=pg_bundle,
+            scheduling_strategy=strategy, depth=depth,
+        )
+
+    def to_wire_dict(self) -> Tuple[dict, List[bytes]]:
+        """Keyed wire form for cold paths whose header is stored/augmented
+        by other services (actor-creation specs pass through the GCS and
+        raylet, which read fields by name)."""
+        args_wire, frames = self._args_wire()
         header = {
             "task_id": self.task_id,
             "job_id": self.job_id,
@@ -149,20 +217,12 @@ class TaskSpec:
         return header, frames
 
     @classmethod
-    def from_wire(cls, header: dict, frames: List[bytes]) -> "TaskSpec":
-        args: List[TaskArg] = []
-        for aw in header["args"]:
-            if aw[0] == ARG_VALUE:
-                _, metadata, start, n, contained = aw
-                args.append(TaskArg(ARG_VALUE, metadata=metadata,
-                                    frames=frames[start:start + n],
-                                    contained_refs=contained))
-            else:
-                args.append(TaskArg(ARG_REF, object_id=aw[1], owner_address=aw[2]))
+    def from_wire_dict(cls, header: dict, frames: List[bytes]) -> "TaskSpec":
         return cls(
             task_id=header["task_id"], job_id=header["job_id"],
             task_type=header["task_type"], name=header["name"],
-            fn_key=header["fn_key"], args=args,
+            fn_key=header["fn_key"],
+            args=cls._args_from_wire(header["args"], frames),
             num_returns=header["num_returns"], resources=header["resources"],
             max_retries=header["max_retries"],
             retry_exceptions=header["retry_exceptions"],

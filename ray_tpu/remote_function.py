@@ -38,6 +38,7 @@ class RemoteFunction:
         self._placement_group_bundle_index = placement_group_bundle_index
         self._fn_key: Optional[str] = None
         self._pickled: Optional[bytes] = None
+        self._demand: Optional[Dict[str, float]] = None
         functools.update_wrapper(self, fn)
 
     def __call__(self, *args, **kwargs):
@@ -46,11 +47,16 @@ class RemoteFunction:
             f"{self._name}.remote()")
 
     def _resource_demand(self) -> Dict[str, float]:
-        demand = dict(self._resources)
-        demand["CPU"] = float(self._num_cpus if self._num_cpus is not None else 1)
-        if self._num_tpus:
-            demand["TPU"] = float(self._num_tpus)
-        return demand
+        # Cached: the demand is fixed per RemoteFunction and read once per
+        # .remote() call (the TaskSpec treats it as immutable).
+        if self._demand is None:
+            demand = dict(self._resources)
+            demand["CPU"] = float(
+                self._num_cpus if self._num_cpus is not None else 1)
+            if self._num_tpus:
+                demand["TPU"] = float(self._num_tpus)
+            self._demand = demand
+        return self._demand
 
     def remote(self, *args, **kwargs):
         w = worker_mod._require_connected()
