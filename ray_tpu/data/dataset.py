@@ -89,6 +89,10 @@ def _merge_sorted(key, descending, *parts):
                   key=key, reverse=descending)
 
 
+def _zip_blocks(a, b):
+    return list(zip(a, b))
+
+
 def _block_len(block):
     return len(block)
 
@@ -207,8 +211,12 @@ class Dataset:
                       for i in range(n - 1)] if keys else []
         part = _remote(_block_partition, num_returns=n)
         parts = [part.remote(b, boundaries, key) for b in self._blocks]
-        merge = _remote(functools.partial(_merge_sorted, key, descending))
-        out = [merge.remote(*[parts[i][j] for i in range(len(parts))])
+        # key/descending travel as task args so the cached remote function
+        # stays one module-level entry (a fresh partial per sort() call
+        # would grow _remote_cache without bound).
+        merge = _remote(_merge_sorted)
+        out = [merge.remote(key, descending,
+                            *[parts[i][j] for i in range(len(parts))])
                for j in range(n)]
         if descending:
             out = out[::-1]
@@ -230,8 +238,6 @@ class Dataset:
         return Dataset(blocks)
 
     def zip(self, other: "Dataset") -> "Dataset":
-        def _zip_blocks(a, b):
-            return list(zip(a, b))
         if self.num_blocks != other.num_blocks:
             raise ValueError("zip requires equal block counts")
         r = _remote(_zip_blocks)

@@ -41,6 +41,15 @@ def _init_session(state, rank: int, world: int, group_name: str,
     state["session"] = _session
 
 
+def _leave_group(state) -> None:
+    """Worker-side: drop this rank's collective membership (trainer
+    shutdown calls this before killing the actor)."""
+    if _session and _session["world"] > 1:
+        from ray_tpu.util import collective
+
+        collective.destroy_collective_group(_session["group"])
+
+
 def _run_train_func(state, fn, config):
     out = fn(config) if config is not None else fn()
     q = _session["queue"] if _session else None
@@ -162,35 +171,37 @@ class Trainer:
                 for w in self._wg.workers]
         done = 0
         pending_reports: Dict[int, List[dict]] = {}
-        while done < self._num_workers:
-            try:
-                msg = self._queue.get(timeout=0.1)
-            except Empty:
-                # surface worker crashes instead of spinning forever: a
-                # single failed future must abort the run (survivors may
-                # be blocked in a collective waiting for the dead rank)
-                ready, _ = ray_tpu.wait(futs, num_returns=len(futs),
-                                        timeout=0)
-                for fut in ready:
-                    ray_tpu.get(fut)  # raises if that worker crashed
-                if len(ready) == len(futs):
-                    break
-                continue
-            if msg["type"] == "done":
-                done += 1
-            elif msg["type"] == "report":
-                rank = msg["rank"]
-                pending_reports.setdefault(rank, []).append(
-                    msg["metrics"])
-                if all(len(v) > 0 for v in pending_reports.values()) \
-                        and len(pending_reports) == self._num_workers:
-                    batch = [pending_reports[r].pop(0)
-                             for r in sorted(pending_reports)]
-                    pending_reports = {
-                        r: v for r, v in pending_reports.items() if v}
-                    for cb in callbacks:
-                        cb.handle_result(batch)
+        # The crash-detection gets inside the poll loop raise too — the
+        # whole run is under one try so callbacks always learn of failure.
         try:
+            while done < self._num_workers:
+                try:
+                    msg = self._queue.get(timeout=0.1)
+                except Empty:
+                    # surface worker crashes instead of spinning forever: a
+                    # single failed future must abort the run (survivors may
+                    # be blocked in a collective waiting for the dead rank)
+                    ready, _ = ray_tpu.wait(futs, num_returns=len(futs),
+                                            timeout=0)
+                    for fut in ready:
+                        ray_tpu.get(fut)  # raises if that worker crashed
+                    if len(ready) == len(futs):
+                        break
+                    continue
+                if msg["type"] == "done":
+                    done += 1
+                elif msg["type"] == "report":
+                    rank = msg["rank"]
+                    pending_reports.setdefault(rank, []).append(
+                        msg["metrics"])
+                    if all(len(v) > 0 for v in pending_reports.values()) \
+                            and len(pending_reports) == self._num_workers:
+                        batch = [pending_reports[r].pop(0)
+                                 for r in sorted(pending_reports)]
+                        pending_reports = {
+                            r: v for r, v in pending_reports.items() if v}
+                        for cb in callbacks:
+                            cb.handle_result(batch)
             results = ray_tpu.get(futs)
             for cb in callbacks:
                 cb.finish_training(error=False)
@@ -222,8 +233,18 @@ class Trainer:
 
     def shutdown(self) -> None:
         if self._wg is not None:
-            self._wg.shutdown()
-            self._wg = None
             from ray_tpu.util.collective import destroy_collective_group
 
+            # Each rank leaves the group BEFORE its actor dies — the
+            # coordinator's membership refcount must reach zero or the
+            # detached coordinator outlives the trainer and a later
+            # same-named group attaches to the stale world size.
+            try:
+                ray_tpu.get([
+                    w.execute_with_state.remote(_leave_group)
+                    for w in self._wg.workers], timeout=10)
+            except Exception:  # noqa: BLE001 — dead workers can't leave
+                pass
+            self._wg.shutdown()
+            self._wg = None
             destroy_collective_group(self._group_name)

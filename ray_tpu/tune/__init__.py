@@ -8,8 +8,8 @@ actors; the driver loop polls results, consults the scheduler
 checkpoints to the experiment dir.
 """
 
-from ray_tpu.tune.tune import run  # noqa: F401
-from ray_tpu.tune.trial import Trial  # noqa: F401
+from ray_tpu.tune.tune import TrialRunner, run  # noqa: F401
+from ray_tpu.tune.trial import Trial, report  # noqa: F401
 from ray_tpu.tune.sample import (  # noqa: F401
     choice,
     grid_search,

@@ -1,0 +1,87 @@
+"""Experiment results (reference: python/ray/tune/analysis/
+experiment_analysis.py ExperimentAnalysis): best trial/config lookup over
+live Trial objects or a persisted experiment_state.pkl."""
+
+from __future__ import annotations
+
+import math
+import os
+import pickle
+from typing import Any, Dict, List, Optional
+
+
+class ExperimentAnalysis:
+    def __init__(self, experiment_dir: str, trials: Optional[list] = None,
+                 metric: str = "score", mode: str = "max"):
+        self.experiment_dir = experiment_dir
+        self.default_metric = metric
+        self.default_mode = mode
+        if trials is not None:
+            self._trials = [{
+                "trial_id": t.trial_id, "config": t.config,
+                "status": t.status, "results": t.results, "error": t.error,
+                "iteration": t.iteration,
+                "latest_checkpoint": getattr(t, "latest_checkpoint", None),
+            } for t in trials]
+        else:
+            path = os.path.join(experiment_dir, "experiment_state.pkl")
+            with open(path, "rb") as f:
+                self._trials = pickle.load(f)["trials"]
+
+    # ------------------------------------------------------------- queries
+
+    @property
+    def trials(self) -> List[Dict[str, Any]]:
+        return self._trials
+
+    def _best(self, metric: Optional[str], mode: Optional[str]):
+        metric = metric or self.default_metric
+        mode = mode or self.default_mode
+        sign = 1.0 if mode == "max" else -1.0
+
+        def score(t):
+            vals = [r[metric] for r in t["results"] if metric in r]
+            return max(sign * v for v in vals) if vals else -math.inf
+
+        scored = [t for t in self._trials if t["results"]]
+        if not scored:
+            return None
+        return max(scored, key=score)
+
+    def best_trial(self, metric: Optional[str] = None,
+                   mode: Optional[str] = None) -> Optional[Dict[str, Any]]:
+        return self._best(metric, mode)
+
+    def best_config(self, metric: Optional[str] = None,
+                    mode: Optional[str] = None) -> Optional[Dict[str, Any]]:
+        t = self._best(metric, mode)
+        return t["config"] if t else None
+
+    def best_result(self, metric: Optional[str] = None,
+                    mode: Optional[str] = None) -> Optional[Dict[str, Any]]:
+        metric = metric or self.default_metric
+        mode = mode or self.default_mode
+        t = self._best(metric, mode)
+        if not t:
+            return None
+        sign = 1.0 if mode == "max" else -1.0
+        return max((r for r in t["results"] if metric in r),
+                   key=lambda r: sign * r[metric])
+
+    def best_checkpoint(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Optional[str]:
+        t = self._best(metric, mode)
+        return t.get("latest_checkpoint") if t else None
+
+    def results_df(self):
+        """Flat per-trial summary rows (a list of dicts; no pandas
+        dependency — reference returns a DataFrame)."""
+        rows = []
+        for t in self._trials:
+            row = {"trial_id": t["trial_id"], "status": t["status"],
+                   "iterations": t["iteration"]}
+            row.update({f"config/{k}": v for k, v in t["config"].items()})
+            if t["results"]:
+                row.update(t["results"][-1])
+            rows.append(row)
+        return rows

@@ -1,0 +1,189 @@
+"""Trial schedulers: FIFO, ASHA, median stopping, PBT.
+
+Parity target: the reference's scheduler suite (reference:
+python/ray/tune/schedulers/trial_scheduler.py FIFOScheduler,
+schedulers/async_hyperband.py AsyncHyperBandScheduler,
+schedulers/median_stopping_rule.py, schedulers/pbt.py
+PopulationBasedTraining). A scheduler sees every intermediate result and
+answers CONTINUE / STOP; PBT additionally rewrites a lagging trial's
+config + weights from a leader (exploit) and perturbs it (explore).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.tune.sample import Domain
+
+logger = logging.getLogger(__name__)
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    """Decision hook; stateless base = FIFO (run every trial to the end)."""
+
+    def set_objective(self, metric: str, mode: str):
+        self.metric = metric
+        self.mode = mode
+
+    def _score(self, result: Dict[str, Any]) -> float:
+        v = result.get(self.metric)
+        if v is None:
+            return -math.inf
+        return float(v) if self.mode == "max" else -float(v)
+
+    def on_trial_result(self, runner, trial, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, runner, trial):
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (reference: schedulers/async_hyperband.py _Bracket): rungs at
+    grace_period * reduction_factor**k; a trial reaching a rung continues
+    only if its metric is in the top 1/reduction_factor of everything
+    recorded at that rung so far (async — no waiting for full rungs)."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 grace_period: int = 1, max_t: int = 100,
+                 reduction_factor: float = 3.0):
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.max_t = max_t
+        self.rf = reduction_factor
+        # rung milestone -> list of recorded scores
+        self.rungs: Dict[int, List[float]] = {}
+        milestone = grace_period
+        while milestone < max_t:
+            self.rungs[int(milestone)] = []
+            milestone *= reduction_factor
+        # per-trial, highest milestone already judged (avoid double counting)
+        self._judged: Dict[str, int] = {}
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        t = result.get(self.time_attr, 0)
+        if t >= self.max_t:
+            return STOP
+        score = self._score(result)
+        decision = CONTINUE
+        for milestone in sorted(self.rungs):
+            if t < milestone or self._judged.get(trial.trial_id, 0) >= milestone:
+                continue
+            self._judged[trial.trial_id] = milestone
+            recorded = self.rungs[milestone]
+            recorded.append(score)
+            k = max(1, int(len(recorded) / self.rf))
+            cutoff = sorted(recorded, reverse=True)[k - 1]
+            if score < cutoff:
+                decision = STOP
+        return decision
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose running-average metric falls below the median of
+    the running averages of all trials at the same step (reference:
+    schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self._sums: Dict[str, float] = {}
+        self._counts: Dict[str, int] = {}
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        tid = trial.trial_id
+        self._sums[tid] = self._sums.get(tid, 0.0) + self._score(result)
+        self._counts[tid] = self._counts.get(tid, 0) + 1
+        t = result.get(self.time_attr, 0)
+        if t < self.grace_period or len(self._counts) < self.min_samples:
+            return CONTINUE
+        avgs = [self._sums[i] / self._counts[i] for i in self._counts]
+        median = sorted(avgs)[len(avgs) // 2]
+        mine = self._sums[tid] / self._counts[tid]
+        return STOP if mine < median else CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: schedulers/pbt.py): every perturbation_interval
+    iterations, a trial in the bottom quantile clones the config +
+    checkpoint of a random top-quantile trial (exploit) and perturbs the
+    cloned hyperparameters (explore: resample with prob. resample_prob,
+    else scale by 0.8 / 1.2). The runner performs the actual actor
+    restart via ``runner.exploit(trial, donor, new_config)``."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_prob = resample_probability
+        self.rng = random.Random(seed)
+        self._last_perturb: Dict[str, int] = {}
+        self._latest: Dict[str, float] = {}
+        self.num_exploits = 0
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        tid = trial.trial_id
+        self._latest[tid] = self._score(result)
+        t = result.get(self.time_attr, 0)
+        if t - self._last_perturb.get(tid, 0) < self.interval:
+            return CONTINUE
+        self._last_perturb[tid] = t
+        lower, upper = self._quantiles()
+        if tid in lower and upper:
+            donor_id = self.rng.choice(upper)
+            donor = next(tr for tr in runner.trials
+                         if tr.trial_id == donor_id)
+            new_config = self._explore(dict(donor.config))
+            logger.info("PBT exploit: %s <- %s, explored %s",
+                        tid, donor_id, new_config)
+            self.num_exploits += 1
+            runner.exploit(trial, donor, new_config)
+        return CONTINUE
+
+    def _quantiles(self):
+        if len(self._latest) < 2:
+            return [], []
+        ordered = sorted(self._latest, key=self._latest.get)
+        n = max(1, int(len(ordered) * self.quantile))
+        return ordered[:n], ordered[-n:]
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        for key, spec in self.mutations.items():
+            if self.rng.random() < self.resample_prob:
+                config[key] = self._resample(spec)
+            elif isinstance(config.get(key), (int, float)):
+                factor = self.rng.choice([0.8, 1.2])
+                value = config[key] * factor
+                if isinstance(config[key], int):
+                    value = max(1, int(round(value)))
+                config[key] = value
+            else:
+                config[key] = self._resample(spec)
+        return config
+
+    def _resample(self, spec):
+        if isinstance(spec, Domain):
+            return spec.sample(self.rng)
+        if isinstance(spec, (list, tuple)):
+            return self.rng.choice(list(spec))
+        if callable(spec):
+            return spec()
+        return spec

@@ -119,6 +119,7 @@ class Trial:
         self.iteration = 0
         self.error: Optional[str] = None
         self.experiment_dir = experiment_dir
+        self.latest_checkpoint: Optional[str] = None
 
     def start(self, resources: Optional[dict] = None):
         opts = dict(resources or {})

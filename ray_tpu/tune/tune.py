@@ -1,0 +1,229 @@
+"""The Tune driver: TrialRunner loop + ``run()``.
+
+Parity target: reference tune.run (python/ray/tune/tune.py) driving
+TrialRunner (tune/trial_runner.py:147, step :566) and RayTrialExecutor
+(tune/ray_trial_executor.py:149). Trials are actors; the driver polls
+their ``next_result`` futures with ``ray_tpu.wait``, routes every result
+through the scheduler, enforces stop criteria, checkpoints experiment
+state after every event, and returns an ExperimentAnalysis.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import tempfile
+import time
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import ray_tpu
+from ray_tpu.tune import trial as trial_mod
+from ray_tpu.tune.result import ExperimentAnalysis
+from ray_tpu.tune.sample import generate_configs
+from ray_tpu.tune.schedulers import (
+    CONTINUE, STOP, FIFOScheduler, PopulationBasedTraining, TrialScheduler,
+)
+from ray_tpu.tune.trial import ERROR, PENDING, RUNNING, TERMINATED, Trial
+
+logger = logging.getLogger(__name__)
+
+
+class TrialRunner:
+    """Event loop over trial actors (reference: TrialRunner.step —
+    process one ready result per step, consult scheduler, refill)."""
+
+    def __init__(self, trials: List[Trial], scheduler: TrialScheduler,
+                 metric: str, mode: str,
+                 stop: Union[Dict[str, Any], Callable, None],
+                 resources_per_trial: Optional[dict],
+                 max_concurrent: int, experiment_dir: str,
+                 checkpoint_freq: int = 0):
+        self.trials = trials
+        self.scheduler = scheduler
+        self.metric = metric
+        self.mode = mode
+        self.stop_criteria = stop
+        self.resources = resources_per_trial or {}
+        self.max_concurrent = max_concurrent
+        self.experiment_dir = experiment_dir
+        self.checkpoint_freq = checkpoint_freq
+        self._pending: Dict[Any, Trial] = {}  # result future -> trial
+        scheduler.set_objective(metric, mode)
+
+    # ------------------------------------------------------------- plumbing
+
+    def _startable(self) -> Optional[Trial]:
+        running = sum(1 for t in self.trials if t.status == RUNNING)
+        if running >= self.max_concurrent:
+            return None
+        return next((t for t in self.trials if t.status == PENDING), None)
+
+    def _start_trial(self, t: Trial):
+        t.experiment_dir = self.experiment_dir
+        t.start(self.resources)
+        self._fetch(t)
+
+    def _fetch(self, t: Trial):
+        self._pending[t.fetch_next()] = t
+
+    def is_finished(self) -> bool:
+        return all(t.status in (TERMINATED, ERROR) for t in self.trials)
+
+    # ------------------------------------------------------------ main loop
+
+    def step(self):
+        """Start what can start, then process ONE ready result."""
+        while True:
+            t = self._startable()
+            if t is None:
+                break
+            self._start_trial(t)
+        if not self._pending:
+            return
+        ready, _ = ray_tpu.wait(list(self._pending), num_returns=1,
+                                timeout=10.0)
+        if not ready:
+            return
+        fut = ready[0]
+        t = self._pending.pop(fut)
+        if t.status != RUNNING:
+            return  # stopped (e.g. PBT exploit) while the result was in flight
+        try:
+            metrics, done = ray_tpu.get(fut)
+        except Exception as e:  # noqa: BLE001 — trial failure, not ours
+            logger.warning("trial %s errored: %s", t.trial_id, e)
+            t.error = repr(e)
+            t.stop(status=ERROR)
+            self._checkpoint_experiment()
+            return
+        if done and metrics is None:
+            self._complete(t)
+            return
+        t.iteration += 1
+        metrics.setdefault("training_iteration", t.iteration)
+        metrics.setdefault("trial_id", t.trial_id)
+        metrics.setdefault("timestamp", time.time())
+        t.last_result = metrics
+        t.results.append(metrics)
+        if self.checkpoint_freq and t.iteration % self.checkpoint_freq == 0:
+            try:
+                ray_tpu.get(t.actor.save_checkpoint.remote(
+                    t.checkpoint_path()))
+                t.latest_checkpoint = t.checkpoint_path()
+            except Exception:  # noqa: BLE001
+                logger.exception("checkpoint of %s failed", t.trial_id)
+        if done or self._hit_stop_criteria(t, metrics):
+            self._complete(t)
+            return
+        actor_before = t.actor
+        decision = self.scheduler.on_trial_result(self, t, metrics)
+        if t.actor is not actor_before or t.status != RUNNING:
+            # the scheduler exploited/replaced this trial; its new actor
+            # already has a pending fetch — fetching again here would leave
+            # two concurrent next_result futures on one trial
+            self._checkpoint_experiment()
+            return
+        if decision == STOP:
+            self._complete(t)
+        else:
+            self._fetch(t)
+        self._checkpoint_experiment()
+
+    def _hit_stop_criteria(self, t: Trial, metrics: Dict[str, Any]) -> bool:
+        s = self.stop_criteria
+        if s is None:
+            return False
+        if callable(s):
+            return bool(s(t.trial_id, metrics))
+        return any(metrics.get(k) is not None and metrics[k] >= v
+                   for k, v in s.items())
+
+    def _complete(self, t: Trial):
+        self.scheduler.on_trial_complete(self, t)
+        t.stop(status=TERMINATED)
+        self._checkpoint_experiment()
+
+    # ------------------------------------------------------------ PBT hook
+
+    def exploit(self, t: Trial, donor: Trial, new_config: Dict[str, Any]):
+        """Clone donor's weights into ``t`` and restart it with
+        ``new_config`` (reference: PBT _exploit + RayTrialExecutor
+        reset/restore)."""
+        path = donor.checkpoint_path()
+        try:
+            ray_tpu.get(donor.actor.save_checkpoint.remote(path))
+        except Exception:  # noqa: BLE001 — donor died; skip the exploit
+            logger.exception("PBT donor checkpoint failed")
+            return
+        donor.latest_checkpoint = path
+        t.stop(status=PENDING)
+        t.config = new_config
+        t.start(self.resources)
+        try:
+            ray_tpu.get(t.actor.restore_checkpoint.remote(path))
+        except Exception:  # noqa: BLE001
+            logger.exception("PBT restore failed")
+        t.iteration = donor.iteration
+        self._fetch(t)
+
+    # --------------------------------------------------------- persistence
+
+    def _checkpoint_experiment(self):
+        state = {
+            "metric": self.metric, "mode": self.mode,
+            "trials": [{
+                "trial_id": t.trial_id, "config": t.config,
+                "status": t.status, "results": t.results,
+                "error": t.error, "iteration": t.iteration,
+                "latest_checkpoint": getattr(t, "latest_checkpoint", None),
+            } for t in self.trials],
+        }
+        tmp = os.path.join(self.experiment_dir, ".experiment_state.tmp")
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f)
+        os.replace(tmp, os.path.join(self.experiment_dir,
+                                     "experiment_state.pkl"))
+
+
+def run(trainable, config: Optional[Dict[str, Any]] = None,
+        num_samples: int = 1, metric: str = "score", mode: str = "max",
+        scheduler: Optional[TrialScheduler] = None,
+        stop: Union[Dict[str, Any], Callable, None] = None,
+        resources_per_trial: Optional[dict] = None,
+        max_concurrent_trials: int = 0,
+        local_dir: str = "", name: str = "",
+        checkpoint_freq: int = 0,
+        seed: Optional[int] = None,
+        verbose: int = 1) -> ExperimentAnalysis:
+    """Run an experiment; returns an ExperimentAnalysis
+    (reference: tune.run, python/ray/tune/tune.py)."""
+    assert mode in ("max", "min"), "mode must be 'max' or 'min'"
+    configs = generate_configs(config or {}, num_samples, seed=seed)
+    if not configs:
+        configs = [{}]
+    base = local_dir or os.path.join(tempfile.gettempdir(), "ray_tpu_tune")
+    exp_name = name or f"exp_{int(time.time())}"
+    experiment_dir = os.path.join(base, exp_name)
+    os.makedirs(experiment_dir, exist_ok=True)
+
+    trials = [Trial(trainable, cfg, experiment_dir) for cfg in configs]
+    scheduler = scheduler or FIFOScheduler()
+    if isinstance(scheduler, PopulationBasedTraining) and not checkpoint_freq:
+        checkpoint_freq = scheduler.interval
+    runner = TrialRunner(
+        trials, scheduler, metric, mode, stop, resources_per_trial,
+        max_concurrent_trials or len(trials), experiment_dir,
+        checkpoint_freq=checkpoint_freq)
+
+    if verbose:
+        logger.info("tune: %d trials -> %s", len(trials), experiment_dir)
+    try:
+        while not runner.is_finished():
+            runner.step()
+    finally:
+        for t in trials:
+            if t.status == RUNNING:
+                t.stop(status=TERMINATED)
+    return ExperimentAnalysis(experiment_dir, trials=trials,
+                              metric=metric, mode=mode)

@@ -61,6 +61,30 @@ class _Coordinator:
         self.rounds: Dict[int, Dict[int, Any]] = {}
         self.fetched: Dict[int, int] = {}
         self.mailbox: Dict[tuple, Any] = {}   # (seq, src, dst) → payload
+        self.members: set = set()
+
+    def join(self, rank: int, world_size: Optional[int] = None) -> int:
+        if world_size is not None and world_size != self.world_size:
+            if not self.members:
+                # stale coordinator left over from a group whose ranks
+                # died without leaving: adopt the new group's config
+                self.world_size = world_size
+                self.rounds.clear()
+                self.fetched.clear()
+                self.mailbox.clear()
+            else:
+                raise RuntimeError(
+                    f"collective group already active with world_size="
+                    f"{self.world_size}, cannot join with {world_size}")
+        self.members.add(rank)
+        return len(self.members)
+
+    def leave(self, rank: int) -> int:
+        """Membership ref-count for destroy: only the LAST member's
+        destroy_collective_group may kill the coordinator, else ranks
+        still mid-collective would poll a dead actor."""
+        self.members.discard(rank)
+        return len(self.members)
 
     def contribute(self, seq: int, rank: int, payload) -> None:
         self.rounds.setdefault(seq, {})[rank] = payload
@@ -103,12 +127,17 @@ class _Group:
 
     def _exchange(self, payload) -> Dict[int, Any]:
         seq = self._next_seq()
-        ray_tpu.get(self.coord.contribute.remote(seq, self.rank, payload))
-        while True:
-            rnd = ray_tpu.get(self.coord.fetch.remote(seq))
-            if rnd is not None:
-                return rnd
-            time.sleep(_POLL_S)
+        try:
+            ray_tpu.get(self.coord.contribute.remote(seq, self.rank, payload))
+            while True:
+                rnd = ray_tpu.get(self.coord.fetch.remote(seq))
+                if rnd is not None:
+                    return rnd
+                time.sleep(_POLL_S)
+        except Exception as e:  # noqa: BLE001 — coordinator died/destroyed
+            raise RuntimeError(
+                f"collective group {self.name!r} coordinator unavailable "
+                f"(group destroyed or coordinator died): {e}") from e
 
 
 # per-process registry: group name → _Group
@@ -132,25 +161,28 @@ def init_collective_group(world_size: int, rank: int,
     coord_cls = ray_tpu.remote(_Coordinator).options(
         num_cpus=0, name=name, get_if_exists=True, lifetime="detached")
     coord = coord_cls.remote(world_size)
+    ray_tpu.get(coord.join.remote(rank, world_size))
     _groups[group_name] = _Group(group_name, rank, world_size, coord)
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
-    """Drop the local membership and kill the (detached) coordinator —
-    otherwise it leaks and a later same-named group with a different
-    world size would attach to the stale one."""
+    """Drop the local membership; the LAST member to leave kills the
+    (detached) coordinator — killing it earlier would strand peers that
+    are mid-collective, and leaking it would let a later same-named group
+    with a different world size attach to the stale one."""
     g = _groups.pop(group_name, None)
     coord = g.coord if g is not None else None
     if coord is None:
         try:
             coord = ray_tpu.get_actor(_COORD_PREFIX + group_name)
         except Exception:  # noqa: BLE001 - not found / not connected
-            coord = None
-    if coord is not None:
-        try:
+            return
+    try:
+        remaining = ray_tpu.get(coord.leave.remote(g.rank if g else -1))
+        if remaining == 0:
             ray_tpu.kill(coord)
-        except Exception:  # noqa: BLE001 - already dead
-            pass
+    except Exception:  # noqa: BLE001 - already dead
+        pass
 
 
 def get_rank(group_name: str = "default") -> int:

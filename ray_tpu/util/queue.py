@@ -44,6 +44,14 @@ class _QueueActor:
             accepted += 1
         return accepted
 
+    def put_all_or_nothing(self, items: List[Any]) -> bool:
+        """Atomic batch put: accept every item or none (a partial accept
+        would duplicate the accepted prefix when the caller retries)."""
+        if self.maxsize > 0 and len(self.items) + len(items) > self.maxsize:
+            return False
+        self.items.extend(items)
+        return True
+
     def get(self, n: int = 1) -> List[Any]:
         out = []
         while self.items and len(out) < n:
@@ -90,9 +98,10 @@ class Queue:
         self.put(item, block=False)
 
     def put_nowait_batch(self, items: List[Any]) -> None:
-        accepted = ray_tpu.get(self.actor.put.remote(list(items)))
-        if accepted != len(items):
-            raise Full(f"only {accepted}/{len(items)} items fit")
+        items = list(items)
+        if not ray_tpu.get(self.actor.put_all_or_nothing.remote(items)):
+            raise Full(f"{len(items)} items do not fit "
+                       f"(maxsize={self.maxsize})")
 
     def get(self, block: bool = True,
             timeout: Optional[float] = None) -> Any:

@@ -1,0 +1,149 @@
+"""Tune: TrialRunner, schedulers (ASHA / median / PBT), analysis.
+
+Mirrors the reference's tune test strategy (reference:
+python/ray/tune/tests/test_trial_scheduler.py, test_trial_runner_*.py):
+deterministic trainables with known metric slopes drive scheduler
+decisions that the tests assert on.
+"""
+
+import json
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.schedulers import (
+    AsyncHyperBandScheduler, MedianStoppingRule, PopulationBasedTraining,
+)
+
+
+def make_slope_trainable():
+    """score grows linearly with a config-determined slope; save/load
+    round-trips the accumulated state (for PBT exploit). Defined inside a
+    function so cloudpickle ships the class by value to workers."""
+
+    class SlopeTrainable:
+        def setup(self, config):
+            self.slope = config["slope"]
+            self.x = 0.0
+
+        def step(self):
+            self.x += self.slope
+            return {"score": self.x}
+
+        def save(self, path):
+            os.makedirs(path, exist_ok=True)
+            with open(os.path.join(path, "state.json"), "w") as f:
+                json.dump({"x": self.x}, f)
+
+        def load(self, path):
+            with open(os.path.join(path, "state.json")) as f:
+                self.x = json.load(f)["x"]
+
+    return SlopeTrainable
+
+
+def test_fifo_function_trainable(ray_start_4cpu, tmp_path):
+    def trainable(config):
+        for _ in range(3):
+            tune.report(score=config["lr"] * 10)
+
+    analysis = tune.run(
+        trainable, config={"lr": tune.grid_search([0.1, 1.0, 0.5])},
+        metric="score", mode="max", local_dir=str(tmp_path),
+        max_concurrent_trials=2)
+    assert analysis.best_config()["lr"] == 1.0
+    best = analysis.best_result()
+    assert best["score"] == pytest.approx(10.0)
+    assert len(analysis.trials) == 3
+    assert all(t["status"] == "TERMINATED" for t in analysis.trials)
+
+
+def test_stop_criteria_dict(ray_start_regular, tmp_path):
+    analysis = tune.run(
+        make_slope_trainable(), config={"slope": 1.0},
+        metric="score", mode="max", stop={"training_iteration": 5},
+        local_dir=str(tmp_path))
+    t = analysis.trials[0]
+    assert t["iteration"] == 5
+    assert t["results"][-1]["score"] == pytest.approx(5.0)
+
+
+def test_asha_early_stopping(ray_start_4cpu, tmp_path):
+    max_t = 16
+    sched = AsyncHyperBandScheduler(grace_period=2, max_t=max_t,
+                                    reduction_factor=2)
+    analysis = tune.run(
+        make_slope_trainable(),
+        config={"slope": tune.grid_search([0.1, 0.2, 0.4, 0.8, 1.2, 2.0])},
+        metric="score", mode="max", scheduler=sched,
+        stop={"training_iteration": max_t},
+        local_dir=str(tmp_path), max_concurrent_trials=4)
+    iters = {t["config"]["slope"]: t["iteration"] for t in analysis.trials}
+    # early stopping happened: the population did NOT all run to max_t
+    assert sum(iters.values()) < max_t * len(iters)
+    # and the best slope won
+    assert analysis.best_config()["slope"] == 2.0
+
+
+def test_median_stopping(ray_start_4cpu, tmp_path):
+    sched = MedianStoppingRule(grace_period=2, min_samples_required=3)
+    analysis = tune.run(
+        make_slope_trainable(),
+        config={"slope": tune.grid_search([0.1, 1.0, 1.0, 1.0])},
+        metric="score", mode="max", scheduler=sched,
+        stop={"training_iteration": 10},
+        local_dir=str(tmp_path), max_concurrent_trials=4)
+    iters = {t["trial_id"]: t["iteration"] for t in analysis.trials}
+    assert sum(iters.values()) < 10 * 4  # the 0.1-slope trial was cut
+    assert analysis.best_config()["slope"] == 1.0
+
+
+def test_pbt_exploit_explore(ray_start_4cpu, tmp_path):
+    sched = PopulationBasedTraining(
+        perturbation_interval=3,
+        hyperparam_mutations={"slope": [0.05, 0.1, 1.0, 2.0]},
+        quantile_fraction=0.25, resample_probability=0.5, seed=7)
+    analysis = tune.run(
+        make_slope_trainable(),
+        config={"slope": tune.grid_search([0.05, 0.1, 1.0, 2.0])},
+        metric="score", mode="max", scheduler=sched,
+        stop={"training_iteration": 12},
+        local_dir=str(tmp_path), max_concurrent_trials=4)
+    assert sched.num_exploits >= 1
+    # exploited trials cloned a leader's accumulated score: every
+    # surviving trial's final score should beat a never-exploited
+    # worst-case (0.05 * 12 = 0.6) by a wide margin for at least the top 2
+    finals = sorted(t["results"][-1]["score"] for t in analysis.trials
+                    if t["results"])
+    assert finals[-1] >= 12 * 2.0 * 0.9  # best slope ran ~uninterrupted
+
+
+def test_experiment_analysis_persistence(ray_start_regular, tmp_path):
+    tune.run(make_slope_trainable(), config={"slope": tune.grid_search([0.5, 1.5])},
+             metric="score", mode="max", stop={"training_iteration": 4},
+             local_dir=str(tmp_path), name="persist")
+    # reload from disk only
+    loaded = tune.ExperimentAnalysis(str(tmp_path / "persist"),
+                                     metric="score", mode="max")
+    assert loaded.best_config()["slope"] == 1.5
+    rows = loaded.results_df()
+    assert len(rows) == 2 and all("config/slope" in r for r in rows)
+
+
+def test_trial_error_isolated(ray_start_4cpu, tmp_path):
+    class Exploding(make_slope_trainable()):
+        def step(self):
+            if self.slope < 0:
+                raise RuntimeError("boom")
+            return super().step()
+
+    analysis = tune.run(
+        Exploding, config={"slope": tune.grid_search([-1.0, 1.0])},
+        metric="score", mode="max", stop={"training_iteration": 3},
+        local_dir=str(tmp_path))
+    by_slope = {t["config"]["slope"]: t for t in analysis.trials}
+    assert by_slope[-1.0]["status"] == "ERROR"
+    assert by_slope[1.0]["status"] == "TERMINATED"
+    assert analysis.best_config()["slope"] == 1.0
