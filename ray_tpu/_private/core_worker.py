@@ -55,7 +55,7 @@ class PendingTaskEntry:
     pending-task table, src/ray/core_worker/task_manager.h)."""
 
     __slots__ = ("spec", "num_retries_left", "return_ids", "dep_ids",
-                 "submitted_at", "lineage_pinned")
+                 "submitted_at", "lineage_pinned", "recovery_waiter")
 
     def __init__(self, spec: TaskSpec, return_ids: List[ObjectID]):
         self.spec = spec
@@ -64,6 +64,9 @@ class PendingTaskEntry:
         self.dep_ids = [ObjectID(b) for b in spec.dependency_ids()]
         self.submitted_at = time.time()
         self.lineage_pinned = False
+        # Future resolved on the next completion of this task (set by
+        # object recovery while it waits for the re-execution).
+        self.recovery_waiter = None
 
 
 class LeasedWorker:
@@ -313,6 +316,8 @@ class CoreWorker:
     def _fire_and_forget(self, coro):
         if self.loop.is_running():
             asyncio.run_coroutine_threadsafe(coro, self.loop)
+        else:
+            coro.close()  # interpreter teardown: drop without a warning
 
     async def _get_owner_conn(self, address: str) -> rpc.Connection:
         if address == self.address:
@@ -380,15 +385,17 @@ class CoreWorker:
 
     # -------------------------------------------------------- release paths
 
-    def _on_object_released(self, oid: ObjectID):
-        """Last reference anywhere dropped: delete the value everywhere."""
+    def _on_object_released(self, oid: ObjectID, record):
+        """Last reference anywhere dropped: delete the value everywhere.
+        ``record`` is the popped Reference — the live table no longer has
+        this id, so ownership/locations must come from the record."""
         self.memory_store.delete(oid)
         with self._attached_lock:
             att = self._attached.pop(oid, None)
         if att is not None:
             att.close()
-        locations = self.reference_counter.get_locations(oid)
-        if self.reference_counter.is_owned(oid) or locations:
+        if record.owned and record.in_plasma:
+            locations = sorted(record.locations or ())
             self._fire_and_forget(self._free_remote(oid, locations))
 
     async def _free_remote(self, oid: ObjectID, locations):
@@ -544,6 +551,12 @@ class CoreWorker:
                 if not recovered:
                     raise exc.ObjectLostError(
                         oid.hex(), reply.get("reason", "pull failed"))
+                # The re-executed task may have returned the value (or an
+                # error object) inline this time — prefer the memory store
+                # over another plasma round trip.
+                obj = self.memory_store.get_if_exists(oid)
+                if obj is not None and obj is not IN_PLASMA:
+                    return self._deserialize_obj(obj)
                 reply, _ = await self.raylet_conn.call(
                     "EnsureObjectLocal",
                     {"object_id": oid.binary(), "owner_address": owner_address})
@@ -569,15 +582,22 @@ class CoreWorker:
             return False
         logger.info("reconstructing %s by resubmitting task %s",
                     oid.hex()[:16], entry.spec.name)
-        self.stats["tasks_retried"] += 1
-        self._queue_spec(entry.spec)
-        # Wait for the resubmitted task to complete again.
-        for _ in range(600):
-            await asyncio.sleep(0.05)
-            obj = self.memory_store.get_if_exists(oid)
-            if obj is not None:
-                return True
-        return False
+        # The memory store still holds the stale IN_PLASMA marker, so
+        # polling it would return immediately — wait for the actual task
+        # completion instead. One shared waiter per entry: concurrent
+        # recoveries of sibling returns resubmit the task ONCE and all
+        # await the same future (shield: one caller timing out must not
+        # cancel it for the rest).
+        if entry.recovery_waiter is None:
+            entry.recovery_waiter = self.loop.create_future()
+            self.stats["tasks_retried"] += 1
+            self._queue_spec(entry.spec)
+        waiter = entry.recovery_waiter
+        try:
+            await asyncio.wait_for(asyncio.shield(waiter), timeout=30.0)
+        except asyncio.TimeoutError:
+            return False
+        return bool(waiter.result())
 
     # ---------------------------------------------------------------- wait
 
@@ -963,6 +983,11 @@ class CoreWorker:
                     obj.contained_refs = contained
                 self.memory_store.put(oid, obj)
         self.stats["tasks_finished"] += 1
+        waiter = entry.recovery_waiter
+        if waiter is not None:
+            entry.recovery_waiter = None
+            if not waiter.done():
+                waiter.set_result(True)
         if not spec.is_actor_task():
             self.reference_counter.update_finished_task_references(
                 [ObjectID(b) for b in spec.dependency_ids()])
@@ -975,6 +1000,14 @@ class CoreWorker:
         task_id = TaskID(spec.task_id)
         for i in range(spec.num_returns):
             self.memory_store.put(task_id.object_id(i + 1), serialized)
+        # A recovery waiting on this task must learn the outcome NOW (the
+        # error value landed in the memory store) rather than time out.
+        entry = self.pending_tasks.get(spec.task_id)
+        if entry is not None and entry.recovery_waiter is not None:
+            waiter = entry.recovery_waiter
+            entry.recovery_waiter = None
+            if not waiter.done():
+                waiter.set_result(True)
         self.reference_counter.update_finished_task_references(
             [ObjectID(b) for b in spec.dependency_ids()])
 

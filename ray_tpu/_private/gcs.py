@@ -114,6 +114,7 @@ class GcsServer:
             "CreatePlacementGroup": self.handle_create_placement_group,
             "RemovePlacementGroup": self.handle_remove_placement_group,
             "GetPlacementGroup": self.handle_get_placement_group,
+            "GetAllPlacementGroups": self.handle_get_all_placement_groups,
             "ReportResourceUsage": self.handle_report_resource_usage,
             "GetClusterResources": self.handle_get_cluster_resources,
             "AddProfileEvents": self.handle_add_profile_events,
@@ -605,6 +606,9 @@ class GcsServer:
         if pg is None:
             return {"found": False}
         return {"found": True, **pg}
+
+    async def handle_get_all_placement_groups(self, conn, header, bufs):
+        return {"placement_groups": list(self.placement_groups.values())}
 
     # --------------------------------------------------------------- events
 

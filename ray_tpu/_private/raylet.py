@@ -27,7 +27,7 @@ import signal
 import subprocess
 import sys
 import time
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import rpc
 from ray_tpu._private.config import RayTpuConfig
@@ -116,6 +116,13 @@ class Raylet:
         self._hb_task: Optional[asyncio.Task] = None
         self._tick_scheduled = False
         self._closing = False
+        # Pull state (reference: PullManager): dedupe + admission control.
+        self._active_pulls: Dict[ObjectID, asyncio.Task] = {}
+        self._pull_inflight_bytes = 0
+        # Serve-side attachment cache: chunked pulls hit the same segment
+        # many times; re-mmap'ing per chunk would sit on the transfer hot
+        # path (reference: ObjectBufferPool holds chunk buffers open).
+        self._serve_attachments: Dict[str, Any] = {}
         self.num_leases_granted = 0
         self.num_spillbacks = 0
 
@@ -130,7 +137,7 @@ class Raylet:
             "SealObject": self.handle_seal_object,
             "GetObjectInfo": self.handle_get_object_info,
             "EnsureObjectLocal": self.handle_ensure_object_local,
-            "FetchObject": self.handle_fetch_object,
+            "FetchObjectChunk": self.handle_fetch_object_chunk,
             "PinObject": self.handle_pin_object,
             "FreeObject": self.handle_free_object,
             "PreparePGBundle": self.handle_prepare_pg_bundle,
@@ -185,6 +192,12 @@ class Raylet:
             except (ConnectionError, asyncio.TimeoutError):
                 pass
             await self.gcs_conn.close()
+        for att in self._serve_attachments.values():
+            try:
+                att.close()
+            except BufferError:
+                pass
+        self._serve_attachments.clear()
         self.store.shutdown()
 
     async def _heartbeat_loop(self):
@@ -630,6 +643,14 @@ class Raylet:
 
     async def handle_free_object(self, conn, header, bufs):
         oid = ObjectID(header["object_id"])
+        entry = self.store._objects.get(oid)  # noqa: SLF001
+        if entry is not None:
+            att = self._serve_attachments.pop(entry[0], None)
+            if att is not None:
+                try:
+                    att.close()
+                except BufferError:
+                    pass
         self.store.free(oid)
 
         # Owner-supplied location list: forward the free to every other node
@@ -650,19 +671,35 @@ class Raylet:
             await asyncio.gather(*[_free_on(nid) for nid in peers])
         return {"ok": True}
 
-    async def handle_fetch_object(self, conn, header, bufs):
-        """Serve a remote raylet's pull: return the raw segment bytes."""
+    async def handle_fetch_object_chunk(self, conn, header, bufs):
+        """Serve one chunk of a remote raylet's pull (reference: the chunked
+        Push path, src/ray/object_manager/push_manager.h — chunks bounded by
+        object_manager_chunk_size so no single frame carries a whole large
+        object)."""
         oid = ObjectID(header["object_id"])
         segment = self.store.lookup(oid)
         if segment is None:
             return {"found": False}
-        from multiprocessing import shared_memory
-        shm = shared_memory.SharedMemory(name=segment)
+        offset = header["offset"]
+        length = header["length"]
+        shm = self._serve_attachments.get(segment)
+        if shm is None:
+            from multiprocessing import shared_memory
+            shm = shared_memory.SharedMemory(name=segment)
+            # bounded cache: drop the oldest attachment beyond 16
+            while len(self._serve_attachments) >= 16:
+                oldest = next(iter(self._serve_attachments))
+                old = self._serve_attachments.pop(oldest)
+                try:
+                    old.close()
+                except BufferError:
+                    pass  # a concurrent chunk read still holds the buf
+            self._serve_attachments[segment] = shm
         entry = self.store._objects.get(oid)  # noqa: SLF001
-        size = entry[1] if entry is not None else shm.size
-        data = bytes(shm.buf[:size])
-        shm.close()
-        return {"found": True}, [data]
+        total = entry[1] if entry is not None else shm.size
+        end = min(offset + length, total)
+        data = bytes(shm.buf[offset:end]) if end > offset else b""
+        return {"found": True, "total_size": total}, [data]
 
     async def handle_ensure_object_local(self, conn, header, bufs):
         """Pull an object into the local store from wherever it lives
@@ -670,7 +707,18 @@ class Raylet:
         oid = ObjectID(header["object_id"])
         if self.store.contains(oid):
             return {"ok": True, "segment": self.store.lookup(oid)}
-        owner_address = header.get("owner_address", "")
+        # Dedupe concurrent pulls of the same object (reference:
+        # PullManager bundles many requests for one object into one pull).
+        pull = self._active_pulls.get(oid)
+        if pull is None:
+            pull = asyncio.get_running_loop().create_task(
+                self._pull_object(oid, header.get("owner_address", "")))
+            self._active_pulls[oid] = pull
+            pull.add_done_callback(
+                lambda _: self._active_pulls.pop(oid, None))
+        return await asyncio.shield(pull)
+
+    async def _pull_object(self, oid: ObjectID, owner_address: str) -> dict:
         locations: List[bytes] = []
         if owner_address:
             try:
@@ -688,43 +736,105 @@ class Raylet:
                 continue
             try:
                 peer = await self._peer_conn(info["address"])
-                reply, rbufs = await peer.call("FetchObject",
-                                               {"object_id": oid.binary()})
-                if reply.get("found"):
-                    data = rbufs[0]
-                    from multiprocessing import shared_memory
-                    import secrets as _secrets
-                    from ray_tpu._private.shm_store import _untrack
-                    name = f"rtpu_{_secrets.token_hex(8)}"
-                    shm = shared_memory.SharedMemory(
-                        name=name, create=True, size=max(len(data), 1))
-                    _untrack(shm)  # created here; store owns its lifetime
-                    shm.buf[:len(data)] = data
-                    shm.close()
-                    if self.store.seal(oid, name, len(data)):
-                        # Report the replica to the owner so its location
-                        # index stays complete and FreeObject reaches this
-                        # node too (reference: ObjectDirectory location adds).
-                        if owner_address:
-                            async def _report(addr=owner_address):
-                                try:
-                                    owner = await self._owner_conn(addr)
-                                    r, _ = await owner.call(
-                                        "AddObjectLocation", {
-                                            "object_id": oid.binary(),
-                                            "node_id":
-                                                self.node_id.binary()})
-                                    if not r.get("ok"):
-                                        # owner already released the
-                                        # object — drop our replica
-                                        self.store.free(oid)
-                                except Exception:  # noqa: BLE001
-                                    pass
-                            asyncio.get_running_loop().create_task(_report())
-                        return {"ok": True, "segment": name}
+                pulled = await self._pull_chunked(oid, peer)
             except ConnectionError:
                 continue
+            if pulled is None:
+                continue
+            name, total = pulled
+            if self.store.seal(oid, name, total):
+                # Report the replica to the owner so its location index
+                # stays complete and FreeObject reaches this node too
+                # (reference: ObjectDirectory location adds).
+                if owner_address:
+                    async def _report(addr=owner_address):
+                        try:
+                            owner = await self._owner_conn(addr)
+                            r, _ = await owner.call(
+                                "AddObjectLocation", {
+                                    "object_id": oid.binary(),
+                                    "node_id": self.node_id.binary()})
+                            if not r.get("ok"):
+                                # owner already released the object —
+                                # drop our replica
+                                self.store.free(oid)
+                        except Exception:  # noqa: BLE001
+                            pass
+                    asyncio.get_running_loop().create_task(_report())
+                return {"ok": True, "segment": name}
         return {"ok": False, "reason": "object not found at any location"}
+
+    async def _pull_chunked(self, oid: ObjectID,
+                            peer: rpc.Connection
+                            ) -> Optional[Tuple[str, int]]:
+        """Windowed chunk pull into a fresh local segment; returns
+        (segment_name, total_size) (reference: PushManager's chunk window
+        + ObjectBufferPool chunk writes). Admission: total in-flight pull
+        bytes are bounded so concurrent pulls cannot overcommit the store
+        (reference: pull_manager.h:47 admission control)."""
+        chunk = self.config.object_manager_chunk_size
+        reply, rbufs = await peer.call("FetchObjectChunk", {
+            "object_id": oid.binary(), "offset": 0, "length": chunk})
+        if not reply.get("found"):
+            return None
+        total = reply["total_size"]
+        # admission: wait until the in-flight pull budget has room
+        budget = max(self.store.capacity // 4, chunk)
+        while self._pull_inflight_bytes > 0 and \
+                self._pull_inflight_bytes + total > budget:
+            await asyncio.sleep(0.005)
+        self._pull_inflight_bytes += total
+        try:
+            from multiprocessing import shared_memory
+            import secrets as _secrets
+            from ray_tpu._private.shm_store import _untrack
+            name = f"rtpu_{_secrets.token_hex(8)}"
+            shm = shared_memory.SharedMemory(
+                name=name, create=True, size=max(total, 1))
+            _untrack(shm)  # created here; store owns its lifetime
+            first = rbufs[0]
+            shm.buf[:len(first)] = first
+            offsets = list(range(chunk, total, chunk))
+            window = asyncio.Semaphore(8)
+
+            async def _fetch_at(off: int):
+                async with window:
+                    r, bufs2 = await peer.call("FetchObjectChunk", {
+                        "object_id": oid.binary(), "offset": off,
+                        "length": chunk})
+                    if not r.get("found"):
+                        raise ConnectionError("object vanished mid-pull")
+                    shm.buf[off:off + len(bufs2[0])] = bufs2[0]
+
+            loop = asyncio.get_running_loop()
+            tasks = [loop.create_task(_fetch_at(o)) for o in offsets]
+            try:
+                if tasks:
+                    await asyncio.gather(*tasks)
+            except (ConnectionError, asyncio.CancelledError):
+                # Stop the in-flight siblings BEFORE the segment goes
+                # away — an orphan write into a closed mmap raises and
+                # leaks "exception never retrieved" noise.
+                for t in tasks:
+                    t.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+                shm.close()
+                self._unlink_segment(name)
+                return None
+            shm.close()
+            return name, total
+        finally:
+            self._pull_inflight_bytes -= total
+
+    @staticmethod
+    def _unlink_segment(name: str):
+        from multiprocessing import shared_memory
+        try:
+            shm = shared_memory.SharedMemory(name=name)
+            shm.close()
+            shm.unlink()
+        except Exception:  # noqa: BLE001 — already gone
+            pass
 
     async def _peer_conn(self, address: str) -> rpc.Connection:
         conn = self._peer_raylets.get(address)

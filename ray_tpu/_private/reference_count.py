@@ -71,7 +71,9 @@ class ReferenceCounter:
         # Fired to tell a remote owner we dropped a borrowed ref.
         self._on_borrow_removed: List[Callable[[ObjectID, str], None]] = []
 
-    def add_release_callback(self, cb: Callable[[ObjectID], None]):
+    def add_release_callback(self, cb: Callable[[ObjectID, "Reference"], None]):
+        """``cb(object_id, released_record)`` — the record is already out
+        of the table; its ``owned``/``locations`` drive data deletion."""
         self._on_release.append(cb)
 
     def add_borrow_removed_callback(self, cb: Callable[[ObjectID, str], None]):
@@ -242,7 +244,11 @@ class ReferenceCounter:
         self._maybe_release(object_id)
 
     def _maybe_release(self, object_id: ObjectID) -> None:
-        to_release: List[ObjectID] = []
+        # Release callbacks receive the popped Reference record: the entry
+        # leaves the table BEFORE callbacks fire (so late borrower/location
+        # reports can't resurrect it), but the callback still needs the
+        # ownership bit and the location set to free remote replicas.
+        to_release: List[tuple] = []
         with self._lock:
             ref = self._refs.get(object_id)
             if ref is None or ref.freed or not ref.is_releasable():
@@ -256,7 +262,7 @@ class ReferenceCounter:
                 if r.freed:
                     continue
                 r.freed = True
-                to_release.append(oid)
+                to_release.append((oid, r))
                 for inner in list(r.contains or ()):
                     iref = self._refs.get(inner)
                     if iref is None:
@@ -265,12 +271,12 @@ class ReferenceCounter:
                         iref.contained_in.discard(oid)
                     if iref.is_releasable() and not iref.freed:
                         stack.append((inner, iref))
-            for oid in to_release:
+            for oid, _ in to_release:
                 self._refs.pop(oid, None)
-        for oid in to_release:
+        for oid, r in to_release:
             for cb in self._on_release:
                 try:
-                    cb(oid)
+                    cb(oid, r)
                 except Exception:
                     logger.exception("release callback failed")
 

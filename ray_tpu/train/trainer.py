@@ -247,4 +247,6 @@ class Trainer:
                 pass
             self._wg.shutdown()
             self._wg = None
-            destroy_collective_group(self._group_name)
+            # force: every rank is gone; a rank that crashed before
+            # leaving must not leak the detached coordinator
+            destroy_collective_group(self._group_name, force=True)

@@ -49,6 +49,8 @@ class TrialRunner:
         self.experiment_dir = experiment_dir
         self.checkpoint_freq = checkpoint_freq
         self._pending: Dict[Any, Trial] = {}  # result future -> trial
+        self._last_ckpt = 0.0
+        self.checkpoint_period_s = 5.0
         scheduler.set_objective(metric, mode)
 
     # ------------------------------------------------------------- plumbing
@@ -95,7 +97,7 @@ class TrialRunner:
             logger.warning("trial %s errored: %s", t.trial_id, e)
             t.error = repr(e)
             t.stop(status=ERROR)
-            self._checkpoint_experiment()
+            self._checkpoint_experiment(force=True)
             return
         if done and metrics is None:
             self._complete(t)
@@ -142,7 +144,7 @@ class TrialRunner:
     def _complete(self, t: Trial):
         self.scheduler.on_trial_complete(self, t)
         t.stop(status=TERMINATED)
-        self._checkpoint_experiment()
+        self._checkpoint_experiment(force=True)
 
     # ------------------------------------------------------------ PBT hook
 
@@ -169,7 +171,15 @@ class TrialRunner:
 
     # --------------------------------------------------------- persistence
 
-    def _checkpoint_experiment(self):
+    def _checkpoint_experiment(self, force: bool = False):
+        # Re-pickling every result after EVERY event is O((trials *
+        # results)^2) disk traffic over an experiment: throttle periodic
+        # snapshots; trial state transitions (complete/error) force one
+        # (reference: TrialRunner checkpoint_period).
+        now = time.time()
+        if not force and now - self._last_ckpt < self.checkpoint_period_s:
+            return
+        self._last_ckpt = now
         state = {
             "metric": self.metric, "mode": self.mode,
             "trials": [{

@@ -7,6 +7,12 @@ ParallelIterator (util/iter.py), collective groups
 """
 
 from ray_tpu.util.actor_pool import ActorPool  # noqa: F401
+from ray_tpu.util.placement_group import (  # noqa: F401
+    PlacementGroup,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
 from ray_tpu.util.queue import Empty, Full, Queue  # noqa: F401
 from ray_tpu.util.iter import (  # noqa: F401
     ParallelIterator,

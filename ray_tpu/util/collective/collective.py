@@ -165,11 +165,16 @@ def init_collective_group(world_size: int, rank: int,
     _groups[group_name] = _Group(group_name, rank, world_size, coord)
 
 
-def destroy_collective_group(group_name: str = "default") -> None:
+def destroy_collective_group(group_name: str = "default",
+                             force: bool = False) -> None:
     """Drop the local membership; the LAST member to leave kills the
     (detached) coordinator — killing it earlier would strand peers that
     are mid-collective, and leaking it would let a later same-named group
-    with a different world size attach to the stale one."""
+    with a different world size attach to the stale one.
+
+    ``force=True`` kills the coordinator unconditionally — the recovery
+    path for groups whose members died without leaving (an owner that
+    already tore down every rank, e.g. Trainer.shutdown, uses this)."""
     g = _groups.pop(group_name, None)
     coord = g.coord if g is not None else None
     if coord is None:
@@ -178,6 +183,9 @@ def destroy_collective_group(group_name: str = "default") -> None:
         except Exception:  # noqa: BLE001 - not found / not connected
             return
     try:
+        if force:
+            ray_tpu.kill(coord)
+            return
         remaining = ray_tpu.get(coord.leave.remote(g.rank if g else -1))
         if remaining == 0:
             ray_tpu.kill(coord)

@@ -1,0 +1,81 @@
+"""Placement group client API.
+
+Role parity: reference ray.util.placement_group
+(reference: python/ray/util/placement_group.py — placement_group(),
+PlacementGroup.ready(), remove_placement_group, placement_group_table).
+The GCS runs the 2PC prepare/commit against raylets
+(ray_tpu/_private/gcs.py handle_create_placement_group); tasks/actors
+join a group via the ``placement_group=`` option.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu import worker as worker_mod
+from ray_tpu._private.ids import PlacementGroupID
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID,
+                 bundles: List[Dict[str, float]]):
+        self.id = pg_id
+        self.bundle_specs = bundles
+
+    def ready(self, timeout: float = 30.0) -> bool:
+        """Block until the group is placed (reference: pg.ready() — there
+        it returns an ObjectRef; here it blocks directly)."""
+        w = worker_mod._require_connected()
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            reply, _ = w.core._run(w.core.gcs_conn.call(
+                "GetPlacementGroup", {"pg_id": self.id.binary()}))
+            if reply.get("found") and reply["state"] == "CREATED":
+                return True
+            if reply.get("found") and reply["state"] == "REMOVED":
+                return False
+            time.sleep(0.05)
+        return False
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundle_specs)
+
+    def __repr__(self):
+        return f"PlacementGroup({self.id.hex()[:12]}, {self.bundle_specs})"
+
+
+def placement_group(bundles: List[Dict[str, float]],
+                    strategy: str = "PACK",
+                    name: str = "") -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"invalid strategy {strategy!r}; "
+                         f"must be one of {VALID_STRATEGIES}")
+    if not bundles or any(not b for b in bundles):
+        raise ValueError("bundles must be a non-empty list of non-empty "
+                         "resource dicts")
+    w = worker_mod._require_connected()
+    pg_id = PlacementGroupID.from_random()
+    w.core._run(w.core.gcs_conn.call("CreatePlacementGroup", {
+        "pg_id": pg_id.binary(), "bundles": bundles,
+        "strategy": strategy, "name": name}))
+    return PlacementGroup(pg_id, bundles)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    w = worker_mod._require_connected()
+    w.core._run(w.core.gcs_conn.call(
+        "RemovePlacementGroup", {"pg_id": pg.id.binary()}))
+
+
+def placement_group_table() -> Dict[str, dict]:
+    w = worker_mod._require_connected()
+    reply, _ = w.core._run(w.core.gcs_conn.call(
+        "GetAllPlacementGroups", {}))
+    return {PlacementGroupID(p["pg_id"]).hex(): {
+        "state": p["state"], "bundles": p["bundles"],
+        "strategy": p["strategy"], "name": p.get("name", ""),
+    } for p in reply.get("placement_groups", [])}

@@ -1,0 +1,128 @@
+"""Object-plane behaviors: spill/restore under pressure, cancel, lineage
+reconstruction after node loss.
+
+Reference coverage model: python/ray/tests/test_object_spilling.py,
+test_cancel.py, test_reconstruction.py.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu import exceptions as exc
+
+
+def _stats(raylet_address: str) -> dict:
+    from ray_tpu._private import rpc
+
+    async def _q():
+        conn = await rpc.connect(raylet_address, peer_name="test-stats")
+        try:
+            reply, _ = await conn.call("GetNodeStats", {})
+            return reply
+        finally:
+            await conn.close()
+
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(_q())
+    finally:
+        loop.close()
+
+
+def test_spill_and_restore_under_pressure(tmp_path):
+    """Pinned primaries spill to disk when the store overfills, and a
+    later get restores them (reference: LocalObjectManager spill/restore,
+    local_object_manager.h:90,:109)."""
+    ray_tpu.init(num_cpus=1, object_store_memory=4 * 1024 * 1024)
+    try:
+        mb = 1024 * 1024
+        refs = [ray_tpu.put(np.full(mb // 8, i, dtype=np.float64))
+                for i in range(6)]  # 6 MB into a 4 MB store
+        # every value still readable — early ones restored from spill
+        for i, r in enumerate(refs):
+            val = ray_tpu.get(r)
+            assert val[0] == float(i) and len(val) == mb // 8
+        node = ray_tpu.worker.global_worker.node
+        stats = node.raylet.store.stats()
+        assert stats["num_spills"] >= 1, stats
+        assert stats["num_restores"] >= 1, stats
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_cancel_queued_task():
+    """Cancelling a not-yet-running task makes get() raise
+    TaskCancelledError (reference: test_cancel.py)."""
+    ray_tpu.init(num_cpus=1)
+    try:
+        @ray_tpu.remote
+        def slow(t):
+            time.sleep(t)
+            return t
+
+        blocker = slow.remote(3.0)
+        queued = [slow.remote(0.0) for _ in range(20)]
+        victim = queued[-1]
+        ray_tpu.cancel(victim)
+        with pytest.raises((exc.TaskCancelledError, exc.RayTaskError)):
+            ray_tpu.get(victim, timeout=20)
+        assert ray_tpu.get(blocker) == 3.0
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_lineage_reconstruction_after_node_loss():
+    """Losing every copy of a task return triggers resubmission of the
+    creating task on a surviving node (reference: ObjectRecoveryManager,
+    object_recovery_manager.h:92 + test_reconstruction.py)."""
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    a = c.add_node(num_cpus=1, resources={"spot": 1})
+    b = c.add_node(num_cpus=1, resources={"spot": 1})
+    c.connect()
+    try:
+        @ray_tpu.remote(resources={"spot": 1}, max_retries=2)
+        def produce():
+            import numpy as np
+            return np.arange(200_000)  # 1.6 MB -> plasma on the spot node
+
+        ref = produce.remote()
+        assert ray_tpu.get(ref)[-1] == 199_999
+        # find which node executed it and kill that node
+        sa, sb = _stats(a.raylet_address), _stats(b.raylet_address)
+        holder, other = (a, b) if sa["store"]["num_objects"] else (b, a)
+        c.remove_node(holder)  # SIGKILL: the only data copy dies with it
+        c.wait_for_nodes(2, timeout=30)
+        # the driver's pulled copy? The driver attached via head raylet -
+        # drop the cached attachment to force a fresh pull
+        core = ray_tpu.worker.global_worker.core
+        with core._attached_lock:
+            for att in core._attached.values():
+                att.close()
+            core._attached.clear()
+        head_stats = _stats(c.head.raylet_address)
+        if head_stats["store"]["num_objects"]:
+            # head holds a replica; free it so the get must reconstruct
+            from ray_tpu._private import rpc as _rpc
+
+            async def _free():
+                conn = await _rpc.connect(c.head.raylet_address,
+                                          peer_name="t")
+                try:
+                    await conn.call("FreeObject",
+                                    {"object_id": ref.object_id.binary()})
+                finally:
+                    await conn.close()
+            loop = asyncio.new_event_loop()
+            loop.run_until_complete(_free())
+            loop.close()
+        out = ray_tpu.get(ref, timeout=60)
+        assert out[-1] == 199_999
+        assert core.stats["tasks_retried"] >= 1
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()

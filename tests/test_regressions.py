@@ -86,7 +86,7 @@ def test_nested_containment_release():
     """Grandchild containment edges must drop when ancestors release."""
     rc = ReferenceCounter(own_address="me")
     released = []
-    rc.add_release_callback(released.append)
+    rc.add_release_callback(lambda oid, record: released.append(oid))
 
     t = TaskID.from_random()
     x, lst, outer = t.object_id(1), t.object_id(2), t.object_id(3)
