@@ -21,6 +21,10 @@ from ray_tpu.worker import (  # noqa: F401
     available_resources,
     cancel,
     cluster_resources,
+    experimental_internal_kv_del,
+    experimental_internal_kv_get,
+    experimental_internal_kv_list,
+    experimental_internal_kv_put,
     get,
     get_runtime_context,
     init,

@@ -82,6 +82,11 @@ class RayTpuConfig:
     rpc_connect_timeout_s: float = 10.0
     rpc_frame_max_bytes: int = 1 << 31
     gcs_port: int = 0
+    # Append-only metadata journal for GCS restart recovery ("" = off)
+    # (reference: GcsTableStorage persistence + GcsInitData reload).
+    gcs_journal_path: str = ""
+    # How long a raylet keeps retrying to reach a restarting GCS.
+    gcs_reconnect_timeout_s: float = 60.0
 
     # --- observability ---
     event_log_enabled: bool = True

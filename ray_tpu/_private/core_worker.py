@@ -156,6 +156,7 @@ class CoreWorker:
         self.actor_handles: Dict[bytes, Any] = {}
 
         self.gcs_conn: Optional[rpc.Connection] = None
+        self._gcs_reconnect_lock = asyncio.Lock()
         self.raylet_conn: Optional[rpc.Connection] = None
         self._server = rpc.RpcServer(self._owner_handlers(), name=f"cw-{mode}")
         self.address = ""
@@ -269,13 +270,40 @@ class CoreWorker:
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
         return fut.result(timeout)
 
+    async def _gcs_call(self, method: str, header=None, bufs=(),
+                        timeout=None):
+        """GCS RPC with one transparent redial: a restarted GCS (journal
+        replay) drops every connection; callers should not fail for that
+        (reference: workers re-resolve the GCS address on failover,
+        core_worker/gcs_server_address_updater.cc). Retried methods must
+        be idempotent server-side (RegisterActor dedupes by actor id)."""
+        try:
+            return await self.gcs_conn.call(method, header, bufs=bufs,
+                                            timeout=timeout)
+        except ConnectionError:
+            if self._shutdown:
+                raise
+            # One reconnect at a time: concurrent failures reuse the
+            # winner's connection instead of each dialing (and double-
+            # subscribing) their own.
+            async with self._gcs_reconnect_lock:
+                if self.gcs_conn is None or self.gcs_conn.closed:
+                    conn = await rpc.connect(
+                        self.gcs_address,
+                        handlers={"Published": self._handle_published},
+                        peer_name="gcs")
+                    await conn.call("Subscribe", {"channel": "ACTOR"})
+                    self.gcs_conn = conn
+            return await self.gcs_conn.call(method, header, bufs=bufs,
+                                            timeout=timeout)
+
     # ------------------------------------------------------------ KV helpers
 
     def _kv_put_sync(self, key: bytes, value: bytes):
-        self._run(self.gcs_conn.call("KVPut", {"key": key}, bufs=[value]))
+        self._run(self._gcs_call("KVPut", {"key": key}, bufs=[value]))
 
     def _kv_get_sync(self, key: bytes) -> Optional[bytes]:
-        header, bufs = self._run(self.gcs_conn.call("KVGet", {"key": key}))
+        header, bufs = self._run(self._gcs_call("KVGet", {"key": key}))
         return bufs[0] if header.get("found") else None
 
     # --------------------------------------------------------- ref reducers
@@ -1044,7 +1072,7 @@ class CoreWorker:
         header["lifetime_resources"] = lifetime_resources
         header["pg_id"] = placement_group_id
         header["pg_bundle"] = placement_group_bundle_index
-        self._run(self.gcs_conn.call("RegisterActor", {
+        self._run(self._gcs_call("RegisterActor", {
             "actor_id": actor_id, "spec": header,
             "name": actor_name, "namespace": namespace,
             "max_restarts": max_restarts, "job_id": self.job_id,
@@ -1144,7 +1172,7 @@ class CoreWorker:
                     return  # a concurrent resolve already connected
                 if self.gcs_conn is None or self.gcs_conn.closed:
                     return
-                reply, _ = await self.gcs_conn.call(
+                reply, _ = await self._gcs_call(
                     "GetActorInfo", {"actor_id": q.actor_id})
                 if not reply.get("found"):
                     await asyncio.sleep(0.05)
@@ -1232,7 +1260,7 @@ class CoreWorker:
             q.buffer.extendleft(reversed(requeue))
 
     def kill_actor(self, actor_id: bytes, no_restart: bool = True):
-        self._run(self.gcs_conn.call("KillActor", {
+        self._run(self._gcs_call("KillActor", {
             "actor_id": actor_id, "no_restart": no_restart}))
 
     async def _handle_published(self, conn, header, bufs):

@@ -86,6 +86,9 @@ class GcsServer:
         self._monitor_task: Optional[asyncio.Task] = None
         self._profile_events: List[dict] = []
         self._cluster_events: List[dict] = []
+        # Optional append-only journal (reference: GcsTableStorage +
+        # GcsInitData reload) — enabled via config.gcs_journal_path.
+        self.journal = None
 
     # ------------------------------------------------------------------ wiring
 
@@ -124,9 +127,21 @@ class GcsServer:
         }
 
     async def start(self, address: str = "") -> str:
+        journal_path = getattr(self.config, "gcs_journal_path", "")
+        if journal_path:
+            self._replay_journal(journal_path)
+            from ray_tpu._private.gcs_storage import GcsJournal
+            self.journal = GcsJournal(journal_path)
         addr = await self._server.listen(address)
         self._monitor_task = asyncio.get_running_loop().create_task(
             self._liveness_monitor())
+        # Actors caught mid-scheduling by a crash (journaled PENDING /
+        # RESTARTING) need their scheduling loop restarted — raylets
+        # re-register within the loop's retry window.
+        for actor in self.actors.values():
+            if actor.state in (ACTOR_PENDING, ACTOR_RESTARTING):
+                asyncio.get_running_loop().create_task(
+                    self._schedule_actor(actor))
         logger.info("GCS listening at %s", addr)
         return addr
 
@@ -134,6 +149,78 @@ class GcsServer:
         if self._monitor_task:
             self._monitor_task.cancel()
         await self._server.close()
+        if self.journal is not None:
+            self.journal.close()
+
+    # ----------------------------------------------------------- persistence
+
+    def _journal_append(self, op: str, payload):
+        if self.journal is not None:
+            self.journal.append(op, payload)
+
+    def _journal_actor(self, actor: "ActorEntry"):
+        """Persist an actor's full mutable state (replayed last-wins)."""
+        self._journal_append("actor_update", {
+            "actor_id": actor.actor_id, "state": actor.state,
+            "address": actor.address, "node_id": actor.node_id,
+            "incarnation": actor.incarnation,
+            "num_restarts": actor.num_restarts,
+            "max_restarts": actor.max_restarts,
+            "death_cause": actor.death_cause,
+        })
+
+    def _replay_journal(self, path: str):
+        """Rebuild tables from the journal (reference: GcsInitData load on
+        gcs_server restart). Nodes are NOT replayed — live raylets
+        re-register over fresh connections."""
+        from ray_tpu._private import gcs_storage
+
+        n = 0
+        max_job = 0
+        for op, p in gcs_storage.replay(path):
+            n += 1
+            if op == "job_add":
+                self.jobs[p["job_id"]] = p["record"]
+                max_job = max(max_job, p.get("job_num", 0))
+            elif op == "job_finish":
+                job = self.jobs.get(p["job_id"])
+                if job:
+                    job["finished"] = True
+            elif op == "kv_put":
+                self.kv[p["key"]] = p["value"]
+            elif op == "kv_del":
+                self.kv.pop(p["key"], None)
+            elif op == "actor_register":
+                actor = ActorEntry(
+                    actor_id=p["actor_id"], spec_header=p["spec"],
+                    spec_frames=list(p["frames"]),
+                    name=p.get("name", ""), namespace=p.get("namespace", ""),
+                    max_restarts=p.get("max_restarts", 0),
+                    job_id=p.get("job_id", b""))
+                self.actors[actor.actor_id] = actor
+                if actor.name:
+                    self.named_actors[(actor.namespace, actor.name)] = \
+                        actor.actor_id
+            elif op == "actor_update":
+                actor = self.actors.get(p["actor_id"])
+                if actor is not None:
+                    actor.state = p["state"]
+                    actor.address = p["address"]
+                    actor.node_id = p["node_id"]
+                    actor.incarnation = p["incarnation"]
+                    actor.num_restarts = p["num_restarts"]
+                    actor.max_restarts = p["max_restarts"]
+                    actor.death_cause = p["death_cause"]
+            elif op == "pg_upsert":
+                self.placement_groups[p["pg_id"]] = p["record"]
+            elif op == "pg_remove":
+                self.placement_groups.pop(p["pg_id"], None)
+        if max_job:
+            self._job_counter = itertools.count(max_job + 1)
+        if n:
+            logger.info("GCS journal replay: %d records -> %d jobs, "
+                        "%d actors, %d kv keys", n, len(self.jobs),
+                        len(self.actors), len(self.kv))
 
     # --------------------------------------------------------------- pubsub
 
@@ -268,6 +355,11 @@ class GcsServer:
             if len(best) > 1 else best[0]
 
     async def handle_register_actor(self, conn, header, bufs):
+        # Idempotent by actor id: the client's _gcs_call may re-send after
+        # a dropped reply — re-registering the same actor must not raise a
+        # name collision or spawn a second scheduling loop.
+        if header["actor_id"] in self.actors:
+            return {"ok": True}
         actor = ActorEntry(
             actor_id=header["actor_id"],
             spec_header=header["spec"],
@@ -288,6 +380,11 @@ class GcsServer:
                         f"namespace {actor.namespace!r}")
             self.named_actors[key] = actor.actor_id
         self.actors[actor.actor_id] = actor
+        self._journal_append("actor_register", {
+            "actor_id": actor.actor_id, "spec": actor.spec_header,
+            "frames": actor.spec_frames, "name": actor.name,
+            "namespace": actor.namespace,
+            "max_restarts": actor.max_restarts, "job_id": actor.job_id})
         asyncio.get_running_loop().create_task(self._schedule_actor(actor))
         return {"ok": True}
 
@@ -335,6 +432,7 @@ class GcsServer:
         actor.state = ACTOR_ALIVE
         actor.address = header["address"]
         actor.node_id = header.get("node_id", actor.node_id)
+        self._journal_actor(actor)
         await self._publish("ACTOR", {
             "actor_id": actor.actor_id, "state": ACTOR_ALIVE,
             "address": actor.address, "incarnation": actor.incarnation})
@@ -360,6 +458,7 @@ class GcsServer:
             actor.incarnation += 1
             actor.state = ACTOR_RESTARTING
             actor.address = ""
+            self._journal_actor(actor)
             await self._publish("ACTOR", {
                 "actor_id": actor.actor_id, "state": ACTOR_RESTARTING,
                 "incarnation": actor.incarnation})
@@ -373,6 +472,7 @@ class GcsServer:
     async def _fail_actor(self, actor: ActorEntry, reason: str):
         actor.state = ACTOR_DEAD
         actor.death_cause = reason
+        self._journal_actor(actor)
         await self._publish("ACTOR", {
             "actor_id": actor.actor_id, "state": ACTOR_DEAD, "reason": reason,
             "incarnation": actor.incarnation})
@@ -436,13 +536,17 @@ class GcsServer:
     # --------------------------------------------------------------- jobs
 
     async def handle_add_job(self, conn, header, bufs):
-        job_id = JobID.from_int(next(self._job_counter)).binary()
-        self.jobs[job_id] = {
+        job_num = next(self._job_counter)
+        job_id = JobID.from_int(job_num).binary()
+        record = {
             "job_id": job_id, "driver_address": header.get("driver_address", ""),
             "start_time": time.time(), "finished": False,
             "namespace": header.get("namespace", ""),
             "metadata": header.get("metadata", {}),
         }
+        self.jobs[job_id] = record
+        self._journal_append("job_add", {"job_id": job_id, "record": record,
+                                         "job_num": job_num})
         return {"job_id": job_id}
 
     async def handle_mark_job_finished(self, conn, header, bufs):
@@ -450,6 +554,7 @@ class GcsServer:
         if job:
             job["finished"] = True
             job["end_time"] = time.time()
+            self._journal_append("job_finish", {"job_id": header["job_id"]})
         await self._publish("JOB", {"event": "finished",
                                     "job_id": header["job_id"]})
         return {"ok": True}
@@ -465,6 +570,7 @@ class GcsServer:
         if not overwrite and key in self.kv:
             return {"added": False}
         self.kv[key] = bufs[0] if bufs else b""
+        self._journal_append("kv_put", {"key": key, "value": self.kv[key]})
         return {"added": True}
 
     async def handle_kv_get(self, conn, header, bufs):
@@ -475,6 +581,8 @@ class GcsServer:
 
     async def handle_kv_del(self, conn, header, bufs):
         existed = self.kv.pop(header["key"], None) is not None
+        if existed:
+            self._journal_append("kv_del", {"key": header["key"]})
         return {"deleted": existed}
 
     async def handle_kv_keys(self, conn, header, bufs):
@@ -524,6 +632,7 @@ class GcsServer:
         pg["state"] = PG_CREATED
         pg["bundle_nodes"] = [node.node_id for node, _ in
                               sorted(prepared, key=lambda p: p[1])]
+        self._journal_append("pg_upsert", {"pg_id": pg_id, "record": pg})
         await self._publish("PG", {"pg_id": pg_id, "state": PG_CREATED})
         return {"ok": True, "bundle_nodes": pg["bundle_nodes"]}
 
@@ -598,6 +707,7 @@ class GcsServer:
                 except ConnectionError:
                     pass
         pg["state"] = PG_REMOVED
+        self._journal_append("pg_remove", {"pg_id": pg["pg_id"]})
         await self._publish("PG", {"pg_id": pg["pg_id"], "state": PG_REMOVED})
         return {"ok": True}
 

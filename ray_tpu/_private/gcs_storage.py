@@ -1,0 +1,66 @@
+"""GCS metadata persistence: append-only journal + replay.
+
+Role parity: reference GcsTableStorage over a store client
+(reference: src/ray/gcs/gcs_server/gcs_table_storage.h; restart reload
+via GcsInitData in gcs_server.cc). Redis is deliberately not a
+dependency — mutations append msgpack-framed records to one journal
+file, and a restarting GCS replays it to rebuild its tables.
+
+Record format: [u32 length][msgpack [op, payload]] per mutation.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import struct
+from typing import Any, Iterator, Tuple
+
+import msgpack
+
+logger = logging.getLogger(__name__)
+
+_U32 = struct.Struct("<I")
+
+
+class GcsJournal:
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "ab")
+
+    def append(self, op: str, payload: Any) -> None:
+        body = msgpack.packb([op, payload], use_bin_type=True)
+        self._f.write(_U32.pack(len(body)))
+        self._f.write(body)
+        self._f.flush()
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def replay(path: str) -> Iterator[Tuple[str, Any]]:
+    """Yield (op, payload) records; a torn trailing record (crash mid-
+    append) is ignored, everything before it is recovered."""
+    if not os.path.exists(path):
+        return
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(4)
+            if len(hdr) < 4:
+                break
+            (n,) = _U32.unpack(hdr)
+            body = f.read(n)
+            if len(body) < n:
+                logger.warning("journal %s: torn trailing record dropped",
+                               path)
+                break
+            try:
+                op, payload = msgpack.unpackb(body, raw=False)
+            except Exception:  # noqa: BLE001 — corrupt tail
+                logger.warning("journal %s: corrupt record dropped", path)
+                break
+            yield op, payload

@@ -65,21 +65,31 @@ class Node:
         self._loop_thread: Optional[rpc.EventLoopThread] = None
         self._owns_session_dir = not session_dir
 
+    async def _boot_gcs(self, gcs_listen: str = ""):
+        self.gcs = GcsServer(self.config)
+        self.gcs_address = await self.gcs.start(
+            gcs_listen or
+            (f"tcp://127.0.0.1:{self.config.gcs_port}"
+             if self.config.gcs_port else "tcp://127.0.0.1:0"))
+
     def start_head(self, gcs_listen: str = ""):
         self._loop_thread = rpc.EventLoopThread("rtpu-node-io")
 
         async def _boot():
-            self.gcs = GcsServer(self.config)
-            self.gcs_address = await self.gcs.start(
-                gcs_listen or
-                (f"tcp://127.0.0.1:{self.config.gcs_port}"
-                 if self.config.gcs_port else "tcp://127.0.0.1:0"))
+            await self._boot_gcs(gcs_listen)
             self.raylet = Raylet(self.config, self.num_cpus,
                                  self.custom_resources, self.session_dir,
                                  self.node_name)
             self.raylet_address = await self.raylet.start(self.gcs_address)
 
         self._loop_thread.run(_boot(), timeout=30)
+        return self
+
+    def start_gcs_only(self, gcs_listen: str = ""):
+        """GCS process without a raylet (for GCS fault-tolerance setups
+        where raylets live in other processes and survive a GCS restart)."""
+        self._loop_thread = rpc.EventLoopThread("rtpu-gcs-io")
+        self._loop_thread.run(self._boot_gcs(gcs_listen), timeout=30)
         return self
 
     def start_worker_node(self, gcs_address: str):
@@ -119,6 +129,8 @@ def main(argv=None):
     """Standalone node process: ``python -m ray_tpu._private.node``."""
     parser = argparse.ArgumentParser()
     parser.add_argument("--head", action="store_true")
+    parser.add_argument("--gcs-only", action="store_true",
+                        help="run only the GCS (no raylet) in this process")
     parser.add_argument("--gcs-address", default="")
     parser.add_argument("--gcs-listen", default="",
                         help="head only: address for the GCS to listen on")
@@ -140,7 +152,9 @@ def main(argv=None):
 
     node = Node(num_cpus=args.num_cpus, custom_resources=resources,
                 session_dir=args.session_dir, node_name=args.node_name)
-    if args.head:
+    if args.gcs_only:
+        node.start_gcs_only(gcs_listen=args.gcs_listen)
+    elif args.head:
         node.start_head(gcs_listen=args.gcs_listen)
     else:
         if not args.gcs_address:

@@ -160,13 +160,7 @@ class Raylet:
         # requests (actor scheduling, PG 2PC, kills) back over this pipe.
         self.gcs_conn = await rpc.connect(
             gcs_address, handlers=self._handlers(), peer_name="gcs")
-        await self.gcs_conn.call("RegisterNode", {
-            "node_id": self.node_id.binary(),
-            "address": self.address,
-            "resources": self.resources_total,
-            "node_name": self.node_name,
-        })
-        await self.gcs_conn.call("Subscribe", {"channel": "NODE"})
+        await self._register_with_gcs()
         self._hb_task = asyncio.get_running_loop().create_task(self._heartbeat_loop())
         for _ in range(self.config.num_prestart_workers):
             self._start_worker_process()
@@ -204,14 +198,51 @@ class Raylet:
         period = self.config.raylet_heartbeat_period_ms / 1000.0
         while not self._closing:
             try:
-                await self.gcs_conn.call("Heartbeat", {
+                reply, _ = await self.gcs_conn.call("Heartbeat", {
                     "node_id": self.node_id.binary(),
                     "resources_available": self.resources_available,
                 })
+                if not reply.get("ok"):
+                    # A restarted GCS does not know this node: re-register
+                    # over the live connection (reference: raylets
+                    # re-register after GCS failover).
+                    await self._register_with_gcs()
             except ConnectionError:
-                logger.warning("GCS connection lost; raylet exiting heartbeat")
-                return
+                logger.warning("GCS connection lost; raylet reconnecting")
+                if not await self._reconnect_gcs():
+                    logger.error("GCS unreachable for %.0fs; heartbeat "
+                                 "loop exiting",
+                                 self.config.gcs_reconnect_timeout_s)
+                    return
             await asyncio.sleep(period)
+
+    async def _register_with_gcs(self):
+        await self.gcs_conn.call("RegisterNode", {
+            "node_id": self.node_id.binary(),
+            "address": self.address,
+            "resources": self.resources_total,
+            "node_name": self.node_name,
+        })
+        await self.gcs_conn.call("Subscribe", {"channel": "NODE"})
+
+    async def _reconnect_gcs(self) -> bool:
+        """Dial the (restarting) GCS until it answers, then re-register
+        (reference: gcs_server_address_updater + raylet re-registration
+        on GCS failover)."""
+        deadline = time.time() + self.config.gcs_reconnect_timeout_s
+        while not self._closing and time.time() < deadline:
+            try:
+                conn = await rpc.connect(
+                    self.gcs_address, handlers=self._handlers(),
+                    peer_name="gcs", timeout=5.0)
+                self.gcs_conn = conn
+                await self._register_with_gcs()
+                logger.info("raylet %s re-registered with restarted GCS",
+                            self.node_id.hex()[:8])
+                return True
+            except ConnectionError:
+                await asyncio.sleep(0.2)
+        return False
 
     async def handle_published(self, conn, header, bufs):
         msg = header["msg"]

@@ -229,7 +229,7 @@ class ActorClass:
 def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
     """Look up a named actor (reference: ray.get_actor)."""
     w = worker_mod._require_connected()
-    reply, _ = w.core._run(w.core.gcs_conn.call("GetNamedActor", {
+    reply, _ = w.core._run(w.core._gcs_call("GetNamedActor", {
         "name": name,
         "namespace": namespace if namespace is not None
         else worker_mod.global_worker.namespace}))
@@ -241,6 +241,6 @@ def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
 
 def list_named_actors(namespace: Optional[str] = None):
     w = worker_mod._require_connected()
-    reply, _ = w.core._run(w.core.gcs_conn.call(
+    reply, _ = w.core._run(w.core._gcs_call(
         "ListNamedActors", {"namespace": namespace}))
     return [a["name"] for a in reply["actors"]]

@@ -230,19 +230,46 @@ async def _cancel_async(core, ref: ObjectRef):
 
 def cluster_resources() -> Dict[str, float]:
     w = _require_connected()
-    reply, _ = w.core._run(w.core.gcs_conn.call("GetClusterResources", {}))
+    reply, _ = w.core._run(w.core._gcs_call("GetClusterResources", {}))
     return reply["total"]
 
 
 def available_resources() -> Dict[str, float]:
     w = _require_connected()
-    reply, _ = w.core._run(w.core.gcs_conn.call("GetClusterResources", {}))
+    reply, _ = w.core._run(w.core._gcs_call("GetClusterResources", {}))
     return reply["available"]
+
+
+def experimental_internal_kv_put(key: bytes, value: bytes,
+                                 overwrite: bool = True) -> bool:
+    """Cluster-wide KV (reference: ray.experimental.internal_kv)."""
+    w = _require_connected()
+    reply, _ = w.core._run(w.core._gcs_call(
+        "KVPut", {"key": key, "overwrite": overwrite}, bufs=[value]))
+    return reply["added"]
+
+
+def experimental_internal_kv_get(key: bytes) -> Optional[bytes]:
+    w = _require_connected()
+    reply, bufs = w.core._run(w.core._gcs_call("KVGet", {"key": key}))
+    return bufs[0] if reply.get("found") else None
+
+
+def experimental_internal_kv_del(key: bytes) -> bool:
+    w = _require_connected()
+    reply, _ = w.core._run(w.core._gcs_call("KVDel", {"key": key}))
+    return reply["deleted"]
+
+
+def experimental_internal_kv_list(prefix: bytes = b"") -> List[bytes]:
+    w = _require_connected()
+    reply, _ = w.core._run(w.core._gcs_call("KVKeys", {"prefix": prefix}))
+    return reply["keys"]
 
 
 def nodes() -> List[dict]:
     w = _require_connected()
-    reply, _ = w.core._run(w.core.gcs_conn.call("GetAllNodeInfo", {}))
+    reply, _ = w.core._run(w.core._gcs_call("GetAllNodeInfo", {}))
     out = []
     for n in reply["nodes"]:
         out.append({

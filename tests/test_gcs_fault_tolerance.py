@@ -1,0 +1,126 @@
+"""GCS fault tolerance: journal persistence + restart recovery.
+
+Reference coverage model: python/ray/tests/test_gcs_fault_tolerance.py —
+kill the GCS process, restart it on the same address, and assert that
+metadata (named actors, KV) survives and raylets re-register.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import NodeHandle
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_gcs(port: int, journal: str, tmpdir: str, tag: str) -> NodeHandle:
+    addr_file = os.path.join(tmpdir, f"gcs_{tag}.addr")
+    env = dict(os.environ)
+    env["RAY_TPU_GCS_JOURNAL_PATH"] = journal
+    env.setdefault("RAY_TPU_WORKER_JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.node", "--gcs-only",
+         "--gcs-listen", f"tcp://127.0.0.1:{port}",
+         "--address-file", addr_file],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    node = NodeHandle(proc, addr_file, head=True)
+    node.wait_ready()
+    return node
+
+
+def _spawn_raylet(gcs_address: str, tmpdir: str) -> NodeHandle:
+    addr_file = os.path.join(tmpdir, "raylet.addr")
+    env = dict(os.environ)
+    env.setdefault("RAY_TPU_WORKER_JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.node",
+         "--gcs-address", gcs_address, "--num-cpus", "2",
+         "--address-file", addr_file],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    node = NodeHandle(proc, addr_file, head=False)
+    node.wait_ready()
+    return node
+
+
+def test_gcs_restart_preserves_metadata(tmp_path):
+    port = _free_port()
+    journal = str(tmp_path / "gcs.journal")
+    gcs = _spawn_gcs(port, journal, str(tmp_path), "a")
+    raylet = _spawn_raylet(gcs.gcs_address, str(tmp_path))
+    try:
+        ray_tpu.init(address=gcs.gcs_address)
+
+        @ray_tpu.remote
+        class KVHolder:
+            def __init__(self):
+                self.state = {}
+
+            def put(self, k, v):
+                self.state[k] = v
+                return True
+
+            def get(self, k):
+                return self.state.get(k)
+
+        holder = KVHolder.options(name="survivor",
+                                  lifetime="detached").remote()
+        assert ray_tpu.get(holder.put.remote("k", 41))
+        ray_tpu.experimental_internal_kv_put(b"mykey", b"myvalue")
+
+        # SIGKILL the GCS; the raylet and the actor worker stay alive.
+        gcs.proc.send_signal(signal.SIGKILL)
+        gcs.proc.wait(timeout=10)
+        gcs2 = _spawn_gcs(port, journal, str(tmp_path), "b")
+        # raylet reconnects + re-registers within its retry budget
+        deadline = time.time() + 30
+        ok = False
+        while time.time() < deadline:
+            try:
+                nodes = ray_tpu.nodes()
+                if any(n["Alive"] for n in nodes):
+                    ok = True
+                    break
+            except Exception:
+                pass
+            time.sleep(0.25)
+        assert ok, "raylet did not re-register with the restarted GCS"
+
+        # KV survived the restart via journal replay
+        assert ray_tpu.experimental_internal_kv_get(b"mykey") == b"myvalue"
+        # the named actor survived: lookup works and its state is intact
+        # (the worker process never died)
+        h2 = ray_tpu.get_actor("survivor")
+        assert ray_tpu.get(h2.get.remote("k"), timeout=30) == 41
+        gcs2.terminate()
+    finally:
+        ray_tpu.shutdown()
+        raylet.terminate()
+        gcs.terminate()
+
+
+def test_journal_replay_tolerates_torn_tail(tmp_path):
+    from ray_tpu._private.gcs_storage import GcsJournal, replay
+
+    path = str(tmp_path / "j.bin")
+    j = GcsJournal(path)
+    j.append("kv_put", {"key": b"a", "value": b"1"})
+    j.append("kv_put", {"key": b"b", "value": b"2"})
+    j.close()
+    # simulate a crash mid-append: garbage half-record at the tail
+    with open(path, "ab") as f:
+        f.write(b"\xff\xff\xff\x7f partial")
+    records = list(replay(path))
+    assert [p["key"] for _, p in records] == [b"a", b"b"]
