@@ -16,6 +16,9 @@ import time
 
 # Workers stay on CPU jax; the head's batched scheduler may use the TPU.
 os.environ.setdefault("RAY_TPU_WORKER_JAX_PLATFORMS", "cpu")
+# The headline numbers run the north-star JAX batched scheduling backend
+# (host backend is the correctness oracle; see scheduler/__init__.py).
+os.environ.setdefault("RAY_TPU_SCHEDULER_BACKEND", "tpu_batched")
 
 BASELINE_TASKS_ASYNC = 13546.95  # reference microbenchmark.txt:10
 BASELINE_ACTOR_ASYNC = 5904.3    # reference microbenchmark.txt:13
@@ -85,6 +88,8 @@ def main():
         "unit": "tasks/s",
         "vs_baseline": round(tasks_per_s / BASELINE_TASKS_ASYNC, 4),
         "extras": {
+            "scheduler_backend": os.environ.get(
+                "RAY_TPU_SCHEDULER_BACKEND", "host"),
             "actor_calls_async_per_s": round(actor_per_s, 1),
             "actor_vs_baseline": round(actor_per_s / BASELINE_ACTOR_ASYNC, 4),
             "puts_per_s": round(puts_per_s, 1),

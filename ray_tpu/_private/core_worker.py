@@ -173,6 +173,9 @@ class CoreWorker:
         self._submit_lock = threading.Lock()
         self._submit_scheduled = False
         self._current_task_id: bytes = b""
+        # Cached cluster node table for locality lease targeting.
+        self._node_table: Dict[bytes, str] = {}
+        self._node_table_ts = -1e9
         self._shutdown = False
         self.task_executor = None   # set in worker mode by worker_main
         self._task_events: List[dict] = []
@@ -490,7 +493,7 @@ class CoreWorker:
         if not reply.get("ok"):
             raise exc.ObjectStoreFullError(
                 f"object {oid.hex()} ({size} bytes) does not fit in the store")
-        self.reference_counter.add_location(oid, reply["node_id"])
+        self.reference_counter.add_location(oid, reply["node_id"], size)
         self.memory_store.put(oid, IN_PLASMA)
 
     # ---------------------------------------------------------------- get
@@ -853,19 +856,71 @@ class CoreWorker:
             worker.inflight += n
             self._push_task_batch_nowait(sc, state, worker, batch)
 
+    def _dep_info(self, spec: TaskSpec) -> List[dict]:
+        """Owner-side locality data per by-ref arg: size + known replica
+        locations from the reference counter (reference: LocalityData fed
+        into lease_policy.h)."""
+        out = []
+        for a in spec.args:
+            if a.kind != ARG_REF:
+                continue
+            size, locations = self.reference_counter.location_info(
+                ObjectID(a.object_id))
+            out.append({"oid": a.object_id,
+                        "owner": a.owner_address or self.address,
+                        "size": size, "locations": locations})
+        return out
+
+    async def _node_address_of(self, node_id: bytes) -> str:
+        """node_id -> raylet address via a cached GCS node table."""
+        now = time.monotonic()
+        if now - self._node_table_ts > 5.0:
+            try:
+                reply, _ = await self._gcs_call("GetAllNodeInfo", {})
+            except (ConnectionError, asyncio.TimeoutError):
+                return ""
+            self._node_table = {n["node_id"]: n["address"]
+                                for n in reply["nodes"] if n["alive"]}
+            self._node_table_ts = now
+        return self._node_table.get(node_id, "")
+
+    async def _best_locality_raylet(self, dep_info: List[dict]) -> str:
+        """Locality-aware lease targeting (reference: lease_policy.h
+        LocalityAwareLeasePolicy::GetBestNodeForTask): request the lease
+        from the node already holding the most argument bytes."""
+        per_node: Dict[bytes, int] = {}
+        for d in dep_info:
+            for nid in d["locations"]:
+                per_node[nid] = per_node.get(nid, 0) + d["size"]
+        if not per_node:
+            return ""
+        best_node = max(per_node, key=per_node.get)
+        if per_node[best_node] <= 0:
+            return ""
+        addr = await self._node_address_of(best_node)
+        return addr if addr and addr != self.raylet_address else ""
+
     async def _request_lease(self, sc: int, state: SchedulingKeyState,
                              raylet_address: str, depth: int = 0):
         try:
-            if raylet_address == self.raylet_address:
-                conn = self.raylet_conn
-            else:
-                conn = await self._get_owner_conn(raylet_address)
             sample = state.queue[0] if state.queue else None
             summary = sample.lease_summary() if sample is not None else {
                 "task_id": b"", "scheduling_class": sc,
                 "resources": state.resources, "deps": [],
                 "strategy": "DEFAULT", "pg_id": b"", "pg_bundle": -1,
                 "runtime_env": None, "depth": 0, "name": ""}
+            if sample is not None:
+                dep_info = self._dep_info(sample)
+                summary["dep_info"] = dep_info
+                if dep_info and depth == 0 and \
+                        raylet_address == self.raylet_address:
+                    target = await self._best_locality_raylet(dep_info)
+                    if target:
+                        raylet_address = target
+            if raylet_address == self.raylet_address:
+                conn = self.raylet_conn
+            else:
+                conn = await self._get_owner_conn(raylet_address)
             reply, _ = await conn.call("RequestWorkerLease", {"summary": summary})
         except (ConnectionError, asyncio.CancelledError):
             state.pending_lease -= 1
@@ -1000,7 +1055,8 @@ class CoreWorker:
         for ret in returns:
             oid = ObjectID(ret["object_id"])
             if ret.get("in_plasma"):
-                self.reference_counter.add_location(oid, ret["node_id"])
+                self.reference_counter.add_location(oid, ret["node_id"],
+                                                    ret.get("size", 0))
                 self.memory_store.put(oid, IN_PLASMA)
             else:
                 start, n = ret["frame_start"], ret["num_frames"]
@@ -1170,10 +1226,17 @@ class CoreWorker:
                 if q.conn is not None and not q.conn.closed and \
                         q.state == "ALIVE":
                     return  # a concurrent resolve already connected
-                if self.gcs_conn is None or self.gcs_conn.closed:
+                if self._shutdown:
                     return
-                reply, _ = await self._gcs_call(
-                    "GetActorInfo", {"actor_id": q.actor_id})
+                # _gcs_call redials a restarting GCS — do NOT bail on a
+                # closed gcs_conn here, or buffered actor calls would
+                # hang with no retry timer.
+                try:
+                    reply, _ = await self._gcs_call(
+                        "GetActorInfo", {"actor_id": q.actor_id})
+                except ConnectionError:
+                    await asyncio.sleep(0.5)  # GCS still down; keep trying
+                    continue
                 if not reply.get("found"):
                     await asyncio.sleep(0.05)
                     continue

@@ -132,6 +132,9 @@ class GcsServer:
             self._replay_journal(journal_path)
             from ray_tpu._private.gcs_storage import GcsJournal
             self.journal = GcsJournal(journal_path)
+            # Boot-time compaction: replaying history once is enough —
+            # snapshot the rebuilt tables so the next restart is O(state).
+            self._compact_journal()
         addr = await self._server.listen(address)
         self._monitor_task = asyncio.get_running_loop().create_task(
             self._liveness_monitor())
@@ -154,9 +157,50 @@ class GcsServer:
 
     # ----------------------------------------------------------- persistence
 
+    # Compact once the live journal exceeds this size (snapshot of the
+    # current tables replaces the full history).
+    JOURNAL_COMPACT_BYTES = 32 * 1024 * 1024
+
     def _journal_append(self, op: str, payload):
         if self.journal is not None:
             self.journal.append(op, payload)
+            if self.journal.size() > self.JOURNAL_COMPACT_BYTES:
+                self._compact_journal()
+
+    def _snapshot_records(self):
+        """Current tables as replayable records (compaction payload)."""
+        records = []
+        for job_id, record in self.jobs.items():
+            records.append(("job_add", {
+                "job_id": job_id, "record": record,
+                "job_num": JobID(job_id).int_value()}))
+        for key, value in self.kv.items():
+            records.append(("kv_put", {"key": key, "value": value}))
+        for actor in self.actors.values():
+            records.append(("actor_register", {
+                "actor_id": actor.actor_id, "spec": actor.spec_header,
+                "frames": actor.spec_frames, "name": actor.name,
+                "namespace": actor.namespace,
+                "max_restarts": actor.max_restarts,
+                "job_id": actor.job_id}))
+            records.append(("actor_update", {
+                "actor_id": actor.actor_id, "state": actor.state,
+                "address": actor.address, "node_id": actor.node_id,
+                "incarnation": actor.incarnation,
+                "num_restarts": actor.num_restarts,
+                "max_restarts": actor.max_restarts,
+                "death_cause": actor.death_cause}))
+        for pg_id, record in self.placement_groups.items():
+            records.append(("pg_upsert", {"pg_id": pg_id, "record": record}))
+        return records
+
+    def _compact_journal(self):
+        if self.journal is None:
+            return
+        before = self.journal.size()
+        self.journal.rewrite(self._snapshot_records())
+        logger.info("GCS journal compacted: %d -> %d bytes", before,
+                    self.journal.size())
 
     def _journal_actor(self, actor: "ActorEntry"):
         """Persist an actor's full mutable state (replayed last-wins)."""
@@ -396,7 +440,11 @@ class GcsServer:
         incarnation = actor.incarnation
         deadline = time.time() + 60.0
         while time.time() < deadline:
-            if actor.state == ACTOR_DEAD or actor.incarnation != incarnation:
+            if actor.state in (ACTOR_DEAD, ACTOR_ALIVE) or \
+                    actor.incarnation != incarnation:
+                # DEAD/superseded — or ALIVE already: a journal-replayed
+                # scheduling loop must not create a second live instance
+                # when the pre-crash worker survived and re-reported.
                 return
             node = self._pick_node_for_actor(resources)
             if node is not None and node.conn is not None and not node.conn.closed:

@@ -35,6 +35,29 @@ class GcsJournal:
         self._f.write(body)
         self._f.flush()
 
+    def size(self) -> int:
+        try:
+            return self._f.tell()
+        except Exception:  # noqa: BLE001
+            return 0
+
+    def rewrite(self, records) -> None:
+        """Compaction: atomically replace the journal with a snapshot of
+        the CURRENT tables (an append-only log otherwise grows without
+        bound and replay time with it — the analog of the reference's
+        table snapshots in GcsTableStorage)."""
+        tmp = self.path + ".compact"
+        with open(tmp, "wb") as f:
+            for op, payload in records:
+                body = msgpack.packb([op, payload], use_bin_type=True)
+                f.write(_U32.pack(len(body)))
+                f.write(body)
+            f.flush()
+            os.fsync(f.fileno())
+        self._f.close()
+        os.replace(tmp, self.path)
+        self._f = open(self.path, "ab")
+
     def close(self) -> None:
         try:
             self._f.close()

@@ -373,6 +373,7 @@ class Raylet:
             pg_id=summary.get("pg_id") or b"",
             pg_bundle=summary.get("pg_bundle", -1),
         )
+        self._init_dep_state(req, summary.get("dep_info") or [])
         fut = asyncio.get_running_loop().create_future()
         fut.client = conn  # type: ignore[attr-defined]
         self._pending[req.req_id] = (req, fut)
@@ -388,6 +389,47 @@ class Raylet:
             # Don't accumulate one closure per lease on a long-lived conn.
             if _on_drop in conn.on_disconnect:
                 conn.on_disconnect.remove(_on_drop)
+
+    def _init_dep_state(self, req: PendingRequest, dep_info: List[dict]):
+        """Dependency manager role (reference: dependency_manager.h:51):
+        build the per-node locality map from the owner-supplied replica
+        index, and pre-pull missing plasma args so dispatch is gated on
+        data being local (RequestTaskDependencies -> HandleObjectLocal)."""
+        locality: Dict[bytes, int] = {}
+        missing: List[Tuple[ObjectID, str, int]] = []
+        for d in dep_info:
+            oid = ObjectID(d["oid"])
+            size = d.get("size", 0)
+            if self.store.contains(oid):
+                locality[self.node_id.binary()] = \
+                    locality.get(self.node_id.binary(), 0) + size
+                continue
+            for nid in d.get("locations", []):
+                locality[nid] = locality.get(nid, 0) + size
+            if size > 0 and d.get("locations"):
+                # A plasma object that lives elsewhere: prefetch it.
+                missing.append((oid, d.get("owner", ""), size))
+        req.locality = locality
+        if missing:
+            req.deps_ready = False
+            asyncio.get_running_loop().create_task(
+                self._prefetch_deps(req, missing))
+
+    async def _prefetch_deps(self, req: PendingRequest,
+                             missing: List[Tuple[ObjectID, str, int]]):
+        pulled = 0
+        for oid, owner, size in missing:
+            try:
+                await self._ensure_local(oid, owner)
+                pulled += size
+            except Exception:  # noqa: BLE001 — dispatch gating is advisory;
+                pass           # the executing worker re-resolves args itself
+        req.deps_ready = True
+        if pulled:
+            # the prefetched bytes are now local: update the locality term
+            req.locality[self.node_id.binary()] = \
+                req.locality.get(self.node_id.binary(), 0) + pulled
+        self._schedule_tick()
 
     def _cancel_pending(self, req_id: int):
         entry = self._pending.pop(req_id, None)
@@ -529,6 +571,12 @@ class Raylet:
 
     async def handle_schedule_actor_creation(self, conn, header, bufs):
         spec = header["spec"]
+        # Idempotence by actor id: a GCS that restarted mid-creation may
+        # re-send the request while the first worker is alive — a second
+        # instance would split-brain the actor.
+        for w in self.workers.values():
+            if w.state == WORKER_ACTOR and w.actor_id == header["actor_id"]:
+                return {"ok": True, "already_created": True}
         resources = spec.get("resources", {"CPU": 1.0})
         pg_key = None
         # Reserve resources BEFORE any await: concurrent creations must not
@@ -735,7 +783,10 @@ class Raylet:
     async def handle_ensure_object_local(self, conn, header, bufs):
         """Pull an object into the local store from wherever it lives
         (reference: PullManager admission + ObjectManager::Pull)."""
-        oid = ObjectID(header["object_id"])
+        return await self._ensure_local(
+            ObjectID(header["object_id"]), header.get("owner_address", ""))
+
+    async def _ensure_local(self, oid: ObjectID, owner_address: str) -> dict:
         if self.store.contains(oid):
             return {"ok": True, "segment": self.store.lookup(oid)}
         # Dedupe concurrent pulls of the same object (reference:
@@ -743,7 +794,7 @@ class Raylet:
         pull = self._active_pulls.get(oid)
         if pull is None:
             pull = asyncio.get_running_loop().create_task(
-                self._pull_object(oid, header.get("owner_address", "")))
+                self._pull_object(oid, owner_address))
             self._active_pulls[oid] = pull
             pull.add_done_callback(
                 lambda _: self._active_pulls.pop(oid, None))

@@ -35,7 +35,7 @@ class Reference:
 
     __slots__ = ("owned", "owner_address", "local_refs", "submitted_refs",
                  "contained_in", "contains", "borrowers", "locations",
-                 "in_plasma", "pinned_lineage", "freed")
+                 "in_plasma", "pinned_lineage", "freed", "size")
 
     def __init__(self):
         self.owned = False
@@ -51,6 +51,8 @@ class Reference:
         self.in_plasma = False
         self.pinned_lineage = False
         self.freed = False
+        # Data size in bytes (plasma objects; feeds locality scheduling).
+        self.size = 0
 
     def is_releasable(self) -> bool:
         return (self.local_refs == 0 and self.submitted_refs == 0
@@ -186,13 +188,16 @@ class ReferenceCounter:
 
     # -- locations (owner-resident object directory) ------------------------
 
-    def add_location(self, object_id: ObjectID, node_id: bytes) -> None:
+    def add_location(self, object_id: ObjectID, node_id: bytes,
+                     size: int = 0) -> None:
         with self._lock:
             ref = self._refs.setdefault(object_id, Reference())
             if ref.locations is None:
                 ref.locations = set()
             ref.locations.add(node_id)
             ref.in_plasma = True
+            if size:
+                ref.size = size
 
     def add_location_if_tracked(self, object_id: ObjectID,
                                 node_id: bytes) -> bool:
@@ -219,6 +224,15 @@ class ReferenceCounter:
         with self._lock:
             ref = self._refs.get(object_id)
             return set(ref.locations) if ref and ref.locations else set()
+
+    def location_info(self, object_id: ObjectID):
+        """(size_bytes, sorted location node ids) for locality scheduling
+        (reference: the owner-fed LocalityData in lease_policy.h)."""
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is None:
+                return 0, []
+            return ref.size, sorted(ref.locations or ())
 
     # -- internals ----------------------------------------------------------
 

@@ -38,6 +38,12 @@ class PendingRequest:
     pg_bundle: int = -1
     # Bytes of task args already local per candidate node (locality term).
     locality: Dict[bytes, int] = field(default_factory=dict)
+    # Frontier gate: False while the local dependency manager is still
+    # prefetching this task's plasma args (reference: DependencyManager
+    # RequestTaskDependencies -> dispatch gating). A request with pending
+    # deps may still SPILL to a node that already holds them, but a local
+    # GRANT waits for the pull.
+    deps_ready: bool = True
 
 
 @dataclass

@@ -82,6 +82,13 @@ class HostBackend(SchedulingBackend):
                         best_key = key
                         best_i = i
             best = nodes[best_i]
+            if local is not None and best.node_id == local.node_id \
+                    and not req.deps_ready:
+                # Frontier gate: the chosen node is THIS node but its args
+                # are still being prefetched — hold the grant (no resource
+                # consumption) until the dependency manager reports ready.
+                decisions.append(Decision(req.req_id, WAIT))
+                continue
             a = avail[best.node_id]
             for k, v in demand.items():
                 a[k] = a.get(k, 0.0) - v

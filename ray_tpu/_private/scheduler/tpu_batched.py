@@ -48,20 +48,50 @@ def _bucket(n: int) -> int:
 
 
 @functools.lru_cache(maxsize=None)
+def _kernel_backend() -> str | None:
+    """Which XLA backend compiles the scheduling kernel.
+
+    Default "cpu": a lease tick is a tiny (T x N) problem where DISPATCH
+    LATENCY dominates — on hardware reached through a remote tunnel a
+    device round trip costs more than the whole tick. Set
+    RAY_TPU_SCHEDULER_KERNEL_DEVICE=default to run on the default
+    platform (the TPU) for very large clusters, where the batched
+    (task x node) scoring actually amortizes the launch. Falls back to
+    "cpu" when the requested platform cannot run a trivial op (e.g. a
+    worker node without TPU access) — the scheduler must keep making
+    decisions either way."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    choice = os.environ.get("RAY_TPU_SCHEDULER_KERNEL_DEVICE", "cpu")
+    if choice == "cpu":
+        return "cpu"
+    try:
+        jax.jit(lambda: jnp.zeros(()))().block_until_ready()
+        return None
+    except Exception:  # noqa: BLE001 — any backend-init failure
+        return "cpu"
+
+
+@functools.lru_cache(maxsize=None)
 def _compiled_kernel(t_bucket: int, n_bucket: int, r_bucket: int):
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     def kernel(demands, totals, avail0, locality, is_local, valid_task,
-               valid_node, spread_fp):
+               valid_node, dep_ready, spread_fp):
         # demands [T,R] f32, totals/avail0 [N,R] f32, locality [T,N] i32,
-        # is_local [N] bool, valid_* masks, spread_fp scalar i64.
+        # is_local [N] bool, valid_* masks, dep_ready [T] bool (frontier:
+        # the local dependency manager finished prefetching this task's
+        # args), spread_fp scalar i64.
         inv_totals = jnp.where(totals > 0, 1.0 / jnp.maximum(totals, 1e-9), 0.0)
         local_idx = jnp.argmax(is_local)
 
         def step(avail, inp):
-            d, loc, tvalid = inp
+            d, loc, tvalid, t_ready = inp
             feasible = jnp.all(totals + 1e-9 >= d[None, :], axis=1) & valid_node
             ready = jnp.all(avail + 1e-9 >= d[None, :], axis=1) & feasible
             used = (totals - avail) + d[None, :]
@@ -87,19 +117,24 @@ def _compiled_kernel(t_bucket: int, n_bucket: int, r_bucket: int):
             chosen = jnp.where(local_ready, local_idx, best)
             any_ready = jnp.any(ready)
             any_feasible = jnp.any(feasible)
+            # Frontier gate: a local grant waits for dep prefetch; a spill
+            # to a node already holding the data proceeds (scoring.py).
+            blocked = (chosen == local_idx) & ~t_ready
             action = jnp.where(
                 ~tvalid, ACTION_WAIT,
                 jnp.where(~any_feasible, ACTION_INFEASIBLE,
-                          jnp.where(any_ready, chosen, ACTION_WAIT)))
+                          jnp.where(any_ready & ~blocked, chosen,
+                                    ACTION_WAIT)))
             take = (action >= 0)
             delta = jnp.where(
                 (jnp.arange(n_bucket) == action)[:, None] & take, d[None, :], 0.0)
             return avail - delta, action.astype(jnp.int32)
 
-        _, actions = lax.scan(step, avail0, (demands, locality, valid_task))
+        _, actions = lax.scan(
+            step, avail0, (demands, locality, valid_task, dep_ready))
         return actions
 
-    return jax.jit(kernel, static_argnames=())
+    return jax.jit(kernel, static_argnames=(), backend=_kernel_backend())
 
 
 class TpuBatchedBackend(SchedulingBackend):
@@ -141,9 +176,11 @@ class TpuBatchedBackend(SchedulingBackend):
         is_local = np.zeros((nb,), dtype=bool)
         valid_task = np.zeros((tb,), dtype=bool)
         valid_node = np.zeros((nb,), dtype=bool)
+        dep_ready = np.ones((tb,), dtype=bool)
         kidx = {k: i for i, k in enumerate(kinds)}
         for ti, req in enumerate(pending):
             valid_task[ti] = True
+            dep_ready[ti] = req.deps_ready
             for k, v in req.resources.items():
                 if v > 0:
                     demands[ti, kidx[k]] = v
@@ -160,6 +197,7 @@ class TpuBatchedBackend(SchedulingBackend):
         kernel = _compiled_kernel(tb, nb, rb)
         actions = np.asarray(kernel(
             demands, totals, avail, locality, is_local, valid_task, valid_node,
+            dep_ready,
             np.int32(min(spread_threshold_fp(spread_threshold), 2**31 - 1))))
 
         decisions: List[Decision] = []

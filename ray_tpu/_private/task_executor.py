@@ -273,7 +273,7 @@ class TaskExecutor:
                         f"return {i} of {spec.name} ({size}B) doesn't fit"))
                 returns.append({
                     "object_id": oid.binary(), "in_plasma": True,
-                    "node_id": reply["node_id"],
+                    "node_id": reply["node_id"], "size": size,
                     "contained": [r.binary() for r in serialized.contained_refs]})
         return {"status": "ok", "task_id": spec.task_id,
                 "returns": returns}, frames_out

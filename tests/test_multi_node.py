@@ -164,6 +164,37 @@ def test_node_death_actor_restart(cluster2):
     cluster2.remove_node(fourth)
 
 
+def test_locality_aware_lease_targeting(cluster2):
+    """A CPU-only task whose big argument lives on node 2 is leased AT
+    node 2 (reference: LocalityAwareLeasePolicy, lease_policy.h — the
+    submitter targets the raylet holding the most argument bytes)."""
+
+    @ray_tpu.remote(resources={"spot": 1})
+    def produce():
+        return np.ones(500_000)  # 4 MB -> plasma on node 2
+
+    ref = produce.remote()
+    ray_tpu.wait([ref], timeout=30)
+
+    @ray_tpu.remote(num_cpus=1)
+    def consume(arr):
+        import os
+        return os.getppid(), float(arr.sum())
+
+    before = _raylet_stats(cluster2.nodes[-1].raylet_address)[
+        "num_leases_granted"]
+    ppid, total = ray_tpu.get(consume.remote(ref))
+    assert total == 500_000.0
+    # the task's worker is a child of node 2's process — locality moved
+    # the placement off the (idle, under-threshold) head node
+    assert ppid == cluster2.nodes[-1].proc.pid, \
+        f"consumer ran under pid {ppid}, expected node2 " \
+        f"{cluster2.nodes[-1].proc.pid} (head {cluster2.head.proc.pid})"
+    after = _raylet_stats(cluster2.nodes[-1].raylet_address)[
+        "num_leases_granted"]
+    assert after > before
+
+
 def test_node_death_detected_by_heartbeat(cluster2):
     """SIGKILL a node: the GCS marks it dead and the cluster keeps
     serving (reference: GcsHeartbeatManager timeout -> node death)."""

@@ -40,7 +40,7 @@ def _random_state(rng, num_tasks, num_nodes, kinds=("CPU", "MEM", "TPU")):
                 locality[n.node_id] = rng.randint(0, 10_000_000)
         pending.append(PendingRequest(
             req_id=t + 1, scheduling_class=0, resources=res,
-            locality=locality))
+            locality=locality, deps_ready=rng.random() < 0.8))
     return pending, nodes
 
 
@@ -85,6 +85,51 @@ def test_spillback_when_local_full():
         d = backend.schedule(pending, nodes, 0.5)
         assert d[0].action == "spill"
         assert d[0].spill_address == "tcp://b"
+
+
+def test_deps_pending_gates_local_grant_only():
+    """Frontier gate: a task whose args are still prefetching WAITs when
+    the winner is the local node, but may still SPILL to the data node."""
+    nodes = [
+        NodeView(node_id=b"a" * 28, address="tcp://a",
+                 total={"CPU": 2.0}, available={"CPU": 2.0}, is_local=True),
+        NodeView(node_id=b"b" * 28, address="tcp://b",
+                 total={"CPU": 2.0}, available={"CPU": 2.0}, is_local=False),
+    ]
+    # local under threshold -> local wins -> gated on deps
+    gated = [PendingRequest(req_id=1, scheduling_class=0,
+                            resources={"CPU": 1.0}, deps_ready=False)]
+    for backend in (HostBackend(), TpuBatchedBackend()):
+        d = backend.schedule(gated, nodes, 1.0)
+        assert d[0].action == "wait"
+    # local saturated -> spill target wins -> not gated
+    nodes[0].available = {"CPU": 0.0}
+    spills = [PendingRequest(req_id=2, scheduling_class=0,
+                             resources={"CPU": 1.0}, deps_ready=False,
+                             locality={b"b" * 28: 10_000_000})]
+    for backend in (HostBackend(), TpuBatchedBackend()):
+        d = backend.schedule(spills, nodes, 0.5)
+        assert d[0].action == "spill" and d[0].spill_address == "tcp://b"
+
+
+def test_locality_breaks_tie_between_remote_nodes():
+    """With the local node saturated, the remote node holding the task's
+    argument bytes wins over an equally-utilized empty one."""
+    nodes = [
+        NodeView(node_id=b"a" * 28, address="tcp://a",
+                 total={"CPU": 2.0}, available={"CPU": 0.0}, is_local=True),
+        NodeView(node_id=b"b" * 28, address="tcp://b",
+                 total={"CPU": 2.0}, available={"CPU": 2.0}, is_local=False),
+        NodeView(node_id=b"c" * 28, address="tcp://c",
+                 total={"CPU": 2.0}, available={"CPU": 2.0}, is_local=False),
+    ]
+    pending = [PendingRequest(req_id=1, scheduling_class=0,
+                              resources={"CPU": 1.0},
+                              locality={b"c" * 28: 50_000_000})]
+    for backend in (HostBackend(), TpuBatchedBackend()):
+        d = backend.schedule(pending, nodes, 0.5)
+        assert d[0].action == "spill"
+        assert d[0].spill_address == "tcp://c", type(backend).__name__
 
 
 def test_sequential_consumption_within_tick():
