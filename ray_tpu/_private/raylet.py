@@ -417,13 +417,15 @@ class Raylet:
 
     async def _prefetch_deps(self, req: PendingRequest,
                              missing: List[Tuple[ObjectID, str, int]]):
-        pulled = 0
-        for oid, owner, size in missing:
+        async def pull_one(oid, owner, size):
             try:
-                await self._ensure_local(oid, owner)
-                pulled += size
+                reply = await self._ensure_local(oid, owner)
+                return size if reply.get("ok") else 0
             except Exception:  # noqa: BLE001 — dispatch gating is advisory;
-                pass           # the executing worker re-resolves args itself
+                return 0       # the executing worker re-resolves args itself
+
+        pulled = sum(await asyncio.gather(
+            *(pull_one(oid, owner, size) for oid, owner, size in missing)))
         req.deps_ready = True
         if pulled:
             # the prefetched bytes are now local: update the locality term

@@ -48,8 +48,9 @@ def _bucket(n: int) -> int:
 
 
 @functools.lru_cache(maxsize=None)
-def _kernel_backend() -> str | None:
-    """Which XLA backend compiles the scheduling kernel.
+def _kernel_device():
+    """Which device runs the scheduling kernel (a ``jax.Device`` the
+    inputs are placed on, or None for the default backend).
 
     Default "cpu": a lease tick is a tiny (T x N) problem where DISPATCH
     LATENCY dominates — on hardware reached through a remote tunnel a
@@ -66,13 +67,13 @@ def _kernel_backend() -> str | None:
     import jax.numpy as jnp
 
     choice = os.environ.get("RAY_TPU_SCHEDULER_KERNEL_DEVICE", "cpu")
-    if choice == "cpu":
-        return "cpu"
-    try:
-        jax.jit(lambda: jnp.zeros(()))().block_until_ready()
-        return None
-    except Exception:  # noqa: BLE001 — any backend-init failure
-        return "cpu"
+    if choice != "cpu":
+        try:
+            jax.jit(lambda: jnp.zeros(()))().block_until_ready()
+            return None
+        except Exception:  # noqa: BLE001 — any backend-init failure
+            pass
+    return jax.local_devices(backend="cpu")[0]
 
 
 @functools.lru_cache(maxsize=None)
@@ -134,7 +135,15 @@ def _compiled_kernel(t_bucket: int, n_bucket: int, r_bucket: int):
             step, avail0, (demands, locality, valid_task, dep_ready))
         return actions
 
-    return jax.jit(kernel, static_argnames=(), backend=_kernel_backend())
+    jitted = jax.jit(kernel)
+    device = _kernel_device()
+    if device is None:
+        return jitted
+
+    def run_on_device(*args):
+        return jitted(*(jax.device_put(a, device) for a in args))
+
+    return run_on_device
 
 
 class TpuBatchedBackend(SchedulingBackend):

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ray_tpu.ops.attention import attention
+from ray_tpu.ops.attention import flash_attention
 from ray_tpu.ops.norms import rmsnorm
 from ray_tpu.ops.rotary import apply_rotary, rope_frequencies
 from ray_tpu.parallel.collectives import tp_allreduce, tp_copy
@@ -123,7 +123,9 @@ def _attend(q, k, v, pcfg: ParallelConfig):
     if impl == "auto":
         impl = "ring" if pcfg.sp else "local"
     if impl == "local" or not pcfg.sp:
-        return attention(q, k, v, causal=True)
+        # Pallas blocked online-softmax kernel on TPU; transparent
+        # XLA-attention fallback off-TPU / at non-block-aligned T.
+        return flash_attention(q, k, v, causal=True)
     if impl == "ring":
         return ring_attention(q, k, v, axis=pcfg.sp, causal=True)
     if impl == "ulysses":

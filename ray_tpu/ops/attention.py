@@ -1,11 +1,18 @@
-"""Attention: pure-JAX reference and a Pallas TPU kernel.
+"""Attention: pure-JAX reference and a Pallas TPU flash kernel.
 
 ``attention`` is the XLA-fused reference (differential-test oracle and
-CPU path). ``flash_attention`` tiles Q into MXU-aligned blocks with the
-K/V panel resident in VMEM — scores never round-trip to HBM. On
-non-TPU backends it transparently falls back to ``attention``.
+CPU path). ``flash_attention`` is blockwise in BOTH q and k/v with an
+online-softmax accumulator carried in VMEM scratch — the [Tq, Tk]
+score matrix never materialises, so VMEM use is O(block_q * block_k),
+independent of sequence length (the memory sense of "flash").
 
-Shapes everywhere: [batch, seq, heads, head_dim].
+The backward pass is a ``jax.custom_vjp`` that recomputes through the
+reference math (XLA's fused attention backward); the Pallas kernel is
+forward-only. Shapes everywhere: [batch, seq, heads, head_dim].
+
+Reference-parity note: the reference snapshot has no attention kernels
+at all (SURVEY.md §5.7 — absent); this op underpins the TPU-native
+long-context capability layered on the runtime.
 """
 
 from __future__ import annotations
@@ -14,6 +21,9 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+_NEG_INF = -1e30
+_LANES = 128  # f32 VMEM lane width; m/l scratch rows are lane-replicated
 
 
 def attention(q, k, v, *, causal: bool = True,
@@ -41,64 +51,145 @@ def _on_tpu() -> bool:
         return False
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal,
-                  block_q):
-    # q_ref [1,1,bq,D]; k_ref/v_ref [1,1,T,D]; o_ref [1,1,bq,D]
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  sm_scale, causal, block_q, block_k, num_k):
+    """One (b, h, qi, ki) grid step of online-softmax attention.
+
+    q_ref [1,1,bq,D]; k_ref/v_ref [1,1,bk,D]; o_ref [1,1,bq,D].
+    Scratch (VMEM, persists across the innermost ki axis):
+      m_ref/l_ref [bq, _LANES] lane-replicated running max / denom,
+      acc_ref [bq, D] running numerator.
+    """
     import jax.experimental.pallas as pl
 
-    qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32)          # [bq, D]
-    k = k_ref[0, 0].astype(jnp.float32)          # [T, D]
-    v = v_ref[0, 0].astype(jnp.float32)
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * sm_scale  # [bq, T]
-    if causal:
-        T = k.shape[0]
-        qpos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 0)
-        kpos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(qpos >= kpos, s, -1e30)
-    m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jax.lax.dot_general(
-        (p / l), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    o_ref[0, 0] = o.astype(o_ref.dtype)
+    qi, ki = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # Causal: blocks strictly above the diagonal contribute nothing.
+    run = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32)          # [bq, D]
+        k = k_ref[0, 0].astype(jnp.float32)          # [bk, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]                         # [bq, 1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)    # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)               # rescale old state
+        p = jnp.exp(s - m_new)                        # [bq, bk]
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == num_k - 1)
+    def _finish():
+        # Fully masked rows (can't happen under causal) would have l=0;
+        # guard the divide anyway so the kernel never emits NaN.
+        l = l_ref[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "sm_scale",
-                                             "block_q", "interpret"))
-def flash_attention(q, k, v, *, causal: bool = True,
-                    sm_scale: float | None = None, block_q: int = 128,
-                    interpret: bool = False):
-    """Pallas blockwise attention; falls back to ``attention`` off-TPU."""
+def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                   interpret):
+    import jax.experimental.pallas as pl
+    import jax.experimental.pallas.tpu as pltpu
+
     B, T, H, D = q.shape
-    sm_scale = sm_scale if sm_scale is not None else D ** -0.5
-    if ((not interpret and not _on_tpu()) or T % block_q or T < block_q
-            or k.shape[1] != T):  # decode (Tq != Tk) → reference path
-        return attention(q, k, v, causal=causal, sm_scale=sm_scale)
-    import jax.experimental.pallas as pl
-
     # [B,T,H,D] → [B,H,T,D] so the MXU dims (T, D) are trailing.
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    grid = (B, H, T // block_q)
-    kernel = functools.partial(_flash_kernel, sm_scale=sm_scale,
-                               causal=causal, block_q=block_q)
+    num_k = T // block_k
+    grid = (B, H, T // block_q, num_k)  # ki innermost: scratch carries
+    kernel = functools.partial(
+        _flash_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_k=num_k)
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, T, D), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, T, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i, j: (b, h, j, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, D),
-                               lambda b, h, i: (b, h, i, 0)),
+                               lambda b, h, i, j: (b, h, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
         interpret=interpret,
     )(qt, kt, vt)
     return out.transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                          interpret)
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                         interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+    # Backward recomputes through the reference math (XLA fused); the
+    # Pallas kernel is forward-only. O(T^2) memory on the backward —
+    # fine at flagship sizes; ring attention covers the long-T regime.
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attention(q_, k_, v_, causal=causal,
+                                     sm_scale=sm_scale), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    sm_scale: float | None = None, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """Blockwise online-softmax attention (Pallas on TPU).
+
+    Falls back to ``attention`` off-TPU (unless ``interpret``), for
+    decode steps (Tq != Tk), and for sequences not divisible by the
+    block sizes.
+    """
+    B, T, H, D = q.shape
+    sm_scale = sm_scale if sm_scale is not None else D ** -0.5
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    if ((not interpret and not _on_tpu()) or T % block_q or T % block_k
+            or k.shape[1] != T):
+        return attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    return _flash(q, k, v, causal, sm_scale, block_q, block_k,
+                  interpret)
