@@ -60,9 +60,13 @@ class ObjectRef:
     # -- asyncio integration ------------------------------------------------
 
     def as_future(self) -> "asyncio.Future":
+        """asyncio future on the CALLING loop (the value fetch itself
+        runs on the core worker's IO loop; wrap_future bridges)."""
         if self._worker is None:
             raise RuntimeError("ObjectRef is detached from a worker")
-        return self._worker.get_async(self)
+        import asyncio
+
+        return asyncio.wrap_future(self._worker.get_async(self))
 
     def __await__(self):
         return self.as_future().__await__()

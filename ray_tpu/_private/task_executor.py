@@ -59,6 +59,13 @@ class TaskExecutor:
         self._actor_is_asyncio = False
         self._actor_sema: Optional[asyncio.Semaphore] = None
         self._actor_pool: Optional[ThreadPoolExecutor] = None
+        # Async actors run user coroutines on a DEDICATED loop thread,
+        # never on the core IO loop (reference: async actors get their
+        # own asyncio loop, _raylet.pyx:501-520 / fiber.h) — so actor
+        # code may call the sync API (create actors, kill, get) without
+        # deadlocking the RPC plane.
+        self._actor_user_loop = None  # rpc.EventLoopThread
+        self._actor_aio_limit = 1000
         # Serial (max_concurrency=1, non-async) actors execute on a
         # dedicated thread with batched dequeue + batched reply delivery,
         # same as normal tasks.
@@ -322,9 +329,12 @@ class TaskExecutor:
         self._actor_is_asyncio = creation.get("is_asyncio", False)
         max_concurrency = creation.get("max_concurrency", 1)
         if self._actor_is_asyncio:
-            self._actor_sema = asyncio.Semaphore(max(max_concurrency, 1000)
-                                                 if max_concurrency == 1
-                                                 else max_concurrency)
+            from ray_tpu._private import rpc
+            # actor.py already defaults async actors to 1000 when the
+            # user didn't pass max_concurrency; an explicit 1 here
+            # means the user wants serialized execution — honor it.
+            self._actor_aio_limit = max(1, max_concurrency)
+            self._actor_user_loop = rpc.EventLoopThread("rtpu-actor-aio")
         elif max_concurrency == 1:
             self._actor_serial_queue = queue_mod.SimpleQueue()
             threading.Thread(target=self._actor_serial_loop,
@@ -396,9 +406,14 @@ class TaskExecutor:
             try:
                 spec = TaskSpec.from_wire(header, bufs)
                 if self._actor_is_asyncio:
-                    await self._actor_sema.acquire()
-                    asyncio.get_running_loop().create_task(
-                        self._run_async_actor_task(spec, fut))
+                    # Hand off to the user loop; concurrency is bounded
+                    # there (semaphore wakes FIFO, and
+                    # run_coroutine_threadsafe preserves submit order,
+                    # so in-order task STARTS are kept).
+                    asyncio.run_coroutine_threadsafe(
+                        self._run_async_actor_task(
+                            spec, fut, asyncio.get_running_loop()),
+                        self._actor_user_loop.loop)
                 else:
                     loop = asyncio.get_running_loop()
 
@@ -441,7 +456,12 @@ class TaskExecutor:
         finally:
             _task_ctx.task_id = b""
 
-    async def _run_async_actor_task(self, spec: TaskSpec, fut: asyncio.Future):
+    async def _run_async_actor_task(self, spec: TaskSpec,
+                                    fut: asyncio.Future, io_loop):
+        """Runs ON THE ACTOR USER LOOP; ``fut`` belongs to ``io_loop``."""
+        if self._actor_sema is None:  # lazily bound to this loop
+            self._actor_sema = asyncio.Semaphore(self._actor_aio_limit)
+        await self._actor_sema.acquire()
         try:
             method = self._lookup_method(spec.name)
             args, kwargs = await asyncio.get_running_loop().run_in_executor(
@@ -460,8 +480,15 @@ class TaskExecutor:
             reply = self._error_reply(spec, format_task_error(spec.name, e))
         finally:
             self._actor_sema.release()
-        if not fut.done():
-            fut.set_result(reply)
+
+        def _set():
+            if not fut.done():
+                fut.set_result(reply)
+
+        try:
+            io_loop.call_soon_threadsafe(_set)
+        except RuntimeError:  # io loop closed: process is shutting down
+            pass
 
     def _lookup_method(self, name: str):
         method_name = name.rsplit(".", 1)[-1]

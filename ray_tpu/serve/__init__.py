@@ -1,0 +1,179 @@
+"""Model serving on the actor runtime.
+
+Parity target: the reference's Serve control/data plane
+(reference: python/ray/serve/ — ServeController controller.py:38,
+Router/ReplicaSet router.py:45,177, RayServeHandle handle.py:44,
+@serve.deployment api.py:610,865, LongPollClient/Host long_poll.py).
+Handle-based calls are first-class (they compose with the task graph);
+an HTTP ingress can be layered on top of handles.
+
+Usage::
+
+    from ray_tpu import serve
+
+    serve.start()
+
+    @serve.deployment(num_replicas=2, max_concurrent_queries=4)
+    class Model:
+        def __call__(self, x):
+            return x * 2
+
+    Model.deploy()
+    handle = Model.get_handle()
+    ray_tpu.get(handle.remote(21))  # 42
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
+from ray_tpu.serve.handle import DeploymentHandle
+
+__all__ = [
+    "start", "shutdown", "deployment", "get_deployment",
+    "list_deployments", "DeploymentHandle",
+]
+
+_controller = None
+
+
+def start(detached: bool = False):
+    """Start (or connect to) the serve control plane.
+
+    ``detached=True`` keeps the controller alive past this driver, like
+    the reference's serve.start(detached=True).
+    """
+    global _controller
+    if _controller is not None:
+        return _controller
+    opts = {"name": CONTROLLER_NAME, "get_if_exists": True,
+            "max_concurrency": 1000}
+    if detached:
+        opts["lifetime"] = "detached"
+    _controller = ray_tpu.remote(ServeController).options(**opts).remote()
+    return _controller
+
+
+def _get_controller():
+    global _controller
+    if _controller is None:
+        try:
+            _controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        except Exception:
+            raise RuntimeError(
+                "serve.start() must be called first") from None
+    return _controller
+
+
+def shutdown() -> None:
+    """Tear down every deployment and the controller."""
+    global _controller
+    if _controller is None:
+        try:
+            _controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        except Exception:
+            return
+    ray_tpu.get(_controller.shutdown.remote())
+    ray_tpu.kill(_controller)
+    _controller = None
+
+
+class Deployment:
+    """Declarative deployment: callable + config, bound by deploy()."""
+
+    def __init__(self, func_or_class: Callable, name: str,
+                 num_replicas: int = 1,
+                 max_concurrent_queries: int = 100,
+                 version: Optional[str] = None,
+                 user_config: Any = None,
+                 ray_actor_options: Optional[Dict] = None,
+                 init_args: tuple = (), init_kwargs: Optional[dict] = None):
+        self._func_or_class = func_or_class
+        self.name = name
+        self.num_replicas = num_replicas
+        self.max_concurrent_queries = max_concurrent_queries
+        self.version = version
+        self.user_config = user_config
+        self.ray_actor_options = ray_actor_options or {}
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs or {}
+
+    def options(self, **overrides) -> "Deployment":
+        cfg = {
+            "name": self.name, "num_replicas": self.num_replicas,
+            "max_concurrent_queries": self.max_concurrent_queries,
+            "version": self.version, "user_config": self.user_config,
+            "ray_actor_options": dict(self.ray_actor_options),
+            "init_args": self.init_args,
+            "init_kwargs": dict(self.init_kwargs),
+        }
+        cfg.update(overrides)
+        return Deployment(self._func_or_class, **cfg)
+
+    def deploy(self, *init_args, **init_kwargs) -> None:
+        """Create or roll the deployment to this config (blocking)."""
+        controller = _get_controller()
+        ray_tpu.get(controller.deploy.remote(
+            self.name, self._func_or_class,
+            init_args or self.init_args,
+            init_kwargs or self.init_kwargs,
+            num_replicas=self.num_replicas,
+            max_concurrent_queries=self.max_concurrent_queries,
+            # an unversioned redeploy always rolls: fresh token
+            version=self.version or uuid.uuid4().hex,
+            user_config=self.user_config,
+            ray_actor_options=self.ray_actor_options))
+
+    def delete(self) -> None:
+        controller = _get_controller()
+        ray_tpu.get(controller.delete_deployment.remote(self.name))
+
+    def get_handle(self) -> DeploymentHandle:
+        return DeploymentHandle(_get_controller(), self.name)
+
+    def __call__(self, *a, **kw):
+        raise RuntimeError(
+            "deployments are invoked via .get_handle().remote(), not "
+            "called directly")
+
+
+def deployment(_func_or_class=None, *, name: Optional[str] = None,
+               num_replicas: int = 1, max_concurrent_queries: int = 100,
+               version: Optional[str] = None, user_config: Any = None,
+               ray_actor_options: Optional[Dict] = None):
+    """``@serve.deployment`` decorator (bare or with options)."""
+    def wrap(func_or_class):
+        return Deployment(
+            func_or_class,
+            name or func_or_class.__name__,
+            num_replicas=num_replicas,
+            max_concurrent_queries=max_concurrent_queries,
+            version=version, user_config=user_config,
+            ray_actor_options=ray_actor_options)
+
+    if _func_or_class is not None:
+        return wrap(_func_or_class)
+    return wrap
+
+
+def get_deployment(name: str) -> Deployment:
+    """Fetch a live deployment's config as a re-deployable object."""
+    controller = _get_controller()
+    info = ray_tpu.get(controller.get_deployment_info.remote(name))
+    if info is None:
+        raise KeyError(f"no deployment named {name!r}")
+    dep = Deployment(
+        None, name,
+        num_replicas=info["num_replicas"],
+        max_concurrent_queries=info["max_concurrent_queries"],
+        version=info["version"], user_config=info["user_config"],
+        ray_actor_options=info["ray_actor_options"],
+        init_args=info["init_args"], init_kwargs=info["init_kwargs"])
+    return dep
+
+
+def list_deployments() -> List[str]:
+    return ray_tpu.get(_get_controller().list_deployments.remote())

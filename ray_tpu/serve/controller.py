@@ -1,0 +1,204 @@
+"""ServeController: the cluster-singleton control plane for serving.
+
+Parity target: the reference's ServeController + BackendState
+(reference: python/ray/serve/controller.py:38, backend_state.py). One
+named async actor owns all deployment goal-state, reconciles replica
+actors toward it (scale up/down, rolling version updates with drain),
+and pushes membership snapshots to routers through the LongPollHost.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.serve.long_poll import LongPollHost
+from ray_tpu.serve.replica import Replica
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+SNAPSHOT_KEY = "replicas:{name}"  # long-poll key per deployment
+REPLICA_STARTUP_TIMEOUT_S = 60.0
+
+
+async def _as_coro(ref):
+    """asyncio.wait_for needs a coroutine/task, not a bare awaitable."""
+    return await ref
+
+
+class ServeController:
+    """Async actor. All methods run interleaved on one event loop, so
+    state mutations need no locks (single-loop discipline, the same
+    posture as the rest of the runtime)."""
+
+    def __init__(self):
+        self._host = LongPollHost()
+        # goal state per deployment
+        self._configs: Dict[str, dict] = {}
+        # live replicas: name -> [{"id": str, "handle": ActorHandle,
+        #                          "version": str}]
+        self._replicas: Dict[str, List[dict]] = {}
+        self._next_replica_id = 0
+        self._reconciling: Dict[str, asyncio.Lock] = {}
+
+    # ---- long-poll host passthrough (routers call this) ----
+
+    async def listen_for_change(self, known: Dict[str, int]):
+        return await self._host.listen_for_change(known)
+
+    # ---- deployment API (called by serve.api) ----
+
+    async def deploy(self, name: str, callable_def: Any,
+                     init_args: tuple, init_kwargs: dict,
+                     num_replicas: int = 1,
+                     max_concurrent_queries: int = 100,
+                     version: Optional[str] = None,
+                     user_config: Any = None,
+                     ray_actor_options: Optional[dict] = None) -> None:
+        """Create or update a deployment and reconcile to the new goal."""
+        version = version or "1"
+        if callable_def is None:
+            # Config-only redeploy (scale / reconfigure via
+            # serve.get_deployment): keep the stored callable.
+            existing = self._configs.get(name)
+            if existing is None:
+                raise ValueError(
+                    f"deployment {name!r} has no stored callable")
+            callable_def = existing["callable_def"]
+        self._configs[name] = {
+            "name": name,
+            "callable_def": callable_def,
+            "init_args": tuple(init_args or ()),
+            "init_kwargs": dict(init_kwargs or {}),
+            "num_replicas": int(num_replicas),
+            "max_concurrent_queries": int(max_concurrent_queries),
+            "version": version,
+            "user_config": user_config,
+            "ray_actor_options": dict(ray_actor_options or {}),
+        }
+        await self._reconcile(name)
+
+    async def delete_deployment(self, name: str) -> None:
+        self._configs.pop(name, None)
+        await self._reconcile(name)
+
+    async def get_deployment_info(self, name: str) -> Optional[dict]:
+        cfg = self._configs.get(name)
+        if cfg is None:
+            return None
+        return {k: v for k, v in cfg.items() if k != "callable_def"}
+
+    async def list_deployments(self) -> List[str]:
+        return sorted(self._configs)
+
+    async def get_replica_snapshot(self, name: str) -> dict:
+        """One-shot snapshot (handles bootstrap before long-poll arms)."""
+        return self._snapshot(name)
+
+    async def shutdown(self) -> None:
+        for name in list(self._configs):
+            self._configs.pop(name, None)
+            await self._reconcile(name)
+
+    # ---- reconciliation ----
+
+    def _snapshot(self, name: str) -> dict:
+        cfg = self._configs.get(name)
+        return {
+            "max_concurrent_queries":
+                cfg["max_concurrent_queries"] if cfg else 1,
+            "replicas": [
+                {"id": r["id"], "handle": r["handle"]}
+                for r in self._replicas.get(name, [])
+            ],
+        }
+
+    async def _notify(self, name: str) -> None:
+        await self._host.notify_changed(
+            SNAPSHOT_KEY.format(name=name), self._snapshot(name))
+
+    async def _reconcile(self, name: str) -> None:
+        # Serialize reconciles per deployment; concurrent deploy() calls
+        # otherwise interleave replica starts and double-count.
+        lock = self._reconciling.setdefault(name, asyncio.Lock())
+        async with lock:
+            await self._reconcile_locked(name)
+
+    async def _reconcile_locked(self, name: str) -> None:
+        import ray_tpu
+
+        cfg = self._configs.get(name)
+        live = self._replicas.setdefault(name, [])
+
+        if cfg is None:  # deleted: drain everything, then kill
+            victims = list(live)
+            self._replicas[name] = []
+            await self._notify(name)  # routers stop sending first
+            await self._drain_and_kill(victims)
+            self._replicas.pop(name, None)
+            return
+
+        version = cfg["version"]
+        current = [r for r in live if r["version"] == version]
+        outdated = [r for r in live if r["version"] != version]
+
+        # Scale up to goal with new-version replicas.
+        want = cfg["num_replicas"]
+        starting = []
+        for _ in range(want - len(current)):
+            self._next_replica_id += 1
+            rid = f"{name}#{version}#{self._next_replica_id}"
+            opts = dict(cfg["ray_actor_options"])
+            opts.setdefault("max_concurrency",
+                            max(cfg["max_concurrent_queries"], 100))
+            handle = ray_tpu.remote(Replica).options(**opts).remote(
+                cfg["callable_def"], cfg["init_args"], cfg["init_kwargs"])
+            starting.append({"id": rid, "handle": handle,
+                             "version": version})
+        # Health-gate: route no traffic to a replica that can't init.
+        # A failing/hanging constructor must not leak the batch or
+        # wedge the reconcile lock forever.
+        try:
+            for r in starting:
+                await asyncio.wait_for(
+                    _as_coro(r["handle"].ready.remote()),
+                    timeout=REPLICA_STARTUP_TIMEOUT_S)
+                current.append(r)
+        except BaseException:
+            for r in starting:
+                if r not in current:
+                    try:
+                        ray_tpu.kill(r["handle"])
+                    except Exception:  # noqa: BLE001
+                        pass
+            # keep serving whatever came healthy; surface the failure
+            self._replicas[name] = current
+            await self._notify(name)
+            raise
+
+        # Scale down extra same-version replicas (newest first).
+        extra = current[want:]
+        current = current[:want]
+
+        if cfg["user_config"] is not None:
+            for r in current:
+                await r["handle"].reconfigure.remote(cfg["user_config"])
+
+        self._replicas[name] = current
+        await self._notify(name)  # switch routers to the new set...
+        await self._drain_and_kill(outdated + extra)  # ...then drain old
+
+    async def _drain_and_kill(self, replicas: List[dict]) -> None:
+        import ray_tpu
+
+        for r in replicas:
+            try:
+                await r["handle"].drain.remote()
+            except Exception:  # noqa: BLE001 — already dead is fine
+                pass
+            try:
+                ray_tpu.kill(r["handle"])
+            except Exception:  # noqa: BLE001
+                pass

@@ -1,0 +1,70 @@
+"""DeploymentHandle: the caller-side entry point for serve queries.
+
+Parity target: the reference's RayServeHandle
+(reference: python/ray/serve/handle.py:44). ``handle.remote(...)``
+returns an ObjectRef (compose with the rest of the task graph);
+membership updates arrive over the controller's long-poll channel.
+"""
+
+from __future__ import annotations
+
+import ray_tpu
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu.serve.long_poll import LongPollClient
+from ray_tpu.serve.controller import SNAPSHOT_KEY
+from ray_tpu.serve.router import ReplicaSet
+
+
+class DeploymentHandle:
+    def __init__(self, controller, deployment_name: str,
+                 method_name: str = "__call__"):
+        self._controller = controller
+        self.deployment_name = deployment_name
+        self._method = method_name
+        self._replica_set = ReplicaSet(deployment_name)
+        # Bootstrap synchronously so the first .remote() doesn't race
+        # the long-poll thread's first listen.
+        snapshot = ray_tpu.get(
+            controller.get_replica_snapshot.remote(deployment_name))
+        self._replica_set.update_membership(snapshot)
+        self._long_poll = LongPollClient(
+            controller,
+            {SNAPSHOT_KEY.format(name=deployment_name):
+             self._replica_set.update_membership})
+
+    def remote(self, *args, **kwargs) -> ObjectRef:
+        """Route one query; blocks only when every replica is at its
+        max_concurrent_queries cap (backpressure)."""
+        return self._replica_set.assign(self._method, args, kwargs)
+
+    def __del__(self):  # stop the long-poll thread with the handle
+        try:
+            self._long_poll.stop()
+        except Exception:  # noqa: BLE001 — interpreter shutdown
+            pass
+
+    def options(self, method_name: str) -> "DeploymentHandle":
+        """A sibling handle invoking a different method of the class."""
+        return DeploymentHandle(self._controller, self.deployment_name,
+                                method_name=method_name)
+
+    def __getattr__(self, name: str) -> "_MethodCaller":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _MethodCaller(self, name)
+
+    def __repr__(self) -> str:
+        return (f"DeploymentHandle(deployment="
+                f"{self.deployment_name!r}, method={self._method!r})")
+
+
+class _MethodCaller:
+    """``handle.other_method.remote(...)`` sugar."""
+
+    def __init__(self, handle: DeploymentHandle, method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs) -> ObjectRef:
+        return self._handle._replica_set.assign(
+            self._method, args, kwargs)

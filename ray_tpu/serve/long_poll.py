@@ -1,0 +1,100 @@
+"""Long-poll change notification between the controller and handles.
+
+Parity target: the reference's LongPollHost/LongPollClient
+(reference: python/ray/serve/long_poll.py:38,135). The host side lives
+inside the ServeController (an async actor): listeners block on an
+``asyncio.Condition`` until a watched key's version advances, so config
+pushes reach every router in one actor-call round trip instead of each
+router polling. The client side is a daemon thread issuing back-to-back
+blocking listens (the core-worker API is thread-safe: calls hop onto
+the IO loop via run_coroutine_threadsafe).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Callable, Dict, Tuple
+
+LISTEN_TIMEOUT_S = 30.0  # heartbeat: return empty so the client re-arms
+
+
+class LongPollHost:
+    """Versioned key/value store with blocking listeners (host side)."""
+
+    def __init__(self):
+        self._values: Dict[str, Any] = {}
+        self._versions: Dict[str, int] = {}
+        self._cond = asyncio.Condition()
+
+    async def notify_changed(self, key: str, value: Any) -> None:
+        async with self._cond:
+            self._values[key] = value
+            self._versions[key] = self._versions.get(key, 0) + 1
+            self._cond.notify_all()
+
+    async def listen_for_change(
+            self, known: Dict[str, int]) -> Dict[str, Tuple[int, Any]]:
+        """Block until some watched key's version != the known version.
+
+        Returns {key: (version, value)} for every changed key; {} on
+        timeout (client re-issues the listen — keeps slow clients from
+        pinning the actor forever).
+        """
+        def changed():
+            return {
+                k: (self._versions[k], self._values[k])
+                for k, v in known.items()
+                if self._versions.get(k, 0) != v and k in self._values
+            }
+
+        async with self._cond:
+            out = changed()
+            if out:
+                return out
+            try:
+                await asyncio.wait_for(
+                    self._cond.wait_for(lambda: bool(changed())),
+                    timeout=LISTEN_TIMEOUT_S)
+            except asyncio.TimeoutError:
+                return {}
+            return changed()
+
+
+class LongPollClient:
+    """Daemon-thread listener pushing updates into callbacks."""
+
+    def __init__(self, host_actor,
+                 callbacks: Dict[str, Callable[[Any], None]]):
+        self._host = host_actor
+        self._callbacks = callbacks
+        self._known = {k: 0 for k in callbacks}
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="serve-long-poll", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def _run(self) -> None:
+        import ray_tpu
+
+        failures = 0
+        while not self._stopped.is_set():
+            try:
+                updates = ray_tpu.get(
+                    self._host.listen_for_change.remote(dict(self._known)),
+                    timeout=LISTEN_TIMEOUT_S * 2)
+                failures = 0
+            except Exception:  # noqa: BLE001 — controller died / shutdown
+                failures += 1
+                if failures >= 20 or self._stopped.wait(0.5):
+                    return  # controller is gone; stop burning a thread
+                continue
+            for key, (version, value) in (updates or {}).items():
+                self._known[key] = version
+                try:
+                    self._callbacks[key](value)
+                except Exception:  # noqa: BLE001 — never kill the loop
+                    pass

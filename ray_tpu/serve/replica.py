@@ -1,0 +1,69 @@
+"""Replica actor: hosts one copy of a deployment's callable.
+
+Parity target: the reference's RayServeWrappedReplica / RayServeReplica
+(reference: python/ray/serve/backend_worker.py). An async actor so many
+requests interleave up to the deployment's max_concurrent_queries (the
+hard cap is enforced caller-side by the ReplicaSet; the replica-side
+counter exists for draining).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+from typing import Any
+
+
+class Replica:
+    """Generic wrapper instantiated by the controller for every replica."""
+
+    def __init__(self, callable_def: Any, init_args: tuple,
+                 init_kwargs: dict):
+        if inspect.isclass(callable_def):
+            self._obj = callable_def(*init_args, **init_kwargs)
+        else:
+            self._obj = callable_def  # plain function deployment
+        self._inflight = 0
+        self._draining = False
+
+    async def ready(self) -> str:
+        """Health check the controller awaits before routing traffic."""
+        return "ok"
+
+    async def handle_request(self, method: str, args: tuple,
+                             kwargs: dict):
+        if self._draining:
+            # The router raced a rolling update; surface a retryable
+            # error (the ReplicaSet refreshes membership and retries).
+            raise RuntimeError("replica is draining")
+        self._inflight += 1
+        try:
+            # Class deployments: bound-method lookup; function
+            # deployments: the function's own __call__.
+            fn = getattr(self._obj, method)
+            result = fn(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = await result
+            return result
+        finally:
+            self._inflight -= 1
+
+    async def drain(self) -> int:
+        """Stop accepting work, wait for in-flight requests to finish.
+
+        Returns the number of requests that were in flight when the
+        drain began (for controller bookkeeping/tests).
+        """
+        self._draining = True
+        started_with = self._inflight
+        while self._inflight > 0:
+            await asyncio.sleep(0.005)
+        return started_with
+
+    async def reconfigure(self, user_config: Any) -> None:
+        """Push a new user_config without restarting the replica."""
+        fn = getattr(self._obj, "reconfigure", None)
+        if fn is not None:
+            result = fn(user_config)
+            if inspect.iscoroutine(result):
+                await result

@@ -1,0 +1,109 @@
+"""ReplicaSet: replica selection with max-concurrent-queries backpressure.
+
+Parity target: the reference's Router/ReplicaSet
+(reference: python/ray/serve/router.py:45,177). Membership comes from
+the controller via long-poll; assignment is round-robin over replicas
+with a free slot, and when every replica is saturated the caller BLOCKS
+until an in-flight request completes — queries can't pile up
+unboundedly on replica queues (the reference enforces the same cap via
+its async flow-control loop).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu._private.object_ref import ObjectRef
+
+
+class ReplicaSet:
+    """Thread-safe (handles may be shared across driver threads)."""
+
+    def __init__(self, deployment_name: str):
+        self.deployment_name = deployment_name
+        self._lock = threading.Lock()
+        self._replicas: List[dict] = []       # {"id", "handle"}
+        self._max_queries = 1
+        self._inflight: Dict[str, List[ObjectRef]] = {}
+        self._rr = 0
+        self._have_members = threading.Event()
+
+    # ---- membership (long-poll callback + bootstrap) ----
+
+    def update_membership(self, snapshot: dict) -> None:
+        with self._lock:
+            self._replicas = list(snapshot.get("replicas", []))
+            self._max_queries = max(
+                1, int(snapshot.get("max_concurrent_queries", 1)))
+            live = {r["id"] for r in self._replicas}
+            for rid in list(self._inflight):
+                if rid not in live:
+                    del self._inflight[rid]
+        if self._replicas:
+            self._have_members.set()
+        else:
+            self._have_members.clear()
+
+    # ---- assignment ----
+
+    def assign(self, method: str, args: tuple, kwargs: dict,
+               timeout_s: Optional[float] = None) -> ObjectRef:
+        """Pick a replica with a free slot and submit; block when all
+        replicas are at max_concurrent_queries."""
+        import ray_tpu
+
+        timeout_s = 30.0 if timeout_s is None else timeout_s
+        deadline = time.monotonic() + timeout_s
+        while True:
+            if not self._have_members.wait(
+                    timeout=max(0.0, deadline - time.monotonic())):
+                raise RuntimeError(
+                    f"no replicas for deployment "
+                    f"{self.deployment_name!r} (not deployed or deleted)")
+            with self._lock:
+                replica = self._try_pick()
+                if replica is not None:
+                    ref = replica["handle"].handle_request.remote(
+                        method, args, kwargs)
+                    self._inflight.setdefault(replica["id"], []).append(ref)
+                    return ref
+                all_inflight = [r for refs in self._inflight.values()
+                                for r in refs]
+            # Backpressure: every slot is busy. Wait for ANY in-flight
+            # query to finish, then retry the pick. (Not counted
+            # against the timeout: progress is being made.)
+            if all_inflight:
+                ray_tpu.wait(all_inflight, num_returns=1, timeout=1.0)
+                deadline = time.monotonic() + timeout_s
+            else:
+                # No members / membership flapped mid-roll: don't
+                # busy-spin the lock while waiting for the long-poll.
+                time.sleep(0.01)
+
+    def _try_pick(self) -> Optional[dict]:
+        """Round-robin over replicas with spare capacity. Caller holds
+        the lock. Also prunes completed refs (wait with timeout=0)."""
+        import ray_tpu
+
+        n = len(self._replicas)
+        if not n:
+            return None
+        # Lazily drop finished queries from the in-flight book.
+        for rid, refs in self._inflight.items():
+            if refs:
+                done, pending = ray_tpu.wait(
+                    refs, num_returns=len(refs), timeout=0)
+                self._inflight[rid] = pending
+        for i in range(n):
+            replica = self._replicas[(self._rr + i) % n]
+            if len(self._inflight.get(replica["id"], [])) \
+                    < self._max_queries:
+                self._rr = (self._rr + i + 1) % n
+                return replica
+        return None
+
+    def num_queued(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._inflight.values())

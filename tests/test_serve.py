@@ -1,0 +1,171 @@
+"""Serve: deploy / scale / update / backpressure / drain.
+
+Mirrors the reference's serve test coverage shape
+(reference: python/ray/serve/tests/test_deploy.py, test_backpressure
+paths in test_router.py).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_cluster():
+    ray_tpu.init(num_cpus=4)
+    serve.start()
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_deploy_class_and_call(serve_cluster):
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+        def name(self):
+            return "doubler"
+
+    Doubler.deploy()
+    h = Doubler.get_handle()
+    assert ray_tpu.get(h.remote(21)) == 42
+    # secondary method routing
+    assert ray_tpu.get(h.name.remote()) == "doubler"
+    assert serve.list_deployments() == ["Doubler"]
+
+
+def test_deploy_function(serve_cluster):
+    @serve.deployment
+    def add_one(x):
+        return x + 1
+
+    add_one.deploy()
+    h = add_one.get_handle()
+    assert ray_tpu.get(h.remote(1)) == 2
+
+
+def test_init_args_and_user_config(serve_cluster):
+    @serve.deployment
+    class Greeter:
+        def __init__(self, greeting):
+            self.greeting = greeting
+            self.suffix = ""
+
+        def reconfigure(self, config):
+            self.suffix = config["suffix"]
+
+        def __call__(self, name):
+            return f"{self.greeting} {name}{self.suffix}"
+
+    Greeter.options(user_config={"suffix": "!"}).deploy("hello")
+    h = Greeter.get_handle()
+    assert ray_tpu.get(h.remote("world")) == "hello world!"
+
+
+def test_scale_up_and_down(serve_cluster):
+    @serve.deployment(num_replicas=1, version="v1")
+    class WhoAmI:
+        def __init__(self):
+            import os
+            self.pid = os.getpid()
+
+        def __call__(self):
+            return self.pid
+
+    WhoAmI.deploy()
+    h = WhoAmI.get_handle()
+    pids = {ray_tpu.get(h.remote()) for _ in range(8)}
+    assert len(pids) == 1
+
+    # scale out (same version: no roll of the surviving replica)
+    serve.get_deployment("WhoAmI").options(num_replicas=3).deploy()
+    deadline = time.monotonic() + 10
+    pids3 = set()
+    while time.monotonic() < deadline and len(pids3) < 3:
+        pids3 = {ray_tpu.get(h.remote()) for _ in range(24)}
+    assert len(pids3) == 3
+    assert pids <= pids3  # v1 survivor kept serving
+
+    # scale back in
+    serve.get_deployment("WhoAmI").options(num_replicas=1).deploy()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        pids1 = {ray_tpu.get(h.remote()) for _ in range(8)}
+        if len(pids1) == 1:
+            break
+    assert len(pids1) == 1
+
+
+def test_rolling_update_changes_code(serve_cluster):
+    @serve.deployment(version="v1")
+    class V:
+        def __call__(self):
+            return "v1"
+
+    V.deploy()
+    h = V.get_handle()
+    assert ray_tpu.get(h.remote()) == "v1"
+
+    @serve.deployment(name="V", version="v2")
+    class V2:
+        def __call__(self):
+            return "v2"
+
+    V2.deploy()
+    # the long-poll pushes the new replica set; allow it a moment
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if ray_tpu.get(h.remote()) == "v2":
+            break
+        time.sleep(0.05)
+    assert ray_tpu.get(h.remote()) == "v2"
+
+
+def test_backpressure_caps_inflight(serve_cluster):
+    @serve.deployment(num_replicas=1, max_concurrent_queries=2)
+    class Slow:
+        def __init__(self):
+            self.active = 0
+            self.max_active = 0
+
+        async def __call__(self):
+            import asyncio
+            self.active += 1
+            self.max_active = max(self.max_active, self.active)
+            await asyncio.sleep(0.2)
+            self.active -= 1
+            return self.max_active
+
+        def peak(self):
+            return self.max_active
+
+    Slow.deploy()
+    h = Slow.get_handle()
+    refs = [h.remote() for _ in range(6)]  # assign() blocks at cap
+    ray_tpu.get(refs)
+    # replica never saw more than max_concurrent_queries at once
+    assert ray_tpu.get(h.peak.remote()) <= 2
+
+
+def test_delete_deployment(serve_cluster):
+    @serve.deployment
+    def f():
+        return 1
+
+    f.deploy()
+    h = f.get_handle()
+    assert ray_tpu.get(h.remote()) == 1
+    f.delete()
+    assert serve.list_deployments() == []
+    # the long-poll push empties the handle's replica set
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and \
+            h._replica_set._have_members.is_set():
+        time.sleep(0.05)
+    with pytest.raises(RuntimeError, match="no replicas"):
+        h._replica_set.assign("__call__", (), {}, timeout_s=1.0)
