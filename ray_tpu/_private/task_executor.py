@@ -406,10 +406,17 @@ class TaskExecutor:
             try:
                 spec = TaskSpec.from_wire(header, bufs)
                 if self._actor_is_asyncio:
-                    # Hand off to the user loop; concurrency is bounded
-                    # there (semaphore wakes FIFO, and
+                    # Admission control HERE (async acquire on the IO
+                    # loop): intake pauses at the concurrency cap, so a
+                    # flood of pushes can't pile unbounded coroutines
+                    # onto the user loop. Release comes back via
+                    # call_soon_threadsafe when the task finishes.
                     # run_coroutine_threadsafe preserves submit order,
-                    # so in-order task STARTS are kept).
+                    # so in-order task STARTS are kept.
+                    if self._actor_sema is None:
+                        self._actor_sema = asyncio.Semaphore(
+                            self._actor_aio_limit)
+                    await self._actor_sema.acquire()
                     asyncio.run_coroutine_threadsafe(
                         self._run_async_actor_task(
                             spec, fut, asyncio.get_running_loop()),
@@ -458,10 +465,8 @@ class TaskExecutor:
 
     async def _run_async_actor_task(self, spec: TaskSpec,
                                     fut: asyncio.Future, io_loop):
-        """Runs ON THE ACTOR USER LOOP; ``fut`` belongs to ``io_loop``."""
-        if self._actor_sema is None:  # lazily bound to this loop
-            self._actor_sema = asyncio.Semaphore(self._actor_aio_limit)
-        await self._actor_sema.acquire()
+        """Runs ON THE ACTOR USER LOOP; ``fut`` and the admission
+        semaphore belong to ``io_loop``."""
         try:
             method = self._lookup_method(spec.name)
             args, kwargs = await asyncio.get_running_loop().run_in_executor(
@@ -478,10 +483,9 @@ class TaskExecutor:
             reply = self._build_reply(spec, None)
         except Exception as e:  # noqa: BLE001
             reply = self._error_reply(spec, format_task_error(spec.name, e))
-        finally:
-            self._actor_sema.release()
 
         def _set():
+            self._actor_sema.release()
             if not fut.done():
                 fut.set_result(reply)
 

@@ -186,10 +186,17 @@ def flash_attention(q, k, v, *, causal: bool = True,
     """
     B, T, H, D = q.shape
     sm_scale = sm_scale if sm_scale is not None else D ** -0.5
-    block_q = min(block_q, T)
-    block_k = min(block_k, T)
-    if ((not interpret and not _on_tpu()) or T % block_q or T % block_k
-            or k.shape[1] != T):
+    if interpret:
+        # interpret mode exists to exercise the kernel: clamp blocks so
+        # it runs even at small T (no Mosaic tiling constraints on CPU).
+        block_q = min(block_q, T)
+        block_k = min(block_k, T)
+    # On real TPU, short / unaligned sequences use the XLA reference:
+    # sub-tile Pallas blocks (sublane 8 / lane 128 granularity) are
+    # where Mosaic lowering gets fragile, and at these sizes XLA's
+    # fused attention wins anyway.
+    if ((not interpret and not _on_tpu()) or T < block_q or T % block_q
+            or T % block_k or k.shape[1] != T):
         return attention(q, k, v, causal=causal, sm_scale=sm_scale)
     return _flash(q, k, v, causal, sm_scale, block_q, block_k,
                   interpret)

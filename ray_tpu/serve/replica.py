@@ -32,10 +32,11 @@ class Replica:
 
     async def handle_request(self, method: str, args: tuple,
                              kwargs: dict):
-        if self._draining:
-            # The router raced a rolling update; surface a retryable
-            # error (the ReplicaSet refreshes membership and retries).
-            raise RuntimeError("replica is draining")
+        # Note: a DRAINING replica still serves — a router that raced
+        # the rolling update may send a few stragglers after the
+        # controller switched the snapshot, and failing them would
+        # surface errors for requests the user did nothing wrong with.
+        # Drain completion just waits a little longer.
         self._inflight += 1
         try:
             # Class deployments: bound-method lookup; function

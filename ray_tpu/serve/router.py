@@ -72,11 +72,21 @@ class ReplicaSet:
                 all_inflight = [r for refs in self._inflight.values()
                                 for r in refs]
             # Backpressure: every slot is busy. Wait for ANY in-flight
-            # query to finish, then retry the pick. (Not counted
-            # against the timeout: progress is being made.)
+            # query to finish, then retry the pick. Only an actual
+            # completion resets the timeout (progress); a wedged
+            # replica must not block a caller that asked for a bound.
             if all_inflight:
-                ray_tpu.wait(all_inflight, num_returns=1, timeout=1.0)
-                deadline = time.monotonic() + timeout_s
+                done, _ = ray_tpu.wait(all_inflight, num_returns=1,
+                                       timeout=1.0)
+                if done:
+                    deadline = time.monotonic() + timeout_s
+                elif time.monotonic() >= deadline:
+                    raise RuntimeError(
+                        f"timed out after {timeout_s}s waiting for a "
+                        f"free slot on deployment "
+                        f"{self.deployment_name!r} (all "
+                        f"{len(self._replicas)} replicas at "
+                        f"max_concurrent_queries={self._max_queries})")
             else:
                 # No members / membership flapped mid-roll: don't
                 # busy-spin the lock while waiting for the long-poll.
@@ -84,22 +94,23 @@ class ReplicaSet:
 
     def _try_pick(self) -> Optional[dict]:
         """Round-robin over replicas with spare capacity. Caller holds
-        the lock. Also prunes completed refs (wait with timeout=0)."""
+        the lock. Completed refs are pruned LAZILY, only for replicas
+        whose book looks full — the unsaturated fast path costs zero
+        IO-loop round trips per assignment."""
         import ray_tpu
 
         n = len(self._replicas)
         if not n:
             return None
-        # Lazily drop finished queries from the in-flight book.
-        for rid, refs in self._inflight.items():
-            if refs:
-                done, pending = ray_tpu.wait(
-                    refs, num_returns=len(refs), timeout=0)
-                self._inflight[rid] = pending
+        prune_at = min(self._max_queries, 32)
         for i in range(n):
             replica = self._replicas[(self._rr + i) % n]
-            if len(self._inflight.get(replica["id"], [])) \
-                    < self._max_queries:
+            refs = self._inflight.get(replica["id"], [])
+            if len(refs) >= prune_at:
+                _, refs = ray_tpu.wait(
+                    refs, num_returns=len(refs), timeout=0)
+                self._inflight[replica["id"]] = refs
+            if len(refs) < self._max_queries:
                 self._rr = (self._rr + i + 1) % n
                 return replica
         return None

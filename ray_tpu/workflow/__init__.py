@@ -1,0 +1,249 @@
+"""Durable workflows: a checkpointed step DAG with crash recovery.
+
+Parity target: the reference's Workflow library
+(reference: python/ray/workflow/ — step_executor.py, WorkflowStorage
+workflow_storage.py:89, recovery.py). Steps are remote tasks whose
+outputs checkpoint to durable storage before the value is used
+downstream; ``resume`` reloads the persisted DAG and re-executes only
+the steps without a checkpoint. Step continuations (a step returning
+another workflow) are supported — that's the recursion/loop primitive.
+
+Usage::
+
+    from ray_tpu import workflow
+
+    workflow.init(storage="/tmp/wf")
+
+    @workflow.step
+    def add(a, b):
+        return a + b
+
+    out = add.step(add.step(1, 2), 3).run(workflow_id="sum3")  # 6
+    workflow.resume("sum3")  # replays from checkpoints -> 6
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.workflow.storage import WorkflowStorage
+
+__all__ = ["init", "step", "Workflow", "resume", "get_output",
+           "get_status", "list_all"]
+
+_storage: Optional[WorkflowStorage] = None
+
+
+def init(storage: Optional[str] = None) -> None:
+    """Set the durable storage root (defaults to ``~/.ray_tpu_workflows``
+    or ``$RAY_TPU_WORKFLOW_STORAGE``)."""
+    global _storage
+    base = (storage or os.environ.get("RAY_TPU_WORKFLOW_STORAGE")
+            or os.path.expanduser("~/.ray_tpu_workflows"))
+    _storage = WorkflowStorage(base)
+
+
+def _get_storage() -> WorkflowStorage:
+    if _storage is None:
+        init()
+    return _storage
+
+
+class Workflow:
+    """A step DAG node: function + args (args may be Workflows)."""
+
+    def __init__(self, fn, args: tuple, kwargs: dict,
+                 name: Optional[str] = None, max_retries: int = 0):
+        self._fn = fn
+        self._args = args
+        self._kwargs = kwargs
+        self._name = name or fn.__name__
+        self._max_retries = max_retries
+
+    def run(self, workflow_id: Optional[str] = None) -> Any:
+        """Execute to completion (blocking) with checkpointing."""
+        return ray_tpu.get(self.run_async(workflow_id))
+
+    def run_async(self, workflow_id: Optional[str] = None):
+        """Start execution; returns an ObjectRef of the final output."""
+        workflow_id = workflow_id or uuid.uuid4().hex[:12]
+        store = _get_storage()
+        store.save_dag(workflow_id, self)
+        store.set_status(workflow_id, "RUNNING")
+        return _execute_dag(store, workflow_id, self)
+
+
+def step(_fn=None, *, name: Optional[str] = None, max_retries: int = 0):
+    """``@workflow.step`` decorator (bare or with options)."""
+    def wrap(fn):
+        return StepFunction(fn, name=name, max_retries=max_retries)
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap
+
+
+class StepFunction:
+    def __init__(self, fn, name: Optional[str] = None,
+                 max_retries: int = 0):
+        self._fn = fn
+        self._name = name
+        self._max_retries = max_retries
+        functools.update_wrapper(self, fn)
+
+    def step(self, *args, **kwargs) -> Workflow:
+        return Workflow(self._fn, args, kwargs, name=self._name,
+                        max_retries=self._max_retries)
+
+    def options(self, name: Optional[str] = None,
+                max_retries: Optional[int] = None) -> "StepFunction":
+        return StepFunction(
+            self._fn, name=name or self._name,
+            max_retries=self._max_retries if max_retries is None
+            else max_retries)
+
+    def __call__(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)  # plain local call
+
+
+# --------------------------------------------------------------- execution
+
+class _Continuation:
+    """Wire marker: a step returned another workflow."""
+
+    def __init__(self, dag: Workflow):
+        self.dag = dag
+
+
+@ray_tpu.remote
+def _run_step(base_dir: str, workflow_id: str, step_id: str, fn,
+              nargs: int, kwarg_keys, *values):
+    """One step as a remote task. Upstream values arrive as TOP-LEVEL
+    ObjectRef arguments in ``values`` (the runtime resolves top-level
+    refs only — reference parity — so args/kwargs are flattened and
+    rebuilt here). Execution order AND sibling parallelism come from
+    normal task scheduling. Idempotent: a checkpointed output
+    short-circuits re-execution on resume."""
+    args = values[:nargs]
+    kwargs = dict(zip(kwarg_keys, values[nargs:]))
+    store = WorkflowStorage(base_dir)
+    if store.has_step_output(workflow_id, step_id):
+        return store.load_step_output(workflow_id, step_id)
+    result = fn(*args, **kwargs)
+    if isinstance(result, Workflow):
+        # Continuation: checkpoint the DAG, not the (unknown) value;
+        # the driver-side executor picks it up.
+        result = _Continuation(result)
+    store.save_step_output(workflow_id, step_id, result)
+    return result
+
+
+def _assign_step_ids(node: Workflow, prefix: str,
+                     counter: Dict[str, int]) -> Dict[int, str]:
+    """Deterministic step ids: name + DFS ordinal (stable across the
+    identical DAG pickle loaded by resume)."""
+    ids: Dict[int, str] = {}
+
+    def visit(n: Workflow):
+        if id(n) in ids:
+            return
+        for a in list(n._args) + list(n._kwargs.values()):
+            if isinstance(a, Workflow):
+                visit(a)
+        k = n._name
+        counter[k] = counter.get(k, 0) + 1
+        ids[id(n)] = f"{prefix}{k}_{counter[k]}"
+
+    visit(node)
+    return ids
+
+
+def _submit_steps(store: WorkflowStorage, workflow_id: str,
+                  root: Workflow, prefix: str = ""):
+    """Submit every step as a task wired by (top-level) ObjectRef args.
+    Returns (root_step_id, root_ref)."""
+    ids = _assign_step_ids(root, prefix, {})
+    refs: Dict[int, Any] = {}
+
+    def submit(n: Workflow):
+        if id(n) in refs:
+            return refs[id(n)]
+        args = tuple(submit(a) if isinstance(a, Workflow) else a
+                     for a in n._args)
+        kwargs = {k: (submit(v) if isinstance(v, Workflow) else v)
+                  for k, v in n._kwargs.items()}
+        opts = _run_step.options(max_retries=n._max_retries) \
+            if n._max_retries else _run_step
+        refs[id(n)] = opts.remote(
+            store.base_dir, workflow_id, ids[id(n)], n._fn,
+            len(args), list(kwargs), *args, *kwargs.values())
+        return refs[id(n)]
+
+    return ids[id(root)], submit(root)
+
+
+def _execute_dag(store: WorkflowStorage, workflow_id: str,
+                 root: Workflow):
+    root_id, root_ref = _submit_steps(store, workflow_id, root)
+    return _finalize.remote(store.base_dir, workflow_id, root_id,
+                            root_ref)
+
+
+@ray_tpu.remote
+def _finalize(base_dir: str, workflow_id: str, root_step_id: str,
+              result):
+    """Resolve continuations, then mark the workflow SUCCESSFUL.
+
+    ONE finalize task per workflow run: the continuation loop lives
+    here (submitting step tasks and blocking on their refs) instead of
+    chaining nested finalize tasks, which would hold one worker per
+    continuation depth and deadlock the pool on deep tail recursion."""
+    store = WorkflowStorage(base_dir)
+    depth = 0
+    while isinstance(result, _Continuation):
+        depth += 1
+        _, ref = _submit_steps(store, workflow_id, result.dag,
+                               prefix=f"{root_step_id}/c{depth}/")
+        result = ray_tpu.get(ref)
+    store.save_step_output(workflow_id, "__output__", result)
+    store.set_status(workflow_id, "SUCCESSFUL")
+    return result
+
+
+# --------------------------------------------------------------- management
+
+def resume(workflow_id: str) -> Any:
+    """Re-execute a workflow from its last checkpoints (blocking)."""
+    return ray_tpu.get(resume_async(workflow_id))
+
+
+def resume_async(workflow_id: str):
+    store = _get_storage()
+    if store.get_status(workflow_id) is None:
+        raise ValueError(f"no workflow with id {workflow_id!r}")
+    dag = store.load_dag(workflow_id)
+    store.set_status(workflow_id, "RUNNING")
+    return _execute_dag(store, workflow_id, dag)
+
+
+def get_output(workflow_id: str) -> Any:
+    """Fetch the checkpointed final output of a finished workflow."""
+    store = _get_storage()
+    status = store.get_status(workflow_id)
+    if status != "SUCCESSFUL":
+        raise ValueError(
+            f"workflow {workflow_id!r} is {status or 'unknown'}; "
+            "resume() it first")
+    return store.load_step_output(workflow_id, "__output__")
+
+
+def get_status(workflow_id: str) -> Optional[str]:
+    return _get_storage().get_status(workflow_id)
+
+
+def list_all() -> List[str]:
+    return _get_storage().list_workflows()
