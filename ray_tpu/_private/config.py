@@ -91,6 +91,9 @@ class RayTpuConfig:
     # --- observability ---
     event_log_enabled: bool = True
     metrics_report_period_ms: int = 2000
+    # Prometheus text endpoint on the GCS host (0 = auto-assign; the
+    # bound address lands in the KV key __rtpu_metrics_address__).
+    metrics_export_port: int = 0
     profiling_enabled: bool = True
     debug_dump_period_ms: int = 10000
 

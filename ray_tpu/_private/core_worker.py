@@ -124,9 +124,11 @@ class CoreWorker:
                  gcs_address: str, raylet_address: str,
                  session_dir: str, job_id: bytes = b"",
                  worker_id: bytes = b"", node_id: bytes = b"",
-                 loop: Optional[asyncio.AbstractEventLoop] = None):
+                 loop: Optional[asyncio.AbstractEventLoop] = None,
+                 log_to_driver: bool = False):
         assert mode in ("driver", "worker")
         self.mode = mode
+        self.log_to_driver = log_to_driver
         self.config = config
         self.gcs_address = gcs_address
         self.raylet_address = raylet_address
@@ -180,6 +182,7 @@ class CoreWorker:
         self.task_executor = None   # set in worker mode by worker_main
         self._task_events: List[dict] = []
         self._profile_flush_task = None
+        self._metrics_report_task = None
         # Set by the actor module so the core worker can build handles
         # without import cycles.
         self._actor_handle_factory: Optional[Callable] = None
@@ -216,11 +219,15 @@ class CoreWorker:
             self.raylet_address, handlers=self._server.handlers,
             peer_name="raylet")
         await self.gcs_conn.call("Subscribe", {"channel": "ACTOR"})
+        if self.mode == "driver" and self.log_to_driver:
+            await self.gcs_conn.call("Subscribe", {"channel": "LOGS"})
         self._driver_task_id = TaskID.for_driver(JobID(self.job_id)) \
             if self.job_id else TaskID.from_random()
         if self.config.profiling_enabled:
             self._profile_flush_task = self.loop.create_task(
                 self._profile_flush_loop())
+        self._metrics_report_task = self.loop.create_task(
+            self._metrics_report_loop())
 
     def shutdown(self):
         if self._shutdown:
@@ -236,6 +243,8 @@ class CoreWorker:
     async def _shutdown_async(self):
         if self._profile_flush_task:
             self._profile_flush_task.cancel()
+        if getattr(self, "_metrics_report_task", None):
+            self._metrics_report_task.cancel()
         if self.mode == "driver" and self.gcs_conn and not self.gcs_conn.closed:
             try:
                 await self.gcs_conn.call("MarkJobFinished",
@@ -301,6 +310,11 @@ class CoreWorker:
                                             timeout=timeout)
 
     # ------------------------------------------------------------ KV helpers
+
+    def gcs_call_sync(self, method: str, header: dict) -> dict:
+        """Generic blocking GCS RPC from API threads (state dumps)."""
+        reply, _ = self._run(self._gcs_call(method, header))
+        return reply
 
     def _kv_put_sync(self, key: bytes, value: bytes):
         self._run(self._gcs_call("KVPut", {"key": key}, bufs=[value]))
@@ -1326,7 +1340,32 @@ class CoreWorker:
         self._run(self._gcs_call("KillActor", {
             "actor_id": actor_id, "no_restart": no_restart}))
 
+    async def _metrics_report_loop(self):
+        """Ship this process's user-metric registry to the GCS on a
+        timer (reference: per-process OpenCensus exporter → metrics
+        agent, stats/metric.h + metrics_agent.py)."""
+        from ray_tpu._private import metrics as metrics_mod
+
+        period = self.config.metrics_report_period_ms / 1000.0
+        reporter = f"{self.mode}-{WorkerID(self.worker_id).hex()[:12]}"
+        while not self._shutdown:
+            await asyncio.sleep(period)
+            snap = metrics_mod.global_registry().snapshot()
+            if not snap:
+                continue
+            try:
+                await self._gcs_call("ReportMetrics", {
+                    "reporter_id": reporter, "snapshot": snap})
+            except Exception:  # noqa: BLE001 — GCS restarting
+                pass
+
     async def _handle_published(self, conn, header, bufs):
+        if header["channel"] == "LOGS":
+            msg = header["msg"]
+            prefix = f"(pid={msg['pid']}, {msg['ip']})"
+            for line in msg["lines"]:
+                print(f"{prefix} {line}", flush=True)
+            return {}
         if header["channel"] == "ACTOR":
             msg = header["msg"]
             q = self.actor_queues.get(msg["actor_id"])

@@ -49,6 +49,7 @@ class NodeEntry:
         self.last_heartbeat = time.time()
         self.alive = True
         self.conn: Optional[rpc.Connection] = None
+        self.stats: dict = {}  # last heartbeat-piggybacked node stats
 
 
 class ActorEntry:
@@ -89,6 +90,13 @@ class GcsServer:
         # Optional append-only journal (reference: GcsTableStorage +
         # GcsInitData reload) — enabled via config.gcs_journal_path.
         self.journal = None
+        # Observability: per-reporter user-metric snapshots (reference:
+        # per-node MetricsAgent re-exporting Prometheus,
+        # python/ray/_private/metrics_agent.py:61) and the HTTP
+        # endpoint serving the merged cluster view.
+        self._metric_snapshots: Dict[str, dict] = {}
+        self._http_server = None
+        self.metrics_address = ""
 
     # ------------------------------------------------------------------ wiring
 
@@ -124,6 +132,8 @@ class GcsServer:
             "GetProfileEvents": self.handle_get_profile_events,
             "AddClusterEvent": self.handle_add_cluster_event,
             "GetClusterEvents": self.handle_get_cluster_events,
+            "ReportMetrics": self.handle_report_metrics,
+            "GetNodeStatsSummary": self.handle_get_node_stats_summary,
         }
 
     async def start(self, address: str = "") -> str:
@@ -138,6 +148,7 @@ class GcsServer:
         addr = await self._server.listen(address)
         self._monitor_task = asyncio.get_running_loop().create_task(
             self._liveness_monitor())
+        await self._start_metrics_http(addr)
         # Actors caught mid-scheduling by a crash (journaled PENDING /
         # RESTARTING) need their scheduling loop restarted — raylets
         # re-register within the loop's retry window.
@@ -151,9 +162,135 @@ class GcsServer:
     async def stop(self):
         if self._monitor_task:
             self._monitor_task.cancel()
+        if self._http_server is not None:
+            self._http_server.close()
         await self._server.close()
         if self.journal is not None:
             self.journal.close()
+
+    # -------------------------------------------------------- observability
+
+    async def _start_metrics_http(self, rpc_addr: str) -> None:
+        """Prometheus text endpoint (reference: metrics agent export on
+        metrics_export_port, metrics_agent.py:61). Serves the merged
+        built-in + user metrics on GET /metrics."""
+        # rpc_addr is "tcp://host:port" or "unix://path"
+        if rpc_addr.startswith("tcp://"):
+            host = rpc_addr[len("tcp://"):].rsplit(":", 1)[0]
+        else:
+            host = "127.0.0.1"
+        port = getattr(self.config, "metrics_export_port", 0)
+        self._http_server = await asyncio.start_server(
+            self._handle_http, host, port)
+        bound = self._http_server.sockets[0].getsockname()
+        self.metrics_address = f"{host}:{bound[1]}"
+        self.kv[b"__rtpu_metrics_address__"] = self.metrics_address.encode()
+
+    async def _handle_http(self, reader, writer):
+        try:
+            request = await asyncio.wait_for(reader.readline(), 10)
+            while True:  # drain headers
+                line = await asyncio.wait_for(reader.readline(), 10)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            path = request.split(b" ")[1] if request.count(b" ") else b"/"
+            if path.startswith(b"/metrics"):
+                body = self._render_metrics().encode()
+                status, ctype = b"200 OK", b"text/plain; version=0.0.4"
+            else:
+                body = b"ray_tpu GCS: scrape /metrics\n"
+                status, ctype = b"200 OK", b"text/plain"
+            writer.write(b"HTTP/1.1 " + status +
+                         b"\r\nContent-Type: " + ctype +
+                         b"\r\nContent-Length: " +
+                         str(len(body)).encode() +
+                         b"\r\nConnection: close\r\n\r\n" + body)
+            await writer.drain()
+        except Exception:  # noqa: BLE001 — malformed scrape
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _builtin_metrics(self) -> dict:
+        """Cluster-state gauges computed from GCS tables + per-node
+        stats piggybacked on heartbeats (reference: metric_defs.h
+        gauges like LocalAvailableResource/ObjectStoreUsedMemory)."""
+        g = {}
+
+        def gauge(name, desc, values):
+            g[name] = {"kind": "gauge", "description": desc,
+                       "boundaries": [],
+                       "values": [[list(k), v] for k, v in values]}
+
+        gauge("ray_tpu_gcs_nodes_alive", "Live raylet count",
+              [((), float(sum(1 for n in self.nodes.values() if n.alive)))])
+        by_state: Dict[str, int] = {}
+        for a in self.actors.values():
+            by_state[a.state] = by_state.get(a.state, 0) + 1
+        gauge("ray_tpu_gcs_actors", "Actors by state",
+              [(((("state", s),)), float(c)) for s, c in by_state.items()])
+        gauge("ray_tpu_gcs_jobs", "Registered jobs",
+              [((), float(len(self.jobs)))])
+        gauge("ray_tpu_gcs_placement_groups", "Placement groups",
+              [((), float(len(self.placement_groups)))])
+        node_gauges = [
+            ("num_workers", "ray_tpu_node_workers", "Worker processes"),
+            ("num_pending_leases", "ray_tpu_node_pending_leases",
+             "Lease requests queued"),
+            ("num_leases_granted", "ray_tpu_node_leases_granted_total",
+             "Leases granted"),
+            ("num_spillbacks", "ray_tpu_node_spillbacks_total",
+             "Lease requests spilled to other nodes"),
+            ("store_used_bytes", "ray_tpu_object_store_bytes_used",
+             "Shared-memory store bytes in use"),
+            ("store_num_objects", "ray_tpu_object_store_objects",
+             "Objects resident in the store"),
+            ("store_num_spills", "ray_tpu_object_store_spills_total",
+             "Objects spilled to external storage"),
+            ("store_num_evictions", "ray_tpu_object_store_evictions_total",
+             "Objects evicted from the store"),
+        ]
+        for key, name, desc in node_gauges:
+            vals = []
+            for n in self.nodes.values():
+                if n.alive and key in n.stats:
+                    vals.append(((("node", n.node_id.hex()[:12]),),
+                                 float(n.stats[key])))
+            if vals:
+                gauge(name, desc, vals)
+        return g
+
+    def _render_metrics(self) -> str:
+        from ray_tpu._private import metrics as metrics_mod
+
+        cutoff = time.time() - self.METRIC_SNAPSHOT_TTL_S
+        for key in [k for k, (ts, _) in self._metric_snapshots.items()
+                    if ts < cutoff]:
+            del self._metric_snapshots[key]
+        snaps = [s for _, s in self._metric_snapshots.values()]
+        merged = metrics_mod.merge_snapshots(snaps)
+        merged.update(self._builtin_metrics())
+        return metrics_mod.render_prometheus(merged)
+
+    # Reporters that stop reporting (dead workers) age out: their
+    # gauges must not be served forever, nor their snapshots leak.
+    METRIC_SNAPSHOT_TTL_S = 60.0
+
+    async def handle_report_metrics(self, conn, header, bufs):
+        self._metric_snapshots[header["reporter_id"]] = (
+            time.time(), header["snapshot"])
+        return {"ok": True}
+
+    async def handle_get_node_stats_summary(self, conn, header, bufs):
+        return {"nodes": [{
+            "node_id": n.node_id, "address": n.address, "alive": n.alive,
+            "resources_total": n.resources_total,
+            "resources_available": n.resources_available,
+            "stats": n.stats,
+        } for n in self.nodes.values()]}
 
     # ----------------------------------------------------------- persistence
 
@@ -315,6 +452,8 @@ class GcsServer:
         entry.last_heartbeat = time.time()
         if "resources_available" in header:
             entry.resources_available = header["resources_available"]
+        if "stats" in header:
+            entry.stats = header["stats"]
         return {"ok": True}
 
     async def handle_report_resource_usage(self, conn, header, bufs):

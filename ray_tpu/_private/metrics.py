@@ -1,0 +1,214 @@
+"""Process-local metric registry with Prometheus text rendering.
+
+Role parity: the reference's C++ OpenCensus stats layer + per-node
+metrics agent (reference: src/ray/stats/metric.h:100, metric_defs.h,
+python/ray/_private/metrics_agent.py:61 → Prometheus). Re-design: each
+process records into an in-memory registry; snapshots ship to the GCS
+(piggybacked on heartbeats for raylets, a periodic ReportMetrics RPC
+for workers), and the GCS renders the merged view on one Prometheus
+text endpoint — no per-node agent daemon, no OpenCensus.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                    50.0, 100.0)
+
+
+def _label_key(labels: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((labels or {}).items()))
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 registry: "MetricRegistry | None" = None):
+        if not name.replace("_", "a").isalnum():
+            raise ValueError(f"invalid metric name {name!r}")
+        self.name = name
+        self.description = description
+        self._lock = threading.Lock()
+        (registry or global_registry()).register(self)
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def __init__(self, name, description="", registry=None):
+        super().__init__(name, description, registry)
+        self._values: Dict[tuple, float] = {}
+
+    def inc(self, value: float = 1.0,
+            labels: Optional[Dict[str, str]] = None) -> None:
+        if value < 0:
+            raise ValueError("counters only go up")
+        k = _label_key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._values)
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def __init__(self, name, description="", registry=None):
+        super().__init__(name, description, registry)
+        self._values: Dict[tuple, float] = {}
+
+    def set(self, value: float,
+            labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = float(value)
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._values)
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(self, name, description="",
+                 boundaries: Sequence[float] = _DEFAULT_BUCKETS,
+                 registry=None):
+        super().__init__(name, description, registry)
+        self.boundaries = tuple(boundaries)
+        # per label-set: (bucket counts, sum, count)
+        self._values: Dict[tuple, list] = {}
+
+    def observe(self, value: float,
+                labels: Optional[Dict[str, str]] = None) -> None:
+        k = _label_key(labels)
+        with self._lock:
+            entry = self._values.get(k)
+            if entry is None:
+                entry = [[0] * (len(self.boundaries) + 1), 0.0, 0]
+                self._values[k] = entry
+            buckets, _, _ = entry
+            for i, b in enumerate(self.boundaries):
+                if value <= b:
+                    buckets[i] += 1
+                    break
+            else:
+                buckets[-1] += 1
+            entry[1] += value
+            entry[2] += 1
+
+    def snapshot(self):
+        with self._lock:
+            return {k: [list(v[0]), v[1], v[2]]
+                    for k, v in self._values.items()}
+
+
+class MetricRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, Metric] = {}
+
+    def register(self, metric: Metric) -> None:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None and type(existing) is not type(metric):
+                raise ValueError(
+                    f"metric {metric.name!r} already registered with a "
+                    f"different type")
+            self._metrics[metric.name] = metric
+
+    def metrics(self) -> List[Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def snapshot(self) -> dict:
+        """Wire-format dump for shipping to the GCS."""
+        out = {}
+        for m in self.metrics():
+            out[m.name] = {
+                "kind": m.kind, "description": m.description,
+                "boundaries": list(getattr(m, "boundaries", ())),
+                "values": [[list(k), v] for k, v in m.snapshot().items()],
+            }
+        return out
+
+
+_GLOBAL: Optional[MetricRegistry] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def global_registry() -> MetricRegistry:
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = MetricRegistry()
+        return _GLOBAL
+
+
+# ------------------------------------------------------------- rendering
+
+def _fmt_labels(pairs) -> str:
+    if not pairs:
+        return ""
+    inner = ",".join(f'{k}="{str(v).replace(chr(34), chr(39))}"'
+                     for k, v in pairs)
+    return "{" + inner + "}"
+
+
+def render_prometheus(merged: Dict[str, dict]) -> str:
+    """merged: {metric_name: {kind, description, boundaries,
+    values: [[labelpairs, value], ...]}} → Prometheus text format."""
+    lines: List[str] = []
+    for name in sorted(merged):
+        m = merged[name]
+        kind = m.get("kind", "gauge")
+        lines.append(f"# HELP {name} {m.get('description', '')}")
+        lines.append(f"# TYPE {name} {kind}")
+        if kind == "histogram":
+            bounds = m.get("boundaries", [])
+            for pairs, (buckets, total, count) in m["values"]:
+                pairs = [tuple(p) for p in pairs]
+                acc = 0
+                for b, c in zip(list(bounds) + ["+Inf"], buckets):
+                    acc += c
+                    lp = _fmt_labels(pairs + [("le", b)])
+                    lines.append(f"{name}_bucket{lp} {acc}")
+                lines.append(
+                    f"{name}_sum{_fmt_labels(pairs)} {total}")
+                lines.append(
+                    f"{name}_count{_fmt_labels(pairs)} {count}")
+        else:
+            for pairs, value in m["values"]:
+                pairs = [tuple(p) for p in pairs]
+                lines.append(f"{name}{_fmt_labels(pairs)} {value}")
+    return "\n".join(lines) + "\n"
+
+
+def merge_snapshots(snapshots: List[dict]) -> Dict[str, dict]:
+    """Merge per-process snapshots (counters/histograms add; gauges
+    last-writer-wins per label set)."""
+    merged: Dict[str, dict] = {}
+    for snap in snapshots:
+        for name, m in snap.items():
+            dst = merged.setdefault(name, {
+                "kind": m["kind"], "description": m["description"],
+                "boundaries": m.get("boundaries", []), "_vals": {}})
+            vals = dst["_vals"]
+            for pairs, value in m["values"]:
+                k = tuple(tuple(p) for p in pairs)
+                if k not in vals:
+                    vals[k] = value
+                elif m["kind"] == "counter":
+                    vals[k] = vals[k] + value
+                elif m["kind"] == "histogram":
+                    old_b, old_s, old_c = vals[k]
+                    new_b, new_s, new_c = value
+                    vals[k] = [[a + b for a, b in zip(old_b, new_b)],
+                               old_s + new_s, old_c + new_c]
+                else:  # gauge: last writer
+                    vals[k] = value
+    for m in merged.values():
+        m["values"] = [[list(k), v] for k, v in m.pop("_vals").items()]
+    return merged

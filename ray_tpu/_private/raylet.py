@@ -162,6 +162,8 @@ class Raylet:
             gcs_address, handlers=self._handlers(), peer_name="gcs")
         await self._register_with_gcs()
         self._hb_task = asyncio.get_running_loop().create_task(self._heartbeat_loop())
+        self._log_monitor_task = asyncio.get_running_loop().create_task(
+            self._log_monitor_loop())
         for _ in range(self.config.num_prestart_workers):
             self._start_worker_process()
         logger.info("raylet %s listening at %s (%s)",
@@ -172,6 +174,8 @@ class Raylet:
         self._closing = True
         if self._hb_task:
             self._hb_task.cancel()
+        if getattr(self, "_log_monitor_task", None):
+            self._log_monitor_task.cancel()
         for w in list(self.workers.values()):
             self._kill_worker(w)
         await self._server.close()
@@ -194,6 +198,77 @@ class Raylet:
         self._serve_attachments.clear()
         self.store.shutdown()
 
+    async def _log_monitor_loop(self):
+        """Tail this node's worker log files and publish new lines to
+        the GCS LOGS channel; drivers with log_to_driver print them
+        (reference: python/ray/_private/log_monitor.py tailing into
+        Redis pubsub, worker.py print_logs)."""
+        log_dir = os.path.join(self.session_dir, "logs")
+        offsets: Dict[str, int] = {}
+        while not self._closing:
+            await asyncio.sleep(0.25)
+            try:
+                names = [n for n in os.listdir(log_dir)
+                         if n.startswith("worker-") and n.endswith(".log")]
+            except FileNotFoundError:
+                continue
+            pid_by_wid_hex = {w.worker_id.hex(): w.pid
+                              for w in self.workers.values()}
+            for name in names:
+                path = os.path.join(log_dir, name)
+                pos = offsets.get(name, 0)
+                try:
+                    with open(path, "rb") as f:
+                        f.seek(pos)
+                        chunk = f.read(256 * 1024)
+                except OSError:
+                    continue
+                if not chunk:
+                    continue
+                # only publish complete lines; keep the tail buffered —
+                # and only advance the offset over lines actually
+                # published (a chatty worker's extra lines are picked up
+                # by the next poll, never dropped)
+                cut = chunk.rfind(b"\n")
+                if cut < 0:
+                    continue
+                lines = chunk[:cut].decode("utf-8", "replace").splitlines()
+                if len(lines) > 1000:
+                    lines = lines[:1000]
+                    cut = 0
+                    for _ in range(1000):
+                        cut = chunk.index(b"\n", cut) + 1
+                    cut -= 1
+                offsets[name] = pos + cut + 1
+                wid_hex = name[len("worker-"):-len(".log")]
+                pid = next((p for w, p in pid_by_wid_hex.items()
+                            if w.startswith(wid_hex)), 0)
+                try:
+                    await self.gcs_conn.call("Publish", {
+                        "channel": "LOGS",
+                        "msg": {"node": self.node_id.hex()[:12],
+                                "ip": self.node_name or "local",
+                                "pid": pid, "lines": lines},
+                    })
+                except ConnectionError:
+                    pass  # heartbeat loop owns reconnects
+
+    def _heartbeat_stats(self) -> dict:
+        """Flat per-node stats piggybacked on heartbeats → GCS metrics
+        endpoint (reference: raylet resource/stats reports feeding the
+        metrics agent; metric_defs.h gauges)."""
+        s = self.store.stats()
+        return {
+            "num_workers": self._alive_worker_count(),
+            "num_pending_leases": len(self._pending),
+            "num_leases_granted": self.num_leases_granted,
+            "num_spillbacks": self.num_spillbacks,
+            "store_used_bytes": s["used_bytes"],
+            "store_num_objects": s["num_objects"],
+            "store_num_spills": s["num_spills"],
+            "store_num_evictions": s["num_evictions"],
+        }
+
     async def _heartbeat_loop(self):
         period = self.config.raylet_heartbeat_period_ms / 1000.0
         while not self._closing:
@@ -201,6 +276,7 @@ class Raylet:
                 reply, _ = await self.gcs_conn.call("Heartbeat", {
                     "node_id": self.node_id.binary(),
                     "resources_available": self.resources_available,
+                    "stats": self._heartbeat_stats(),
                 })
                 if not reply.get("ok"):
                     # A restarted GCS does not know this node: re-register

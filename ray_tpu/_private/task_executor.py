@@ -417,10 +417,14 @@ class TaskExecutor:
                         self._actor_sema = asyncio.Semaphore(
                             self._actor_aio_limit)
                     await self._actor_sema.acquire()
-                    asyncio.run_coroutine_threadsafe(
-                        self._run_async_actor_task(
-                            spec, fut, asyncio.get_running_loop()),
-                        self._actor_user_loop.loop)
+                    try:
+                        asyncio.run_coroutine_threadsafe(
+                            self._run_async_actor_task(
+                                spec, fut, asyncio.get_running_loop()),
+                            self._actor_user_loop.loop)
+                    except BaseException:  # handoff failed: free slot
+                        self._actor_sema.release()
+                        raise
                 else:
                     loop = asyncio.get_running_loop()
 
@@ -467,6 +471,7 @@ class TaskExecutor:
                                     fut: asyncio.Future, io_loop):
         """Runs ON THE ACTOR USER LOOP; ``fut`` and the admission
         semaphore belong to ``io_loop``."""
+        reply = None
         try:
             method = self._lookup_method(spec.name)
             args, kwargs = await asyncio.get_running_loop().run_in_executor(
@@ -483,16 +488,23 @@ class TaskExecutor:
             reply = self._build_reply(spec, None)
         except Exception as e:  # noqa: BLE001
             reply = self._error_reply(spec, format_task_error(spec.name, e))
+        finally:
+            # BaseException paths too (CancelledError from a user-loop
+            # shutdown): the admission slot and the caller's future MUST
+            # be released either way, or the actor wedges at the cap.
+            if reply is None:
+                reply = self._error_reply(spec, exc.RaySystemError(
+                    f"actor task {spec.name} cancelled"))
 
-        def _set():
-            self._actor_sema.release()
-            if not fut.done():
-                fut.set_result(reply)
+            def _set(reply=reply):
+                self._actor_sema.release()
+                if not fut.done():
+                    fut.set_result(reply)
 
-        try:
-            io_loop.call_soon_threadsafe(_set)
-        except RuntimeError:  # io loop closed: process is shutting down
-            pass
+            try:
+                io_loop.call_soon_threadsafe(_set)
+            except RuntimeError:  # io loop closed: shutting down
+                pass
 
     def _lookup_method(self, name: str):
         method_name = name.rsplit(".", 1)[-1]

@@ -8,6 +8,8 @@ membership updates arrive over the controller's long-poll channel.
 
 from __future__ import annotations
 
+import threading
+
 import ray_tpu
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu.serve.long_poll import LongPollClient
@@ -31,15 +33,31 @@ class DeploymentHandle:
             controller,
             {SNAPSHOT_KEY.format(name=deployment_name):
              self._replica_set.update_membership})
+        # Janitor: drop completed bookkeeping refs after traffic
+        # quiesces so results aren't pinned in the object store.
+        self._closed = threading.Event()
+        self._janitor = threading.Thread(
+            target=self._janitor_loop, name="serve-handle-janitor",
+            daemon=True)
+        self._janitor.start()
+
+    def _janitor_loop(self):
+        while not self._closed.wait(1.0):
+            try:
+                if self._replica_set.num_queued():
+                    self._replica_set.prune()
+            except Exception:  # noqa: BLE001 — shutdown races
+                pass
 
     def remote(self, *args, **kwargs) -> ObjectRef:
         """Route one query; blocks only when every replica is at its
         max_concurrent_queries cap (backpressure)."""
         return self._replica_set.assign(self._method, args, kwargs)
 
-    def __del__(self):  # stop the long-poll thread with the handle
+    def __del__(self):  # stop the helper threads with the handle
         try:
             self._long_poll.stop()
+            self._closed.set()
         except Exception:  # noqa: BLE001 — interpreter shutdown
             pass
 

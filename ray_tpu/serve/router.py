@@ -92,28 +92,40 @@ class ReplicaSet:
                 # busy-spin the lock while waiting for the long-poll.
                 time.sleep(0.01)
 
-    def _try_pick(self) -> Optional[dict]:
-        """Round-robin over replicas with spare capacity. Caller holds
-        the lock. Completed refs are pruned LAZILY, only for replicas
-        whose book looks full — the unsaturated fast path costs zero
-        IO-loop round trips per assignment."""
+    def _prune_locked(self, rid: str) -> List[ObjectRef]:
+        """Drop completed refs from one replica's book (holds lock)."""
         import ray_tpu
 
+        refs = self._inflight.get(rid, [])
+        if refs:
+            _, refs = ray_tpu.wait(refs, num_returns=len(refs),
+                                   timeout=0)
+            self._inflight[rid] = refs
+        return refs
+
+    def _try_pick(self) -> Optional[dict]:
+        """Round-robin over replicas with spare capacity. Caller holds
+        the lock. Only the CANDIDATE replica's book is pruned per probe
+        (one IO-loop round trip per assignment, not one per replica —
+        the round 1 version pruned every book on every pick)."""
         n = len(self._replicas)
         if not n:
             return None
-        prune_at = min(self._max_queries, 32)
         for i in range(n):
             replica = self._replicas[(self._rr + i) % n]
-            refs = self._inflight.get(replica["id"], [])
-            if len(refs) >= prune_at:
-                _, refs = ray_tpu.wait(
-                    refs, num_returns=len(refs), timeout=0)
-                self._inflight[replica["id"]] = refs
+            refs = self._prune_locked(replica["id"])
             if len(refs) < self._max_queries:
                 self._rr = (self._rr + i + 1) % n
                 return replica
         return None
+
+    def prune(self) -> None:
+        """Release completed refs from every book. Called by the
+        handle's janitor so quiesced traffic doesn't pin results in the
+        object store via our bookkeeping copies."""
+        with self._lock:
+            for rid in list(self._inflight):
+                self._prune_locked(rid)
 
     def num_queued(self) -> int:
         with self._lock:

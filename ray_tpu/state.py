@@ -1,0 +1,102 @@
+"""Cluster introspection: the ``ray status`` / ``ray memory`` surface.
+
+Parity target: reference python/ray/state.py + the status/memory CLI
+paths (reference: python/ray/scripts/scripts.py:1521 `ray status`,
+:1497 `ray memory` dumping the ref table via GCS).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import ray_tpu
+from ray_tpu import worker as worker_mod
+
+
+def _core():
+    return worker_mod._require_connected().core
+
+
+def node_stats() -> List[dict]:
+    """Per-node resource + store/scheduler stats (raw)."""
+    core = _core()
+    reply = core.gcs_call_sync("GetNodeStatsSummary", {})
+    return reply.get("nodes", [])
+
+
+def metrics_address() -> str:
+    """host:port of the cluster's Prometheus text endpoint."""
+    addr = ray_tpu.experimental_internal_kv_get(
+        b"__rtpu_metrics_address__")
+    return addr.decode() if addr else ""
+
+
+def status() -> str:
+    """Human-readable cluster summary (the ``ray status`` analog)."""
+    nodes = node_stats()
+    alive = [n for n in nodes if n["alive"]]
+    dead = [n for n in nodes if not n["alive"]]
+
+    total: Dict[str, float] = {}
+    avail: Dict[str, float] = {}
+    for n in alive:
+        for k, v in n["resources_total"].items():
+            total[k] = total.get(k, 0.0) + v
+        for k, v in n["resources_available"].items():
+            avail[k] = avail.get(k, 0.0) + v
+
+    lines = ["======== Cluster status ========",
+             f"Nodes: {len(alive)} alive" +
+             (f", {len(dead)} dead" if dead else "")]
+    lines.append("Resources:")
+    for k in sorted(total):
+        used = total[k] - avail.get(k, 0.0)
+        lines.append(f"  {used:g}/{total[k]:g} {k} in use")
+    pending = sum(n["stats"].get("num_pending_leases", 0) for n in alive)
+    granted = sum(n["stats"].get("num_leases_granted", 0) for n in alive)
+    spill = sum(n["stats"].get("num_spillbacks", 0) for n in alive)
+    workers = sum(n["stats"].get("num_workers", 0) for n in alive)
+    lines.append(f"Scheduler: {pending} pending leases, "
+                 f"{granted} granted, {spill} spillbacks")
+    lines.append(f"Workers: {workers}")
+    store_bytes = sum(n["stats"].get("store_used_bytes", 0) for n in alive)
+    store_objs = sum(n["stats"].get("store_num_objects", 0) for n in alive)
+    lines.append(f"Object store: {store_objs} objects, "
+                 f"{store_bytes / (1024 ** 2):.1f} MiB used")
+    return "\n".join(lines)
+
+
+def memory_summary() -> str:
+    """Ref-table + store dump (the ``ray memory`` analog).
+
+    Covers this driver's ownership table (local refs, submitted-task
+    refs, borrows, pinned bytes) and every node's store occupancy."""
+    core = _core()
+    rc = core.reference_counter
+    lines = ["======== Object references (this driver) ========",
+             f"{'OBJECT ID':<44} {'LOCAL':>5} {'SUBMITTED':>9} "
+             f"{'BORROWERS':>9}  PLASMA"]
+    n_shown = 0
+    for oid, ref in list(rc._refs.items())[:200]:
+        lines.append(
+            f"{oid.hex():<44} {ref.local_refs:>5} "
+            f"{ref.submitted_refs:>9} "
+            f"{len(ref.borrowers or ()):>9}  "
+            f"{'yes' if ref.in_plasma else 'no'}")
+        n_shown += 1
+    total = rc.num_tracked()
+    if total > n_shown:
+        lines.append(f"... and {total - n_shown} more")
+    lines.append(f"Total tracked references: {total}")
+    lines.append("")
+    lines.append("======== Object store (per node) ========")
+    for n in node_stats():
+        s = n.get("stats", {})
+        nid = n["node_id"].hex()[:12] if isinstance(n["node_id"], bytes) \
+            else str(n["node_id"])[:12]
+        lines.append(
+            f"node {nid}: {s.get('store_num_objects', 0)} objects, "
+            f"{s.get('store_used_bytes', 0) / (1024 ** 2):.1f} MiB, "
+            f"{s.get('store_num_spills', 0)} spilled, "
+            f"{s.get('store_num_evictions', 0)} evicted")
+    return "\n".join(lines)

@@ -1,0 +1,15 @@
+"""User-defined application metrics.
+
+Parity target: ``ray.util.metrics`` Counter/Gauge/Histogram
+(reference: python/ray/util/metrics.py:18). Metrics recorded anywhere
+(driver, workers, actors) flow to the GCS and appear on the cluster's
+Prometheus endpoint (``ray_tpu.state.metrics_address()``).
+"""
+
+from ray_tpu._private.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+)
+
+__all__ = ["Counter", "Gauge", "Histogram"]

@@ -94,7 +94,8 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[int] = None,
         core = CoreWorker(mode="driver", config=config,
                           gcs_address=gcs_address,
                           raylet_address=raylet_address,
-                          session_dir=session_dir)
+                          session_dir=session_dir,
+                          log_to_driver=log_to_driver)
         core.connect()
         actor_mod.register_with_core_worker(core)
         global_worker.core = core

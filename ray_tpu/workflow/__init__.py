@@ -66,7 +66,12 @@ class Workflow:
 
     def run(self, workflow_id: Optional[str] = None) -> Any:
         """Execute to completion (blocking) with checkpointing."""
-        return ray_tpu.get(self.run_async(workflow_id))
+        workflow_id = workflow_id or uuid.uuid4().hex[:12]
+        try:
+            return ray_tpu.get(self.run_async(workflow_id))
+        except Exception:
+            _get_storage().set_status(workflow_id, "FAILED")
+            raise
 
     def run_async(self, workflow_id: Optional[str] = None):
         """Start execution; returns an ObjectRef of the final output."""
@@ -218,7 +223,11 @@ def _finalize(base_dir: str, workflow_id: str, root_step_id: str,
 
 def resume(workflow_id: str) -> Any:
     """Re-execute a workflow from its last checkpoints (blocking)."""
-    return ray_tpu.get(resume_async(workflow_id))
+    try:
+        return ray_tpu.get(resume_async(workflow_id))
+    except Exception:
+        _get_storage().set_status(workflow_id, "FAILED")
+        raise
 
 
 def resume_async(workflow_id: str):
@@ -235,9 +244,10 @@ def get_output(workflow_id: str) -> Any:
     store = _get_storage()
     status = store.get_status(workflow_id)
     if status != "SUCCESSFUL":
+        hint = ("it failed — fix the step and resume()"
+                if status == "FAILED" else "resume() it first")
         raise ValueError(
-            f"workflow {workflow_id!r} is {status or 'unknown'}; "
-            "resume() it first")
+            f"workflow {workflow_id!r} is {status or 'unknown'}; {hint}")
     return store.load_step_output(workflow_id, "__output__")
 
 

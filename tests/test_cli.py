@@ -1,0 +1,40 @@
+"""CLI: start/status/memory/stop against a real detached head node.
+
+Mirrors the reference's CLI smoke coverage
+(reference: python/ray/tests/test_cli.py).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(tmpbase, *argv, timeout=90):
+    env = {**os.environ, "PYTHONPATH": REPO, "RAY_TPU_TMPDIR": tmpbase}
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu", *argv],
+        capture_output=True, text=True, timeout=timeout, env=env)
+
+
+def test_cli_lifecycle(tmp_path):
+    base = str(tmp_path)
+    try:
+        r = _run(base, "start", "--head", "--num-cpus", "2")
+        assert r.returncode == 0, r.stderr
+        assert "GCS address" in r.stdout
+
+        r = _run(base, "status")
+        assert r.returncode == 0, r.stderr
+        assert "Cluster status" in r.stdout
+        assert "Prometheus metrics" in r.stdout
+
+        r = _run(base, "memory")
+        assert r.returncode == 0, r.stderr
+        assert "Object references" in r.stdout
+    finally:
+        r = _run(base, "stop")
+    assert "stopped" in r.stdout

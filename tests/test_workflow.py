@@ -83,8 +83,8 @@ def test_step_failure_marks_not_successful(wf_cluster):
 
     with pytest.raises(Exception, match="nope"):
         boom.step().run(workflow_id="bad")
-    assert workflow.get_status("bad") == "RUNNING"  # never completed
-    with pytest.raises(ValueError, match="resume"):
+    assert workflow.get_status("bad") == "FAILED"
+    with pytest.raises(ValueError, match="failed"):
         workflow.get_output("bad")
 
 
