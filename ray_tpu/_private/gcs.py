@@ -148,7 +148,12 @@ class GcsServer:
         addr = await self._server.listen(address)
         self._monitor_task = asyncio.get_running_loop().create_task(
             self._liveness_monitor())
-        await self._start_metrics_http(addr)
+        try:
+            await self._start_metrics_http(addr)
+        except OSError as e:
+            # A port conflict degrades observability; it must not take
+            # down the control plane.
+            logger.warning("metrics endpoint failed to bind: %s", e)
         # Actors caught mid-scheduling by a crash (journaled PENDING /
         # RESTARTING) need their scheduling loop restarted — raylets
         # re-register within the loop's retry window.
@@ -287,6 +292,7 @@ class GcsServer:
     async def handle_get_node_stats_summary(self, conn, header, bufs):
         return {"nodes": [{
             "node_id": n.node_id, "address": n.address, "alive": n.alive,
+            "node_name": n.node_name,
             "resources_total": n.resources_total,
             "resources_available": n.resources_available,
             "stats": n.stats,

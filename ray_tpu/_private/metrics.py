@@ -149,11 +149,16 @@ def global_registry() -> MetricRegistry:
 
 # ------------------------------------------------------------- rendering
 
+def _escape_label(value) -> str:
+    # Prometheus exposition escaping: backslash, double-quote, newline.
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_labels(pairs) -> str:
     if not pairs:
         return ""
-    inner = ",".join(f'{k}="{str(v).replace(chr(34), chr(39))}"'
-                     for k, v in pairs)
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in pairs)
     return "{" + inner + "}"
 
 

@@ -228,17 +228,16 @@ class Raylet:
                 # only publish complete lines; keep the tail buffered —
                 # and only advance the offset over lines actually
                 # published (a chatty worker's extra lines are picked up
-                # by the next poll, never dropped)
+                # by the next poll, never dropped). Split on raw \n so
+                # the byte offset always matches the line count.
                 cut = chunk.rfind(b"\n")
                 if cut < 0:
                     continue
-                lines = chunk[:cut].decode("utf-8", "replace").splitlines()
-                if len(lines) > 1000:
-                    lines = lines[:1000]
-                    cut = 0
-                    for _ in range(1000):
-                        cut = chunk.index(b"\n", cut) + 1
-                    cut -= 1
+                raw = chunk[:cut].split(b"\n")
+                if len(raw) > 1000:
+                    raw = raw[:1000]
+                    cut = sum(len(r) for r in raw) + len(raw) - 1
+                lines = [r.decode("utf-8", "replace") for r in raw]
                 offsets[name] = pos + cut + 1
                 wid_hex = name[len("worker-"):-len(".log")]
                 pid = next((p for w, p in pid_by_wid_hex.items()

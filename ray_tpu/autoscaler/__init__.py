@@ -1,0 +1,18 @@
+"""Autoscaler: demand-driven cluster elasticity.
+
+Parity target: reference python/ray/autoscaler/ (StandardAutoscaler
+autoscaler.py:67, Monitor monitor.py:87, NodeProvider plugins, tested
+through a mock provider in python/ray/tests/test_autoscaler.py).
+"""
+
+from ray_tpu.autoscaler.autoscaler import (  # noqa: F401
+    AutoscalerConfig,
+    LoadMetrics,
+    StandardAutoscaler,
+)
+from ray_tpu.autoscaler.monitor import Monitor  # noqa: F401
+from ray_tpu.autoscaler.node_provider import (  # noqa: F401
+    FakeNodeProvider,
+    LocalSubprocessProvider,
+    NodeProvider,
+)

@@ -1,0 +1,56 @@
+"""Monitor: the daemon loop driving the autoscaler from GCS state.
+
+Parity target: the reference's Monitor daemon
+(reference: python/ray/autoscaler/_private/monitor.py:87 — polls load
+from the GCS, calls StandardAutoscaler.update()). Runs as a thread in
+whatever process wants scaling (the driver, or a head-node sidecar);
+it speaks plain GCS RPC, so it works against any cluster.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from ray_tpu.autoscaler.autoscaler import (
+    AutoscalerConfig, LoadMetrics, StandardAutoscaler,
+)
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+logger = logging.getLogger(__name__)
+
+
+class Monitor:
+    def __init__(self, provider: NodeProvider,
+                 config: Optional[AutoscalerConfig] = None,
+                 poll_interval_s: float = 1.0):
+        self.autoscaler = StandardAutoscaler(
+            provider, config or AutoscalerConfig())
+        self.poll_interval_s = poll_interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Monitor":
+        self._thread = threading.Thread(
+            target=self._run, name="rtpu-autoscaler", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        from ray_tpu import worker as worker_mod
+
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                core = worker_mod._require_connected().core
+                reply = core.gcs_call_sync("GetNodeStatsSummary", {})
+                metrics = LoadMetrics.from_node_stats(
+                    reply.get("nodes", []))
+                self.autoscaler.update(metrics)
+            except Exception:  # noqa: BLE001 — keep the daemon alive
+                logger.debug("autoscaler tick failed", exc_info=True)

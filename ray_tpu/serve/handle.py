@@ -34,20 +34,15 @@ class DeploymentHandle:
             {SNAPSHOT_KEY.format(name=deployment_name):
              self._replica_set.update_membership})
         # Janitor: drop completed bookkeeping refs after traffic
-        # quiesces so results aren't pinned in the object store.
+        # quiesces so results aren't pinned in the object store. The
+        # thread must NOT hold a reference to this handle (that would
+        # keep __del__ from ever firing) — it closes over the replica
+        # set and the stop event only.
         self._closed = threading.Event()
         self._janitor = threading.Thread(
-            target=self._janitor_loop, name="serve-handle-janitor",
-            daemon=True)
+            target=_janitor_loop, args=(self._replica_set, self._closed),
+            name="serve-handle-janitor", daemon=True)
         self._janitor.start()
-
-    def _janitor_loop(self):
-        while not self._closed.wait(1.0):
-            try:
-                if self._replica_set.num_queued():
-                    self._replica_set.prune()
-            except Exception:  # noqa: BLE001 — shutdown races
-                pass
 
     def remote(self, *args, **kwargs) -> ObjectRef:
         """Route one query; blocks only when every replica is at its
@@ -74,6 +69,16 @@ class DeploymentHandle:
     def __repr__(self) -> str:
         return (f"DeploymentHandle(deployment="
                 f"{self.deployment_name!r}, method={self._method!r})")
+
+
+def _janitor_loop(replica_set: ReplicaSet,
+                  closed: threading.Event) -> None:
+    while not closed.wait(1.0):
+        try:
+            if replica_set.num_queued():
+                replica_set.prune()
+        except Exception:  # noqa: BLE001 — shutdown races
+            pass
 
 
 class _MethodCaller:

@@ -105,15 +105,18 @@ class ReplicaSet:
 
     def _try_pick(self) -> Optional[dict]:
         """Round-robin over replicas with spare capacity. Caller holds
-        the lock. Only the CANDIDATE replica's book is pruned per probe
-        (one IO-loop round trip per assignment, not one per replica —
-        the round 1 version pruned every book on every pick)."""
+        the lock. Books are pruned only when they look full — the
+        unsaturated fast path costs zero IO-loop round trips; the
+        handle's 1s janitor covers quiesced-traffic ref release."""
         n = len(self._replicas)
         if not n:
             return None
+        prune_at = min(self._max_queries, 32)
         for i in range(n):
             replica = self._replicas[(self._rr + i) % n]
-            refs = self._prune_locked(replica["id"])
+            refs = self._inflight.get(replica["id"], [])
+            if len(refs) >= prune_at:
+                refs = self._prune_locked(replica["id"])
             if len(refs) < self._max_queries:
                 self._rr = (self._rr + i + 1) % n
                 return replica

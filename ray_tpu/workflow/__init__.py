@@ -223,8 +223,11 @@ def _finalize(base_dir: str, workflow_id: str, root_step_id: str,
 
 def resume(workflow_id: str) -> Any:
     """Re-execute a workflow from its last checkpoints (blocking)."""
+    # Lookup errors (unknown id) must raise cleanly, NOT stamp a
+    # phantom FAILED record — only an actual re-execution may fail.
+    ref = resume_async(workflow_id)
     try:
-        return ray_tpu.get(resume_async(workflow_id))
+        return ray_tpu.get(ref)
     except Exception:
         _get_storage().set_status(workflow_id, "FAILED")
         raise

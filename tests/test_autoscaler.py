@@ -1,0 +1,119 @@
+"""Autoscaler: policy unit tests via FakeNodeProvider + real elasticity.
+
+Mirrors the reference's test strategy: drive StandardAutoscaler with a
+mock provider and synthetic load (reference:
+python/ray/tests/test_autoscaler.py MockProvider), plus one end-to-end
+run with real worker-node subprocesses.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    AutoscalerConfig,
+    FakeNodeProvider,
+    LoadMetrics,
+    LocalSubprocessProvider,
+    Monitor,
+    StandardAutoscaler,
+)
+
+
+def _metrics(pending=0, total=0.0, used=0.0, idle=()):
+    return LoadMetrics(pending_leases=pending, cpus_total=total,
+                       cpus_used=used,
+                       idle_by_name={n: True for n in idle})
+
+
+def test_scale_up_on_pending_demand():
+    p = FakeNodeProvider()
+    a = StandardAutoscaler(p, AutoscalerConfig(max_workers=4,
+                                               cpus_per_worker=2))
+    a.update(_metrics(pending=0))
+    assert p.created == []
+    # ramp: each tick adds at most upscaling_speed x fleet (min 1)
+    a.update(_metrics(pending=3, total=2, used=2))
+    assert len(p.created) == 1
+    a.update(_metrics(pending=3, total=4, used=4))
+    assert len(p.created) == 2
+    for _ in range(5):
+        a.update(_metrics(pending=50, total=6, used=6))
+    assert len(p.nodes) <= 4  # max_workers respected
+
+
+def test_scale_up_respects_upscaling_speed():
+    p = FakeNodeProvider()
+    a = StandardAutoscaler(
+        p, AutoscalerConfig(max_workers=10, cpus_per_worker=1,
+                            upscaling_speed=1.0))
+    a.update(_metrics(pending=100, total=1, used=1))
+    assert len(p.created) == 1  # 1x of size-0 fleet → 1
+    a.update(_metrics(pending=100, total=2, used=2))
+    assert len(p.created) == 2  # 1x of 1 node → +1
+
+
+def test_min_workers_floor():
+    p = FakeNodeProvider()
+    a = StandardAutoscaler(p, AutoscalerConfig(min_workers=2,
+                                               max_workers=4))
+    a.update(_metrics())
+    assert len(p.nodes) == 2
+
+
+def test_scale_down_after_idle_timeout():
+    p = FakeNodeProvider()
+    a = StandardAutoscaler(
+        p, AutoscalerConfig(min_workers=1, max_workers=4,
+                            idle_timeout_s=5.0))
+    n1 = p.create_node(1)
+    n2 = p.create_node(1)
+    t0 = 1000.0
+    a.update(_metrics(idle=[n1, n2]), now=t0)       # idle noticed
+    assert p.terminated == []
+    a.update(_metrics(idle=[n1, n2]), now=t0 + 6)   # past timeout
+    assert len(p.terminated) == 1                    # min_workers=1 floor
+    # busy again: idle clock resets
+    survivor = p.non_terminated_nodes()[0]
+    a.update(_metrics(), now=t0 + 12)
+    a.update(_metrics(idle=[survivor]), now=t0 + 13)
+    assert len(p.terminated) == 1
+
+
+def test_end_to_end_elasticity():
+    """Real worker nodes: demand spawns a node, tasks drain on it."""
+    ray_tpu.init(num_cpus=1)
+    provider = None
+    monitor = None
+    try:
+        info = ray_tpu.nodes()
+        gcs_address = ray_tpu.worker.global_worker.core.gcs_address
+        provider = LocalSubprocessProvider(gcs_address, cpus_per_node=2)
+        monitor = Monitor(provider, AutoscalerConfig(
+            max_workers=2, cpus_per_worker=2, idle_timeout_s=60),
+            poll_interval_s=0.3).start()
+
+        @ray_tpu.remote
+        def busy(i):
+            import time as t
+            t.sleep(0.4)
+            return i
+
+        # 8 half-second tasks on a 1-CPU head: pending leases pile up,
+        # the monitor should add worker nodes and the queue must drain.
+        refs = [busy.remote(i) for i in range(8)]
+        assert sorted(ray_tpu.get(refs, timeout=90)) == list(range(8))
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and \
+                not provider.non_terminated_nodes():
+            time.sleep(0.2)
+        assert provider.non_terminated_nodes(), \
+            "autoscaler never launched a worker node"
+        assert len(ray_tpu.nodes()) >= 2
+    finally:
+        if monitor:
+            monitor.stop()
+        if provider:
+            provider.shutdown()
+        ray_tpu.shutdown()
