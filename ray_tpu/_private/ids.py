@@ -46,7 +46,9 @@ class BaseID:
             raise ValueError(
                 f"{type(self).__name__} requires {self.SIZE} bytes, got {len(id_bytes)}"
             )
-        self._bytes = bytes(id_bytes)
+        # skip the defensive copy when already immutable (hot path)
+        self._bytes = id_bytes if type(id_bytes) is bytes \
+            else bytes(id_bytes)
         self._hash = None
 
     @classmethod
@@ -132,6 +134,25 @@ class TaskID(BaseID):
         if not 0 <= index <= _MAX_INDEX:
             raise ValueError(f"object index out of range: {index}")
         return ObjectID(self._bytes + index.to_bytes(4, "little"))
+
+
+# ---- bytes-level helpers for the submit hot path (single source of
+# truth for the wire layout; core_worker avoids ID-object churn) ----
+
+# Return-object index suffixes (1-based little-endian), precomputed.
+OID_SUFFIX = tuple((i + 1).to_bytes(4, "little") for i in range(64))
+
+
+def make_task_id_bytes(lineage_prefix16: bytes) -> bytes:
+    """task_id = 16-byte actor/lineage prefix + 8 random bytes."""
+    return lineage_prefix16 + _random_bytes(TASK_ID_SIZE - ACTOR_ID_SIZE)
+
+
+def return_object_id_bytes(task_id: bytes, index1: int) -> bytes:
+    """ObjectID bytes for 1-based return ``index1`` of ``task_id``."""
+    if index1 <= len(OID_SUFFIX):
+        return task_id + OID_SUFFIX[index1 - 1]
+    return task_id + index1.to_bytes(4, "little")
 
 
 class ObjectID(BaseID):

@@ -75,6 +75,7 @@ def main(argv=None):
         return core
 
     core = loop.run_until_complete(boot())
+    worker_mod._tune_gc()  # same GC policy as drivers (hot exec path)
     try:
         loop.run_forever()
     finally:

@@ -102,9 +102,12 @@ class StandardAutoscaler:
             return
 
         # ---- scale down ----
+        live = set(nodes)
+        for stale in [n for n in self._idle_since if n not in live]:
+            del self._idle_since[stale]  # crashed/externally removed
+        remaining = len(nodes)
         for nid in nodes:
-            if len(self.provider.non_terminated_nodes()) \
-                    <= cfg.min_workers:
+            if remaining <= cfg.min_workers:
                 break
             if metrics.idle_by_name.get(nid, False):
                 since = self._idle_since.setdefault(nid, now)
@@ -113,6 +116,7 @@ class StandardAutoscaler:
                                 nid)
                     self.provider.terminate_node(nid)
                     self._idle_since.pop(nid, None)
+                    remaining -= 1
             else:
                 self._idle_since.pop(nid, None)
 

@@ -45,6 +45,7 @@ class Monitor:
     def _run(self) -> None:
         from ray_tpu import worker as worker_mod
 
+        consecutive_failures = 0
         while not self._stop.wait(self.poll_interval_s):
             try:
                 core = worker_mod._require_connected().core
@@ -52,5 +53,13 @@ class Monitor:
                 metrics = LoadMetrics.from_node_stats(
                     reply.get("nodes", []))
                 self.autoscaler.update(metrics)
+                consecutive_failures = 0
             except Exception:  # noqa: BLE001 — keep the daemon alive
-                logger.debug("autoscaler tick failed", exc_info=True)
+                consecutive_failures += 1
+                # a persistently failing autoscaler must be VISIBLE,
+                # but not once per tick
+                if consecutive_failures in (1, 10) or \
+                        consecutive_failures % 100 == 0:
+                    logger.warning(
+                        "autoscaler tick failed (%d consecutive)",
+                        consecutive_failures, exc_info=True)
