@@ -1348,6 +1348,31 @@ class CoreWorker:
         if requeue:
             q.buffer.extendleft(reversed(requeue))
 
+    def cancel(self, ref: ObjectRef, force: bool = False):
+        """Best-effort task cancel (reference: CoreWorker::CancelTask):
+        drop it from the local queue if not yet pushed, else ask every
+        leased worker of the scheduling class to cancel."""
+        self._run(self._cancel_async(ref))
+
+    async def _cancel_async(self, ref: ObjectRef):
+        entry = self.pending_tasks.get(ref.object_id.task_id().binary())
+        if entry is None:
+            return
+        state = self.scheduling_keys.get(entry.spec.scheduling_class)
+        if state is None:
+            return
+        if entry.spec in state.queue:
+            state.queue.remove(entry.spec)
+            self._store_error_for_task(
+                entry.spec, exc.TaskCancelledError(entry.spec.name))
+            return
+        for lw in state.workers:
+            try:
+                await lw.conn.call("CancelTask",
+                                   {"task_id": entry.spec.task_id})
+            except ConnectionError:
+                pass
+
     def kill_actor(self, actor_id: bytes, no_restart: bool = True):
         self._run(self._gcs_call("KillActor", {
             "actor_id": actor_id, "no_restart": no_restart}))

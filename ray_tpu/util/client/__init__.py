@@ -1,0 +1,11 @@
+"""Thin-client mode (``ray://``): use a remote cluster without being in it.
+
+Parity target: reference python/ray/util/client/ (design doc
+ARCHITECTURE.md, protocol ray_client.proto). ``ray_tpu.init(
+address="ray://host:port")`` routes the whole public API through a
+ClientCore speaking to a ClientServer proxy that runs as a driver
+inside the cluster.
+"""
+
+from ray_tpu.util.client.client import ClientCore  # noqa: F401
+from ray_tpu.util.client.server import ClientServer  # noqa: F401

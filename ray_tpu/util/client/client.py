@@ -1,0 +1,250 @@
+"""ClientCore: the thin-client adapter behind ``ray://`` connections.
+
+Parity target: the reference's client worker
+(reference: python/ray/util/client/worker.py — the API-compatible stub
+layer every `ray.*` call routes through in client mode). Re-design:
+instead of a parallel stub API, ClientCore implements the same method
+surface the real CoreWorker exposes to the public layers
+(submit_task / create_actor / submit_actor_task / get / put / wait /
+kill_actor / function_manager / reference_counter / the _gcs_call
+shim), so `worker.py`, `remote_function.py`, and `actor.py` run
+UNCHANGED against a remote cluster.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+from ray_tpu._private import rpc
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu.util.client.common import dumps_args
+
+try:
+    import cloudpickle
+except ImportError:  # pragma: no cover
+    import pickle as cloudpickle
+
+
+class _GcsCallSentinel(tuple):
+    """What ClientCore._gcs_call returns; consumed by ClientCore._run."""
+
+
+class ClientFunctionManager:
+    def __init__(self, client: "ClientCore"):
+        self._client = client
+        self._exported = set()
+
+    def prepare(self, fn):
+        pickled = cloudpickle.dumps(fn)
+        return hashlib.sha1(pickled).hexdigest(), pickled
+
+    def export_prepickled(self, key: str, pickled: bytes,
+                          fn: Any = None) -> None:
+        if key in self._exported:
+            return
+        self._client._call("CFnPut", {"key": key}, bufs=[pickled])
+        self._exported.add(key)
+
+
+class ClientRefCounter:
+    """Local counts only; zero → batched release push to the server."""
+
+    def __init__(self, client: "ClientCore"):
+        self._client = client
+        self._lock = threading.Lock()
+        self._counts: Dict[ObjectID, int] = {}
+
+    def add_local_reference(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._counts[object_id] = self._counts.get(object_id, 0) + 1
+
+    def remove_local_reference(self, object_id: ObjectID) -> None:
+        release = False
+        with self._lock:
+            n = self._counts.get(object_id, 0) - 1
+            if n <= 0:
+                self._counts.pop(object_id, None)
+                release = True
+            else:
+                self._counts[object_id] = n
+        if release:
+            self._client._release(object_id.binary())
+
+
+class ClientCore:
+    """Connects to a ClientServer; plugs in as ``global_worker.core``."""
+
+    mode = "client"
+    task_executor = None  # RuntimeContext.current_actor_id probes this
+
+    def __init__(self, server_address: str):
+        self._loop_thread = rpc.EventLoopThread("rtpu-client-io")
+        self.loop = self._loop_thread.loop
+        self._conn = self._loop_thread.run(
+            rpc.connect(server_address, peer_name="client-server"))
+        self.function_manager = ClientFunctionManager(self)
+        self.reference_counter = ClientRefCounter(self)
+        self.address = f"ray-client:{server_address}"
+        self.gcs_address = server_address
+        # Valid-width ids so get_runtime_context() works in client mode
+        # (the nil job id marks "no in-cluster job").
+        self.job_id = b"\xff" * 4
+        self.worker_id = b"\xff" * 28
+        self.node_id = b"\xff" * 28
+        self._shutdown = False
+
+    # ------------------------------------------------------------- rpc
+
+    def _call(self, method: str, header: dict, bufs=()):
+        return self._loop_thread.run(
+            self._conn.call(method, header, bufs=list(bufs)),
+            timeout=None)
+
+    def _release(self, id_bytes: bytes) -> None:
+        if self._shutdown:
+            return
+        try:
+            self._loop_thread.call_soon(
+                self._conn.push("CRelease", {"ids": [id_bytes]}))
+        except Exception:  # noqa: BLE001 — interpreter shutdown
+            pass
+
+    def _make_refs(self, ids: List[bytes]) -> List[ObjectRef]:
+        refs = []
+        for i in ids:
+            oid = ObjectID(i)
+            self.reference_counter.add_local_reference(oid)
+            refs.append(ObjectRef(oid, owner_address="", worker=self,
+                                  skip_adding_local_ref=True))
+        return refs
+
+    # -------------------------------------------------------- task api
+
+    def submit_task(self, fn_key: str, name: str, args: List[Any],
+                    num_returns: int = 1,
+                    resources: Optional[Dict[str, float]] = None,
+                    max_retries: Optional[int] = None,
+                    retry_exceptions: bool = False,
+                    placement_group_id: bytes = b"",
+                    placement_group_bundle_index: int = -1,
+                    scheduling_strategy: str = "DEFAULT",
+                    runtime_env: Optional[Dict] = None) -> List[ObjectRef]:
+        # fail fast on options the thin client doesn't carry yet,
+        # instead of silently running with different semantics
+        if placement_group_id or runtime_env or \
+                scheduling_strategy != "DEFAULT":
+            raise ValueError(
+                "placement groups, runtime_env, and non-default "
+                "scheduling strategies are not supported over ray:// "
+                "client connections")
+        reply, _ = self._call("CSubmitTask", {
+            "fn_key": fn_key, "name": name, "num_returns": num_returns,
+            "resources": resources, "max_retries": max_retries,
+            "retry_exceptions": retry_exceptions,
+        }, bufs=[dumps_args(list(args))])
+        return self._make_refs(reply["ids"])
+
+    def create_actor(self, fn_key: str, name: str, args: List[Any],
+                     **opts) -> bytes:
+        if opts.pop("placement_group_id", b""):
+            raise ValueError("placement groups are not supported over "
+                             "ray:// client connections")
+        opts.pop("placement_group_bundle_index", None)
+        reply, _ = self._call("CCreateActor", {
+            "fn_key": fn_key, "name": name, "opts": opts,
+        }, bufs=[dumps_args(list(args))])
+        return reply["actor_id"]
+
+    def submit_actor_task(self, actor_id: bytes, fn_key: str, name: str,
+                          args: List[Any], num_returns: int = 1,
+                          max_task_retries: int = 0) -> List[ObjectRef]:
+        reply, _ = self._call("CActorCall", {
+            "actor_id": actor_id, "fn_key": fn_key, "name": name,
+            "num_returns": num_returns,
+            "max_task_retries": max_task_retries,
+        }, bufs=[dumps_args(list(args))])
+        return self._make_refs(reply["ids"])
+
+    # ------------------------------------------------------ object api
+
+    def put(self, value: Any, _owner_ref=None) -> ObjectRef:
+        reply, _ = self._call("CPut", {}, bufs=[dumps_args(value)])
+        return self._make_refs([reply["id"]])[0]
+
+    def _resolve_incoming_ref(self, id_bytes: bytes) -> ObjectRef:
+        """Values may contain ObjectRefs (persistent ids) — rebuild
+        them as client refs (server booked them during serialization)."""
+        return self._make_refs([id_bytes])[0]
+
+    def get(self, refs: Sequence[ObjectRef],
+            timeout: Optional[float] = None):
+        from ray_tpu.util.client.common import loads_args
+
+        reply, bufs = self._call("CGet", {
+            "ids": [r.object_id.binary() for r in refs],
+            "timeout": timeout})
+        if not reply["ok"]:
+            raise cloudpickle.loads(bufs[0])
+        return [loads_args(b, self._resolve_incoming_ref) for b in bufs]
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None, fetch_local: bool = True):
+        by_id = {r.object_id.binary(): r for r in refs}
+        reply, _ = self._call("CWait", {
+            "ids": [r.object_id.binary() for r in refs],
+            "num_returns": num_returns, "timeout": timeout})
+        return ([by_id[i] for i in reply["ready"]],
+                [by_id[i] for i in reply["not_ready"]])
+
+    # ------------------------------------------------------- actor api
+
+    def kill_actor(self, actor_id: bytes, no_restart: bool = True):
+        self._call("CKill", {"actor_id": actor_id,
+                             "no_restart": no_restart})
+
+    def cancel(self, ref: ObjectRef, force: bool = False):
+        self._call("CCancel", {"id": ref.object_id.binary(),
+                               "force": force})
+
+    # ---------------------------------------------- GCS passthrough shim
+
+    def _gcs_call(self, method: str, header=None, bufs=(), timeout=None):
+        """NOT a coroutine (unlike CoreWorker's): returns a sentinel the
+        paired _run executes — so worker.py's
+        ``core._run(core._gcs_call(...))`` idiom works unchanged."""
+        return _GcsCallSentinel((method, header, list(bufs)))
+
+    def _run(self, sentinel, timeout=None):
+        if not isinstance(sentinel, _GcsCallSentinel):
+            raise TypeError(
+                "ClientCore._run only executes _gcs_call sentinels")
+        method, header, bufs = sentinel
+        reply, rbufs = self._call("CGcs", {"method": method,
+                                           "header": header}, bufs=bufs)
+        return reply, rbufs
+
+    def gcs_call_sync(self, method: str, header: dict) -> dict:
+        reply, _ = self._run(self._gcs_call(method, header))
+        return reply
+
+    def _kv_put_sync(self, key: bytes, value: bytes):
+        self._run(self._gcs_call("KVPut", {"key": key}, bufs=[value]))
+
+    def _kv_get_sync(self, key: bytes):
+        header, bufs = self._run(self._gcs_call("KVGet", {"key": key}))
+        return bufs[0] if header.get("found") else None
+
+    # ------------------------------------------------------- lifecycle
+
+    def shutdown(self):
+        if self._shutdown:
+            return
+        self._shutdown = True
+        try:
+            self._loop_thread.run(self._conn.close(), timeout=3)
+        except Exception:  # noqa: BLE001
+            pass
+        self._loop_thread.stop()

@@ -1,0 +1,65 @@
+"""Shared pickling helpers for the thin client.
+
+Parity target: the reference's client_pickler
+(reference: python/ray/util/client/client_pickler.py) — ObjectRefs
+cross the wire as persistent ids, resolved against the server-side
+per-connection ref table, so refs nested anywhere inside argument
+structures round-trip correctly.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Callable, Dict
+
+try:
+    import cloudpickle
+except ImportError:  # pragma: no cover
+    cloudpickle = pickle
+
+from ray_tpu._private.object_ref import ObjectRef
+
+
+class ClientArgPickler(cloudpickle.Pickler):
+    """ObjectRefs become persistent ids (both directions). ``on_ref``
+    lets the server book refs it serializes into a reply, so the
+    client can use them later."""
+
+    def __init__(self, file, protocol=None,
+                 on_ref: Callable[[ObjectRef], None] | None = None):
+        super().__init__(file, protocol)
+        self._on_ref = on_ref
+
+    def persistent_id(self, obj):
+        if isinstance(obj, ObjectRef):
+            if self._on_ref is not None:
+                self._on_ref(obj)
+            return ("ref", obj.object_id.binary())
+        return None
+
+
+class ServerArgUnpickler(pickle.Unpickler):
+    """Server side: persistent ids resolve to the connection's refs."""
+
+    def __init__(self, file, resolver: Callable[[bytes], Any]):
+        super().__init__(file)
+        self._resolver = resolver
+
+    def persistent_load(self, pid):
+        kind, id_bytes = pid
+        if kind != "ref":
+            raise pickle.UnpicklingError(f"unknown persistent id {kind}")
+        return self._resolver(id_bytes)
+
+
+def dumps_args(obj: Any,
+               on_ref: Callable[[ObjectRef], None] | None = None) -> bytes:
+    buf = io.BytesIO()
+    ClientArgPickler(buf, protocol=pickle.HIGHEST_PROTOCOL,
+                     on_ref=on_ref).dump(obj)
+    return buf.getvalue()
+
+
+def loads_args(data: bytes, resolver: Callable[[bytes], Any]) -> Any:
+    return ServerArgUnpickler(io.BytesIO(data), resolver).load()

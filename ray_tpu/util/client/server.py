@@ -1,0 +1,212 @@
+"""Client server: the cluster-side proxy for ``ray://`` clients.
+
+Parity target: the reference's client server / proxier
+(reference: python/ray/util/client/server/server.py, proxier.py,
+protocol src/ray/protobuf/ray_client.proto). One process connected to
+the cluster as a driver serves many thin clients; per-connection state
+(object refs, actor handles, exported functions) is dropped — and the
+refs released — when a client disconnects.
+
+Handlers run on the driver's IO loop, so every blocking core-worker
+call hops to the default executor (the sync API must not run on the
+IO loop thread).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict
+
+from ray_tpu._private import rpc
+from ray_tpu.util.client.common import dumps_args, loads_args
+
+logger = logging.getLogger(__name__)
+
+try:
+    import cloudpickle
+except ImportError:  # pragma: no cover
+    import pickle as cloudpickle
+
+
+class _ConnState:
+    def __init__(self):
+        self.refs: Dict[bytes, object] = {}       # id -> ObjectRef
+        self.actors: Dict[bytes, object] = {}     # actor_id -> handle
+
+
+class ClientServer:
+    """Serve thin clients from a process already connected as a driver."""
+
+    def __init__(self):
+        self._states: Dict[object, _ConnState] = {}
+        self._server = rpc.RpcServer({
+            "CFnPut": self.handle_fn_put,
+            "CSubmitTask": self.handle_submit_task,
+            "CCreateActor": self.handle_create_actor,
+            "CActorCall": self.handle_actor_call,
+            "CGet": self.handle_get,
+            "CPut": self.handle_put,
+            "CWait": self.handle_wait,
+            "CKill": self.handle_kill,
+            "CCancel": self.handle_cancel,
+            "CRelease": self.handle_release,
+            "CGcs": self.handle_gcs,
+        }, name="client-server")
+        self._server.on_connect.append(
+            lambda conn: conn.on_disconnect.append(self._on_disconnect))
+        self.address = ""
+
+    def start(self, listen: str = "tcp://127.0.0.1:0") -> str:
+        """Blocking start from the driver thread; serves on the
+        connected core worker's IO loop."""
+        import ray_tpu.worker as worker_mod
+
+        core = worker_mod._require_connected().core
+        self._core = core
+        self.address = core._run(self._server.listen(listen))
+        # advertise for discovery (ray_tpu.init(address="ray://auto"))
+        core._kv_put_sync(b"__rtpu_client_server__",
+                          self.address.encode())
+        logger.info("client server listening at %s", self.address)
+        return self.address
+
+    def stop(self) -> None:
+        self._core._run(self._server.close())
+
+    # ------------------------------------------------------------ state
+
+    def _state(self, conn) -> _ConnState:
+        st = self._states.get(conn)
+        if st is None:
+            st = self._states[conn] = _ConnState()
+        return st
+
+    def _on_disconnect(self, conn) -> None:
+        # Dropping the maps releases every ObjectRef/handle the client
+        # held (their __del__ decrements this driver's refcounts) —
+        # the reference's per-client cleanup.
+        self._states.pop(conn, None)
+
+    def _resolver(self, st: _ConnState):
+        def resolve(id_bytes: bytes):
+            ref = st.refs.get(id_bytes)
+            if ref is None:
+                raise KeyError(
+                    f"client referenced unknown object "
+                    f"{id_bytes.hex()[:16]} (already released?)")
+            return ref
+        return resolve
+
+    def _book(self, st: _ConnState, refs) -> list:
+        ids = []
+        for r in refs:
+            st.refs[r.object_id.binary()] = r
+            ids.append(r.object_id.binary())
+        return ids
+
+    @staticmethod
+    async def _offload(fn):
+        """Run a blocking core call off the IO loop."""
+        return await asyncio.get_running_loop().run_in_executor(None, fn)
+
+    # ---------------------------------------------------------- handlers
+
+    async def handle_fn_put(self, conn, header, bufs):
+        key, pickled = header["key"], bufs[0]
+        await self._offload(
+            lambda: self._core.function_manager.export_prepickled(
+                key, pickled))
+        return {}
+
+    async def handle_submit_task(self, conn, header, bufs):
+        st = self._state(conn)
+        args = loads_args(bufs[0], self._resolver(st))
+        refs = await self._offload(lambda: self._core.submit_task(
+            fn_key=header["fn_key"], name=header["name"], args=args,
+            num_returns=header.get("num_returns", 1),
+            resources=header.get("resources") or None,
+            max_retries=header.get("max_retries"),
+            retry_exceptions=header.get("retry_exceptions", False)))
+        return {"ids": self._book(st, refs)}
+
+    async def handle_create_actor(self, conn, header, bufs):
+        st = self._state(conn)
+        args = loads_args(bufs[0], self._resolver(st))
+        actor_id = await self._offload(lambda: self._core.create_actor(
+            fn_key=header["fn_key"], name=header["name"], args=args,
+            **header.get("opts", {})))
+        # hold a handle so per-call handles on the client stay valid
+        from ray_tpu.actor import ActorHandle
+        st.actors[actor_id] = ActorHandle(
+            self._core, actor_id, header["name"], header["fn_key"])
+        return {"actor_id": actor_id}
+
+    async def handle_actor_call(self, conn, header, bufs):
+        st = self._state(conn)
+        args = loads_args(bufs[0], self._resolver(st))
+        refs = await self._offload(
+            lambda: self._core.submit_actor_task(
+                header["actor_id"], header["fn_key"], header["name"],
+                args, num_returns=header.get("num_returns", 1),
+                max_task_retries=header.get("max_task_retries", 0)))
+        return {"ids": self._book(st, refs)}
+
+    async def handle_put(self, conn, header, bufs):
+        st = self._state(conn)
+        value = loads_args(bufs[0], self._resolver(st))
+        ref = await self._offload(lambda: self._core.put(value))
+        return {"id": self._book(st, [ref])[0]}
+
+    async def handle_get(self, conn, header, bufs):
+        st = self._state(conn)
+        refs = [self._resolver(st)(i) for i in header["ids"]]
+        timeout = header.get("timeout")
+        def book(ref):
+            # a returned value may CONTAIN ObjectRefs (nested remote
+            # calls): book them so the client can use them later
+            st.refs.setdefault(ref.object_id.binary(), ref)
+
+        try:
+            values = await self._offload(
+                lambda: self._core.get(refs, timeout=timeout))
+            return ({"ok": True},
+                    [dumps_args(v, on_ref=book) for v in values])
+        except Exception as e:  # noqa: BLE001 — ship to the client
+            return ({"ok": False}, [cloudpickle.dumps(e)])
+
+    async def handle_wait(self, conn, header, bufs):
+        st = self._state(conn)
+        refs = [self._resolver(st)(i) for i in header["ids"]]
+        num_returns, timeout = header["num_returns"], header.get("timeout")
+        ready, not_ready = await self._offload(
+            lambda: self._core.wait(refs, num_returns=num_returns,
+                                    timeout=timeout))
+        return {"ready": [r.object_id.binary() for r in ready],
+                "not_ready": [r.object_id.binary() for r in not_ready]}
+
+    async def handle_kill(self, conn, header, bufs):
+        actor_id = header["actor_id"]
+        no_restart = header.get("no_restart", True)
+        await self._offload(
+            lambda: self._core.kill_actor(actor_id,
+                                          no_restart=no_restart))
+        return {}
+
+    async def handle_cancel(self, conn, header, bufs):
+        st = self._state(conn)
+        ref = self._resolver(st)(header["id"])
+        force = header.get("force", False)
+        await self._offload(lambda: self._core.cancel(ref, force=force))
+        return {}
+
+    async def handle_release(self, conn, header, bufs):
+        st = self._state(conn)
+        for i in header["ids"]:
+            st.refs.pop(i, None)
+        return {}
+
+    async def handle_gcs(self, conn, header, bufs):
+        reply, rbufs = await self._core._gcs_call(
+            header["method"], header["header"], bufs=list(bufs))
+        return reply, list(rbufs)

@@ -116,6 +116,19 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[int] = None,
             config.object_store_memory = object_store_memory
         set_config(config)
 
+        if address is not None and address.startswith("ray://"):
+            # Thin-client mode (reference: ray.init("ray://...") →
+            # util/client). The whole API routes through a ClientCore
+            # speaking to a cluster-side proxy.
+            from ray_tpu.util.client import ClientCore
+
+            client = ClientCore(address[len("ray://"):])
+            global_worker.core = client
+            global_worker.mode = "client"
+            global_worker.namespace = namespace
+            atexit.register(shutdown)
+            return {"address": address, "mode": "client"}
+
         if address is None:
             node = Node(config=config,
                         num_cpus=num_cpus if num_cpus is not None
@@ -245,30 +258,8 @@ def kill(actor_handle, *, no_restart: bool = True):
 
 
 def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
-    w = _require_connected()
-    w.core._run(_cancel_async(w.core, ref))
-
-
-async def _cancel_async(core, ref: ObjectRef):
-    spec_entry = core.pending_tasks.get(ref.object_id.task_id().binary())
-    if spec_entry is None:
-        return
-    # Best effort: mark cancelled at every leased worker of the class.
-    sc = spec_entry.spec.scheduling_class
-    state = core.scheduling_keys.get(sc)
-    if state is None:
-        return
-    if spec_entry.spec in state.queue:
-        state.queue.remove(spec_entry.spec)
-        core._store_error_for_task(spec_entry.spec,
-                                   exc.TaskCancelledError(spec_entry.spec.name))
-        return
-    for lw in state.workers:
-        try:
-            await lw.conn.call("CancelTask",
-                               {"task_id": spec_entry.spec.task_id})
-        except ConnectionError:
-            pass
+    # uniform across driver and ray:// client cores
+    _require_connected().core.cancel(ref, force=force)
 
 
 def cluster_resources() -> Dict[str, float]:

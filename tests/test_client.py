@@ -1,0 +1,117 @@
+"""Thin client (ray://): tasks, actors, put/get/wait, release, errors.
+
+Mirrors the reference's client test shape
+(reference: python/ray/tests/test_client.py).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.client import ClientServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The client exercises the full public API from a DIFFERENT process —
+# the only state it shares with the cluster is the ray:// socket.
+_CLIENT_SCRIPT = """
+import sys
+import ray_tpu
+
+ray_tpu.init(address=sys.argv[1])
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+@ray_tpu.remote
+def fail():
+    raise ValueError("client-boom")
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start):
+        self.n = start
+
+    def incr(self, k=1):
+        self.n += k
+        return self.n
+
+# tasks + nested refs inside args
+r1 = add.remote(1, 2)
+assert ray_tpu.get(r1) == 3
+assert ray_tpu.get(add.remote(r1, 10)) == 13
+
+# put/get + wait
+big = ray_tpu.put(list(range(10000)))
+assert ray_tpu.get(big)[-1] == 9999
+ready, not_ready = ray_tpu.wait([r1, big], num_returns=2, timeout=10)
+assert len(ready) == 2 and not not_ready
+
+# actors
+c = Counter.remote(100)
+assert ray_tpu.get(c.incr.remote()) == 101
+assert ray_tpu.get(c.incr.remote(9)) == 110
+ray_tpu.kill(c)
+
+# error propagation
+try:
+    ray_tpu.get(fail.remote())
+    raise SystemExit("expected error")
+except Exception as e:
+    assert "client-boom" in str(e), e
+
+# GCS passthrough (kv + cluster state)
+ray_tpu.experimental_internal_kv_put(b"ck", b"cv")
+assert ray_tpu.experimental_internal_kv_get(b"ck") == b"cv"
+assert len(ray_tpu.nodes()) >= 1
+
+ray_tpu.shutdown()
+print("CLIENT-OK")
+"""
+
+
+def test_client_end_to_end():
+    ray_tpu.init(num_cpus=2)
+    server = ClientServer()
+    try:
+        address = server.start()
+        r = subprocess.run(
+            [sys.executable, "-c", _CLIENT_SCRIPT, f"ray://{address}"],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "PYTHONPATH": REPO})
+        assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+        assert "CLIENT-OK" in r.stdout
+    finally:
+        server.stop()
+        ray_tpu.shutdown()
+
+
+def test_client_disconnect_releases_refs():
+    ray_tpu.init(num_cpus=2)
+    server = ClientServer()
+    try:
+        address = server.start()
+        script = f"""
+import ray_tpu
+ray_tpu.init(address="ray://{address}")
+refs = [ray_tpu.put(b"x" * 1000) for _ in range(10)]
+print("HOLDING", flush=True)
+"""
+        r = subprocess.run(
+            [sys.executable, "-c", script], capture_output=True,
+            text=True, timeout=60, env={**os.environ, "PYTHONPATH": REPO})
+        assert r.returncode == 0, r.stderr
+        # after the client process exits, its per-connection state is
+        # dropped server-side
+        import time
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and server._states:
+            time.sleep(0.1)
+        assert not server._states
+    finally:
+        server.stop()
+        ray_tpu.shutdown()
