@@ -1,0 +1,20 @@
+"""RL library: distributed rollouts + jitted JAX learners.
+
+Parity target: the reference's RLlib layering (reference: rllib/ —
+Trainer agents/trainer.py:513, RolloutWorker
+evaluation/rollout_worker.py:105, WorkerSet evaluation/worker_set.py,
+Policy policy/policy.py). Scope: the architecture (vector envs →
+rollout-worker actors → WorkerSet → jitted learner → Tune-compatible
+Trainer) with PPO as the flagship algorithm; the reference's 20+ algo
+zoo is out of scope by design.
+"""
+
+from ray_tpu.rllib.env import ENV_REGISTRY, CartPole, VectorEnv  # noqa: F401
+from ray_tpu.rllib.policy import (  # noqa: F401
+    compute_gae,
+    init_policy_params,
+    ppo_loss,
+    sample_actions,
+)
+from ray_tpu.rllib.ppo import DEFAULT_CONFIG, PPOTrainer  # noqa: F401
+from ray_tpu.rllib.rollout_worker import RolloutWorker, WorkerSet  # noqa: F401

@@ -1,0 +1,91 @@
+"""JAX policy: actor-critic MLP with jitted action sampling + PPO loss.
+
+Parity target: the reference's Policy abstraction
+(reference: rllib/policy/policy.py, torch_policy.py — compute_actions,
+loss, get/set_weights). TPU-first re-design: the policy is a pytree of
+params plus PURE jitted functions (sample, value, loss) — batched
+matmuls on the MXU, no per-step Python in the learner.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def init_policy_params(key, obs_size: int, num_actions: int,
+                       hidden: int = 64) -> Dict:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    init = jax.nn.initializers.orthogonal(np.sqrt(2))
+    zinit = jax.nn.initializers.orthogonal(0.01)
+    return {
+        "w1": init(k1, (obs_size, hidden), jnp.float32),
+        "b1": jnp.zeros((hidden,)),
+        "w2": init(k2, (hidden, hidden), jnp.float32),
+        "b2": jnp.zeros((hidden,)),
+        "pi": zinit(k3, (hidden, num_actions), jnp.float32),
+        "pi_b": jnp.zeros((num_actions,)),
+        "vf": init(k4, (hidden, 1), jnp.float32),
+        "vf_b": jnp.zeros((1,)),
+    }
+
+
+def _trunk(params, obs):
+    h = jnp.tanh(obs @ params["w1"] + params["b1"])
+    return jnp.tanh(h @ params["w2"] + params["b2"])
+
+
+def logits_and_value(params, obs):
+    h = _trunk(params, obs)
+    return (h @ params["pi"] + params["pi_b"],
+            (h @ params["vf"] + params["vf_b"])[..., 0])
+
+
+@jax.jit
+def sample_actions(params, obs, key):
+    """→ (actions, logp, value): one fused device step per env batch."""
+    logits, value = logits_and_value(params, obs)
+    actions = jax.random.categorical(key, logits)
+    logp = jax.nn.log_softmax(logits)[
+        jnp.arange(logits.shape[0]), actions]
+    return actions, logp, value
+
+
+@functools.partial(jax.jit, static_argnames=("clip", "vf_coeff",
+                                             "ent_coeff"))
+def ppo_loss(params, batch, *, clip=0.2, vf_coeff=0.5, ent_coeff=0.01):
+    """Clipped-surrogate PPO objective (standard public formulation)."""
+    logits, value = logits_and_value(params, batch["obs"])
+    logp_all = jax.nn.log_softmax(logits)
+    logp = logp_all[jnp.arange(logits.shape[0]), batch["actions"]]
+    ratio = jnp.exp(logp - batch["logp_old"])
+    adv = batch["advantages"]
+    pg = -jnp.minimum(
+        ratio * adv,
+        jnp.clip(ratio, 1 - clip, 1 + clip) * adv).mean()
+    vf = jnp.mean((value - batch["returns"]) ** 2)
+    entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1).mean()
+    total = pg + vf_coeff * vf - ent_coeff * entropy
+    return total, {"policy_loss": pg, "vf_loss": vf, "entropy": entropy}
+
+
+def compute_gae(rewards, values, dones, last_value, *, gamma=0.99,
+                lam=0.95):
+    """Generalized advantage estimation over a [T, B] rollout (numpy —
+    runs on the rollout worker, scan-free and cheap)."""
+    T = rewards.shape[0]
+    adv = np.zeros_like(rewards)
+    last_gae = np.zeros(rewards.shape[1], dtype=np.float32)
+    next_value = last_value
+    for t in range(T - 1, -1, -1):
+        nonterminal = 1.0 - dones[t]
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        last_gae = delta + gamma * lam * nonterminal * last_gae
+        adv[t] = last_gae
+        next_value = values[t]
+    returns = adv + values
+    return adv, returns

@@ -1,0 +1,151 @@
+"""PPOTrainer: distributed rollouts + a jitted minibatch-SGD learner.
+
+Parity target: the reference's Trainer/PPO
+(reference: rllib/agents/trainer.py:513 — train :645 — and
+rllib/agents/ppo/ppo.py). TPU-first re-design: sampling fans out over
+RolloutWorker actors (the task/actor runtime), the learner is ONE
+jitted update (epoch x minibatch loop via lax.scan inside jit, Adam
+from optax) so the whole optimization phase is a single device
+program. ``PPOTrainer`` also satisfies the Tune trainable contract
+(train() -> result dict, save/restore), like the reference's
+Trainer-is-a-Trainable layering.
+"""
+
+from __future__ import annotations
+
+import functools
+import pickle
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.policy import init_policy_params, ppo_loss
+from ray_tpu.rllib.rollout_worker import WorkerSet
+
+DEFAULT_CONFIG: Dict[str, Any] = {
+    "env": "CartPole-v0",
+    "num_workers": 2,
+    "num_envs_per_worker": 8,
+    "rollout_len": 128,
+    "gamma": 0.99,
+    "lambda": 0.95,
+    "lr": 3e-4,
+    "clip": 0.2,
+    "vf_coeff": 0.5,
+    "entropy_coeff": 0.01,
+    "num_sgd_epochs": 4,
+    "minibatch_size": 256,
+    "seed": 0,
+}
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_epochs", "num_minibatches", "clip",
+                     "vf_coeff", "ent_coeff"))
+def _ppo_update(params, opt_state, batch, key, *, num_epochs,
+                num_minibatches, clip, vf_coeff, ent_coeff, lr):
+    """The whole PPO optimization phase as one compiled program:
+    (epochs x minibatches) of Adam steps via nested lax.scan."""
+    import optax
+
+    optimizer = optax.adam(lr)
+    n = batch["obs"].shape[0]
+    mb = n // num_minibatches
+
+    def minibatch_step(carry, idx):
+        params, opt_state = carry
+        sub = {k: v[idx] for k, v in batch.items()}
+        # advantage normalization per minibatch (standard practice)
+        adv = sub["advantages"]
+        sub = dict(sub, advantages=(adv - adv.mean()) /
+                   (adv.std() + 1e-8))
+        (loss, aux), grads = jax.value_and_grad(
+            ppo_loss, has_aux=True)(params, sub, clip=clip,
+                                    vf_coeff=vf_coeff,
+                                    ent_coeff=ent_coeff)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return (params, opt_state), (loss, aux["entropy"])
+
+    def epoch_step(carry, key):
+        perm = jax.random.permutation(key, n)[:num_minibatches * mb]
+        idxs = perm.reshape(num_minibatches, mb)
+        return jax.lax.scan(minibatch_step, carry, idxs)
+
+    keys = jax.random.split(key, num_epochs)
+    (params, opt_state), (losses, entropies) = jax.lax.scan(
+        epoch_step, (params, opt_state), keys)
+    return params, opt_state, jnp.mean(losses), jnp.mean(entropies)
+
+
+class PPOTrainer:
+    """Also a Tune trainable: train()/save()/restore()."""
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        import optax
+
+        self.config = {**DEFAULT_CONFIG, **(config or {})}
+        cfg = self.config
+        probe = make_env(cfg["env"], 1)
+        self.params = init_policy_params(
+            jax.random.key(cfg["seed"]), probe.observation_size,
+            probe.num_actions)
+        self._opt_state = optax.adam(cfg["lr"]).init(self.params)
+        self.workers = WorkerSet(
+            cfg["env"], cfg["num_workers"], cfg["num_envs_per_worker"],
+            cfg["rollout_len"], cfg["gamma"], cfg["lambda"])
+        self._key = jax.random.key(cfg["seed"] + 1)
+        self._iteration = 0
+        self._timesteps = 0
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        self.workers.set_weights(self.params)
+        batch = self.workers.sample()
+        self._timesteps += len(batch["obs"])
+        num_minibatches = max(
+            1, len(batch["obs"]) // cfg["minibatch_size"])
+        self._key, sub = jax.random.split(self._key)
+        self.params, self._opt_state, loss, entropy = _ppo_update(
+            self.params, self._opt_state,
+            {k: jnp.asarray(v) for k, v in batch.items()}, sub,
+            num_epochs=cfg["num_sgd_epochs"],
+            num_minibatches=num_minibatches, clip=cfg["clip"],
+            vf_coeff=cfg["vf_coeff"], ent_coeff=cfg["entropy_coeff"],
+            lr=cfg["lr"])
+        self._iteration += 1
+        returns = self.workers.episode_returns()
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_total": self._timesteps,
+            "episode_reward_mean":
+                float(np.mean(returns)) if returns else float("nan"),
+            "episodes_this_iter": len(returns),
+            "loss": float(loss),
+            "entropy": float(entropy),
+        }
+
+    # ---- Tune trainable contract ----
+
+    def save(self, path: str) -> str:
+        with open(path, "wb") as f:
+            pickle.dump({"params": self.params,
+                         "opt_state": self._opt_state,
+                         "iteration": self._iteration,
+                         "timesteps": self._timesteps}, f)
+        return path
+
+    def restore(self, path: str) -> None:
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        self.params = state["params"]
+        self._opt_state = state["opt_state"]
+        self._iteration = state["iteration"]
+        self._timesteps = state["timesteps"]
+
+    def stop(self) -> None:
+        pass

@@ -1,0 +1,178 @@
+"""RolloutWorker: an actor that owns envs + a policy copy and samples.
+
+Parity target: the reference's RolloutWorker + WorkerSet
+(reference: rllib/evaluation/rollout_worker.py:105 — sample :726,
+get_weights/set_weights — and rllib/evaluation/worker_set.py:31).
+
+TPU-first: with a jax-native env the WHOLE rollout (policy sampling +
+env stepping, T steps) is one jitted ``lax.scan`` — a single device
+program per sample() call. Numpy ``VectorEnv``s fall back to per-step
+stepping (the generic external-env path).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List
+
+import jax
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import VectorEnv, make_env
+from ray_tpu.rllib.policy import (
+    compute_gae, init_policy_params, logits_and_value, sample_actions,
+)
+
+
+@functools.partial(jax.jit, static_argnames=("env", "T"))
+def _device_rollout(params, state, steps, key, *, env, T):
+    """[T]-step rollout fully on device: scan(policy→env)."""
+    def body(carry, _):
+        state, steps, key = carry
+        key, k_act, k_env = jax.random.split(key, 3)
+        obs = env.obs(state)
+        actions, logp, value = sample_actions(params, obs, k_act)
+        state, steps, reward, done = env.step(state, steps, actions,
+                                              k_env)
+        return ((state, steps, key),
+                (obs, actions, logp, value, reward, done))
+
+    (state, steps, key), traj = jax.lax.scan(
+        body, (state, steps, key), None, length=T)
+    _, last_value = logits_and_value(params, env.obs(state))
+    return state, steps, key, traj, last_value
+
+
+class RolloutWorker:
+    """Runs as an actor; one instance steps ``num_envs`` episodes."""
+
+    def __init__(self, env_name, num_envs: int, rollout_len: int,
+                 seed: int = 0, gamma: float = 0.99, lam: float = 0.95):
+        import jax
+
+        self.env = make_env(env_name, num_envs)
+        self.num_envs = num_envs
+        self.rollout_len = rollout_len
+        self.gamma, self.lam = gamma, lam
+        self._key = jax.random.key(seed)
+        self._jax_env = not isinstance(self.env, VectorEnv)
+        if self._jax_env:
+            self._key, sub = jax.random.split(self._key)
+            self._state, self._steps = self.env.reset(sub, num_envs)
+        else:
+            self.obs = self.env.reset(seed)
+        self.params = init_policy_params(
+            jax.random.key(0), self.env.observation_size,
+            self.env.num_actions)
+        # episode-return bookkeeping for metrics
+        self._ep_return = np.zeros(num_envs, dtype=np.float32)
+        self._finished_returns: List[float] = []
+
+    def set_weights(self, params) -> None:
+        self.params = params
+
+    def sample(self) -> Dict[str, np.ndarray]:
+        """One rollout of [T, B] transitions with GAE advantages."""
+        if self._jax_env:
+            return self._sample_device()
+        return self._sample_host()
+
+    def _sample_device(self) -> Dict[str, np.ndarray]:
+        self._state, self._steps, self._key, traj, last_value = \
+            _device_rollout(self.params, self._state, self._steps,
+                            self._key, env=self.env,
+                            T=self.rollout_len)
+        obs, actions, logp, value, reward, done = \
+            (np.asarray(a) for a in traj)
+        self._track_returns(reward, done)
+        adv, ret = compute_gae(reward, value, done,
+                               np.asarray(last_value),
+                               gamma=self.gamma, lam=self.lam)
+        flat = lambda a: a.reshape(-1, *a.shape[2:])  # noqa: E731
+        return {
+            "obs": flat(obs), "actions": flat(actions),
+            "logp_old": flat(logp), "advantages": flat(adv),
+            "returns": flat(ret),
+        }
+
+    def _sample_host(self) -> Dict[str, np.ndarray]:
+        import jax
+
+        T, B = self.rollout_len, self.num_envs
+        obs_buf = np.zeros((T, B, self.env.observation_size), np.float32)
+        act_buf = np.zeros((T, B), np.int32)
+        logp_buf = np.zeros((T, B), np.float32)
+        val_buf = np.zeros((T, B), np.float32)
+        rew_buf = np.zeros((T, B), np.float32)
+        done_buf = np.zeros((T, B), np.float32)
+
+        for t in range(T):
+            self._key, sub = jax.random.split(self._key)
+            actions, logp, value = sample_actions(
+                self.params, self.obs, sub)
+            actions = np.asarray(actions)
+            obs_buf[t] = self.obs
+            act_buf[t] = actions
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(value)
+            self.obs, reward, done = self.env.step(actions)
+            rew_buf[t] = reward
+            done_buf[t] = done
+        self._track_returns(rew_buf, done_buf)
+
+        _, _, last_value = sample_actions(self.params, self.obs,
+                                          self._key)
+        adv, ret = compute_gae(rew_buf, val_buf, done_buf,
+                               np.asarray(last_value),
+                               gamma=self.gamma, lam=self.lam)
+        flat = lambda a: a.reshape(-1, *a.shape[2:])  # noqa: E731
+        return {
+            "obs": flat(obs_buf), "actions": flat(act_buf),
+            "logp_old": flat(logp_buf), "advantages": flat(adv),
+            "returns": flat(ret),
+        }
+
+    def _track_returns(self, rewards: np.ndarray,
+                       dones: np.ndarray) -> None:
+        for t in range(rewards.shape[0]):
+            self._ep_return += rewards[t]
+            done = dones[t].astype(bool)
+            if done.any():
+                self._finished_returns.extend(
+                    self._ep_return[done].tolist())
+                self._ep_return[done] = 0.0
+
+    def episode_returns(self, clear: bool = True) -> List[float]:
+        out = list(self._finished_returns)
+        if clear:
+            self._finished_returns.clear()
+        return out
+
+
+class WorkerSet:
+    """A set of RolloutWorker actors (reference: worker_set.py:31)."""
+
+    def __init__(self, env_name, num_workers: int, num_envs: int,
+                 rollout_len: int, gamma: float, lam: float):
+        cls = ray_tpu.remote(RolloutWorker)
+        self.workers = [
+            cls.remote(env_name, num_envs, rollout_len, seed=i + 1,
+                       gamma=gamma, lam=lam)
+            for i in range(num_workers)]
+
+    def sample(self) -> Dict[str, np.ndarray]:
+        batches = ray_tpu.get([w.sample.remote() for w in self.workers])
+        return {k: np.concatenate([b[k] for b in batches])
+                for k in batches[0]}
+
+    def set_weights(self, params) -> None:
+        ray_tpu.get([w.set_weights.remote(params)
+                     for w in self.workers])
+
+    def episode_returns(self) -> List[float]:
+        out: List[float] = []
+        for rs in ray_tpu.get(
+                [w.episode_returns.remote() for w in self.workers]):
+            out.extend(rs)
+        return out

@@ -50,28 +50,45 @@ class ClientFunctionManager:
 
 
 class ClientRefCounter:
-    """Local counts only; zero → batched release push to the server."""
+    """Local counts only; zero → batched release push to the server.
+
+    Releases are deferred through a pending set and flushed ids are
+    re-checked against live counts under the lock — a ref re-acquired
+    between the zero-crossing and the flush (e.g. the same id arriving
+    nested in a get() reply) must not be released out from under the
+    new holder."""
 
     def __init__(self, client: "ClientCore"):
         self._client = client
         self._lock = threading.Lock()
         self._counts: Dict[ObjectID, int] = {}
+        self._pending_release: set = set()
 
     def add_local_reference(self, object_id: ObjectID) -> None:
         with self._lock:
             self._counts[object_id] = self._counts.get(object_id, 0) + 1
+            self._pending_release.discard(object_id)
 
     def remove_local_reference(self, object_id: ObjectID) -> None:
-        release = False
+        flush = False
         with self._lock:
             n = self._counts.get(object_id, 0) - 1
             if n <= 0:
                 self._counts.pop(object_id, None)
-                release = True
+                self._pending_release.add(object_id)
+                flush = True
             else:
                 self._counts[object_id] = n
-        if release:
-            self._client._release(object_id.binary())
+        if flush:
+            self._flush_releases()
+
+    def _flush_releases(self) -> None:
+        with self._lock:
+            ids = [o.binary() for o in self._pending_release
+                   if o not in self._counts]
+            self._pending_release.clear()
+        if ids:
+            self._client._release(ids)
 
 
 class ClientCore:
@@ -103,12 +120,12 @@ class ClientCore:
             self._conn.call(method, header, bufs=list(bufs)),
             timeout=None)
 
-    def _release(self, id_bytes: bytes) -> None:
+    def _release(self, id_bytes_list) -> None:
         if self._shutdown:
             return
         try:
             self._loop_thread.call_soon(
-                self._conn.push("CRelease", {"ids": [id_bytes]}))
+                self._conn.push("CRelease", {"ids": id_bytes_list}))
         except Exception:  # noqa: BLE001 — interpreter shutdown
             pass
 
@@ -174,10 +191,17 @@ class ClientCore:
         reply, _ = self._call("CPut", {}, bufs=[dumps_args(value)])
         return self._make_refs([reply["id"]])[0]
 
-    def _resolve_incoming_ref(self, id_bytes: bytes) -> ObjectRef:
-        """Values may contain ObjectRefs (persistent ids) — rebuild
-        them as client refs (server booked them during serialization)."""
-        return self._make_refs([id_bytes])[0]
+    def _resolve_incoming(self, kind: str, payload):
+        """Values may contain ObjectRefs / ActorHandles (persistent
+        ids) — rebuild them as client objects (the server booked them
+        during serialization)."""
+        from ray_tpu.util.client.common import make_actor_handle
+
+        if kind == "ref":
+            return self._make_refs([payload])[0]
+        if kind == "actor":
+            return make_actor_handle(self, payload)
+        raise KeyError(f"unknown persistent id kind {kind!r}")
 
     def get(self, refs: Sequence[ObjectRef],
             timeout: Optional[float] = None):
@@ -188,7 +212,7 @@ class ClientCore:
             "timeout": timeout})
         if not reply["ok"]:
             raise cloudpickle.loads(bufs[0])
-        return [loads_args(b, self._resolve_incoming_ref) for b in bufs]
+        return [loads_args(b, self._resolve_incoming) for b in bufs]
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None, fetch_local: bool = True):

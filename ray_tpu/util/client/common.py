@@ -1,17 +1,17 @@
 """Shared pickling helpers for the thin client.
 
 Parity target: the reference's client_pickler
-(reference: python/ray/util/client/client_pickler.py) — ObjectRefs
-cross the wire as persistent ids, resolved against the server-side
-per-connection ref table, so refs nested anywhere inside argument
-structures round-trip correctly.
+(reference: python/ray/util/client/client_pickler.py) — ObjectRefs and
+ActorHandles cross the wire as pickle persistent ids, resolved against
+the server-side per-connection tables, so refs/handles nested anywhere
+inside argument or value structures round-trip correctly.
 """
 
 from __future__ import annotations
 
 import io
 import pickle
-from typing import Any, Callable, Dict
+from typing import Any, Callable, Optional
 
 try:
     import cloudpickle
@@ -22,44 +22,63 @@ from ray_tpu._private.object_ref import ObjectRef
 
 
 class ClientArgPickler(cloudpickle.Pickler):
-    """ObjectRefs become persistent ids (both directions). ``on_ref``
-    lets the server book refs it serializes into a reply, so the
-    client can use them later."""
+    """ObjectRefs / ActorHandles become persistent ids (both
+    directions). ``on_ref``/``on_actor`` let the server book objects it
+    serializes into a reply, so the client can use them later."""
 
     def __init__(self, file, protocol=None,
-                 on_ref: Callable[[ObjectRef], None] | None = None):
+                 on_ref: Optional[Callable] = None,
+                 on_actor: Optional[Callable] = None):
         super().__init__(file, protocol)
         self._on_ref = on_ref
+        self._on_actor = on_actor
 
     def persistent_id(self, obj):
+        from ray_tpu.actor import ActorHandle
+
         if isinstance(obj, ObjectRef):
             if self._on_ref is not None:
                 self._on_ref(obj)
             return ("ref", obj.object_id.binary())
+        if isinstance(obj, ActorHandle):
+            if self._on_actor is not None:
+                self._on_actor(obj)
+            st = obj._serialization_state()
+            return ("actor", (st["actor_id"], st["class_name"],
+                              st["fn_key"], st["max_task_retries"],
+                              tuple(st["method_num_returns"].items())))
         return None
 
 
 class ServerArgUnpickler(pickle.Unpickler):
-    """Server side: persistent ids resolve to the connection's refs."""
+    """Persistent ids resolve through ``resolver(kind, payload)``."""
 
-    def __init__(self, file, resolver: Callable[[bytes], Any]):
+    def __init__(self, file, resolver: Callable[[str, Any], Any]):
         super().__init__(file)
         self._resolver = resolver
 
     def persistent_load(self, pid):
-        kind, id_bytes = pid
-        if kind != "ref":
-            raise pickle.UnpicklingError(f"unknown persistent id {kind}")
-        return self._resolver(id_bytes)
+        kind, payload = pid
+        return self._resolver(kind, payload)
 
 
-def dumps_args(obj: Any,
-               on_ref: Callable[[ObjectRef], None] | None = None) -> bytes:
+def make_actor_handle(core, payload):
+    """Rebuild an ActorHandle (either side) from its persistent id."""
+    from ray_tpu.actor import ActorHandle
+
+    actor_id, class_name, fn_key, max_task_retries, mnr = payload
+    return ActorHandle(core, actor_id, class_name, fn_key,
+                       max_task_retries=max_task_retries,
+                       method_num_returns=dict(mnr))
+
+
+def dumps_args(obj: Any, on_ref: Optional[Callable] = None,
+               on_actor: Optional[Callable] = None) -> bytes:
     buf = io.BytesIO()
     ClientArgPickler(buf, protocol=pickle.HIGHEST_PROTOCOL,
-                     on_ref=on_ref).dump(obj)
+                     on_ref=on_ref, on_actor=on_actor).dump(obj)
     return buf.getvalue()
 
 
-def loads_args(data: bytes, resolver: Callable[[bytes], Any]) -> Any:
+def loads_args(data: bytes, resolver: Callable[[str, Any], Any]) -> Any:
     return ServerArgUnpickler(io.BytesIO(data), resolver).load()

@@ -65,9 +65,6 @@ class ClientServer:
         core = worker_mod._require_connected().core
         self._core = core
         self.address = core._run(self._server.listen(listen))
-        # advertise for discovery (ray_tpu.init(address="ray://auto"))
-        core._kv_put_sync(b"__rtpu_client_server__",
-                          self.address.encode())
         logger.info("client server listening at %s", self.address)
         return self.address
 
@@ -89,14 +86,28 @@ class ClientServer:
         self._states.pop(conn, None)
 
     def _resolver(self, st: _ConnState):
-        def resolve(id_bytes: bytes):
-            ref = st.refs.get(id_bytes)
-            if ref is None:
-                raise KeyError(
-                    f"client referenced unknown object "
-                    f"{id_bytes.hex()[:16]} (already released?)")
-            return ref
+        from ray_tpu.util.client.common import make_actor_handle
+
+        def resolve(kind: str, payload):
+            if kind == "ref":
+                ref = st.refs.get(payload)
+                if ref is None:
+                    raise KeyError(
+                        f"client referenced unknown object "
+                        f"{payload.hex()[:16]} (already released?)")
+                return ref
+            if kind == "actor":
+                actor_id = payload[0]
+                handle = st.actors.get(actor_id)
+                if handle is None:
+                    handle = st.actors[actor_id] = make_actor_handle(
+                        self._core, payload)
+                return handle
+            raise KeyError(f"unknown persistent id kind {kind!r}")
         return resolve
+
+    def _resolve_ref(self, st: _ConnState, id_bytes: bytes):
+        return self._resolver(st)("ref", id_bytes)
 
     def _book(self, st: _ConnState, refs) -> list:
         ids = []
@@ -160,28 +171,35 @@ class ClientServer:
 
     async def handle_get(self, conn, header, bufs):
         st = self._state(conn)
-        refs = [self._resolver(st)(i) for i in header["ids"]]
+        refs = [self._resolve_ref(st, i) for i in header["ids"]]
         timeout = header.get("timeout")
+
         def book(ref):
             # a returned value may CONTAIN ObjectRefs (nested remote
             # calls): book them so the client can use them later
             st.refs.setdefault(ref.object_id.binary(), ref)
 
+        def book_actor(handle):
+            st.actors.setdefault(handle._actor_id, handle)
+
         try:
-            values = await self._offload(
-                lambda: self._core.get(refs, timeout=timeout))
+            # handlers already run ON the core's IO loop: await the
+            # async path directly — an unbounded blocking get would
+            # otherwise pin a default-executor thread per waiting
+            # client and can starve the loop's executor users
+            values = await self._core.get_objects_async(
+                refs, timeout=timeout)
             return ({"ok": True},
-                    [dumps_args(v, on_ref=book) for v in values])
+                    [dumps_args(v, on_ref=book, on_actor=book_actor)
+                     for v in values])
         except Exception as e:  # noqa: BLE001 — ship to the client
             return ({"ok": False}, [cloudpickle.dumps(e)])
 
     async def handle_wait(self, conn, header, bufs):
         st = self._state(conn)
-        refs = [self._resolver(st)(i) for i in header["ids"]]
-        num_returns, timeout = header["num_returns"], header.get("timeout")
-        ready, not_ready = await self._offload(
-            lambda: self._core.wait(refs, num_returns=num_returns,
-                                    timeout=timeout))
+        refs = [self._resolve_ref(st, i) for i in header["ids"]]
+        ready, not_ready = await self._core._wait_async(
+            refs, header["num_returns"], header.get("timeout"))
         return {"ready": [r.object_id.binary() for r in ready],
                 "not_ready": [r.object_id.binary() for r in not_ready]}
 
@@ -191,11 +209,12 @@ class ClientServer:
         await self._offload(
             lambda: self._core.kill_actor(actor_id,
                                           no_restart=no_restart))
+        self._state(conn).actors.pop(actor_id, None)
         return {}
 
     async def handle_cancel(self, conn, header, bufs):
         st = self._state(conn)
-        ref = self._resolver(st)(header["id"])
+        ref = self._resolve_ref(st, header["id"])
         force = header.get("force", False)
         await self._offload(lambda: self._core.cancel(ref, force=force))
         return {}

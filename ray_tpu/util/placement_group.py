@@ -31,7 +31,7 @@ class PlacementGroup:
         w = worker_mod._require_connected()
         deadline = time.time() + timeout
         while time.time() < deadline:
-            reply, _ = w.core._run(w.core.gcs_conn.call(
+            reply, _ = w.core._run(w.core._gcs_call(
                 "GetPlacementGroup", {"pg_id": self.id.binary()}))
             if reply.get("found") and reply["state"] == "CREATED":
                 return True
@@ -59,7 +59,7 @@ def placement_group(bundles: List[Dict[str, float]],
                          "resource dicts")
     w = worker_mod._require_connected()
     pg_id = PlacementGroupID.from_random()
-    w.core._run(w.core.gcs_conn.call("CreatePlacementGroup", {
+    w.core._run(w.core._gcs_call("CreatePlacementGroup", {
         "pg_id": pg_id.binary(), "bundles": bundles,
         "strategy": strategy, "name": name}))
     return PlacementGroup(pg_id, bundles)
@@ -67,13 +67,13 @@ def placement_group(bundles: List[Dict[str, float]],
 
 def remove_placement_group(pg: PlacementGroup) -> None:
     w = worker_mod._require_connected()
-    w.core._run(w.core.gcs_conn.call(
+    w.core._run(w.core._gcs_call(
         "RemovePlacementGroup", {"pg_id": pg.id.binary()}))
 
 
 def placement_group_table() -> Dict[str, dict]:
     w = worker_mod._require_connected()
-    reply, _ = w.core._run(w.core.gcs_conn.call(
+    reply, _ = w.core._run(w.core._gcs_call(
         "GetAllPlacementGroups", {}))
     return {PlacementGroupID(p["pg_id"]).hex(): {
         "state": p["state"], "bundles": p["bundles"],

@@ -357,7 +357,7 @@ def timeline() -> List[dict]:
     """Chrome-tracing events collected from all workers (reference:
     ray.timeline / state.chrome_tracing_dump)."""
     w = _require_connected()
-    reply, _ = w.core._run(w.core.gcs_conn.call("GetProfileEvents", {}))
+    reply, _ = w.core._run(w.core._gcs_call("GetProfileEvents", {}))
     events = []
     for e in reply["events"]:
         events.append({

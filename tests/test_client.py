@@ -55,6 +55,13 @@ assert len(ready) == 2 and not not_ready
 c = Counter.remote(100)
 assert ray_tpu.get(c.incr.remote()) == 101
 assert ray_tpu.get(c.incr.remote(9)) == 110
+
+# actor handles cross the wire inside task args
+@ray_tpu.remote
+def poke(counter):
+    return ray_tpu.get(counter.incr.remote(1000))
+
+assert ray_tpu.get(poke.remote(c)) == 1110
 ray_tpu.kill(c)
 
 # error propagation
