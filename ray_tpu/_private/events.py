@@ -1,0 +1,80 @@
+"""Structured event framework: JSON event lines with severity + labels.
+
+Parity target: the reference's event framework (reference:
+src/ray/util/event.h:40 RAY_EVENT macro, EventManager :97,
+LogEventReporter :62 — structured JSON events appended to per-
+component files under the session log dir). Each process gets one
+emitter; events also flow to the GCS cluster-event table so
+``ray_tpu.state``/dashboards see them without scraping files.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+SEVERITIES = ("DEBUG", "INFO", "WARNING", "ERROR", "FATAL")
+
+
+class EventEmitter:
+    """Appends JSON event lines to ``<log_dir>/events/event_<source>.log``."""
+
+    def __init__(self, source: str, log_dir: Optional[str] = None):
+        self.source = source
+        self._lock = threading.Lock()
+        self._file = None
+        if log_dir:
+            event_dir = os.path.join(log_dir, "events")
+            os.makedirs(event_dir, exist_ok=True)
+            self._path = os.path.join(event_dir,
+                                      f"event_{source}.log")
+        else:
+            self._path = None
+
+    def emit(self, severity: str, label: str, message: str,
+             **fields: Any) -> Dict[str, Any]:
+        if severity not in SEVERITIES:
+            raise ValueError(f"severity must be one of {SEVERITIES}")
+        event = {
+            "timestamp": time.time(),
+            "severity": severity,
+            "label": label,
+            "message": message,
+            "source_type": self.source,
+            "pid": os.getpid(),
+            "custom_fields": fields,
+        }
+        if self._path is not None:
+            line = json.dumps(event) + "\n"
+            with self._lock:
+                if self._file is None:
+                    self._file = open(self._path, "a")
+                self._file.write(line)
+                self._file.flush()
+        return event
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+def read_events(log_dir: str) -> list:
+    """Parse every event file under ``<log_dir>/events``."""
+    out = []
+    event_dir = os.path.join(log_dir, "events")
+    try:
+        names = sorted(os.listdir(event_dir))
+    except FileNotFoundError:
+        return out
+    for name in names:
+        with open(os.path.join(event_dir, name)) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+    return out

@@ -87,6 +87,12 @@ class Raylet:
             spill_dir=os.path.join(session_dir, "spill", self.node_id.hex()[:8]),
             spilling_enabled=config.object_spilling_enabled)
 
+        # Structured event log (reference: util/event.h RAY_EVENT)
+        from ray_tpu._private.events import EventEmitter
+        self.events = EventEmitter(
+            "raylet", os.path.join(session_dir, "logs")
+            if config.event_log_enabled else None)
+
         self.workers: Dict[bytes, WorkerHandle] = {}
         self.leases: Dict[int, LeaseEntry] = {}
         self._lease_counter = itertools.count(1)
@@ -168,6 +174,10 @@ class Raylet:
             self._start_worker_process()
         logger.info("raylet %s listening at %s (%s)",
                     self.node_id.hex()[:8], self.address, self.resources_total)
+        self.events.emit("INFO", "RAYLET_STARTED",
+                         f"raylet listening at {self.address}",
+                         node=self.node_id.hex()[:12],
+                         resources=self.resources_total)
         return self.address
 
     async def stop(self):
@@ -176,6 +186,7 @@ class Raylet:
             self._hb_task.cancel()
         if getattr(self, "_log_monitor_task", None):
             self._log_monitor_task.cancel()
+        self.events.close()
         for w in list(self.workers.values()):
             self._kill_worker(w)
         await self._server.close()
@@ -398,6 +409,11 @@ class Raylet:
             return
         prev_state = handle.state
         handle.state = WORKER_DEAD
+        self.events.emit(
+            "WARNING", "WORKER_DIED",
+            f"worker {worker_id.hex()[:12]} disconnected",
+            pid=handle.pid, prev_state=prev_state,
+            node=self.node_id.hex()[:12])
         logger.warning("worker %s (%s) disconnected", worker_id.hex()[:8], prev_state)
         if handle.lease_id is not None and handle.lease_id in self.leases:
             self._release_lease(handle.lease_id)

@@ -1,0 +1,147 @@
+"""Streaming: a fluent DataStream API over actor operator pipelines.
+
+Parity target: the reference's streaming library (reference:
+streaming/python/ — StreamingContext, DataStream with
+map/filter/flat_map/key_by/reduce/sink — over the C++ engine
+streaming/src/; see runtime.py for the engine re-design). Usage::
+
+    from ray_tpu import streaming
+
+    ctx = streaming.StreamingContext()
+    out = (ctx.from_collection(words)
+              .flat_map(str.split)
+              .key_by(lambda w: w)
+              .reduce(lambda a, b: a + b)
+              .execute())
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+from ray_tpu.streaming.runtime import Barrier, Eos, StreamOperator
+
+__all__ = ["StreamingContext", "DataStream"]
+
+_BATCH = 64
+
+
+class _Stage:
+    def __init__(self, kind: str, fn: Optional[Callable]):
+        self.kind = kind
+        self.fn = fn
+
+
+class DataStream:
+    def __init__(self, ctx: "StreamingContext", stages: List[_Stage]):
+        self._ctx = ctx
+        self._stages = stages
+
+    def _with(self, stage: _Stage) -> "DataStream":
+        # preserve KeyedStream-ness across chained transforms
+        return type(self)(self._ctx, self._stages + [stage])
+
+    def map(self, fn: Callable) -> "DataStream":
+        return self._with(_Stage("map", fn))
+
+    def filter(self, fn: Callable) -> "DataStream":
+        return self._with(_Stage("filter", fn))
+
+    def flat_map(self, fn: Callable) -> "DataStream":
+        return self._with(_Stage("flat_map", fn))
+
+    def key_by(self, key_fn: Callable) -> "KeyedStream":
+        keyed = self._with(_Stage("map", _KeyBy(key_fn)))
+        return KeyedStream(keyed._ctx, keyed._stages)
+
+    def sink(self, fn: Optional[Callable] = None) -> "DataStream":
+        return self._with(_Stage("sink", fn))
+
+    def execute(self, checkpoint_every: Optional[int] = None
+                ) -> List[Any]:
+        """Build the operator actors, stream the source through, and
+        return the terminal stage's output (the last stage becomes a
+        sink when none was declared)."""
+        stages = list(self._stages)
+        if stages[-1].kind != "sink":
+            stages.append(_Stage("sink", None))
+        return self._ctx._run(stages, checkpoint_every)
+
+
+class KeyedStream(DataStream):
+    def reduce(self, fn: Callable) -> DataStream:
+        return self._with(_Stage("reduce", fn))
+
+
+class _KeyBy:
+    """Picklable key extractor → (key, record) pairs."""
+
+    def __init__(self, key_fn: Callable):
+        self.key_fn = key_fn
+
+    def __call__(self, rec):
+        return (self.key_fn(rec), rec)
+
+
+class StreamingContext:
+    def __init__(self, capacity: int = 256):
+        self.capacity = capacity
+        self._source: Iterable[Any] = ()
+        self.operators: List[Any] = []  # live handles of the last run
+
+    def from_collection(self, items: Iterable[Any]) -> DataStream:
+        self._source = items
+        return DataStream(self, [])
+
+    def _run(self, stages: List[_Stage],
+             checkpoint_every: Optional[int]) -> List[Any]:
+        op_cls = ray_tpu.remote(StreamOperator)
+        ops = [op_cls.remote(s.kind, s.fn, self.capacity)
+               for s in stages]
+        self.operators = ops
+        # wire the chain back-to-front
+        for up, down in zip(ops, ops[1:]):
+            ray_tpu.get(up.set_downstream.remote(down))
+
+        head = ops[0]
+        batch: List[Any] = []
+        sent = 0
+        barrier_id = 0
+        for item in self._source:
+            batch.append(item)
+            sent += 1
+            if len(batch) >= _BATCH:
+                ray_tpu.get(head.push.remote(batch))
+                batch = []
+            if checkpoint_every and sent % checkpoint_every == 0:
+                if batch:
+                    ray_tpu.get(head.push.remote(batch))
+                    batch = []
+                barrier_id += 1
+                ray_tpu.get(head.push.remote([Barrier(barrier_id)]))
+        if batch:
+            ray_tpu.get(head.push.remote(batch))
+        ray_tpu.get(head.push.remote([Eos()]))
+
+        # wait for EOS to reach the sink, surfacing operator failures
+        sink = ops[-1]
+        import time
+
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            errors = ray_tpu.get([op.error.remote() for op in ops])
+            bad = next((e for e in errors if e), None)
+            if bad:
+                raise RuntimeError(f"stream operator failed:\n{bad}")
+            if ray_tpu.get(sink.eos_done.remote()):
+                break
+            time.sleep(0.02)
+        else:
+            raise TimeoutError("stream did not reach EOS")
+        ray_tpu.get(sink.drain.remote())
+        errors = ray_tpu.get([op.error.remote() for op in ops])
+        bad = next((e for e in errors if e), None)
+        if bad:  # an error that raced the EOS poll
+            raise RuntimeError(f"stream operator failed:\n{bad}")
+        return ray_tpu.get(sink.sink_output.remote())

@@ -1,0 +1,181 @@
+"""Streaming runtime: operator actors linked by credit-controlled channels.
+
+Parity target: the reference's streaming engine (reference:
+streaming/src/ — DataWriter/DataReader data_writer.h, data_reader.h,
+credit-based flow_control.h, barrier/checkpoint reliability
+reliability/barrier_helper.h, transport over direct actor calls in
+streaming/src/queue/). Re-design: each operator is an async actor;
+records flow downstream as batched actor calls; the receiver admits at
+most ``capacity`` in-flight records per input channel and the sender
+BLOCKS when its credit window is exhausted (credit returns ride the
+push replies). Barriers flow in-band: an operator aligns barriers from
+all inputs, snapshots its state, and forwards the barrier downstream
+(Chandy-Lamport style, the public pattern the reference implements).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Callable, Dict, List, Optional
+
+class Barrier:
+    """In-band checkpoint marker (typed: user records can never be
+    mistaken for control messages)."""
+
+    def __init__(self, barrier_id: int):
+        self.barrier_id = barrier_id
+
+
+class Eos:
+    """In-band end-of-stream marker."""
+
+
+class StreamOperator:
+    """Async actor hosting one pipeline stage.
+
+    fn(record) → list of output records (map=1, filter=0/1, flat_map=n)
+    For keyed reduce, the operator keeps per-key state and emits
+    updated (key, value) pairs.
+    """
+
+    def __init__(self, op_kind: str, fn: Optional[Callable],
+                 capacity: int = 256, num_inputs: int = 1):
+        self.op_kind = op_kind
+        self.fn = fn
+        self.capacity = capacity
+        self.num_inputs = num_inputs
+        self.downstream = None           # ActorHandle or None (sink)
+        self._inflight = 0
+        self._space = asyncio.Condition()
+        self._queue: Optional[asyncio.Queue] = None
+        self._consumer: Optional[asyncio.Task] = None
+        self._barrier_waiting: Dict[int, int] = {}  # barrier_id → count
+        self._eos_seen = 0
+        self._state: Dict[Any, Any] = {}  # keyed-reduce state
+        self._sink_out: List[Any] = []
+        self._snapshots: Dict[int, dict] = {}
+        self._error: Optional[str] = None
+
+    def set_downstream(self, handle) -> None:
+        self.downstream = handle
+
+    # ---- data plane ----
+
+    async def push(self, records: List[Any]) -> int:
+        """Receive a batch from upstream. Returns the remaining credit
+        AFTER admitting this batch (the sender's new window). Blocks —
+        i.e. delays the reply, which IS the backpressure — while the
+        operator is over capacity. A single consumer task processes
+        admitted batches strictly in arrival order (records and
+        barriers must not reorder)."""
+        if self._consumer is None:
+            self._queue = asyncio.Queue()
+            self._consumer = asyncio.get_running_loop().create_task(
+                self._consume_loop())
+        async with self._space:
+            await self._space.wait_for(
+                lambda: self._inflight < self.capacity)
+            self._inflight += len(records)
+        self._queue.put_nowait(records)
+        return max(0, self.capacity - self._inflight)
+
+    async def _consume_loop(self) -> None:
+        while True:
+            records = await self._queue.get()
+            try:
+                await self._process(records)
+            except Exception as e:  # noqa: BLE001 — driver polls error()
+                import traceback
+
+                if self._error is None:
+                    self._error = (f"{type(e).__name__}: {e}\n"
+                                   f"{traceback.format_exc()}")
+            finally:
+                # credit MUST return even when user code raised, or the
+                # channel wedges at capacity
+                async with self._space:
+                    self._inflight -= len(records)
+                    self._space.notify_all()
+
+    async def _process(self, records: List[Any]) -> None:
+        out: List[Any] = []
+        control: List[Any] = []
+        for rec in records:
+            if isinstance(rec, (Barrier, Eos)):
+                control.append(rec)
+                continue
+            out.extend(self._apply(rec))
+        if out:
+            if self.downstream is not None:
+                await self._send(out)
+            else:
+                self._sink_out.extend(out)
+        for rec in control:
+            await self._handle_control(rec)
+
+    def _apply(self, rec: Any) -> List[Any]:
+        if self.op_kind == "map":
+            return [self.fn(rec)]
+        if self.op_kind == "filter":
+            return [rec] if self.fn(rec) else []
+        if self.op_kind == "flat_map":
+            return list(self.fn(rec))
+        if self.op_kind == "reduce":
+            key, value = rec
+            if key in self._state:
+                self._state[key] = self.fn(self._state[key], value)
+            else:
+                self._state[key] = value
+            return [(key, self._state[key])]
+        if self.op_kind == "sink":
+            return [self.fn(rec) if self.fn else rec]
+        raise ValueError(f"unknown op kind {self.op_kind!r}")
+
+    async def _send(self, records: List[Any]) -> None:
+        credit = await self.downstream.push.remote(records)
+        # Credit window: if the receiver reports no space, the next
+        # push's reply will simply block — nothing else to do here;
+        # the await above already paced us to the receiver.
+        del credit
+
+    async def _handle_control(self, rec) -> None:
+        if isinstance(rec, Eos):
+            self._eos_seen += 1
+            if self._eos_seen >= self.num_inputs:
+                if self.downstream is not None:
+                    await self.downstream.push.remote([Eos()])
+            return
+        barrier_id = rec.barrier_id
+        n = self._barrier_waiting.get(barrier_id, 0) + 1
+        self._barrier_waiting[barrier_id] = n
+        if n >= self.num_inputs:  # aligned: snapshot + forward
+            del self._barrier_waiting[barrier_id]
+            self._snapshots[barrier_id] = {
+                "state": dict(self._state),
+                "sink_len": len(self._sink_out),
+            }
+            if self.downstream is not None:
+                await self.downstream.push.remote([Barrier(barrier_id)])
+
+    # ---- introspection (driver-side) ----
+
+    async def drain(self) -> None:
+        """Wait until everything admitted has been processed."""
+        async with self._space:
+            await self._space.wait_for(lambda: self._inflight == 0)
+
+    async def sink_output(self) -> List[Any]:
+        return list(self._sink_out)
+
+    async def snapshot(self, barrier_id: int) -> Optional[dict]:
+        return self._snapshots.get(barrier_id)
+
+    async def eos_done(self) -> bool:
+        return self._eos_seen >= self.num_inputs
+
+    async def error(self) -> Optional[str]:
+        return self._error
+
+    async def stats(self) -> dict:
+        return {"inflight": self._inflight,
+                "snapshots": sorted(self._snapshots)}

@@ -1,0 +1,81 @@
+"""Serializability inspection: find WHY an object won't pickle.
+
+Parity target: ``ray.util.check_serialize.inspect_serializability``
+(reference: python/ray/util/check_serialize.py) — walk an object's
+closure/attribute graph and report the leaf members that fail, instead
+of one opaque pickling error.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Set, Tuple
+
+try:
+    import cloudpickle
+except ImportError:  # pragma: no cover
+    import pickle as cloudpickle
+
+
+class FailureTuple:
+    def __init__(self, obj: Any, name: str, parent: Any):
+        self.obj = obj
+        self.name = name
+        self.parent = parent
+
+    def __repr__(self):
+        return f"FailureTuple({self.name} [obj={self.obj!r}])"
+
+
+def _serializable(obj: Any) -> bool:
+    try:
+        cloudpickle.dumps(obj)
+        return True
+    except Exception:  # noqa: BLE001 — that's the question being asked
+        return False
+
+
+def _walk(obj: Any, name: str, parent: Any, failures: list,
+          seen: Set[int], depth: int) -> None:
+    if id(obj) in seen or depth > 4:
+        return
+    seen.add(id(obj))
+    if _serializable(obj):
+        return
+
+    children: list = []
+    if inspect.isfunction(obj):
+        if obj.__closure__:
+            children += [
+                (f"{name}.<closure>.{v}", c.cell_contents)
+                for v, c in zip(obj.__code__.co_freevars, obj.__closure__)
+            ]
+        children += [(f"{name}.<globals>.{k}", v)
+                     for k, v in obj.__globals__.items()
+                     if k in obj.__code__.co_names]
+    elif hasattr(obj, "__dict__") and isinstance(obj.__dict__, dict):
+        children += [(f"{name}.{k}", v) for k, v in obj.__dict__.items()]
+    elif isinstance(obj, (list, tuple, set)):
+        children += [(f"{name}[{i}]", v) for i, v in enumerate(obj)]
+    elif isinstance(obj, dict):
+        children += [(f"{name}[{k!r}]", v) for k, v in obj.items()]
+
+    found_deeper = False
+    for child_name, child in children:
+        if not _serializable(child):
+            found_deeper = True
+            _walk(child, child_name, obj, failures, seen, depth + 1)
+    if not found_deeper:
+        failures.append(FailureTuple(obj, name, parent))
+
+
+def inspect_serializability(obj: Any, name: str = None
+                            ) -> Tuple[bool, Set[FailureTuple]]:
+    """→ (is_serializable, failure_set). Failures name the deepest
+    unpicklable members reachable from ``obj``."""
+    name = name or getattr(obj, "__name__", repr(obj)[:40])
+    if _serializable(obj):
+        return True, set()
+    failures: list = []
+    _walk(obj, name, None, failures, set(), 0)
+    return False, set(failures)

@@ -62,33 +62,28 @@ class ClientRefCounter:
         self._client = client
         self._lock = threading.Lock()
         self._counts: Dict[ObjectID, int] = {}
-        self._pending_release: set = set()
+        self._adds: Dict[ObjectID, int] = {}  # cumulative bookings seen
 
     def add_local_reference(self, object_id: ObjectID) -> None:
+        # Every add corresponds 1:1 to a server-side booking (a reply
+        # id or a persistent-id resolve).
         with self._lock:
             self._counts[object_id] = self._counts.get(object_id, 0) + 1
-            self._pending_release.discard(object_id)
+            self._adds[object_id] = self._adds.get(object_id, 0) + 1
 
     def remove_local_reference(self, object_id: ObjectID) -> None:
-        flush = False
         with self._lock:
             n = self._counts.get(object_id, 0) - 1
-            if n <= 0:
-                self._counts.pop(object_id, None)
-                self._pending_release.add(object_id)
-                flush = True
-            else:
+            if n > 0:
                 self._counts[object_id] = n
-        if flush:
-            self._flush_releases()
-
-    def _flush_releases(self) -> None:
-        with self._lock:
-            ids = [o.binary() for o in self._pending_release
-                   if o not in self._counts]
-            self._pending_release.clear()
-        if ids:
-            self._client._release(ids)
+                return
+            self._counts.pop(object_id, None)
+            booked = self._adds.pop(object_id, 1)
+        # Release exactly the bookings this client consumed: the server
+        # decrements a pin count, so a booking from an in-flight reply
+        # the client hasn't processed yet survives the release instead
+        # of being popped out from under the new holder.
+        self._client._release([(object_id.binary(), booked)])
 
 
 class ClientCore:

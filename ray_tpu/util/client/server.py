@@ -31,8 +31,29 @@ except ImportError:  # pragma: no cover
 
 class _ConnState:
     def __init__(self):
-        self.refs: Dict[bytes, object] = {}       # id -> ObjectRef
+        # id -> [ObjectRef, pin_count]: every id the server hands the
+        # client (reply ids, persistent ids inside values) adds a pin;
+        # client releases carry the number of bookings consumed, so a
+        # release can never drop a booking from a reply the client has
+        # not processed yet.
+        self.refs: Dict[bytes, list] = {}
         self.actors: Dict[bytes, object] = {}     # actor_id -> handle
+
+    def book(self, ref) -> bytes:
+        id_bytes = ref.object_id.binary()
+        entry = self.refs.get(id_bytes)
+        if entry is None:
+            self.refs[id_bytes] = [ref, 1]
+        else:
+            entry[1] += 1
+        return id_bytes
+
+    def release(self, id_bytes: bytes, n: int) -> None:
+        entry = self.refs.get(id_bytes)
+        if entry is not None:
+            entry[1] -= n
+            if entry[1] <= 0:
+                del self.refs[id_bytes]
 
 
 class ClientServer:
@@ -90,12 +111,12 @@ class ClientServer:
 
         def resolve(kind: str, payload):
             if kind == "ref":
-                ref = st.refs.get(payload)
-                if ref is None:
+                entry = st.refs.get(payload)
+                if entry is None:
                     raise KeyError(
                         f"client referenced unknown object "
                         f"{payload.hex()[:16]} (already released?)")
-                return ref
+                return entry[0]
             if kind == "actor":
                 actor_id = payload[0]
                 handle = st.actors.get(actor_id)
@@ -110,11 +131,7 @@ class ClientServer:
         return self._resolver(st)("ref", id_bytes)
 
     def _book(self, st: _ConnState, refs) -> list:
-        ids = []
-        for r in refs:
-            st.refs[r.object_id.binary()] = r
-            ids.append(r.object_id.binary())
-        return ids
+        return [st.book(r) for r in refs]
 
     @staticmethod
     async def _offload(fn):
@@ -177,7 +194,7 @@ class ClientServer:
         def book(ref):
             # a returned value may CONTAIN ObjectRefs (nested remote
             # calls): book them so the client can use them later
-            st.refs.setdefault(ref.object_id.binary(), ref)
+            st.book(ref)
 
         def book_actor(handle):
             st.actors.setdefault(handle._actor_id, handle)
@@ -221,8 +238,8 @@ class ClientServer:
 
     async def handle_release(self, conn, header, bufs):
         st = self._state(conn)
-        for i in header["ids"]:
-            st.refs.pop(i, None)
+        for id_bytes, n in header["ids"]:
+            st.release(id_bytes, n)
         return {}
 
     async def handle_gcs(self, conn, header, bufs):

@@ -1,0 +1,122 @@
+"""multiprocessing.Pool API over cluster tasks.
+
+Parity target: ``ray.util.multiprocessing.Pool``
+(reference: python/ray/util/multiprocessing/pool.py) — drop-in Pool
+whose work units run as tasks, so a Pool program scales past one
+machine unchanged.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+_CHUNK = 16
+
+
+@ray_tpu.remote
+def _run_chunk(fn: Callable, chunk: List[Any], star: bool) -> List[Any]:
+    if star:
+        return [fn(*args) for args in chunk]
+    return [fn(a) for a in chunk]
+
+
+class AsyncResult:
+    def __init__(self, refs: List, single: bool = False):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        chunks = ray_tpu.get(self._refs, timeout=timeout)
+        out = [v for chunk in chunks for v in chunk]
+        return out[0] if self._single else out
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout)
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait(self._refs,
+                                num_returns=len(self._refs), timeout=0)
+        return len(ready) == len(self._refs)
+
+
+class Pool:
+    """Pool of cluster workers (processes come from the worker pool,
+    not from this object — ``processes`` only bounds chunking)."""
+
+    def __init__(self, processes: Optional[int] = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self._processes = processes or 0
+
+    def _chunks(self, iterable: Iterable, chunksize: Optional[int]):
+        items = list(iterable)
+        if chunksize is None:
+            if self._processes:
+                # spread the work ~4 chunks per "process" slot so
+                # stragglers rebalance (same heuristic as stdlib Pool)
+                chunksize = max(1, len(items) //
+                                (self._processes * 4) or 1)
+            else:
+                chunksize = _CHUNK
+        it = iter(items)
+        while True:
+            chunk = list(itertools.islice(it, chunksize))
+            if not chunk:
+                return
+            yield chunk
+
+    def _submit(self, fn, iterable, chunksize, star) -> AsyncResult:
+        refs = [_run_chunk.remote(fn, chunk, star)
+                for chunk in self._chunks(iterable, chunksize)]
+        return AsyncResult(refs)
+
+    def map(self, fn: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        return self._submit(fn, iterable, chunksize, star=False).get()
+
+    def map_async(self, fn, iterable, chunksize=None) -> AsyncResult:
+        return self._submit(fn, iterable, chunksize, star=False)
+
+    def starmap(self, fn: Callable, iterable: Iterable,
+                chunksize: Optional[int] = None) -> List[Any]:
+        return self._submit(fn, iterable, chunksize, star=True).get()
+
+    def apply(self, fn: Callable, args: tuple = (),
+              kwargs: Optional[dict] = None) -> Any:
+        return self.apply_async(fn, args, kwargs).get()
+
+    def apply_async(self, fn: Callable, args: tuple = (),
+                    kwargs: Optional[dict] = None) -> AsyncResult:
+        return AsyncResult([_apply.remote(fn, args, kwargs or {})],
+                           single=True)
+
+    def imap(self, fn: Callable, iterable: Iterable,
+             chunksize: Optional[int] = None):
+        refs = [_run_chunk.remote(fn, chunk, False)
+                for chunk in self._chunks(iterable, chunksize)]
+        for ref in refs:
+            yield from ray_tpu.get(ref)
+
+    def close(self) -> None:
+        pass
+
+    def join(self) -> None:
+        pass
+
+    def terminate(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+@ray_tpu.remote
+def _apply(fn: Callable, args: tuple, kwargs: dict) -> List[Any]:
+    return [fn(*args, **kwargs)]

@@ -1,0 +1,88 @@
+"""Events, check_serialize, multiprocessing shim.
+
+Mirrors the reference's event framework tests (src/ray/util/event*),
+test_serialization check_serialize coverage, and
+python/ray/tests/test_multiprocessing.py.
+"""
+
+import os
+import threading
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.events import EventEmitter, read_events
+from ray_tpu.util.check_serialize import inspect_serializability
+from ray_tpu.util.multiprocessing import Pool
+
+
+def test_event_emitter_roundtrip(tmp_path):
+    em = EventEmitter("testsrc", str(tmp_path))
+    em.emit("WARNING", "NODE_DIED", "node x died", node="x")
+    em.emit("INFO", "OK", "fine")
+    em.close()
+    events = read_events(str(tmp_path))
+    assert len(events) == 2
+    assert events[0]["severity"] == "WARNING"
+    assert events[0]["label"] == "NODE_DIED"
+    assert events[0]["custom_fields"] == {"node": "x"}
+    with pytest.raises(ValueError):
+        em.emit("LOUD", "X", "bad severity")
+
+
+def test_worker_death_emits_event():
+    os.environ["RAY_TPU_KEEP_SESSION_DIR"] = "1"
+    try:
+        info = ray_tpu.init(num_cpus=1)
+        session_dir = info["session_dir"]
+
+        @ray_tpu.remote(max_retries=0)
+        def die():
+            os._exit(1)
+
+        with pytest.raises(Exception):
+            ray_tpu.get(die.remote())
+        ray_tpu.shutdown()
+        events = read_events(os.path.join(session_dir, "logs"))
+        labels = [e["label"] for e in events]
+        assert "RAYLET_STARTED" in labels
+        assert "WORKER_DIED" in labels
+    finally:
+        os.environ.pop("RAY_TPU_KEEP_SESSION_DIR", None)
+
+
+def test_inspect_serializability():
+    ok, failures = inspect_serializability({"a": [1, 2, 3]})
+    assert ok and not failures
+
+    lock = threading.Lock()
+
+    def uses_lock():
+        return lock
+
+    ok, failures = inspect_serializability(uses_lock)
+    assert not ok
+    assert any("lock" in f.name for f in failures), failures
+
+
+@pytest.fixture
+def mp_cluster():
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_pool_map_starmap_apply(mp_cluster):
+    # lambdas/closures pickle by value — module-level test functions
+    # would be pickled by reference to a module workers can't import
+    sq = lambda x: x * x          # noqa: E731
+    addmul = lambda a, b: a + 10 * b  # noqa: E731
+    with Pool() as p:
+        assert p.map(sq, range(40)) == [x * x for x in range(40)]
+        assert p.starmap(addmul, [(1, 2), (3, 4)]) == [21, 43]
+        assert p.apply(addmul, (5, 6)) == 65
+        r = p.map_async(sq, range(10), chunksize=3)
+        r.wait(timeout=30)
+        assert r.ready()
+        assert r.get() == [x * x for x in range(10)]
+        assert list(p.imap(sq, range(7))) == [x * x for x in range(7)]
