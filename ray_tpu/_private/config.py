@@ -71,7 +71,11 @@ class RayTpuConfig:
 
     # --- liveness / fault tolerance ---
     raylet_heartbeat_period_ms: int = 250
-    num_heartbeats_timeout: int = 20
+    # 10s of silence marks a node dead (reference default ≈3s; wider
+    # here because an in-process head under full single-host task load
+    # can delay the heartbeat coroutine by seconds — GIL + loop
+    # occupancy — and a false node death kills the whole bench).
+    num_heartbeats_timeout: int = 40
     task_max_retries_default: int = 3
     actor_max_restarts_default: int = 0
     # Enable lineage-based reconstruction of lost shared-memory objects.

@@ -466,6 +466,8 @@ class GcsServer:
         entry = self.nodes.get(header["node_id"])
         if entry is not None:
             entry.resources_available = header["resources_available"]
+            # any raylet traffic proves liveness
+            entry.last_heartbeat = time.time()
         return {"ok": True}
 
     async def handle_get_all_node_info(self, conn, header, bufs):

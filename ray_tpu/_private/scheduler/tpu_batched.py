@@ -147,11 +147,51 @@ def _compiled_kernel(t_bucket: int, n_bucket: int, r_bucket: int):
 
 
 class TpuBatchedBackend(SchedulingBackend):
-    """Drop-in for HostBackend behind the scheduler seam."""
+    """Drop-in for HostBackend behind the scheduler seam.
+
+    XLA backend bring-up happens in a SIDE thread; until it completes,
+    ticks are served by the host backend (identical placements, only
+    the decision path differs). A wedged bring-up (e.g. a dead device
+    tunnel) therefore degrades the scheduler instead of blocking the
+    raylet's IO loop — leases are the cluster's heartbeat, and a
+    blocked loop also stalls heartbeats into false node deaths."""
 
     def __init__(self):
         import jax.numpy as jnp  # noqa: F401 — fail fast if jax is missing
+        import threading
+
+        from ray_tpu._private.scheduler.host_backend import HostBackend
+
         self._resource_names: List[str] = []
+        self._fallback = HostBackend()
+        self._kernel_ready = False
+        self._probe_done = threading.Event()
+
+        def probe():
+            try:
+                _kernel_device()
+                self._kernel_ready = True
+            except Exception:  # noqa: BLE001 — any init failure
+                pass
+            finally:
+                self._probe_done.set()
+                if not self._kernel_ready:
+                    import logging
+
+                    logging.getLogger(__name__).error(
+                        "tpu_batched kernel backend failed to "
+                        "initialize; staying on the host decision path")
+
+        threading.Thread(target=probe, daemon=True,
+                         name="rtpu-sched-probe").start()
+
+    def wait_ready(self, timeout_s: float = 60.0) -> bool:
+        """Block until the kernel backend is up (or declared bad).
+        Tests that differentially compare THIS backend's decisions
+        against the host oracle must call this first — otherwise they
+        compare the fallback against itself and prove nothing."""
+        self._probe_done.wait(timeout_s)
+        return self._kernel_ready
 
     def schedule(self, pending: List[PendingRequest],
                  nodes: List[NodeView],
@@ -160,6 +200,9 @@ class TpuBatchedBackend(SchedulingBackend):
 
         if not pending:
             return []
+        if not self._kernel_ready:
+            return self._fallback.schedule(pending, nodes,
+                                           spread_threshold)
         # Stable resource-kind interning across ticks (reference:
         # scheduling_ids.h string->int interning).
         kinds = list(self._resource_names)

@@ -7,7 +7,11 @@ Blocks are ObjectRefs to plain lists (rows) or numpy struct-dicts;
 ``to_jax``/``iter_batches`` feed device-ready arrays.
 """
 
-from ray_tpu.data.dataset import Dataset  # noqa: F401
+from ray_tpu.data.dataset import (  # noqa: F401
+    BlockMetadata,
+    Dataset,
+    GroupedDataset,
+)
 from ray_tpu.data.pipeline import DatasetPipeline  # noqa: F401
 from ray_tpu.data.read_api import (  # noqa: F401
     from_items,
@@ -17,6 +21,7 @@ from ray_tpu.data.read_api import (  # noqa: F401
     read_csv,
     read_json,
     read_numpy,
+    read_parquet,
     read_text,
 )
 

@@ -124,6 +124,7 @@ def _remote(fn, num_returns=1):
 class Dataset:
     def __init__(self, blocks: List):
         self._blocks = list(blocks)
+        self._meta = None  # cached List[BlockMetadata]
 
     # ------------------------------------------------------------ meta
 
@@ -131,10 +132,55 @@ class Dataset:
     def num_blocks(self) -> int:
         return len(self._blocks)
 
+    def _metadata(self):
+        """Per-block metadata, computed once (reference: BlockMetadata
+        tracked by data/block.py; here fetched via one task per block
+        and cached on the dataset)."""
+        if self._meta is None:
+            metas = ray_tpu.get([_remote(_block_meta).remote(b)
+                                 for b in self._blocks])
+            self._meta = [BlockMetadata(*m) for m in metas]
+        return self._meta
+
     def count(self) -> int:
-        return builtins.sum(
-            ray_tpu.get([_remote(_block_len).remote(b)
-                         for b in self._blocks]))
+        return builtins.sum(m.num_rows for m in self._metadata())
+
+    def size_bytes(self) -> int:
+        """Estimated in-memory size across blocks."""
+        return builtins.sum(m.size_bytes for m in self._metadata())
+
+    def schema(self):
+        """Schema of the first non-empty block (dict rows → {field:
+        type name}; scalar rows → type name)."""
+        for m in self._metadata():
+            if m.schema is not None:
+                return m.schema
+        return None
+
+    def groupby(self, key: Callable) -> "GroupedDataset":
+        return GroupedDataset(self, key)
+
+    # ------------------------------------------------------------ write
+
+    def write_parquet(self, dir_path: str) -> List[str]:
+        from ray_tpu.data import read_api
+
+        return read_api.write_parquet(self, dir_path)
+
+    def write_csv(self, dir_path: str) -> List[str]:
+        from ray_tpu.data import read_api
+
+        return read_api.write_csv(self, dir_path)
+
+    def write_json(self, dir_path: str) -> List[str]:
+        from ray_tpu.data import read_api
+
+        return read_api.write_json(self, dir_path)
+
+    def write_numpy(self, dir_path: str) -> List[str]:
+        from ray_tpu.data import read_api
+
+        return read_api.write_numpy(self, dir_path)
 
     def __repr__(self):
         return f"Dataset(num_blocks={self.num_blocks})"
@@ -327,3 +373,93 @@ class Dataset:
         from ray_tpu.data.pipeline import DatasetPipeline
 
         return DatasetPipeline([self] * times)
+
+
+# -------------------------------------------------------- block metadata
+
+class BlockMetadata:
+    """Per-block stats (reference: data/block.py BlockMetadata)."""
+
+    __slots__ = ("num_rows", "size_bytes", "schema")
+
+    def __init__(self, num_rows: int, size_bytes: int, schema):
+        self.num_rows = num_rows
+        self.size_bytes = size_bytes
+        self.schema = schema
+
+    def __repr__(self):
+        return (f"BlockMetadata(rows={self.num_rows}, "
+                f"bytes={self.size_bytes}, schema={self.schema})")
+
+
+def _block_meta(block):
+    import sys
+
+    if block and isinstance(block[0], dict):
+        schema = {k: type(v).__name__ for k, v in block[0].items()}
+    elif block:
+        schema = type(block[0]).__name__
+    else:
+        schema = None
+    size = builtins.sum(sys.getsizeof(r) for r in block[:64])
+    if len(block) > 64 and block:
+        size = int(size * len(block) / min(64, len(block)))
+    return [len(block), size, schema]
+
+
+def _block_group(key_fn, agg_fn, on, block):
+    # Partials NEVER apply the init seed: a key spanning blocks would
+    # absorb it once per block. The seed folds in exactly once, after
+    # the final merge (_group_dict_to_rows).
+    out = {}
+    for row in block:
+        k = key_fn(row)
+        v = on(row) if on else row
+        out[k] = agg_fn(out[k], v) if k in out else v
+    return out
+
+
+def _merge_group_dicts(agg_fn, *dicts):
+    out = {}
+    for d in dicts:
+        for k, v in d.items():
+            out[k] = agg_fn(out[k], v) if k in out else v
+    return out
+
+
+class GroupedDataset:
+    """``ds.groupby(key)`` → per-key aggregations (reference:
+    data/grouped_dataset.py). Hash-combine per block, tree-merge."""
+
+    def __init__(self, ds: "Dataset", key: Callable):
+        self._ds = ds
+        self._key = key
+
+    def aggregate(self, agg_fn: Callable, *, on: Optional[Callable] = None,
+                  init=None) -> "Dataset":
+        part = _remote(_block_group)
+        partials = [part.remote(self._key, agg_fn, on, b)
+                    for b in self._ds._blocks]
+        merge = _remote(_merge_group_dicts)
+        while len(partials) > 1:  # tree reduce
+            nxt = []
+            for i in builtins.range(0, len(partials), 4):
+                group = partials[i:i + 4]
+                nxt.append(merge.remote(agg_fn, *group)
+                           if len(group) > 1 else group[0])
+            partials = nxt
+        items = _remote(_group_dict_to_rows).remote(
+            partials[0], agg_fn, init)
+        return Dataset([items])
+
+    def count(self) -> "Dataset":
+        return self.aggregate(lambda a, b: a + b, on=lambda _: 1)
+
+    def sum(self, on: Optional[Callable] = None) -> "Dataset":
+        return self.aggregate(lambda a, b: a + b, on=on)
+
+
+def _group_dict_to_rows(d, agg_fn=None, init=None):
+    if init is not None:
+        d = {k: agg_fn(init, v) for k, v in d.items()}
+    return sorted(d.items())

@@ -115,3 +115,78 @@ def read_text(paths) -> Dataset:
 
 def read_binary_files(paths) -> Dataset:
     return _read(paths, _read_binary_file)
+
+
+# ------------------------------------------------------- parquet (arrow)
+
+def _read_parquet_file(path, columns=None):
+    import pyarrow.parquet as pq
+
+    table = pq.read_table(path, columns=columns)
+    return table.to_pylist()  # rows as dicts, consistent with read_csv
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
+    """One read task per file (reference: data/datasource/
+    parquet_datasource.py over pyarrow)."""
+    r = _remote(_read_parquet_file)
+    return Dataset([r.remote(p, columns) for p in _expand(paths)])
+
+
+# ---------------------------------------------------------- write APIs
+
+def _write_block(path, fmt, block):
+    import os
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if fmt == "parquet":
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        pq.write_table(pa.Table.from_pylist(list(block)), path)
+    elif fmt == "csv":
+        rows = list(block)
+        with open(path, "w", newline="") as f:
+            if rows and isinstance(rows[0], dict):
+                w = _csv.DictWriter(f, fieldnames=list(rows[0]))
+                w.writeheader()
+                w.writerows(rows)
+            else:
+                # scalar rows get a "value" header so read_csv
+                # (DictReader) round-trips as {"value": ...} rows
+                # instead of eating the first row as field names
+                w = _csv.writer(f)
+                w.writerow(["value"])
+                w.writerows([[r] for r in rows])
+    elif fmt == "json":
+        with open(path, "w") as f:
+            for r in block:
+                f.write(_json.dumps(r) + "\n")
+    elif fmt == "numpy":
+        np.save(path, np.asarray(list(block)))
+    else:
+        raise ValueError(f"unknown write format {fmt!r}")
+    return path
+
+
+def _write(ds: Dataset, dir_path: str, fmt: str, ext: str) -> List[str]:
+    w = _remote(_write_block)
+    return ray_tpu.get([
+        w.remote(f"{dir_path}/block_{i:05d}.{ext}", fmt, b)
+        for i, b in enumerate(ds._blocks)])
+
+
+def write_parquet(ds: Dataset, dir_path: str) -> List[str]:
+    return _write(ds, dir_path, "parquet", "parquet")
+
+
+def write_csv(ds: Dataset, dir_path: str) -> List[str]:
+    return _write(ds, dir_path, "csv", "csv")
+
+
+def write_json(ds: Dataset, dir_path: str) -> List[str]:
+    return _write(ds, dir_path, "json", "json")
+
+
+def write_numpy(ds: Dataset, dir_path: str) -> List[str]:
+    return _write(ds, dir_path, "numpy", "npy")

@@ -64,7 +64,7 @@ class DataStream:
         return the terminal stage's output (the last stage becomes a
         sink when none was declared)."""
         stages = list(self._stages)
-        if stages[-1].kind != "sink":
+        if not stages or stages[-1].kind != "sink":
             stages.append(_Stage("sink", None))
         return self._ctx._run(stages, checkpoint_every)
 

@@ -6,9 +6,9 @@ credit-based flow_control.h, barrier/checkpoint reliability
 reliability/barrier_helper.h, transport over direct actor calls in
 streaming/src/queue/). Re-design: each operator is an async actor;
 records flow downstream as batched actor calls; the receiver admits at
-most ``capacity`` in-flight records per input channel and the sender
-BLOCKS when its credit window is exhausted (credit returns ride the
-push replies). Barriers flow in-band: an operator aligns barriers from
+most ``capacity`` in-flight records per input channel and withholds
+the push REPLY while full — the sender awaits it, so the blocked reply
+is the credit window. Barriers flow in-band: an operator aligns barriers from
 all inputs, snapshots its state, and forwards the barrier downstream
 (Chandy-Lamport style, the public pattern the reference implements).
 """
@@ -61,13 +61,12 @@ class StreamOperator:
 
     # ---- data plane ----
 
-    async def push(self, records: List[Any]) -> int:
-        """Receive a batch from upstream. Returns the remaining credit
-        AFTER admitting this batch (the sender's new window). Blocks —
-        i.e. delays the reply, which IS the backpressure — while the
-        operator is over capacity. A single consumer task processes
-        admitted batches strictly in arrival order (records and
-        barriers must not reorder)."""
+    async def push(self, records: List[Any]) -> None:
+        """Receive a batch from upstream. The reply is DELAYED while
+        the operator is over capacity — that blocked reply IS the
+        backpressure (the sender awaits it before sending more). A
+        single consumer task processes admitted batches strictly in
+        arrival order (records and barriers must not reorder)."""
         if self._consumer is None:
             self._queue = asyncio.Queue()
             self._consumer = asyncio.get_running_loop().create_task(
@@ -77,7 +76,6 @@ class StreamOperator:
                 lambda: self._inflight < self.capacity)
             self._inflight += len(records)
         self._queue.put_nowait(records)
-        return max(0, self.capacity - self._inflight)
 
     async def _consume_loop(self) -> None:
         while True:
@@ -132,11 +130,9 @@ class StreamOperator:
         raise ValueError(f"unknown op kind {self.op_kind!r}")
 
     async def _send(self, records: List[Any]) -> None:
-        credit = await self.downstream.push.remote(records)
-        # Credit window: if the receiver reports no space, the next
-        # push's reply will simply block — nothing else to do here;
-        # the await above already paced us to the receiver.
-        del credit
+        # the await paces this operator to the receiver's admission
+        # rate (the reply is withheld while the receiver is full)
+        await self.downstream.push.remote(records)
 
     async def _handle_control(self, rec) -> None:
         if isinstance(rec, Eos):

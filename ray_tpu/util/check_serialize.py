@@ -37,7 +37,12 @@ def _serializable(obj: Any) -> bool:
 
 def _walk(obj: Any, name: str, parent: Any, failures: list,
           seen: Set[int], depth: int) -> None:
-    if id(obj) in seen or depth > 4:
+    if id(obj) in seen:
+        return
+    if depth > 4:
+        # too deep to keep walking — still record THIS node so the
+        # caller always gets at least one named failure
+        failures.append(FailureTuple(obj, name, parent))
         return
     seen.add(id(obj))
     if _serializable(obj):

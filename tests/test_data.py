@@ -115,3 +115,77 @@ def test_pipeline_window_repeat(ray_start_4cpu):
     assert sorted(pipe.take(100)) == [x + 100 for x in range(8)]
     rep = ds.repeat(2)
     assert rep.count() == 16
+
+
+def test_block_metadata_and_schema(ray_start_regular):
+    from ray_tpu import data
+
+    ds = data.from_items([{"a": 1, "b": "x"}] * 30, parallelism=3)
+    assert ds.count() == 30
+    assert ds.schema() == {"a": "int", "b": "str"}
+    assert ds.size_bytes() > 0
+    # scalar schema
+    assert data.range(10).schema() == "int"
+
+
+def test_groupby_aggregate(ray_start_regular):
+    from ray_tpu import data
+
+    rows = [{"k": i % 3, "v": i} for i in range(30)]
+    ds = data.from_items(rows, parallelism=4)
+    sums = dict(ds.groupby(lambda r: r["k"]).sum(
+        on=lambda r: r["v"]).take_all())
+    want = {}
+    for r in rows:
+        want[r["k"]] = want.get(r["k"], 0) + r["v"]
+    assert sums == want
+    counts = dict(ds.groupby(lambda r: r["k"]).count().take_all())
+    assert counts == {0: 10, 1: 10, 2: 10}
+
+
+def test_parquet_roundtrip(ray_start_regular, tmp_path):
+    from ray_tpu import data
+
+    rows = [{"x": i, "name": f"r{i}"} for i in range(50)]
+    ds = data.from_items(rows, parallelism=4)
+    files = ds.write_parquet(str(tmp_path / "pq"))
+    assert len(files) == 4
+    back = data.read_parquet(str(tmp_path / "pq" / "*.parquet"))
+    assert sorted(back.take_all(), key=lambda r: r["x"]) == rows
+    # column pruning
+    cols = data.read_parquet(str(tmp_path / "pq" / "*.parquet"),
+                             columns=["x"]).take_all()
+    assert all(set(r) == {"x"} for r in cols)
+
+
+def test_csv_json_write_roundtrip(ray_start_regular, tmp_path):
+    from ray_tpu import data
+
+    rows = [{"x": str(i)} for i in range(20)]
+    ds = data.from_items(rows, parallelism=2)
+    ds.write_csv(str(tmp_path / "csv"))
+    assert sorted(data.read_csv(str(tmp_path / "csv" / "*.csv"))
+                  .take_all(), key=lambda r: int(r["x"])) == rows
+    ds.write_json(str(tmp_path / "json"))
+    assert sorted(data.read_json(str(tmp_path / "json" / "*.json"))
+                  .take_all(), key=lambda r: int(r["x"])) == rows
+
+
+def test_groupby_aggregate_with_init(ray_start_regular):
+    from ray_tpu import data
+
+    # the init seed must fold in exactly ONCE per key even when a
+    # key's rows span every block
+    rows = [{"k": 0, "v": 1}] * 10
+    ds = data.from_items(rows, parallelism=4)
+    out = dict(ds.groupby(lambda r: r["k"]).aggregate(
+        lambda a, b: a + b, on=lambda r: r["v"], init=100).take_all())
+    assert out == {0: 110}
+
+
+def test_csv_scalar_roundtrip(ray_start_regular, tmp_path):
+    from ray_tpu import data
+
+    data.range(10, parallelism=2).write_csv(str(tmp_path / "s"))
+    back = data.read_csv(str(tmp_path / "s" / "*.csv")).take_all()
+    assert sorted(int(r["value"]) for r in back) == list(range(10))

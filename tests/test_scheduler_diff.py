@@ -44,13 +44,21 @@ def _random_state(rng, num_tasks, num_nodes, kinds=("CPU", "MEM", "TPU")):
     return pending, nodes
 
 
+def _ready_tpu_backend():
+    backend = TpuBatchedBackend()
+    assert backend.wait_ready(), "kernel backend failed to init"
+    return backend
+
+
 @pytest.mark.parametrize("seed", range(8))
 def test_backends_agree(seed):
     rng = random.Random(seed)
     pending, nodes = _random_state(
         rng, num_tasks=rng.randint(1, 40), num_nodes=rng.randint(1, 6))
     host = HostBackend().schedule(pending, nodes, 0.5)
-    tpu = TpuBatchedBackend().schedule(pending, nodes, 0.5)
+    tpu_backend = TpuBatchedBackend()
+    assert tpu_backend.wait_ready(), "kernel backend failed to init"
+    tpu = tpu_backend.schedule(pending, nodes, 0.5)
     assert len(host) == len(tpu)
     for h, t in zip(host, tpu):
         assert (h.req_id, h.action, h.spill_address) == \
@@ -66,7 +74,7 @@ def test_infeasible_and_wait():
         PendingRequest(req_id=1, scheduling_class=0, resources={"CPU": 64.0}),
         PendingRequest(req_id=2, scheduling_class=0, resources={"CPU": 1.0}),
     ]
-    for backend in (HostBackend(), TpuBatchedBackend()):
+    for backend in (HostBackend(), _ready_tpu_backend()):
         d = backend.schedule(pending, nodes, 0.5)
         assert d[0].action == "infeasible"
         assert d[1].action == "wait"
@@ -81,7 +89,7 @@ def test_spillback_when_local_full():
     ]
     pending = [PendingRequest(req_id=1, scheduling_class=0,
                               resources={"CPU": 1.0})]
-    for backend in (HostBackend(), TpuBatchedBackend()):
+    for backend in (HostBackend(), _ready_tpu_backend()):
         d = backend.schedule(pending, nodes, 0.5)
         assert d[0].action == "spill"
         assert d[0].spill_address == "tcp://b"
@@ -99,7 +107,7 @@ def test_deps_pending_gates_local_grant_only():
     # local under threshold -> local wins -> gated on deps
     gated = [PendingRequest(req_id=1, scheduling_class=0,
                             resources={"CPU": 1.0}, deps_ready=False)]
-    for backend in (HostBackend(), TpuBatchedBackend()):
+    for backend in (HostBackend(), _ready_tpu_backend()):
         d = backend.schedule(gated, nodes, 1.0)
         assert d[0].action == "wait"
     # local saturated -> spill target wins -> not gated
@@ -107,7 +115,7 @@ def test_deps_pending_gates_local_grant_only():
     spills = [PendingRequest(req_id=2, scheduling_class=0,
                              resources={"CPU": 1.0}, deps_ready=False,
                              locality={b"b" * 28: 10_000_000})]
-    for backend in (HostBackend(), TpuBatchedBackend()):
+    for backend in (HostBackend(), _ready_tpu_backend()):
         d = backend.schedule(spills, nodes, 0.5)
         assert d[0].action == "spill" and d[0].spill_address == "tcp://b"
 
@@ -126,7 +134,7 @@ def test_locality_breaks_tie_between_remote_nodes():
     pending = [PendingRequest(req_id=1, scheduling_class=0,
                               resources={"CPU": 1.0},
                               locality={b"c" * 28: 50_000_000})]
-    for backend in (HostBackend(), TpuBatchedBackend()):
+    for backend in (HostBackend(), _ready_tpu_backend()):
         d = backend.schedule(pending, nodes, 0.5)
         assert d[0].action == "spill"
         assert d[0].spill_address == "tcp://c", type(backend).__name__
@@ -139,6 +147,6 @@ def test_sequential_consumption_within_tick():
                       is_local=True)]
     pending = [PendingRequest(req_id=i, scheduling_class=0,
                               resources={"CPU": 1.0}) for i in range(1, 4)]
-    for backend in (HostBackend(), TpuBatchedBackend()):
+    for backend in (HostBackend(), _ready_tpu_backend()):
         d = backend.schedule(pending, nodes, 1.0)
         assert [x.action for x in d] == ["grant", "grant", "wait"]

@@ -97,3 +97,8 @@ def test_barrier_snapshots_consistent(stream_cluster):
     # sink saw the barriers too (forwarded downstream)
     sink_stats = ray_tpu.get(ctx.operators[-1].stats.remote())
     assert sink_stats["snapshots"] == [1, 2]
+
+
+def test_empty_pipeline_passthrough(stream_cluster):
+    ctx = streaming.StreamingContext()
+    assert sorted(ctx.from_collection([3, 1, 2]).execute()) == [1, 2, 3]

@@ -42,9 +42,18 @@ def test_worker_death_emits_event():
 
         with pytest.raises(Exception):
             ray_tpu.get(die.remote())
+        # the WORKER_DIED emit races the error reply: poll the event
+        # file before tearing the cluster down
+        import time
+        labels = []
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            labels = [e["label"] for e in
+                      read_events(os.path.join(session_dir, "logs"))]
+            if "WORKER_DIED" in labels:
+                break
+            time.sleep(0.2)
         ray_tpu.shutdown()
-        events = read_events(os.path.join(session_dir, "logs"))
-        labels = [e["label"] for e in events]
         assert "RAYLET_STARTED" in labels
         assert "WORKER_DIED" in labels
     finally:
