@@ -1134,7 +1134,8 @@ class CoreWorker:
                      is_asyncio: bool = False,
                      placement_group_id: bytes = b"",
                      placement_group_bundle_index: int = -1,
-                     max_pending_calls: int = -1) -> bytes:
+                     max_pending_calls: int = -1,
+                     runtime_env: Dict | None = None) -> bytes:
         actor_id = ActorID.of(JobID(self.job_id)).binary()
         prepared_args, arg_holds = self._prepare_args(args)
         spec = TaskSpec(
@@ -1143,7 +1144,7 @@ class CoreWorker:
             args=prepared_args, num_returns=0,
             resources=resources or {"CPU": 1.0},
             owner_address=self.address, owner_worker_id=self.worker_id,
-            actor_id=actor_id,
+            actor_id=actor_id, runtime_env=runtime_env,
             actor_creation={"max_restarts": max_restarts,
                             "max_concurrency": max_concurrency,
                             "is_asyncio": is_asyncio,

@@ -364,7 +364,19 @@ class Raylet:
             log_dir, f"worker-{worker_id.hex()[:12]}.log"), "ab")
         env = dict(os.environ)
         env["RAY_TPU_WORKER_ID"] = worker_id.hex()
-        env.setdefault("JAX_PLATFORMS", env.get("RAY_TPU_WORKER_JAX_PLATFORMS", "cpu"))
+        # Workers default to CPU jax (RAY_TPU_WORKER_JAX_PLATFORMS="",
+        # i.e. empty, keeps the inherited platform for TPU workers).
+        # This must OVERRIDE any inherited JAX_PLATFORMS — and when the
+        # worker is CPU-only, also drop the device-plugin trigger env
+        # so a wedged TPU transport can never hang worker startup
+        # (observed: device-backend bring-up blocking indefinitely,
+        # which turns into actor-resolve timeouts).
+        worker_platforms = env.get("RAY_TPU_WORKER_JAX_PLATFORMS", "cpu")
+        if worker_platforms:
+            env["JAX_PLATFORMS"] = worker_platforms
+            if "tpu" not in worker_platforms and \
+                    "axon" not in worker_platforms:
+                env.pop("PALLAS_AXON_POOL_IPS", None)
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_main",
              "--raylet-address", self.address,

@@ -14,8 +14,10 @@ and the reply carries only the location.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import inspect
 import logging
+import os
 import queue as queue_mod
 import threading
 import traceback
@@ -35,6 +37,46 @@ from ray_tpu._private.task_spec import ARG_REF, ARG_VALUE, TaskSpec
 logger = logging.getLogger(__name__)
 
 _task_ctx = threading.local()
+
+_SUPPORTED_RUNTIME_ENV_KEYS = {"env_vars"}
+
+
+def _validate_runtime_env(runtime_env: dict) -> dict:
+    """env_vars is the supported field (reference: runtime envs
+    validated in _private/runtime_env/validation.py; conda/pip/
+    working_dir need a package-distribution plane this build doesn't
+    have — fail fast rather than silently ignore)."""
+    unknown = set(runtime_env) - _SUPPORTED_RUNTIME_ENV_KEYS
+    if unknown:
+        raise ValueError(
+            f"unsupported runtime_env keys {sorted(unknown)}; "
+            f"supported: {sorted(_SUPPORTED_RUNTIME_ENV_KEYS)}")
+    return {str(k): str(v)
+            for k, v in (runtime_env.get("env_vars") or {}).items()}
+
+
+@contextlib.contextmanager
+def _runtime_env_ctx(runtime_env):
+    """Apply a task's env_vars around its execution, then restore."""
+    if not runtime_env:
+        yield
+        return
+    env_vars = _validate_runtime_env(runtime_env)
+    saved = {k: os.environ.get(k) for k in env_vars}
+    os.environ.update(env_vars)
+    try:
+        yield
+    finally:
+        for k, old in saved.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+
+
+def _apply_runtime_env_persistent(runtime_env):
+    if runtime_env:
+        os.environ.update(_validate_runtime_env(runtime_env))
 
 
 def current_task_id() -> bytes:
@@ -209,7 +251,8 @@ class TaskExecutor:
             fn = self.core.function_manager.fetch(spec.fn_key)
             args, kwargs = self._resolve_args(spec)
             t0 = _now()
-            result = fn(*args, **kwargs)
+            with _runtime_env_ctx(spec.runtime_env):
+                result = fn(*args, **kwargs)
             self.core.add_task_event({
                 "event": "task:execute", "name": spec.name,
                 "task_id": spec.task_id.hex(), "start": t0, "end": _now(),
@@ -355,6 +398,11 @@ class TaskExecutor:
         if not self.core.job_id and spec.job_id:
             self.core.job_id = spec.job_id  # see _execute_task_sync
         try:
+            # Actor runtime envs persist for the actor's lifetime —
+            # this worker process is dedicated to the actor
+            # (reference: runtime envs realized at worker setup,
+            # workers/setup_worker.py).
+            _apply_runtime_env_persistent(spec.runtime_env)
             cls = self.core.function_manager.fetch(spec.fn_key)
             args, kwargs = self._resolve_args(spec)
             return cls(*args, **kwargs)

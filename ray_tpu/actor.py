@@ -191,6 +191,7 @@ class ActorClass:
             resources=self._resource_demand(),
             lifetime_resources=self._lifetime_resources(),
             is_asyncio=self._is_asyncio,
+            runtime_env=self._runtime_env,
             placement_group_id=pg.id.binary() if pg is not None else b"",
             placement_group_bundle_index=self._placement_group_bundle_index,
             max_pending_calls=self._max_pending_calls)

@@ -93,10 +93,6 @@ def _zip_blocks(a, b):
     return list(zip(a, b))
 
 
-def _block_len(block):
-    return len(block)
-
-
 def _block_agg(agg, on, block):
     vals = [on(r) if on else r for r in block]
     if not vals:
@@ -402,8 +398,8 @@ def _block_meta(block):
     else:
         schema = None
     size = builtins.sum(sys.getsizeof(r) for r in block[:64])
-    if len(block) > 64 and block:
-        size = int(size * len(block) / min(64, len(block)))
+    if len(block) > 64:  # extrapolate from the sampled prefix
+        size = int(size * len(block) / 64)
     return [len(block), size, schema]
 
 

@@ -1,0 +1,155 @@
+"""Training backends: per-framework worker-group setup.
+
+Parity target: the reference's sgd v2 backend abstraction
+(reference: python/ray/util/sgd/v2/backends/{backend.py,torch.py,
+tensorflow.py} — BackendConfig + on_start/on_shutdown hooks that wire
+each framework's process group over the worker actors).
+
+* ``HostBackend`` — no extra wiring; the object-store collective group
+  from trainer start() is the communication fabric.
+* ``TorchBackend`` — initializes ``torch.distributed`` (gloo) across
+  the worker actors: rank 0's host opens a TCP store, every worker
+  joins; user train functions can use dist.all_reduce etc.
+* ``JaxBackend`` — exports the multi-process JAX env
+  (coordinator/process count/process id) on every worker so a train
+  function may call ``jax.distributed.initialize()``; on real
+  multi-host TPU slices those processes ride ICI via XLA collectives.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Optional
+
+import ray_tpu
+
+
+class Backend:
+    def on_start(self, worker_group, num_workers: int) -> None:
+        pass
+
+    def on_shutdown(self, worker_group) -> None:
+        pass
+
+
+class HostBackend(Backend):
+    pass
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _rank0_rendezvous(state):
+    """Runs ON rank 0's worker: its node's IP + a free port there —
+    the rendezvous must live where rank 0 lives, not on the driver
+    (workers may be on other nodes). Free-port probing is inherently
+    racy; init_process_group retries/fails loudly if the port is
+    stolen between probe and bind."""
+    import socket as sock
+
+    with sock.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+    try:
+        ip = sock.gethostbyname(sock.gethostname())
+    except OSError:
+        ip = "127.0.0.1"
+    return ip, port
+
+
+def _torch_init(state, rank, world_size, addr, port):
+    import datetime
+    import os
+
+    import torch.distributed as dist
+
+    os.environ["MASTER_ADDR"] = addr
+    os.environ["MASTER_PORT"] = str(port)
+    dist.init_process_group(
+        backend="gloo", rank=rank, world_size=world_size,
+        timeout=datetime.timedelta(seconds=60))
+    state["torch_distributed"] = True
+    return rank
+
+
+def _torch_shutdown(state):
+    import torch.distributed as dist
+
+    if state.pop("torch_distributed", None) and dist.is_initialized():
+        dist.destroy_process_group()
+
+
+class TorchBackend(Backend):
+    def __init__(self, master_addr: Optional[str] = None,
+                 master_port: Optional[int] = None):
+        self.master_addr = master_addr
+        self.master_port = master_port
+
+    def on_start(self, worker_group, num_workers: int) -> None:
+        addr, port = self.master_addr, self.master_port
+        if addr is None or port is None:
+            r_addr, r_port = ray_tpu.get(
+                worker_group.workers[0].execute_with_state.remote(
+                    _rank0_rendezvous))
+            addr, port = addr or r_addr, port or r_port
+        ray_tpu.get([
+            w.execute_with_state.remote(
+                _torch_init, rank, num_workers, addr, port)
+            for rank, w in enumerate(worker_group.workers)])
+
+    def on_shutdown(self, worker_group) -> None:
+        try:
+            ray_tpu.get([w.execute_with_state.remote(_torch_shutdown)
+                         for w in worker_group.workers])
+        except Exception:  # noqa: BLE001 — workers may already be dead
+            pass
+
+
+def _jax_env_init(state, rank, world_size, coordinator):
+    import os
+
+    os.environ["JAX_COORDINATOR_ADDRESS"] = coordinator
+    os.environ["JAX_NUM_PROCESSES"] = str(world_size)
+    os.environ["JAX_PROCESS_ID"] = str(rank)
+    state["jax_distributed_env"] = True
+    return rank
+
+
+class JaxBackend(Backend):
+    """Exports the jax.distributed env; the train function decides
+    when (and whether) to call ``jax.distributed.initialize()`` —
+    initializing eagerly would pin the backend choice before user code
+    can configure platforms."""
+
+    def __init__(self, coordinator_address: Optional[str] = None):
+        self.coordinator_address = coordinator_address
+
+    def on_start(self, worker_group, num_workers: int) -> None:
+        coordinator = self.coordinator_address
+        if coordinator is None:
+            ip, port = ray_tpu.get(
+                worker_group.workers[0].execute_with_state.remote(
+                    _rank0_rendezvous))
+            coordinator = f"{ip}:{port}"
+        ray_tpu.get([
+            w.execute_with_state.remote(_jax_env_init, rank,
+                                        num_workers, coordinator)
+            for rank, w in enumerate(worker_group.workers)])
+
+
+_BACKENDS = {"host": HostBackend, "torch": TorchBackend,
+             "jax": JaxBackend}
+
+
+def make_train_backend(backend) -> Backend:
+    if isinstance(backend, Backend):
+        return backend
+    try:
+        return _BACKENDS[backend]()
+    except KeyError:
+        raise ValueError(
+            f"unknown train backend {backend!r}; "
+            f"one of {sorted(_BACKENDS)}") from None

@@ -156,6 +156,11 @@ class Trainer:
                 self._queue, self._ckpt_dir)
             for r in range(self._num_workers)]
         ray_tpu.get(futs)
+        # framework wiring (torch process group / jax distributed env)
+        from ray_tpu.train.backends import make_train_backend
+
+        self._backend_impl = make_train_backend(self._backend)
+        self._backend_impl.on_start(self._wg, self._num_workers)
 
     def run(self, train_func: Callable, config: Optional[dict] = None,
             callbacks: Optional[List[TrainingCallback]] = None
@@ -234,6 +239,9 @@ class Trainer:
     def shutdown(self) -> None:
         if self._wg is not None:
             from ray_tpu.util.collective import destroy_collective_group
+
+            if getattr(self, "_backend_impl", None) is not None:
+                self._backend_impl.on_shutdown(self._wg)
 
             # Each rank leaves the group BEFORE its actor dies — the
             # coordinator's membership refcount must reach zero or the

@@ -39,12 +39,13 @@ def _walk(obj: Any, name: str, parent: Any, failures: list,
           seen: Set[int], depth: int) -> None:
     if id(obj) in seen:
         return
+    seen.add(id(obj))
     if depth > 4:
         # too deep to keep walking — still record THIS node so the
-        # caller always gets at least one named failure
+        # caller always gets at least one named failure (seen-marked
+        # above: a shared deep object reports once, not once per path)
         failures.append(FailureTuple(obj, name, parent))
         return
-    seen.add(id(obj))
     if _serializable(obj):
         return
 

@@ -144,6 +144,7 @@ def test_groupby_aggregate(ray_start_regular):
 
 
 def test_parquet_roundtrip(ray_start_regular, tmp_path):
+    pytest.importorskip("pyarrow")
     from ray_tpu import data
 
     rows = [{"x": i, "name": f"r{i}"} for i in range(50)]

@@ -95,3 +95,55 @@ def test_pool_map_starmap_apply(mp_cluster):
         assert r.ready()
         assert r.get() == [x * x for x in range(10)]
         assert list(p.imap(sq, range(7))) == [x * x for x in range(7)]
+
+
+def test_runtime_env_env_vars(mp_cluster):
+    @ray_tpu.remote(runtime_env={"env_vars": {"MY_FLAG": "42"}})
+    def read_flag():
+        return os.environ.get("MY_FLAG"), os.environ.get("OTHER")
+
+    @ray_tpu.remote
+    def read_plain():
+        return os.environ.get("MY_FLAG")
+
+    assert ray_tpu.get(read_flag.remote()) == ("42", None)
+    # env restored between tasks on the same worker
+    assert ray_tpu.get(read_plain.remote()) is None
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"ACTOR_FLAG": "yes"}})
+    class EnvActor:
+        def flag(self):
+            return os.environ.get("ACTOR_FLAG")
+
+    a = EnvActor.remote()
+    assert ray_tpu.get(a.flag.remote()) == "yes"  # persists per actor
+
+    @ray_tpu.remote(runtime_env={"conda": "env"})
+    def bad():
+        return 1
+
+    with pytest.raises(Exception, match="unsupported runtime_env"):
+        ray_tpu.get(bad.remote())
+
+
+def test_torch_train_backend():
+    pytest.importorskip("torch")
+
+    ray_tpu.init(num_cpus=2)
+    try:
+        from ray_tpu.train import Trainer
+
+        def train_fn(config=None):
+            import torch
+            import torch.distributed as dist
+
+            t = torch.ones(2) * (dist.get_rank() + 1)
+            dist.all_reduce(t)  # 1+2 = 3 per element
+            return t.tolist()
+
+        trainer = Trainer(backend="torch", num_workers=2)
+        results = trainer.run(train_fn)
+        trainer.shutdown()
+        assert results == [[3.0, 3.0], [3.0, 3.0]]
+    finally:
+        ray_tpu.shutdown()
