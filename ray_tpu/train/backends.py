@@ -18,7 +18,6 @@ each framework's process group over the worker actors).
 
 from __future__ import annotations
 
-import socket
 from typing import Optional
 
 import ray_tpu
@@ -36,12 +35,6 @@ class HostBackend(Backend):
     pass
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
 def _rank0_rendezvous(state):
     """Runs ON rank 0's worker: its node's IP + a free port there —
     the rendezvous must live where rank 0 lives, not on the driver
@@ -53,8 +46,14 @@ def _rank0_rendezvous(state):
     with sock.socket() as s:
         s.bind(("", 0))
         port = s.getsockname()[1]
+    # The UDP-connect trick picks the interface that routes outward —
+    # gethostbyname(gethostname()) returns 127.0.1.1 on hosts with the
+    # common Debian-style /etc/hosts entry, which would point every
+    # remote rank at its own loopback.
     try:
-        ip = sock.gethostbyname(sock.gethostname())
+        with sock.socket(sock.AF_INET, sock.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 80))  # no packet is sent
+            ip = s.getsockname()[0]
     except OSError:
         ip = "127.0.0.1"
     return ip, port
@@ -68,9 +67,12 @@ def _torch_init(state, rank, world_size, addr, port):
 
     os.environ["MASTER_ADDR"] = addr
     os.environ["MASTER_PORT"] = str(port)
+    # This timeout governs EVERY later collective on the group, not
+    # just rendezvous — keep torch's generous default order (a slow
+    # step with >60s between all_reduces must not abort training).
     dist.init_process_group(
         backend="gloo", rank=rank, world_size=world_size,
-        timeout=datetime.timedelta(seconds=60))
+        timeout=datetime.timedelta(minutes=30))
     state["torch_distributed"] = True
     return rank
 

@@ -6,11 +6,12 @@ runs a user ``train_func(config)`` on N worker actors;
 (reference: sgd/v2/session.py); checkpoints save/load through the
 driver-visible filesystem.
 
-TPU-native stance: the reference's torch backend wires up DDP + c10d
-(reference: util/sgd/torch/distributed_torch_runner.py). Here the
-"backend" is a host collective group (``ray_tpu.util.collective``) for
-gradient/param sync of host arrays, while per-worker device math is
-JAX; single-process multi-device DP should instead use
+Backends (see ``backends.py``): the default ``host`` backend syncs
+host arrays through a ``ray_tpu.util.collective`` group; ``torch``
+wires a real ``torch.distributed`` gloo process group across the
+worker actors (reference: util/sgd/torch/distributed_torch_runner.py);
+``jax`` exports the ``jax.distributed`` coordinator env per worker.
+Single-process multi-device DP should instead use
 ``ray_tpu.parallel`` shardings directly.
 """
 

@@ -110,6 +110,11 @@ def test_end_to_end_elasticity():
             time.sleep(0.2)
         assert provider.non_terminated_nodes(), \
             "autoscaler never launched a worker node"
+        # launched != registered: the worker-node process takes a few
+        # seconds to boot its raylet and join the GCS
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and len(ray_tpu.nodes()) < 2:
+            time.sleep(0.2)
         assert len(ray_tpu.nodes()) >= 2
     finally:
         if monitor:

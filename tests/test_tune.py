@@ -74,9 +74,14 @@ def test_asha_early_stopping(ray_start_4cpu, tmp_path):
     max_t = 16
     sched = AsyncHyperBandScheduler(grace_period=2, max_t=max_t,
                                     reduction_factor=2)
+    # Descending slopes: the runner polls trials in creation order, so
+    # each rung's strong results land before the weak ones — the
+    # arrival order async-halving is DESIGNED to cut on. (Ascending
+    # order is ASHA's known worst case: every arrival beats the
+    # median-so-far and nothing ever stops.)
     analysis = tune.run(
         make_slope_trainable(),
-        config={"slope": tune.grid_search([0.1, 0.2, 0.4, 0.8, 1.2, 2.0])},
+        config={"slope": tune.grid_search([2.0, 1.2, 0.8, 0.4, 0.2, 0.1])},
         metric="score", mode="max", scheduler=sched,
         stop={"training_iteration": max_t},
         local_dir=str(tmp_path), max_concurrent_trials=4)
@@ -89,9 +94,10 @@ def test_asha_early_stopping(ray_start_4cpu, tmp_path):
 
 def test_median_stopping(ray_start_4cpu, tmp_path):
     sched = MedianStoppingRule(grace_period=2, min_samples_required=3)
+    # weak trial last: it reports after the three medians it must lose to
     analysis = tune.run(
         make_slope_trainable(),
-        config={"slope": tune.grid_search([0.1, 1.0, 1.0, 1.0])},
+        config={"slope": tune.grid_search([1.0, 1.0, 1.0, 0.1])},
         metric="score", mode="max", scheduler=sched,
         stop={"training_iteration": 10},
         local_dir=str(tmp_path), max_concurrent_trials=4)
