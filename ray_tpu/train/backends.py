@@ -55,7 +55,12 @@ def _rank0_rendezvous(state):
             s.connect(("8.8.8.8", 80))  # no packet is sent
             ip = s.getsockname()[0]
     except OSError:
-        ip = "127.0.0.1"
+        # no default route (air-gapped cluster): hostname resolution
+        # still beats loopback when /etc/hosts maps a real address
+        try:
+            ip = sock.gethostbyname(sock.gethostname())
+        except OSError:
+            ip = "127.0.0.1"
     return ip, port
 
 
@@ -67,11 +72,16 @@ def _torch_init(state, rank, world_size, addr, port):
 
     os.environ["MASTER_ADDR"] = addr
     os.environ["MASTER_PORT"] = str(port)
-    # This timeout governs EVERY later collective on the group, not
-    # just rendezvous — keep torch's generous default order (a slow
-    # step with >60s between all_reduces must not abort training).
+    # Explicit store: rendezvous failures (stolen port, wrong address)
+    # surface within 60s, while the GROUP timeout — which governs every
+    # later collective — stays at torch's generous default order (a
+    # slow step with >60s between all_reduces must not abort training).
+    store = dist.TCPStore(addr, port, world_size,
+                          is_master=(rank == 0),
+                          timeout=datetime.timedelta(seconds=60))
     dist.init_process_group(
-        backend="gloo", rank=rank, world_size=world_size,
+        backend="gloo", store=store, rank=rank,
+        world_size=world_size,
         timeout=datetime.timedelta(minutes=30))
     state["torch_distributed"] = True
     return rank
