@@ -76,9 +76,12 @@ def _torch_init(state, rank, world_size, addr, port):
     # surface within 60s, while the GROUP timeout — which governs every
     # later collective — stays at torch's generous default order (a
     # slow step with >60s between all_reduces must not abort training).
+    # 5 min rendezvous: enough for worker-start skew under load (cold
+    # torch import + actor scheduling), still 6x faster to surface a
+    # bad address than the 30-min collective timeout.
     store = dist.TCPStore(addr, port, world_size,
                           is_master=(rank == 0),
-                          timeout=datetime.timedelta(seconds=60))
+                          timeout=datetime.timedelta(minutes=5))
     dist.init_process_group(
         backend="gloo", store=store, rank=rank,
         world_size=world_size,
