@@ -167,6 +167,9 @@ class CoreWorker:
         self._attached: Dict[ObjectID, AttachedObject] = {}
         self._attached_lock = threading.Lock()
         self.function_manager = FunctionManager(self._kv_put_sync, self._kv_get_sync)
+        # runtime envs: job-level default + per-driver upload dedupe cache
+        self.job_runtime_env: Optional[Dict] = None
+        self._uploaded_working_dirs: Dict[str, str] = {}
         self._task_counter = itertools.count(1)
         self._put_counter = itertools.count(1)
         # Submission batching: the caller thread appends specs here and
@@ -697,6 +700,67 @@ class CoreWorker:
         await conn.call("GetObject", {"object_id": oid.binary(),
                                       "timeout": 3600.0})
 
+    # -------------------------------------------------------- runtime envs
+
+    def set_job_runtime_env(self, runtime_env: Optional[Dict]) -> None:
+        """Job-level default env (ray.init(runtime_env=...)): uploaded
+        once, merged under every task/actor env (reference: JobConfig
+        runtime_env, python/ray/job_config.py). Persisted in the GCS KV
+        so WORKERS of this job merge it into their nested submissions
+        too (the reference ships JobConfig inside the job table)."""
+        from ray_tpu._private import runtime_env as runtime_env_mod
+
+        self.job_runtime_env = self._prepare_runtime_env(runtime_env)
+        if self.job_runtime_env and self.job_id:
+            import json as _json
+            self._kv_put_sync(
+                runtime_env_mod.JOB_ENV_KEY_PREFIX + self.job_id,
+                _json.dumps(self.job_runtime_env).encode())
+
+    def adopt_job_runtime_env(self, job_id: bytes) -> None:
+        """Worker side: when adopting a job, pick up its job-level
+        runtime env so nested task/actor submissions inherit it."""
+        from ray_tpu._private import runtime_env as runtime_env_mod
+
+        if self.job_runtime_env is not None or not job_id:
+            return
+        try:
+            raw = self._kv_get_sync(
+                runtime_env_mod.JOB_ENV_KEY_PREFIX + job_id)
+        except Exception:  # noqa: BLE001 — GCS restarting; best effort
+            return
+        import json as _json
+        # {} on miss: caches "no job env" so this is one KV read per
+        # worker, not one per task.
+        self.job_runtime_env = _json.loads(raw) if raw else {}
+
+    def _prepare_runtime_env(self, runtime_env: Optional[Dict]):
+        if not runtime_env:
+            return runtime_env
+        from ray_tpu._private import runtime_env as runtime_env_mod
+        return runtime_env_mod.prepare_runtime_env(
+            runtime_env, self._kv_get_sync, self._kv_put_sync,
+            self._uploaded_working_dirs)
+
+    def _resolve_runtime_env(self, runtime_env: Optional[Dict]):
+        """Prepare (validate/upload) a per-task env and merge the job
+        default under it. Task env_vars overlay the job's; a task-level
+        working_dir wins over the job's."""
+        prepared = self._prepare_runtime_env(runtime_env)
+        job = self.job_runtime_env
+        if not job:
+            return prepared
+        if not prepared:
+            return dict(job)
+        merged = dict(job)
+        merged.update({k: v for k, v in prepared.items()
+                       if k != "env_vars"})
+        env_vars = dict(job.get("env_vars") or {})
+        env_vars.update(prepared.get("env_vars") or {})
+        if env_vars:
+            merged["env_vars"] = env_vars
+        return merged
+
     # ------------------------------------------------------- task submission
 
     def submit_task(self, fn_key: str, name: str, args: List[Any],
@@ -731,7 +795,7 @@ class CoreWorker:
             placement_group_id=placement_group_id,
             placement_group_bundle_index=placement_group_bundle_index,
             scheduling_strategy=scheduling_strategy,
-            runtime_env=runtime_env)
+            runtime_env=self._resolve_runtime_env(runtime_env))
         return self._register_and_submit(spec, arg_holds)
 
     def _register_and_submit(self, spec: TaskSpec,
@@ -1144,7 +1208,8 @@ class CoreWorker:
             args=prepared_args, num_returns=0,
             resources=resources or {"CPU": 1.0},
             owner_address=self.address, owner_worker_id=self.worker_id,
-            actor_id=actor_id, runtime_env=runtime_env,
+            actor_id=actor_id,
+            runtime_env=self._resolve_runtime_env(runtime_env),
             actor_creation={"max_restarts": max_restarts,
                             "max_concurrency": max_concurrency,
                             "is_asyncio": is_asyncio,
@@ -1271,7 +1336,9 @@ class CoreWorker:
                         reply["incarnation"] != q.incarnation:
                     try:
                         q.conn = await rpc.connect(
-                            reply["address"], peer_name="actor")
+                            reply["address"], peer_name="actor",
+                            handlers={"ActorTaskResult":
+                                      self._actor_result_handler(q)})
                     except ConnectionError:
                         await asyncio.sleep(0.05)
                         continue
@@ -1336,6 +1403,11 @@ class CoreWorker:
             # Connection lost: the conn-lost handler requeues inflight.
             return
         reply, rbufs = fut.result()
+        if reply.get("streamed"):
+            # Concurrent actor: per-task results arrive as
+            # ActorTaskResult pushes (see _actor_result_handler);
+            # entries stay inflight until theirs lands.
+            return
         requeue: List[Tuple[TaskSpec, int]] = []
         for (spec, seqno), (rheader, fstart, nframes) in zip(
                 batch, reply["replies"]):
@@ -1348,6 +1420,28 @@ class CoreWorker:
                 [ObjectID(b) for b in spec.dependency_ids()])
         if requeue:
             q.buffer.extendleft(reversed(requeue))
+
+    def _actor_result_handler(self, q: ActorQueueState):
+        """Push handler resolving one streamed actor-task result
+        (concurrent actors reply per task, not per batch)."""
+        async def handler(conn, header, bufs):
+            if q.conn is not conn:
+                return  # stale pre-restart connection
+            seqno = header["seqno"]
+            entry = q.inflight.get(seqno)
+            if entry is None:
+                return  # already requeued by a conn-loss race
+            spec, _ = entry
+            rheader = header["reply"]
+            q.inflight.pop(seqno, None)
+            if rheader.get("status") == "actor_restarting":
+                q.buffer.append((spec, seqno))
+                self._pump_actor_queue(q)
+                return
+            self._complete_task(spec, rheader, list(bufs))
+            self.reference_counter.update_finished_task_references(
+                [ObjectID(b) for b in spec.dependency_ids()])
+        return handler
 
     def cancel(self, ref: ObjectRef, force: bool = False):
         """Best-effort task cancel (reference: CoreWorker::CancelTask):

@@ -30,6 +30,7 @@ import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import rpc
+from ray_tpu._private import runtime_env as runtime_env_mod
 from ray_tpu._private.config import RayTpuConfig
 from ray_tpu._private.ids import NodeID, ObjectID, WorkerID
 from ray_tpu._private.scheduler import (
@@ -58,6 +59,9 @@ class WorkerHandle:
         self.actor_id: bytes = b""
         self.job_id: bytes = b""
         self.started_at = time.time()
+        # Runtime env this worker last activated: leases prefer a match
+        # (reference: worker_pool.h:135 runtime_env_hash PopWorker key).
+        self.env_hash: str = ""
 
 
 class LeaseEntry:
@@ -447,11 +451,15 @@ class Raylet:
         self.workers.pop(worker_id, None)
         self._schedule_tick()
 
-    def _pop_idle_worker(self) -> Optional[WorkerHandle]:
+    def _pop_idle_worker(self, env_hash: str = "") -> Optional[WorkerHandle]:
+        fallback = None
         for w in self.workers.values():
             if w.state == WORKER_IDLE and w.conn is not None and not w.conn.closed:
-                return w
-        return None
+                if w.env_hash == env_hash:
+                    return w  # warm for this runtime env
+                if fallback is None:
+                    fallback = w
+        return fallback
 
     def _kill_worker(self, handle: WorkerHandle):
         handle.state = WORKER_DEAD
@@ -475,6 +483,8 @@ class Raylet:
             strategy=summary.get("strategy", "DEFAULT"),
             pg_id=summary.get("pg_id") or b"",
             pg_bundle=summary.get("pg_bundle", -1),
+            env_hash=runtime_env_mod.hash_runtime_env(
+                summary.get("runtime_env")),
         )
         self._init_dep_state(req, summary.get("dep_info") or [])
         fut = asyncio.get_running_loop().create_future()
@@ -597,11 +607,12 @@ class Raylet:
         return views
 
     def _try_grant(self, req_id: int, req: PendingRequest, fut: asyncio.Future):
-        worker = self._pop_idle_worker()
+        worker = self._pop_idle_worker(req.env_hash)
         if worker is None:
             if self._alive_worker_count() + self._num_starting < self.max_workers:
                 self._start_worker_process()
             return  # stays pending until a worker registers/frees
+        worker.env_hash = req.env_hash
         self._pending.pop(req_id, None)
         lease_id = next(self._lease_counter)
         for k, v in req.resources.items():
@@ -627,11 +638,12 @@ class Raylet:
         if not all(bundle_avail.get(k, 0.0) + 1e-9 >= v
                    for k, v in req.resources.items() if v > 0):
             return  # wait for bundle capacity
-        worker = self._pop_idle_worker()
+        worker = self._pop_idle_worker(req.env_hash)
         if worker is None:
             if self._alive_worker_count() + self._num_starting < self.max_workers:
                 self._start_worker_process()
             return
+        worker.env_hash = req.env_hash
         self._pending.pop(req_id, None)
         for k, v in req.resources.items():
             bundle_avail[k] = bundle_avail.get(k, 0.0) - v

@@ -162,6 +162,12 @@ class Connection:
         """One-way message; no reply expected."""
         await self._send(_pack_msg(KIND_PUSH, 0, method, header, bufs))
 
+    def push_nowait(self, method: str, header: Any = None,
+                    bufs: Sequence[bytes] = ()):
+        """One-way message from the loop thread, coalesced like replies
+        (used for streamed per-task actor results)."""
+        self._write_nowait(_pack_msg(KIND_PUSH, 0, method, header, bufs))
+
     async def _recv_loop(self):
         try:
             while True:

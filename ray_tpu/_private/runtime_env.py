@@ -1,0 +1,260 @@
+"""Runtime environments: per-task/actor env_vars + working_dir packages.
+
+Parity: the reference's runtime-env plane —
+``python/ray/_private/runtime_env/working_dir.py`` (zip packages keyed by
+content hash, shipped through GCS KV), realized per node by the dashboard
+agent (``src/ray/raylet/agent_manager.h:67`` CreateRuntimeEnv), with
+workers reused by env hash (``src/ray/raylet/worker_pool.h:135``).
+
+TPU-native redesign: there is no per-node agent process. The package
+travels through the GCS KV (the only blob plane every node already
+reaches), and the *worker* realizes it lazily — download, extract into the
+session dir keyed by content hash, activate via ``sys.path`` + cwd — the
+first time a task carrying that env executes there. Extraction is
+cross-process safe (atomic rename) so many workers on a node share one
+materialized copy. The raylet's worker pool prefers leasing a worker that
+last ran the same env hash, so warm workers skip re-activation.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import io
+import json
+import logging
+import os
+import sys
+import tempfile
+import zipfile
+from typing import Any, Callable, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+PKG_KEY_PREFIX = b"rtpu:pkg:"
+JOB_ENV_KEY_PREFIX = b"rtpu:job_env:"
+# Parked module trees per package dir (see activate()): makes env-hash
+# worker reuse skip re-imports.
+_module_cache: Dict[str, Dict[str, Any]] = {}
+URI_SCHEME = "pkg:"
+SUPPORTED_KEYS = {"env_vars", "working_dir", "working_dir_uri"}
+MAX_PACKAGE_BYTES = 512 * 1024 * 1024
+_DEFAULT_EXCLUDES = {"__pycache__", ".git", ".venv", "node_modules"}
+
+
+def validate_runtime_env(runtime_env: Dict[str, Any]) -> None:
+    unknown = set(runtime_env) - SUPPORTED_KEYS
+    if unknown:
+        raise ValueError(
+            f"unsupported runtime_env keys {sorted(unknown)}; "
+            f"supported: {sorted(SUPPORTED_KEYS)}")
+    env_vars = runtime_env.get("env_vars") or {}
+    if not isinstance(env_vars, dict):
+        raise ValueError("runtime_env['env_vars'] must be a dict")
+
+
+def hash_runtime_env(runtime_env: Optional[Dict[str, Any]]) -> str:
+    """Stable identity of a (prepared) runtime env, for worker-pool
+    matching (reference: worker_pool runtime_env_hash)."""
+    if not runtime_env:
+        return ""
+    return hashlib.sha1(
+        json.dumps(runtime_env, sort_keys=True, default=str).encode()
+    ).hexdigest()[:16]
+
+
+# ---------------------------------------------------------------- packaging
+
+
+def package_working_dir(path: str,
+                        excludes: Optional[set] = None) -> tuple:
+    """Deterministically zip a directory; returns (zip_bytes, pkg_hash).
+
+    The hash covers file names + contents, so identical trees dedupe to
+    one KV entry regardless of mtimes (reference: _get_local_path /
+    package hashing in runtime_env/packaging)."""
+    path = os.path.abspath(os.path.expanduser(path))
+    if not os.path.isdir(path):
+        raise ValueError(f"working_dir {path!r} is not a directory")
+    excludes = (excludes or set()) | _DEFAULT_EXCLUDES
+    entries = []
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs if d not in excludes)
+        for fname in sorted(files):
+            full = os.path.join(root, fname)
+            rel = os.path.relpath(full, path)
+            entries.append((rel, full))
+    hasher = hashlib.sha1()
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for rel, full in entries:
+            with open(full, "rb") as f:
+                data = f.read()
+            hasher.update(rel.encode())
+            hasher.update(b"\0")
+            hasher.update(data)
+            # Fixed date → byte-identical archives for identical trees.
+            info = zipfile.ZipInfo(rel, date_time=(2020, 1, 1, 0, 0, 0))
+            info.external_attr = 0o644 << 16
+            zf.writestr(info, data)
+    blob = buf.getvalue()
+    if len(blob) > MAX_PACKAGE_BYTES:
+        raise ValueError(
+            f"working_dir package is {len(blob)} bytes; "
+            f"limit {MAX_PACKAGE_BYTES}")
+    return blob, hasher.hexdigest()[:20]
+
+
+def _dir_signature(path: str) -> str:
+    """Cheap change detector (names + sizes + mtimes) so a driver that
+    edits its working_dir between submissions re-packages, while
+    unchanged trees skip the full content walk."""
+    h = hashlib.sha1()
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs if d not in _DEFAULT_EXCLUDES)
+        for fname in sorted(files):
+            full = os.path.join(root, fname)
+            try:
+                st = os.stat(full)
+            except OSError:
+                continue
+            h.update(f"{os.path.relpath(full, path)}:"
+                     f"{st.st_size}:{st.st_mtime_ns}".encode())
+    return h.hexdigest()
+
+
+def prepare_runtime_env(runtime_env: Optional[Dict[str, Any]],
+                        kv_get: Callable[[bytes], Optional[bytes]],
+                        kv_put: Callable[[bytes, bytes], None],
+                        uploaded_cache: Dict[str, tuple]) -> Optional[Dict]:
+    """Driver-side: validate and rewrite ``working_dir`` (a local path)
+    into ``working_dir_uri`` (a content-hash URI), uploading the package
+    to GCS KV if this driver hasn't already (cache invalidated when the
+    directory changes)."""
+    if not runtime_env:
+        return runtime_env
+    validate_runtime_env(runtime_env)
+    wd = runtime_env.get("working_dir")
+    if not wd:
+        return runtime_env
+    out = {k: v for k, v in runtime_env.items() if k != "working_dir"}
+    abspath = os.path.abspath(os.path.expanduser(wd))
+    sig = _dir_signature(abspath)
+    cached = uploaded_cache.get(abspath)
+    if cached is not None and cached[0] == sig:
+        out["working_dir_uri"] = cached[1]
+        return out
+    blob, pkg_hash = package_working_dir(wd)
+    key = PKG_KEY_PREFIX + pkg_hash.encode()
+    if kv_get(key) is None:
+        kv_put(key, blob)
+    uri = URI_SCHEME + pkg_hash
+    uploaded_cache[abspath] = (sig, uri)
+    out["working_dir_uri"] = uri
+    return out
+
+
+# -------------------------------------------------------------- realization
+
+
+def ensure_local_package(uri: str, base_dir: str,
+                         kv_get: Callable[[bytes], Optional[bytes]]) -> str:
+    """Worker-side: materialize a package dir for ``pkg:<hash>``; cached
+    per node under ``<session>/runtime_resources/<hash>``. Concurrent
+    extractions race benignly: extract to a temp dir, atomic rename."""
+    if not uri.startswith(URI_SCHEME):
+        raise ValueError(f"bad package uri {uri!r}")
+    pkg_hash = uri[len(URI_SCHEME):]
+    target = os.path.join(base_dir, "runtime_resources", pkg_hash)
+    if os.path.isdir(target):
+        return target
+    blob = kv_get(PKG_KEY_PREFIX + pkg_hash.encode())
+    if blob is None:
+        raise RuntimeError(
+            f"runtime_env package {uri} not found in the cluster KV "
+            f"(was the driver's upload lost?)")
+    os.makedirs(os.path.dirname(target), exist_ok=True)
+    tmp = tempfile.mkdtemp(dir=os.path.dirname(target),
+                           prefix=f".{pkg_hash}-")
+    try:
+        with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+            zf.extractall(tmp)
+        try:
+            os.rename(tmp, target)
+        except OSError:
+            pass  # somebody else won the race
+    finally:
+        if os.path.isdir(tmp) and os.path.isdir(target) and tmp != target:
+            import shutil
+            shutil.rmtree(tmp, ignore_errors=True)
+    return target
+
+
+@contextlib.contextmanager
+def activate(runtime_env: Optional[Dict[str, Any]], base_dir: str,
+             kv_get: Callable[[bytes], Optional[bytes]]):
+    """Apply a runtime env around one task execution, then restore:
+    env_vars into os.environ, the working_dir package onto sys.path[0]
+    and as cwd (reference: workers/setup_worker.py + working_dir_manager
+    setup_for_worker)."""
+    if not runtime_env:
+        yield
+        return
+    env_vars = {str(k): str(v)
+                for k, v in (runtime_env.get("env_vars") or {}).items()}
+    saved_env = {k: os.environ.get(k) for k in env_vars}
+    os.environ.update(env_vars)
+    uri = runtime_env.get("working_dir_uri")
+    saved_cwd = None
+    pkg_dir = None
+    if uri:
+        pkg_dir = ensure_local_package(uri, base_dir, kv_get)
+        saved_cwd = os.getcwd()
+        sys.path.insert(0, pkg_dir)
+        os.chdir(pkg_dir)
+        # Warm worker: restore this package's previously-imported
+        # modules instead of re-importing them.
+        for mod_name, mod in _module_cache.pop(pkg_dir, {}).items():
+            sys.modules.setdefault(mod_name, mod)
+    try:
+        yield
+    finally:
+        if pkg_dir is not None:
+            with contextlib.suppress(ValueError):
+                sys.path.remove(pkg_dir)
+            with contextlib.suppress(OSError):
+                os.chdir(saved_cwd)
+            # Reversibility includes imports: modules loaded FROM the
+            # package must not leak into later tasks on this worker
+            # (those tasks may carry a different working_dir with a
+            # same-named module). They are PARKED, not dropped: a later
+            # task with the same package restores them without
+            # re-importing — this is what makes env-hash worker
+            # affinity (raylet _pop_idle_worker) worth having.
+            parked = _module_cache.setdefault(pkg_dir, {})
+            for mod_name, mod in list(sys.modules.items()):
+                mod_file = getattr(mod, "__file__", None) or ""
+                if mod_file.startswith(pkg_dir + os.sep):
+                    parked[mod_name] = mod
+                    del sys.modules[mod_name]
+        for k, old in saved_env.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+
+
+def activate_persistent(runtime_env: Optional[Dict[str, Any]],
+                        base_dir: str,
+                        kv_get: Callable[[bytes], Optional[bytes]]) -> None:
+    """Apply an env for the lifetime of this worker (actor creation)."""
+    if not runtime_env:
+        return
+    os.environ.update(
+        {str(k): str(v)
+         for k, v in (runtime_env.get("env_vars") or {}).items()})
+    uri = runtime_env.get("working_dir_uri")
+    if uri:
+        pkg_dir = ensure_local_package(uri, base_dir, kv_get)
+        sys.path.insert(0, pkg_dir)
+        os.chdir(pkg_dir)

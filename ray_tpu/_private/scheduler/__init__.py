@@ -36,6 +36,9 @@ class PendingRequest:
     strategy: str = "DEFAULT"
     pg_id: bytes = b""
     pg_bundle: int = -1
+    # Runtime-env identity for worker-pool affinity (reference:
+    # worker_pool.h:135 runtime_env_hash).
+    env_hash: str = ""
     # Bytes of task args already local per candidate node (locality term).
     locality: Dict[bytes, int] = field(default_factory=dict)
     # Frontier gate: False while the local dependency manager is still

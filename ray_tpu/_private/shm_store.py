@@ -19,6 +19,7 @@ import logging
 import os
 import secrets
 import struct
+import threading
 import time
 from multiprocessing import resource_tracker, shared_memory
 from typing import Dict, List, Optional, Tuple
@@ -35,6 +36,50 @@ _U32 = struct.Struct("<I")
 
 def _align8(n: int) -> int:
     return (n + 7) & ~7
+
+
+_zombie_lock = threading.Lock()
+_zombies: List[shared_memory.SharedMemory] = []
+
+
+class _QuietSharedMemory(shared_memory.SharedMemory):
+    """A SharedMemory whose close() tolerates live zero-copy consumers.
+
+    Deserialized arrays (pickle5 out-of-band buffers) may still view the
+    mapping when the store detaches; mmap.close() then raises BufferError.
+    Instead of surfacing that (or letting __del__ print it), the segment is
+    parked in a zombie list and reaped by sweep_zombies() once the consumers
+    are gone. Reference discipline: plasma client Release
+    (src/ray/object_manager/plasma/client.cc)."""
+
+    def close(self):  # noqa: D102 - see class docstring
+        try:
+            shared_memory.SharedMemory.close(self)
+        except BufferError:
+            try:
+                with _zombie_lock:
+                    _zombies.append(self)
+            except Exception:
+                pass  # interpreter teardown
+
+
+def sweep_zombies() -> int:
+    """Retry closing parked mappings whose consumers have since died.
+    Returns the number of mappings still alive."""
+    with _zombie_lock:
+        parked, _zombies[:] = _zombies[:], []
+    still = []
+    for shm in parked:
+        try:
+            shared_memory.SharedMemory.close(shm)
+        except BufferError:
+            still.append(shm)
+        except Exception:
+            pass
+    if still:
+        with _zombie_lock:
+            _zombies.extend(still)
+    return len(still)
 
 
 def _untrack(shm: shared_memory.SharedMemory) -> None:
@@ -82,9 +127,10 @@ class AttachedObject:
     __slots__ = ("shm", "metadata", "frames")
 
     def __init__(self, name: str):
+        sweep_zombies()
         # Attach-only: python 3.12 does not resource-track attachments, so
         # no _untrack here (an unmatched unregister trips the tracker).
-        self.shm = shared_memory.SharedMemory(name=name)
+        self.shm = _QuietSharedMemory(name=name)
         buf = self.shm.buf
         (header_len,) = _U32.unpack(bytes(buf[0:4]))
         meta, frame_lens = msgpack.unpackb(bytes(buf[4:4 + header_len]), raw=False)
@@ -101,6 +147,7 @@ class AttachedObject:
             self.shm.close()
         except Exception:
             pass
+        sweep_zombies()
 
 
 class ShmStoreServer:

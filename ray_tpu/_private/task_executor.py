@@ -14,10 +14,8 @@ and the reply carries only the location.
 from __future__ import annotations
 
 import asyncio
-import contextlib
 import inspect
 import logging
-import os
 import queue as queue_mod
 import threading
 import traceback
@@ -26,6 +24,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu import exceptions as exc
 from ray_tpu._private import rpc
+from ray_tpu._private import runtime_env as runtime_env_mod
 from ray_tpu._private.core_worker import CoreWorker
 from ray_tpu._private.ids import ObjectID, TaskID
 from ray_tpu._private.memory_store import IN_PLASMA
@@ -37,46 +36,6 @@ from ray_tpu._private.task_spec import ARG_REF, ARG_VALUE, TaskSpec
 logger = logging.getLogger(__name__)
 
 _task_ctx = threading.local()
-
-_SUPPORTED_RUNTIME_ENV_KEYS = {"env_vars"}
-
-
-def _validate_runtime_env(runtime_env: dict) -> dict:
-    """env_vars is the supported field (reference: runtime envs
-    validated in _private/runtime_env/validation.py; conda/pip/
-    working_dir need a package-distribution plane this build doesn't
-    have — fail fast rather than silently ignore)."""
-    unknown = set(runtime_env) - _SUPPORTED_RUNTIME_ENV_KEYS
-    if unknown:
-        raise ValueError(
-            f"unsupported runtime_env keys {sorted(unknown)}; "
-            f"supported: {sorted(_SUPPORTED_RUNTIME_ENV_KEYS)}")
-    return {str(k): str(v)
-            for k, v in (runtime_env.get("env_vars") or {}).items()}
-
-
-@contextlib.contextmanager
-def _runtime_env_ctx(runtime_env):
-    """Apply a task's env_vars around its execution, then restore."""
-    if not runtime_env:
-        yield
-        return
-    env_vars = _validate_runtime_env(runtime_env)
-    saved = {k: os.environ.get(k) for k in env_vars}
-    os.environ.update(env_vars)
-    try:
-        yield
-    finally:
-        for k, old in saved.items():
-            if old is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = old
-
-
-def _apply_runtime_env_persistent(runtime_env):
-    if runtime_env:
-        os.environ.update(_validate_runtime_env(runtime_env))
 
 
 def current_task_id() -> bytes:
@@ -245,13 +204,17 @@ class TaskExecutor:
         self.core._current_task_id = spec.task_id
         if not self.core.job_id and spec.job_id:
             # adopt the submitting job: nested task/actor creation from
-            # this worker needs a job id for ID derivation
+            # this worker needs a job id for ID derivation (and the
+            # job-level runtime env for nested submissions)
             self.core.job_id = spec.job_id
+            self.core.adopt_job_runtime_env(spec.job_id)
         try:
             fn = self.core.function_manager.fetch(spec.fn_key)
             args, kwargs = self._resolve_args(spec)
             t0 = _now()
-            with _runtime_env_ctx(spec.runtime_env):
+            with runtime_env_mod.activate(
+                    spec.runtime_env, self.core.session_dir,
+                    self.core._kv_get_sync):
                 result = fn(*args, **kwargs)
             self.core.add_task_event({
                 "event": "task:execute", "name": spec.name,
@@ -397,12 +360,15 @@ class TaskExecutor:
         self.core._current_task_id = spec.task_id
         if not self.core.job_id and spec.job_id:
             self.core.job_id = spec.job_id  # see _execute_task_sync
+            self.core.adopt_job_runtime_env(spec.job_id)
         try:
             # Actor runtime envs persist for the actor's lifetime —
             # this worker process is dedicated to the actor
             # (reference: runtime envs realized at worker setup,
             # workers/setup_worker.py).
-            _apply_runtime_env_persistent(spec.runtime_env)
+            runtime_env_mod.activate_persistent(
+                spec.runtime_env, self.core.session_dir,
+                self.core._kv_get_sync)
             cls = self.core.function_manager.fetch(spec.fn_key)
             args, kwargs = self._resolve_args(spec)
             return cls(*args, **kwargs)
@@ -413,11 +379,32 @@ class TaskExecutor:
     def handle_push_actor_tasks(self, conn, header, bufs):
         """Receiver-side ordering: execute strictly in client seqno order,
         buffering out-of-order arrivals (reference: ActorSchedulingQueue).
-        Sync RPC fast path — returns the batch reply future."""
+        Sync RPC fast path.
+
+        Reply discipline depends on the actor's concurrency model.
+        Serial actors (max_concurrency=1, non-async) complete in push
+        order, so the whole batch shares ONE aggregated reply message
+        (cheapest wire path — this is the microbenchmark hot loop).
+        Concurrent actors (asyncio / thread pool) complete in ANY order
+        and a long-running call (e.g. a 30s long-poll listen) must not
+        hold the reply of a fast call pushed in the same batch — each
+        task's result streams back as its own ActorTaskResult push the
+        moment it lands (reference: per-call replies in
+        direct_actor_transport.h)."""
         loop = asyncio.get_running_loop()
         tasks = header["tasks"]
-        batch_fut, futs = self._batch_reply_aggregator(
-            loop, [t[0] for t in tasks])
+        serial = not self._actor_is_asyncio and self._actor_pool is None
+        if serial:
+            batch_fut, futs = self._batch_reply_aggregator(
+                loop, [t[0] for t in tasks])
+        else:
+            batch_fut = {"streamed": True}
+            futs = []
+            for (tw, seqno, _f, _n) in tasks:
+                fut = loop.create_future()
+                fut.add_done_callback(
+                    self._make_stream_reply_cb(conn, seqno, tw))
+                futs.append(fut)
         callers = set()
         for (tw, seqno, fstart, nframes), fut in zip(tasks, futs):
             caller = tw[TaskSpec.WIRE_OWNER_WORKER_ID]
@@ -429,6 +416,22 @@ class TaskExecutor:
         return batch_fut
 
     handle_push_actor_tasks.rpc_sync = True
+
+    def _make_stream_reply_cb(self, conn, seqno: int, tw: list):
+        def _cb(f: asyncio.Future):
+            if f.cancelled() or f.exception() is not None:
+                e = RuntimeError("cancelled") if f.cancelled() \
+                    else f.exception()
+                rheader, rframes = self._infra_error_reply(tw, e)
+            else:
+                rheader, rframes = f.result()
+            try:
+                conn.push_nowait("ActorTaskResult",
+                                 {"seqno": seqno, "reply": rheader},
+                                 bufs=rframes)
+            except (ConnectionError, OSError):
+                pass  # owner gone; its conn-loss path handles retries
+        return _cb
 
     def _drain_reorder_buffer(self, caller: bytes):
         reorder = self._actor_reorder.get(caller, {})
@@ -502,6 +505,7 @@ class TaskExecutor:
         _task_ctx.task_id = spec.task_id
         if not self.core.job_id and spec.job_id:
             self.core.job_id = spec.job_id  # see _execute_task_sync
+            self.core.adopt_job_runtime_env(spec.job_id)
         try:
             method = self._lookup_method(spec.name)
             args, kwargs = self._resolve_args(spec)

@@ -5,8 +5,10 @@ Trainer agents/trainer.py:513, RolloutWorker
 evaluation/rollout_worker.py:105, WorkerSet evaluation/worker_set.py,
 Policy policy/policy.py). Scope: the architecture (vector envs →
 rollout-worker actors → WorkerSet → jitted learner → Tune-compatible
-Trainer) with PPO as the flagship algorithm; the reference's 20+ algo
-zoo is out of scope by design.
+Trainer) with two algorithm families proving it generalizes: PPO
+(on-policy, fused device rollouts) and DQN (value-based, replay-buffer
+actor + offline IO, reference: rllib/agents/dqn +
+rllib/execution/replay_buffer.py + rllib/offline/).
 """
 
 from ray_tpu.rllib.env import ENV_REGISTRY, CartPole, VectorEnv  # noqa: F401
@@ -16,5 +18,12 @@ from ray_tpu.rllib.policy import (  # noqa: F401
     ppo_loss,
     sample_actions,
 )
+from ray_tpu.rllib.dqn import DQNTrainer  # noqa: F401
+from ray_tpu.rllib.offline import JsonReader, JsonWriter  # noqa: F401
 from ray_tpu.rllib.ppo import DEFAULT_CONFIG, PPOTrainer  # noqa: F401
-from ray_tpu.rllib.rollout_worker import RolloutWorker, WorkerSet  # noqa: F401
+from ray_tpu.rllib.replay_buffer import ReplayBuffer  # noqa: F401
+from ray_tpu.rllib.rollout_worker import (  # noqa: F401
+    RolloutWorker,
+    TransitionWorker,
+    WorkerSet,
+)

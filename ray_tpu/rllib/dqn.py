@@ -1,0 +1,226 @@
+"""DQNTrainer: distributed epsilon-greedy sampling + replay + a jitted
+double-DQN learner.
+
+Parity target: the reference's DQN family
+(reference: rllib/agents/dqn/dqn.py built on trainer_template.py:53,
+with replay via rllib/execution/replay_buffer.py and offline IO via
+rllib/offline/). TPU-first re-design: the optimization phase is ONE
+jitted program — K minibatch Adam steps via lax.scan over batches
+pre-gathered from the replay actor — and the Q-network matmuls run in
+the MXU-friendly [batch, features] layout the buffer already stores.
+
+Proves the second algorithm family shares the abstractions: env
+registry + TransitionWorker (rollout_worker.py), ReplayBuffer actor,
+JsonWriter/JsonReader offline IO, and the Tune trainable contract.
+"""
+
+from __future__ import annotations
+
+import functools
+import pickle
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.offline import JsonReader, JsonWriter
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.rollout_worker import TransitionWorker
+
+DEFAULT_CONFIG: Dict[str, Any] = {
+    "env": "Chain-v0",
+    "num_workers": 1,
+    "num_envs_per_worker": 8,
+    "rollout_len": 32,
+    "gamma": 0.99,
+    "lr": 5e-3,
+    "buffer_size": 50_000,
+    "learning_starts": 256,
+    "train_batch_size": 128,
+    "num_sgd_steps": 8,
+    "target_update_freq": 4,      # in train() iterations
+    "epsilon_initial": 1.0,
+    "epsilon_final": 0.05,
+    "epsilon_decay_iters": 20,
+    "double_q": True,
+    "hidden": 64,
+    "seed": 0,
+    "output": None,               # dir → JsonWriter episode logging
+    "input": None,                # dir → offline training, no env sampling
+}
+
+
+def init_q_params(key, obs_size: int, num_actions: int,
+                  hidden: int = 64) -> Dict:
+    k1, k2, k3 = jax.random.split(key, 3)
+    init = jax.nn.initializers.orthogonal(np.sqrt(2))
+    return {
+        "w1": init(k1, (obs_size, hidden), jnp.float32),
+        "b1": jnp.zeros((hidden,)),
+        "w2": init(k2, (hidden, hidden), jnp.float32),
+        "b2": jnp.zeros((hidden,)),
+        "q": init(k3, (hidden, num_actions), jnp.float32),
+        "q_b": jnp.zeros((num_actions,)),
+    }
+
+
+def q_values(params, obs):
+    h = jnp.tanh(obs @ params["w1"] + params["b1"])
+    h = jnp.tanh(h @ params["w2"] + params["b2"])
+    return h @ params["q"] + params["q_b"]
+
+
+@functools.partial(jax.jit, static_argnames=("gamma", "double_q", "lr"))
+def _dqn_update(params, target_params, opt_state, batches, *,
+                gamma, double_q, lr):
+    """K Adam steps as one compiled program: lax.scan over the [K,
+    batch, ...] stack of replay minibatches (Huber TD loss, double-DQN
+    action selection by the online net)."""
+    import optax
+
+    optimizer = optax.adam(lr)
+
+    def td_loss(p, mb):
+        q = q_values(p, mb["obs"])
+        qa = q[jnp.arange(q.shape[0]), mb["actions"]]
+        q_next_target = q_values(target_params, mb["next_obs"])
+        if double_q:
+            sel = jnp.argmax(q_values(p, mb["next_obs"]), axis=-1)
+            bootstrap = q_next_target[
+                jnp.arange(q_next_target.shape[0]), sel]
+        else:
+            bootstrap = q_next_target.max(axis=-1)
+        target = mb["rewards"] + gamma * (1.0 - mb["dones"]) * \
+            jax.lax.stop_gradient(bootstrap)
+        return optax.huber_loss(qa, target).mean()
+
+    def step(carry, mb):
+        p, opt_state = carry
+        loss, grads = jax.value_and_grad(td_loss)(p, mb)
+        updates, opt_state = optimizer.update(grads, opt_state, p)
+        p = optax.apply_updates(p, updates)
+        return (p, opt_state), loss
+
+    (params, opt_state), losses = jax.lax.scan(
+        step, (params, opt_state), batches)
+    return params, opt_state, jnp.mean(losses)
+
+
+class DQNTrainer:
+    """Also a Tune trainable: train()/save()/restore()."""
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        import optax
+
+        self.config = {**DEFAULT_CONFIG, **(config or {})}
+        cfg = self.config
+        probe = make_env(cfg["env"], 1)
+        self.params = init_q_params(
+            jax.random.key(cfg["seed"]), probe.observation_size,
+            probe.num_actions, hidden=cfg["hidden"])
+        self.target_params = self.params
+        self._opt_state = optax.adam(cfg["lr"]).init(self.params)
+        self._offline = cfg["input"] is not None
+        # Replay lives in its own actor so many workers can feed it and
+        # its memory is isolated from the learner (reference:
+        # LocalReplayBuffer actor, rllib/execution/replay_buffer.py:302).
+        self.buffer = ray_tpu.remote(ReplayBuffer).options(
+            num_cpus=0).remote(cfg["buffer_size"], seed=cfg["seed"])
+        if self._offline:
+            batch = JsonReader(cfg["input"]).read_all()
+            if batch is None:
+                raise ValueError(f"no offline data in {cfg['input']!r}")
+            ray_tpu.get(self.buffer.add.remote(batch))
+            self.workers = []
+        else:
+            cls = ray_tpu.remote(TransitionWorker)
+            self.workers = [
+                cls.remote(cfg["env"], cfg["num_envs_per_worker"],
+                           cfg["rollout_len"], q_values, seed=i + 1)
+                for i in range(cfg["num_workers"])]
+        self._writer = JsonWriter(cfg["output"]) if cfg["output"] else None
+        self._iteration = 0
+        self._timesteps = 0
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._iteration / max(1, cfg["epsilon_decay_iters"]))
+        return cfg["epsilon_initial"] + frac * (
+            cfg["epsilon_final"] - cfg["epsilon_initial"])
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        eps = self._epsilon()
+        if not self._offline:
+            ray_tpu.get([w.set_weights.remote(self.params)
+                         for w in self.workers])
+            batches = ray_tpu.get(
+                [w.sample.remote(eps) for w in self.workers])
+            for b in batches:
+                self._timesteps += len(b["obs"])
+                if self._writer is not None:
+                    self._writer.write(b)
+            adds = [self.buffer.add.remote(b) for b in batches]
+            buffer_size = ray_tpu.get(adds)[-1]
+        else:
+            buffer_size = ray_tpu.get(self.buffer.size.remote())
+
+        loss = float("nan")
+        if buffer_size >= cfg["learning_starts"]:
+            k = cfg["num_sgd_steps"]
+            minibatches = ray_tpu.get(
+                [self.buffer.sample.remote(cfg["train_batch_size"])
+                 for _ in range(k)])
+            stacked = {key: jnp.stack([m[key] for m in minibatches])
+                       for key in minibatches[0]}
+            self.params, self._opt_state, loss = _dqn_update(
+                self.params, self.target_params, self._opt_state,
+                stacked, gamma=cfg["gamma"], double_q=cfg["double_q"],
+                lr=cfg["lr"])
+            loss = float(loss)
+        self._iteration += 1
+        if self._iteration % cfg["target_update_freq"] == 0:
+            self.target_params = self.params
+
+        returns: list = []
+        if not self._offline:
+            for rs in ray_tpu.get([w.episode_returns.remote()
+                                   for w in self.workers]):
+                returns.extend(rs)
+        return {
+            "training_iteration": self._iteration,
+            "timesteps_total": self._timesteps,
+            "buffer_size": int(buffer_size),
+            "epsilon": eps,
+            "episode_reward_mean":
+                float(np.mean(returns)) if returns else float("nan"),
+            "episodes_this_iter": len(returns),
+            "loss": loss,
+        }
+
+    # ---- Tune trainable contract ----
+
+    def save(self, path: str) -> str:
+        with open(path, "wb") as f:
+            pickle.dump({"params": self.params,
+                         "target_params": self.target_params,
+                         "opt_state": self._opt_state,
+                         "iteration": self._iteration,
+                         "timesteps": self._timesteps}, f)
+        return path
+
+    def restore(self, path: str) -> None:
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        self.params = state["params"]
+        self.target_params = state["target_params"]
+        self._opt_state = state["opt_state"]
+        self._iteration = state["iteration"]
+        self._timesteps = state["timesteps"]
+
+    def stop(self) -> None:
+        if self._writer is not None:
+            self._writer.close()

@@ -151,7 +151,48 @@ class JaxCartPole:
         return new_state, steps, reward, done.astype(jnp.float32)
 
 
-ENV_REGISTRY = {"CartPole-v0": JaxCartPole, "CartPole-np": CartPole}
+class Chain(VectorEnv):
+    """Deterministic chain MDP (reference test-env role:
+    rllib/examples/env/ deterministic debug envs): positions 0..N-1,
+    actions {left, right}; +1 only for reaching the right end, then the
+    episode ends. Optimal return is exactly 1.0 per episode with the
+    shortest path — a crisp learnability oracle for value-based
+    agents."""
+
+    LENGTH = 6
+    MAX_STEPS = 16
+    num_actions = 2
+
+    def __init__(self, num_envs: int = 8):
+        self.num_envs = num_envs
+        self.observation_size = self.LENGTH
+        self._pos = None
+        self._steps = None
+
+    def _obs(self) -> np.ndarray:
+        eye = np.eye(self.LENGTH, dtype=np.float32)
+        return eye[self._pos]
+
+    def reset(self, seed: int = 0) -> np.ndarray:
+        self._pos = np.zeros(self.num_envs, dtype=np.int64)
+        self._steps = np.zeros(self.num_envs, dtype=np.int32)
+        return self._obs()
+
+    def step(self, actions: np.ndarray):
+        move = np.where(actions == 1, 1, -1)
+        self._pos = np.clip(self._pos + move, 0, self.LENGTH - 1)
+        self._steps += 1
+        reached = self._pos == self.LENGTH - 1
+        done = reached | (self._steps >= self.MAX_STEPS)
+        reward = reached.astype(np.float32)
+        if done.any():
+            self._pos[done] = 0
+            self._steps[done] = 0
+        return self._obs(), reward, done
+
+
+ENV_REGISTRY = {"CartPole-v0": JaxCartPole, "CartPole-np": CartPole,
+                "Chain-v0": Chain}
 
 
 def make_env(name_or_cls, num_envs: int):

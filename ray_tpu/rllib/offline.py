@@ -1,0 +1,93 @@
+"""Offline experience IO: write sampled batches to disk, read them back.
+
+Parity target: the reference's offline dataset plane
+(reference: rllib/offline/json_writer.py JsonWriter,
+rllib/offline/json_reader.py JsonReader — Trainer config
+``output``/``input``). Batches are JSON-lines files, one sample batch
+per line with base64 numpy payloads — portable, appendable, and
+streamable back into a replay buffer for offline training.
+"""
+
+from __future__ import annotations
+
+import base64
+import glob
+import io
+import json
+import os
+import time
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+
+def _encode(arr: np.ndarray) -> dict:
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(arr), allow_pickle=False)
+    return {"__npy__": base64.b64encode(buf.getvalue()).decode()}
+
+
+def _decode(obj: dict) -> np.ndarray:
+    return np.load(io.BytesIO(base64.b64decode(obj["__npy__"])),
+                   allow_pickle=False)
+
+
+class JsonWriter:
+    """Append sample batches to ``<dir>/batches-<ts>.jsonl``."""
+
+    def __init__(self, output_dir: str, max_file_size: int = 64 << 20):
+        self.output_dir = output_dir
+        self.max_file_size = max_file_size
+        os.makedirs(output_dir, exist_ok=True)
+        self._file = None
+        self._path = ""
+
+    def _roll(self) -> None:
+        if self._file is not None:
+            self._file.close()
+        self._path = os.path.join(
+            self.output_dir,
+            f"batches-{int(time.time() * 1000)}-{os.getpid()}.jsonl")
+        self._file = open(self._path, "a")
+
+    def write(self, batch: Dict[str, np.ndarray]) -> None:
+        if self._file is None or (
+                self._file.tell() > self.max_file_size):
+            self._roll()
+        record = {k: _encode(v) for k, v in batch.items()}
+        self._file.write(json.dumps(record) + "\n")
+        self._file.flush()
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+class JsonReader:
+    """Iterate sample batches from every ``*.jsonl`` under a dir."""
+
+    def __init__(self, input_dir: str):
+        self.paths: List[str] = sorted(
+            glob.glob(os.path.join(input_dir, "*.jsonl")))
+        if not self.paths:
+            raise FileNotFoundError(
+                f"no offline batch files under {input_dir!r}")
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        for path in self.paths:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    record = json.loads(line)
+                    yield {k: _decode(v) for k, v in record.items()}
+
+    def read_all(self) -> Optional[Dict[str, np.ndarray]]:
+        """Concatenate every batch into one ({} keys must match)."""
+        batches = list(self)
+        if not batches:
+            return None
+        return {k: np.concatenate([b[k] for b in batches])
+                for k in batches[0]}

@@ -1,0 +1,70 @@
+"""Replay buffer — runs as an actor shared by workers and the learner.
+
+Parity target: the reference's replay machinery
+(reference: rllib/execution/replay_buffer.py — ReplayBuffer :71,
+LocalReplayBuffer actor wrapper :17/:302 used by DQN-family agents).
+TPU-first posture: storage is preallocated contiguous numpy rings per
+key, so sample() is one fancy-index gather producing exactly the
+[batch, ...] layout the jitted learner consumes — no per-transition
+Python objects.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class ReplayBuffer:
+    """Uniform ring-buffer replay. Use directly, or as an actor via
+    ``ray_tpu.remote(ReplayBuffer).remote(capacity)``."""
+
+    def __init__(self, capacity: int = 100_000, seed: int = 0):
+        self.capacity = int(capacity)
+        self._store: Optional[Dict[str, np.ndarray]] = None
+        self._next = 0
+        self._size = 0
+        self._rng = np.random.default_rng(seed)
+        self.num_added = 0
+
+    def _allocate(self, batch: Dict[str, np.ndarray]) -> None:
+        self._store = {
+            k: np.zeros((self.capacity,) + v.shape[1:], dtype=v.dtype)
+            for k, v in batch.items()}
+
+    def add(self, batch: Dict[str, np.ndarray]) -> int:
+        """Append a batch of transitions ({key: [n, ...]}); returns the
+        current size."""
+        batch = {k: np.asarray(v) for k, v in batch.items()}
+        n = len(next(iter(batch.values())))
+        if self._store is None:
+            self._allocate(batch)
+        for start in range(0, n, self.capacity):
+            chunk = {k: v[start:start + self.capacity]
+                     for k, v in batch.items()}
+            m = len(next(iter(chunk.values())))
+            idx = (self._next + np.arange(m)) % self.capacity
+            for k, v in chunk.items():
+                self._store[k][idx] = v
+            self._next = int((self._next + m) % self.capacity)
+            self._size = int(min(self._size + m, self.capacity))
+        self.num_added += n
+        return self._size
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        """Uniform sample with replacement → {key: [batch, ...]}."""
+        if self._size == 0:
+            raise ValueError("cannot sample from an empty buffer")
+        idx = self._rng.integers(0, self._size, size=batch_size)
+        return {k: v[idx] for k, v in self._store.items()}
+
+    def __len__(self) -> int:
+        return self._size
+
+    def size(self) -> int:
+        return self._size
+
+    def stats(self) -> dict:
+        return {"size": self._size, "capacity": self.capacity,
+                "num_added": self.num_added}

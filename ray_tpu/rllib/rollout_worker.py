@@ -150,6 +150,74 @@ class RolloutWorker:
         return out
 
 
+class TransitionWorker:
+    """Value-based sampling twin of RolloutWorker: collects
+    (obs, action, reward, next_obs, done) transitions with an
+    epsilon-greedy policy over a Q-network — the sample source for
+    DQN-family learners feeding a replay buffer (reference:
+    rollout_worker.py sampling for rllib/agents/dqn). Shares the env
+    registry and episode bookkeeping with RolloutWorker."""
+
+    def __init__(self, env_name, num_envs: int, rollout_len: int,
+                 q_fn, seed: int = 0):
+        self.env = make_env(env_name, num_envs)
+        if not isinstance(self.env, VectorEnv):
+            raise ValueError(
+                "TransitionWorker samples numpy VectorEnvs; jax-native "
+                "envs belong to the fused on-device path")
+        self.num_envs = num_envs
+        self.rollout_len = rollout_len
+        self._q_fn = jax.jit(q_fn)
+        self._rng = np.random.default_rng(seed)
+        self.obs = self.env.reset(seed)
+        self.params = None
+        self._ep_return = np.zeros(num_envs, dtype=np.float32)
+        self._finished_returns: List[float] = []
+
+    def set_weights(self, params) -> None:
+        self.params = params
+
+    def sample(self, epsilon: float) -> Dict[str, np.ndarray]:
+        T, B = self.rollout_len, self.num_envs
+        obs_dim = self.env.observation_size
+        out = {
+            "obs": np.zeros((T * B, obs_dim), np.float32),
+            "actions": np.zeros((T * B,), np.int32),
+            "rewards": np.zeros((T * B,), np.float32),
+            "next_obs": np.zeros((T * B, obs_dim), np.float32),
+            "dones": np.zeros((T * B,), np.float32),
+        }
+        for t in range(T):
+            q = np.asarray(self._q_fn(self.params, self.obs))
+            greedy = q.argmax(axis=-1)
+            explore = self._rng.random(B) < epsilon
+            randa = self._rng.integers(0, self.env.num_actions, size=B)
+            actions = np.where(explore, randa, greedy).astype(np.int32)
+            nxt, reward, done = self.env.step(actions)
+            sl = slice(t * B, (t + 1) * B)
+            out["obs"][sl] = self.obs
+            out["actions"][sl] = actions
+            out["rewards"][sl] = reward
+            # note: env auto-resets; next_obs for done steps is the
+            # fresh episode's obs, masked out by (1 - done) in the
+            # bootstrapped target, so this is correct.
+            out["next_obs"][sl] = nxt
+            out["dones"][sl] = done
+            self._ep_return += reward
+            if done.any():
+                self._finished_returns.extend(
+                    self._ep_return[done].tolist())
+                self._ep_return[done] = 0.0
+            self.obs = nxt
+        return out
+
+    def episode_returns(self, clear: bool = True) -> List[float]:
+        out = list(self._finished_returns)
+        if clear:
+            self._finished_returns.clear()
+        return out
+
+
 class WorkerSet:
     """A set of RolloutWorker actors (reference: worker_set.py:31)."""
 

@@ -5,7 +5,10 @@ Parity target: the reference's Serve control/data plane
 Router/ReplicaSet router.py:45,177, RayServeHandle handle.py:44,
 @serve.deployment api.py:610,865, LongPollClient/Host long_poll.py).
 Handle-based calls are first-class (they compose with the task graph);
-an HTTP ingress can be layered on top of handles.
+HTTP ingress is served by the HTTPProxy actor (http_proxy.py, parity
+with python/ray/serve/http_proxy.py:162): every deployment gets a
+route (default ``/<name>``, opt out with ``route_prefix=None``) and
+receives an ``HTTPRequest`` when invoked over HTTP.
 
 Usage::
 
@@ -31,22 +34,30 @@ from typing import Any, Callable, Dict, List, Optional
 import ray_tpu
 from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
 from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve.http_proxy import (HTTPProxy, HTTPRequest, HTTPResponse,
+                                      PROXY_NAME)
 
 __all__ = [
     "start", "shutdown", "deployment", "get_deployment",
-    "list_deployments", "DeploymentHandle",
+    "list_deployments", "DeploymentHandle", "HTTPRequest", "HTTPResponse",
+    "get_http_address",
 ]
 
 _controller = None
+_http_address = None
 
 
-def start(detached: bool = False):
+def start(detached: bool = False, http: bool = True,
+          http_host: str = "127.0.0.1", http_port: int = 0):
     """Start (or connect to) the serve control plane.
 
     ``detached=True`` keeps the controller alive past this driver, like
-    the reference's serve.start(detached=True).
+    the reference's serve.start(detached=True). ``http=True`` (default)
+    also starts the HTTP ingress proxy (reference:
+    python/ray/serve/http_proxy.py); ``http_port=0`` binds an ephemeral
+    port — read it back with :func:`get_http_address`.
     """
-    global _controller
+    global _controller, _http_address
     if _controller is not None:
         return _controller
     opts = {"name": CONTROLLER_NAME, "get_if_exists": True,
@@ -54,7 +65,27 @@ def start(detached: bool = False):
     if detached:
         opts["lifetime"] = "detached"
     _controller = ray_tpu.remote(ServeController).options(**opts).remote()
+    if http:
+        popts = {"name": PROXY_NAME, "get_if_exists": True,
+                 "max_concurrency": 10000, "num_cpus": 0}
+        if detached:
+            popts["lifetime"] = "detached"
+        proxy = ray_tpu.remote(HTTPProxy).options(**popts).remote(
+            _controller, http_host, http_port)
+        _http_address = ray_tpu.get(proxy.ready.remote())
     return _controller
+
+
+def get_http_address() -> Optional[str]:
+    """'host:port' of the HTTP ingress, or None if HTTP is off."""
+    global _http_address
+    if _http_address is None:
+        try:
+            proxy = ray_tpu.get_actor(PROXY_NAME)
+            _http_address = ray_tpu.get(proxy.ready.remote())
+        except Exception:
+            return None
+    return _http_address
 
 
 def _get_controller():
@@ -69,16 +100,23 @@ def _get_controller():
 
 
 def shutdown() -> None:
-    """Tear down every deployment and the controller."""
-    global _controller
+    """Tear down every deployment, the HTTP proxy, and the controller."""
+    global _controller, _http_address
     if _controller is None:
         try:
             _controller = ray_tpu.get_actor(CONTROLLER_NAME)
         except Exception:
             return
+    try:
+        proxy = ray_tpu.get_actor(PROXY_NAME)
+        ray_tpu.get(proxy.drain.remote())
+        ray_tpu.kill(proxy)
+    except Exception:
+        pass
     ray_tpu.get(_controller.shutdown.remote())
     ray_tpu.kill(_controller)
     _controller = None
+    _http_address = None
 
 
 class Deployment:
@@ -90,7 +128,8 @@ class Deployment:
                  version: Optional[str] = None,
                  user_config: Any = None,
                  ray_actor_options: Optional[Dict] = None,
-                 init_args: tuple = (), init_kwargs: Optional[dict] = None):
+                 init_args: tuple = (), init_kwargs: Optional[dict] = None,
+                 route_prefix: Optional[str] = "__default__"):
         self._func_or_class = func_or_class
         self.name = name
         self.num_replicas = num_replicas
@@ -100,6 +139,8 @@ class Deployment:
         self.ray_actor_options = ray_actor_options or {}
         self.init_args = init_args
         self.init_kwargs = init_kwargs or {}
+        # "__default__" → /<name>; None → not HTTP-routable (handle-only)
+        self.route_prefix = route_prefix
 
     def options(self, **overrides) -> "Deployment":
         cfg = {
@@ -109,6 +150,7 @@ class Deployment:
             "ray_actor_options": dict(self.ray_actor_options),
             "init_args": self.init_args,
             "init_kwargs": dict(self.init_kwargs),
+            "route_prefix": self.route_prefix,
         }
         cfg.update(overrides)
         return Deployment(self._func_or_class, **cfg)
@@ -125,7 +167,8 @@ class Deployment:
             # an unversioned redeploy always rolls: fresh token
             version=self.version or uuid.uuid4().hex,
             user_config=self.user_config,
-            ray_actor_options=self.ray_actor_options))
+            ray_actor_options=self.ray_actor_options,
+            route_prefix=self.route_prefix))
 
     def delete(self) -> None:
         controller = _get_controller()
@@ -143,7 +186,8 @@ class Deployment:
 def deployment(_func_or_class=None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_concurrent_queries: int = 100,
                version: Optional[str] = None, user_config: Any = None,
-               ray_actor_options: Optional[Dict] = None):
+               ray_actor_options: Optional[Dict] = None,
+               route_prefix: Optional[str] = "__default__"):
     """``@serve.deployment`` decorator (bare or with options)."""
     def wrap(func_or_class):
         return Deployment(
@@ -152,7 +196,8 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
             num_replicas=num_replicas,
             max_concurrent_queries=max_concurrent_queries,
             version=version, user_config=user_config,
-            ray_actor_options=ray_actor_options)
+            ray_actor_options=ray_actor_options,
+            route_prefix=route_prefix)
 
     if _func_or_class is not None:
         return wrap(_func_or_class)
@@ -171,7 +216,8 @@ def get_deployment(name: str) -> Deployment:
         max_concurrent_queries=info["max_concurrent_queries"],
         version=info["version"], user_config=info["user_config"],
         ray_actor_options=info["ray_actor_options"],
-        init_args=info["init_args"], init_kwargs=info["init_kwargs"])
+        init_args=info["init_args"], init_kwargs=info["init_kwargs"],
+        route_prefix=info.get("route_prefix"))
     return dep
 
 

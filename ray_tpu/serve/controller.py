@@ -20,6 +20,7 @@ logger = logging.getLogger(__name__)
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 SNAPSHOT_KEY = "replicas:{name}"  # long-poll key per deployment
+ROUTES_KEY = "routes"             # long-poll key for the HTTP route table
 REPLICA_STARTUP_TIMEOUT_S = 60.0
 
 
@@ -56,9 +57,18 @@ class ServeController:
                      max_concurrent_queries: int = 100,
                      version: Optional[str] = None,
                      user_config: Any = None,
-                     ray_actor_options: Optional[dict] = None) -> None:
+                     ray_actor_options: Optional[dict] = None,
+                     route_prefix: Optional[str] = "__default__") -> None:
         """Create or update a deployment and reconcile to the new goal."""
         version = version or "1"
+        if route_prefix == "__default__":
+            route_prefix = f"/{name}"
+        if route_prefix:
+            for other, cfg in self._configs.items():
+                if other != name and cfg.get("route_prefix") == route_prefix:
+                    raise ValueError(
+                        f"route_prefix {route_prefix!r} is already used "
+                        f"by deployment {other!r}")
         if callable_def is None:
             # Config-only redeploy (scale / reconfigure via
             # serve.get_deployment): keep the stored callable.
@@ -77,12 +87,31 @@ class ServeController:
             "version": version,
             "user_config": user_config,
             "ray_actor_options": dict(ray_actor_options or {}),
+            "route_prefix": route_prefix,
         }
+        # Reconcile BEFORE announcing the route: when the proxy learns a
+        # new route and bootstraps its replica snapshot, replicas must
+        # already be serving (reference ordering: backend_state goal
+        # completion precedes endpoint-table publication).
         await self._reconcile(name)
+        await self._notify_routes()
 
     async def delete_deployment(self, name: str) -> None:
         self._configs.pop(name, None)
+        await self._notify_routes()
         await self._reconcile(name)
+
+    async def get_routes(self) -> Dict[str, str]:
+        """HTTP route table: {route_prefix: deployment_name} (reference:
+        python/ray/serve/api.py route management + http_proxy routing)."""
+        return {
+            cfg["route_prefix"]: name
+            for name, cfg in self._configs.items()
+            if cfg.get("route_prefix")
+        }
+
+    async def _notify_routes(self) -> None:
+        await self._host.notify_changed(ROUTES_KEY, await self.get_routes())
 
     async def get_deployment_info(self, name: str) -> Optional[dict]:
         cfg = self._configs.get(name)
@@ -101,6 +130,7 @@ class ServeController:
         for name in list(self._configs):
             self._configs.pop(name, None)
             await self._reconcile(name)
+        await self._notify_routes()
 
     # ---- reconciliation ----
 

@@ -1,0 +1,421 @@
+"""HTTP ingress for serve: an asyncio HTTP/1.1 server inside an actor.
+
+Parity target: the reference's HTTPProxy
+(reference: python/ray/serve/http_proxy.py:162) — an actor per ingress
+node accepting HTTP traffic, routing by path prefix to deployments, and
+forwarding to replicas with max-concurrent-queries flow control. The
+reference fronts uvicorn/starlette; here the server is stdlib asyncio
+(no external deps), the route table arrives over the controller's
+long-poll channel, and replica assignment is fully async (awaiting
+ObjectRefs on the actor's event loop) so thousands of connections share
+one loop without threads.
+
+Deployments receive an :class:`HTTPRequest`; they may return
+``bytes`` / ``str`` / JSON-able objects or an :class:`HTTPResponse`
+for full control. ``GET /-/routes`` returns the live route table.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import traceback
+from typing import Any, Dict, List, Optional
+from urllib.parse import parse_qsl, unquote, urlsplit
+
+from ray_tpu.serve.controller import ROUTES_KEY, SNAPSHOT_KEY
+from ray_tpu.serve.long_poll import LongPollClient
+
+logger = logging.getLogger(__name__)
+
+PROXY_NAME = "SERVE_PROXY"
+IDLE_KEEPALIVE_S = 60.0
+MAX_HEADER_BYTES = 65536
+MAX_BODY_BYTES = 512 * 1024 * 1024
+
+
+class HTTPRequest:
+    """What a deployment's callable receives for an HTTP-routed query."""
+
+    __slots__ = ("method", "path", "route_prefix", "query_string", "query",
+                 "headers", "body")
+
+    def __init__(self, method: str, path: str, route_prefix: str,
+                 query_string: str, headers: Dict[str, str], body: bytes):
+        self.method = method
+        self.path = path
+        self.route_prefix = route_prefix
+        self.query_string = query_string
+        self.query = dict(parse_qsl(query_string))
+        self.headers = headers
+        self.body = body
+
+    @property
+    def text(self) -> str:
+        return self.body.decode("utf-8", errors="replace")
+
+    def json(self) -> Any:
+        return json.loads(self.body) if self.body else None
+
+    def __repr__(self) -> str:
+        return f"HTTPRequest({self.method} {self.path!r})"
+
+
+class HTTPResponse:
+    """Explicit response: status, headers, raw body."""
+
+    __slots__ = ("status", "body", "headers", "content_type")
+
+    def __init__(self, body: Any = b"", status: int = 200,
+                 content_type: Optional[str] = None,
+                 headers: Optional[Dict[str, str]] = None):
+        self.status = int(status)
+        self.body = body
+        self.content_type = content_type
+        self.headers = dict(headers or {})
+
+
+_REASONS = {200: "OK", 204: "No Content", 400: "Bad Request",
+            404: "Not Found", 405: "Method Not Allowed",
+            411: "Length Required", 500: "Internal Server Error",
+            503: "Service Unavailable"}
+
+
+def _encode_result(result: Any) -> HTTPResponse:
+    if isinstance(result, HTTPResponse):
+        return result
+    if result is None:
+        return HTTPResponse(b"", status=200, content_type="text/plain")
+    if isinstance(result, (bytes, bytearray, memoryview)):
+        return HTTPResponse(bytes(result),
+                            content_type="application/octet-stream")
+    if isinstance(result, str):
+        return HTTPResponse(result.encode(),
+                            content_type="text/plain; charset=utf-8")
+    return HTTPResponse(json.dumps(result, default=str).encode(),
+                        content_type="application/json")
+
+
+class _AsyncReplicaSet:
+    """Per-deployment replica selection on the proxy's event loop.
+
+    The handle-side ReplicaSet (ray_tpu/serve/router.py) blocks a
+    thread; inside the proxy every request is a coroutine, so
+    saturation is awaited, not slept: when all replicas are at
+    max_concurrent_queries the assigner waits on the in-flight futures
+    and retries on first completion (reference: ReplicaSet.
+    assign_replica, python/ray/serve/router.py:177).
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self.replicas: List[dict] = []
+        self.max_queries = 1
+        self._inflight: Dict[str, set] = {}   # rid -> set[asyncio.Future]
+        self._rr = 0
+        self._changed = asyncio.Event()
+
+    def update_membership(self, snapshot: dict) -> None:
+        self.replicas = list(snapshot.get("replicas", []))
+        self.max_queries = max(
+            1, int(snapshot.get("max_concurrent_queries", 1)))
+        live = {r["id"] for r in self.replicas}
+        for rid in list(self._inflight):
+            if rid not in live:
+                del self._inflight[rid]
+        self._changed.set()
+
+    async def assign(self, method: str, args: tuple, kwargs: dict,
+                     timeout_s: float = 30.0):
+        """Submit to a replica with a free slot; returns the result."""
+        deadline = asyncio.get_running_loop().time() + timeout_s
+        while True:
+            replica = self._try_pick()
+            if replica is not None:
+                rid = replica["id"]
+                ref = replica["handle"].handle_request.remote(
+                    method, args, kwargs)
+                fut = asyncio.ensure_future(ref.as_future())
+                book = self._inflight.setdefault(rid, set())
+                book.add(fut)
+                fut.add_done_callback(book.discard)
+                return await fut
+            waiters = [f for s in self._inflight.values() for f in s]
+            self._changed.clear()
+            timeout = deadline - asyncio.get_running_loop().time()
+            if timeout <= 0:
+                raise RuntimeError(
+                    f"timed out waiting for a free slot on deployment "
+                    f"{self.name!r} ({len(self.replicas)} replicas at "
+                    f"max_concurrent_queries={self.max_queries})")
+            membership = asyncio.ensure_future(self._changed.wait())
+            try:
+                # Wake on any completion OR a membership change.
+                await asyncio.wait(
+                    waiters + [membership],
+                    timeout=min(timeout, 1.0),
+                    return_when=asyncio.FIRST_COMPLETED)
+            finally:
+                membership.cancel()
+
+    def _try_pick(self) -> Optional[dict]:
+        n = len(self.replicas)
+        for i in range(n):
+            replica = self.replicas[(self._rr + i) % n]
+            if len(self._inflight.get(replica["id"], ())) < self.max_queries:
+                self._rr = (self._rr + i + 1) % n
+                return replica
+        return None
+
+
+class HTTPProxy:
+    """Async actor hosting the ingress server.
+
+    Lifecycle: the controller-facing side (route table, replica
+    membership) updates via long-poll; connections are served on the
+    actor's event loop. In-flight requests survive deployment updates:
+    the controller drains replicas before killing them, and the proxy
+    holds the ObjectRef until the reply lands.
+    """
+
+    def __init__(self, controller, host: str = "127.0.0.1", port: int = 0):
+        self._controller = controller
+        self._host = host
+        self._port = port
+        self._routes: Dict[str, str] = {}       # prefix -> deployment name
+        self._sets: Dict[str, _AsyncReplicaSet] = {}
+        self._server = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._long_poll: Optional[LongPollClient] = None
+        self.num_requests = 0
+        self.num_errors = 0
+
+    async def ready(self) -> str:
+        """Start the server (idempotent); returns 'host:port'."""
+        if self._server is None:
+            self._loop = asyncio.get_running_loop()
+            # Client first: _apply_routes registers per-deployment
+            # membership callbacks on it, including for deployments
+            # that predate the proxy.
+            self._long_poll = LongPollClient(
+                self._controller,
+                {ROUTES_KEY: self._on_routes_changed})
+            routes = await self._controller.get_routes.remote()
+            await self._apply_routes(routes)
+            self._server = await asyncio.start_server(
+                self._handle_connection, host=self._host, port=self._port)
+            self._port = self._server.sockets[0].getsockname()[1]
+            logger.info("serve HTTP proxy listening on %s:%d",
+                        self._host, self._port)
+        return f"{self._host}:{self._port}"
+
+    async def drain(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        if self._long_poll is not None:
+            self._long_poll.stop()
+
+    # ---- route/membership plumbing ----
+
+    def _on_routes_changed(self, routes: Dict[str, str]) -> None:
+        # Called from the long-poll thread; hop to the loop.
+        if self._loop is not None:
+            fut = asyncio.run_coroutine_threadsafe(
+                self._apply_routes(routes), self._loop)
+
+            def _log_err(f):
+                if f.exception() is not None:
+                    logger.error("route-table apply failed: %r",
+                                 f.exception())
+            fut.add_done_callback(_log_err)
+
+    async def _apply_routes(self, routes: Dict[str, str]) -> None:
+        self._routes = dict(routes or {})
+        wanted = set(self._routes.values())
+        for name in wanted - set(self._sets):
+            rs = _AsyncReplicaSet(name)
+            snapshot = await self._controller.get_replica_snapshot.remote(
+                name)
+            rs.update_membership(snapshot)
+            self._sets[name] = rs
+            if self._long_poll is not None:
+                self._long_poll.add_callback(
+                    SNAPSHOT_KEY.format(name=name),
+                    self._membership_cb(name))
+        for name in set(self._sets) - wanted:
+            del self._sets[name]
+
+    def _membership_cb(self, name: str):
+        def cb(snapshot: dict) -> None:
+            if self._loop is None:
+                return
+
+            def apply() -> None:
+                rs = self._sets.get(name)
+                if rs is not None:
+                    rs.update_membership(snapshot)
+            self._loop.call_soon_threadsafe(apply)
+        return cb
+
+    def _match_route(self, path: str):
+        best = None
+        for prefix, name in self._routes.items():
+            if path == prefix or path.startswith(
+                    prefix.rstrip("/") + "/") or prefix == "/":
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, name)
+        return best
+
+    # ---- HTTP plumbing ----
+
+    async def _handle_connection(self, reader: asyncio.StreamReader,
+                                 writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                try:
+                    request_line = await asyncio.wait_for(
+                        reader.readline(), timeout=IDLE_KEEPALIVE_S)
+                except asyncio.TimeoutError:
+                    break
+                if not request_line or request_line in (b"\r\n", b"\n"):
+                    break
+                keep_alive = await self._handle_request(
+                    request_line, reader, writer)
+                if not keep_alive:
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        except Exception:  # noqa: BLE001 — one bad conn can't kill the server
+            logger.exception("connection handler error")
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _handle_request(self, request_line: bytes,
+                              reader: asyncio.StreamReader,
+                              writer: asyncio.StreamWriter) -> bool:
+        try:
+            parts = request_line.decode("latin-1").strip().split()
+            if len(parts) != 3:
+                await self._write_response(
+                    writer, HTTPResponse(b"bad request line", status=400),
+                    keep_alive=False)
+                return False
+            method, target, http_version = parts
+            headers: Dict[str, str] = {}
+            total = 0
+            while True:
+                line = await reader.readline()
+                total += len(line)
+                if total > MAX_HEADER_BYTES:
+                    raise ValueError("headers too large")
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                key, _, value = line.decode("latin-1").partition(":")
+                headers[key.strip().lower()] = value.strip()
+            if "chunked" in headers.get("transfer-encoding", "").lower():
+                # Not implemented; misreading the chunk stream would
+                # desynchronize keep-alive framing.
+                await self._write_response(
+                    writer,
+                    HTTPResponse(b"chunked requests not supported; "
+                                 b"send Content-Length", status=411),
+                    keep_alive=False)
+                return False
+            length = int(headers.get("content-length", "0") or "0")
+            if length > MAX_BODY_BYTES:
+                raise ValueError("body too large")
+            body = await reader.readexactly(length) if length else b""
+        except (ValueError, asyncio.IncompleteReadError):
+            await self._write_response(
+                writer, HTTPResponse(b"malformed request", status=400),
+                keep_alive=False)
+            return False
+
+        keep_alive = (http_version.upper() != "HTTP/1.0"
+                      and headers.get("connection", "").lower() != "close")
+        url = urlsplit(target)
+        path = unquote(url.path)
+        self.num_requests += 1
+
+        if path == "/-/routes":
+            await self._write_response(
+                writer, _encode_result(self._routes), keep_alive)
+            return keep_alive
+        if path == "/-/healthz":
+            await self._write_response(
+                writer, _encode_result("ok"), keep_alive)
+            return keep_alive
+
+        match = self._match_route(path)
+        if match is None:
+            await self._write_response(
+                writer,
+                HTTPResponse(f"no deployment routes {path!r}".encode(),
+                             status=404), keep_alive)
+            return keep_alive
+        prefix, name = match
+        # Roll/startup race: the route table announces a deployment a
+        # beat before its replica set finishes bootstrapping — give the
+        # membership push a moment before failing the request.
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + 5.0
+        rs = self._sets.get(name)
+        while ((rs is None or not rs.replicas)
+               and loop.time() < deadline):
+            await asyncio.sleep(0.05)
+            rs = self._sets.get(name)
+        if rs is None or not rs.replicas:
+            await self._write_response(
+                writer, HTTPResponse(b"no replicas available", status=503),
+                keep_alive)
+            return keep_alive
+
+        request = HTTPRequest(method, path, prefix, url.query, headers, body)
+        try:
+            result = await rs.assign("__call__", (request,), {})
+            response = _encode_result(result)
+        except Exception:  # noqa: BLE001 — user code / replica failure
+            self.num_errors += 1
+            response = HTTPResponse(traceback.format_exc().encode(),
+                                    status=500,
+                                    content_type="text/plain")
+        await self._write_response(writer, response, keep_alive)
+        return keep_alive
+
+    async def _write_response(self, writer: asyncio.StreamWriter,
+                              response: HTTPResponse,
+                              keep_alive: bool) -> None:
+        body = response.body
+        if isinstance(body, str):
+            body = body.encode()
+        elif not isinstance(body, (bytes, bytearray, memoryview)):
+            body = json.dumps(body, default=str).encode()
+        reason = _REASONS.get(response.status, "Unknown")
+        headers = {
+            "content-type": response.content_type or "text/plain",
+            "server": "ray-tpu-serve",
+        }
+        headers.update({k.lower(): v for k, v in response.headers.items()})
+        # Framing headers are the proxy's, always: a user-supplied
+        # Content-Length would desynchronize keep-alive framing.
+        headers["content-length"] = str(len(body))
+        headers["connection"] = "keep-alive" if keep_alive else "close"
+        headers.pop("transfer-encoding", None)
+        head = [f"HTTP/1.1 {response.status} {reason}"]
+        head += [f"{k}: {v}" for k, v in headers.items()]
+        writer.write(("\r\n".join(head) + "\r\n\r\n").encode("latin-1"))
+        writer.write(bytes(body))
+        await writer.drain()
+
+    async def stats(self) -> dict:
+        return {"num_requests": self.num_requests,
+                "num_errors": self.num_errors,
+                "routes": dict(self._routes),
+                "address": f"{self._host}:{self._port}"}

@@ -77,6 +77,15 @@ class LongPollClient:
     def stop(self) -> None:
         self._stopped.set()
 
+    def add_callback(self, key: str,
+                     callback: Callable[[Any], None]) -> None:
+        """Watch another key on the live listener (the HTTP proxy learns
+        deployments dynamically from the route table). Safe from any
+        thread: dict item assignment is atomic and the loop copies
+        ``_known`` per listen."""
+        self._callbacks[key] = callback
+        self._known.setdefault(key, 0)
+
     def _run(self) -> None:
         import ray_tpu
 

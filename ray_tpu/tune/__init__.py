@@ -24,3 +24,8 @@ from ray_tpu.tune.schedulers import (  # noqa: F401
     PopulationBasedTraining,
 )
 from ray_tpu.tune.result import ExperimentAnalysis  # noqa: F401
+from ray_tpu.tune.suggest import (  # noqa: F401
+    BasicVariantGenerator,
+    Searcher,
+    TPESearcher,
+)

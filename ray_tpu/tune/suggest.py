@@ -1,0 +1,245 @@
+"""Search algorithms: the Searcher seam + an in-tree TPE implementation.
+
+Parity target: the reference's suggestion layer
+(reference: python/ray/tune/suggest/suggestion.py — Searcher with
+``suggest``/``on_trial_complete``/``save``/``restore``; 15+ external
+wrappers live in tune/suggest/). Here the seam is the same protocol,
+with two in-tree implementations: BasicVariantGenerator (grid × random,
+the default) and TPESearcher (a Tree-structured Parzen Estimator — the
+algorithm behind hyperopt, reimplemented over this module's Domain
+primitives so no external dependency is needed).
+
+Searcher state is checkpointed alongside the experiment
+(reference: tune/suggest/suggestion.py save/restore +
+durable_trainable.py), so a killed experiment resumes both trials and
+the searcher's observation history.
+"""
+
+from __future__ import annotations
+
+import math
+import pickle
+import random
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.tune.sample import (Choice, Domain, GridSearch, LogUniform,
+                                 RandInt, Uniform, generate_configs)
+
+
+class Searcher:
+    """Suggest/observe protocol (reference: suggestion.py Searcher)."""
+
+    def __init__(self):
+        self.metric: str = "score"
+        self.mode: str = "max"
+
+    def set_search_properties(self, metric: str, mode: str,
+                              space: Dict[str, Any]) -> None:
+        self.metric, self.mode = metric, mode
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        """Next config to try, or None when the search space is
+        exhausted."""
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str,
+                        result: Dict[str, Any]) -> None:
+        pass
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:
+        pass
+
+    # -- persistence (reference: Searcher.save/restore) --
+
+    def save(self, path: str) -> None:
+        with open(path, "wb") as f:
+            pickle.dump(self.__dict__, f)
+
+    def restore(self, path: str) -> None:
+        with open(path, "rb") as f:
+            self.__dict__.update(pickle.load(f))
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid × random expansion behind the Searcher seam (reference:
+    tune/suggest/basic_variant.py)."""
+
+    def __init__(self, space: Dict[str, Any], num_samples: int = 1,
+                 seed: Optional[int] = None):
+        super().__init__()
+        self._configs = generate_configs(space or {}, num_samples,
+                                         seed=seed) or [{}]
+        self._next = 0
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._next >= len(self._configs):
+            return None
+        cfg = self._configs[self._next]
+        self._next += 1
+        return cfg
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator (Bergstra et al., 2011).
+
+    Observations are split at the γ-quantile of the objective into
+    "good" and "bad" sets; each dimension gets a Parzen (kernel
+    mixture) density for both sets, candidates are drawn from the good
+    density, and the candidate maximizing the density ratio l(x)/g(x)
+    is suggested. Independent per-dimension treatment, matching the
+    canonical algorithm (and hyperopt's default behavior, which the
+    reference wraps in tune/suggest/hyperopt.py).
+    """
+
+    def __init__(self, space: Dict[str, Any], gamma: float = 0.15,
+                 n_initial_points: int = 8, n_candidates: int = 24,
+                 seed: Optional[int] = None):
+        super().__init__()
+        for key, dom in (space or {}).items():
+            if isinstance(dom, GridSearch):
+                raise ValueError(
+                    f"TPESearcher does not combine with grid_search "
+                    f"(key {key!r}); use BasicVariantGenerator")
+        self.space = dict(space or {})
+        self.gamma = gamma
+        self.n_initial = n_initial_points
+        self.n_candidates = n_candidates
+        self.rng = random.Random(seed)
+        # trial_id -> (unit-space config, objective); completed only
+        self.observations: List[tuple] = []
+        self._live: Dict[str, Dict[str, float]] = {}
+
+    # -- unit-space transforms per Domain --
+
+    def _to_unit(self, key: str, value: Any) -> float:
+        dom = self.space[key]
+        if isinstance(dom, Uniform):
+            return (value - dom.low) / (dom.high - dom.low)
+        if isinstance(dom, LogUniform):
+            return (math.log(value) - dom._lo) / (dom._hi - dom._lo)
+        if isinstance(dom, RandInt):
+            return (value - dom.low) / max(1, dom.high - 1 - dom.low)
+        if isinstance(dom, Choice):
+            return float(dom.categories.index(value))
+        return float(value)
+
+    def _from_unit(self, key: str, u: float) -> Any:
+        dom = self.space[key]
+        u = min(1.0, max(0.0, u))
+        if isinstance(dom, Uniform):
+            return dom.low + u * (dom.high - dom.low)
+        if isinstance(dom, LogUniform):
+            return math.exp(dom._lo + u * (dom._hi - dom._lo))
+        if isinstance(dom, RandInt):
+            return dom.low + round(u * max(1, dom.high - 1 - dom.low))
+        if isinstance(dom, Choice):
+            return dom.categories[int(round(u))]
+        return u
+
+    def _random_config(self) -> Dict[str, Any]:
+        cfg = {}
+        for k, dom in self.space.items():
+            cfg[k] = dom.sample(self.rng) if isinstance(dom, Domain) \
+                else (dom() if callable(dom) else dom)
+        return cfg
+
+    # -- the estimator --
+
+    def _split(self):
+        """Sort observations by objective (best first) and split at the
+        γ-quantile."""
+        sign = -1.0 if self.mode == "max" else 1.0
+        ranked = sorted(self.observations, key=lambda o: sign * o[1])
+        n_good = max(1, int(math.ceil(self.gamma * len(ranked))))
+        return ranked[:n_good], ranked[n_good:]
+
+    @staticmethod
+    def _parzen_logpdf(x: float, centers: List[float],
+                       sigma: float) -> float:
+        """Log-density of a gaussian mixture at the centers PLUS one
+        uniform-prior component (the prior keeps real exploration mass
+        in l(x), as in the canonical parzen estimator)."""
+        norm = 1.0 / (sigma * math.sqrt(2.0 * math.pi))
+        inv = 1.0 / (2.0 * sigma * sigma)
+        acc = 1.0  # the uniform component's density on [0, 1]
+        for c in centers:
+            acc += norm * math.exp(-(x - c) * (x - c) * inv)
+        return math.log(acc / (len(centers) + 1) + 1e-12)
+
+    def _suggest_dim(self, key: str, good, bad) -> Any:
+        dom = self.space[key]
+        if isinstance(dom, Choice):
+            ncat = len(dom.categories)
+            cg = [1.0] * ncat
+            cb = [1.0] * ncat
+            for cfg, _ in good:
+                cg[int(cfg[key])] += 1
+            for cfg, _ in bad:
+                cb[int(cfg[key])] += 1
+            weights = [cg[i] / cb[i] for i in range(ncat)]
+            # sample ∝ ratio: exploration without argmax lock-in
+            return self.rng.choices(dom.categories, weights=weights)[0]
+        g_centers = [cfg[key] for cfg, _ in good]
+        b_centers = [cfg[key] for cfg, _ in bad]
+        sigma = max(0.05, 1.0 / (1.0 + len(g_centers)))
+        best_u, best_score = None, -float("inf")
+        for _ in range(self.n_candidates):
+            # draw from l: a good-center gaussian or the uniform prior
+            if self.rng.random() < 1.0 / (len(g_centers) + 1):
+                u = self.rng.random()
+            else:
+                u = min(1.0, max(0.0, self.rng.gauss(
+                    self.rng.choice(g_centers), sigma)))
+            # EI surrogate: argmax l(x)/g(x) with EQUAL bandwidths — a
+            # widened g flattens the denominator and the ratio
+            # degenerates to mode-seeking (premature convergence).
+            score = (self._parzen_logpdf(u, g_centers, sigma)
+                     - self._parzen_logpdf(u, b_centers, sigma))
+            if score > best_score:
+                best_u, best_score = u, score
+        return self._from_unit(key, best_u)
+
+    # -- Searcher protocol --
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if len(self.observations) < self.n_initial or not self.space:
+            cfg = self._random_config()
+        else:
+            good, bad = self._split()
+            cfg = {}
+            for k, dom in self.space.items():
+                if isinstance(dom, Domain):
+                    cfg[k] = self._suggest_dim(k, good, bad)
+                else:  # constants / callables pass through
+                    cfg[k] = dom() if callable(dom) else dom
+        self._live[trial_id] = {
+            k: self._to_unit(k, v) for k, v in cfg.items()
+            if k in self.space and isinstance(self.space[k], Domain)}
+        return cfg
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:
+        unit_cfg = self._live.pop(trial_id, None)
+        if error or unit_cfg is None or result is None:
+            return
+        value = result.get(self.metric)
+        if value is None:
+            return
+        self.observations.append((unit_cfg, float(value)))
+
+    def save(self, path: str) -> None:
+        state = dict(self.__dict__)
+        state["rng"] = self.rng.getstate()
+        with open(path, "wb") as f:
+            pickle.dump(state, f)
+
+    def restore(self, path: str) -> None:
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        rng_state = state.pop("rng")
+        self.__dict__.update(state)
+        self.rng = random.Random()
+        self.rng.setstate(rng_state)

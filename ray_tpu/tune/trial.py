@@ -107,8 +107,9 @@ class Trial:
     _ids = itertools.count()
 
     def __init__(self, trainable, config: Dict[str, Any],
-                 experiment_dir: str = ""):
-        self.trial_id = f"trial_{next(Trial._ids):05d}"
+                 experiment_dir: str = "",
+                 trial_id: Optional[str] = None):
+        self.trial_id = trial_id or f"trial_{next(Trial._ids):05d}"
         self.trainable = trainable
         self.config = config
         self.status = PENDING

@@ -20,7 +20,6 @@ from typing import Any, Callable, Dict, List, Optional, Union
 import ray_tpu
 from ray_tpu.tune import trial as trial_mod
 from ray_tpu.tune.result import ExperimentAnalysis
-from ray_tpu.tune.sample import generate_configs
 from ray_tpu.tune.schedulers import (
     CONTINUE, STOP, FIFOScheduler, PopulationBasedTraining, TrialScheduler,
 )
@@ -31,15 +30,23 @@ logger = logging.getLogger(__name__)
 
 class TrialRunner:
     """Event loop over trial actors (reference: TrialRunner.step —
-    process one ready result per step, consult scheduler, refill)."""
+    process one ready result per step, consult scheduler, refill).
+    Trials are created lazily from the search algorithm
+    (reference: SearchGenerator wrapping a Searcher,
+    tune/suggest/search_generator.py)."""
 
-    def __init__(self, trials: List[Trial], scheduler: TrialScheduler,
+    def __init__(self, trainable: Any, search_alg, max_trials: int,
+                 scheduler: TrialScheduler,
                  metric: str, mode: str,
                  stop: Union[Dict[str, Any], Callable, None],
                  resources_per_trial: Optional[dict],
                  max_concurrent: int, experiment_dir: str,
-                 checkpoint_freq: int = 0):
-        self.trials = trials
+                 checkpoint_freq: int = 0,
+                 trials: Optional[List[Trial]] = None):
+        self.trainable = trainable
+        self.search_alg = search_alg
+        self.max_trials = max_trials
+        self.trials: List[Trial] = list(trials or [])
         self.scheduler = scheduler
         self.metric = metric
         self.mode = mode
@@ -50,6 +57,7 @@ class TrialRunner:
         self.checkpoint_freq = checkpoint_freq
         self._pending: Dict[Any, Trial] = {}  # result future -> trial
         self._last_ckpt = 0.0
+        self._exhausted = False
         self.checkpoint_period_s = 5.0
         scheduler.set_objective(metric, mode)
 
@@ -59,7 +67,20 @@ class TrialRunner:
         running = sum(1 for t in self.trials if t.status == RUNNING)
         if running >= self.max_concurrent:
             return None
-        return next((t for t in self.trials if t.status == PENDING), None)
+        t = next((t for t in self.trials if t.status == PENDING), None)
+        if t is not None:
+            return t
+        if self._exhausted or self.search_alg is None or \
+                len(self.trials) >= self.max_trials:
+            return None
+        tid = f"trial_{next(trial_mod.Trial._ids):05d}"
+        cfg = self.search_alg.suggest(tid)
+        if cfg is None:
+            self._exhausted = True
+            return None
+        t = Trial(self.trainable, cfg, self.experiment_dir, trial_id=tid)
+        self.trials.append(t)
+        return t
 
     def _start_trial(self, t: Trial):
         t.experiment_dir = self.experiment_dir
@@ -70,7 +91,10 @@ class TrialRunner:
         self._pending[t.fetch_next()] = t
 
     def is_finished(self) -> bool:
-        return all(t.status in (TERMINATED, ERROR) for t in self.trials)
+        more = (self.search_alg is not None and not self._exhausted
+                and len(self.trials) < self.max_trials)
+        return not more and all(
+            t.status in (TERMINATED, ERROR) for t in self.trials)
 
     # ------------------------------------------------------------ main loop
 
@@ -97,6 +121,8 @@ class TrialRunner:
             logger.warning("trial %s errored: %s", t.trial_id, e)
             t.error = repr(e)
             t.stop(status=ERROR)
+            if self.search_alg is not None:
+                self.search_alg.on_trial_complete(t.trial_id, error=True)
             self._checkpoint_experiment(force=True)
             return
         if done and metrics is None:
@@ -108,6 +134,8 @@ class TrialRunner:
         metrics.setdefault("timestamp", time.time())
         t.last_result = metrics
         t.results.append(metrics)
+        if self.search_alg is not None:
+            self.search_alg.on_trial_result(t.trial_id, metrics)
         if self.checkpoint_freq and t.iteration % self.checkpoint_freq == 0:
             try:
                 ray_tpu.get(t.actor.save_checkpoint.remote(
@@ -143,6 +171,8 @@ class TrialRunner:
 
     def _complete(self, t: Trial):
         self.scheduler.on_trial_complete(self, t)
+        if self.search_alg is not None:
+            self.search_alg.on_trial_complete(t.trial_id, t.last_result)
         t.stop(status=TERMINATED)
         self._checkpoint_experiment(force=True)
 
@@ -194,46 +224,116 @@ class TrialRunner:
             pickle.dump(state, f)
         os.replace(tmp, os.path.join(self.experiment_dir,
                                      "experiment_state.pkl"))
+        if self.search_alg is not None:
+            # Searcher state rides the same checkpoint cadence so a
+            # killed experiment resumes its observation history too
+            # (reference: SearchAlgorithm save alongside trial-runner
+            # checkpoints, tune/suggest/suggestion.py save/restore).
+            tmp = os.path.join(self.experiment_dir, ".searcher_state.tmp")
+            try:
+                self.search_alg.save(tmp)
+                os.replace(tmp, os.path.join(self.experiment_dir,
+                                             "searcher_state.pkl"))
+            except Exception:  # noqa: BLE001 — never kill the loop
+                logger.exception("searcher checkpoint failed")
+
+
+def _restore_trials(trainable, experiment_dir: str) -> List[Trial]:
+    """Rebuild Trial objects from a persisted experiment_state.pkl:
+    completed/errored trials keep their results; interrupted ones
+    re-run (reference: TrialRunner.resume, tune/trial_runner.py)."""
+    import itertools
+
+    path = os.path.join(experiment_dir, "experiment_state.pkl")
+    with open(path, "rb") as f:
+        state = pickle.load(f)
+    trials: List[Trial] = []
+    max_id = -1
+    for rec in state["trials"]:
+        t = Trial(trainable, rec["config"], experiment_dir,
+                  trial_id=rec["trial_id"])
+        if rec["status"] in (TERMINATED, ERROR):
+            t.status = rec["status"]
+            t.results = rec["results"]
+            t.last_result = rec["results"][-1] if rec["results"] else {}
+            t.iteration = rec["iteration"]
+            t.error = rec["error"]
+        else:
+            t.status = PENDING  # interrupted: re-run from scratch
+        t.latest_checkpoint = rec.get("latest_checkpoint")
+        trials.append(t)
+        try:
+            max_id = max(max_id, int(rec["trial_id"].split("_")[-1]))
+        except ValueError:
+            pass
+    # keep fresh trial ids disjoint from the restored ones
+    trial_mod.Trial._ids = itertools.count(max_id + 1)
+    return trials
 
 
 def run(trainable, config: Optional[Dict[str, Any]] = None,
         num_samples: int = 1, metric: str = "score", mode: str = "max",
         scheduler: Optional[TrialScheduler] = None,
+        search_alg=None,
         stop: Union[Dict[str, Any], Callable, None] = None,
         resources_per_trial: Optional[dict] = None,
         max_concurrent_trials: int = 0,
         local_dir: str = "", name: str = "",
         checkpoint_freq: int = 0,
         seed: Optional[int] = None,
+        resume: bool = False,
         verbose: int = 1) -> ExperimentAnalysis:
     """Run an experiment; returns an ExperimentAnalysis
-    (reference: tune.run, python/ray/tune/tune.py)."""
+    (reference: tune.run, python/ray/tune/tune.py).
+
+    ``search_alg`` is any :class:`ray_tpu.tune.suggest.Searcher`; the
+    default expands ``config`` as grid × random (the reference's
+    BasicVariantGenerator). ``resume=True`` reloads trials AND searcher
+    state from a previous run of the same ``name``.
+    """
     assert mode in ("max", "min"), "mode must be 'max' or 'min'"
-    configs = generate_configs(config or {}, num_samples, seed=seed)
-    if not configs:
-        configs = [{}]
+    from ray_tpu.tune.suggest import BasicVariantGenerator
+
     base = local_dir or os.path.join(tempfile.gettempdir(), "ray_tpu_tune")
     exp_name = name or f"exp_{int(time.time())}"
     experiment_dir = os.path.join(base, exp_name)
     os.makedirs(experiment_dir, exist_ok=True)
 
-    trials = [Trial(trainable, cfg, experiment_dir) for cfg in configs]
+    if search_alg is None:
+        search_alg = BasicVariantGenerator(config or {}, num_samples,
+                                           seed=seed)
+        max_trials = len(search_alg._configs)
+    else:
+        max_trials = num_samples
+    search_alg.set_search_properties(metric, mode, config)
+
+    restored: List[Trial] = []
+    if resume:
+        state_path = os.path.join(experiment_dir, "experiment_state.pkl")
+        if os.path.exists(state_path):
+            restored = _restore_trials(trainable, experiment_dir)
+        searcher_path = os.path.join(experiment_dir, "searcher_state.pkl")
+        if os.path.exists(searcher_path):
+            search_alg.restore(searcher_path)
+
     scheduler = scheduler or FIFOScheduler()
     if isinstance(scheduler, PopulationBasedTraining) and not checkpoint_freq:
         checkpoint_freq = scheduler.interval
     runner = TrialRunner(
-        trials, scheduler, metric, mode, stop, resources_per_trial,
-        max_concurrent_trials or len(trials), experiment_dir,
-        checkpoint_freq=checkpoint_freq)
+        trainable, search_alg, max_trials, scheduler, metric, mode, stop,
+        resources_per_trial,
+        max_concurrent_trials or max_trials, experiment_dir,
+        checkpoint_freq=checkpoint_freq, trials=restored)
 
     if verbose:
-        logger.info("tune: %d trials -> %s", len(trials), experiment_dir)
+        logger.info("tune: up to %d trials -> %s", max_trials,
+                    experiment_dir)
     try:
         while not runner.is_finished():
             runner.step()
     finally:
-        for t in trials:
+        for t in runner.trials:
             if t.status == RUNNING:
                 t.stop(status=TERMINATED)
-    return ExperimentAnalysis(experiment_dir, trials=trials,
+    return ExperimentAnalysis(experiment_dir, trials=runner.trials,
                               metric=metric, mode=mode)

@@ -113,11 +113,13 @@ class _Coordinator:
 
 
 class _Group:
-    def __init__(self, name: str, rank: int, world_size: int, coordinator):
+    def __init__(self, name: str, rank: int, world_size: int, coordinator,
+                 backend: str = "host"):
         self.name = name
         self.rank = rank
         self.world_size = world_size
         self.coord = coordinator
+        self.backend = backend
         self.seq = 0
         self.p2p_seq: Dict[tuple, int] = {}
 
@@ -150,11 +152,18 @@ def init_collective_group(world_size: int, rank: int,
                           backend: str = "host",
                           group_name: str = "default") -> None:
     """Declare membership; rank 0's process may pre-create the
-    coordinator, otherwise whoever arrives first creates it."""
-    if backend not in ("host", "object_store"):
+    coordinator, otherwise whoever arrives first creates it.
+
+    Backends (reference: collective.py:111 backend param — nccl/gloo):
+    ``host``/``object_store`` — rendezvous + numpy reduction on host;
+    ``tpu``/``xla``/``device`` — same rendezvous, but the reduction is
+    a compiled XLA collective over the local device mesh and the result
+    is device-resident (see util/collective/device.py).
+    """
+    if backend not in ("host", "object_store", "tpu", "xla", "device"):
         raise ValueError(
-            f"backend {backend!r} not supported; device collectives are "
-            "XLA collectives — see ray_tpu.parallel")
+            f"backend {backend!r} not supported; expected host/"
+            f"object_store or tpu/xla/device")
     if group_name in _groups:
         raise RuntimeError(f"group {group_name!r} already initialized")
     name = _COORD_PREFIX + group_name
@@ -162,7 +171,8 @@ def init_collective_group(world_size: int, rank: int,
         num_cpus=0, name=name, get_if_exists=True, lifetime="detached")
     coord = coord_cls.remote(world_size)
     ray_tpu.get(coord.join.remote(rank, world_size))
-    _groups[group_name] = _Group(group_name, rank, world_size, coord)
+    _groups[group_name] = _Group(group_name, rank, world_size, coord,
+                                 backend=backend)
 
 
 def destroy_collective_group(group_name: str = "default",
@@ -210,17 +220,29 @@ def _group(group_name: str) -> _Group:
     return _groups[group_name]
 
 
+def _is_device_backend(g: _Group) -> bool:
+    return g.backend in ("tpu", "xla", "device")
+
+
 def allreduce(tensor, group_name: str = "default",
               op: str = ReduceOp.SUM) -> np.ndarray:
     g = _group(group_name)
     rnd = g._exchange(np.asarray(tensor))
-    return _reduce([rnd[r] for r in sorted(rnd)], op)
+    contributions = [rnd[r] for r in sorted(rnd)]
+    if _is_device_backend(g):
+        from ray_tpu.util.collective.device import mesh_reduce
+        return mesh_reduce(contributions, op)
+    return _reduce(contributions, op)
 
 
 def allgather(tensor, group_name: str = "default") -> List[np.ndarray]:
     g = _group(group_name)
     rnd = g._exchange(np.asarray(tensor))
-    return [np.asarray(rnd[r]) for r in sorted(rnd)]
+    contributions = [rnd[r] for r in sorted(rnd)]
+    if _is_device_backend(g):
+        from ray_tpu.util.collective.device import mesh_allgather
+        return mesh_allgather(contributions)
+    return [np.asarray(c) for c in contributions]
 
 
 def broadcast(tensor, src_rank: int = 0,
@@ -228,6 +250,9 @@ def broadcast(tensor, src_rank: int = 0,
     g = _group(group_name)
     payload = np.asarray(tensor) if g.rank == src_rank else None
     rnd = g._exchange(payload)
+    if _is_device_backend(g):
+        import jax.numpy as jnp
+        return jnp.asarray(rnd[src_rank])  # device-resident copy
     return np.asarray(rnd[src_rank])
 
 
@@ -236,8 +261,14 @@ def reducescatter(tensor, group_name: str = "default",
     """Reduce then return this rank's 1/world_size slice (dim 0)."""
     g = _group(group_name)
     rnd = g._exchange(np.asarray(tensor))
-    full = _reduce([rnd[r] for r in sorted(rnd)], op)
-    return np.array_split(full, g.world_size, axis=0)[g.rank]
+    contributions = [rnd[r] for r in sorted(rnd)]
+    if _is_device_backend(g):
+        from ray_tpu.util.collective.device import mesh_reduce
+        full = mesh_reduce(contributions, op)
+    else:
+        full = _reduce(contributions, op)
+    return np.array_split(np.asarray(full), g.world_size,
+                          axis=0)[g.rank]
 
 
 def barrier(group_name: str = "default") -> None:

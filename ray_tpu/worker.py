@@ -93,6 +93,7 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[int] = None,
          resources: Optional[Dict[str, float]] = None,
          object_store_memory: Optional[int] = None,
          namespace: str = "", ignore_reinit_error: bool = False,
+         runtime_env: Optional[Dict[str, Any]] = None,
          _system_config: Optional[Dict[str, Any]] = None,
          log_to_driver: bool = True) -> Dict[str, Any]:
     """Start (or connect to) a cluster and attach this process as a driver.
@@ -117,6 +118,11 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[int] = None,
         set_config(config)
 
         if address is not None and address.startswith("ray://"):
+            if runtime_env:
+                # fail fast, matching the client-mode posture for
+                # per-task runtime envs (util/client/client.py)
+                raise ValueError(
+                    "runtime_env is not supported in ray:// client mode")
             # Thin-client mode (reference: ray.init("ray://...") →
             # util/client). The whole API routes through a ClientCore
             # speaking to a cluster-side proxy.
@@ -150,6 +156,8 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[int] = None,
                           session_dir=session_dir,
                           log_to_driver=log_to_driver)
         core.connect()
+        if runtime_env:
+            core.set_job_runtime_env(runtime_env)
         _tune_gc()
         actor_mod.register_with_core_worker(core)
         global_worker.core = core

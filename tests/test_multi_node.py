@@ -208,3 +208,25 @@ def test_node_death_detected_by_heartbeat(cluster2):
         return 42
 
     assert ray_tpu.get(f.remote()) == 42
+
+
+def test_working_dir_ships_across_nodes(tmp_path, cluster2):
+    """A task pinned to the OTHER node imports a module that only ever
+    existed in the driver's working_dir (deleted before execution):
+    the package plane must carry it through the GCS KV (reference:
+    runtime_env/working_dir.py + agent_manager.h:67 CreateRuntimeEnv)."""
+    import shutil
+
+    wd = tmp_path / "proj"
+    wd.mkdir()
+    (wd / "only_on_driver.py").write_text("WHO = 'crossed-nodes'\n")
+
+    @ray_tpu.remote(resources={"spot": 1},
+                    runtime_env={"working_dir": str(wd)})
+    def probe():
+        import only_on_driver
+        return only_on_driver.WHO
+
+    ref = probe.remote()
+    shutil.rmtree(wd)
+    assert ray_tpu.get(ref, timeout=60) == "crossed-nodes"

@@ -126,3 +126,31 @@ def test_lineage_reconstruction_after_node_loss():
     finally:
         ray_tpu.shutdown()
         c.shutdown()
+
+
+def test_attachment_zombie_sweep():
+    """A detached mapping with live zero-copy consumers must not raise
+    BufferError (from SharedMemory.__del__) and must be unmapped once the
+    consumer dies (reference: plasma client Release discipline,
+    src/ray/object_manager/plasma/client.cc)."""
+    import gc
+
+    from ray_tpu._private import shm_store
+    from ray_tpu._private.serialization import SerializationContext
+
+    ctx = SerializationContext()
+    arr = np.arange(4096, dtype=np.float64)
+    name, size = shm_store.write_segment(ctx.serialize(arr))
+    try:
+        att = shm_store.AttachedObject(name)
+        # Zero-copy view into the mapping, as ray_tpu.get() produces.
+        view = ctx.deserialize(att.metadata, att.frames)
+        assert isinstance(view, np.ndarray) and view[17] == 17.0
+        att.close()  # consumer still alive: mapping parked, no BufferError
+        assert shm_store.sweep_zombies() >= 1
+        assert view[4095] == 4095.0  # still readable through the zombie
+        del view
+        gc.collect()
+        assert shm_store.sweep_zombies() == 0  # consumer gone: unmapped
+    finally:
+        shm_store.ShmStoreServer._unlink(name)

@@ -213,3 +213,56 @@ def test_trainer_checkpoint(ray_start_4cpu, tmp_path):
     second = trainer.run(train_func, config={})
     trainer.shutdown()
     assert first == [0] and second == [2]
+
+
+def test_collective_device_backend_matches_host(ray_start_4cpu):
+    """The device backend (XLA mesh collectives, util/collective/
+    device.py) must produce results identical to the host backend
+    (reference: nccl vs gloo group parity,
+    python/ray/util/collective/collective.py:111,244)."""
+
+    @ray_tpu.remote
+    class Rank:
+        def __init__(self, rank, world, backend, group):
+            from ray_tpu.util import collective as col
+            col.init_collective_group(world, rank, backend=backend,
+                                      group_name=group)
+            self.rank = rank
+            self.group = group
+
+        def ops(self):
+            from ray_tpu.util import collective as col
+            out = {}
+            out["sum"] = np.asarray(col.allreduce(
+                np.arange(8.0) * (self.rank + 1), group_name=self.group))
+            out["max"] = np.asarray(col.allreduce(
+                np.arange(8.0) * (self.rank + 1), group_name=self.group,
+                op=col.ReduceOp.MAX))
+            out["prod"] = np.asarray(col.allreduce(
+                np.full(4, 2.0 + self.rank), group_name=self.group,
+                op=col.ReduceOp.PRODUCT))
+            out["gather"] = [np.asarray(x) for x in col.allgather(
+                np.array([self.rank, 10.0]), group_name=self.group)]
+            out["bcast"] = np.asarray(col.broadcast(
+                np.array([7.0 + self.rank]), src_rank=1,
+                group_name=self.group))
+            out["rs"] = np.asarray(col.reducescatter(
+                np.arange(6.0), group_name=self.group))
+            return out
+
+    world = 3  # not a divisor of the 8-device mesh: exercises padding
+    results = {}
+    for backend, group in (("host", "gh"), ("tpu", "gd")):
+        actors = [Rank.remote(r, world, backend, group)
+                  for r in range(world)]
+        results[backend] = ray_tpu.get([a.ops.remote() for a in actors])
+        del actors
+    for rank in range(world):
+        h, d = results["host"][rank], results["tpu"][rank]
+        for key in ("sum", "max", "prod", "bcast", "rs"):
+            np.testing.assert_allclose(h[key], d[key], err_msg=key)
+        for hg, dg in zip(h["gather"], d["gather"]):
+            np.testing.assert_allclose(hg, dg)
+    # ground truth for one op
+    np.testing.assert_allclose(
+        results["tpu"][0]["sum"], np.arange(8.0) * 6)
