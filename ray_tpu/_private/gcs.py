@@ -202,8 +202,14 @@ class GcsServer:
             if path.startswith(b"/metrics"):
                 body = self._render_metrics().encode()
                 status, ctype = b"200 OK", b"text/plain; version=0.0.4"
+            elif path.startswith(b"/api/"):
+                body, status = self._dashboard_api(
+                    path.decode("latin-1", errors="replace"))
+                ctype = b"application/json"
             else:
-                body = b"ray_tpu GCS: scrape /metrics\n"
+                body = (b"ray_tpu head: scrape /metrics; dashboard API "
+                        b"under /api/ (nodes|actors|jobs|cluster|"
+                        b"placement_groups|metrics)\n")
                 status, ctype = b"200 OK", b"text/plain"
             writer.write(b"HTTP/1.1 " + status +
                          b"\r\nContent-Type: " + ctype +
@@ -218,6 +224,76 @@ class GcsServer:
                 writer.close()
             except Exception:  # noqa: BLE001
                 pass
+
+    def _dashboard_api(self, path: str):
+        """Dashboard-lite: JSON cluster state straight off the GCS
+        tables (reference: dashboard/head.py + datacenter.py aggregate
+        the same node/actor/job views; no React client here — the JSON
+        API is the product)."""
+        import json
+
+        def dump(obj):
+            return json.dumps(obj, default=str).encode(), b"200 OK"
+
+        route = path.split("?")[0].rstrip("/")
+        if route == "/api/nodes":
+            return dump([{
+                "node_id": n.node_id.hex(), "address": n.address,
+                "node_name": n.node_name, "alive": n.alive,
+                "resources_total": n.resources_total,
+                "resources_available": n.resources_available,
+                "last_heartbeat_age_s":
+                    round(time.time() - n.last_heartbeat, 3),
+                "stats": n.stats,
+            } for n in self.nodes.values()])
+        if route == "/api/actors":
+            return dump([{
+                "actor_id": a.actor_id.hex(), "name": a.name,
+                "namespace": a.namespace, "state": a.state,
+                "class_name": a.spec_header.get("name", ""),
+                "node_id": a.node_id.hex() if a.node_id else "",
+                "address": a.address,
+                "num_restarts": a.num_restarts,
+                "max_restarts": a.max_restarts,
+                "job_id": a.job_id.hex() if a.job_id else "",
+            } for a in self.actors.values()])
+        if route == "/api/jobs":
+            return dump([{
+                "job_id": job_id.hex(), **{
+                    k: v for k, v in record.items()
+                    if isinstance(v, (str, int, float, bool, type(None)))}
+            } for job_id, record in self.jobs.items()])
+        if route == "/api/placement_groups":
+            return dump([{
+                "pg_id": pg_id.hex(),
+                **{k: v for k, v in pg.items()
+                   if k in ("name", "strategy", "state")},
+                "bundles": pg.get("bundles"),
+            } for pg_id, pg in self.placement_groups.items()])
+        if route == "/api/cluster":
+            total: Dict[str, float] = {}
+            avail: Dict[str, float] = {}
+            for n in self.nodes.values():
+                if not n.alive:
+                    continue
+                for k, v in n.resources_total.items():
+                    total[k] = total.get(k, 0.0) + v
+                for k, v in n.resources_available.items():
+                    avail[k] = avail.get(k, 0.0) + v
+            return dump({
+                "nodes_alive": sum(1 for n in self.nodes.values()
+                                   if n.alive),
+                "nodes_total": len(self.nodes),
+                "actors": len(self.actors),
+                "jobs": len(self.jobs),
+                "placement_groups": len(self.placement_groups),
+                "resources_total": total,
+                "resources_available": avail,
+            })
+        if route == "/api/metrics":
+            return dump(self._merged_metrics())
+        return (json.dumps({"error": f"unknown route {route!r}"}).encode(),
+                b"404 Not Found")
 
     def _builtin_metrics(self) -> dict:
         """Cluster-state gauges computed from GCS tables + per-node
@@ -257,6 +333,18 @@ class GcsServer:
              "Objects spilled to external storage"),
             ("store_num_evictions", "ray_tpu_object_store_evictions_total",
              "Objects evicted from the store"),
+            # host stats collected by the raylet via psutil (reference:
+            # reporter_agent.py:126)
+            ("host_cpu_percent", "ray_tpu_node_cpu_percent",
+             "Host CPU utilization"),
+            ("host_mem_used_bytes", "ray_tpu_node_mem_used_bytes",
+             "Host memory used"),
+            ("host_mem_total_bytes", "ray_tpu_node_mem_total_bytes",
+             "Host memory total"),
+            ("host_disk_used_bytes", "ray_tpu_node_disk_used_bytes",
+             "Session-dir disk used"),
+            ("raylet_rss_bytes", "ray_tpu_raylet_rss_bytes",
+             "Raylet process RSS"),
         ]
         for key, name, desc in node_gauges:
             vals = []
@@ -268,7 +356,9 @@ class GcsServer:
                 gauge(name, desc, vals)
         return g
 
-    def _render_metrics(self) -> str:
+    def _merged_metrics(self) -> dict:
+        """Reporter snapshots (TTL-pruned) + builtin gauges, shared by
+        the Prometheus rendering and the /api/metrics JSON view."""
         from ray_tpu._private import metrics as metrics_mod
 
         cutoff = time.time() - self.METRIC_SNAPSHOT_TTL_S
@@ -278,7 +368,12 @@ class GcsServer:
         snaps = [s for _, s in self._metric_snapshots.values()]
         merged = metrics_mod.merge_snapshots(snaps)
         merged.update(self._builtin_metrics())
-        return metrics_mod.render_prometheus(merged)
+        return merged
+
+    def _render_metrics(self) -> str:
+        from ray_tpu._private import metrics as metrics_mod
+
+        return metrics_mod.render_prometheus(self._merged_metrics())
 
     # Reporters that stop reporting (dead workers) age out: their
     # gauges must not be served forever, nor their snapshots leak.

@@ -269,10 +269,11 @@ class Raylet:
 
     def _heartbeat_stats(self) -> dict:
         """Flat per-node stats piggybacked on heartbeats → GCS metrics
-        endpoint (reference: raylet resource/stats reports feeding the
-        metrics agent; metric_defs.h gauges)."""
+        endpoint + dashboard API (reference: raylet resource/stats
+        reports feeding the metrics agent, metric_defs.h gauges; host
+        stats parity: reporter_agent.py:126 psutil collection)."""
         s = self.store.stats()
-        return {
+        out = {
             "num_workers": self._alive_worker_count(),
             "num_pending_leases": len(self._pending),
             "num_leases_granted": self.num_leases_granted,
@@ -282,6 +283,22 @@ class Raylet:
             "store_num_spills": s["num_spills"],
             "store_num_evictions": s["num_evictions"],
         }
+        try:
+            import psutil
+
+            # interval=None: non-blocking since-last-call sample
+            out["host_cpu_percent"] = psutil.cpu_percent(interval=None)
+            vm = psutil.virtual_memory()
+            out["host_mem_used_bytes"] = float(vm.used)
+            out["host_mem_total_bytes"] = float(vm.total)
+            du = psutil.disk_usage(self.session_dir or "/")
+            out["host_disk_used_bytes"] = float(du.used)
+            out["host_disk_total_bytes"] = float(du.total)
+            proc = psutil.Process()
+            out["raylet_rss_bytes"] = float(proc.memory_info().rss)
+        except Exception:  # noqa: BLE001 — stats are best-effort
+            pass
+        return out
 
     async def _heartbeat_loop(self):
         period = self.config.raylet_heartbeat_period_ms / 1000.0

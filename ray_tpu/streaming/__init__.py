@@ -34,13 +34,29 @@ class _Stage:
 
 
 class DataStream:
-    def __init__(self, ctx: "StreamingContext", stages: List[_Stage]):
+    def __init__(self, ctx: "StreamingContext", stages: List[_Stage],
+                 branches: Optional[List["DataStream"]] = None):
         self._ctx = ctx
         self._stages = stages
+        self._source: Optional[Iterable[Any]] = None
+        # fan-in: upstream branch pipelines merging into this stream
+        # (reference: streaming python DataStream.union)
+        self._branches = branches or []
 
     def _with(self, stage: _Stage) -> "DataStream":
         # preserve KeyedStream-ness across chained transforms
-        return type(self)(self._ctx, self._stages + [stage])
+        stream = type(self)(self._ctx, self._stages + [stage],
+                            self._branches)
+        stream._source = self._source
+        return stream
+
+    def union(self, *others: "DataStream") -> "DataStream":
+        """Merge this stream with others into one multi-input stage;
+        downstream transforms see records from every branch. Barrier
+        alignment across the branches is the runtime's job
+        (runtime.py _maybe_align)."""
+        branches = [self] + list(others)
+        return DataStream(self._ctx, [], branches=branches)
 
     def map(self, fn: Callable) -> "DataStream":
         return self._with(_Stage("map", fn))
@@ -53,7 +69,10 @@ class DataStream:
 
     def key_by(self, key_fn: Callable) -> "KeyedStream":
         keyed = self._with(_Stage("map", _KeyBy(key_fn)))
-        return KeyedStream(keyed._ctx, keyed._stages)
+        stream = KeyedStream(keyed._ctx, keyed._stages,
+                             keyed._branches)
+        stream._source = keyed._source
+        return stream
 
     def sink(self, fn: Optional[Callable] = None) -> "DataStream":
         return self._with(_Stage("sink", fn))
@@ -63,10 +82,7 @@ class DataStream:
         """Build the operator actors, stream the source through, and
         return the terminal stage's output (the last stage becomes a
         sink when none was declared)."""
-        stages = list(self._stages)
-        if not stages or stages[-1].kind != "sink":
-            stages.append(_Stage("sink", None))
-        return self._ctx._run(stages, checkpoint_every)
+        return self._ctx._run(self, checkpoint_every)
 
 
 class KeyedStream(DataStream):
@@ -91,46 +107,107 @@ class StreamingContext:
         self.operators: List[Any] = []  # live handles of the last run
 
     def from_collection(self, items: Iterable[Any]) -> DataStream:
-        self._source = items
-        return DataStream(self, [])
+        stream = DataStream(self, [])
+        stream._source = items
+        self._source = items  # kept for backwards compatibility
+        return stream
 
-    def _run(self, stages: List[_Stage],
-             checkpoint_every: Optional[int]) -> List[Any]:
-        op_cls = ray_tpu.remote(StreamOperator)
+    def _build_chain(self, op_cls, stages: List[_Stage]) -> List[Any]:
         ops = [op_cls.remote(s.kind, s.fn, self.capacity)
                for s in stages]
-        self.operators = ops
-        # wire the chain back-to-front
         for up, down in zip(ops, ops[1:]):
             ray_tpu.get(up.set_downstream.remote(down))
+        return ops
 
-        head = ops[0]
-        batch: List[Any] = []
+    def _run(self, stream: DataStream,
+             checkpoint_every: Optional[int]) -> List[Any]:
+        op_cls = ray_tpu.remote(StreamOperator)
+        suffix = list(stream._stages)
+        if not suffix or suffix[-1].kind != "sink":
+            suffix.append(_Stage("sink", None))
+
+        if stream._branches:
+            # Fan-in topology: branch chains → union op → suffix chain.
+            branches = stream._branches
+            for b in branches:
+                if b._branches:
+                    raise ValueError("nested union is not supported")
+            union_op = op_cls.remote(
+                "union", None, self.capacity, len(branches))
+            suffix_ops = self._build_chain(op_cls, suffix)
+            ray_tpu.get(union_op.set_downstream.remote(suffix_ops[0]))
+            heads = []
+            all_ops = [union_op] + suffix_ops
+            for i, b in enumerate(branches):
+                if b._stages:
+                    chain = self._build_chain(op_cls, b._stages)
+                    ray_tpu.get(
+                        chain[-1].set_downstream.remote(union_op, i))
+                    heads.append(chain[0])
+                    all_ops = chain + all_ops
+                else:
+                    heads.append((union_op, i))
+            sources = [b._source if b._source is not None else ()
+                       for b in branches]
+        else:
+            all_ops = self._build_chain(op_cls, suffix)
+            heads = [all_ops[0]]
+            sources = [stream._source if stream._source is not None
+                       else self._source]
+        self.operators = all_ops
+        sink = all_ops[-1]
+
+        def _push(head, payload):
+            if isinstance(head, tuple):  # (op, channel) direct fan-in
+                ray_tpu.get(head[0].push.remote(payload, head[1]))
+            else:
+                ray_tpu.get(head.push.remote(payload))
+
+        # Drive every source round-robin so fan-in edges genuinely
+        # interleave; barriers are injected into EVERY head at the same
+        # logical point (the runtime aligns them downstream).
+        iters = [iter(s) for s in sources]
+        batches: List[List[Any]] = [[] for _ in sources]
+        live = set(range(len(sources)))
         sent = 0
         barrier_id = 0
-        for item in self._source:
-            batch.append(item)
-            sent += 1
-            if len(batch) >= _BATCH:
-                ray_tpu.get(head.push.remote(batch))
-                batch = []
-            if checkpoint_every and sent % checkpoint_every == 0:
-                if batch:
-                    ray_tpu.get(head.push.remote(batch))
-                    batch = []
-                barrier_id += 1
-                ray_tpu.get(head.push.remote([Barrier(barrier_id)]))
-        if batch:
-            ray_tpu.get(head.push.remote(batch))
-        ray_tpu.get(head.push.remote([Eos()]))
+
+        def _inject_barrier():
+            nonlocal barrier_id
+            barrier_id += 1
+            for j in range(len(sources)):
+                if batches[j]:
+                    _push(heads[j], batches[j])
+                    batches[j] = []
+                _push(heads[j], [Barrier(barrier_id)])
+
+        while live:
+            for i in list(live):
+                try:
+                    batches[i].append(next(iters[i]))
+                except StopIteration:
+                    if batches[i]:
+                        _push(heads[i], batches[i])
+                        batches[i] = []
+                    live.discard(i)
+                    continue
+                sent += 1
+                if len(batches[i]) >= _BATCH:
+                    _push(heads[i], batches[i])
+                    batches[i] = []
+                # per-record cadence: a barrier lands exactly every
+                # checkpoint_every records across all sources
+                if checkpoint_every and sent % checkpoint_every == 0:
+                    _inject_barrier()
+        for i in range(len(sources)):
+            _push(heads[i], [Eos()])
 
         # wait for EOS to reach the sink, surfacing operator failures
-        sink = ops[-1]
         import time
 
         deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
-            errors = ray_tpu.get([op.error.remote() for op in ops])
+            errors = ray_tpu.get([op.error.remote() for op in all_ops])
             bad = next((e for e in errors if e), None)
             if bad:
                 raise RuntimeError(f"stream operator failed:\n{bad}")
@@ -140,7 +217,7 @@ class StreamingContext:
         else:
             raise TimeoutError("stream did not reach EOS")
         ray_tpu.get(sink.drain.remote())
-        errors = ray_tpu.get([op.error.remote() for op in ops])
+        errors = ray_tpu.get([op.error.remote() for op in all_ops])
         bad = next((e for e in errors if e), None)
         if bad:  # an error that raced the EOS poll
             raise RuntimeError(f"stream operator failed:\n{bad}")

@@ -2,21 +2,37 @@
 
 Parity target: the reference's streaming engine (reference:
 streaming/src/ — DataWriter/DataReader data_writer.h, data_reader.h,
-credit-based flow_control.h, barrier/checkpoint reliability
-reliability/barrier_helper.h, transport over direct actor calls in
-streaming/src/queue/). Re-design: each operator is an async actor;
-records flow downstream as batched actor calls; the receiver admits at
-most ``capacity`` in-flight records per input channel and withholds
-the push REPLY while full — the sender awaits it, so the blocked reply
-is the credit window. Barriers flow in-band: an operator aligns barriers from
-all inputs, snapshots its state, and forwards the barrier downstream
-(Chandy-Lamport style, the public pattern the reference implements).
+credit-based flow_control.h, bounded ring_buffer/, barrier/checkpoint
+reliability reliability/barrier_helper.h, transport over direct actor
+calls in streaming/src/queue/). Re-design for the actor runtime:
+
+- **Per-edge credits.** Every input edge has its own bounded window
+  (``capacity`` records). ``push(channel, records)`` withholds its
+  reply while the edge is over capacity; credits replenish when the
+  records are *consumed*, not merely enqueued. The blocked reply is the
+  credit grant — the wire protocol needs no separate credit messages
+  (the reference's flow_control.h exchanges explicit credit counts
+  because its channels are shared-memory rings; an actor call's reply
+  slot already carries exactly one bit of "you may send again").
+- **Windowed senders.** An operator keeps up to ``SEND_WINDOW``
+  un-replied pushes in flight per downstream edge — pipelining without
+  unbounded queues (actor-call ordering keeps batches in order).
+- **Aligned barriers.** Chandy-Lamport alignment: when a barrier
+  arrives on one edge, that edge STALLS (its post-barrier records are
+  stashed, not processed) until the same barrier has arrived on every
+  edge; then the operator snapshots its state, forwards the barrier
+  once, and unstalls (reference: barrier_helper.h alignment).
+- EOS: an edge at end-of-stream auto-aligns for any later barrier.
 """
 
 from __future__ import annotations
 
 import asyncio
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional
+
+SEND_WINDOW = 4
+
 
 class Barrier:
     """In-band checkpoint marker (typed: user records can never be
@@ -28,6 +44,21 @@ class Barrier:
 
 class Eos:
     """In-band end-of-stream marker."""
+
+
+class _Edge:
+    """Receiver-side state of one input channel."""
+
+    __slots__ = ("inflight", "peak_inflight", "ready", "stalled_on",
+                 "stash", "eos")
+
+    def __init__(self):
+        self.inflight = 0          # admitted, not yet consumed
+        self.peak_inflight = 0     # high-water mark (tests/monitoring)
+        self.ready: deque = deque()  # admitted batches awaiting the consumer
+        self.stalled_on: Optional[int] = None  # barrier awaiting alignment
+        self.stash: List[Any] = []  # records held while stalled
+        self.eos = False
 
 
 class StreamOperator:
@@ -45,43 +76,57 @@ class StreamOperator:
         self.capacity = capacity
         self.num_inputs = num_inputs
         self.downstream = None           # ActorHandle or None (sink)
-        self._inflight = 0
-        self._space = asyncio.Condition()
-        self._queue: Optional[asyncio.Queue] = None
+        self._out_channel = 0
+        self._edges: Dict[int, _Edge] = {
+            i: _Edge() for i in range(num_inputs)}
+        self._work = asyncio.Condition()
         self._consumer: Optional[asyncio.Task] = None
-        self._barrier_waiting: Dict[int, int] = {}  # barrier_id → count
-        self._eos_seen = 0
+        self._outstanding: deque = deque()  # windowed downstream pushes
+        self._eos_forwarded = False
         self._state: Dict[Any, Any] = {}  # keyed-reduce state
         self._sink_out: List[Any] = []
         self._snapshots: Dict[int, dict] = {}
         self._error: Optional[str] = None
 
-    def set_downstream(self, handle) -> None:
+    def set_downstream(self, handle, channel: int = 0) -> None:
         self.downstream = handle
+        self._out_channel = channel
 
     # ---- data plane ----
 
-    async def push(self, records: List[Any]) -> None:
-        """Receive a batch from upstream. The reply is DELAYED while
-        the operator is over capacity — that blocked reply IS the
-        backpressure (the sender awaits it before sending more). A
-        single consumer task processes admitted batches strictly in
-        arrival order (records and barriers must not reorder)."""
+    async def push(self, records: List[Any], channel: int = 0) -> None:
+        """Receive a batch on one input edge. The reply is DELAYED
+        while the edge is over capacity — that blocked reply IS the
+        credit window; it replenishes when the consumer processes the
+        records, not when they are queued."""
+        edge = self._edges[channel]
         if self._consumer is None:
-            self._queue = asyncio.Queue()
             self._consumer = asyncio.get_running_loop().create_task(
                 self._consume_loop())
-        async with self._space:
-            await self._space.wait_for(
-                lambda: self._inflight < self.capacity)
-            self._inflight += len(records)
-        self._queue.put_nowait(records)
+        async with self._work:
+            await self._work.wait_for(
+                lambda: edge.inflight < self.capacity)
+            edge.inflight += len(records)
+            edge.peak_inflight = max(edge.peak_inflight, edge.inflight)
+            edge.ready.append(records)
+            self._work.notify_all()
+
+    def _runnable_edge(self) -> Optional[int]:
+        for cid, edge in self._edges.items():
+            if edge.ready and edge.stalled_on is None:
+                return cid
+        return None
 
     async def _consume_loop(self) -> None:
         while True:
-            records = await self._queue.get()
+            async with self._work:
+                await self._work.wait_for(
+                    lambda: self._runnable_edge() is not None)
+                cid = self._runnable_edge()
+                edge = self._edges[cid]
+                records = edge.ready.popleft()
             try:
-                await self._process(records)
+                await self._process_edge(cid, records)
             except Exception as e:  # noqa: BLE001 — driver polls error()
                 import traceback
 
@@ -91,29 +136,84 @@ class StreamOperator:
             finally:
                 # credit MUST return even when user code raised, or the
                 # channel wedges at capacity
-                async with self._space:
-                    self._inflight -= len(records)
-                    self._space.notify_all()
+                async with self._work:
+                    edge.inflight -= len(records)
+                    self._work.notify_all()
 
-    async def _process(self, records: List[Any]) -> None:
+    async def _process_edge(self, cid: int, records: List[Any]) -> None:
+        edge = self._edges[cid]
         out: List[Any] = []
-        control: List[Any] = []
-        for rec in records:
-            if isinstance(rec, (Barrier, Eos)):
-                control.append(rec)
+        i = 0
+        while i < len(records):
+            rec = records[i]
+            if isinstance(rec, Barrier):
+                # stall this edge; records after the barrier wait for
+                # alignment (they belong to the next epoch)
+                edge.stalled_on = rec.barrier_id
+                edge.stash.extend(records[i + 1:])
+                await self._flush(out)
+                out = []
+                await self._maybe_align(rec.barrier_id)
+                return
+            if isinstance(rec, Eos):
+                edge.eos = True
+                await self._flush(out)
+                out = []
+                await self._maybe_forward_eos()
+                # an ended edge can no longer block any barrier
+                for bid in list(self._pending_barriers()):
+                    await self._maybe_align(bid)
+                i += 1
                 continue
             out.extend(self._apply(rec))
-        if out:
+            i += 1
+        await self._flush(out)
+
+    def _pending_barriers(self) -> List[int]:
+        return sorted({e.stalled_on for e in self._edges.values()
+                       if e.stalled_on is not None})
+
+    async def _maybe_align(self, barrier_id: int) -> None:
+        """Snapshot + forward once EVERY live edge has stalled on this
+        barrier (edges at EOS auto-align)."""
+        for edge in self._edges.values():
+            if edge.eos:
+                continue
+            if edge.stalled_on != barrier_id:
+                return  # still waiting on this edge
+        self._snapshots[barrier_id] = {
+            "state": dict(self._state),
+            "sink_len": len(self._sink_out),
+        }
+        if self.downstream is not None:
+            await self._send([Barrier(barrier_id)])
+        # unstall: stashed (post-barrier) records become ready batches
+        async with self._work:
+            for edge in self._edges.values():
+                if edge.stalled_on == barrier_id:
+                    edge.stalled_on = None
+                    if edge.stash:
+                        # re-queue at the FRONT: stashed records precede
+                        # anything admitted later on this edge. They
+                        # re-enter the credit window (the consumer
+                        # returns credit per processed batch).
+                        edge.inflight += len(edge.stash)
+                        edge.ready.appendleft(list(edge.stash))
+                        edge.stash.clear()
+            self._work.notify_all()
+
+    async def _maybe_forward_eos(self) -> None:
+        if self._eos_forwarded:
+            return
+        if all(e.eos for e in self._edges.values()):
+            self._eos_forwarded = True
             if self.downstream is not None:
-                await self._send(out)
-            else:
-                self._sink_out.extend(out)
-        for rec in control:
-            await self._handle_control(rec)
+                await self._send([Eos()])
+            await self._drain_sends()
 
     def _apply(self, rec: Any) -> List[Any]:
-        if self.op_kind == "map":
-            return [self.fn(rec)]
+        if self.op_kind in ("map", "union"):
+            return [self.fn(rec)] if self.fn else [rec]
         if self.op_kind == "filter":
             return [rec] if self.fn(rec) else []
         if self.op_kind == "flat_map":
@@ -129,36 +229,36 @@ class StreamOperator:
             return [self.fn(rec) if self.fn else rec]
         raise ValueError(f"unknown op kind {self.op_kind!r}")
 
-    async def _send(self, records: List[Any]) -> None:
-        # the await paces this operator to the receiver's admission
-        # rate (the reply is withheld while the receiver is full)
-        await self.downstream.push.remote(records)
-
-    async def _handle_control(self, rec) -> None:
-        if isinstance(rec, Eos):
-            self._eos_seen += 1
-            if self._eos_seen >= self.num_inputs:
-                if self.downstream is not None:
-                    await self.downstream.push.remote([Eos()])
+    async def _flush(self, out: List[Any]) -> None:
+        if not out:
             return
-        barrier_id = rec.barrier_id
-        n = self._barrier_waiting.get(barrier_id, 0) + 1
-        self._barrier_waiting[barrier_id] = n
-        if n >= self.num_inputs:  # aligned: snapshot + forward
-            del self._barrier_waiting[barrier_id]
-            self._snapshots[barrier_id] = {
-                "state": dict(self._state),
-                "sink_len": len(self._sink_out),
-            }
-            if self.downstream is not None:
-                await self.downstream.push.remote([Barrier(barrier_id)])
+        if self.downstream is not None:
+            await self._send(out)
+        else:
+            self._sink_out.extend(out)
+
+    async def _send(self, records: List[Any]) -> None:
+        """Windowed pipelined push: up to SEND_WINDOW un-replied batches
+        in flight (replies are the receiver's credit grants; actor-call
+        ordering keeps the batches in order on the wire)."""
+        while len(self._outstanding) >= SEND_WINDOW:
+            await self._outstanding.popleft()
+        ref = self.downstream.push.remote(records, self._out_channel)
+        self._outstanding.append(asyncio.ensure_future(ref.as_future()))
+
+    async def _drain_sends(self) -> None:
+        while self._outstanding:
+            await self._outstanding.popleft()
 
     # ---- introspection (driver-side) ----
 
     async def drain(self) -> None:
         """Wait until everything admitted has been processed."""
-        async with self._space:
-            await self._space.wait_for(lambda: self._inflight == 0)
+        async with self._work:
+            await self._work.wait_for(
+                lambda: all(e.inflight == 0
+                            for e in self._edges.values()))
+        await self._drain_sends()
 
     async def sink_output(self) -> List[Any]:
         return list(self._sink_out)
@@ -167,11 +267,17 @@ class StreamOperator:
         return self._snapshots.get(barrier_id)
 
     async def eos_done(self) -> bool:
-        return self._eos_seen >= self.num_inputs
+        return self._eos_forwarded or \
+            all(e.eos for e in self._edges.values())
 
     async def error(self) -> Optional[str]:
         return self._error
 
     async def stats(self) -> dict:
-        return {"inflight": self._inflight,
-                "snapshots": sorted(self._snapshots)}
+        return {
+            "inflight": {c: e.inflight for c, e in self._edges.items()},
+            "peak_inflight": {c: e.peak_inflight
+                              for c, e in self._edges.items()},
+            "stalled": {c: e.stalled_on for c, e in self._edges.items()},
+            "snapshots": sorted(self._snapshots),
+        }

@@ -115,3 +115,65 @@ def test_worker_logs_stream_to_driver(capfd):
         assert "(pid=" in seen  # the log-monitor prefix
     finally:
         ray_tpu.shutdown()
+
+
+def test_dashboard_json_api(obs_cluster):
+    """Dashboard-lite: the head serves JSON cluster state under /api/
+    (reference: dashboard/head.py module views + per-node psutil stats
+    from reporter_agent.py:126)."""
+    import json
+    import urllib.error
+
+    @ray_tpu.remote
+    class Pinger:
+        def ping(self):
+            return "pong"
+
+    p = Pinger.options(name="dash_actor").remote()
+    assert ray_tpu.get(p.ping.remote()) == "pong"
+
+    addr = state.metrics_address()
+
+    def api(route):
+        with urllib.request.urlopen(f"http://{addr}{route}",
+                                    timeout=5) as resp:
+            assert resp.status == 200
+            return json.loads(resp.read())
+
+    nodes = api("/api/nodes")
+    assert len(nodes) == 1 and nodes[0]["alive"]
+    assert nodes[0]["resources_total"]["CPU"] == 2.0
+
+    # psutil host stats ride the heartbeat into the node view
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        stats = api("/api/nodes")[0]["stats"]
+        if "host_cpu_percent" in stats and "host_mem_total_bytes" in stats:
+            break
+        time.sleep(0.3)
+    assert stats["host_mem_total_bytes"] > 0, stats
+
+    actors = api("/api/actors")
+    named = [a for a in actors if a["name"] == "dash_actor"]
+    assert named and named[0]["state"] == "ALIVE"
+
+    cluster = api("/api/cluster")
+    assert cluster["nodes_alive"] == 1
+    assert cluster["resources_total"]["CPU"] == 2.0
+    assert cluster["actors"] >= 1
+
+    jobs = api("/api/jobs")
+    assert len(jobs) >= 1
+
+    metrics = api("/api/metrics")
+    assert "ray_tpu_gcs_nodes_alive" in metrics
+
+    # host gauges reach the Prometheus rendering too
+    _scrape_until("ray_tpu_node_cpu_percent")
+
+    # unknown routes 404 with a JSON error
+    try:
+        urllib.request.urlopen(f"http://{addr}/api/nope", timeout=5)
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404

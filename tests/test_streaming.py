@@ -60,7 +60,11 @@ def test_flow_control_bounds_inflight(stream_cluster):
            .execute())
     assert len(out) == 400
     stats = ray_tpu.get(ctx.operators[-1].stats.remote())
-    assert stats["inflight"] == 0
+    assert stats["inflight"] == {0: 0}
+    # bounded queue depth: the high-water mark stays at the credit
+    # window (capacity + at most one in-flight batch), nowhere near
+    # the 400-record stream (reference: flow_control.h credits)
+    assert stats["peak_inflight"][0] <= 32 + 64, stats
 
 
 def test_operator_error_propagates(stream_cluster):
@@ -102,3 +106,60 @@ def test_barrier_snapshots_consistent(stream_cluster):
 def test_empty_pipeline_passthrough(stream_cluster):
     ctx = streaming.StreamingContext()
     assert sorted(ctx.from_collection([3, 1, 2]).execute()) == [1, 2, 3]
+
+
+def test_union_fan_in_word_count(stream_cluster):
+    """Two branch pipelines merge into one multi-input stage
+    (reference: streaming python DataStream.union)."""
+    ctx = streaming.StreamingContext()
+    left = ctx.from_collection(["a b", "b"]).flat_map(str.split)
+    right = ctx.from_collection(["c a c"]).flat_map(str.split)
+    out = (left.union(right)
+           .map(lambda w: (w, 1))
+           .key_by(lambda kv: kv[0])
+           .map(lambda key_rec: (key_rec[0], key_rec[1][1]))
+           .reduce(lambda a, b: a + b)
+           .execute())
+    final = {}
+    for key, running in out:
+        final[key] = running
+    assert final == {"a": 2, "b": 2, "c": 2}
+
+
+def test_union_barrier_alignment(stream_cluster):
+    """Chandy-Lamport alignment across fan-in edges: the union's
+    snapshot at barrier k must reflect exactly the pre-barrier records
+    of BOTH branches, with the faster branch stalled until the slower
+    one's barrier arrives (reference: barrier_helper.h alignment)."""
+    import asyncio
+
+    from ray_tpu.streaming.runtime import Barrier, Eos, StreamOperator
+
+    op_cls = ray_tpu.remote(StreamOperator)
+    union = op_cls.remote("reduce", lambda a, b: a + b, 64, 2)
+    # feed both edges: k=... records then a barrier, staggered
+    ray_tpu.get(union.push.remote([("k", 1), ("k", 2)], 0))
+    ray_tpu.get(union.push.remote([Barrier(1), ("k", 100)], 0))  # edge 0 stalls
+    time.sleep(0.2)
+    snap = ray_tpu.get(union.snapshot.remote(1))
+    assert snap is None  # not aligned yet: edge 1's barrier missing
+    ray_tpu.get(union.push.remote([("k", 4)], 1))
+    ray_tpu.get(union.push.remote([Barrier(1)], 1))
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        snap = ray_tpu.get(union.snapshot.remote(1))
+        if snap is not None:
+            break
+        time.sleep(0.02)
+    # snapshot covers 1+2 (edge 0) + 4 (edge 1), NOT the post-barrier 100
+    assert snap is not None and snap["state"] == {"k": 7}, snap
+    ray_tpu.get(union.push.remote([Eos()], 0))
+    ray_tpu.get(union.push.remote([Eos()], 1))
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if ray_tpu.get(union.eos_done.remote()):
+            break
+        time.sleep(0.02)
+    # after alignment the stalled 100 was processed
+    out = ray_tpu.get(union.sink_output.remote())
+    assert out[-1] == ("k", 107), out
