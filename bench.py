@@ -1,17 +1,31 @@
 #!/usr/bin/env python
-"""Driver benchmark: task throughput microbenchmark, one JSON line to stdout.
+"""Driver benchmark: task throughput microbenchmarks, one JSON line to stdout.
 
 Mirrors the reference's `ray microbenchmark` harness
-(reference: python/ray/_private/ray_perf.py, CLI scripts.py:1421).
-Primary metric: single-client async no-arg task throughput, vs the
-reference's published 13,546.95 tasks/s on a 64-vCPU m5.16xlarge
-(BASELINE.md, release/release_logs/1.6.0/microbenchmark.txt:10).
+(reference: python/ray/_private/ray_perf.py, CLI scripts.py:1421) plus the
+single-node scalability drain (reference: release scalability suite,
+release/release_logs/1.6.0/scalability/single_node.txt "Queued task time":
+1M queued tasks in 154.0s).
 
-Output: {"metric": ..., "value": N, "unit": "tasks/s", "vs_baseline": N}
+Rows vs BASELINE.md:
+  - single client tasks async  (13,546.95/s)   — primary metric
+  - single client tasks sync   (1,488.59/s)
+  - multi client tasks async   (39,337.9/s)
+  - 1:1 actor calls async      (5,904.3/s)
+  - single client put          (37,315.16/s)
+  - single client put GB/s     (19.3 GB/s)
+  - 1M-task drain              (154.0 s) + p50/p99 task sojourn latency
+    and raylet lease-decision latency percentiles
+
+Output: {"metric": ..., "value": N, "unit": "tasks/s", "vs_baseline": N,
+         "extras": {...}}
 """
+import concurrent.futures
+import functools
 import json
 import os
 import sys
+import threading
 import time
 
 # Workers stay on CPU jax; the head's batched scheduler may use the TPU.
@@ -20,9 +34,30 @@ os.environ.setdefault("RAY_TPU_WORKER_JAX_PLATFORMS", "cpu")
 # (host backend is the correctness oracle; see scheduler/__init__.py).
 os.environ.setdefault("RAY_TPU_SCHEDULER_BACKEND", "tpu_batched")
 
-BASELINE_TASKS_ASYNC = 13546.95  # reference microbenchmark.txt:10
-BASELINE_ACTOR_ASYNC = 5904.3    # reference microbenchmark.txt:13
-BASELINE_PUT_PER_S = 37315.16    # reference microbenchmark.txt:2
+BASELINE_TASKS_ASYNC = 13546.95   # reference microbenchmark.txt:10
+BASELINE_TASKS_SYNC = 1488.59     # microbenchmark.txt:9
+BASELINE_MULTI_CLIENT = 39337.9   # microbenchmark.txt:11
+BASELINE_ACTOR_ASYNC = 5904.3     # microbenchmark.txt:13
+BASELINE_PUT_PER_S = 37315.16     # microbenchmark.txt:2
+BASELINE_PUT_GBPS = 19.3          # microbenchmark.txt:7
+BASELINE_MILLION_S = 154.0        # scalability/single_node.txt
+
+
+_T0 = time.perf_counter()
+
+if os.environ.get("BENCH_TRACE"):
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+
+
+def _trace(msg: str) -> None:
+    """Stage timestamps to stderr (BENCH_TRACE=1); the JSON line on
+    stdout stays machine-clean either way."""
+    if os.environ.get("BENCH_TRACE"):
+        print(f"[bench +{time.perf_counter() - _T0:7.1f}s] {msg}",
+              file=sys.stderr, flush=True)
 
 
 def timeit(fn, warmup=1, repeat=3):
@@ -64,6 +99,13 @@ def main():
         ray_tpu.get([small_task.remote() for _ in range(n_tasks)])
         return n_tasks
 
+    n_sync = max(100, n_tasks // 10)
+
+    def bench_tasks_sync():
+        for _ in range(n_sync):
+            ray_tpu.get(small_task.remote())
+        return n_sync
+
     counter = Counter.remote()
     ray_tpu.get(counter.ping.remote())
 
@@ -76,9 +118,116 @@ def main():
         ray_tpu.get(refs[-1])
         return n_tasks
 
+    def bench_put_gb():
+        import numpy as np
+
+        mb64 = np.ones(8 * 1024 * 1024, dtype=np.float64)  # 64 MB
+        nput = 16
+        refs = [ray_tpu.put(mb64) for _ in range(nput)]
+        del refs
+        return nput * 64 / 1024.0  # GB
+
+    def memcpy_gbps():
+        """This box's raw memory bandwidth — the physical ceiling for
+        the zero-copy put path (one memcpy into shm). The reference's
+        19.3 GB/s ran on m4.16xlarge-class memory."""
+        import numpy as np
+
+        src = np.ones(8 * 1024 * 1024, dtype=np.float64)
+        dst = np.empty_like(src)
+        t0 = time.perf_counter()
+        for _ in range(4):
+            np.copyto(dst, src)
+        return (4 * 64 / 1024.0) / (time.perf_counter() - t0)
+
+    _trace("init done; tasks_async")
     tasks_per_s = timeit(bench_tasks_async)
+    _trace("tasks_sync")
+    tasks_sync_per_s = timeit(bench_tasks_sync, warmup=0, repeat=2)
+    _trace("actor_async")
     actor_per_s = timeit(bench_actor_async)
+    _trace("puts")
     puts_per_s = timeit(bench_puts)
+    _trace("put_gb")
+    put_gbps = timeit(bench_put_gb, warmup=1, repeat=2)
+    mem_gbps = memcpy_gbps()
+    _trace("multi_client")
+
+    # ---- multi-client: extra driver processes against this cluster ----
+    multi_per_s = 0.0
+    try:
+        multi_per_s = _multi_client(n_tasks)
+    except Exception:  # noqa: BLE001 — secondary row must not kill bench
+        pass
+
+    _trace(f"multi_client done ({multi_per_s:.0f}/s); drain")
+    # ---- the 1M-task drain (scalability row + latency percentiles) ----
+    num_drain = int(os.environ.get("BENCH_NUM_DRAIN", "1000000"))
+    probe_every = max(1, num_drain // 128)
+    probes = []
+    probes_lock = threading.Lock()
+    probe_futs = []
+    refs = []
+    chunk = 20_000
+    t0 = time.perf_counter()
+    submitted = 0
+
+    def _probe_done(_f, t):
+        with probes_lock:
+            probes.append(time.perf_counter() - t)
+
+    while submitted < num_drain:
+        n = min(chunk, num_drain - submitted)
+        refs.extend(small_task.remote() for _ in range(n))
+        submitted += n
+        while len(probe_futs) < submitted // probe_every:
+            t_probe = time.perf_counter()
+            fut = small_task.remote().future()
+            fut.add_done_callback(
+                functools.partial(_probe_done, t=t_probe))
+            probe_futs.append(fut)
+    drain_timed_out = False
+    for start in range(0, len(refs), chunk):
+        try:
+            # generous per-chunk guard: a wedged cluster must still let
+            # the bench emit its JSON line rather than hang the driver
+            ray_tpu.get(refs[start:start + chunk],
+                        timeout=float(os.environ.get(
+                            "BENCH_CHUNK_TIMEOUT", "300")))
+        except Exception:  # noqa: BLE001 — GetTimeoutError et al.
+            drain_timed_out = True
+            num_drain = start  # completed portion only
+            try:  # wedge forensics (BENCH_TRACE only)
+                r = ray_tpu.worker.global_worker.node.raylet
+                _trace(f"avail={r.resources_available} "
+                       f"pending={len(r._pending)} "
+                       f"leases={[(lid, e.resources) for lid, e in r.leases.items()]} "
+                       f"workers={[(w.state, w.job_id.hex()[:6], w.lease_id) for w in r.workers.values()]}")
+            except Exception as e:  # noqa: BLE001
+                _trace(f"forensics failed: {e}")
+            break
+    drain_wall = time.perf_counter() - t0
+    _trace(f"drain done in {drain_wall:.1f}s timeout={drain_timed_out}")
+    refs = None
+    # quiesce the probe callbacks, then read under the lock — wait()
+    # can return (timeout, or waiter woken pre-callback) while a late
+    # completion is still appending
+    concurrent.futures.wait(probe_futs, timeout=60)
+    with probes_lock:
+        probes = sorted(probes)
+
+    from ray_tpu._private.metrics import percentile
+
+    def pct(p):
+        return percentile(probes, p) if probes else 0.0
+
+    # raylet-side lease-decision latency percentiles
+    lease_lat = {}
+    try:
+        node = ray_tpu.worker.global_worker.node
+        lease_lat = node.raylet._latency_percentiles()
+    except Exception:  # noqa: BLE001
+        pass
 
     ray_tpu.shutdown()
 
@@ -90,14 +239,80 @@ def main():
         "extras": {
             "scheduler_backend": os.environ.get(
                 "RAY_TPU_SCHEDULER_BACKEND", "host"),
+            "tasks_sync_per_s": round(tasks_sync_per_s, 1),
+            "tasks_sync_vs_baseline": round(
+                tasks_sync_per_s / BASELINE_TASKS_SYNC, 4),
+            "multi_client_tasks_per_s": round(multi_per_s, 1),
+            "multi_client_vs_baseline": round(
+                multi_per_s / BASELINE_MULTI_CLIENT, 4),
             "actor_calls_async_per_s": round(actor_per_s, 1),
             "actor_vs_baseline": round(actor_per_s / BASELINE_ACTOR_ASYNC, 4),
             "puts_per_s": round(puts_per_s, 1),
             "puts_vs_baseline": round(puts_per_s / BASELINE_PUT_PER_S, 4),
+            "put_gb_per_s": round(put_gbps, 2),
+            "put_gb_vs_baseline": round(put_gbps / BASELINE_PUT_GBPS, 4),
+            "host_memcpy_gb_per_s": round(mem_gbps, 2),
+            "put_vs_memcpy_ceiling": round(put_gbps / mem_gbps, 4),
+            "million_drain": {
+                "num_tasks": num_drain,
+                "timed_out": drain_timed_out,
+                "wall_s": round(drain_wall, 1),
+                "tasks_per_s": round(num_drain / drain_wall, 1),
+                "vs_baseline_154s": round(
+                    BASELINE_MILLION_S / drain_wall
+                    * (num_drain / 1_000_000), 4),
+                "task_sojourn_p50_ms": round(pct(0.50) * 1e3, 2),
+                "task_sojourn_p99_ms": round(pct(0.99) * 1e3, 2),
+                "lease_schedule_latency": lease_lat,
+            },
         },
     }
     print(json.dumps(result))
     return 0
+
+
+def _multi_client(n_tasks: int) -> float:
+    """Aggregate async-task throughput with 2 extra driver processes
+    (reference: ray_perf.py multi-client row runs parallel drivers)."""
+    import subprocess
+    import sys as _sys
+
+    import ray_tpu
+
+    gcs = ray_tpu.worker.global_worker.core.gcs_address
+    script = (
+        "import faulthandler,os,sys,time\n"
+        # self-terminating watchdog: a wedged child (device-plugin GIL
+        # hang) must not stall the parent's communicate() for long
+        "faulthandler.dump_traceback_later(120, exit=True)\n"
+        "import ray_tpu\n"
+        f"ray_tpu.init(address={gcs!r})\n"
+        "@ray_tpu.remote\n"
+        "def t(): return b'ok'\n"
+        f"n={n_tasks}\n"
+        "ray_tpu.get([t.remote() for _ in range(200)])\n"
+        "t0=time.perf_counter()\n"
+        "ray_tpu.get([t.remote() for _ in range(n)])\n"
+        "print('RATE', n/(time.perf_counter()-t0))\n"
+        "ray_tpu.shutdown()\n")
+    env = dict(os.environ)
+    procs = [subprocess.Popen([_sys.executable, "-c", script],
+                              stdout=subprocess.PIPE, env=env, text=True)
+             for _ in range(2)]
+    total = 0.0
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=150)
+            for line in out.splitlines():
+                if line.startswith("RATE"):
+                    total += float(line.split()[1])
+    finally:
+        # a straggler left running would poison the drain timing below
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    return total
 
 
 if __name__ == "__main__":

@@ -18,6 +18,13 @@ _DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
                     50.0, 100.0)
 
 
+def percentile(sorted_seq, p: float):
+    """Nearest-rank percentile of an ascending-sorted sequence (the one
+    definition shared by the raylet latency stats and bench.py, so the
+    two rows stay comparable)."""
+    return sorted_seq[min(len(sorted_seq) - 1, int(p * len(sorted_seq)))]
+
+
 def _label_key(labels: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted((labels or {}).items()))
 

@@ -135,6 +135,14 @@ class Raylet:
         self._serve_attachments: Dict[str, Any] = {}
         self.num_leases_granted = 0
         self.num_spillbacks = 0
+        # Schedule latency (request arrival -> decision dispatched), a
+        # bounded reservoir for percentile reporting (reference: the
+        # north-star p50/p99 schedule-latency metric, BASELINE.json).
+        from collections import deque as _deque
+        self._sched_latencies: Any = _deque(maxlen=65536)
+        # (queue_len, wall_s) per scheduler tick — the pure decision
+        # cost of the kernel, free of queueing effects.
+        self._tick_durations: Any = _deque(maxlen=65536)
 
     def _handlers(self):
         return {
@@ -298,6 +306,9 @@ class Raylet:
             out["raylet_rss_bytes"] = float(proc.memory_info().rss)
         except Exception:  # noqa: BLE001 — stats are best-effort
             pass
+        # NOTE: latency percentiles are deliberately NOT computed here —
+        # sorting a 64k reservoir 4x/s on the event loop would stall
+        # heartbeats under load; GetNodeStats computes them on demand.
         return out
 
     async def _heartbeat_loop(self):
@@ -502,6 +513,7 @@ class Raylet:
             pg_bundle=summary.get("pg_bundle", -1),
             env_hash=runtime_env_mod.hash_runtime_env(
                 summary.get("runtime_env")),
+            arrival_ts=time.monotonic(),
         )
         self._init_dep_state(req, summary.get("dep_info") or [])
         fut = asyncio.get_running_loop().create_future()
@@ -591,8 +603,12 @@ class Raylet:
                 pg_grants.append((rid, req, fut))
             else:
                 reqs.append(req)
+        t_tick = time.monotonic()
         decisions = self.backend.schedule(
             reqs, nodes, self.config.scheduler_spread_threshold) if reqs else []
+        if reqs:
+            self._tick_durations.append(
+                (len(reqs), time.monotonic() - t_tick))
         for d in decisions:
             req, fut = self._pending.get(d.req_id, (None, None))
             if req is None or fut.done():
@@ -603,9 +619,11 @@ class Raylet:
             elif d.action == SPILL:
                 self.num_spillbacks += 1
                 self._pending.pop(d.req_id, None)
+                self._note_latency(req)
                 fut.set_result(({"granted": False, "spill": d.spill_address}, ()))
             elif d.action == INFEASIBLE:
                 self._pending.pop(d.req_id, None)
+                self._note_latency(req)
                 fut.set_result(({"granted": False, "infeasible": True}, ()))
             # WAIT: stays pending.
         for rid, req, fut in pg_grants:
@@ -631,13 +649,16 @@ class Raylet:
             return  # stays pending until a worker registers/frees
         worker.env_hash = req.env_hash
         self._pending.pop(req_id, None)
+        self._note_latency(req)
         lease_id = next(self._lease_counter)
         for k, v in req.resources.items():
             self.resources_available[k] = self.resources_available.get(k, 0.0) - v
         worker.state = WORKER_LEASED
         worker.lease_id = lease_id
         client = getattr(fut, "client", None)
-        self.leases[lease_id] = LeaseEntry(lease_id, worker, req.resources, client)
+        lease = LeaseEntry(lease_id, worker, req.resources, client)
+        self.leases[lease_id] = lease
+        self._watch_lease_client(lease)
         self.num_leases_granted += 1
         fut.set_result(({"granted": True, "lease_id": lease_id,
                          "worker_address": worker.address,
@@ -662,6 +683,7 @@ class Raylet:
             return
         worker.env_hash = req.env_hash
         self._pending.pop(req_id, None)
+        self._note_latency(req)
         for k, v in req.resources.items():
             bundle_avail[k] = bundle_avail.get(k, 0.0) - v
         lease_id = next(self._lease_counter)
@@ -671,6 +693,7 @@ class Raylet:
                            getattr(fut, "client", None))
         lease.pg_key = key  # type: ignore[attr-defined]
         self.leases[lease_id] = lease
+        self._watch_lease_client(lease)
         self.num_leases_granted += 1
         fut.set_result(({"granted": True, "lease_id": lease_id,
                          "worker_address": worker.address,
@@ -682,10 +705,40 @@ class Raylet:
                             worker_alive=not header.get("worker_died", False))
         return {"ok": True}
 
+    def _watch_lease_client(self, lease: LeaseEntry):
+        """Reclaim a granted lease if its owner's connection drops.
+
+        Without this a driver that exits while holding leases leaks the
+        leased resources forever and every later lease WAITs — the
+        reference ties worker leases to owner liveness the same way
+        (node manager DisconnectClient → owned-worker teardown). The
+        worker is killed, not recycled: it may be mid-task for the dead
+        job, and a poisoned "idle" worker would stall its next lease."""
+        conn = lease.client
+        if conn is None:
+            return
+
+        def _on_client_drop(c, lid=lease.lease_id):
+            entry = self.leases.get(lid)
+            if entry is None:
+                return
+            logger.warning(
+                "lease %d owner disconnected; reclaiming worker %s",
+                lid, entry.worker.worker_id.hex()[:8])
+            self._kill_worker(entry.worker)
+            self._release_lease(lid, worker_alive=False)
+
+        lease.on_client_drop = _on_client_drop  # type: ignore[attr-defined]
+        conn.on_disconnect.append(_on_client_drop)
+
     def _release_lease(self, lease_id: int, worker_alive: bool = True):
         lease = self.leases.pop(lease_id, None)
         if lease is None:
             return
+        cb = getattr(lease, "on_client_drop", None)
+        if cb is not None and lease.client is not None and \
+                cb in lease.client.on_disconnect:
+            lease.client.on_disconnect.remove(cb)
         pg_key = getattr(lease, "pg_key", None)
         if pg_key is not None and pg_key in self._pg_available:
             for k, v in lease.resources.items():
@@ -1101,8 +1154,39 @@ class Raylet:
 
     # -------------------------------------------------------------- stats
 
+    def _note_latency(self, req) -> None:
+        if getattr(req, "arrival_ts", 0.0):
+            self._sched_latencies.append(
+                time.monotonic() - req.arrival_ts)
+
+    def _latency_percentiles(self) -> dict:
+        from ray_tpu._private.metrics import percentile
+
+        lat = sorted(self._sched_latencies)
+        if not lat:
+            return {"count": 0}
+        out = {
+            "count": len(lat),
+            "p50_ms": round(percentile(lat, 0.50) * 1e3, 3),
+            "p90_ms": round(percentile(lat, 0.90) * 1e3, 3),
+            "p99_ms": round(percentile(lat, 0.99) * 1e3, 3),
+            "max_ms": round(lat[-1] * 1e3, 3),
+        }
+        ticks = list(self._tick_durations)
+        if ticks:
+            durs = sorted(t for _, t in ticks)
+            out["tick"] = {
+                "count": len(ticks),
+                "p50_ms": round(percentile(durs, 0.50) * 1e3, 3),
+                "p99_ms": round(percentile(durs, 0.99) * 1e3, 3),
+                "max_queue": max(n for n, _ in ticks),
+                "max_ms": round(durs[-1] * 1e3, 3),
+            }
+        return out
+
     async def handle_get_node_stats(self, conn, header, bufs):
         return {
+            "schedule_latency": self._latency_percentiles(),
             "node_id": self.node_id.binary(),
             "address": self.address,
             "resources_total": self.resources_total,

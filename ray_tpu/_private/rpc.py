@@ -285,7 +285,9 @@ class Connection:
                 fut.set_exception(ConnectionError(
                     f"connection to {self.peer_name} lost"))
         self._pending.clear()
-        for cb in self.on_disconnect:
+        # snapshot: callbacks may unregister themselves (or siblings)
+        # from the live list mid-iteration, which would skip entries
+        for cb in list(self.on_disconnect):
             try:
                 cb(self)
             except Exception:

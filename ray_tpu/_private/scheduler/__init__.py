@@ -47,6 +47,8 @@ class PendingRequest:
     # deps may still SPILL to a node that already holds them, but a local
     # GRANT waits for the pull.
     deps_ready: bool = True
+    # monotonic arrival time (schedule-latency accounting)
+    arrival_ts: float = 0.0
 
 
 @dataclass

@@ -1,4 +1,5 @@
-"""tpu_batched scheduling backend: the decision path as one JAX kernel.
+"""tpu_batched scheduling backend: the decision path as one JAX kernel
+over STATE-RESIDENT arrays.
 
 The north-star design (BASELINE.json): instead of per-task callback chains
 (reference: ClusterTaskManager::DispatchScheduledTasksToWorkers,
@@ -10,21 +11,30 @@ single jit-compiled program over arrays:
   * locality [T, N]  — bytes of each task's args already on each node
   * is_local [N]
 
-One ``lax.scan`` over tasks (grants must see earlier grants' resource
-consumption — inherently sequential) with fully vectorized per-node
-feasibility + fixed-point scoring inside each step; XLA fuses the scan body
-into one kernel, so a tick over thousands of pending tasks is one device
-launch instead of thousands of callback invocations. Sizes are bucketed to
-keep retraces rare.
+The request-side arrays are **resident**: they live on the kernel device
+across ticks, keyed by slot. A tick uploads only the DELTA — rows for
+newly arrived / changed requests, cleared validity bits for departed
+ones — so tick cost is O(changes) + one kernel launch, not O(T × N)
+Python work (the round-2 shape). Requests keep their slot for life; a
+per-tick permutation restores arrival order inside the kernel (grants
+must see earlier grants' resource consumption, so the scan is ordered).
 
-Placements are bit-identical to the host backend (shared fixed-point score,
-scheduler/scoring.py); tests/test_scheduler_diff.py enforces it.
+One ``lax.scan`` over tasks with fully vectorized per-node feasibility +
+fixed-point scoring inside each step; XLA fuses gather + scan into one
+program, so a tick over thousands of pending tasks is one device launch
+instead of thousands of callback invocations. Capacities are bucketed
+(powers of two) to keep retraces rare; growth copies into a bigger
+bucket. Ticks are submit-triggered and coalesced by the raylet
+(_schedule_tick schedules at most one tick per loop turn).
+
+Placements are bit-identical to the host backend (shared fixed-point
+score, scheduler/scoring.py); tests/test_scheduler_diff.py enforces it.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from ray_tpu._private.scheduler import (
     GRANT, INFEASIBLE, SPILL, WAIT, Decision, NodeView, PendingRequest,
@@ -76,18 +86,53 @@ def _kernel_device():
     return jax.local_devices(backend="cpu")[0]
 
 
+@functools.lru_cache(maxsize=1)
+def _preflight_backend_init(attempts: int = 2, timeout_s: float = 60.0,
+                            retry_sleep_s: float = 10.0) -> bool:
+    """True if jax backend init completes in a throwaway subprocess.
+
+    Runs the same ``jax.local_devices(backend="cpu")`` call that
+    ``_kernel_device`` will make, but in a child process under a hard
+    timeout, with the same environment (so a backend-resolution-
+    wrapping device plugin is exercised too)."""
+    import os
+    import subprocess
+    import sys
+    import time
+
+    for i in range(attempts):
+        if i:
+            time.sleep(retry_sleep_s)
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; jax.local_devices(backend='cpu')"],
+                env=dict(os.environ), timeout=timeout_s,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            if r.returncode == 0:
+                return True
+        except subprocess.TimeoutExpired:
+            pass
+        except Exception:  # noqa: BLE001 — treat as not responsive
+            return False
+    return False
+
+
 @functools.lru_cache(maxsize=None)
 def _compiled_kernel(t_bucket: int, n_bucket: int, r_bucket: int):
+    """Gather (slot → arrival order) + feasibility/scoring scan, fused
+    into one jitted program."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    def kernel(demands, totals, avail0, locality, is_local, valid_task,
-               valid_node, dep_ready, spread_fp):
-        # demands [T,R] f32, totals/avail0 [N,R] f32, locality [T,N] i32,
-        # is_local [N] bool, valid_* masks, dep_ready [T] bool (frontier:
-        # the local dependency manager finished prefetching this task's
-        # args), spread_fp scalar i64.
+    def kernel(demands_s, locality_s, dep_ready_s, perm, totals, avail0,
+               is_local, valid_task, valid_node, spread_fp):
+        # *_s are SLOT-ordered resident arrays; perm maps scan position
+        # (arrival order) → slot. valid_task is per scan POSITION.
+        demands = demands_s[perm]
+        locality = locality_s[perm]
+        dep_ready = dep_ready_s[perm]
         inv_totals = jnp.where(totals > 0, 1.0 / jnp.maximum(totals, 1e-9), 0.0)
         local_idx = jnp.argmax(is_local)
 
@@ -141,9 +186,42 @@ def _compiled_kernel(t_bucket: int, n_bucket: int, r_bucket: int):
         return jitted
 
     def run_on_device(*args):
-        return jitted(*(jax.device_put(a, device) for a in args))
+        import jax
+
+        return jitted(*(a if hasattr(a, "devices") else
+                        jax.device_put(a, device) for a in args))
 
     return run_on_device
+
+
+@functools.lru_cache(maxsize=1)
+def _row_scatter():
+    """Jitted row scatter (jit caches per shape/dtype on its own)."""
+    import jax
+
+    return jax.jit(lambda arr, idx, rows: arr.at[idx].set(rows))
+
+
+class _ResidentState:
+    """Slot-addressed request arrays living on the kernel device."""
+
+    def __init__(self, cap_t: int, cap_n: int, cap_r: int, device):
+        import jax
+        import jax.numpy as jnp
+
+        import contextlib
+
+        self.cap_t, self.cap_n, self.cap_r = cap_t, cap_n, cap_r
+        with jax.default_device(device) if device is not None else \
+                contextlib.nullcontext():
+            self.demands = jnp.zeros((cap_t, cap_r), jnp.float32)
+            self.locality = jnp.zeros((cap_t, cap_n), jnp.int32)
+            self.dep_ready = jnp.ones((cap_t,), bool)
+        self.slots: Dict[int, int] = {}       # req_id -> slot
+        self.free: List[int] = list(range(cap_t - 1, -1, -1))
+        # per-request fingerprint of the mutable inputs (deps_ready +
+        # locality dict) so changed rows re-upload
+        self.finger: Dict[int, tuple] = {}
 
 
 class TpuBatchedBackend(SchedulingBackend):
@@ -166,11 +244,30 @@ class TpuBatchedBackend(SchedulingBackend):
         self._fallback = HostBackend()
         self._kernel_ready = False
         self._probe_done = threading.Event()
+        self._state: Optional[_ResidentState] = None
+        self._node_order: List[bytes] = []
+        self.num_row_uploads = 0   # introspection: delta-upload counter
+        self.num_rebuilds = 0
 
         def probe():
             try:
-                _kernel_device()
-                self._kernel_ready = True
+                # Pre-flight in a DISPOSABLE SUBPROCESS first: a wedged
+                # device plugin (e.g. a dead TPU tunnel) blocks inside
+                # backend init while holding the GIL, which would freeze
+                # the whole driver process — not just this thread. A
+                # subprocess can be timed out and killed; only when it
+                # proves the plugin responsive do we init in-process.
+                # Exception: a process already pinned to CPU-only jax
+                # (jax.config or env) resolves backends without the
+                # plugin — direct init is safe and the subprocess would
+                # wrongly probe the plugin-wrapped path.
+                import jax
+
+                pinned_cpu = "cpu" in str(
+                    getattr(jax.config, "jax_platforms", None) or "")
+                if pinned_cpu or _preflight_backend_init():
+                    _kernel_device()
+                    self._kernel_ready = True
             except Exception:  # noqa: BLE001 — any init failure
                 pass
             finally:
@@ -193,18 +290,9 @@ class TpuBatchedBackend(SchedulingBackend):
         self._probe_done.wait(timeout_s)
         return self._kernel_ready
 
-    def schedule(self, pending: List[PendingRequest],
-                 nodes: List[NodeView],
-                 spread_threshold: float) -> List[Decision]:
-        import numpy as np
+    # ---------------------------------------------------------- resident
 
-        if not pending:
-            return []
-        if not self._kernel_ready:
-            return self._fallback.schedule(pending, nodes,
-                                           spread_threshold)
-        # Stable resource-kind interning across ticks (reference:
-        # scheduling_ids.h string->int interning).
+    def _intern_kinds(self, pending, nodes) -> List[str]:
         kinds = list(self._resource_names)
         known = set(kinds)
         for req in pending:
@@ -218,26 +306,104 @@ class TpuBatchedBackend(SchedulingBackend):
                     kinds.append(k)
                     known.add(k)
         self._resource_names = kinds
+        return kinds
 
-        T, N, R = len(pending), len(nodes), max(len(kinds), 1)
-        tb, nb, rb = _bucket(T), _bucket(N), _bucket(R)
-        demands = np.zeros((tb, rb), dtype=np.float32)
-        locality = np.zeros((tb, nb), dtype=np.int32)
-        totals = np.zeros((nb, rb), dtype=np.float32)
-        avail = np.zeros((nb, rb), dtype=np.float32)
-        is_local = np.zeros((nb,), dtype=bool)
-        valid_task = np.zeros((tb,), dtype=bool)
-        valid_node = np.zeros((nb,), dtype=bool)
-        dep_ready = np.ones((tb,), dtype=bool)
+    @staticmethod
+    def _fingerprint(req: PendingRequest) -> tuple:
+        # exact: the host oracle reads locality dicts directly, so a
+        # missed change would diverge the differential tests
+        return (req.deps_ready, tuple(sorted(req.locality.items())))
+
+    def _ensure_state(self, n_pending: int, nodes: List[NodeView],
+                      kinds: List[str]) -> _ResidentState:
+        """(Re)build the resident arrays when capacities or the node
+        column order change; otherwise return the live state."""
+        node_order = [n.node_id for n in nodes]
+        st = self._state
+        # Sized from n_pending alone: each tick reconciles slots to
+        # exactly the pending set before allocating, so n_pending live
+        # requests always fit an n_pending-bucket capacity.
+        need_t = _bucket(n_pending)
+        need_n = _bucket(len(nodes))
+        need_r = _bucket(max(len(kinds), 1))
+        if (st is None or need_t > st.cap_t or need_n != st.cap_n
+                or need_r != st.cap_r or node_order != self._node_order):
+            self._state = _ResidentState(
+                max(need_t, st.cap_t if st else 0), need_n, need_r,
+                _kernel_device())
+            self._node_order = node_order
+            self.num_rebuilds += 1
+            # existing requests re-upload on this tick (their
+            # fingerprints are dropped)
+        return self._state
+
+    def schedule(self, pending: List[PendingRequest],
+                 nodes: List[NodeView],
+                 spread_threshold: float) -> List[Decision]:
+        import numpy as np
+
+        if not pending:
+            return []
+        if not self._kernel_ready:
+            return self._fallback.schedule(pending, nodes,
+                                           spread_threshold)
+        # Stable resource-kind interning across ticks (reference:
+        # scheduling_ids.h string->int interning).
+        kinds = self._intern_kinds(pending, nodes)
         kidx = {k: i for i, k in enumerate(kinds)}
-        for ti, req in enumerate(pending):
-            valid_task[ti] = True
-            dep_ready[ti] = req.deps_ready
-            for k, v in req.resources.items():
-                if v > 0:
-                    demands[ti, kidx[k]] = v
-            for ni, n in enumerate(nodes):
-                locality[ti, ni] = min(req.locality.get(n.node_id, 0), 2**31 - 1)
+        nidx = {n.node_id: i for i, n in enumerate(nodes)}
+        st = self._ensure_state(len(pending), nodes, kinds)
+        T, N = len(pending), len(nodes)
+        tb, nb, rb = st.cap_t, st.cap_n, st.cap_r
+
+        # ---- delta detection: new / changed / departed requests ----
+        current = set()
+        dirty: List[PendingRequest] = []
+        for req in pending:
+            current.add(req.req_id)
+            fp = self._fingerprint(req)
+            if st.finger.get(req.req_id) != fp:
+                st.finger[req.req_id] = fp
+                dirty.append(req)
+        for req_id in [r for r in st.slots if r not in current]:
+            st.free.append(st.slots.pop(req_id))
+            st.finger.pop(req_id, None)
+
+        if dirty:
+            idx = np.empty((len(dirty),), np.int32)
+            drows = np.zeros((len(dirty), rb), np.float32)
+            lrows = np.zeros((len(dirty), nb), np.int32)
+            deps = np.ones((len(dirty),), bool)
+            for i, req in enumerate(dirty):
+                slot = st.slots.get(req.req_id)
+                if slot is None:
+                    slot = st.free.pop()
+                    st.slots[req.req_id] = slot
+                idx[i] = slot
+                for k, v in req.resources.items():
+                    if v > 0:
+                        drows[i, kidx[k]] = v
+                for node_id, nbytes in req.locality.items():
+                    ni = nidx.get(node_id)
+                    if ni is not None:
+                        lrows[i, ni] = min(nbytes, 2**31 - 1)
+                deps[i] = req.deps_ready
+            scatter = _row_scatter()
+            st.demands = scatter(st.demands, idx, drows)
+            st.locality = scatter(st.locality, idx, lrows)
+            st.dep_ready = scatter(st.dep_ready, idx, deps)
+            self.num_row_uploads += len(dirty)
+
+        # ---- per-tick small inputs (arrival order + node table) ----
+        perm = np.zeros((tb,), np.int32)
+        valid_task = np.zeros((tb,), bool)
+        for pos, req in enumerate(pending):
+            perm[pos] = st.slots[req.req_id]
+            valid_task[pos] = True
+        totals = np.zeros((nb, rb), np.float32)
+        avail = np.zeros((nb, rb), np.float32)
+        is_local = np.zeros((nb,), bool)
+        valid_node = np.zeros((nb,), bool)
         for ni, n in enumerate(nodes):
             valid_node[ni] = True
             is_local[ni] = n.is_local
@@ -248,8 +414,8 @@ class TpuBatchedBackend(SchedulingBackend):
 
         kernel = _compiled_kernel(tb, nb, rb)
         actions = np.asarray(kernel(
-            demands, totals, avail, locality, is_local, valid_task, valid_node,
-            dep_ready,
+            st.demands, st.locality, st.dep_ready, perm, totals, avail,
+            is_local, valid_task, valid_node,
             np.int32(min(spread_threshold_fp(spread_threshold), 2**31 - 1))))
 
         decisions: List[Decision] = []

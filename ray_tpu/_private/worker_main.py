@@ -28,10 +28,15 @@ def main(argv=None):
         level=args.log_level,
         format=f"[worker {args.worker_id[:8]}] %(levelname)s %(name)s: %(message)s")
 
-    # Debug aid: periodic all-thread stack dumps to the worker log.
+    # Debug aids: periodic all-thread stack dumps to the worker log,
+    # and SIGUSR1 → immediate stack dump (so a wedged worker can be
+    # inspected from outside without killing it).
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
     dump_s = float(os.environ.get("RAY_TPU_WORKER_STACK_DUMP_S", "0"))
     if dump_s > 0:
-        import faulthandler
         faulthandler.dump_traceback_later(dump_s, repeat=True)
 
     from ray_tpu._private import rpc

@@ -1,0 +1,138 @@
+"""Single-chip model benchmark: flagship transformer train-step MFU
+plus the flash-attention kernel, printed as one JSON line.
+
+Run as ``python -m ray_tpu.models.bench_model`` (bench.py invokes it in
+a subprocess so a wedged device plugin cannot take the whole bench
+down). The reference snapshot has no model-level benchmark to compare
+against (SURVEY.md §6 covers runtime microbenchmarks only) — these
+rows measure the TPU-native capability layer on its own terms:
+tokens/s, achieved TFLOP/s, and MFU against the chip's peak.
+
+FLOP accounting (the standard 6ND convention + exact attention term):
+  dense train FLOPs/step = 6 * n_params * tokens
+  attention FLOPs/step   = 12 * L * B * H * T^2 * Dh  (x1/2 causal)
+MFU = (dense + attention) / step_time / peak. Peak comes from the
+device kind (override with RAY_TPU_PEAK_TFLOPS).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+# bf16 peak TFLOP/s per chip by device-kind substring (public specs).
+_PEAK_TFLOPS = (
+    ("v6", 918.0),
+    ("v5p", 459.0),
+    ("v5", 197.0),       # v5e / v5 lite
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+)
+
+
+def _peak_for(kind: str) -> float | None:
+    env = os.environ.get("RAY_TPU_PEAK_TFLOPS")
+    if env:
+        return float(env)
+    kind = kind.lower()
+    for sub, peak in _PEAK_TFLOPS:
+        if sub in kind:
+            return peak
+    return None
+
+
+def run(steps: int = 8) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import transformer as tfm
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform in ("tpu", "axon")
+    out: dict = {"platform": dev.platform, "device_kind": dev.device_kind}
+
+    if on_tpu:
+        cfg = tfm.TransformerConfig(
+            vocab=32768, d_model=1024, n_heads=16, n_layers=8,
+            d_ff=4096, max_seq=1024, dtype=jnp.bfloat16)
+        B, T = 16, 1024
+    else:  # smoke-scale: keeps the row alive off-TPU without minutes of CPU
+        cfg = tfm.TransformerConfig(
+            vocab=512, d_model=128, n_heads=4, n_layers=2, d_ff=256,
+            max_seq=128, dtype=jnp.float32)
+        B, T = 4, 128
+
+    pcfg = tfm.ParallelConfig()
+    params = tfm.init_params(jax.random.key(0), cfg)
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    step_fn, optimizer = tfm.make_train_step(cfg, pcfg)
+    opt_state = optimizer.init(params)
+    tokens = jax.random.randint(jax.random.key(1), (B, T + 1), 0, cfg.vocab)
+    batch = {"tokens": tokens[:, :-1], "targets": tokens[:, 1:]}
+
+    # warmup (compile) then timed steps, fully synchronized
+    params, opt_state, loss = step_fn(params, opt_state, batch)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step_fn(params, opt_state, batch)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / steps
+
+    n_tokens = B * T
+    dense_flops = 6.0 * n_params * n_tokens
+    attn_flops = (12.0 * cfg.n_layers * B * cfg.n_heads * T * T
+                  * cfg.head_dim) / 2.0  # causal halves the work
+    tflops = (dense_flops + attn_flops) / dt / 1e12
+    out["train"] = {
+        "n_params": n_params,
+        "batch": B, "seq": T,
+        "step_ms": round(dt * 1e3, 2),
+        "tokens_per_s": round(n_tokens / dt, 1),
+        "achieved_tflops": round(tflops, 2),
+    }
+    peak = _peak_for(dev.device_kind)
+    if peak:
+        out["train"]["peak_tflops"] = peak
+        out["train"]["mfu"] = round(tflops / peak, 4)
+
+    # ---- flash-attention kernel row (fwd + bwd through the kernel) ----
+    from ray_tpu.ops.attention import attention, flash_attention
+
+    if on_tpu:
+        Bf, Tf, Hf, Df = 4, 4096, 8, 128
+    else:
+        Bf, Tf, Hf, Df = 1, 256, 2, 64
+    kq, kk, kv = jax.random.split(jax.random.key(2), 3)
+    qf = jax.random.normal(kq, (Bf, Tf, Hf, Df), jnp.bfloat16)
+    kf = jax.random.normal(kk, (Bf, Tf, Hf, Df), jnp.bfloat16)
+    vf = jax.random.normal(kv, (Bf, Tf, Hf, Df), jnp.bfloat16)
+
+    def bench_attn(fn, reps=8):
+        fwd = jax.jit(fn)
+        jax.block_until_ready(fwd(qf, kf, vf))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            o = fwd(qf, kf, vf)
+        jax.block_until_ready(o)
+        return (time.perf_counter() - t0) / reps
+
+    t_flash = bench_attn(lambda q, k, v: flash_attention(q, k, v))
+    t_ref = bench_attn(lambda q, k, v: attention(q, k, v))
+    fwd_flops = 4.0 * Bf * Hf * Tf * Tf * Df / 2.0
+    out["flash_attention"] = {
+        "shape": [Bf, Tf, Hf, Df],
+        "fwd_ms": round(t_flash * 1e3, 2),
+        "fwd_tflops": round(fwd_flops / t_flash / 1e12, 2),
+        "xla_ref_ms": round(t_ref * 1e3, 2),
+        "speedup_vs_xla": round(t_ref / t_flash, 3),
+    }
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps(run()))

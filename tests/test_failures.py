@@ -113,3 +113,34 @@ def test_method_num_returns(ray_start_regular):
     s = Splitter.remote()
     a, b = s.split.remote((10, 20))
     assert ray_tpu.get([a, b]) == [10, 20]
+
+
+def test_abrupt_driver_exit_releases_leases(ray_start_regular):
+    """A driver that dies while holding worker leases must not leak the
+    leased resources — later leases would WAIT forever (reference: node
+    manager client-disconnect tears down workers owned by the dead
+    driver). Regression: raylet._watch_lease_client."""
+    import subprocess
+    import sys
+
+    gcs = ray_tpu.worker.global_worker.core.gcs_address
+    script = (
+        "import os, sys\n"
+        f"sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})\n"
+        "import ray_tpu\n"
+        f"ray_tpu.init(address={gcs!r})\n"
+        "@ray_tpu.remote\n"
+        "def t(): return 1\n"
+        "assert ray_tpu.get([t.remote() for _ in range(8)]) == [1] * 8\n"
+        # die abruptly: no shutdown(), leases still held
+        "os._exit(0)\n")
+    subprocess.run([sys.executable, "-c", script], timeout=120, check=True)
+
+    # the 2 CPUs must be reclaimable: this drains only if the dead
+    # driver's lease was released
+    @ray_tpu.remote
+    def alive():
+        return "ok"
+
+    assert ray_tpu.get(
+        [alive.remote() for _ in range(20)], timeout=60) == ["ok"] * 20

@@ -150,3 +150,83 @@ def test_sequential_consumption_within_tick():
     for backend in (HostBackend(), _ready_tpu_backend()):
         d = backend.schedule(pending, nodes, 1.0)
         assert [x.action for x in d] == ["grant", "grant", "wait"]
+
+
+def test_resident_state_incremental_across_ticks():
+    """The resident backend must stay bit-identical to the host oracle
+    across a SEQUENCE of ticks with arrivals, departures, locality
+    mutations and dep-ready flips — the delta-upload path, not just the
+    first full upload (reference shape: cluster_task_manager dispatch
+    loop re-entered per event)."""
+    rng = random.Random(7)
+    pending, nodes = _random_state(rng, num_tasks=30, num_nodes=4)
+    backend = _ready_tpu_backend()
+    host = HostBackend()
+    next_id = len(pending) + 1
+    for tick in range(12):
+        got = backend.schedule(pending, nodes, 0.5)
+        want = host.schedule(pending, nodes, 0.5)
+        assert [(d.req_id, d.action, d.spill_address) for d in got] == \
+            [(d.req_id, d.action, d.spill_address) for d in want], tick
+        # mutate: drop granted/spilled, flip deps, mutate locality, add
+        granted = {d.req_id for d in got if d.action in ("grant", "spill")}
+        pending = [r for r in pending if r.req_id not in granted]
+        for r in pending:
+            if rng.random() < 0.2:
+                r.deps_ready = not r.deps_ready
+            if rng.random() < 0.2:
+                r.locality[nodes[rng.randrange(len(nodes))].node_id] = \
+                    rng.randint(0, 10_000_000)
+        for _ in range(rng.randint(0, 6)):
+            res = {"CPU": float(rng.choice([1, 2, 4]))}
+            pending.append(PendingRequest(
+                req_id=next_id, scheduling_class=0, resources=res,
+                deps_ready=rng.random() < 0.8))
+            next_id += 1
+        # nodes regain/lose availability between ticks
+        for n in nodes:
+            n.available = {k: float(rng.randint(0, int(v)))
+                           for k, v in n.total.items()}
+    assert backend.num_row_uploads > 30  # deltas actually flowed
+
+
+def test_resident_kernel_10k_pending_stress():
+    """10k pending lease requests through the kernel in one tick, then
+    incremental ticks as grants drain — the scale the north star is
+    about (VERDICT r2: nothing stressed the kernel past test size)."""
+    import time as _t
+
+    rng = random.Random(3)
+    nodes = [NodeView(node_id=bytes([i]) * 28, address=f"tcp://n{i}",
+                      total={"CPU": 16.0},
+                      available={"CPU": 16.0}, is_local=(i == 0))
+             for i in range(8)]
+    pending = [PendingRequest(req_id=t + 1, scheduling_class=0,
+                              resources={"CPU": 1.0})
+               for t in range(10_000)]
+    backend = _ready_tpu_backend()
+    host = HostBackend()
+    t0 = _t.perf_counter()
+    got = backend.schedule(pending, nodes, 0.5)
+    first_tick_s = _t.perf_counter() - t0
+    want = host.schedule(pending, nodes, 0.5)
+    assert [(d.req_id, d.action) for d in got] == \
+        [(d.req_id, d.action) for d in want]
+    # the cluster can hold 8*16 = 128 concurrent leases
+    assert sum(1 for d in got if d.action in ("grant", "spill")) == 128
+    # drain in waves; incremental ticks must stay correct and cheap
+    t_inc = 0.0
+    for wave in range(3):
+        granted = {d.req_id for d in got if d.action in ("grant", "spill")}
+        pending = [r for r in pending if r.req_id not in granted]
+        t0 = _t.perf_counter()
+        got = backend.schedule(pending, nodes, 0.5)
+        t_inc = _t.perf_counter() - t0
+        want = host.schedule(pending, nodes, 0.5)
+        assert [(d.req_id, d.action) for d in got] == \
+            [(d.req_id, d.action) for d in want], wave
+    # delta ticks upload nothing (no request changed) — purely the
+    # kernel launch; must not degrade to a full O(T x N) rebuild
+    assert backend.num_row_uploads == 10_000, backend.num_row_uploads
+    print(f"first tick {first_tick_s*1e3:.1f}ms, "
+          f"incremental {t_inc*1e3:.1f}ms")
