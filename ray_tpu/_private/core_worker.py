@@ -88,13 +88,21 @@ class SchedulingKeyState:
     """Per scheduling-class submission state (reference: SchedulingKey
     queues in direct_task_transport.h)."""
 
-    __slots__ = ("queue", "workers", "pending_lease", "resources")
+    __slots__ = ("queue", "workers", "pending_lease", "resources",
+                 "steal_pending", "reassigned")
 
     def __init__(self, resources):
         self.queue: deque[TaskSpec] = deque()
         self.workers: List[LeasedWorker] = []
         self.pending_lease = 0
         self.resources = resources
+        # Work stealing (reference: direct_task_transport.h:57): at most
+        # one outstanding StealTasks per key. ``reassigned`` maps a
+        # stolen task_id -> the VICTIM's worker_id: the victim's batch
+        # slot (stolen marker, or victim death) must be skipped, but a
+        # THIEF dying while executing the stolen task must still retry.
+        self.steal_pending = False
+        self.reassigned: Dict[bytes, bytes] = {}
 
 
 class ActorQueueState:
@@ -192,7 +200,8 @@ class CoreWorker:
         self._actor_handle_factory: Optional[Callable] = None
 
         self.stats = {"tasks_submitted": 0, "tasks_finished": 0,
-                      "tasks_retried": 0, "actor_tasks_submitted": 0,
+                      "tasks_retried": 0, "tasks_stolen": 0,
+                      "actor_tasks_submitted": 0,
                       "puts": 0, "gets": 0}
 
     # ------------------------------------------------------------ lifecycle
@@ -1016,17 +1025,6 @@ class CoreWorker:
             state.pending_lease -= 1
             return
         if reply.get("granted"):
-            if not state.queue:
-                # Stale grant: the queue drained while this request was
-                # pending at the raylet. Hand the worker straight back —
-                # keeping it starves other scheduling classes.
-                state.pending_lease -= 1
-                try:
-                    await conn.call("ReturnWorker", {
-                        "lease_id": reply["lease_id"], "worker_died": False})
-                except ConnectionError:
-                    pass
-                return
             try:
                 wconn = await rpc.connect(reply["worker_address"],
                                           peer_name="leased-worker")
@@ -1040,7 +1038,15 @@ class CoreWorker:
             state.pending_lease -= 1
             wconn.on_disconnect.append(
                 lambda c: self._on_leased_worker_died(sc, state, lw))
-            self._pump_scheduling_key(sc, state)
+            if state.queue:
+                self._pump_scheduling_key(sc, state)
+            elif not self._try_steal(sc, state):
+                # Stale grant: the queue drained while this request was
+                # pending at the raylet and no sibling has stealable
+                # backlog. Hand the worker straight back — keeping it
+                # starves other scheduling classes.
+                state.workers.remove(lw)
+                await self._return_lease(lw)
         elif reply.get("spill") and depth < 4:
             await self._request_lease(sc, state, reply["spill"], depth + 1)
         elif reply.get("infeasible"):
@@ -1049,6 +1055,45 @@ class CoreWorker:
                 f"task requires infeasible resources {state.resources}"))
         else:
             state.pending_lease -= 1
+
+    def _try_steal(self, sc: int, state: SchedulingKeyState) -> bool:
+        """Initiate work stealing when a worker sits idle while a
+        sibling has a deep pipeline (reference:
+        direct_task_transport.h:57 StealTasks). Returns True if a steal
+        was started (the idle worker should be kept leased)."""
+        if state.steal_pending or state.queue:
+            return False
+        victim = max((w for w in state.workers if w.inflight >= 2),
+                     key=lambda w: w.inflight, default=None)
+        if victim is None or not any(
+                w is not victim and w.inflight == 0 for w in state.workers):
+            return False
+        state.steal_pending = True
+        self.loop.create_task(self._steal_tasks(sc, state, victim))
+        return True
+
+    async def _steal_tasks(self, sc: int, state: SchedulingKeyState,
+                           victim: LeasedWorker):
+        try:
+            reply, rbufs = await victim.conn.call(
+                "StealTasks", {"max_n": victim.inflight - 1})
+        except ConnectionError:
+            reply, rbufs = {"tasks": []}, []
+        finally:
+            state.steal_pending = False
+        for tw, fstart, nframes in reply["tasks"]:
+            spec = TaskSpec.from_wire(tw, list(rbufs[fstart:fstart + nframes]))
+            state.reassigned[spec.task_id] = victim.worker_id
+            state.queue.append(spec)
+            self.stats["tasks_stolen"] += 1
+        if state.queue:
+            self._pump_scheduling_key(sc, state)
+        # thieves the steal couldn't feed go back to the pool
+        for w in [w for w in state.workers if w.inflight == 0]:
+            if state.queue:
+                break
+            state.workers.remove(w)
+            self.loop.create_task(self._return_lease(w))
 
     def _fail_queued_tasks(self, state: SchedulingKeyState, error: BaseException):
         for spec in state.queue:
@@ -1090,12 +1135,21 @@ class CoreWorker:
         except ConnectionError:
             lw.inflight -= len(batch)
             for spec in batch:
-                self._retry_or_fail_after_worker_death(spec)
+                self._retry_or_fail_after_worker_death(spec, lw.worker_id)
             return
         fut.add_done_callback(
             lambda f: self._on_push_batch_done(f, sc, state, lw, batch))
 
-    def _retry_or_fail_after_worker_death(self, spec: TaskSpec):
+    def _retry_or_fail_after_worker_death(self, spec: TaskSpec,
+                                          via_worker_id: bytes = b""):
+        state = self.scheduling_keys.get(spec.scheduling_class)
+        if state is not None and \
+                state.reassigned.get(spec.task_id) == via_worker_id:
+            # the VICTIM of a steal died before its batch reply; the
+            # task already runs elsewhere — only this worker's copy is
+            # skipped (a thief's death still retries below)
+            state.reassigned.pop(spec.task_id, None)
+            return
         entry = self.pending_tasks.get(spec.task_id)
         if entry is not None and entry.num_retries_left != 0:
             if entry.num_retries_left > 0:
@@ -1115,18 +1169,24 @@ class CoreWorker:
         err = fut.exception() if not fut.cancelled() else None
         if fut.cancelled() or err is not None:
             for spec in batch:
-                self._retry_or_fail_after_worker_death(spec)
+                self._retry_or_fail_after_worker_death(spec, lw.worker_id)
             return
         reply, rbufs = fut.result()
         for spec, (rheader, fstart, nframes) in zip(batch, reply["replies"]):
+            if rheader.get("stolen"):
+                # relinquished by the worker via StealTasks; the steal
+                # reply already requeued it elsewhere
+                state.reassigned.pop(spec.task_id, None)
+                continue
             self._complete_task(spec, rheader, rbufs[fstart:fstart + nframes])
-        # Reuse or return the lease.
+        # Reuse the lease, steal for it, or return it.
         if state.queue:
             self._pump_scheduling_key(sc, state)
         elif lw.inflight == 0:
-            if lw in state.workers:
-                state.workers.remove(lw)
-            self.loop.create_task(self._return_lease(lw))
+            if not self._try_steal(sc, state):
+                if lw in state.workers:
+                    state.workers.remove(lw)
+                self.loop.create_task(self._return_lease(lw))
 
     def _complete_task(self, spec: TaskSpec, reply: dict, rbufs: List[bytes]):
         """Handle a task reply: land return values in the memory store /

@@ -42,16 +42,62 @@ def current_task_id() -> bytes:
     return getattr(_task_ctx, "task_id", b"")
 
 
+class StealableQueue:
+    """SimpleQueue-compatible FIFO whose tail can be relinquished.
+
+    Backs the work-stealing protocol (reference: StealTasks in
+    direct_task_transport.h:57 — queued-but-unstarted tasks move off a
+    busy worker): the execution thread pops from the head one task at a
+    time, so everything still queued here is fair game for a thief."""
+
+    def __init__(self):
+        import collections
+
+        self._dq = collections.deque()
+        self._cv = threading.Condition()
+
+    def put(self, item):
+        with self._cv:
+            self._dq.append(item)
+            self._cv.notify()
+
+    def get(self):
+        with self._cv:
+            while not self._dq:
+                self._cv.wait()
+            return self._dq.popleft()
+
+    def get_nowait(self):
+        with self._cv:
+            if not self._dq:
+                raise queue_mod.Empty
+            return self._dq.popleft()
+
+    def empty(self) -> bool:
+        with self._cv:
+            return not self._dq
+
+    def steal(self, max_n: int):
+        """Pop up to max_n items from the TAIL (newest first), returned
+        in original submission order."""
+        with self._cv:
+            out = []
+            while self._dq and len(out) < max_n:
+                out.append(self._dq.pop())
+            out.reverse()
+            return out
+
+
 class TaskExecutor:
     def __init__(self, core: CoreWorker):
         self.core = core
         # Normal tasks execute serially, like a reference worker: one
-        # dedicated execution thread fed by a queue. Batching the dequeue
-        # and the reply delivery costs one loop wakeup per BURST of tasks
+        # dedicated execution thread fed by a queue. Batching the
+        # reply delivery costs one loop wakeup per BURST of tasks
         # instead of one thread-pool hop per task.
         self._task_pool = ThreadPoolExecutor(max_workers=1,
                                              thread_name_prefix="rtpu-exec")
-        self._exec_queue: queue_mod.SimpleQueue = queue_mod.SimpleQueue()
+        self._exec_queue: StealableQueue = StealableQueue()
         self._exec_thread = threading.Thread(
             target=self._exec_loop, name="rtpu-task-exec", daemon=True)
         self._exec_thread.start()
@@ -83,6 +129,7 @@ class TaskExecutor:
         self._actor_consumer: Optional[asyncio.Task] = None
         core._server.handlers.update({
             "PushTasks": self.handle_push_tasks,
+            "StealTasks": self.handle_steal_tasks,
             "CreateActor": self.handle_create_actor,
             "PushActorTasks": self.handle_push_actor_tasks,
             "CancelTask": self.handle_cancel_task,
@@ -140,6 +187,31 @@ class TaskExecutor:
 
     handle_push_tasks.rpc_sync = True
 
+    async def handle_steal_tasks(self, conn, header, bufs):
+        """Relinquish up to max_n queued-but-unstarted tasks (reference:
+        direct_task_transport.h:57 StealTasks). The stolen specs ride
+        back in THIS reply (the owner requeues them immediately); their
+        slots in the original PushTasks batch reply resolve to a
+        ``stolen`` marker the owner skips."""
+        items = self._exec_queue.steal(int(header.get("max_n", 0)))
+        theaders: List[list] = []
+        frames: List[bytes] = []
+        for tw, tbufs, fut in items:
+            spec = TaskSpec.from_wire(tw, tbufs)
+            if spec.task_id in self._cancelled:
+                # an acknowledged cancel must not be undone by moving
+                # the task to a thief that never saw the CancelTask
+                self._cancelled.discard(spec.task_id)
+                if not fut.done():
+                    fut.set_result(self._error_reply(
+                        spec, exc.TaskCancelledError(spec.name)))
+                continue
+            theaders.append([tw, len(frames), len(tbufs)])
+            frames.extend(tbufs)
+            if not fut.done():
+                fut.set_result(({"stolen": True}, []))
+        return {"tasks": theaders}, frames
+
     def _exec_loop(self):
         self._serial_exec_loop(self._exec_queue, self._run_one_task)
 
@@ -149,26 +221,34 @@ class TaskExecutor:
             return self._error_reply(spec, exc.TaskCancelledError(spec.name))
         return self._execute_task_sync(spec)
 
-    def _serial_exec_loop(self, q: queue_mod.SimpleQueue, run_one):
-        """Dedicated execution thread: drain bursts from the queue, run
-        them serially via ``run_one(spec)``, deliver all replies with one
-        loop wakeup."""
+    def _serial_exec_loop(self, q, run_one):
+        """Dedicated execution thread: run tasks serially via
+        ``run_one(spec)``, ONE dequeue at a time (whatever is still
+        queued stays stealable), delivering accumulated replies with one
+        loop wakeup whenever the queue momentarily drains. Pending
+        replies are flushed BEFORE any blocking dequeue — a steal can
+        empty the queue between our empty() check and the next get(),
+        and replies must not be held hostage to future work."""
+        results = []
         while True:
-            batch = [q.get()]
-            while True:
-                try:
-                    batch.append(q.get_nowait())
-                except queue_mod.Empty:
-                    break
-            results = []
-            for header, bufs, fut in batch:
-                try:
-                    reply = run_one(TaskSpec.from_wire(header, bufs))
-                except BaseException as e:  # noqa: BLE001 — keep thread alive
-                    logger.exception("task execution loop error")
-                    reply = self._infra_error_reply(header, e)
-                results.append((fut, reply))
-            self.core.loop.call_soon_threadsafe(self._deliver_replies, results)
+            try:
+                header, bufs, fut = q.get_nowait()
+            except queue_mod.Empty:
+                if results:
+                    self.core.loop.call_soon_threadsafe(
+                        self._deliver_replies, results)
+                    results = []
+                header, bufs, fut = q.get()
+            try:
+                reply = run_one(TaskSpec.from_wire(header, bufs))
+            except BaseException as e:  # noqa: BLE001 — keep thread alive
+                logger.exception("task execution loop error")
+                reply = self._infra_error_reply(header, e)
+            results.append((fut, reply))
+            if q.empty():
+                self.core.loop.call_soon_threadsafe(
+                    self._deliver_replies, results)
+                results = []
 
     def _infra_error_reply(self, tw: list, e: BaseException):
         """Error reply built from the raw wire header (the spec may not even

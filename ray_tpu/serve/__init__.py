@@ -169,10 +169,12 @@ class Deployment:
             user_config=self.user_config,
             ray_actor_options=self.ray_actor_options,
             route_prefix=self.route_prefix))
+        _wait_http_route(self.name, self.route_prefix)
 
     def delete(self) -> None:
         controller = _get_controller()
         ray_tpu.get(controller.delete_deployment.remote(self.name))
+        _wait_http_route(self.name, None)
 
     def get_handle(self) -> DeploymentHandle:
         return DeploymentHandle(_get_controller(), self.name)
@@ -181,6 +183,21 @@ class Deployment:
         raise RuntimeError(
             "deployments are invoked via .get_handle().remote(), not "
             "called directly")
+
+
+def _wait_http_route(name: str, route_prefix) -> None:
+    """Best-effort: block until the HTTP proxy applied the new route
+    table (the long-poll push is async; without this the first request
+    after deploy() races the table update and can 404)."""
+    try:
+        proxy = ray_tpu.get_actor(PROXY_NAME)
+    except Exception:  # noqa: BLE001 — http=False or detached teardown
+        return
+    try:
+        ray_tpu.get(proxy.wait_for_route.remote(name, route_prefix),
+                    timeout=15)
+    except Exception:  # noqa: BLE001 — readiness is advisory
+        pass
 
 
 def deployment(_func_or_class=None, *, name: Optional[str] = None,

@@ -218,6 +218,30 @@ class HTTPProxy:
         if self._long_poll is not None:
             self._long_poll.stop()
 
+    async def wait_for_route(self, name: str, prefix,
+                             timeout_s: float = 10.0) -> bool:
+        """Block until this proxy's applied route table reflects the
+        deployment (deploy() calls this so the first HTTP request after
+        a blocking deploy cannot 404 on a stale table; the reference's
+        deploy waits on goal_id completion the same way,
+        python/ray/serve/api.py Deployment.deploy). ``prefix`` is the
+        raw config value: the ``__default__`` sentinel means /<name>,
+        None means the deployment must NOT be routable."""
+        deadline = asyncio.get_running_loop().time() + timeout_s
+        if prefix == "__default__":
+            prefix = "/" + name
+
+        def applied() -> bool:
+            if prefix is None:
+                return name not in self._routes.values()
+            return self._routes.get(prefix) == name
+
+        while not applied():
+            if asyncio.get_running_loop().time() >= deadline:
+                return False
+            await asyncio.sleep(0.02)
+        return True
+
     # ---- route/membership plumbing ----
 
     def _on_routes_changed(self, routes: Dict[str, str]) -> None:
