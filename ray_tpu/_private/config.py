@@ -65,8 +65,10 @@ class RayTpuConfig:
     # --- worker pool ---
     # Hard cap on workers started per node (0 = num_cpus).
     max_workers_per_node: int = 0
-    # Workers prestarted at node boot.
-    num_prestart_workers: int = 0
+    # Workers prestarted at node boot. -1 = auto: one per CPU (the
+    # reference's PrestartWorkers heuristic, worker_pool.h:94 — cold
+    # leases then never pay process-start latency). 0 disables.
+    num_prestart_workers: int = -1
     worker_register_timeout_s: float = 30.0
 
     # --- liveness / fault tolerance ---

@@ -51,6 +51,17 @@ from ray_tpu._private.task_spec import (
 logger = logging.getLogger(__name__)
 
 
+def _trace_ctx():
+    """Span context for a submission, or None when tracing is off. The
+    env check keeps the off-path to one dict lookup; the tracing module
+    imports lazily (it lives above this one in the package graph)."""
+    if os.environ.get("RAY_TPU_TRACE", "") in ("", "0"):
+        return None
+    from ray_tpu.util import tracing
+
+    return tracing.inject_context()
+
+
 class PendingTaskEntry:
     """Owner-side record of one submitted task (reference: TaskManager's
     pending-task table, src/ray/core_worker/task_manager.h)."""
@@ -379,6 +390,12 @@ class CoreWorker:
             asyncio.run_coroutine_threadsafe(coro, self.loop)
         else:
             coro.close()  # interpreter teardown: drop without a warning
+
+    def kv_put_nowait(self, key: bytes, value: bytes) -> None:
+        """Fire-and-forget internal-KV put (tracing/telemetry export —
+        must never block or fail the caller's thread)."""
+        self._fire_and_forget(self._gcs_call(
+            "KVPut", {"key": key, "overwrite": True}, bufs=[value]))
 
     async def _get_owner_conn(self, address: str) -> rpc.Connection:
         if address == self.address:
@@ -804,7 +821,8 @@ class CoreWorker:
             placement_group_id=placement_group_id,
             placement_group_bundle_index=placement_group_bundle_index,
             scheduling_strategy=scheduling_strategy,
-            runtime_env=self._resolve_runtime_env(runtime_env))
+            runtime_env=self._resolve_runtime_env(runtime_env),
+            trace_ctx=_trace_ctx())
         return self._register_and_submit(spec, arg_holds)
 
     def _register_and_submit(self, spec: TaskSpec,
@@ -1311,7 +1329,7 @@ class CoreWorker:
             args=prepared_args, num_returns=num_returns,
             resources={}, max_retries=max_task_retries,
             owner_address=self.address, owner_worker_id=self.worker_id,
-            actor_id=actor_id)
+            actor_id=actor_id, trace_ctx=_trace_ctx())
         return_ids = [task_id.object_id(i + 1) for i in range(num_returns)]
         refs = []
         for oid in return_ids:

@@ -182,7 +182,11 @@ class Raylet:
         self._hb_task = asyncio.get_running_loop().create_task(self._heartbeat_loop())
         self._log_monitor_task = asyncio.get_running_loop().create_task(
             self._log_monitor_loop())
-        for _ in range(self.config.num_prestart_workers):
+        n_prestart = self.config.num_prestart_workers
+        if n_prestart < 0:  # auto: one warm worker per CPU slot
+            n_prestart = min(int(self.resources_total.get("CPU", 0)),
+                             self.max_workers)
+        for _ in range(n_prestart):
             self._start_worker_process()
         logger.info("raylet %s listening at %s (%s)",
                     self.node_id.hex()[:8], self.address, self.resources_total)
@@ -309,6 +313,10 @@ class Raylet:
         # NOTE: latency percentiles are deliberately NOT computed here —
         # sorting a 64k reservoir 4x/s on the event loop would stall
         # heartbeats under load; GetNodeStats computes them on demand.
+        # Per-handler RPC latency (C4 instrumented-asio parity) IS
+        # carried: the snapshot is a dozen small dict entries.
+        from ray_tpu._private.rpc import handler_stats
+        out["rpc_handlers"] = handler_stats.snapshot()
         return out
 
     async def _heartbeat_loop(self):
@@ -425,9 +433,13 @@ class Raylet:
         """Workers counted against the task-worker pool cap. Actor workers
         are excluded: an actor owns a dedicated process for its lifetime
         (reference: worker_pool.h dedicated workers), so a node with
-        num_cpus task slots can still serve tasks while actors live."""
+        num_cpus task slots can still serve tasks while actors live.
+        STARTING workers are excluded too — ``_num_starting`` already
+        accounts for them, and double-counting halves the pool (every
+        cap check is ``_num_starting + _alive_worker_count()``)."""
         return sum(1 for w in self.workers.values()
-                   if w.state not in (WORKER_DEAD, WORKER_ACTOR))
+                   if w.state not in (WORKER_DEAD, WORKER_ACTOR,
+                                      WORKER_STARTING))
 
     async def handle_register_worker(self, conn, header, bufs):
         wid = header["worker_id"]
@@ -1185,8 +1197,10 @@ class Raylet:
         return out
 
     async def handle_get_node_stats(self, conn, header, bufs):
+        from ray_tpu._private.rpc import handler_stats
         return {
             "schedule_latency": self._latency_percentiles(),
+            "rpc_handlers": handler_stats.snapshot(),
             "node_id": self.node_id.binary(),
             "address": self.address,
             "resources_total": self.resources_total,

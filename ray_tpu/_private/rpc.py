@@ -23,12 +23,48 @@ import pickle
 import socket
 import struct
 import threading
+import time
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Sequence, Tuple
 
 import cloudpickle
 import msgpack
 
 logger = logging.getLogger(__name__)
+
+
+class _HandlerStats:
+    """Per-process, per-handler RPC latency accounting (reference: the
+    instrumented-asio per-handler event stats, C4 —
+    src/ray/common/asio/instrumented_io_context.h stats_ tracking).
+    Lock-free on the hot path: handlers run on their loop thread and
+    the [count, total, max] cells are updated per-thread-safe enough
+    for monotonic counters read by a snapshot."""
+
+    def __init__(self):
+        self._stats: Dict[str, list] = {}
+
+    def note(self, method: str, dt: float) -> None:
+        e = self._stats.get(method)
+        if e is None:
+            e = self._stats[method] = [0, 0.0, 0.0]
+        e[0] += 1
+        e[1] += dt
+        if dt > e[2]:
+            e[2] = dt
+
+    def snapshot(self) -> Dict[str, dict]:
+        out = {}
+        for method, (count, total, mx) in list(self._stats.items()):
+            out[method] = {
+                "count": count,
+                "mean_ms": round(total / count * 1e3, 3) if count else 0.0,
+                "total_s": round(total, 3),
+                "max_ms": round(mx * 1e3, 3),
+            }
+        return out
+
+
+handler_stats = _HandlerStats()
 
 KIND_REQUEST = 0
 KIND_REPLY = 1
@@ -234,13 +270,16 @@ class Connection:
     def _handle_sync(self, handler, seq: int, method: str, header, bufs):
         """Dispatch a handler marked ``rpc_sync``: called inline on the
         recv loop; may return a Future for deferred replies."""
+        t0 = time.monotonic()
         try:
             result = handler(self, header, bufs)
         except Exception as e:  # noqa: BLE001 — propagate to caller
+            handler_stats.note(method, time.monotonic() - t0)
             self._reply_error_nowait(seq, method, e)
             return
         if isinstance(result, asyncio.Future):
             def _on_done(fut: asyncio.Future):
+                handler_stats.note(method, time.monotonic() - t0)
                 if fut.cancelled():
                     self._reply_error_nowait(
                         seq, method, RuntimeError(f"{method} cancelled"))
@@ -250,14 +289,21 @@ class Connection:
                     self._reply_nowait(seq, method, fut.result())
             result.add_done_callback(_on_done)
         else:
+            handler_stats.note(method, time.monotonic() - t0)
             self._reply_nowait(seq, method, result)
 
     async def _handle(self, seq: int, method: str, header, bufs):
         handler = self.handlers.get(method)
+        t0 = time.monotonic()
         try:
             if handler is None:
                 raise RuntimeError(f"no handler for method {method!r}")
-            result = await handler(self, header, bufs)
+            try:
+                result = await handler(self, header, bufs)
+            finally:
+                # raising handlers count too — the misbehaving methods
+                # are exactly the ones latency stats must show
+                handler_stats.note(method, time.monotonic() - t0)
             if isinstance(result, tuple) and len(result) == 2 and \
                     isinstance(result[1], (list, tuple)):
                 rheader, rbufs = result

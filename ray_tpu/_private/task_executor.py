@@ -42,6 +42,20 @@ def current_task_id() -> bytes:
     return getattr(_task_ctx, "task_id", b"")
 
 
+def _exec_span(spec: TaskSpec):
+    """Consumer span around task execution when the submission carried
+    span context (reference: tracing_helper.py server-side span); a
+    no-op context otherwise."""
+    if not spec.trace_ctx:
+        import contextlib
+
+        return contextlib.nullcontext()
+    from ray_tpu.util import tracing
+
+    return tracing.task_execution_span(
+        spec.name, TaskID(spec.task_id).hex(), spec.trace_ctx)
+
+
 class StealableQueue:
     """SimpleQueue-compatible FIFO whose tail can be relinquished.
 
@@ -294,7 +308,7 @@ class TaskExecutor:
             t0 = _now()
             with runtime_env_mod.activate(
                     spec.runtime_env, self.core.session_dir,
-                    self.core._kv_get_sync):
+                    self.core._kv_get_sync), _exec_span(spec):
                 result = fn(*args, **kwargs)
             self.core.add_task_event({
                 "event": "task:execute", "name": spec.name,
@@ -589,7 +603,8 @@ class TaskExecutor:
         try:
             method = self._lookup_method(spec.name)
             args, kwargs = self._resolve_args(spec)
-            result = method(*args, **kwargs)
+            with _exec_span(spec):
+                result = method(*args, **kwargs)
             return self._build_reply(spec, result)
         except _ActorExitSignal:
             self._request_exit("actor exited via exit_actor()")

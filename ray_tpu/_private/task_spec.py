@@ -61,7 +61,7 @@ class TaskSpec:
         "owner_address", "owner_worker_id", "actor_id", "actor_counter",
         "actor_creation", "runtime_env", "placement_group_id",
         "placement_group_bundle_index", "scheduling_strategy", "depth",
-        "_sched",
+        "trace_ctx", "_sched",
     )
 
     def __init__(self, task_id: bytes, job_id: bytes, task_type: int,
@@ -75,7 +75,8 @@ class TaskSpec:
                  placement_group_id: bytes = b"",
                  placement_group_bundle_index: int = -1,
                  scheduling_strategy: str = "DEFAULT",
-                 depth: int = 0):
+                 depth: int = 0,
+                 trace_ctx=None):
         self.task_id = task_id
         self.job_id = job_id
         self.task_type = task_type
@@ -96,6 +97,10 @@ class TaskSpec:
         self.placement_group_bundle_index = placement_group_bundle_index
         self.scheduling_strategy = scheduling_strategy
         self.depth = depth
+        # (trace_id_hex, parent_span_id_hex) span context propagated
+        # through submission (reference: util/tracing/tracing_helper.py
+        # _inject_tracing_into_function metadata propagation)
+        self.trace_ctx = trace_ctx
         self._sched = -1
 
     @property
@@ -152,7 +157,7 @@ class TaskSpec:
             self.owner_worker_id, self.actor_id, self.actor_counter,
             self.actor_creation, self.runtime_env, self.placement_group_id,
             self.placement_group_bundle_index, self.scheduling_strategy,
-            self.depth,
+            self.depth, self.trace_ctx,
         ]
         return header, frames
 
@@ -174,7 +179,7 @@ class TaskSpec:
         (task_id, job_id, task_type, name, fn_key, args_wire, num_returns,
          resources, max_retries, retry_exceptions, owner_address,
          owner_worker_id, actor_id, actor_counter, actor_creation,
-         runtime_env, pg_id, pg_bundle, strategy, depth) = header
+         runtime_env, pg_id, pg_bundle, strategy, depth, trace_ctx) = header
         return cls(
             task_id=task_id, job_id=job_id, task_type=task_type, name=name,
             fn_key=fn_key, args=cls._args_from_wire(args_wire, frames),
@@ -185,6 +190,7 @@ class TaskSpec:
             actor_creation=actor_creation, runtime_env=runtime_env,
             placement_group_id=pg_id, placement_group_bundle_index=pg_bundle,
             scheduling_strategy=strategy, depth=depth,
+            trace_ctx=tuple(trace_ctx) if trace_ctx else None,
         )
 
     def to_wire_dict(self) -> Tuple[dict, List[bytes]]:
@@ -213,6 +219,7 @@ class TaskSpec:
             "pg_bundle": self.placement_group_bundle_index,
             "strategy": self.scheduling_strategy,
             "depth": self.depth,
+            "trace_ctx": self.trace_ctx,
         }
         return header, frames
 
@@ -235,6 +242,8 @@ class TaskSpec:
             placement_group_bundle_index=header.get("pg_bundle", -1),
             scheduling_strategy=header.get("strategy", "DEFAULT"),
             depth=header.get("depth", 0),
+            trace_ctx=tuple(header["trace_ctx"])
+            if header.get("trace_ctx") else None,
         )
 
     def lease_summary(self) -> dict:

@@ -24,6 +24,7 @@ import traceback
 from typing import Any, Dict, List, Optional
 from urllib.parse import parse_qsl, unquote, urlsplit
 
+from ray_tpu import exceptions as exc
 from ray_tpu.serve.controller import ROUTES_KEY, SNAPSHOT_KEY
 from ray_tpu.serve.long_poll import LongPollClient
 
@@ -115,19 +116,48 @@ class _AsyncReplicaSet:
         self._inflight: Dict[str, set] = {}   # rid -> set[asyncio.Future]
         self._rr = 0
         self._changed = asyncio.Event()
+        self._member_ids: set = set()
 
     def update_membership(self, snapshot: dict) -> None:
         self.replicas = list(snapshot.get("replicas", []))
         self.max_queries = max(
             1, int(snapshot.get("max_concurrent_queries", 1)))
         live = {r["id"] for r in self.replicas}
+        # the controller's authoritative view (local evictions in
+        # assign() don't touch this): died-replica retry policy keys
+        # off whether the controller REMOVED the replica (a roll) or
+        # still believes in it (a crash)
+        self._member_ids = set(live)
         for rid in list(self._inflight):
             if rid not in live:
                 del self._inflight[rid]
         self._changed.set()
 
+    async def _safe_to_retry(self, rid: str, idempotent: bool) -> bool:
+        """Whether a request whose replica died may be re-sent.
+
+        A controlled roll drains before killing, so a died call never
+        started executing — always safe. A spontaneous crash may have
+        executed side effects, so only idempotent requests retry.
+        Roll evidence = the controller's membership no longer lists the
+        replica (waiting briefly for the in-flight push to land)."""
+        if idempotent:
+            return True
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + 2.0
+        while rid in self._member_ids:
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                return False  # controller still believes in it: crash
+            self._changed.clear()
+            try:
+                await asyncio.wait_for(self._changed.wait(), remaining)
+            except asyncio.TimeoutError:
+                return False
+        return True
+
     async def assign(self, method: str, args: tuple, kwargs: dict,
-                     timeout_s: float = 30.0):
+                     timeout_s: float = 30.0, idempotent: bool = False):
         """Submit to a replica with a free slot; returns the result."""
         deadline = asyncio.get_running_loop().time() + timeout_s
         while True:
@@ -140,7 +170,15 @@ class _AsyncReplicaSet:
                 book = self._inflight.setdefault(rid, set())
                 book.add(fut)
                 fut.add_done_callback(book.discard)
-                return await fut
+                try:
+                    return await fut
+                except exc.ActorDiedError:
+                    self.replicas = [r for r in self.replicas
+                                     if r["id"] != rid]
+                    self._inflight.pop(rid, None)
+                    if await self._safe_to_retry(rid, idempotent):
+                        continue
+                    raise
             waiters = [f for s in self._inflight.values() for f in s]
             self._changed.clear()
             timeout = deadline - asyncio.get_running_loop().time()
@@ -403,7 +441,9 @@ class HTTPProxy:
 
         request = HTTPRequest(method, path, prefix, url.query, headers, body)
         try:
-            result = await rs.assign("__call__", (request,), {})
+            result = await rs.assign(
+                "__call__", (request,), {},
+                idempotent=method in ("GET", "HEAD", "OPTIONS"))
             response = _encode_result(result)
         except Exception:  # noqa: BLE001 — user code / replica failure
             self.num_errors += 1

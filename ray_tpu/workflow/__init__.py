@@ -31,16 +31,19 @@ from typing import Any, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.workflow.storage import WorkflowStorage
+from ray_tpu.workflow.virtual_actor import (  # noqa: F401
+    VirtualActorHandle, get_actor, virtual_actor)
 
 __all__ = ["init", "step", "Workflow", "resume", "get_output",
-           "get_status", "list_all"]
+           "get_status", "list_all", "virtual_actor", "get_actor"]
 
 _storage: Optional[WorkflowStorage] = None
 
 
 def init(storage: Optional[str] = None) -> None:
-    """Set the durable storage root (defaults to ``~/.ray_tpu_workflows``
-    or ``$RAY_TPU_WORKFLOW_STORAGE``)."""
+    """Set the durable storage root: a path, ``file://``, ``kv://``
+    (cluster-internal GCS KV) or ``s3://`` URL (defaults to
+    ``~/.ray_tpu_workflows`` or ``$RAY_TPU_WORKFLOW_STORAGE``)."""
     global _storage
     base = (storage or os.environ.get("RAY_TPU_WORKFLOW_STORAGE")
             or os.path.expanduser("~/.ray_tpu_workflows"))
@@ -125,7 +128,7 @@ class _Continuation:
 
 
 @ray_tpu.remote
-def _run_step(base_dir: str, workflow_id: str, step_id: str, fn,
+def _run_step(storage_url: str, workflow_id: str, step_id: str, fn,
               nargs: int, kwarg_keys, *values):
     """One step as a remote task. Upstream values arrive as TOP-LEVEL
     ObjectRef arguments in ``values`` (the runtime resolves top-level
@@ -135,9 +138,10 @@ def _run_step(base_dir: str, workflow_id: str, step_id: str, fn,
     short-circuits re-execution on resume."""
     args = values[:nargs]
     kwargs = dict(zip(kwarg_keys, values[nargs:]))
-    store = WorkflowStorage(base_dir)
-    if store.has_step_output(workflow_id, step_id):
-        return store.load_step_output(workflow_id, step_id)
+    store = WorkflowStorage(storage_url)
+    found, cached = store.try_load_step_output(workflow_id, step_id)
+    if found:
+        return cached
     result = fn(*args, **kwargs)
     if isinstance(result, Workflow):
         # Continuation: checkpoint the DAG, not the (unknown) value;
@@ -184,7 +188,7 @@ def _submit_steps(store: WorkflowStorage, workflow_id: str,
         opts = _run_step.options(max_retries=n._max_retries) \
             if n._max_retries else _run_step
         refs[id(n)] = opts.remote(
-            store.base_dir, workflow_id, ids[id(n)], n._fn,
+            store.url, workflow_id, ids[id(n)], n._fn,
             len(args), list(kwargs), *args, *kwargs.values())
         return refs[id(n)]
 
@@ -194,12 +198,12 @@ def _submit_steps(store: WorkflowStorage, workflow_id: str,
 def _execute_dag(store: WorkflowStorage, workflow_id: str,
                  root: Workflow):
     root_id, root_ref = _submit_steps(store, workflow_id, root)
-    return _finalize.remote(store.base_dir, workflow_id, root_id,
+    return _finalize.remote(store.url, workflow_id, root_id,
                             root_ref)
 
 
 @ray_tpu.remote
-def _finalize(base_dir: str, workflow_id: str, root_step_id: str,
+def _finalize(storage_url: str, workflow_id: str, root_step_id: str,
               result):
     """Resolve continuations, then mark the workflow SUCCESSFUL.
 
@@ -207,7 +211,7 @@ def _finalize(base_dir: str, workflow_id: str, root_step_id: str,
     here (submitting step tasks and blocking on their refs) instead of
     chaining nested finalize tasks, which would hold one worker per
     continuation depth and deadlock the pool on deep tail recursion."""
-    store = WorkflowStorage(base_dir)
+    store = WorkflowStorage(storage_url)
     depth = 0
     while isinstance(result, _Continuation):
         depth += 1

@@ -1,19 +1,26 @@
-"""Durable workflow storage (filesystem backend).
+"""Durable workflow storage: a pluggable backend seam.
 
-Parity target: the reference's WorkflowStorage
-(reference: python/ray/workflow/workflow_storage.py:89 —
-save_step_output :124, inspect paths — and workflow/storage/filesystem.py).
-Layout::
+Parity target: the reference's WorkflowStorage over swappable backends
+(reference: python/ray/workflow/workflow_storage.py:89,
+workflow/storage/base.py, storage/filesystem.py, storage/s3.py).
+Backends implement a small key-value contract; ``WorkflowStorage``
+layers the workflow layout on top::
 
-    <base>/<workflow_id>/
-        dag.pkl                  # the whole step DAG (for resume)
-        status                   # RUNNING | SUCCESSFUL | FAILED
-        steps/<step_id>/output.pkl
+    <workflow_id>/dag.pkl                  # the whole step DAG (resume)
+    <workflow_id>/status                   # RUNNING | SUCCESSFUL | FAILED
+    <workflow_id>/steps/<step_id>/output.pkl
+    actors/<actor_id>/state.pkl            # virtual actor state
 
-Writes are atomic (tmp + rename) so a driver killed mid-checkpoint
-never leaves a half-written output that resume would trust. The base
-dir must be on a filesystem reachable by every node that executes
-steps (the same contract as the reference's filesystem backend).
+Selection is by URL (``storage_from_url``):
+
+* ``file:///path`` (or a bare path) — filesystem; writes are atomic
+  (tmp + rename) so a driver killed mid-checkpoint never leaves a
+  half-written output that resume would trust. The base dir must be
+  reachable by every node that executes steps.
+* ``kv://prefix`` — the cluster's internal GCS KV (journal-persisted,
+  survives GCS restarts; reachable from every worker by construction).
+* ``s3://bucket/prefix`` — reference-parity cloud backend; requires
+  boto3 (not bundled — the class raises a clear error without it).
 """
 
 from __future__ import annotations
@@ -44,59 +51,225 @@ def _atomic_write(path: str, data: bytes) -> None:
         raise
 
 
-class WorkflowStorage:
+class Storage:
+    """Backend contract (reference: workflow/storage/base.py Storage)."""
+
+    url: str = ""
+
+    def put(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def exists(self, key: str) -> bool:
+        return self.get(key) is not None
+
+    def list_prefix(self, prefix: str) -> List[str]:
+        """Immediate child names under a '/'-delimited prefix."""
+        raise NotImplementedError
+
+
+class FilesystemStorage(Storage):
     def __init__(self, base_dir: str):
         self.base_dir = base_dir
+        self.url = f"file://{base_dir}"
         os.makedirs(base_dir, exist_ok=True)
 
-    # ---- per-workflow ----
+    def _path(self, key: str) -> str:
+        return os.path.join(self.base_dir, *key.split("/"))
 
-    def _wf_dir(self, workflow_id: str) -> str:
-        return os.path.join(self.base_dir, workflow_id)
+    def put(self, key: str, data: bytes) -> None:
+        _atomic_write(self._path(key), data)
 
-    def save_dag(self, workflow_id: str, dag: Any) -> None:
-        _atomic_write(os.path.join(self._wf_dir(workflow_id), "dag.pkl"),
-                      cloudpickle.dumps(dag))
-
-    def load_dag(self, workflow_id: str) -> Any:
-        with open(os.path.join(self._wf_dir(workflow_id), "dag.pkl"),
-                  "rb") as f:
-            return pickle.loads(f.read())
-
-    def set_status(self, workflow_id: str, status: str) -> None:
-        _atomic_write(os.path.join(self._wf_dir(workflow_id), "status"),
-                      status.encode())
-
-    def get_status(self, workflow_id: str) -> Optional[str]:
+    def get(self, key: str) -> Optional[bytes]:
         try:
-            with open(os.path.join(self._wf_dir(workflow_id),
-                                   "status"), "rb") as f:
-                return f.read().decode()
-        except FileNotFoundError:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except (FileNotFoundError, IsADirectoryError):
             return None
 
-    def list_workflows(self) -> List[str]:
+    def exists(self, key: str) -> bool:
+        return os.path.exists(self._path(key))
+
+    def list_prefix(self, prefix: str) -> List[str]:
         try:
-            return sorted(
-                d for d in os.listdir(self.base_dir)
-                if os.path.isdir(os.path.join(self.base_dir, d)))
+            return sorted(os.listdir(self._path(prefix)))
         except FileNotFoundError:
             return []
 
+
+class KVStorage(Storage):
+    """Workflow storage inside the cluster's internal GCS KV — the KV
+    journal makes it durable across GCS restarts, and every worker can
+    reach it without a shared filesystem."""
+
+    def __init__(self, prefix: str = "workflow"):
+        self.prefix = prefix.strip("/")
+        self.url = f"kv://{self.prefix}"
+
+    def _key(self, key: str) -> bytes:
+        return f"__wf__/{self.prefix}/{key}".encode()
+
+    def put(self, key: str, data: bytes) -> None:
+        import ray_tpu
+
+        ray_tpu.experimental_internal_kv_put(self._key(key), data,
+                                             overwrite=True)
+
+    def get(self, key: str) -> Optional[bytes]:
+        import ray_tpu
+
+        return ray_tpu.experimental_internal_kv_get(self._key(key))
+
+    def exists(self, key: str) -> bool:
+        # keys-only RPC: existence must not transfer the (possibly
+        # large) checkpoint value
+        import ray_tpu
+
+        return bool(ray_tpu.experimental_internal_kv_list(self._key(key)))
+
+    def list_prefix(self, prefix: str) -> List[str]:
+        import ray_tpu
+
+        base = self._key(prefix).rstrip(b"/") + b"/"
+        out = set()
+        for k in ray_tpu.experimental_internal_kv_list(base):
+            rest = k[len(base):].decode()
+            out.add(rest.split("/", 1)[0])
+        return sorted(out)
+
+
+class S3Storage(Storage):
+    """Reference-parity S3 backend (reference: workflow/storage/s3.py).
+    boto3 is not bundled in this environment; the class is importable
+    (URL routing + tests can see it) but raises on construction
+    without it."""
+
+    def __init__(self, bucket: str, prefix: str = ""):
+        try:
+            import boto3
+        except ImportError as e:  # pragma: no cover - env has no boto3
+            raise RuntimeError(
+                "s3:// workflow storage requires boto3, which is not "
+                "installed in this environment") from e
+
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        self.url = f"s3://{bucket}/{self.prefix}"
+        self._s3 = boto3.client("s3")  # pragma: no cover
+
+    def _key(self, key: str) -> str:  # pragma: no cover
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    def put(self, key: str, data: bytes) -> None:  # pragma: no cover
+        self._s3.put_object(Bucket=self.bucket, Key=self._key(key),
+                            Body=data)
+
+    def get(self, key: str) -> Optional[bytes]:  # pragma: no cover
+        try:
+            r = self._s3.get_object(Bucket=self.bucket, Key=self._key(key))
+            return r["Body"].read()
+        except self._s3.exceptions.NoSuchKey:
+            return None
+
+    def list_prefix(self, prefix: str) -> List[str]:  # pragma: no cover
+        base = self._key(prefix).rstrip("/") + "/"
+        out = set()
+        pages = self._s3.get_paginator("list_objects_v2").paginate(
+            Bucket=self.bucket, Prefix=base, Delimiter="/")
+        for page in pages:
+            for cp in page.get("CommonPrefixes", []):
+                out.add(cp["Prefix"][len(base):].rstrip("/"))
+            for obj in page.get("Contents", []):
+                out.add(obj["Key"][len(base):].split("/", 1)[0])
+        return sorted(x for x in out if x)
+
+
+def storage_from_url(url: str) -> Storage:
+    """file:///path | kv://prefix | s3://bucket/prefix | bare path."""
+    if url.startswith("kv://"):
+        return KVStorage(url[len("kv://"):] or "workflow")
+    if url.startswith("s3://"):
+        rest = url[len("s3://"):]
+        bucket, _, prefix = rest.partition("/")
+        return S3Storage(bucket, prefix)
+    if url.startswith("file://"):
+        url = url[len("file://"):]
+    return FilesystemStorage(url)
+
+
+class WorkflowStorage:
+    """Workflow layout over a Storage backend. Constructible either
+    from a backend or from a URL/path (what remote steps receive)."""
+
+    def __init__(self, base: "str | Storage"):
+        self.backend = (base if isinstance(base, Storage)
+                        else storage_from_url(base))
+        self.url = self.backend.url
+
+    # ---- per-workflow ----
+
+    def save_dag(self, workflow_id: str, dag: Any) -> None:
+        self.backend.put(f"{workflow_id}/dag.pkl", cloudpickle.dumps(dag))
+
+    def load_dag(self, workflow_id: str) -> Any:
+        data = self.backend.get(f"{workflow_id}/dag.pkl")
+        if data is None:
+            raise FileNotFoundError(f"no dag for workflow {workflow_id}")
+        return pickle.loads(data)
+
+    def set_status(self, workflow_id: str, status: str) -> None:
+        self.backend.put(f"{workflow_id}/status", status.encode())
+
+    def get_status(self, workflow_id: str) -> Optional[str]:
+        data = self.backend.get(f"{workflow_id}/status")
+        return data.decode() if data is not None else None
+
+    def list_workflows(self) -> List[str]:
+        return [w for w in self.backend.list_prefix("")
+                if w != "actors"]
+
     # ---- per-step ----
 
-    def _step_output_path(self, workflow_id: str, step_id: str) -> str:
-        return os.path.join(self._wf_dir(workflow_id), "steps", step_id,
-                            "output.pkl")
-
     def has_step_output(self, workflow_id: str, step_id: str) -> bool:
-        return os.path.exists(self._step_output_path(workflow_id, step_id))
+        return self.backend.exists(
+            f"{workflow_id}/steps/{step_id}/output.pkl")
 
     def save_step_output(self, workflow_id: str, step_id: str,
                          value: Any) -> None:
-        _atomic_write(self._step_output_path(workflow_id, step_id),
-                      cloudpickle.dumps(value))
+        self.backend.put(f"{workflow_id}/steps/{step_id}/output.pkl",
+                         cloudpickle.dumps(value))
 
     def load_step_output(self, workflow_id: str, step_id: str) -> Any:
-        with open(self._step_output_path(workflow_id, step_id), "rb") as f:
-            return pickle.loads(f.read())
+        data = self.backend.get(
+            f"{workflow_id}/steps/{step_id}/output.pkl")
+        if data is None:
+            raise FileNotFoundError(
+                f"no output for {workflow_id}/{step_id}")
+        return pickle.loads(data)
+
+    def try_load_step_output(self, workflow_id: str, step_id: str):
+        """(found, value) in ONE backend fetch — the resume hot path
+        would otherwise transfer every checkpoint twice (exists + load)
+        over remote backends."""
+        data = self.backend.get(
+            f"{workflow_id}/steps/{step_id}/output.pkl")
+        if data is None:
+            return False, None
+        return True, pickle.loads(data)
+
+    # ---- virtual actors ----
+
+    def save_actor_state(self, actor_id: str, seq: int,
+                         state: Any) -> None:
+        self.backend.put(f"actors/{actor_id}/state.pkl",
+                         cloudpickle.dumps((seq, state)))
+
+    def load_actor_state(self, actor_id: str):
+        """Returns (seq, state) or None if the actor was never created."""
+        data = self.backend.get(f"actors/{actor_id}/state.pkl")
+        return pickle.loads(data) if data is not None else None
+
+    def list_actors(self) -> List[str]:
+        return self.backend.list_prefix("actors")

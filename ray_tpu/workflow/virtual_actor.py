@@ -1,0 +1,183 @@
+"""Virtual actors: durable actors addressed by id, state in storage.
+
+Parity target: the reference's virtual actor layer
+(reference: python/ray/workflow/virtual_actor_class.py — VirtualActor,
+``get_or_create`` :86, readonly methods). A virtual actor holds no
+process: each method call runs as a task that loads the persisted
+instance, applies the method, and checkpoints the new state before the
+result is returned. The actor therefore survives cluster restarts and
+driver crashes, and is resumable from any driver that shares the
+storage.
+
+Usage::
+
+    from ray_tpu import workflow
+
+    @workflow.virtual_actor
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        @workflow.virtual_actor.readonly
+        def peek(self):
+            return self.n
+
+    workflow.init(storage="/tmp/wf")
+    c = Counter.get_or_create("my_counter")
+    assert c.incr.run() == 1
+    # ... crash, new driver ...
+    c = workflow.get_actor("my_counter")
+    assert c.incr.run() == 2
+
+Consistency model: calls made through ONE handle are totally ordered
+(each call chains on the previous call's ref). Concurrent handles are
+last-write-wins, as in the reference's non-locking storage backends.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Optional
+
+try:
+    import cloudpickle
+except ImportError:  # pragma: no cover
+    cloudpickle = pickle
+
+import ray_tpu
+from ray_tpu.workflow.storage import WorkflowStorage
+
+
+class _Failed:
+    """Resolved value of a failed call: the task returns this marker
+    instead of raising, so the handle's order chain (``_tail``) stays
+    usable — a raised ref would poison every later chained call with
+    the stored error. ``run()`` re-raises it for the caller."""
+
+    def __init__(self, error: BaseException):
+        self.error = error
+
+
+@ray_tpu.remote
+def _run_actor_method(storage_url: str, actor_id: str, method: str,
+                      readonly: bool, args: tuple, kwargs: dict,
+                      _after):
+    """One virtual-actor method call as a task. ``_after`` is the
+    previous call's ref (or None): a top-level arg the runtime resolves
+    first, giving per-handle total ordering."""
+    store = WorkflowStorage(storage_url)
+    rec = store.load_actor_state(actor_id)
+    if rec is None:
+        return _Failed(
+            ValueError(f"virtual actor {actor_id!r} does not exist"))
+    seq, inst = rec
+    try:
+        result = getattr(inst, method)(*args, **kwargs)
+    except BaseException as e:  # noqa: BLE001 — surfaced via run()
+        return _Failed(e)  # state NOT persisted: the call never happened
+    if not readonly:
+        store.save_actor_state(actor_id, seq + 1, inst)
+    return result
+
+
+class _VirtualMethod:
+    def __init__(self, handle: "VirtualActorHandle", name: str,
+                 readonly: bool):
+        self._handle = handle
+        self._name = name
+        self._readonly = readonly
+
+    def run_async(self, *args, **kwargs):
+        """Returns the call's ObjectRef. A failed call resolves to a
+        ``_Failed`` marker (it would poison the order chain if it
+        raised); ``run()`` translates it back into the exception."""
+        h = self._handle
+        ref = _run_actor_method.remote(
+            h._storage_url, h._actor_id, self._name, self._readonly,
+            args, kwargs, None if self._readonly else h._tail)
+        if not self._readonly:
+            h._tail = ref
+        return ref
+
+    def run(self, *args, **kwargs):
+        out = ray_tpu.get(self.run_async(*args, **kwargs))
+        if isinstance(out, _Failed):
+            raise out.error
+        return out
+
+
+class VirtualActorHandle:
+    """Client-side handle; ``_tail`` chains mutating calls in order."""
+
+    def __init__(self, cls, actor_id: str, storage_url: str):
+        self._cls = cls
+        self._actor_id = actor_id
+        self._storage_url = storage_url
+        self._tail = None
+
+    def __getattr__(self, name: str):
+        method = getattr(self._cls, name, None)
+        if method is None or not callable(method):
+            raise AttributeError(
+                f"virtual actor {self._cls.__name__} has no method "
+                f"{name!r}")
+        return _VirtualMethod(
+            self, name, getattr(method, "__workflow_readonly__", False))
+
+
+class VirtualActorClass:
+    """What ``@workflow.virtual_actor`` returns: a factory for durable
+    instances addressed by id."""
+
+    def __init__(self, cls):
+        self._cls = cls
+        self.__name__ = cls.__name__
+
+    def get_or_create(self, actor_id: str, *init_args,
+                      **init_kwargs) -> VirtualActorHandle:
+        from ray_tpu import workflow
+
+        store = workflow._get_storage()
+        if store.load_actor_state(actor_id) is None:
+            inst = self._cls(*init_args, **init_kwargs)
+            store.save_actor_state(actor_id, 0, inst)
+            # class ships to storage so get_actor() works class-free
+            store.backend.put(f"actors/{actor_id}/class.pkl",
+                              cloudpickle.dumps(self._cls))
+        return VirtualActorHandle(self._cls, actor_id, store.url)
+
+    def __call__(self, *a, **kw):
+        raise RuntimeError(
+            "virtual actors are created with .get_or_create(actor_id), "
+            "not instantiated directly")
+
+
+def virtual_actor(cls):
+    """``@workflow.virtual_actor`` class decorator."""
+    return VirtualActorClass(cls)
+
+
+def _readonly(fn):
+    """``@workflow.virtual_actor.readonly``: the method reads state but
+    never persists it (and doesn't order against mutating calls)."""
+    fn.__workflow_readonly__ = True
+    return fn
+
+
+virtual_actor.readonly = _readonly
+
+
+def get_actor(actor_id: str) -> VirtualActorHandle:
+    """Look up an existing virtual actor by id (class comes from
+    storage — no local class definition needed)."""
+    from ray_tpu import workflow
+
+    store = workflow._get_storage()
+    data = store.backend.get(f"actors/{actor_id}/class.pkl")
+    if data is None or store.load_actor_state(actor_id) is None:
+        raise ValueError(f"no virtual actor with id {actor_id!r}")
+    return VirtualActorHandle(pickle.loads(data), actor_id, store.url)

@@ -211,3 +211,18 @@ def test_work_stealing_rebalances_queued_tasks(ray_start_regular):
     assert fast_wall < 5.0, f"fast tasks took {fast_wall:.1f}s"
     assert ray_tpu.worker.global_worker.core.stats["tasks_stolen"] > 0
     assert ray_tpu.get(slow_ref, timeout=30) == "slow"
+
+
+def test_workers_prestarted_at_boot(ray_start_regular):
+    """The raylet prestarts one worker per CPU at node boot (reference:
+    worker_pool PrestartWorkers heuristic) so a cold first lease does
+    not pay worker process start."""
+    raylet = ray_tpu.worker.global_worker.node.raylet
+    deadline = time.perf_counter() + 15
+    while time.perf_counter() < deadline:
+        alive = [w for w in raylet.workers.values()
+                 if w.state not in ("dead",)]
+        if len(alive) >= 2:
+            break
+        time.sleep(0.1)
+    assert len(alive) >= 2, [w.state for w in raylet.workers.values()]
