@@ -23,6 +23,7 @@ import asyncio
 import itertools
 import logging
 import os
+import sys
 import threading
 import time
 from collections import deque
@@ -51,15 +52,26 @@ from ray_tpu._private.task_spec import (
 logger = logging.getLogger(__name__)
 
 
-def _trace_ctx():
-    """Span context for a submission, or None when tracing is off. The
-    env check keeps the off-path to one dict lookup; the tracing module
-    imports lazily (it lives above this one in the package graph)."""
-    if os.environ.get("RAY_TPU_TRACE", "") in ("", "0"):
-        return None
-    from ray_tpu.util import tracing
+_tracing_mod = None
 
-    return tracing.inject_context()
+
+def _trace_ctx():
+    """Span context for a submission, or None when tracing is off.
+
+    Off-path cost is one sys.modules lookup (profiled: a per-call
+    os.environ.get cost ~6us/task): tracing activates through
+    ``ray_tpu.util.tracing`` being imported — enable() imports it in
+    the driver, CoreWorker.__init__ imports it when RAY_TPU_TRACE=1
+    was set in the environment, and workers import it in ``_exec_span``
+    the moment a traced spec arrives, before any nested submission."""
+    global _tracing_mod
+    m = _tracing_mod
+    if m is None:
+        m = sys.modules.get("ray_tpu.util.tracing")
+        if m is None:
+            return None
+        _tracing_mod = m
+    return m.inject_context() if m.enabled() else None
 
 
 class PendingTaskEntry:
@@ -100,7 +112,7 @@ class SchedulingKeyState:
     queues in direct_task_transport.h)."""
 
     __slots__ = ("queue", "workers", "pending_lease", "resources",
-                 "steal_pending", "reassigned")
+                 "steal_pending", "reassigned", "last_grant_ts")
 
     def __init__(self, resources):
         self.queue: deque[TaskSpec] = deque()
@@ -114,6 +126,8 @@ class SchedulingKeyState:
         # THIEF dying while executing the stolen task must still retry.
         self.steal_pending = False
         self.reassigned: Dict[bytes, bytes] = {}
+        # when the last lease grant landed (breadth/depth phase signal)
+        self.last_grant_ts = 0.0
 
 
 class ActorQueueState:
@@ -147,6 +161,12 @@ class CoreWorker:
                  loop: Optional[asyncio.AbstractEventLoop] = None,
                  log_to_driver: bool = False):
         assert mode in ("driver", "worker")
+        if os.environ.get("RAY_TPU_TRACE", "") not in ("", "0"):
+            # same truthiness predicate as tracing.enabled()
+            # honor env-var-only activation (tracing.py's documented
+            # contract): importing arms the sys.modules gate in
+            # _trace_ctx without putting os.environ on the hot path
+            from ray_tpu.util import tracing  # noqa: F401
         self.mode = mode
         self.log_to_driver = log_to_driver
         self.config = config
@@ -956,19 +976,52 @@ class CoreWorker:
         self._pump_scheduling_key(sc, state)
 
     def _pump_scheduling_key(self, sc: int, state: SchedulingKeyState):
+        """Breadth-first lease acquisition, depth only when breadth is
+        exhausted: leases are requested in proportion to the queue (one
+        per ~8 queued tasks, bounded), and each worker's batch is sized
+        to an even split across the workers we have or expect — NOT to
+        the full pipeline cap. The cap (deep, for wire batching) only
+        bites when the cluster can't give us more workers, so a 100-task
+        job on an 8-CPU node parallelizes instead of serializing into
+        one 512-deep pipeline (reference: per-scheduling-key lease
+        requests bounded by backlog, direct_task_transport.h)."""
         cap = self.config.max_tasks_in_flight_per_worker
+        max_pending = self.config.max_pending_leases_per_scheduling_class
         while state.queue:
+            qlen = len(state.queue)
+            # target worker count for this backlog (breadth first)
+            want = min(max(1, qlen // 8), max_pending)
+            while len(state.workers) + state.pending_lease < want:
+                state.pending_lease += 1
+                self.loop.create_task(
+                    self._request_lease(sc, state, self.raylet_address))
             worker = min((w for w in state.workers if w.inflight < cap),
                          key=lambda w: w.inflight, default=None)
             if worker is None:
-                if state.pending_lease < 1 + len(state.queue) // (cap * 4):
+                if state.pending_lease == 0:
                     state.pending_lease += 1
                     self.loop.create_task(
                         self._request_lease(sc, state, self.raylet_address))
                 return
-            # Fill this worker's pipeline in ONE wire message (the batched
-            # analog of the reference's per-worker pipelining window).
-            n = min(len(state.queue), cap - worker.inflight)
+            # Batch sizing: fair share over current+expected workers
+            # while grants are ARRIVING (breadth phase); once they stop
+            # — saturated node, or a single-worker box whose extra
+            # lease requests just sit pending — deepen to the cap so
+            # wire batches stay large (tail batches shrinking with the
+            # fair share measured a ~20% throughput loss).
+            growing = state.pending_lease > 0 and \
+                time.monotonic() - state.last_grant_ts < 0.05
+            if growing:
+                share = qlen // max(
+                    1, len(state.workers) + state.pending_lease)
+                target = min(cap, max(8, share))
+            else:
+                target = cap
+            if worker.inflight >= target:
+                # growing: breadth pending, wait for grants;
+                # otherwise: every worker at the cap, wait for replies
+                return
+            n = min(qlen, target - worker.inflight)
             batch = [state.queue.popleft() for _ in range(n)]
             worker.inflight += n
             self._push_task_batch_nowait(sc, state, worker, batch)
@@ -1054,6 +1107,7 @@ class CoreWorker:
                               reply["worker_id"])
             state.workers.append(lw)
             state.pending_lease -= 1
+            state.last_grant_ts = time.monotonic()
             wconn.on_disconnect.append(
                 lambda c: self._on_leased_worker_died(sc, state, lw))
             if state.queue:

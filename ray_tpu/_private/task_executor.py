@@ -16,6 +16,7 @@ from __future__ import annotations
 import asyncio
 import inspect
 import logging
+import os
 import queue as queue_mod
 import threading
 import traceback
@@ -42,18 +43,59 @@ def current_task_id() -> bytes:
     return getattr(_task_ctx, "task_id", b"")
 
 
+import contextlib as _contextlib
+
+_NULL_SPAN = _contextlib.nullcontext()  # shared: stateless enter/exit
+
+
 def _exec_span(spec: TaskSpec):
     """Consumer span around task execution when the submission carried
     span context (reference: tracing_helper.py server-side span); a
-    no-op context otherwise."""
+    shared no-op context otherwise (hot path: one attribute check)."""
     if not spec.trace_ctx:
-        import contextlib
-
-        return contextlib.nullcontext()
+        return _NULL_SPAN
     from ray_tpu.util import tracing
 
     return tracing.task_execution_span(
         spec.name, TaskID(spec.task_id).hex(), spec.trace_ctx)
+
+
+class _BatchState:
+    """Reply aggregation for one pushed task batch: a slot array with a
+    lock-protected countdown, resolved into the single batch reply on
+    the IO loop when the last slot lands. Replaces one asyncio.Future +
+    done-callback PER TASK (profiled at several us/task) with one lock
+    acquire per task and ONE loop wakeup per batch. Slots complete from
+    the exec thread (run/error) or the IO loop (stolen/cancelled)."""
+
+    __slots__ = ("fut", "slots", "remaining", "lock", "loop")
+
+    def __init__(self, loop, n: int):
+        self.fut = loop.create_future()
+        self.slots: List[Optional[tuple]] = [None] * n
+        self.remaining = n
+        self.lock = threading.Lock()
+        self.loop = loop
+
+    def complete(self, i: int, reply: tuple) -> None:
+        with self.lock:
+            if self.slots[i] is not None:
+                return  # raced (e.g. steal vs. exec): first wins
+            self.slots[i] = reply
+            self.remaining -= 1
+            done = self.remaining == 0
+        if done:
+            self.loop.call_soon_threadsafe(self._resolve)
+
+    def _resolve(self) -> None:
+        if self.fut.done():
+            return
+        rheaders = []
+        rframes: List[bytes] = []
+        for rh, rfr in self.slots:
+            rheaders.append([rh, len(rframes), len(rfr)])
+            rframes.extend(rfr)
+        self.fut.set_result(({"replies": rheaders}, rframes))
 
 
 class StealableQueue:
@@ -154,10 +196,9 @@ class TaskExecutor:
     # ------------------------------------------------------------ normal tasks
 
     def _batch_reply_aggregator(self, loop, tws: List[list]):
-        """One reply message per pushed batch: returns (batch_fut, per-task
-        futs). Each per-task future resolves to a (reply_header, frames)
-        tuple; once all land, the batch future resolves to
-        ({"replies": [[rheader, fstart, nframes], ...]}, frames)."""
+        """Future-based batch aggregation for the SERIAL ACTOR path
+        (its reorder buffer keys completion off per-task futures).
+        Normal tasks use the cheaper ``_BatchState`` instead."""
         batch_fut = loop.create_future()
         n = len(tws)
         slots: List[Optional[tuple]] = [None] * n
@@ -193,11 +234,11 @@ class TaskExecutor:
         thread and return the batch future the RPC layer replies from."""
         loop = asyncio.get_running_loop()
         tasks = header["tasks"]
-        batch_fut, futs = self._batch_reply_aggregator(
-            loop, [t[0] for t in tasks])
-        for (tw, fstart, nframes), fut in zip(tasks, futs):
-            self._exec_queue.put((tw, bufs[fstart:fstart + nframes], fut))
-        return batch_fut
+        batch = _BatchState(loop, len(tasks))
+        put = self._exec_queue.put
+        for i, (tw, fstart, nframes) in enumerate(tasks):
+            put((tw, bufs[fstart:fstart + nframes], batch, i))
+        return batch.fut
 
     handle_push_tasks.rpc_sync = True
 
@@ -210,24 +251,23 @@ class TaskExecutor:
         items = self._exec_queue.steal(int(header.get("max_n", 0)))
         theaders: List[list] = []
         frames: List[bytes] = []
-        for tw, tbufs, fut in items:
+        for tw, tbufs, batch, i in items:
             spec = TaskSpec.from_wire(tw, tbufs)
             if spec.task_id in self._cancelled:
                 # an acknowledged cancel must not be undone by moving
                 # the task to a thief that never saw the CancelTask
                 self._cancelled.discard(spec.task_id)
-                if not fut.done():
-                    fut.set_result(self._error_reply(
-                        spec, exc.TaskCancelledError(spec.name)))
+                batch.complete(i, self._error_reply(
+                    spec, exc.TaskCancelledError(spec.name)))
                 continue
             theaders.append([tw, len(frames), len(tbufs)])
             frames.extend(tbufs)
-            if not fut.done():
-                fut.set_result(({"stolen": True}, []))
+            batch.complete(i, ({"stolen": True}, []))
         return {"tasks": theaders}, frames
 
     def _exec_loop(self):
-        self._serial_exec_loop(self._exec_queue, self._run_one_task)
+        self._serial_exec_loop(self._exec_queue, self._run_one_task,
+                               batched=True)
 
     def _run_one_task(self, spec: TaskSpec):
         if spec.task_id in self._cancelled:
@@ -235,14 +275,21 @@ class TaskExecutor:
             return self._error_reply(spec, exc.TaskCancelledError(spec.name))
         return self._execute_task_sync(spec)
 
-    def _serial_exec_loop(self, q, run_one):
+    def _serial_exec_loop(self, q, run_one, batched: bool = False):
         """Dedicated execution thread: run tasks serially via
         ``run_one(spec)``, ONE dequeue at a time (whatever is still
-        queued stays stealable), delivering accumulated replies with one
-        loop wakeup whenever the queue momentarily drains. Pending
-        replies are flushed BEFORE any blocking dequeue — a steal can
-        empty the queue between our empty() check and the next get(),
-        and replies must not be held hostage to future work."""
+        queued stays stealable).
+
+        ``batched=True`` (normal tasks): items are (tw, bufs, batch, i)
+        and completion goes through ``_BatchState`` — the batch itself
+        coalesces the loop wakeup, no per-task future exists.
+        ``batched=False`` (serial actors): items are (tw, bufs, fut);
+        accumulated replies are flushed with one loop wakeup whenever
+        the queue momentarily drains, and BEFORE any blocking dequeue
+        (a steal can empty the queue between empty() and get())."""
+        self._maybe_profile_thread()
+        if batched:
+            self._batched_exec_loop(q, run_one)  # never returns
         results = []
         while True:
             try:
@@ -263,6 +310,43 @@ class TaskExecutor:
                 self.core.loop.call_soon_threadsafe(
                     self._deliver_replies, results)
                 results = []
+
+    def _batched_exec_loop(self, q, run_one):
+        while True:
+            tw, bufs, batch, i = q.get()
+            try:
+                reply = run_one(TaskSpec.from_wire(tw, bufs))
+            except BaseException as e:  # noqa: BLE001 — keep thread alive
+                logger.exception("task execution loop error")
+                reply = self._infra_error_reply(tw, e)
+            batch.complete(i, reply)
+
+    _profiling_claimed = False
+
+    def _maybe_profile_thread(self):
+        """RAY_TPU_WORKER_PROFILE=/dir: dump this thread's cProfile at
+        exit. Only ONE exec thread per process profiles (a second
+        enable doesn't reliably raise on 3.12, and two dumps to the
+        same path would overwrite each other)."""
+        profile_dir = os.environ.get("RAY_TPU_WORKER_PROFILE", "")
+        if not profile_dir or TaskExecutor._profiling_claimed:
+            return
+        TaskExecutor._profiling_claimed = True
+        import atexit
+        import cProfile
+
+        prof = cProfile.Profile()
+        try:
+            prof.enable()
+        except ValueError:
+            return
+
+        def _dump():
+            prof.disable()
+            os.makedirs(profile_dir, exist_ok=True)
+            prof.dump_stats(os.path.join(
+                profile_dir, f"worker-{os.getpid()}-exec.prof"))
+        atexit.register(_dump)
 
     def _infra_error_reply(self, tw: list, e: BaseException):
         """Error reply built from the raw wire header (the spec may not even
@@ -541,7 +625,9 @@ class TaskExecutor:
 
     def _actor_serial_loop(self):
         """Serial-actor execution thread (max_concurrency=1, non-async):
-        same batched loop as normal tasks."""
+        uses the FUTURE-based path (batched=False) — the reorder buffer
+        keys completion off per-task futures, unlike normal tasks'
+        _BatchState slot aggregation."""
         self._serial_exec_loop(self._actor_serial_queue,
                                self._execute_actor_task_sync)
 

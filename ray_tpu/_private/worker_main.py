@@ -35,6 +35,9 @@ def main(argv=None):
     import signal
 
     faulthandler.register(signal.SIGUSR1, all_threads=True)
+    # SIGTERM = graceful exit (atexit hooks — profile dumps — run);
+    # the raylet's hard teardown still uses SIGKILL.
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
     dump_s = float(os.environ.get("RAY_TPU_WORKER_STACK_DUMP_S", "0"))
     if dump_s > 0:
         faulthandler.dump_traceback_later(dump_s, repeat=True)
@@ -81,6 +84,9 @@ def main(argv=None):
 
     core = loop.run_until_complete(boot())
     worker_mod._tune_gc()  # same GC policy as drivers (hot exec path)
+    # Debug aid: RAY_TPU_WORKER_PROFILE=/dir — the exec thread dumps
+    # cProfile stats at exit (task_executor._serial_exec_loop). On
+    # 3.12 cProfile is process-wide, so only that one thread profiles.
     try:
         loop.run_forever()
     finally:

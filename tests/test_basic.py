@@ -190,27 +190,33 @@ def test_runtime_context(ray_start_regular):
     assert ctx.worker_id is not None
 
 
-def test_work_stealing_rebalances_queued_tasks(ray_start_regular):
+def test_work_stealing_rebalances_queued_tasks():
     """Tasks queued behind a slow task on one worker migrate to an idle
     worker (reference: direct_task_transport.h:57 StealTasks). 40 tasks
-    with the slow one first: worker A gets a full 32-deep pipeline,
-    worker B drains the rest, then steals A's queued backlog instead of
-    letting it wait out the slow task."""
-    @ray_tpu.remote
-    def work(d):
-        time.sleep(d)
-        return "slow" if d else "fast"
+    with the slow one first: worker A gets a full 32-deep pipeline
+    (cap pinned — the default is far deeper), worker B drains the
+    rest, then steals A's queued backlog instead of letting it wait
+    out the slow task."""
+    ray_tpu.init(num_cpus=2, _system_config={
+        "max_tasks_in_flight_per_worker": 32})
+    try:
+        @ray_tpu.remote
+        def work(d):
+            time.sleep(d)
+            return "slow" if d else "fast"
 
-    t0 = time.perf_counter()
-    slow_ref = work.remote(6)       # same scheduling class as the rest
-    fast_refs = [work.remote(0) for _ in range(39)]
-    assert ray_tpu.get(fast_refs, timeout=30) == ["fast"] * 39
-    fast_wall = time.perf_counter() - t0
-    # without stealing the ~31 tasks behind `slow` would wait out the
-    # full 6s sleep; generous margin for the 1-core CI box
-    assert fast_wall < 5.0, f"fast tasks took {fast_wall:.1f}s"
-    assert ray_tpu.worker.global_worker.core.stats["tasks_stolen"] > 0
-    assert ray_tpu.get(slow_ref, timeout=30) == "slow"
+        t0 = time.perf_counter()
+        slow_ref = work.remote(6)   # same scheduling class as the rest
+        fast_refs = [work.remote(0) for _ in range(39)]
+        assert ray_tpu.get(fast_refs, timeout=30) == ["fast"] * 39
+        fast_wall = time.perf_counter() - t0
+        # without stealing the ~31 tasks behind `slow` would wait out
+        # the full 6s sleep; generous margin for the 1-core CI box
+        assert fast_wall < 5.0, f"fast tasks took {fast_wall:.1f}s"
+        assert ray_tpu.worker.global_worker.core.stats["tasks_stolen"] > 0
+        assert ray_tpu.get(slow_ref, timeout=30) == "slow"
+    finally:
+        ray_tpu.shutdown()
 
 
 def test_workers_prestarted_at_boot(ray_start_regular):
