@@ -58,6 +58,10 @@ class RayTpuConfig:
     # max_pending_lease_requests_per_scheduling_category); requested in
     # proportion to the backlog, ~one per 8 queued tasks.
     max_pending_leases_per_scheduling_class: int = 16
+    # How long an idle leased worker is kept before returning it to the
+    # pool. Returning instantly makes every sync-loop task pay a fresh
+    # lease round trip through the raylet (~500us of the sync row).
+    idle_lease_keepalive_s: float = 0.2
     # Hybrid policy: prefer the local/first node until its utilization
     # exceeds this threshold, then spread (reference: scheduler_spread_threshold).
     scheduler_spread_threshold: float = 0.5

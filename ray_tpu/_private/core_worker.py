@@ -95,7 +95,7 @@ class PendingTaskEntry:
 
 class LeasedWorker:
     __slots__ = ("address", "lease_id", "node_id", "conn", "inflight",
-                 "raylet_address", "worker_id")
+                 "raylet_address", "worker_id", "idle_timer")
 
     def __init__(self, address, lease_id, node_id, conn, raylet_address, worker_id):
         self.address = address
@@ -105,6 +105,8 @@ class LeasedWorker:
         self.raylet_address = raylet_address
         self.worker_id = worker_id
         self.inflight = 0
+        # cancellable keepalive TimerHandle while idle (exactly one)
+        self.idle_timer = None
 
 
 class SchedulingKeyState:
@@ -1024,6 +1026,9 @@ class CoreWorker:
             n = min(qlen, target - worker.inflight)
             batch = [state.queue.popleft() for _ in range(n)]
             worker.inflight += n
+            if worker.idle_timer is not None:
+                worker.idle_timer.cancel()
+                worker.idle_timer = None
             self._push_task_batch_nowait(sc, state, worker, batch)
 
     def _dep_info(self, spec: TaskSpec) -> List[dict]:
@@ -1128,6 +1133,26 @@ class CoreWorker:
         else:
             state.pending_lease -= 1
 
+    def _schedule_idle_return(self, sc: int, state: SchedulingKeyState,
+                              lw: "LeasedWorker") -> None:
+        """Keep an idle leased worker warm for a grace period before
+        returning it — a sync-loop caller (submit, get, repeat) reuses
+        the lease instead of paying a raylet round trip per task. One
+        cancellable timer per worker: re-arming replaces the old timer,
+        and the pump cancels it when work lands, so a stale timer can
+        never return a lease that went back into use."""
+        def _maybe_return():
+            lw.idle_timer = None
+            if lw not in state.workers or lw.inflight > 0 or state.queue:
+                return  # back in use
+            state.workers.remove(lw)
+            self.loop.create_task(self._return_lease(lw))
+
+        if lw.idle_timer is not None:
+            lw.idle_timer.cancel()
+        lw.idle_timer = self.loop.call_later(
+            self.config.idle_lease_keepalive_s, _maybe_return)
+
     def _try_steal(self, sc: int, state: SchedulingKeyState) -> bool:
         """Initiate work stealing when a worker sits idle while a
         sibling has a deep pipeline (reference:
@@ -1160,12 +1185,12 @@ class CoreWorker:
             self.stats["tasks_stolen"] += 1
         if state.queue:
             self._pump_scheduling_key(sc, state)
-        # thieves the steal couldn't feed go back to the pool
+        # thieves the steal couldn't feed idle out through the normal
+        # keepalive (an immediate return would defeat the warm lease)
         for w in [w for w in state.workers if w.inflight == 0]:
             if state.queue:
                 break
-            state.workers.remove(w)
-            self.loop.create_task(self._return_lease(w))
+            self._schedule_idle_return(sc, state, w)
 
     def _fail_queued_tasks(self, state: SchedulingKeyState, error: BaseException):
         for spec in state.queue:
@@ -1251,14 +1276,12 @@ class CoreWorker:
                 state.reassigned.pop(spec.task_id, None)
                 continue
             self._complete_task(spec, rheader, rbufs[fstart:fstart + nframes])
-        # Reuse the lease, steal for it, or return it.
+        # Reuse the lease, steal for it, or (after a grace) return it.
         if state.queue:
             self._pump_scheduling_key(sc, state)
         elif lw.inflight == 0:
             if not self._try_steal(sc, state):
-                if lw in state.workers:
-                    state.workers.remove(lw)
-                self.loop.create_task(self._return_lease(lw))
+                self._schedule_idle_return(sc, state, lw)
 
     def _complete_task(self, spec: TaskSpec, reply: dict, rbufs: List[bytes]):
         """Handle a task reply: land return values in the memory store /
