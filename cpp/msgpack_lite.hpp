@@ -1,0 +1,259 @@
+// Minimal msgpack codec for the ray_tpu wire protocol (cross-language
+// client). Covers the value subset the cross-language boundary allows:
+// nil, bool, int, float64, str, bin, array, map (reference contract:
+// src/ray/common/ serialization for java/cpp workers — descriptor +
+// primitive values; here the transport is msgpack instead of protobuf).
+//
+// Spec: https://github.com/msgpack/msgpack/blob/master/spec.md
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace ray_tpu {
+
+struct Value;
+using ValuePtr = std::shared_ptr<Value>;
+
+struct Value {
+  enum class Type { Nil, Bool, Int, Float, Str, Bin, Array, Map };
+  Type type = Type::Nil;
+  bool b = false;
+  int64_t i = 0;
+  double f = 0.0;
+  std::string s;                       // Str and Bin payloads
+  std::vector<Value> array;
+  std::vector<std::pair<Value, Value>> map;  // preserves order
+
+  Value() = default;
+  static Value Nil() { return Value(); }
+  static Value Of(bool v) { Value x; x.type = Type::Bool; x.b = v; return x; }
+  static Value Of(int64_t v) { Value x; x.type = Type::Int; x.i = v; return x; }
+  static Value Of(int v) { return Of(static_cast<int64_t>(v)); }
+  static Value Of(double v) { Value x; x.type = Type::Float; x.f = v; return x; }
+  static Value Of(const std::string& v) {
+    Value x; x.type = Type::Str; x.s = v; return x;
+  }
+  static Value Of(const char* v) { return Of(std::string(v)); }
+  static Value Bin(const std::string& v) {
+    Value x; x.type = Type::Bin; x.s = v; return x;
+  }
+  static Value Arr(std::vector<Value> v) {
+    Value x; x.type = Type::Array; x.array = std::move(v); return x;
+  }
+  static Value MapOf(std::vector<std::pair<Value, Value>> v) {
+    Value x; x.type = Type::Map; x.map = std::move(v); return x;
+  }
+
+  const Value* Find(const std::string& key) const {
+    for (const auto& kv : map)
+      if (kv.first.type == Type::Str && kv.first.s == key) return &kv.second;
+    return nullptr;
+  }
+};
+
+namespace detail {
+
+inline void PutByte(std::string& out, uint8_t b) {
+  out.push_back(static_cast<char>(b));
+}
+
+template <typename T>
+inline void PutBE(std::string& out, T v) {  // big-endian per spec
+  for (int shift = (sizeof(T) - 1) * 8; shift >= 0; shift -= 8)
+    PutByte(out, static_cast<uint8_t>((v >> shift) & 0xff));
+}
+
+}  // namespace detail
+
+inline void Encode(const Value& v, std::string& out) {
+  using detail::PutBE;
+  using detail::PutByte;
+  switch (v.type) {
+    case Value::Type::Nil:
+      PutByte(out, 0xc0);
+      break;
+    case Value::Type::Bool:
+      PutByte(out, v.b ? 0xc3 : 0xc2);
+      break;
+    case Value::Type::Int: {
+      int64_t i = v.i;
+      if (i >= 0 && i < 128) {
+        PutByte(out, static_cast<uint8_t>(i));
+      } else if (i < 0 && i >= -32) {
+        PutByte(out, static_cast<uint8_t>(i));
+      } else {
+        PutByte(out, 0xd3);  // int64
+        PutBE<uint64_t>(out, static_cast<uint64_t>(i));
+      }
+      break;
+    }
+    case Value::Type::Float:
+      PutByte(out, 0xcb);
+      {
+        uint64_t bits;
+        std::memcpy(&bits, &v.f, 8);
+        PutBE<uint64_t>(out, bits);
+      }
+      break;
+    case Value::Type::Str: {
+      size_t n = v.s.size();
+      if (n < 32) {
+        PutByte(out, static_cast<uint8_t>(0xa0 | n));
+      } else if (n < 256) {
+        PutByte(out, 0xd9);
+        PutByte(out, static_cast<uint8_t>(n));
+      } else {
+        PutByte(out, 0xda);
+        PutBE<uint16_t>(out, static_cast<uint16_t>(n));
+      }
+      out.append(v.s);
+      break;
+    }
+    case Value::Type::Bin: {
+      size_t n = v.s.size();
+      if (n < 256) {
+        PutByte(out, 0xc4);
+        PutByte(out, static_cast<uint8_t>(n));
+      } else {
+        PutByte(out, 0xc5);
+        PutBE<uint16_t>(out, static_cast<uint16_t>(n));
+      }
+      out.append(v.s);
+      break;
+    }
+    case Value::Type::Array: {
+      size_t n = v.array.size();
+      if (n < 16) {
+        PutByte(out, static_cast<uint8_t>(0x90 | n));
+      } else {
+        PutByte(out, 0xdc);
+        PutBE<uint16_t>(out, static_cast<uint16_t>(n));
+      }
+      for (const auto& e : v.array) Encode(e, out);
+      break;
+    }
+    case Value::Type::Map: {
+      size_t n = v.map.size();
+      if (n < 16) {
+        PutByte(out, static_cast<uint8_t>(0x80 | n));
+      } else {
+        PutByte(out, 0xde);
+        PutBE<uint16_t>(out, static_cast<uint16_t>(n));
+      }
+      for (const auto& kv : v.map) {
+        Encode(kv.first, out);
+        Encode(kv.second, out);
+      }
+      break;
+    }
+  }
+}
+
+class Decoder {
+ public:
+  Decoder(const char* data, size_t len) : p_(data), end_(data + len) {}
+
+  Value Decode() {
+    uint8_t tag = Byte();
+    if (tag < 0x80) return Value::Of(static_cast<int64_t>(tag));
+    if (tag >= 0xe0) return Value::Of(static_cast<int64_t>(static_cast<int8_t>(tag)));
+    if ((tag & 0xf0) == 0x80) return DecodeMap(tag & 0x0f);
+    if ((tag & 0xf0) == 0x90) return DecodeArray(tag & 0x0f);
+    if ((tag & 0xe0) == 0xa0) return DecodeStr(tag & 0x1f);
+    switch (tag) {
+      case 0xc0: return Value::Nil();
+      case 0xc2: return Value::Of(false);
+      case 0xc3: return Value::Of(true);
+      case 0xc4: return DecodeBin(Byte());
+      case 0xc5: return DecodeBin(BE<uint16_t>());
+      case 0xc6: return DecodeBin(BE<uint32_t>());
+      case 0xca: {  // float32
+        uint32_t bits = BE<uint32_t>();
+        float f;
+        std::memcpy(&f, &bits, 4);
+        return Value::Of(static_cast<double>(f));
+      }
+      case 0xcb: {  // float64
+        uint64_t bits = BE<uint64_t>();
+        double f;
+        std::memcpy(&f, &bits, 8);
+        return Value::Of(f);
+      }
+      case 0xcc: return Value::Of(static_cast<int64_t>(Byte()));
+      case 0xcd: return Value::Of(static_cast<int64_t>(BE<uint16_t>()));
+      case 0xce: return Value::Of(static_cast<int64_t>(BE<uint32_t>()));
+      case 0xcf: return Value::Of(static_cast<int64_t>(BE<uint64_t>()));
+      case 0xd0: return Value::Of(static_cast<int64_t>(static_cast<int8_t>(Byte())));
+      case 0xd1: return Value::Of(static_cast<int64_t>(static_cast<int16_t>(BE<uint16_t>())));
+      case 0xd2: return Value::Of(static_cast<int64_t>(static_cast<int32_t>(BE<uint32_t>())));
+      case 0xd3: return Value::Of(static_cast<int64_t>(BE<uint64_t>()));
+      case 0xd9: return DecodeStr(Byte());
+      case 0xda: return DecodeStr(BE<uint16_t>());
+      case 0xdb: return DecodeStr(BE<uint32_t>());
+      case 0xdc: return DecodeArray(BE<uint16_t>());
+      case 0xdd: return DecodeArray(BE<uint32_t>());
+      case 0xde: return DecodeMap(BE<uint16_t>());
+      case 0xdf: return DecodeMap(BE<uint32_t>());
+      default:
+        throw std::runtime_error("msgpack_lite: unsupported tag " +
+                                 std::to_string(tag));
+    }
+  }
+
+ private:
+  uint8_t Byte() {
+    Need(1);
+    return static_cast<uint8_t>(*p_++);
+  }
+  template <typename T>
+  T BE() {
+    Need(sizeof(T));
+    T v = 0;
+    for (size_t k = 0; k < sizeof(T); ++k)
+      v = (v << 8) | static_cast<uint8_t>(*p_++);
+    return v;
+  }
+  void Need(size_t n) {
+    if (static_cast<size_t>(end_ - p_) < n)
+      throw std::runtime_error("msgpack_lite: truncated input");
+  }
+  Value DecodeStr(size_t n) {
+    Need(n);
+    Value v = Value::Of(std::string(p_, n));
+    p_ += n;
+    return v;
+  }
+  Value DecodeBin(size_t n) {
+    Need(n);
+    Value v = Value::Bin(std::string(p_, n));
+    p_ += n;
+    return v;
+  }
+  Value DecodeArray(size_t n) {
+    std::vector<Value> items;
+    items.reserve(n);
+    for (size_t k = 0; k < n; ++k) items.push_back(Decode());
+    return Value::Arr(std::move(items));
+  }
+  Value DecodeMap(size_t n) {
+    std::vector<std::pair<Value, Value>> items;
+    items.reserve(n);
+    for (size_t k = 0; k < n; ++k) {
+      Value key = Decode();
+      Value val = Decode();
+      items.emplace_back(std::move(key), std::move(val));
+    }
+    return Value::MapOf(std::move(items));
+  }
+
+  const char* p_;
+  const char* end_;
+};
+
+}  // namespace ray_tpu

@@ -1,0 +1,164 @@
+// Native C++ client for a ray_tpu cluster (cross-language driver).
+//
+// Speaks the framed-msgpack RPC protocol of ray_tpu/_private/rpc.py:
+//   u32le body_len | msgpack [kind, seq, method, header, nbufs]
+//   | nbufs x (u64le len | raw bytes)
+// against the cluster-side client server
+// (ray_tpu/util/client/server.py). The cross-language surface is
+// CallNamed: invoke a Python function registered via
+// ray_tpu.util.cross_language.register() with msgpack-native args
+// (reference parity: cross-language task invocation by function
+// descriptor, python/ray/cross_language.py + core_worker/lib/java —
+// redesigned over this runtime's wire protocol).
+//
+// Synchronous, single-connection, no external dependencies.
+#pragma once
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <stdexcept>
+#include <string>
+
+#include "msgpack_lite.hpp"
+
+namespace ray_tpu {
+
+class RayTpuClient {
+ public:
+  ~RayTpuClient() { Close(); }
+
+  void Connect(const std::string& host, int port) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) throw std::runtime_error("socket() failed");
+    int one = 1;
+    ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1)
+      throw std::runtime_error("bad host: " + host);
+    if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+      throw std::runtime_error("connect() to " + host + " failed");
+  }
+
+  void Close() {
+    if (fd_ >= 0) {
+      ::close(fd_);
+      fd_ = -1;
+    }
+  }
+
+  // Liveness check against the client server.
+  bool Ping() {
+    Value reply = Call("CPing", Value::MapOf({}));
+    const Value* ok = reply.Find("ok");
+    return ok != nullptr && ok->type == Value::Type::Bool && ok->b;
+  }
+
+  // Invoke a registered Python function by name. Throws on transport
+  // errors AND on server-reported errors (unknown name, task failure,
+  // non-msgpack result).
+  Value CallNamed(const std::string& name, std::vector<Value> args,
+                  int timeout_s = 300) {
+    Value header = Value::MapOf({
+        {Value::Of("name"), Value::Of(name)},
+        {Value::Of("args"), Value::Arr(std::move(args))},
+        {Value::Of("timeout"), Value::Of(static_cast<int64_t>(timeout_s))},
+    });
+    Value reply = Call("CCallNamed", std::move(header));
+    const Value* err = reply.Find("error");
+    if (err != nullptr && err->type == Value::Type::Str)
+      throw std::runtime_error("CallNamed(" + name + "): " + err->s);
+    const Value* value = reply.Find("value");
+    if (value == nullptr)
+      throw std::runtime_error("CallNamed(" + name + "): malformed reply");
+    return *value;
+  }
+
+  // One request-reply round trip (kind 0 -> expect kind 1 on our seq).
+  Value Call(const std::string& method, Value header) {
+    int64_t seq = next_seq_++;
+    Value msg = Value::Arr({Value::Of(static_cast<int64_t>(0)),
+                            Value::Of(seq), Value::Of(method),
+                            std::move(header),
+                            Value::Of(static_cast<int64_t>(0))});
+    std::string body;
+    Encode(msg, body);
+    std::string frame;
+    PutLE32(frame, static_cast<uint32_t>(body.size()));
+    frame += body;
+    SendAll(frame.data(), frame.size());
+
+    for (;;) {
+      std::string rbody = RecvFrame();
+      Decoder dec(rbody.data(), rbody.size());
+      Value m = dec.Decode();
+      if (m.type != Value::Type::Array || m.array.size() != 5)
+        throw std::runtime_error("malformed rpc frame");
+      int64_t kind = m.array[0].i;
+      int64_t rseq = m.array[1].i;
+      int64_t nbufs = m.array[4].i;
+      for (int64_t k = 0; k < nbufs; ++k) RecvBuf();  // drain raw frames
+      if (rseq != seq) continue;  // unsolicited push / other seq
+      if (kind == 2)              // KIND_ERROR: pickled python exception
+        throw std::runtime_error("server error on " + method);
+      return std::move(m.array[3]);
+    }
+  }
+
+ private:
+  static void PutLE32(std::string& out, uint32_t v) {
+    for (int k = 0; k < 4; ++k)
+      out.push_back(static_cast<char>((v >> (8 * k)) & 0xff));
+  }
+
+  std::string RecvFrame() {
+    char hdr[4];
+    RecvAll(hdr, 4);
+    uint32_t len = 0;
+    for (int k = 3; k >= 0; --k)
+      len = (len << 8) | static_cast<uint8_t>(hdr[k]);
+    std::string body(len, '\0');
+    RecvAll(body.data(), len);
+    return body;
+  }
+
+  std::string RecvBuf() {
+    char hdr[8];
+    RecvAll(hdr, 8);
+    uint64_t len = 0;
+    for (int k = 7; k >= 0; --k)
+      len = (len << 8) | static_cast<uint8_t>(hdr[k]);
+    std::string buf(len, '\0');
+    RecvAll(buf.data(), len);
+    return buf;
+  }
+
+  void SendAll(const char* data, size_t len) {
+    while (len > 0) {
+      ssize_t n = ::send(fd_, data, len, 0);
+      if (n <= 0) throw std::runtime_error("send() failed");
+      data += n;
+      len -= static_cast<size_t>(n);
+    }
+  }
+
+  void RecvAll(char* data, size_t len) {
+    while (len > 0) {
+      ssize_t n = ::recv(fd_, data, len, 0);
+      if (n <= 0) throw std::runtime_error("connection closed by server");
+      data += n;
+      len -= static_cast<size_t>(n);
+    }
+  }
+
+  int fd_ = -1;
+  int64_t next_seq_ = 1;
+};
+
+}  // namespace ray_tpu

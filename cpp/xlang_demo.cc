@@ -1,0 +1,75 @@
+// Cross-language demo/test driver: connects to a ray_tpu client
+// server and invokes registered Python functions from C++.
+//
+//   xlang_demo <host> <port>
+//
+// Exercises: ping, int/float/str/list args and results, error
+// surfaces (unknown function). Prints one line per check; exits 0
+// only if everything passed (tests/test_cross_language.py asserts on
+// this).
+#include <cstdio>
+#include <cstdlib>
+
+#include "ray_tpu_client.hpp"
+
+using ray_tpu::RayTpuClient;
+using ray_tpu::Value;
+
+int main(int argc, char** argv) {
+  if (argc != 3) {
+    std::fprintf(stderr, "usage: %s <host> <port>\n", argv[0]);
+    return 2;
+  }
+  try {
+    RayTpuClient client;
+    client.Connect(argv[1], std::atoi(argv[2]));
+
+    if (!client.Ping()) {
+      std::fprintf(stderr, "ping failed\n");
+      return 1;
+    }
+    std::printf("ping ok\n");
+
+    Value sum = client.CallNamed("add", {Value::Of(40), Value::Of(2)});
+    if (sum.type != Value::Type::Int || sum.i != 42) {
+      std::fprintf(stderr, "add(40,2) != 42\n");
+      return 1;
+    }
+    std::printf("add(40,2) = %lld\n", static_cast<long long>(sum.i));
+
+    Value greet = client.CallNamed("greet", {Value::Of("c++")});
+    if (greet.type != Value::Type::Str || greet.s != "hello c++") {
+      std::fprintf(stderr, "greet mismatch: %s\n", greet.s.c_str());
+      return 1;
+    }
+    std::printf("greet = %s\n", greet.s.c_str());
+
+    Value stats = client.CallNamed(
+        "stats", {Value::Arr({Value::Of(1.0), Value::Of(2.0),
+                              Value::Of(3.0), Value::Of(6.0)})});
+    const Value* mean = stats.Find("mean");
+    if (mean == nullptr || mean->f != 3.0) {
+      std::fprintf(stderr, "stats mean != 3.0\n");
+      return 1;
+    }
+    std::printf("stats mean = %g\n", mean->f);
+
+    bool raised = false;
+    try {
+      client.CallNamed("no_such_function", {});
+    } catch (const std::runtime_error&) {
+      raised = true;
+    }
+    if (!raised) {
+      std::fprintf(stderr, "unknown function did not raise\n");
+      return 1;
+    }
+    std::printf("unknown function raises ok\n");
+
+    std::printf("XLANG OK\n");
+    return 0;
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "fatal: %s\n", e.what());
+    return 1;
+  }
+}

@@ -73,7 +73,13 @@ class ClientServer:
             "CCancel": self.handle_cancel,
             "CRelease": self.handle_release,
             "CGcs": self.handle_gcs,
+            # cross-language entry point: call a registered Python
+            # function by NAME with msgpack-native args (the C++
+            # client in cpp/ uses only this + CPing)
+            "CCallNamed": self.handle_call_named,
+            "CPing": self.handle_ping,
         }, name="client-server")
+        self._named_fn_cache: Dict[str, object] = {}
         self._server.on_connect.append(
             lambda conn: conn.on_disconnect.append(self._on_disconnect))
         self.address = ""
@@ -246,3 +252,41 @@ class ClientServer:
         reply, rbufs = await self._core._gcs_call(
             header["method"], header["header"], bufs=list(bufs))
         return reply, list(rbufs)
+
+    # ------------------------------------------------- cross-language
+
+    async def handle_ping(self, conn, header, bufs):
+        return {"ok": True, "server": "ray_tpu"}
+
+    async def handle_call_named(self, conn, header, bufs):
+        """Cross-language call: run the function registered under
+        ``name`` (ray_tpu.util.cross_language) as a task with
+        msgpack-native args; the result must be msgpack-native too."""
+        from ray_tpu.util import cross_language
+
+        name = header["name"]
+        args = header.get("args") or []
+        kwargs = header.get("kwargs") or {}
+        import ray_tpu
+
+        remote_fn = self._named_fn_cache.get(name)
+        if remote_fn is None:
+            fn = await self._offload(lambda: cross_language.lookup(name))
+            if fn is None:
+                return {"error": f"no function registered as {name!r}"}
+            remote_fn = ray_tpu.remote(fn)
+            self._named_fn_cache[name] = remote_fn
+
+        def run():
+            ref = remote_fn.remote(*args, **kwargs)
+            return ray_tpu.get(ref, timeout=header.get("timeout", 300))
+
+        try:
+            value = await self._offload(run)
+        except Exception as e:  # noqa: BLE001 — client sees the error
+            return {"error": f"{type(e).__name__}: {e}"}
+        if not cross_language.check_msgpack_value(value):
+            return {"error":
+                    f"result of {name!r} is not msgpack-serializable "
+                    f"({type(value).__name__})"}
+        return {"value": value}

@@ -1,0 +1,83 @@
+"""Cross-language task invocation: non-Python clients call registered
+Python functions by NAME over the client-server wire protocol.
+
+Parity target: the reference's cross-language layer (reference:
+python/ray/cross_language.py java_function/java_actor_class,
+src/ray/core_worker/lib/java — functions addressed by descriptor, not
+by pickled code). Redesigned for this runtime: a Python driver
+registers functions under string names in the cluster KV; any client
+that can speak framed msgpack (see ``cpp/`` for the native C++ client)
+submits ``CCallNamed`` to the client server, which runs the function
+as a normal task and returns the msgpack-encodable result.
+
+Usage (Python side)::
+
+    from ray_tpu.util import cross_language
+    cross_language.register("add", lambda a, b: a + b)
+    server = ray_tpu.util.client.server.ClientServer()
+    addr = server.start()          # give addr to the C++ client
+
+C++ side: ``RayTpuClient c; c.Connect(host, port);
+c.CallNamed("add", {1, 2})`` (cpp/ray_tpu_client.hpp).
+
+Arguments and results must be msgpack-native values (nil/bool/int/
+float/str/bin/array/map) — the same contract as the reference's
+cross-language serialization boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+try:
+    import cloudpickle
+except ImportError:  # pragma: no cover
+    import pickle as cloudpickle
+
+_KV_PREFIX = b"__crosslang__/"
+
+
+def register(name: str, fn: Callable) -> None:
+    """Export ``fn`` cluster-wide under ``name`` for non-Python
+    callers. Overwrites any previous registration."""
+    import ray_tpu
+
+    ray_tpu.experimental_internal_kv_put(
+        _KV_PREFIX + name.encode(), cloudpickle.dumps(fn), overwrite=True)
+
+
+def unregister(name: str) -> bool:
+    import ray_tpu
+
+    return ray_tpu.experimental_internal_kv_del(_KV_PREFIX + name.encode())
+
+
+def list_registered() -> List[str]:
+    import ray_tpu
+
+    return sorted(
+        k[len(_KV_PREFIX):].decode()
+        for k in ray_tpu.experimental_internal_kv_list(_KV_PREFIX))
+
+
+def lookup(name: str) -> Optional[Callable]:
+    """Fetch + unpickle a registered function (used by the client
+    server; results are cached per-process by the caller)."""
+    import ray_tpu
+
+    data = ray_tpu.experimental_internal_kv_get(_KV_PREFIX + name.encode())
+    if data is None:
+        return None
+    return cloudpickle.loads(data)
+
+
+def check_msgpack_value(value: Any) -> bool:
+    """True if ``value`` crosses the language boundary losslessly."""
+    if value is None or isinstance(value, (bool, int, float, str, bytes)):
+        return True
+    if isinstance(value, (list, tuple)):
+        return all(check_msgpack_value(v) for v in value)
+    if isinstance(value, dict):
+        return all(isinstance(k, (str, int, bytes))
+                   and check_msgpack_value(v) for k, v in value.items())
+    return False
