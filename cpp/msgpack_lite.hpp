@@ -74,6 +74,14 @@ inline void PutBE(std::string& out, T v) {  // big-endian per spec
 inline void Encode(const Value& v, std::string& out) {
   using detail::PutBE;
   using detail::PutByte;
+  // msgpack's 32-bit length headers are the spec's maximum; refuse
+  // rather than emit a corrupt stream for absurd payloads.
+  constexpr size_t kMax32 = 0xffffffffull;
+  if ((v.type == Value::Type::Str || v.type == Value::Type::Bin)
+          ? v.s.size() > kMax32
+          : (v.type == Value::Type::Array ? v.array.size() > kMax32
+             : (v.type == Value::Type::Map && v.map.size() > kMax32)))
+    throw std::length_error("msgpack_lite: payload exceeds 32-bit length");
   switch (v.type) {
     case Value::Type::Nil:
       PutByte(out, 0xc0);
@@ -108,9 +116,12 @@ inline void Encode(const Value& v, std::string& out) {
       } else if (n < 256) {
         PutByte(out, 0xd9);
         PutByte(out, static_cast<uint8_t>(n));
-      } else {
+      } else if (n < 65536) {
         PutByte(out, 0xda);
         PutBE<uint16_t>(out, static_cast<uint16_t>(n));
+      } else {
+        PutByte(out, 0xdb);
+        PutBE<uint32_t>(out, static_cast<uint32_t>(n));
       }
       out.append(v.s);
       break;
@@ -120,9 +131,12 @@ inline void Encode(const Value& v, std::string& out) {
       if (n < 256) {
         PutByte(out, 0xc4);
         PutByte(out, static_cast<uint8_t>(n));
-      } else {
+      } else if (n < 65536) {
         PutByte(out, 0xc5);
         PutBE<uint16_t>(out, static_cast<uint16_t>(n));
+      } else {
+        PutByte(out, 0xc6);
+        PutBE<uint32_t>(out, static_cast<uint32_t>(n));
       }
       out.append(v.s);
       break;
@@ -131,9 +145,12 @@ inline void Encode(const Value& v, std::string& out) {
       size_t n = v.array.size();
       if (n < 16) {
         PutByte(out, static_cast<uint8_t>(0x90 | n));
-      } else {
+      } else if (n < 65536) {
         PutByte(out, 0xdc);
         PutBE<uint16_t>(out, static_cast<uint16_t>(n));
+      } else {
+        PutByte(out, 0xdd);
+        PutBE<uint32_t>(out, static_cast<uint32_t>(n));
       }
       for (const auto& e : v.array) Encode(e, out);
       break;
@@ -142,9 +159,12 @@ inline void Encode(const Value& v, std::string& out) {
       size_t n = v.map.size();
       if (n < 16) {
         PutByte(out, static_cast<uint8_t>(0x80 | n));
-      } else {
+      } else if (n < 65536) {
         PutByte(out, 0xde);
         PutBE<uint16_t>(out, static_cast<uint16_t>(n));
+      } else {
+        PutByte(out, 0xdf);
+        PutBE<uint32_t>(out, static_cast<uint32_t>(n));
       }
       for (const auto& kv : v.map) {
         Encode(kv.first, out);

@@ -123,11 +123,14 @@ class SchedulingKeyState:
         self.resources = resources
         # Work stealing (reference: direct_task_transport.h:57): at most
         # one outstanding StealTasks per key. ``reassigned`` maps a
-        # stolen task_id -> the VICTIM's worker_id: the victim's batch
-        # slot (stolen marker, or victim death) must be skipped, but a
-        # THIEF dying while executing the stolen task must still retry.
+        # stolen task_id -> a multiset (list, repeats allowed) of VICTIM
+        # worker_ids — a task stolen twice has two victim slots, and
+        # both steals can even be from the same worker. Each victim's
+        # batch slot (stolen marker, or victim death) must be skipped
+        # exactly once, but a THIEF dying while executing the stolen
+        # task must still retry.
         self.steal_pending = False
-        self.reassigned: Dict[bytes, bytes] = {}
+        self.reassigned: Dict[bytes, List[bytes]] = {}
         # when the last lease grant landed (breadth/depth phase signal)
         self.last_grant_ts = 0.0
 
@@ -1180,7 +1183,8 @@ class CoreWorker:
             state.steal_pending = False
         for tw, fstart, nframes in reply["tasks"]:
             spec = TaskSpec.from_wire(tw, list(rbufs[fstart:fstart + nframes]))
-            state.reassigned[spec.task_id] = victim.worker_id
+            state.reassigned.setdefault(spec.task_id, []).append(
+                victim.worker_id)
             state.queue.append(spec)
             self.stats["tasks_stolen"] += 1
         if state.queue:
@@ -1241,11 +1245,14 @@ class CoreWorker:
                                           via_worker_id: bytes = b""):
         state = self.scheduling_keys.get(spec.scheduling_class)
         if state is not None and \
-                state.reassigned.get(spec.task_id) == via_worker_id:
+                via_worker_id in state.reassigned.get(spec.task_id, ()):
             # the VICTIM of a steal died before its batch reply; the
             # task already runs elsewhere — only this worker's copy is
             # skipped (a thief's death still retries below)
-            state.reassigned.pop(spec.task_id, None)
+            victims = state.reassigned[spec.task_id]
+            victims.remove(via_worker_id)
+            if not victims:
+                del state.reassigned[spec.task_id]
             return
         entry = self.pending_tasks.get(spec.task_id)
         if entry is not None and entry.num_retries_left != 0:
@@ -1271,9 +1278,14 @@ class CoreWorker:
         reply, rbufs = fut.result()
         for spec, (rheader, fstart, nframes) in zip(batch, reply["replies"]):
             if rheader.get("stolen"):
-                # relinquished by the worker via StealTasks; the steal
-                # reply already requeued it elsewhere
-                state.reassigned.pop(spec.task_id, None)
+                # relinquished by THIS worker via StealTasks; the steal
+                # reply already requeued it elsewhere. Consume only this
+                # victim's entry — a second steal's victim keeps its own.
+                victims = state.reassigned.get(spec.task_id)
+                if victims is not None and lw.worker_id in victims:
+                    victims.remove(lw.worker_id)
+                    if not victims:
+                        del state.reassigned[spec.task_id]
                 continue
             self._complete_task(spec, rheader, rbufs[fstart:fstart + nframes])
         # Reuse the lease, steal for it, or (after a grace) return it.

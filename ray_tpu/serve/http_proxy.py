@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import traceback
 from typing import Any, Dict, List, Optional
 from urllib.parse import parse_qsl, unquote, urlsplit
@@ -447,8 +448,15 @@ class HTTPProxy:
             response = _encode_result(result)
         except Exception:  # noqa: BLE001 — user code / replica failure
             self.num_errors += 1
-            response = HTTPResponse(traceback.format_exc().encode(),
-                                    status=500,
+            # tracebacks stay server-side: the ingress surface must not
+            # leak file paths / code structure to arbitrary clients
+            tb = traceback.format_exc()
+            logger.error("request to %s failed:\n%s", path, tb)
+            if os.environ.get("RAY_TPU_SERVE_DEBUG"):
+                body = tb.encode()
+            else:
+                body = b"internal error (see serve logs)"
+            response = HTTPResponse(body, status=500,
                                     content_type="text/plain")
         await self._write_response(writer, response, keep_alive)
         return keep_alive

@@ -125,8 +125,12 @@ class StreamOperator:
                 cid = self._runnable_edge()
                 edge = self._edges[cid]
                 records = edge.ready.popleft()
+            # mutable so the stash point can fix the credit BEFORE any
+            # downstream await that might raise (else the stashed
+            # records' credit would be returned twice)
+            consumed_box = [len(records)]
             try:
-                await self._process_edge(cid, records)
+                await self._process_edge(cid, records, consumed_box)
             except Exception as e:  # noqa: BLE001 — driver polls error()
                 import traceback
 
@@ -135,12 +139,21 @@ class StreamOperator:
                                    f"{traceback.format_exc()}")
             finally:
                 # credit MUST return even when user code raised, or the
-                # channel wedges at capacity
+                # channel wedges at capacity — but only for records
+                # actually consumed: post-barrier records stashed during
+                # a stall stay counted against the window until
+                # alignment re-queues them, so a sender cannot push past
+                # capacity while the barrier is pending.
                 async with self._work:
-                    edge.inflight -= len(records)
+                    edge.inflight -= consumed_box[0]
                     self._work.notify_all()
 
-    async def _process_edge(self, cid: int, records: List[Any]) -> None:
+    async def _process_edge(self, cid: int, records: List[Any],
+                            consumed_box: List[int]) -> None:
+        """Sets ``consumed_box[0]`` to the number of records CONSUMED
+        (credit to return); stashed post-barrier records are not
+        consumed yet. Written at the stash point so the count is right
+        even if a later await raises."""
         edge = self._edges[cid]
         out: List[Any] = []
         i = 0
@@ -148,9 +161,11 @@ class StreamOperator:
             rec = records[i]
             if isinstance(rec, Barrier):
                 # stall this edge; records after the barrier wait for
-                # alignment (they belong to the next epoch)
+                # alignment (they belong to the next epoch). Credit for
+                # the stash is withheld NOW, before flush/align awaits.
                 edge.stalled_on = rec.barrier_id
                 edge.stash.extend(records[i + 1:])
+                consumed_box[0] = i + 1
                 await self._flush(out)
                 out = []
                 await self._maybe_align(rec.barrier_id)
@@ -195,9 +210,9 @@ class StreamOperator:
                     if edge.stash:
                         # re-queue at the FRONT: stashed records precede
                         # anything admitted later on this edge. They
-                        # re-enter the credit window (the consumer
-                        # returns credit per processed batch).
-                        edge.inflight += len(edge.stash)
+                        # never left the credit window (the consumer
+                        # withheld their credit at the barrier), so no
+                        # inflight adjustment here.
                         edge.ready.appendleft(list(edge.stash))
                         edge.stash.clear()
             self._work.notify_all()

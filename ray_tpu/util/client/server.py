@@ -15,6 +15,7 @@ IO loop thread).
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import logging
 from typing import Dict
 
@@ -269,13 +270,23 @@ class ClientServer:
         kwargs = header.get("kwargs") or {}
         import ray_tpu
 
-        remote_fn = self._named_fn_cache.get(name)
-        if remote_fn is None:
-            fn = await self._offload(lambda: cross_language.lookup(name))
-            if fn is None:
-                return {"error": f"no function registered as {name!r}"}
-            remote_fn = ray_tpu.remote(fn)
-            self._named_fn_cache[name] = remote_fn
+        # Cache keyed by the pickled registration bytes so a
+        # re-register() overwrite (or unregister) takes effect on a
+        # live server instead of serving the first-cached function.
+        data = await self._offload(lambda: cross_language.lookup_raw(name))
+        if data is None:
+            self._named_fn_cache.pop(name, None)
+            return {"error": f"no function registered as {name!r}"}
+        digest = hashlib.sha1(data).digest()
+        cached = self._named_fn_cache.get(name)
+        if cached is not None and cached[0] == digest:
+            remote_fn = cached[1]
+        else:
+            # unpickling can run arbitrary import-time code — keep it
+            # off the IO loop like every other blocking call here
+            remote_fn = await self._offload(
+                lambda: ray_tpu.remote(cloudpickle.loads(data)))
+            self._named_fn_cache[name] = (digest, remote_fn)
 
         def run():
             ref = remote_fn.remote(*args, **kwargs)

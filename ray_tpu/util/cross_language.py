@@ -63,12 +63,18 @@ def list_registered() -> List[str]:
 def lookup(name: str) -> Optional[Callable]:
     """Fetch + unpickle a registered function (used by the client
     server; results are cached per-process by the caller)."""
-    import ray_tpu
-
-    data = ray_tpu.experimental_internal_kv_get(_KV_PREFIX + name.encode())
+    data = lookup_raw(name)
     if data is None:
         return None
     return cloudpickle.loads(data)
+
+
+def lookup_raw(name: str) -> Optional[bytes]:
+    """Fetch the pickled registration bytes without unpickling — lets
+    callers cache by content and notice re-``register()`` overwrites."""
+    import ray_tpu
+
+    return ray_tpu.experimental_internal_kv_get(_KV_PREFIX + name.encode())
 
 
 def check_msgpack_value(value: Any) -> bool:
