@@ -312,6 +312,8 @@ class TaskExecutor:
                 results = []
 
     def _batched_exec_loop(self, q, run_one):
+        checkpoint = self._profile_checkpoint
+        n_done = 0
         while True:
             tw, bufs, batch, i = q.get()
             try:
@@ -320,8 +322,13 @@ class TaskExecutor:
                 logger.exception("task execution loop error")
                 reply = self._infra_error_reply(tw, e)
             batch.complete(i, reply)
+            if checkpoint is not None:
+                n_done += 1
+                if n_done % 20000 == 0:
+                    checkpoint()
 
     _profiling_claimed = False
+    _profile_checkpoint = None
 
     def _maybe_profile_thread(self):
         """RAY_TPU_WORKER_PROFILE=/dir: dump this thread's cProfile at
@@ -341,12 +348,20 @@ class TaskExecutor:
         except ValueError:
             return
 
+        path = os.path.join(profile_dir, f"worker-{os.getpid()}-exec.prof")
+        os.makedirs(profile_dir, exist_ok=True)
+
         def _dump():
             prof.disable()
-            os.makedirs(profile_dir, exist_ok=True)
-            prof.dump_stats(os.path.join(
-                profile_dir, f"worker-{os.getpid()}-exec.prof"))
+            prof.dump_stats(path)
         atexit.register(_dump)
+        # The raylet's hard teardown can SIGKILL the worker before
+        # atexit runs — the exec loop checkpoints via this hook so a
+        # profile always lands (dump_stats disables; re-enable after).
+        def _checkpoint():
+            prof.dump_stats(path)
+            prof.enable()
+        self._profile_checkpoint = _checkpoint
 
     def _infra_error_reply(self, tw: list, e: BaseException):
         """Error reply built from the raw wire header (the spec may not even
