@@ -162,6 +162,14 @@ def main():
 
     _trace(f"multi_client done ({multi_per_s:.0f}/s); drain")
     # ---- the 1M-task drain (scalability row + latency percentiles) ----
+    # Driver-side GC tuning for the 1M-object working set: default gen0
+    # collections (every ~700 allocs) repeatedly scan the ~millions of
+    # live pending-task objects (measured ~5% of drain wall). App-level
+    # tuning, same as any large-heap Python service would do.
+    import gc
+    gc.collect()
+    gc.freeze()
+    gc.set_threshold(200000, 50, 50)
     num_drain = int(os.environ.get("BENCH_NUM_DRAIN", "1000000"))
     probe_every = max(1, num_drain // 128)
     probes = []
@@ -276,12 +284,24 @@ def main():
     return 0
 
 
+TPU_CACHE_PATH = os.environ.get(
+    "BENCH_TPU_CACHE",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "BENCH_TPU_CACHE.json"))
+
+
 def _model_bench() -> dict:
     """Flagship-transformer MFU + flash-attention rows, in a subprocess
     under a hard timeout — a wedged device plugin (the tunnel hazard)
-    must cost this row, not the whole bench. If the device is
-    unreachable, reruns pinned to CPU jax at smoke scale so the row
-    still exists (marked device_unreachable)."""
+    must cost this row, not the whole bench.
+
+    Tunnel resilience (the axon tunnel can be down for hours):
+    - the device probe RETRIES across several minutes (this is the last
+      bench step; nothing else waits on it),
+    - every successful TPU row is persisted to ``BENCH_TPU_CACHE`` and
+      re-emitted timestamped + ``stale: true`` whenever the tunnel is
+      down, so the record always carries the last real-TPU numbers,
+    - if no TPU row has EVER succeeded, the output says so loudly."""
     import subprocess
     import sys as _sys
 
@@ -296,27 +316,68 @@ def _model_bench() -> dict:
                 return json.loads(line)
         return {"error": f"no JSON (exit {r.returncode})"}
 
-    try:
-        probe = subprocess.run(
-            [_sys.executable, "-c", "import jax; jax.devices()"],
-            env=dict(os.environ), timeout=90,
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-        device_ok = probe.returncode == 0
-    except Exception:  # noqa: BLE001 — TimeoutExpired et al.
-        device_ok = False
+    attempts = []
+    device_ok = False
+    n_probes = int(os.environ.get("BENCH_TPU_PROBE_ATTEMPTS", "4"))
+    for attempt in range(n_probes):
+        t0 = time.time()
+        try:
+            probe = subprocess.run(
+                [_sys.executable, "-c", "import jax; jax.devices()"],
+                env=dict(os.environ), timeout=90,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            device_ok = probe.returncode == 0
+        except Exception:  # noqa: BLE001 — TimeoutExpired et al.
+            device_ok = False
+        attempts.append({"at": round(t0, 1), "ok": device_ok,
+                         "took_s": round(time.time() - t0, 1)})
+        _trace(f"device probe {attempt + 1}/{n_probes}: ok={device_ok}")
+        if device_ok:
+            break
+        if attempt + 1 < n_probes:
+            time.sleep(float(os.environ.get("BENCH_TPU_PROBE_GAP_S", "45")))
     try:
         if device_ok:
-            return run_one(dict(os.environ), timeout=900)
+            out = run_one(dict(os.environ), timeout=900)
+            if not out.get("error") and \
+                    out.get("platform") in ("tpu", "axon"):
+                try:
+                    with open(TPU_CACHE_PATH, "w") as f:
+                        json.dump({"row": out, "saved_at": time.time(),
+                                   "saved_at_iso": time.strftime(
+                                       "%Y-%m-%dT%H:%M:%S%z")}, f)
+                except OSError:
+                    pass
+                out["probe_attempts"] = attempts
+                return out
+            # probe passed but the run itself fell back / failed:
+            # treat like unreachable below so the cache still surfaces
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("PALLAS_AXON_POOL_IPS", None)  # device-plugin gate
         out = run_one(env, timeout=300)
         out["device_unreachable"] = True
-        return out
+        out["probe_attempts"] = attempts
     except subprocess.TimeoutExpired:
-        return {"error": "timeout", "device_unreachable": not device_ok}
+        out = {"error": "timeout", "device_unreachable": not device_ok,
+               "probe_attempts": attempts}
     except Exception as e:  # noqa: BLE001
-        return {"error": str(e)}
+        out = {"error": str(e), "probe_attempts": attempts}
+    # Surface the last-known-good real-TPU row, clearly marked stale.
+    try:
+        with open(TPU_CACHE_PATH) as f:
+            cached = json.load(f)
+        row = cached.get("row") or {}
+        row["stale"] = True
+        row["cached_at"] = cached.get("saved_at_iso") or cached.get("saved_at")
+        out["tpu_last_good"] = row
+    except (OSError, ValueError):
+        out["tpu_last_good"] = None
+        out["ALERT_NO_TPU_ROW_EVER"] = (
+            "no real-TPU model row has ever succeeded on this workspace; "
+            "every bench-time probe found the tunnel down "
+            f"(see probe_attempts; {len(attempts)} attempts this run)")
+    return out
 
 
 def _multi_client(n_tasks: int) -> float:

@@ -45,8 +45,8 @@ from ray_tpu._private.serialization import (
 )
 from ray_tpu._private.shm_store import AttachedObject, write_segment
 from ray_tpu._private.task_spec import (
-    ARG_REF, ARG_VALUE, TASK_ACTOR, TASK_ACTOR_CREATION, TASK_NORMAL,
-    TaskArg, TaskSpec,
+    ARG_REF, ARG_VALUE, REPLY_ACTOR_RESTARTING, REPLY_ERROR, REPLY_STOLEN,
+    TASK_ACTOR, TASK_ACTOR_CREATION, TASK_NORMAL, TaskArg, TaskSpec,
 )
 
 logger = logging.getLogger(__name__)
@@ -220,8 +220,12 @@ class CoreWorker:
         # schedules ONE loop wakeup per burst instead of one
         # run_coroutine_threadsafe per task (the round-1 hot-path cost).
         self._submit_buffer: deque = deque()
-        self._submit_lock = threading.Lock()
         self._submit_scheduled = False
+        # Batched local-ref decrefs: ObjectRef.__del__ is a per-object
+        # hot path (dropping a list of 1M refs); it appends here
+        # (GIL-atomic) and the loop drains under ONE lock round trip.
+        self._decref_buffer: deque = deque()
+        self._decref_scheduled = False
         self._current_task_id: bytes = b""
         # Cached cluster node table for locality lease targeting.
         self._node_table: Dict[bytes, str] = {}
@@ -597,7 +601,17 @@ class CoreWorker:
                                 timeout: float | None = None):
         deadline = None if timeout is None else time.monotonic() + timeout
         out = []
+        # Fast path: values already in the memory store (the common case
+        # for bulk gets over completed tasks — once the first pending
+        # ref resolves, most of the rest have landed) skip the
+        # per-ref coroutine entirely.
+        store_get = self.memory_store.get_if_exists
+        deserialize = self.serialization_context.deserialize
         for ref in refs:
+            obj = store_get(ref.object_id)
+            if obj is not None and obj is not IN_PLASMA:
+                out.append(deserialize(obj.metadata, obj.frames))
+                continue
             remaining = None if deadline is None else deadline - time.monotonic()
             if remaining is not None and remaining <= 0:
                 raise exc.GetTimeoutError(
@@ -850,6 +864,50 @@ class CoreWorker:
             trace_ctx=_trace_ctx())
         return self._register_and_submit(spec, arg_holds)
 
+    def make_task_template(self, fn_key: str, name: str,
+                           num_returns: int = 1,
+                           resources: Dict[str, float] | None = None,
+                           max_retries: int | None = None,
+                           retry_exceptions: bool = False,
+                           placement_group_id: bytes = b"",
+                           placement_group_bundle_index: int = -1,
+                           scheduling_strategy: str = "DEFAULT",
+                           runtime_env: Dict | None = None) -> TaskSpec:
+        """Prototype TaskSpec for repeated submissions of the same
+        remote function: runtime env resolved and scheduling class
+        interned ONCE, per-call work reduced to id generation + arg
+        prep + a slot-copy clone (see TaskSpec.clone_for)."""
+        proto = TaskSpec(
+            task_id=b"", job_id=self.job_id,
+            task_type=TASK_NORMAL, name=name, fn_key=fn_key, args=[],
+            num_returns=num_returns,
+            resources=resources or {"CPU": 1.0},
+            max_retries=self.config.task_max_retries_default
+            if max_retries is None else max_retries,
+            retry_exceptions=retry_exceptions,
+            owner_address=self.address, owner_worker_id=self.worker_id,
+            placement_group_id=placement_group_id,
+            placement_group_bundle_index=placement_group_bundle_index,
+            scheduling_strategy=scheduling_strategy,
+            runtime_env=self._resolve_runtime_env(runtime_env))
+        proto.scheduling_class  # intern now, off the per-call path
+        return proto
+
+    def submit_task_from_template(self, proto: TaskSpec,
+                                  args: List[Any]) -> List[ObjectRef]:
+        if self.mode == "driver":
+            prefix = self._task_lineage_prefix
+        else:
+            prefix = (self._current_task_id or
+                      self._driver_task_id.binary())[:ACTOR_ID_SIZE]
+        if args:
+            prepared_args, arg_holds = self._prepare_args(args)
+        else:
+            prepared_args, arg_holds = (), None
+        spec = proto.clone_for(make_task_id_bytes(prefix), prepared_args,
+                               trace_ctx=_trace_ctx())
+        return self._register_and_submit(spec, arg_holds)
+
     def _register_and_submit(self, spec: TaskSpec,
                              arg_holds: Optional[List[ObjectRef]] = None
                              ) -> List[ObjectRef]:
@@ -874,24 +932,56 @@ class CoreWorker:
         self._enqueue_submit("task", spec)
         return refs
 
+    def queue_local_decref(self, object_id: ObjectID):
+        """Deferred remove_local_reference (called from ObjectRef.__del__,
+        any thread): batch the lock + release side effects onto the loop."""
+        self._decref_buffer.append(object_id)
+        if not self._decref_scheduled:
+            self._decref_scheduled = True
+            try:
+                self.loop.call_soon_threadsafe(self._drain_decrefs)
+            except RuntimeError:  # loop closed: shutting down
+                self._decref_scheduled = False
+
+    def _drain_decrefs(self):
+        self._decref_scheduled = False
+        buf = self._decref_buffer
+        remove = self.reference_counter.remove_local_reference
+        # Chunked: dropping a 1M-ref list must not freeze the IO loop
+        # for the whole backlog — yield after a slice and reschedule.
+        for _ in range(20000):
+            try:
+                oid = buf.popleft()
+            except IndexError:
+                return
+            remove(oid)
+        if buf and not self._decref_scheduled:
+            self._decref_scheduled = True
+            self.loop.call_soon(self._drain_decrefs)
+
     def _enqueue_submit(self, kind: str, spec: TaskSpec):
         """Queue a spec for submission and wake the IO loop at most once
         per burst (reference analog: the submitter queue pump in
-        direct_task_transport.cc, but batched for the caller thread)."""
-        with self._submit_lock:
-            self._submit_buffer.append((kind, spec))
-            if self._submit_scheduled:
-                return
+        direct_task_transport.cc, but batched for the caller thread).
+        Lock-free: deque.append is GIL-atomic, and the drain clears the
+        scheduled flag BEFORE popping, so the worst interleaving is one
+        spurious extra wakeup — never a stranded spec."""
+        self._submit_buffer.append((kind, spec))
+        if not self._submit_scheduled:
             self._submit_scheduled = True
-        self.loop.call_soon_threadsafe(self._drain_submit_buffer)
+            self.loop.call_soon_threadsafe(self._drain_submit_buffer)
 
     def _drain_submit_buffer(self):
         """Loop thread: move buffered submissions into per-key / per-actor
         queues, then pump each touched queue once."""
-        with self._submit_lock:
-            items = list(self._submit_buffer)
-            self._submit_buffer.clear()
-            self._submit_scheduled = False
+        self._submit_scheduled = False
+        items = []
+        buf = self._submit_buffer
+        while True:
+            try:
+                items.append(buf.popleft())
+            except IndexError:
+                break
         touched_keys: Dict[int, SchedulingKeyState] = {}
         touched_actors: Dict[bytes, ActorQueueState] = {}
         for kind, spec in items:
@@ -1181,8 +1271,14 @@ class CoreWorker:
             reply, rbufs = {"tasks": []}, []
         finally:
             state.steal_pending = False
-        for tw, fstart, nframes in reply["tasks"]:
-            spec = TaskSpec.from_wire(tw, list(rbufs[fstart:fstart + nframes]))
+        protos = [TaskSpec.from_tail_wire(t) for t in reply.get("protos", ())]
+        for pidx, task_id, args_wire, fstart, nframes, trace_ctx in \
+                reply["tasks"]:
+            spec = protos[pidx].clone_for(
+                task_id,
+                TaskSpec._args_from_wire(
+                    args_wire, list(rbufs[fstart:fstart + nframes])),
+                trace_ctx=tuple(trace_ctx) if trace_ctx else None)
             state.reassigned.setdefault(spec.task_id, []).append(
                 victim.worker_id)
             state.queue.append(spec)
@@ -1223,15 +1319,25 @@ class CoreWorker:
                                 lw: LeasedWorker, batch: List[TaskSpec]):
         """Loop thread: write ONE PushTasks frame carrying the whole batch
         and attach completion handling to the reply future — no per-task
-        coroutine, no per-task syscall."""
+        coroutine, no per-task syscall. Static spec fields ride once per
+        distinct prototype (TaskSpec.tail_wire), not once per task."""
+        tails: List[list] = []
+        tail_idx: Dict[int, int] = {}
         theaders: List[list] = []
         frames: List[bytes] = []
         for spec in batch:
-            tw, tfr = spec.to_wire()
-            theaders.append([tw, len(frames), len(tfr)])
-            frames.extend(tfr)
+            proto = spec._proto or spec
+            pidx = tail_idx.get(id(proto))
+            if pidx is None:
+                pidx = tail_idx[id(proto)] = len(tails)
+                tails.append(proto.tail_wire())
+            args_wire, afr = spec._args_wire()
+            theaders.append([pidx, spec.task_id, args_wire, len(frames),
+                             len(afr), spec.trace_ctx])
+            frames.extend(afr)
         try:
-            fut = lw.conn.call_nowait("PushTasks", {"tasks": theaders},
+            fut = lw.conn.call_nowait("PushTasks",
+                                      {"protos": tails, "tasks": theaders},
                                       bufs=frames)
         except ConnectionError:
             lw.inflight -= len(batch)
@@ -1277,7 +1383,7 @@ class CoreWorker:
             return
         reply, rbufs = fut.result()
         for spec, (rheader, fstart, nframes) in zip(batch, reply["replies"]):
-            if rheader.get("stolen"):
+            if rheader[0] == REPLY_STOLEN:
                 # relinquished by THIS worker via StealTasks; the steal
                 # reply already requeued it elsewhere. Consume only this
                 # victim's entry — a second steal's victim keeps its own.
@@ -1295,31 +1401,30 @@ class CoreWorker:
             if not self._try_steal(sc, state):
                 self._schedule_idle_return(sc, state, lw)
 
-    def _complete_task(self, spec: TaskSpec, reply: dict, rbufs: List[bytes]):
+    def _complete_task(self, spec: TaskSpec, reply: list, rbufs: List[bytes]):
         """Handle a task reply: land return values in the memory store /
-        record plasma locations (reference: TaskManager::CompletePendingTask)."""
+        record plasma locations (reference: TaskManager::CompletePendingTask).
+        ``reply`` is the compact [status, returns] list (task_spec.py)."""
         entry = self.pending_tasks.get(spec.task_id)
         if entry is None:
             return
-        if reply.get("status") == "error" and spec.retry_exceptions and \
+        if reply[0] == REPLY_ERROR and spec.retry_exceptions and \
                 entry.num_retries_left != 0:
             if entry.num_retries_left > 0:
                 entry.num_retries_left -= 1
             self.stats["tasks_retried"] += 1
             self._queue_spec(spec)
             return
-        returns = reply.get("returns", [])
-        for ret in returns:
-            oid = ObjectID(ret["object_id"])
-            if ret.get("in_plasma"):
-                self.reference_counter.add_location(oid, ret["node_id"],
-                                                    ret.get("size", 0))
+        for oid_b, in_plasma, meta, start, n, contained_b in reply[1]:
+            oid = ObjectID(oid_b)
+            if in_plasma:
+                # plasma entry: meta=node_id, start=size
+                self.reference_counter.add_location(oid, meta, start)
                 self.memory_store.put(oid, IN_PLASMA)
             else:
-                start, n = ret["frame_start"], ret["num_frames"]
-                obj = SerializedObject(ret["metadata"], rbufs[start:start + n])
-                contained = [ObjectID(b) for b in ret.get("contained", [])]
-                if contained:
+                obj = SerializedObject(meta, rbufs[start:start + n])
+                if contained_b:
+                    contained = [ObjectID(b) for b in contained_b]
                     self.reference_counter.add_contained_refs(oid, contained)
                     obj.contained_refs = contained
                 self.memory_store.put(oid, obj)
@@ -1329,7 +1434,7 @@ class CoreWorker:
             entry.recovery_waiter = None
             if not waiter.done():
                 waiter.set_result(True)
-        if not spec.is_actor_task():
+        if spec.args and not spec.is_actor_task():
             self.reference_counter.update_finished_task_references(
                 [ObjectID(b) for b in spec.dependency_ids()])
         # Lineage stays for reconstruction; drop spec args to bound memory.
@@ -1579,12 +1684,13 @@ class CoreWorker:
         for (spec, seqno), (rheader, fstart, nframes) in zip(
                 batch, reply["replies"]):
             q.inflight.pop(seqno, None)
-            if rheader.get("status") == "actor_restarting":
+            if rheader[0] == REPLY_ACTOR_RESTARTING:
                 requeue.append((spec, seqno))
                 continue
             self._complete_task(spec, rheader, rbufs[fstart:fstart + nframes])
-            self.reference_counter.update_finished_task_references(
-                [ObjectID(b) for b in spec.dependency_ids()])
+            if spec.args:
+                self.reference_counter.update_finished_task_references(
+                    [ObjectID(b) for b in spec.dependency_ids()])
         if requeue:
             q.buffer.extendleft(reversed(requeue))
 
@@ -1601,13 +1707,14 @@ class CoreWorker:
             spec, _ = entry
             rheader = header["reply"]
             q.inflight.pop(seqno, None)
-            if rheader.get("status") == "actor_restarting":
+            if rheader[0] == REPLY_ACTOR_RESTARTING:
                 q.buffer.append((spec, seqno))
                 self._pump_actor_queue(q)
                 return
             self._complete_task(spec, rheader, list(bufs))
-            self.reference_counter.update_finished_task_references(
-                [ObjectID(b) for b in spec.dependency_ids()])
+            if spec.args:
+                self.reference_counter.update_finished_task_references(
+                    [ObjectID(b) for b in spec.dependency_ids()])
         return handler
 
     def cancel(self, ref: ObjectRef, force: bool = False):
@@ -1688,12 +1795,24 @@ class CoreWorker:
         if self.config.profiling_enabled:
             self._task_events.append(event)
 
+    def add_exec_event(self, name: str, task_id: bytes,
+                       start: float, end: float):
+        """Hot-path execution event: append a TUPLE; the dict form (with
+        hex ids) is built lazily at flush time, off the per-task path."""
+        self._task_events.append(("task:execute", name, task_id, start, end))
+
     async def _profile_flush_loop(self):
         period = self.config.metrics_report_period_ms / 1000.0
         while not self._shutdown:
             await asyncio.sleep(period)
             if self._task_events and self.gcs_conn and not self.gcs_conn.closed:
                 events, self._task_events = self._task_events, []
+                wid = self.worker_id.hex()
+                events = [
+                    {"event": e[0], "name": e[1], "task_id": e[2].hex(),
+                     "start": e[3], "end": e[4], "worker_id": wid}
+                    if type(e) is tuple else e
+                    for e in events]
                 try:
                     await self.gcs_conn.call("AddProfileEvents",
                                              {"events": events})

@@ -58,9 +58,11 @@ class FunctionManager:
             self._pickled_cache[key] = pickled
 
     def fetch(self, key: str) -> Any:
-        with self._lock:
-            if key in self._cache:
-                return self._cache[key]
+        # Lock-free fast path: dict reads are atomic under the GIL and
+        # entries are only ever added (per-task hot path).
+        fn = self._cache.get(key)
+        if fn is not None:
+            return fn
         pickled = self._kv_get(FN_KV_PREFIX + key.encode())
         if pickled is None:
             raise RuntimeError(f"function {key} not found in GCS KV")

@@ -38,6 +38,75 @@ PG_CREATED = "CREATED"
 PG_REMOVED = "REMOVED"
 
 
+# Human-facing cluster status page (reference: dashboard/client React
+# app's node/actor/job views — here one dependency-free static page over
+# the same /api/* routes, refreshed client-side).
+_STATUS_PAGE = b"""<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
+<style>
+ body{font:13px/1.5 system-ui,sans-serif;margin:1.2em;color:#222}
+ h1{font-size:18px} h2{font-size:14px;margin:1.2em 0 .3em}
+ table{border-collapse:collapse;width:100%;margin-bottom:.6em}
+ th,td{border:1px solid #ccc;padding:2px 8px;text-align:left;
+       font:12px/1.4 ui-monospace,monospace}
+ th{background:#f0f0f0} .dead{color:#b00} .alive{color:#070}
+ #err{color:#b00}
+</style></head><body>
+<h1>ray_tpu cluster <span id="ts"></span></h1><div id="err"></div>
+<h2>Cluster</h2><table id="cluster"></table>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Actors</h2><table id="actors"></table>
+<h2>Jobs</h2><table id="jobs"></table>
+<h2>Placement groups</h2><table id="pgs"></table>
+<script>
+function row(tr, cells, tag) {
+  var r = document.createElement('tr');
+  cells.forEach(function(c){
+    var td = document.createElement(tag||'td');
+    if (c && c.cls) { td.textContent = c.v; td.className = c.cls; }
+    else td.textContent = (typeof c === 'object') ? JSON.stringify(c) : c;
+    r.appendChild(td);
+  });
+  tr.appendChild(r);
+}
+function fill(id, hdr, rows) {
+  var t = document.getElementById(id); t.innerHTML = '';
+  row(t, hdr, 'th'); rows.forEach(function(r){ row(t, r); });
+}
+async function tick() {
+  try {
+    var j = async function(p){ return (await fetch(p)).json(); };
+    var c = await j('/api/cluster');
+    fill('cluster', Object.keys(c), [Object.values(c)]);
+    var nodes = await j('/api/nodes');
+    fill('nodes', ['node_id','address','state','cpu_avail/total',
+                   'heartbeat_age_s'],
+      nodes.map(function(n){ return [n.node_id.slice(0,12), n.address,
+        {v: n.alive ? 'ALIVE' : 'DEAD', cls: n.alive ? 'alive' : 'dead'},
+        (n.resources_available.CPU||0)+'/'+(n.resources_total.CPU||0),
+        n.last_heartbeat_age_s]; }));
+    var actors = await j('/api/actors');
+    fill('actors', ['actor_id','name','class','state','restarts','node'],
+      actors.map(function(a){ return [a.actor_id.slice(0,12), a.name,
+        a.class_name, a.state, a.num_restarts+'/'+a.max_restarts,
+        a.node_id.slice(0,12)]; }));
+    var jobs = await j('/api/jobs');
+    fill('jobs', jobs.length ? Object.keys(jobs[0]) : ['job_id'],
+      jobs.map(function(x){ return Object.values(x); }));
+    var pgs = await j('/api/placement_groups');
+    fill('pgs', ['pg_id','name','strategy','state','bundles'],
+      pgs.map(function(p){ return [p.pg_id.slice(0,12), p.name||'',
+        p.strategy, p.state, p.bundles]; }));
+    document.getElementById('ts').textContent =
+      '- ' + new Date().toLocaleTimeString();
+    document.getElementById('err').textContent = '';
+  } catch (e) { document.getElementById('err').textContent = 'refresh failed: ' + e; }
+}
+tick(); setInterval(tick, 5000);
+</script></body></html>
+"""
+
+
 class NodeEntry:
     def __init__(self, node_id: bytes, address: str, resources: Dict[str, float],
                  node_name: str = ""):
@@ -203,13 +272,16 @@ class GcsServer:
                 body = self._render_metrics().encode()
                 status, ctype = b"200 OK", b"text/plain; version=0.0.4"
             elif path.startswith(b"/api/"):
-                body, status = self._dashboard_api(
+                body, status = await self._dashboard_api(
                     path.decode("latin-1", errors="replace"))
                 ctype = b"application/json"
+            elif path in (b"/", b"/index.html", b"/dashboard"):
+                body = _STATUS_PAGE
+                status, ctype = b"200 OK", b"text/html; charset=utf-8"
             else:
-                body = (b"ray_tpu head: scrape /metrics; dashboard API "
-                        b"under /api/ (nodes|actors|jobs|cluster|"
-                        b"placement_groups|metrics)\n")
+                body = (b"ray_tpu head: status page at /; scrape /metrics; "
+                        b"dashboard API under /api/ (nodes|actors|jobs|"
+                        b"cluster|placement_groups|metrics|logs|stacks)\n")
                 status, ctype = b"200 OK", b"text/plain"
             writer.write(b"HTTP/1.1 " + status +
                          b"\r\nContent-Type: " + ctype +
@@ -225,17 +297,53 @@ class GcsServer:
             except Exception:  # noqa: BLE001
                 pass
 
-    def _dashboard_api(self, path: str):
+    async def _dashboard_api(self, path: str):
         """Dashboard-lite: JSON cluster state straight off the GCS
         tables (reference: dashboard/head.py + datacenter.py aggregate
-        the same node/actor/job views; no React client here — the JSON
-        API is the product)."""
+        the same node/actor/job views; the human-facing view is the
+        static status page at ``/`` rendering these routes). ``/api/
+        logs`` and ``/api/stacks`` proxy to the node's raylet for
+        per-node depth (reference: dashboard/modules/log + `ray
+        stack`)."""
         import json
+        from urllib.parse import parse_qs
 
         def dump(obj):
             return json.dumps(obj, default=str).encode(), b"200 OK"
 
-        route = path.split("?")[0].rstrip("/")
+        route, _, qs = path.partition("?")
+        route = route.rstrip("/")
+        params = {k: v[0] for k, v in parse_qs(qs).items()}
+        if route in ("/api/logs", "/api/stacks"):
+            node = None
+            want = params.get("node", "")
+            for n in self.nodes.values():
+                if n.alive and (not want or n.node_id.hex().startswith(want)):
+                    node = n
+                    break
+            if node is None:
+                return dump({"error": f"no alive node matching {want!r}"})
+            from ray_tpu._private import rpc as rpc_mod
+            try:
+                conn = await rpc_mod.connect(node.address,
+                                             peer_name="dashboard")
+                try:
+                    if route == "/api/stacks":
+                        reply, _b = await conn.call(
+                            "DumpWorkerStacks", {}, timeout=15.0)
+                        reply.pop("node_id", None)
+                        reply["node"] = node.node_id.hex()
+                    else:
+                        reply, _b = await conn.call("GetLogs", {
+                            "name": params.get("name", ""),
+                            "tail": params.get("tail", "200"),
+                        }, timeout=10.0)
+                        reply["node"] = node.node_id.hex()
+                    return dump(reply)
+                finally:
+                    await conn.close()
+            except (ConnectionError, asyncio.TimeoutError) as e:
+                return dump({"error": f"raylet unreachable: {e}"})
         if route == "/api/nodes":
             return dump([{
                 "node_id": n.node_id.hex(), "address": n.address,

@@ -48,7 +48,7 @@ class ObjectRef:
         worker = self._worker
         if worker is not None:
             try:
-                worker.reference_counter.remove_local_reference(self.object_id)
+                worker.queue_local_decref(self.object_id)
             except Exception:
                 pass
 

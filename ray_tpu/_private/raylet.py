@@ -140,6 +140,9 @@ class Raylet:
         # north-star p50/p99 schedule-latency metric, BASELINE.json).
         from collections import deque as _deque
         self._sched_latencies: Any = _deque(maxlen=65536)
+        # split reservoirs: arrival->first-decision / first-decision->grant
+        self._decision_latencies: Any = _deque(maxlen=65536)
+        self._grant_waits: Any = _deque(maxlen=65536)
         # (queue_len, wall_s) per scheduler tick — the pure decision
         # cost of the kernel, free of queueing effects.
         self._tick_durations: Any = _deque(maxlen=65536)
@@ -162,6 +165,8 @@ class Raylet:
             "CommitPGBundle": self.handle_commit_pg_bundle,
             "ReturnPGBundle": self.handle_return_pg_bundle,
             "GetNodeStats": self.handle_get_node_stats,
+            "DumpWorkerStacks": self.handle_dump_worker_stacks,
+            "GetLogs": self.handle_get_logs,
             "Published": self.handle_published,
         }
 
@@ -619,8 +624,14 @@ class Raylet:
         decisions = self.backend.schedule(
             reqs, nodes, self.config.scheduler_spread_threshold) if reqs else []
         if reqs:
-            self._tick_durations.append(
-                (len(reqs), time.monotonic() - t_tick))
+            t_done = time.monotonic()
+            self._tick_durations.append((len(reqs), t_done - t_tick))
+            for req in reqs:
+                if not req.first_decision_ts:
+                    req.first_decision_ts = t_done
+        for rid, req, fut in pg_grants:
+            if not req.first_decision_ts:
+                req.first_decision_ts = t_tick
         for d in decisions:
             req, fut = self._pending.get(d.req_id, (None, None))
             if req is None or fut.done():
@@ -1167,23 +1178,41 @@ class Raylet:
     # -------------------------------------------------------------- stats
 
     def _note_latency(self, req) -> None:
-        if getattr(req, "arrival_ts", 0.0):
-            self._sched_latencies.append(
-                time.monotonic() - req.arrival_ts)
+        now = time.monotonic()
+        arrival = getattr(req, "arrival_ts", 0.0)
+        if arrival:
+            self._sched_latencies.append(now - arrival)
+            first = getattr(req, "first_decision_ts", 0.0)
+            if first:
+                self._decision_latencies.append(first - arrival)
+                self._grant_waits.append(now - first)
 
-    def _latency_percentiles(self) -> dict:
+    @staticmethod
+    def _pct_block(samples) -> dict:
         from ray_tpu._private.metrics import percentile
 
-        lat = sorted(self._sched_latencies)
+        lat = sorted(samples)
         if not lat:
             return {"count": 0}
-        out = {
+        return {
             "count": len(lat),
             "p50_ms": round(percentile(lat, 0.50) * 1e3, 3),
             "p90_ms": round(percentile(lat, 0.90) * 1e3, 3),
             "p99_ms": round(percentile(lat, 0.99) * 1e3, 3),
             "max_ms": round(lat[-1] * 1e3, 3),
         }
+
+    def _latency_percentiles(self) -> dict:
+        from ray_tpu._private.metrics import percentile
+
+        out = self._pct_block(self._sched_latencies)
+        if not out["count"]:
+            return out
+        # arrival->first-decision (kernel responsiveness) vs
+        # first-decision->grant (resource/queue wait): reported apart so
+        # a saturated node's backlog can't mask kernel regressions.
+        out["decision"] = self._pct_block(self._decision_latencies)
+        out["grant_wait"] = self._pct_block(self._grant_waits)
         ticks = list(self._tick_durations)
         if ticks:
             durs = sorted(t for _, t in ticks)
@@ -1195,6 +1224,62 @@ class Raylet:
                 "max_ms": round(durs[-1] * 1e3, 3),
             }
         return out
+
+    async def handle_dump_worker_stacks(self, conn, header, bufs):
+        """Aggregate all-thread stack dumps from every live worker on
+        this node (reference: `ray stack`, scripts.py:1393 — py-spy
+        over local pids; here each worker self-reports over RPC)."""
+        out = []
+        for w in list(self.workers.values()):
+            if w.conn is None or w.conn.closed or w.state == WORKER_DEAD:
+                continue
+            try:
+                reply, _ = await w.conn.call("DumpStack", {}, timeout=5.0)
+                reply["worker_id"] = w.worker_id.hex() \
+                    if isinstance(w.worker_id, bytes) else w.worker_id
+                out.append(reply)
+            except (ConnectionError, asyncio.TimeoutError):
+                out.append({"pid": w.pid, "error": "unreachable"})
+        return {"node_id": self.node_id.binary(), "workers": out}
+
+    async def handle_get_logs(self, conn, header, bufs):
+        """List / tail this node's session log files (reference:
+        dashboard log module, dashboard/modules/log — per-node file
+        serving; here the raylet serves its own session dir)."""
+        log_dir = os.path.join(self.session_dir, "logs")
+        name = header.get("name") or ""
+        try:
+            tail = int(header.get("tail") or 200)
+        except (TypeError, ValueError):
+            tail = 200
+        try:
+            files = sorted(os.listdir(log_dir))
+        except OSError:
+            files = []
+        if not name:
+            out = []
+            for fname in files:
+                try:
+                    out.append({"name": fname, "size": os.path.getsize(
+                        os.path.join(log_dir, fname))})
+                except OSError:
+                    continue
+            return {"files": out}
+        matches = [f for f in files if name in f]
+        if not matches:
+            return {"error": f"no log file matching {name!r}",
+                    "files": [{"name": f} for f in files]}
+        path = os.path.join(log_dir, matches[0])
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - 256 * 1024))
+                lines = f.read().decode(
+                    "utf-8", errors="replace").splitlines()[-tail:]
+        except OSError as e:
+            return {"error": str(e)}
+        return {"name": matches[0], "lines": lines}
 
     async def handle_get_node_stats(self, conn, header, bufs):
         from ray_tpu._private.rpc import handler_stats

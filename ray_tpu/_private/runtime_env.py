@@ -32,12 +32,14 @@ from typing import Any, Callable, Dict, Optional
 logger = logging.getLogger(__name__)
 
 PKG_KEY_PREFIX = b"rtpu:pkg:"
+WHEEL_KEY_PREFIX = b"rtpu:whl:"
 JOB_ENV_KEY_PREFIX = b"rtpu:job_env:"
 # Parked module trees per package dir (see activate()): makes env-hash
 # worker reuse skip re-imports.
 _module_cache: Dict[str, Dict[str, Any]] = {}
 URI_SCHEME = "pkg:"
-SUPPORTED_KEYS = {"env_vars", "working_dir", "working_dir_uri"}
+WHEEL_URI_SCHEME = "kvwhl:"
+SUPPORTED_KEYS = {"env_vars", "working_dir", "working_dir_uri", "pip"}
 MAX_PACKAGE_BYTES = 512 * 1024 * 1024
 _DEFAULT_EXCLUDES = {"__pycache__", ".git", ".venv", "node_modules"}
 
@@ -51,6 +53,11 @@ def validate_runtime_env(runtime_env: Dict[str, Any]) -> None:
     env_vars = runtime_env.get("env_vars") or {}
     if not isinstance(env_vars, dict):
         raise ValueError("runtime_env['env_vars'] must be a dict")
+    pip = runtime_env.get("pip")
+    if pip is not None and not isinstance(pip, (list, tuple, str)):
+        raise ValueError(
+            "runtime_env['pip'] must be a list of requirement strings / "
+            "local wheel paths, or a path to a requirements.txt")
 
 
 def hash_runtime_env(runtime_env: Optional[Dict[str, Any]]) -> str:
@@ -135,22 +142,74 @@ def prepare_runtime_env(runtime_env: Optional[Dict[str, Any]],
         return runtime_env
     validate_runtime_env(runtime_env)
     wd = runtime_env.get("working_dir")
-    if not wd:
+    pip = runtime_env.get("pip")
+    if not wd and not pip:
         return runtime_env
     out = {k: v for k, v in runtime_env.items() if k != "working_dir"}
-    abspath = os.path.abspath(os.path.expanduser(wd))
-    sig = _dir_signature(abspath)
-    cached = uploaded_cache.get(abspath)
-    if cached is not None and cached[0] == sig:
-        out["working_dir_uri"] = cached[1]
-        return out
-    blob, pkg_hash = package_working_dir(wd)
-    key = PKG_KEY_PREFIX + pkg_hash.encode()
-    if kv_get(key) is None:
-        kv_put(key, blob)
-    uri = URI_SCHEME + pkg_hash
-    uploaded_cache[abspath] = (sig, uri)
-    out["working_dir_uri"] = uri
+    if wd:
+        abspath = os.path.abspath(os.path.expanduser(wd))
+        sig = _dir_signature(abspath)
+        cached = uploaded_cache.get(abspath)
+        if cached is not None and cached[0] == sig:
+            out["working_dir_uri"] = cached[1]
+        else:
+            blob, pkg_hash = package_working_dir(wd)
+            key = PKG_KEY_PREFIX + pkg_hash.encode()
+            if kv_get(key) is None:
+                kv_put(key, blob)
+            uri = URI_SCHEME + pkg_hash
+            uploaded_cache[abspath] = (sig, uri)
+            out["working_dir_uri"] = uri
+    if pip:
+        out["pip"] = prepare_pip_entries(pip, kv_get, kv_put,
+                                         uploaded_cache)
+    return out
+
+
+def prepare_pip_entries(pip, kv_get, kv_put, cache=None) -> list:
+    """Driver-side pip normalization (reference role:
+    _private/runtime_env/conda.py + validation.py — dependencies become
+    part of the env identity). A ``requirements.txt`` path expands to
+    its lines; local wheel/sdist paths upload to the cluster KV by
+    content hash and rewrite to ``kvwhl:<hash>:<filename>`` so a node
+    with no index access (or no shared filesystem) can still install
+    them; plain requirement strings pass through to pip untouched.
+    Uploads cache by (size, mtime) signature — and a wheel deleted
+    AFTER upload keeps resolving to its KV copy (only the cluster
+    needs it now)."""
+    if isinstance(pip, str):
+        with open(os.path.expanduser(pip)) as f:
+            entries = [ln.strip() for ln in f
+                       if ln.strip() and not ln.strip().startswith("#")]
+    else:
+        entries = [str(e) for e in pip]
+    out = []
+    for e in entries:
+        if not e.endswith((".whl", ".tar.gz", ".zip")):
+            out.append(e)
+            continue
+        path = os.path.abspath(os.path.expanduser(e))
+        cached = cache.get(path) if cache is not None else None
+        if os.path.isfile(path):
+            st = os.stat(path)
+            sig = (st.st_size, st.st_mtime_ns)
+            if cached is not None and cached[0] == sig:
+                out.append(cached[1])
+                continue
+            with open(path, "rb") as f:
+                blob = f.read()
+            whl_hash = hashlib.sha1(blob).hexdigest()[:20]
+            key = WHEEL_KEY_PREFIX + whl_hash.encode()
+            if kv_get(key) is None:
+                kv_put(key, blob)
+            uri = f"{WHEEL_URI_SCHEME}{whl_hash}:{os.path.basename(path)}"
+            if cache is not None:
+                cache[path] = (sig, uri)
+            out.append(uri)
+        elif cached is not None:
+            out.append(cached[1])  # uploaded earlier, source since deleted
+        else:
+            out.append(e)  # not a local file: hand to pip verbatim
     return out
 
 
@@ -190,6 +249,79 @@ def ensure_local_package(uri: str, base_dir: str,
     return target
 
 
+def ensure_pip_env(entries, base_dir: str,
+                   kv_get: Callable[[bytes], Optional[bytes]]) -> str:
+    """Worker-side: materialize a pip environment directory for the
+    normalized entry list; created ONCE per node under
+    ``<session>/runtime_resources/pip/<hash>`` (atomic rename), shared
+    by every worker on the node (reference role: per-node runtime-env
+    agent materializing conda/pip envs, agent_manager.h:43 — here the
+    first worker to need the env builds it).
+
+    Isolation via ``pip install --target`` into the keyed dir (no venv
+    spawn): activation is a sys.path prepend, so warm workers pay
+    nothing and the host interpreter's site-packages stays untouched."""
+    import subprocess
+    import sys as _sys
+
+    env_key = hashlib.sha1(
+        json.dumps(list(entries)).encode()).hexdigest()[:16]
+    target = os.path.join(base_dir, "runtime_resources", "pip", env_key)
+    if os.path.isdir(target):
+        return target
+    os.makedirs(os.path.dirname(target), exist_ok=True)
+    tmp = tempfile.mkdtemp(dir=os.path.dirname(target),
+                           prefix=f".{env_key}-")
+    wheel_dir = os.path.join(tmp, ".wheels")
+    try:
+        args = []
+        all_kv = True
+        for e in entries:
+            if e.startswith(WHEEL_URI_SCHEME):
+                whl_hash, _, fname = e[len(WHEEL_URI_SCHEME):].partition(":")
+                blob = kv_get(WHEEL_KEY_PREFIX + whl_hash.encode())
+                if blob is None:
+                    raise RuntimeError(
+                        f"pip wheel {fname} ({whl_hash}) not in cluster KV")
+                os.makedirs(wheel_dir, exist_ok=True)
+                local = os.path.join(wheel_dir, fname)
+                with open(local, "wb") as f:
+                    f.write(blob)
+                args.append(local)
+            else:
+                args.append(e)
+                all_kv = False
+        cmd = [_sys.executable, "-m", "pip", "install", "--target", tmp,
+               "--no-warn-script-location", "--disable-pip-version-check",
+               "--quiet"]
+        if all_kv:
+            cmd += ["--no-index"]  # fully offline: every dep is a KV wheel
+        try:
+            r = subprocess.run(cmd + args, text=True, timeout=600,
+                               stdout=subprocess.PIPE,
+                               stderr=subprocess.STDOUT)
+        except subprocess.TimeoutExpired:
+            raise RuntimeError(
+                "pip install for runtime_env timed out (600s)") from None
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"pip install for runtime_env failed "
+                f"(exit {r.returncode}):\n{r.stdout[-2000:]}")
+        import shutil
+        shutil.rmtree(wheel_dir, ignore_errors=True)
+        try:
+            os.rename(tmp, target)
+        except OSError:
+            pass  # somebody else won the race
+    finally:
+        # rename moved tmp away on success; anything left (failed or
+        # lost-race install) must not accumulate across task retries
+        if os.path.isdir(tmp):
+            import shutil
+            shutil.rmtree(tmp, ignore_errors=True)
+    return target
+
+
 @contextlib.contextmanager
 def activate(runtime_env: Optional[Dict[str, Any]], base_dir: str,
              kv_get: Callable[[bytes], Optional[bytes]]):
@@ -205,8 +337,15 @@ def activate(runtime_env: Optional[Dict[str, Any]], base_dir: str,
     saved_env = {k: os.environ.get(k) for k in env_vars}
     os.environ.update(env_vars)
     uri = runtime_env.get("working_dir_uri")
+    pip_entries = runtime_env.get("pip")
     saved_cwd = None
     pkg_dir = None
+    pip_dir = None
+    if pip_entries:
+        pip_dir = ensure_pip_env(pip_entries, base_dir, kv_get)
+        sys.path.insert(0, pip_dir)
+        for mod_name, mod in _module_cache.pop(pip_dir, {}).items():
+            sys.modules.setdefault(mod_name, mod)
     if uri:
         pkg_dir = ensure_local_package(uri, base_dir, kv_get)
         saved_cwd = os.getcwd()
@@ -224,17 +363,23 @@ def activate(runtime_env: Optional[Dict[str, Any]], base_dir: str,
                 sys.path.remove(pkg_dir)
             with contextlib.suppress(OSError):
                 os.chdir(saved_cwd)
-            # Reversibility includes imports: modules loaded FROM the
-            # package must not leak into later tasks on this worker
-            # (those tasks may carry a different working_dir with a
-            # same-named module). They are PARKED, not dropped: a later
-            # task with the same package restores them without
-            # re-importing — this is what makes env-hash worker
-            # affinity (raylet _pop_idle_worker) worth having.
-            parked = _module_cache.setdefault(pkg_dir, {})
+        # Reversibility includes imports: modules loaded FROM the
+        # package / pip env must not leak into later tasks on this
+        # worker (those tasks may carry a different env with a
+        # same-named module). They are PARKED, not dropped: a later
+        # task with the same env restores them without re-importing —
+        # this is what makes env-hash worker affinity
+        # (raylet _pop_idle_worker) worth having.
+        for env_dir in (pkg_dir, pip_dir):
+            if env_dir is None:
+                continue
+            if env_dir is pip_dir:
+                with contextlib.suppress(ValueError):
+                    sys.path.remove(pip_dir)
+            parked = _module_cache.setdefault(env_dir, {})
             for mod_name, mod in list(sys.modules.items()):
                 mod_file = getattr(mod, "__file__", None) or ""
-                if mod_file.startswith(pkg_dir + os.sep):
+                if mod_file.startswith(env_dir + os.sep):
                     parked[mod_name] = mod
                     del sys.modules[mod_name]
         for k, old in saved_env.items():
@@ -253,6 +398,9 @@ def activate_persistent(runtime_env: Optional[Dict[str, Any]],
     os.environ.update(
         {str(k): str(v)
          for k, v in (runtime_env.get("env_vars") or {}).items()})
+    pip_entries = runtime_env.get("pip")
+    if pip_entries:
+        sys.path.insert(0, ensure_pip_env(pip_entries, base_dir, kv_get))
     uri = runtime_env.get("working_dir_uri")
     if uri:
         pkg_dir = ensure_local_package(uri, base_dir, kv_get)

@@ -49,6 +49,12 @@ class PendingRequest:
     deps_ready: bool = True
     # monotonic arrival time (schedule-latency accounting)
     arrival_ts: float = 0.0
+    # monotonic time of the FIRST scheduler tick that evaluated this
+    # request: arrival->first_decision is pure decision latency;
+    # first_decision->grant is resource wait (the two must be reported
+    # separately — on a saturated node the latter measures queue depth,
+    # not the kernel).
+    first_decision_ts: float = 0.0
 
 
 @dataclass

@@ -30,9 +30,12 @@ from ray_tpu._private.core_worker import CoreWorker
 from ray_tpu._private.ids import ObjectID, TaskID
 from ray_tpu._private.memory_store import IN_PLASMA
 from ray_tpu._private.object_ref import ObjectRef
-from ray_tpu._private.serialization import SerializedObject, format_task_error
+from ray_tpu._private.serialization import (META_RAW, SerializedObject,
+                                            format_task_error)
 from ray_tpu._private.shm_store import write_segment
-from ray_tpu._private.task_spec import ARG_REF, ARG_VALUE, TaskSpec
+from ray_tpu._private.ids import return_object_id_bytes
+from ray_tpu._private.task_spec import (ARG_REF, ARG_VALUE, REPLY_ERROR,
+                                        REPLY_OK, REPLY_STOLEN, TaskSpec)
 
 logger = logging.getLogger(__name__)
 
@@ -189,6 +192,7 @@ class TaskExecutor:
             "CreateActor": self.handle_create_actor,
             "PushActorTasks": self.handle_push_actor_tasks,
             "CancelTask": self.handle_cancel_task,
+            "DumpStack": self.handle_dump_stack,
             "Exit": self.handle_exit,
         })
         self._cancelled: set[bytes] = set()
@@ -231,13 +235,19 @@ class TaskExecutor:
 
     def handle_push_tasks(self, conn, header, bufs):
         """Sync RPC fast path (rpc_sync): queue the batch for the execution
-        thread and return the batch future the RPC layer replies from."""
+        thread and return the batch future the RPC layer replies from.
+        The batch carries each distinct static spec tail once
+        (TaskSpec.tail_wire); per-task entries are [proto_idx, task_id,
+        args_wire, frame_start, num_frames, trace_ctx]."""
         loop = asyncio.get_running_loop()
         tasks = header["tasks"]
+        protos = [TaskSpec.from_tail_wire(t) for t in header["protos"]]
         batch = _BatchState(loop, len(tasks))
         put = self._exec_queue.put
-        for i, (tw, fstart, nframes) in enumerate(tasks):
-            put((tw, bufs[fstart:fstart + nframes], batch, i))
+        for i, (pidx, task_id, args_wire, fstart, nframes, trace_ctx) in \
+                enumerate(tasks):
+            put((protos[pidx], task_id, args_wire,
+                 bufs[fstart:fstart + nframes], trace_ctx, batch, i))
         return batch.fut
 
     handle_push_tasks.rpc_sync = True
@@ -249,21 +259,28 @@ class TaskExecutor:
         slots in the original PushTasks batch reply resolve to a
         ``stolen`` marker the owner skips."""
         items = self._exec_queue.steal(int(header.get("max_n", 0)))
+        tails: List[list] = []
+        tail_idx: dict = {}
         theaders: List[list] = []
         frames: List[bytes] = []
-        for tw, tbufs, batch, i in items:
-            spec = TaskSpec.from_wire(tw, tbufs)
-            if spec.task_id in self._cancelled:
+        for proto, task_id, args_wire, tbufs, trace_ctx, batch, i in items:
+            if task_id in self._cancelled:
                 # an acknowledged cancel must not be undone by moving
                 # the task to a thief that never saw the CancelTask
-                self._cancelled.discard(spec.task_id)
+                self._cancelled.discard(task_id)
                 batch.complete(i, self._error_reply(
-                    spec, exc.TaskCancelledError(spec.name)))
+                    proto.clone_for(task_id, []),
+                    exc.TaskCancelledError(proto.name)))
                 continue
-            theaders.append([tw, len(frames), len(tbufs)])
+            pidx = tail_idx.get(id(proto))
+            if pidx is None:
+                pidx = tail_idx[id(proto)] = len(tails)
+                tails.append(proto.tail_wire())
+            theaders.append([pidx, task_id, args_wire, len(frames),
+                             len(tbufs), trace_ctx])
             frames.extend(tbufs)
-            batch.complete(i, ({"stolen": True}, []))
-        return {"tasks": theaders}, frames
+            batch.complete(i, ([REPLY_STOLEN, ()], []))
+        return {"protos": tails, "tasks": theaders}, frames
 
     def _exec_loop(self):
         self._serial_exec_loop(self._exec_queue, self._run_one_task,
@@ -313,14 +330,20 @@ class TaskExecutor:
 
     def _batched_exec_loop(self, q, run_one):
         checkpoint = self._profile_checkpoint
+        args_from_wire = TaskSpec._args_from_wire
         n_done = 0
         while True:
-            tw, bufs, batch, i = q.get()
+            proto, task_id, args_wire, bufs, trace_ctx, batch, i = q.get()
             try:
-                reply = run_one(TaskSpec.from_wire(tw, bufs))
+                spec = proto.clone_for(
+                    task_id,
+                    args_from_wire(args_wire, bufs) if args_wire else (),
+                    trace_ctx=tuple(trace_ctx) if trace_ctx else None)
+                reply = run_one(spec)
             except BaseException as e:  # noqa: BLE001 — keep thread alive
                 logger.exception("task execution loop error")
-                reply = self._infra_error_reply(tw, e)
+                reply = self._infra_error_reply_for(
+                    task_id, proto.num_returns, e)
             batch.complete(i, reply)
             if checkpoint is not None:
                 n_done += 1
@@ -367,24 +390,24 @@ class TaskExecutor:
         """Error reply built from the raw wire header (the spec may not even
         deserialize): every declared return gets an error object so the
         caller's get() raises instead of hanging."""
-        serialized = self.core.serialization_context.serialize_error(
-            exc.RaySystemError(f"task execution failed in the worker: {e!r}"))
-        meta, frames = serialized.to_wire()
         raw_task_id = tw[TaskSpec.WIRE_TASK_ID] if len(tw) > 0 else b"\0" * 24
         num_returns = tw[TaskSpec.WIRE_NUM_RETURNS] \
             if len(tw) > TaskSpec.WIRE_NUM_RETURNS else 1
-        task_id = TaskID(raw_task_id)
+        return self._infra_error_reply_for(raw_task_id, num_returns, e)
+
+    def _infra_error_reply_for(self, task_id: bytes, num_returns: int,
+                               e: BaseException):
+        serialized = self.core.serialization_context.serialize_error(
+            exc.RaySystemError(f"task execution failed in the worker: {e!r}"))
+        meta, frames = serialized.to_wire()
         returns = []
         frames_out: List[bytes] = []
         for i in range(max(num_returns, 1)):
             start = len(frames_out)
             frames_out.extend(frames)
-            returns.append({"object_id": task_id.object_id(i + 1).binary(),
-                            "in_plasma": False, "metadata": meta,
-                            "frame_start": start, "num_frames": len(frames),
-                            "contained": []})
-        return {"status": "error", "task_id": raw_task_id,
-                "returns": returns}, frames_out
+            returns.append([return_object_id_bytes(task_id, i + 1), 0,
+                            meta, start, len(frames), ()])
+        return [REPLY_ERROR, returns], frames_out
 
     @staticmethod
     def _deliver_replies(results):
@@ -393,33 +416,35 @@ class TaskExecutor:
                 fut.set_result(reply)
 
     def _execute_task_sync(self, spec: TaskSpec):
+        core = self.core
         _task_ctx.task_id = spec.task_id
-        self.core._current_task_id = spec.task_id
-        if not self.core.job_id and spec.job_id:
+        core._current_task_id = spec.task_id
+        if not core.job_id and spec.job_id:
             # adopt the submitting job: nested task/actor creation from
             # this worker needs a job id for ID derivation (and the
             # job-level runtime env for nested submissions)
-            self.core.job_id = spec.job_id
-            self.core.adopt_job_runtime_env(spec.job_id)
+            core.job_id = spec.job_id
+            core.adopt_job_runtime_env(spec.job_id)
         try:
-            fn = self.core.function_manager.fetch(spec.fn_key)
-            args, kwargs = self._resolve_args(spec)
-            t0 = _now()
-            with runtime_env_mod.activate(
-                    spec.runtime_env, self.core.session_dir,
-                    self.core._kv_get_sync), _exec_span(spec):
+            fn = core.function_manager.fetch(spec.fn_key)
+            args, kwargs = self._resolve_args(spec) if spec.args \
+                else ((), {})
+            profile = core.config.profiling_enabled
+            t0 = _now() if profile else 0.0
+            env_cm = runtime_env_mod.activate(
+                spec.runtime_env, core.session_dir,
+                core._kv_get_sync) if spec.runtime_env else _NULL_SPAN
+            with env_cm, _exec_span(spec):
                 result = fn(*args, **kwargs)
-            self.core.add_task_event({
-                "event": "task:execute", "name": spec.name,
-                "task_id": spec.task_id.hex(), "start": t0, "end": _now(),
-                "worker_id": self.core.worker_id.hex()})
+            if profile:
+                core.add_exec_event(spec.name, spec.task_id, t0, _now())
             return self._build_reply(spec, result)
         except Exception as e:  # noqa: BLE001
             logger.info("task %s failed:\n%s", spec.name, traceback.format_exc())
             return self._error_reply(spec, format_task_error(spec.name, e))
         finally:
             _task_ctx.task_id = b""
-            self.core._current_task_id = b""
+            core._current_task_id = b""
 
     def _resolve_args(self, spec: TaskSpec) -> Tuple[list, dict]:
         args: List[Any] = []
@@ -442,8 +467,26 @@ class TaskExecutor:
 
     def _build_reply(self, spec: TaskSpec, result: Any):
         if spec.num_returns == 0:
-            return {"status": "ok", "task_id": spec.task_id, "returns": []}, []
+            return [REPLY_OK, ()], []
         if spec.num_returns == 1:
+            if type(result) is bytes and \
+                    len(result) <= self.core.config.max_direct_call_object_size:
+                # Fastest path: a raw-bytes return inlines with no
+                # serializer object at all.
+                return [REPLY_OK, [
+                    [return_object_id_bytes(spec.task_id, 1), 0, META_RAW,
+                     0, 1, ()],
+                ]], [result]
+            # Hot path: one return value, usually small enough to inline.
+            serialized = self.core.serialization_context.serialize(result)
+            if serialized.total_bytes() <= \
+                    self.core.config.max_direct_call_object_size:
+                meta, frames = serialized.to_wire()
+                return [REPLY_OK, [
+                    [return_object_id_bytes(spec.task_id, 1), 0, meta, 0,
+                     len(frames),
+                     [r.binary() for r in serialized.contained_refs]],
+                ]], frames
             results = [result]
         else:
             results = list(result) if result is not None else []
@@ -454,55 +497,59 @@ class TaskExecutor:
                         f"produced {len(results)}")))
         returns = []
         frames_out: List[bytes] = []
-        task_id = TaskID(spec.task_id)
         for i, value in enumerate(results):
-            oid = task_id.object_id(i + 1)
+            oid_b = return_object_id_bytes(spec.task_id, i + 1)
             serialized = self.core.serialization_context.serialize(value)
+            contained = [r.binary() for r in serialized.contained_refs]
             if serialized.total_bytes() <= \
                     self.core.config.max_direct_call_object_size:
                 meta, frames = serialized.to_wire()
                 start = len(frames_out)
                 frames_out.extend(frames)
-                returns.append({
-                    "object_id": oid.binary(), "in_plasma": False,
-                    "metadata": meta, "frame_start": start,
-                    "num_frames": len(frames),
-                    "contained": [r.binary() for r in serialized.contained_refs]})
+                returns.append([oid_b, 0, meta, start, len(frames), contained])
             else:
                 segment, size = write_segment(serialized)
                 reply, _ = self.core._run(self.core.raylet_conn.call(
-                    "SealObject", {"object_id": oid.binary(),
+                    "SealObject", {"object_id": oid_b,
                                    "segment": segment, "size": size,
                                    "pin": True}))
                 if not reply.get("ok"):
                     return self._error_reply(spec, exc.ObjectStoreFullError(
                         f"return {i} of {spec.name} ({size}B) doesn't fit"))
-                returns.append({
-                    "object_id": oid.binary(), "in_plasma": True,
-                    "node_id": reply["node_id"], "size": size,
-                    "contained": [r.binary() for r in serialized.contained_refs]})
-        return {"status": "ok", "task_id": spec.task_id,
-                "returns": returns}, frames_out
+                returns.append([oid_b, 1, reply["node_id"], size, 0, contained])
+        return [REPLY_OK, returns], frames_out
 
     def _error_reply(self, spec: TaskSpec, error: BaseException):
         serialized = self.core.serialization_context.serialize_error(error)
         returns = []
         frames_out: List[bytes] = []
-        task_id = TaskID(spec.task_id)
         meta, frames = serialized.to_wire()
         for i in range(max(spec.num_returns, 1)):
             start = len(frames_out)
             frames_out.extend(frames)
-            returns.append({"object_id": task_id.object_id(i + 1).binary(),
-                            "in_plasma": False, "metadata": meta,
-                            "frame_start": start, "num_frames": len(frames),
-                            "contained": []})
-        return {"status": "error", "task_id": spec.task_id,
-                "returns": returns}, frames_out
+            returns.append([return_object_id_bytes(spec.task_id, i + 1), 0,
+                            meta, start, len(frames), ()])
+        return [REPLY_ERROR, returns], frames_out
 
     async def handle_cancel_task(self, conn, header, bufs):
         self._cancelled.add(header["task_id"])
         return {"ok": True}
+
+    async def handle_dump_stack(self, conn, header, bufs):
+        """All-thread stack dump for ``ray_tpu stack`` (reference:
+        scripts.py:1393 `ray stack` py-spy attach — here the worker
+        self-reports over its RPC channel, no ptrace needed)."""
+        import sys as _sys
+
+        frames = _sys._current_frames()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        parts = []
+        for ident, frame in frames.items():
+            parts.append(f"--- thread {names.get(ident, '?')} ({ident}) ---")
+            parts.append("".join(traceback.format_stack(frame)))
+        return {"pid": os.getpid(),
+                "actor_id": self._actor_id,
+                "stacks": "\n".join(parts)}
 
     async def handle_exit(self, conn, header, bufs):
         loop = asyncio.get_running_loop()

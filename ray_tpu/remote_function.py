@@ -39,6 +39,9 @@ class RemoteFunction:
         self._fn_key: Optional[str] = None
         self._pickled: Optional[bytes] = None
         self._demand: Optional[Dict[str, float]] = None
+        # (core, job_id, prototype TaskSpec) — see CoreWorker
+        # .make_task_template; invalidated on reconnect / job adoption
+        self._template = None
         functools.update_wrapper(self, fn)
 
     def __call__(self, *args, **kwargs):
@@ -60,26 +63,58 @@ class RemoteFunction:
 
     def remote(self, *args, **kwargs):
         w = worker_mod._require_connected()
+        core = w.core
         if self._fn_key is None:
             self._fn_key, self._pickled = \
-                w.core.function_manager.prepare(self._function)
-        w.core.function_manager.export_prepickled(
+                core.function_manager.prepare(self._function)
+        core.function_manager.export_prepickled(
             self._fn_key, self._pickled, self._function)
-        call_args = list(args)
+        if not hasattr(core, "make_task_template"):
+            # ray:// client core: no template fast path — submit per call
+            call_args = list(args)
+            if kwargs:
+                call_args.append({"__rtpu_kwargs__": True, "kwargs": kwargs})
+            pg = self._placement_group
+            refs = core.submit_task(
+                fn_key=self._fn_key, name=self._name, args=call_args,
+                num_returns=self._num_returns,
+                resources=self._resource_demand(),
+                max_retries=self._max_retries,
+                retry_exceptions=self._retry_exceptions,
+                placement_group_id=pg.id.binary() if pg is not None else b"",
+                placement_group_bundle_index=self._placement_group_bundle_index,
+                scheduling_strategy=self._scheduling_strategy,
+                runtime_env=self._runtime_env)
+            if self._num_returns == 0:
+                return None
+            return refs[0] if self._num_returns == 1 else refs
+        tmpl = self._template
+        if tmpl is not None and self._runtime_env:
+            # working_dir / local-wheel envs re-resolve per call: the
+            # content hash must track edits made between submissions
+            # (prepare_runtime_env's _dir_signature cache makes the
+            # unchanged case cheap). Envs without local content resolve
+            # to themselves, so this never rebuilds for plain env_vars.
+            if core._resolve_runtime_env(self._runtime_env) != \
+                    tmpl[2].runtime_env:
+                tmpl = None
+        if tmpl is None or tmpl[0] is not core or tmpl[1] != core.job_id:
+            pg = self._placement_group
+            proto = core.make_task_template(
+                fn_key=self._fn_key, name=self._name,
+                num_returns=self._num_returns,
+                resources=self._resource_demand(),
+                max_retries=self._max_retries,
+                retry_exceptions=self._retry_exceptions,
+                placement_group_id=pg.id.binary() if pg is not None else b"",
+                placement_group_bundle_index=self._placement_group_bundle_index,
+                scheduling_strategy=self._scheduling_strategy,
+                runtime_env=self._runtime_env)
+            tmpl = self._template = (core, core.job_id, proto)
         if kwargs:
-            call_args.append({"__rtpu_kwargs__": True, "kwargs": kwargs})
-        pg = self._placement_group
-        pg_id = pg.id.binary() if pg is not None else b""
-        refs = w.core.submit_task(
-            fn_key=self._fn_key, name=self._name, args=call_args,
-            num_returns=self._num_returns,
-            resources=self._resource_demand(),
-            max_retries=self._max_retries,
-            retry_exceptions=self._retry_exceptions,
-            placement_group_id=pg_id,
-            placement_group_bundle_index=self._placement_group_bundle_index,
-            scheduling_strategy=self._scheduling_strategy,
-            runtime_env=self._runtime_env)
+            args = list(args) + \
+                [{"__rtpu_kwargs__": True, "kwargs": kwargs}]
+        refs = core.submit_task_from_template(tmpl[2], args)
         if self._num_returns == 0:
             return None
         if self._num_returns == 1:

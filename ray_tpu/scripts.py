@@ -146,6 +146,78 @@ def cmd_memory(args) -> None:
     ray_tpu.shutdown()
 
 
+def cmd_stack(args) -> None:
+    """All-worker stack dump per node (reference: `ray stack`,
+    scripts.py:1393 — py-spy over local worker pids; here every worker
+    self-reports all its threads over RPC, so it works cluster-wide)."""
+    ray_tpu = _connect(args)
+    from ray_tpu._private import rpc as rpc_mod
+
+    nodes = [n for n in ray_tpu.nodes() if n.get("Alive")]
+
+    async def _dump(address):
+        conn = await rpc_mod.connect(address, peer_name="stack-cli")
+        try:
+            reply, _ = await conn.call("DumpWorkerStacks", {}, timeout=15.0)
+            return reply
+        finally:
+            await conn.close()
+
+    core = ray_tpu.worker.global_worker.core
+    for n in nodes:
+        print(f"===== node {n['NodeID'][:12]} {n['Address']} =====")
+        try:
+            reply = core._run(_dump(n["Address"]))
+        except Exception as e:  # noqa: BLE001
+            print(f"  unreachable: {e}")
+            continue
+        for w in reply.get("workers", []):
+            print(f"--- worker pid {w.get('pid')} "
+                  f"{w.get('worker_id', '')[:12]} ---")
+            print(w.get("stacks") or w.get("error", ""))
+    ray_tpu.shutdown()
+
+
+def cmd_logs(args) -> None:
+    """List or tail a node's session log files over the raylet RPC."""
+    ray_tpu = _connect(args)
+    from ray_tpu._private import rpc as rpc_mod
+
+    nodes = [n for n in ray_tpu.nodes() if n.get("Alive")]
+    node = nodes[0] if nodes else None
+    for n in nodes:
+        if args.node and n["NodeID"].startswith(args.node):
+            node = n
+            break
+    if node is None:
+        print("no alive nodes")
+        ray_tpu.shutdown()
+        return
+
+    async def _logs(address):
+        conn = await rpc_mod.connect(address, peer_name="logs-cli")
+        try:
+            reply, _ = await conn.call(
+                "GetLogs", {"name": args.name, "tail": args.tail},
+                timeout=10.0)
+            return reply
+        finally:
+            await conn.close()
+
+    core = ray_tpu.worker.global_worker.core
+    reply = core._run(_logs(node["Address"]))
+    if "files" in reply and not args.name:
+        for f in reply["files"]:
+            print(f"{f.get('size', 0):>10}  {f['name']}")
+    elif "lines" in reply:
+        print(f"==> {reply['name']} <==")
+        for line in reply["lines"]:
+            print(line)
+    else:
+        print(reply.get("error", reply))
+    ray_tpu.shutdown()
+
+
 def cmd_timeline(args) -> None:
     ray_tpu = _connect(args)
     events = ray_tpu.timeline()
@@ -220,11 +292,18 @@ def main(argv=None) -> None:
     p.set_defaults(fn=cmd_stop)
 
     for name, fn in [("status", cmd_status), ("memory", cmd_memory),
-                     ("timeline", cmd_timeline)]:
+                     ("timeline", cmd_timeline), ("stack", cmd_stack),
+                     ("logs", cmd_logs)]:
         p = sub.add_parser(name)
         p.add_argument("--address", default="")
         if name == "timeline":
             p.add_argument("--output", default="")
+        if name == "logs":
+            p.add_argument("--node", default="",
+                           help="node id hex prefix (default: first node)")
+            p.add_argument("--name", default="",
+                           help="log file substring; empty lists files")
+            p.add_argument("--tail", type=int, default=200)
         p.set_defaults(fn=fn)
 
     p = sub.add_parser("microbenchmark",

@@ -108,6 +108,11 @@ class ClientCore:
         self.node_id = b"\xff" * 28
         self._shutdown = False
 
+    def queue_local_decref(self, object_id: ObjectID) -> None:
+        # ObjectRef.__del__ protocol (see core_worker.queue_local_decref);
+        # the client releases synchronously — no loop to batch onto.
+        self.reference_counter.remove_local_reference(object_id)
+
     # ------------------------------------------------------------- rpc
 
     def _call(self, method: str, header: dict, bufs=()):

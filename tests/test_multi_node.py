@@ -230,3 +230,59 @@ def test_working_dir_ships_across_nodes(tmp_path, cluster2):
     ref = probe.remote()
     shutil.rmtree(wd)
     assert ray_tpu.get(ref, timeout=60) == "crossed-nodes"
+
+
+def _build_test_wheel(tmp_path) -> str:
+    """Handcraft a minimal valid wheel for a package that exists nowhere
+    else (no index access needed — pip installs local wheels offline)."""
+    import zipfile
+
+    name, ver = "rtpu_testpkg", "0.1"
+    whl = tmp_path / f"{name}-{ver}-py3-none-any.whl"
+    di = f"{name}-{ver}.dist-info"
+    files = {
+        f"{name}/__init__.py":
+            "import random\nTOKEN = random.random()\n"
+            "WHO = 'pip-crossed-nodes'\n",
+        f"{di}/METADATA":
+            "Metadata-Version: 2.1\nName: rtpu-testpkg\nVersion: 0.1\n",
+        f"{di}/WHEEL":
+            "Wheel-Version: 1.0\nGenerator: rtpu-test\n"
+            "Root-Is-Purelib: true\nTag: py3-none-any\n",
+    }
+    record = "".join(f"{p},,\n" for p in files) + f"{di}/RECORD,,\n"
+    files[f"{di}/RECORD"] = record
+    with zipfile.ZipFile(whl, "w") as zf:
+        for p, c in files.items():
+            zf.writestr(p, c)
+    return str(whl)
+
+
+def test_pip_env_ships_across_nodes_with_warm_reuse(tmp_path, cluster2):
+    """runtime_env={'pip': [...]} on a task pinned to the OTHER node:
+    the wheel travels through the cluster KV (kvwhl: rewrite), the
+    worker materializes the env once per node (pip install --target
+    keyed by env hash), and a second task with the same env lands on
+    the SAME warm worker without re-importing the package (reference:
+    _private/runtime_env/conda.py per-env materialization +
+    worker_pool.h:135 env-hash worker reuse)."""
+    import os
+
+    whl = _build_test_wheel(tmp_path)
+
+    @ray_tpu.remote(resources={"spot": 1}, runtime_env={"pip": [whl]})
+    def probe():
+        import rtpu_testpkg
+        return (os.getpid(), rtpu_testpkg.WHO, rtpu_testpkg.TOKEN,
+                rtpu_testpkg.__file__)
+
+    ref = probe.remote()
+    os.unlink(whl)  # only the KV copy can serve the install now
+    pid1, who, tok1, mod_path = ray_tpu.get(ref, timeout=120)
+    assert who == "pip-crossed-nodes"
+    assert os.sep + "pip" + os.sep in mod_path and \
+        "runtime_resources" in mod_path
+    pid2, _, tok2, _ = ray_tpu.get(probe.remote(), timeout=60)
+    assert pid2 == pid1, "env-hash matching must reuse the warm worker"
+    assert tok2 == tok1, \
+        "parked module must be restored, not re-imported, on reuse"

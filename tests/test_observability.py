@@ -179,6 +179,51 @@ def test_dashboard_json_api(obs_cluster):
         assert e.code == 404
 
 
+def test_status_page_logs_and_stack_dump(obs_cluster):
+    """The human-facing floor (reference: dashboard/head.py page +
+    dashboard log module + `ray stack` scripts.py:1393): the head
+    serves an HTML status page over the /api/ routes, /api/logs tails
+    a node's session logs, and /api/stacks returns every worker's
+    thread stacks — including the frame of a task running right now."""
+    import json
+    import threading
+
+    addr = state.metrics_address()
+
+    def fetch(route):
+        with urllib.request.urlopen(f"http://{addr}{route}",
+                                    timeout=20) as resp:
+            assert resp.status == 200
+            return resp.read()
+
+    page = fetch("/").decode()
+    assert "<html" in page and "ray_tpu" in page
+    for route in ("/api/cluster", "/api/nodes", "/api/actors"):
+        assert route in page  # the page drives the JSON API
+
+    # ---- a recognizably-named task, parked mid-execution ----
+    @ray_tpu.remote
+    def snoozing_probe_task():
+        time.sleep(8)
+        return 1
+
+    ref = snoozing_probe_task.remote()
+    time.sleep(1.5)  # let it reach the worker and block in sleep
+
+    stacks = json.loads(fetch("/api/stacks"))
+    assert stacks.get("workers"), stacks
+    combined = "\n".join(w.get("stacks", "") for w in stacks["workers"])
+    assert "snoozing_probe_task" in combined, combined[-2000:]
+    assert ray_tpu.get(ref, timeout=30) == 1
+
+    # ---- logs: list then tail a worker log ----
+    listing = json.loads(fetch("/api/logs"))
+    names = [f["name"] for f in listing.get("files", [])]
+    assert any("worker" in n for n in names), names
+    tail = json.loads(fetch("/api/logs?name=worker&tail=50"))
+    assert tail.get("lines") is not None and tail.get("name"), tail
+
+
 def test_task_tracing_span_propagation():
     """Span context rides task submission driver -> task -> nested task
     (reference: util/tracing/tracing_helper.py — context injected into
