@@ -5,11 +5,15 @@ Trainer agents/trainer.py:513, RolloutWorker
 evaluation/rollout_worker.py:105, WorkerSet evaluation/worker_set.py,
 Policy policy/policy.py). Scope: the architecture (vector envs →
 rollout-worker actors → WorkerSet → jitted learner → Tune-compatible
-Trainer) with two algorithm families proving it generalizes: PPO
-(on-policy, fused device rollouts) and DQN (value-based, replay-buffer
-actor + offline IO, reference: rllib/agents/dqn +
-rllib/execution/replay_buffer.py + rllib/offline/).
+Trainer) with the execution-plan dataflow layer (execution.py,
+reference: rllib/execution/* ops + trainer_template.py) and three
+algorithm shapes proving it generalizes: PPO (sync on-policy), DQN
+(replay off-policy + offline IO, reference: rllib/agents/dqn +
+rllib/execution/replay_buffer.py + rllib/offline/), and IMPALA-lite
+(async on-policy with importance weighting).
 """
+
+from ray_tpu.rllib import execution  # noqa: F401
 
 from ray_tpu.rllib.env import ENV_REGISTRY, CartPole, VectorEnv  # noqa: F401
 from ray_tpu.rllib.policy import (  # noqa: F401
@@ -19,6 +23,8 @@ from ray_tpu.rllib.policy import (  # noqa: F401
     sample_actions,
 )
 from ray_tpu.rllib.dqn import DQNTrainer  # noqa: F401
+from ray_tpu.rllib.execution import Trainer, build_trainer  # noqa: F401
+from ray_tpu.rllib.impala import ImpalaTrainer  # noqa: F401
 from ray_tpu.rllib.offline import JsonReader, JsonWriter  # noqa: F401
 from ray_tpu.rllib.ppo import DEFAULT_CONFIG, PPOTrainer  # noqa: F401
 from ray_tpu.rllib.replay_buffer import ReplayBuffer  # noqa: F401

@@ -17,14 +17,14 @@ JsonWriter/JsonReader offline IO, and the Tune trainable contract.
 from __future__ import annotations
 
 import functools
-import pickle
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 import ray_tpu
+from ray_tpu.rllib import execution
 from ray_tpu.rllib.env import make_env
 from ray_tpu.rllib.offline import JsonReader, JsonWriter
 from ray_tpu.rllib.replay_buffer import ReplayBuffer
@@ -109,14 +109,17 @@ def _dqn_update(params, target_params, opt_state, batches, *,
     return params, opt_state, jnp.mean(losses)
 
 
-class DQNTrainer:
-    """Also a Tune trainable: train()/save()/restore()."""
+class DQNTrainer(execution.Trainer):
+    """Replay off-policy shape of the execution-plan substrate
+    (reference: dqn.py's plan = Concurrently([rollouts -> store,
+    replay -> train -> target-update]) per trainer_template.py). Also a
+    Tune trainable via the template."""
 
-    def __init__(self, config: Optional[Dict[str, Any]] = None):
+    default_config = DEFAULT_CONFIG
+
+    def setup(self, cfg: Dict[str, Any]) -> None:
         import optax
 
-        self.config = {**DEFAULT_CONFIG, **(config or {})}
-        cfg = self.config
         probe = make_env(cfg["env"], 1)
         self.params = init_q_params(
             jax.random.key(cfg["seed"]), probe.observation_size,
@@ -129,11 +132,14 @@ class DQNTrainer:
         # LocalReplayBuffer actor, rllib/execution/replay_buffer.py:302).
         self.buffer = ray_tpu.remote(ReplayBuffer).options(
             num_cpus=0).remote(cfg["buffer_size"], seed=cfg["seed"])
+        self._counters = {"timesteps_total": 0, "buffer_size": 0,
+                          "epsilon": cfg["epsilon_initial"]}
         if self._offline:
             batch = JsonReader(cfg["input"]).read_all()
             if batch is None:
                 raise ValueError(f"no offline data in {cfg['input']!r}")
-            ray_tpu.get(self.buffer.add.remote(batch))
+            self._counters["buffer_size"] = int(
+                ray_tpu.get(self.buffer.add.remote(batch)))
             self.workers = []
         else:
             cls = ray_tpu.remote(TransitionWorker)
@@ -142,8 +148,6 @@ class DQNTrainer:
                            cfg["rollout_len"], q_values, seed=i + 1)
                 for i in range(cfg["num_workers"])]
         self._writer = JsonWriter(cfg["output"]) if cfg["output"] else None
-        self._iteration = 0
-        self._timesteps = 0
 
     def _epsilon(self) -> float:
         cfg = self.config
@@ -151,75 +155,66 @@ class DQNTrainer:
         return cfg["epsilon_initial"] + frac * (
             cfg["epsilon_final"] - cfg["epsilon_initial"])
 
-    def train(self) -> Dict[str, Any]:
+    def execution_plan(self):
         cfg = self.config
-        eps = self._epsilon()
-        if not self._offline:
-            ray_tpu.get([w.set_weights.remote(self.params)
-                         for w in self.workers])
-            batches = ray_tpu.get(
-                [w.sample.remote(eps) for w in self.workers])
-            for b in batches:
-                self._timesteps += len(b["obs"])
-                if self._writer is not None:
-                    self._writer.write(b)
-            adds = [self.buffer.add.remote(b) for b in batches]
-            buffer_size = ray_tpu.get(adds)[-1]
-        else:
-            buffer_size = ray_tpu.get(self.buffer.size.remote())
+        replay = execution.Replay(
+            self.buffer, train_batch_size=cfg["train_batch_size"],
+            num_steps=cfg["num_sgd_steps"],
+            learning_starts=cfg["learning_starts"],
+            size_fn=lambda: self._counters["buffer_size"])
+        learn = execution.TrainOneStep(replay, self._learn_on_batches)
+        learn = execution.UpdateTargetNetwork(
+            learn, self._update_target, cfg["target_update_freq"])
+        if self._offline:
+            return execution.StandardMetricsReporting(
+                learn, [], self._counters)
 
-        loss = float("nan")
-        if buffer_size >= cfg["learning_starts"]:
-            k = cfg["num_sgd_steps"]
-            minibatches = ray_tpu.get(
-                [self.buffer.sample.remote(cfg["train_batch_size"])
-                 for _ in range(k)])
-            stacked = {key: jnp.stack([m[key] for m in minibatches])
-                       for key in minibatches[0]}
-            self.params, self._opt_state, loss = _dqn_update(
-                self.params, self.target_params, self._opt_state,
-                stacked, gamma=cfg["gamma"], double_q=cfg["double_q"],
-                lr=cfg["lr"])
-            loss = float(loss)
-        self._iteration += 1
-        if self._iteration % cfg["target_update_freq"] == 0:
-            self.target_params = self.params
+        rollouts = execution.ParallelRollouts(
+            self.workers, mode="bulk_sync",
+            weights=lambda: self.params,
+            sample_args=lambda: (self._epsilon(),))
+        store = execution.ForEach(rollouts, self._ingest)
+        plan = execution.Concurrently([store, learn], output=1)
+        return execution.StandardMetricsReporting(
+            plan, self.workers, self._counters)
 
-        returns: list = []
-        if not self._offline:
-            for rs in ray_tpu.get([w.episode_returns.remote()
-                                   for w in self.workers]):
-                returns.extend(rs)
-        return {
-            "training_iteration": self._iteration,
-            "timesteps_total": self._timesteps,
-            "buffer_size": int(buffer_size),
-            "epsilon": eps,
-            "episode_reward_mean":
-                float(np.mean(returns)) if returns else float("nan"),
-            "episodes_this_iter": len(returns),
-            "loss": loss,
-        }
+    def _ingest(self, batch):
+        """Count, tee to offline output, and store SYNCHRONOUSLY so the
+        replay op (advanced next in the same Concurrently round) sees
+        this round's transitions, like the reference's local-mode
+        store-then-replay ordering."""
+        self._counters["timesteps_total"] += len(batch["obs"])
+        self._counters["epsilon"] = self._epsilon()
+        if self._writer is not None:
+            self._writer.write(batch)
+        self._counters["buffer_size"] = int(
+            ray_tpu.get(self.buffer.add.remote(batch)))
+        return batch
 
-    # ---- Tune trainable contract ----
+    def _learn_on_batches(self, stacked) -> Dict[str, Any]:
+        if stacked is None:
+            return {"loss": float("nan")}
+        cfg = self.config
+        self.params, self._opt_state, loss = _dqn_update(
+            self.params, self.target_params, self._opt_state,
+            stacked, gamma=cfg["gamma"], double_q=cfg["double_q"],
+            lr=cfg["lr"])
+        return {"loss": float(loss)}
 
-    def save(self, path: str) -> str:
-        with open(path, "wb") as f:
-            pickle.dump({"params": self.params,
-                         "target_params": self.target_params,
-                         "opt_state": self._opt_state,
-                         "iteration": self._iteration,
-                         "timesteps": self._timesteps}, f)
-        return path
+    def _update_target(self) -> None:
+        self.target_params = self.params
 
-    def restore(self, path: str) -> None:
-        with open(path, "rb") as f:
-            state = pickle.load(f)
+    def get_state(self) -> dict:
+        return {"params": self.params,
+                "target_params": self.target_params,
+                "opt_state": self._opt_state,
+                "timesteps": self._counters["timesteps_total"]}
+
+    def set_state(self, state: dict) -> None:
         self.params = state["params"]
         self.target_params = state["target_params"]
         self._opt_state = state["opt_state"]
-        self._iteration = state["iteration"]
-        self._timesteps = state["timesteps"]
+        self._counters["timesteps_total"] = state["timesteps"]
 
     def stop(self) -> None:
         if self._writer is not None:

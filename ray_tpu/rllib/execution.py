@@ -1,0 +1,263 @@
+"""Execution-plan dataflow: RL training loops as composed iterators.
+
+Parity target: the reference's execution ops
+(reference: rllib/execution/rollout_ops.py ParallelRollouts /
+ConcatBatches, replay_ops StoreToReplayBuffer / Replay,
+train_ops.py TrainOneStep / UpdateTargetNetwork,
+concurrency_ops.py Concurrently, metric_ops StandardMetricsReporting)
+powering 20+ algorithms through the trainer template
+(reference: rllib/agents/trainer_template.py:53 build_trainer).
+
+TPU-first re-design: ops are plain Python generators over the task/
+actor runtime — no LocalIterator class hierarchy. Sampling fans out as
+actor calls (``ray_tpu.wait`` drives the async mode), while the
+learner stays ONE jitted device program per train step (the lax.scan
+update fns in ppo.py / dqn.py), so composing ops never fragments the
+device work. An algorithm is: an ``execution_plan`` generator wiring
+these ops + a jitted update — see PPOTrainer / DQNTrainer /
+ImpalaTrainer for the three shapes (sync on-policy, replay off-policy,
+async on-policy).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+
+def concat_batches(batches: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+    """Row-concatenate sample batches (reference: SampleBatch.concat_samples)."""
+    if len(batches) == 1:
+        return batches[0]
+    return {k: np.concatenate([np.asarray(b[k]) for b in batches])
+            for k in batches[0]}
+
+
+def ParallelRollouts(workers: List[Any], *, mode: str = "bulk_sync",
+                     sample_args: Callable[[], tuple] = tuple,
+                     weights: Callable[[], Any] | None = None
+                     ) -> Iterator[Dict[str, np.ndarray]]:
+    """Stream sample batches from rollout-worker actors
+    (reference: rollout_ops.py ParallelRollouts).
+
+    ``bulk_sync``: broadcast current weights, gather one batch from
+    every worker, yield their concatenation — the on-policy shape.
+    ``async``: keep one sample call in flight per worker and yield
+    batches as they land (weights broadcast before each resubmission,
+    so a batch may be one policy version stale — the IMPALA shape).
+    ``weights()`` supplies the current parameters each round.
+    """
+    if mode == "bulk_sync":
+        while True:
+            if weights is not None:
+                w = weights()
+                ray_tpu.get([a.set_weights.remote(w) for a in workers])
+            batches = ray_tpu.get(
+                [a.sample.remote(*sample_args()) for a in workers])
+            yield concat_batches(batches)
+    elif mode == "async":
+        inflight = {}
+        for a in workers:
+            if weights is not None:
+                a.set_weights.remote(weights())
+            inflight[a.sample.remote(*sample_args())] = a
+        while True:
+            done, _ = ray_tpu.wait(list(inflight), num_returns=1)
+            ref = done[0]
+            actor = inflight.pop(ref)
+            batch = ray_tpu.get(ref)
+            if weights is not None:
+                actor.set_weights.remote(weights())
+            inflight[actor.sample.remote(*sample_args())] = actor
+            yield batch
+    else:
+        raise ValueError(f"unknown rollout mode {mode!r}")
+
+
+def ConcatBatches(it: Iterable, min_rows: int) -> Iterator:
+    """Buffer upstream batches until at least ``min_rows`` rows, then
+    yield one concatenated batch (reference: rollout_ops ConcatBatches)."""
+    buf: List[dict] = []
+    rows = 0
+    for batch in it:
+        buf.append(batch)
+        rows += len(next(iter(batch.values())))
+        if rows >= min_rows:
+            yield concat_batches(buf)
+            buf, rows = [], 0
+
+
+def ForEach(it: Iterable, fn: Callable[[Any], Any]) -> Iterator:
+    """Map an op over the stream (reference: LocalIterator.for_each)."""
+    for item in it:
+        yield fn(item)
+
+
+def StoreToReplayBuffer(it: Iterable, buffer: Any) -> Iterator:
+    """Tee batches into a replay-buffer actor, passing them through
+    (reference: replay_ops.py StoreToReplayBuffer)."""
+    for batch in it:
+        buffer.add.remote(batch)
+        yield batch
+
+
+def Replay(buffer: Any, *, train_batch_size: int, num_steps: int,
+           learning_starts: int = 0,
+           size_fn: Callable[[], int] | None = None
+           ) -> Iterator[Optional[dict]]:
+    """Sample ``num_steps`` minibatches per round from the replay actor,
+    yielding them stacked [K, batch, ...] for a single lax.scan update
+    — or None while the buffer is warming up (reference:
+    replay_ops.py Replay; the stacking keeps the learner one compiled
+    program instead of K host round trips). ``size_fn`` supplies a
+    locally-known buffer size (e.g. the return of the same round's
+    add()) to skip the per-round size RPC."""
+    import jax.numpy as jnp
+
+    while True:
+        size = size_fn() if size_fn is not None \
+            else ray_tpu.get(buffer.size.remote())
+        if size < max(learning_starts, 1):
+            yield None
+            continue
+        minibatches = ray_tpu.get(
+            [buffer.sample.remote(train_batch_size)
+             for _ in range(num_steps)])
+        yield {k: jnp.stack([m[k] for m in minibatches])
+               for k in minibatches[0]}
+
+
+def TrainOneStep(it: Iterable, train_fn: Callable[[Any], dict]) -> Iterator:
+    """Apply the jitted learner update to each upstream item
+    (reference: train_ops.py TrainOneStep — minus the GPU-loader
+    machinery: on TPU the update IS one device program)."""
+    for item in it:
+        yield train_fn(item)
+
+
+def UpdateTargetNetwork(it: Iterable, update_fn: Callable[[], None],
+                        every: int) -> Iterator:
+    """Invoke ``update_fn`` every N upstream items (reference:
+    train_ops.py UpdateTargetNetwork). The update runs BEFORE the
+    boundary item is yielded, so it lands inside the same train()
+    iteration (a checkpoint taken right after the Nth iteration holds
+    the freshly-synced target)."""
+    count = 0
+    for item in it:
+        count += 1
+        if count % every == 0:
+            update_fn()
+        yield item
+
+
+def Concurrently(iters: List[Iterable], *, output: int = -1) -> Iterator:
+    """Round-robin several sub-plans, yielding the designated one's
+    items (reference: concurrency_ops.py Concurrently round_robin).
+    Each round advances every sub-plan once; the ``output`` plan's
+    item is yielded (default: the last, conventionally the learner)."""
+    its = [iter(i) for i in iters]
+    if output < 0:
+        output = len(its) + output
+    while True:
+        out = None
+        for i, it in enumerate(its):
+            item = next(it)
+            if i == output:
+                out = item
+        yield out
+
+
+def StandardMetricsReporting(it: Iterable, workers: List[Any],
+                             counters: Dict[str, Any]) -> Iterator[dict]:
+    """Fold rollout-worker episode stats into each learner result
+    (reference: metric_ops.py StandardMetricsReporting /
+    CollectMetrics)."""
+    for result in it:
+        returns: List[float] = []
+        if workers:
+            for rs in ray_tpu.get(
+                    [w.episode_returns.remote() for w in workers]):
+                returns.extend(rs)
+        out = dict(result or {})
+        out.update(counters)
+        out["episode_reward_mean"] = \
+            float(np.mean(returns)) if returns else float("nan")
+        out["episodes_this_iter"] = len(returns)
+        yield out
+
+
+class Trainer:
+    """Trainer template (reference: trainer_template.py:53
+    build_trainer): an algorithm provides ``default_config``,
+    ``setup(config)`` (build params/workers/buffers), an
+    ``execution_plan()`` generator of result dicts, and
+    ``get_state``/``set_state`` for checkpointing. The template owns
+    train() bookkeeping and the Tune trainable contract."""
+
+    default_config: Dict[str, Any] = {}
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        self.config = {**self.default_config, **(config or {})}
+        self._iteration = 0
+        self.setup(self.config)
+        self._plan = self.execution_plan()
+
+    # -- algorithm hooks ----------------------------------------------------
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def execution_plan(self) -> Iterator[dict]:
+        raise NotImplementedError
+
+    def get_state(self) -> dict:
+        raise NotImplementedError
+
+    def set_state(self, state: dict) -> None:
+        raise NotImplementedError
+
+    # -- template -----------------------------------------------------------
+
+    def train(self) -> Dict[str, Any]:
+        result = next(self._plan)
+        self._iteration += 1
+        result["training_iteration"] = self._iteration
+        return result
+
+    def save(self, path: str) -> str:
+        import pickle
+
+        with open(path, "wb") as f:
+            pickle.dump({"state": self.get_state(),
+                         "iteration": self._iteration}, f)
+        return path
+
+    def restore(self, path: str) -> None:
+        import pickle
+
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        self.set_state(blob["state"])
+        self._iteration = blob["iteration"]
+
+    def stop(self) -> None:
+        pass
+
+
+def build_trainer(*, name: str, default_config: Dict[str, Any],
+                  setup: Callable, execution_plan: Callable,
+                  get_state: Callable, set_state: Callable) -> type:
+    """Functional trainer construction (reference:
+    trainer_template.py:53): algorithm #N is a config + four callables,
+    not a hand-wired class."""
+    cls = type(name, (Trainer,), {
+        "default_config": default_config,
+        "setup": setup,
+        "execution_plan": execution_plan,
+        "get_state": get_state,
+        "set_state": set_state,
+    })
+    return cls

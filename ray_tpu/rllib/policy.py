@@ -73,6 +73,27 @@ def ppo_loss(params, batch, *, clip=0.2, vf_coeff=0.5, ent_coeff=0.01):
     return total, {"policy_loss": pg, "vf_loss": vf, "entropy": entropy}
 
 
+@functools.partial(jax.jit, static_argnames=("rho_clip", "vf_coeff",
+                                             "ent_coeff"))
+def impala_loss(params, batch, *, rho_clip=1.0, vf_coeff=0.5,
+                ent_coeff=0.01):
+    """Off-policy actor-critic with clipped importance weights — the
+    V-trace-lite objective for async (stale-policy) batches (standard
+    public IMPALA formulation, truncated-rho policy gradient; the
+    value targets reuse the workers' GAE returns)."""
+    logits, value = logits_and_value(params, batch["obs"])
+    logp_all = jax.nn.log_softmax(logits)
+    logp = logp_all[jnp.arange(logits.shape[0]), batch["actions"]]
+    rho = jnp.minimum(jnp.exp(logp - batch["logp_old"]), rho_clip)
+    adv = batch["advantages"]
+    adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+    pg = -(jax.lax.stop_gradient(rho) * adv * logp).mean()
+    vf = jnp.mean((value - batch["returns"]) ** 2)
+    entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1).mean()
+    total = pg + vf_coeff * vf - ent_coeff * entropy
+    return total, {"policy_loss": pg, "vf_loss": vf, "entropy": entropy}
+
+
 def compute_gae(rewards, values, dones, last_value, *, gamma=0.99,
                 lam=0.95):
     """Generalized advantage estimation over a [T, B] rollout (numpy —

@@ -14,13 +14,12 @@ Trainer-is-a-Trainable layering.
 from __future__ import annotations
 
 import functools
-import pickle
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
+from ray_tpu.rllib import execution
 from ray_tpu.rllib.env import make_env
 from ray_tpu.rllib.policy import init_policy_params, ppo_loss
 from ray_tpu.rllib.rollout_worker import WorkerSet
@@ -82,14 +81,17 @@ def _ppo_update(params, opt_state, batch, key, *, num_epochs,
     return params, opt_state, jnp.mean(losses), jnp.mean(entropies)
 
 
-class PPOTrainer:
-    """Also a Tune trainable: train()/save()/restore()."""
+class PPOTrainer(execution.Trainer):
+    """Sync on-policy shape of the execution-plan substrate
+    (reference: ppo.py's execution_plan = ParallelRollouts |>
+    TrainOneStep |> StandardMetricsReporting). Also a Tune trainable
+    via the template."""
 
-    def __init__(self, config: Optional[Dict[str, Any]] = None):
+    default_config = DEFAULT_CONFIG
+
+    def setup(self, cfg: Dict[str, Any]) -> None:
         import optax
 
-        self.config = {**DEFAULT_CONFIG, **(config or {})}
-        cfg = self.config
         probe = make_env(cfg["env"], 1)
         self.params = init_policy_params(
             jax.random.key(cfg["seed"]), probe.observation_size,
@@ -99,14 +101,24 @@ class PPOTrainer:
             cfg["env"], cfg["num_workers"], cfg["num_envs_per_worker"],
             cfg["rollout_len"], cfg["gamma"], cfg["lambda"])
         self._key = jax.random.key(cfg["seed"] + 1)
-        self._iteration = 0
-        self._timesteps = 0
+        self._counters = {"timesteps_total": 0}
 
-    def train(self) -> Dict[str, Any]:
+    def execution_plan(self):
+        rollouts = execution.ParallelRollouts(
+            self.workers.workers, mode="bulk_sync",
+            weights=lambda: self.params)
+
+        def count(batch):
+            self._counters["timesteps_total"] += len(batch["obs"])
+            return batch
+
+        it = execution.ForEach(rollouts, count)
+        it = execution.TrainOneStep(it, self._learn_on_batch)
+        return execution.StandardMetricsReporting(
+            it, self.workers.workers, self._counters)
+
+    def _learn_on_batch(self, batch) -> Dict[str, Any]:
         cfg = self.config
-        self.workers.set_weights(self.params)
-        batch = self.workers.sample()
-        self._timesteps += len(batch["obs"])
         num_minibatches = max(
             1, len(batch["obs"]) // cfg["minibatch_size"])
         self._key, sub = jax.random.split(self._key)
@@ -117,35 +129,13 @@ class PPOTrainer:
             num_minibatches=num_minibatches, clip=cfg["clip"],
             vf_coeff=cfg["vf_coeff"], ent_coeff=cfg["entropy_coeff"],
             lr=cfg["lr"])
-        self._iteration += 1
-        returns = self.workers.episode_returns()
-        return {
-            "training_iteration": self._iteration,
-            "timesteps_total": self._timesteps,
-            "episode_reward_mean":
-                float(np.mean(returns)) if returns else float("nan"),
-            "episodes_this_iter": len(returns),
-            "loss": float(loss),
-            "entropy": float(entropy),
-        }
+        return {"loss": float(loss), "entropy": float(entropy)}
 
-    # ---- Tune trainable contract ----
+    def get_state(self) -> dict:
+        return {"params": self.params, "opt_state": self._opt_state,
+                "timesteps": self._counters["timesteps_total"]}
 
-    def save(self, path: str) -> str:
-        with open(path, "wb") as f:
-            pickle.dump({"params": self.params,
-                         "opt_state": self._opt_state,
-                         "iteration": self._iteration,
-                         "timesteps": self._timesteps}, f)
-        return path
-
-    def restore(self, path: str) -> None:
-        with open(path, "rb") as f:
-            state = pickle.load(f)
+    def set_state(self, state: dict) -> None:
         self.params = state["params"]
         self._opt_state = state["opt_state"]
-        self._iteration = state["iteration"]
-        self._timesteps = state["timesteps"]
-
-    def stop(self) -> None:
-        pass
+        self._counters["timesteps_total"] = state["timesteps"]

@@ -230,9 +230,12 @@ class WorkerSet:
             for i in range(num_workers)]
 
     def sample(self) -> Dict[str, np.ndarray]:
-        batches = ray_tpu.get([w.sample.remote() for w in self.workers])
-        return {k: np.concatenate([b[k] for b in batches])
-                for k in batches[0]}
+        """One synchronous gather-and-concat round (execution plans use
+        execution.ParallelRollouts instead; this is the direct API)."""
+        from ray_tpu.rllib.execution import concat_batches
+
+        return concat_batches(
+            ray_tpu.get([w.sample.remote() for w in self.workers]))
 
     def set_weights(self, params) -> None:
         ray_tpu.get([w.set_weights.remote(params)
