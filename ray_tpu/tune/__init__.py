@@ -26,6 +26,7 @@ from ray_tpu.tune.schedulers import (  # noqa: F401
 from ray_tpu.tune.result import ExperimentAnalysis  # noqa: F401
 from ray_tpu.tune.suggest import (  # noqa: F401
     BasicVariantGenerator,
+    BOHBSearcher,
     Searcher,
     TPESearcher,
 )

@@ -147,11 +147,17 @@ class TPESearcher(Searcher):
 
     # -- the estimator --
 
+    def _model_observations(self) -> List[tuple]:
+        """The observation set the estimator fits on (hook: BOHB
+        narrows this to a single fidelity)."""
+        return self.observations
+
     def _split(self):
         """Sort observations by objective (best first) and split at the
         γ-quantile."""
         sign = -1.0 if self.mode == "max" else 1.0
-        ranked = sorted(self.observations, key=lambda o: sign * o[1])
+        ranked = sorted(self._model_observations(),
+                        key=lambda o: sign * o[1])
         n_good = max(1, int(math.ceil(self.gamma * len(ranked))))
         return ranked[:n_good], ranked[n_good:]
 
@@ -204,7 +210,8 @@ class TPESearcher(Searcher):
     # -- Searcher protocol --
 
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
-        if len(self.observations) < self.n_initial or not self.space:
+        if len(self._model_observations()) < self.n_initial \
+                or not self.space:
             cfg = self._random_config()
         else:
             good, bad = self._split()
@@ -243,3 +250,54 @@ class TPESearcher(Searcher):
         self.__dict__.update(state)
         self.rng = random.Random()
         self.rng.setstate(rng_state)
+
+
+class BOHBSearcher(TPESearcher):
+    """BOHB's model component (Falkner et al. 2018, "BOHB: Robust and
+    Efficient Hyperparameter Optimization at Scale"): the TPE/KDE model
+    fit on observations from the LARGEST budget (fidelity) that has
+    accumulated enough points — intermediate results at every budget
+    feed the model, so early ASHA rungs inform suggestions long before
+    any trial finishes. Pair with AsyncHyperBandScheduler for the full
+    BOHB structure (reference: tune/suggest/bohb.py TuneBOHB +
+    schedulers/hb_bohb.py; re-derived from the public algorithm, no
+    hpbandster dependency).
+    """
+
+    def __init__(self, space: Dict[str, Any],
+                 min_points_in_model: int = 8, **kw):
+        super().__init__(space, n_initial_points=min_points_in_model,
+                         **kw)
+        # budget (training_iteration) -> [(unit config, value)]
+        self.budget_obs: Dict[int, List[tuple]] = {}
+        # (trial_id, budget) pairs already recorded: a resumed trial
+        # replaying iterations must not double-count its config's mass
+        self._seen: set = set()
+
+    def _model_observations(self) -> List[tuple]:
+        best: List[tuple] = []
+        for budget in sorted(self.budget_obs):
+            obs = self.budget_obs[budget]
+            if len(obs) >= self.n_initial:
+                best = obs  # keep climbing to the largest viable budget
+        return best
+
+    def on_trial_result(self, trial_id: str,
+                        result: Dict[str, Any]) -> None:
+        unit_cfg = self._live.get(trial_id)
+        value = result.get(self.metric)
+        if unit_cfg is None or value is None:
+            return
+        budget = int(result.get("training_iteration", 1))
+        if (trial_id, budget) in self._seen:
+            return
+        self._seen.add((trial_id, budget))
+        self.budget_obs.setdefault(budget, []).append(
+            (dict(unit_cfg), float(value)))
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:
+        # the final result was already recorded per-budget by
+        # on_trial_result; just retire the live entry
+        self._live.pop(trial_id, None)

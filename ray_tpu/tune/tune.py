@@ -28,6 +28,38 @@ from ray_tpu.tune.trial import ERROR, PENDING, RUNNING, TERMINATED, Trial
 logger = logging.getLogger(__name__)
 
 
+def _pack_checkpoint(path: str) -> bytes:
+    """Checkpoint (file OR directory) -> one durable blob."""
+    if os.path.isdir(path):
+        import io
+        import zipfile
+
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w") as zf:
+            for root, _dirs, files in os.walk(path):
+                for fname in sorted(files):
+                    full = os.path.join(root, fname)
+                    zf.write(full, os.path.relpath(full, path))
+        return b"DIR0" + buf.getvalue()
+    with open(path, "rb") as f:
+        return b"FIL0" + f.read()
+
+
+def _unpack_checkpoint(blob: bytes, path: str) -> None:
+    tag, payload = blob[:4], blob[4:]
+    if tag == b"DIR0":
+        import io
+        import zipfile
+
+        os.makedirs(path, exist_ok=True)
+        with zipfile.ZipFile(io.BytesIO(payload)) as zf:
+            zf.extractall(path)
+    else:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(payload)
+
+
 class TrialRunner:
     """Event loop over trial actors (reference: TrialRunner.step —
     process one ready result per step, consult scheduler, refill).
@@ -42,7 +74,8 @@ class TrialRunner:
                  resources_per_trial: Optional[dict],
                  max_concurrent: int, experiment_dir: str,
                  checkpoint_freq: int = 0,
-                 trials: Optional[List[Trial]] = None):
+                 trials: Optional[List[Trial]] = None,
+                 storage=None, exp_name: str = ""):
         self.trainable = trainable
         self.search_alg = search_alg
         self.max_trials = max_trials
@@ -59,6 +92,13 @@ class TrialRunner:
         self._last_ckpt = 0.0
         self._exhausted = False
         self.checkpoint_period_s = 5.0
+        # Durable experiments (reference: durable_trainable.py +
+        # tune/syncer.py): experiment + searcher state and trial
+        # checkpoints mirror into a workflow Storage backend (file:// /
+        # kv:// / s3://) so a DIFFERENT driver can resume after the
+        # head dies — the local experiment_dir is just a working copy.
+        self.storage = storage
+        self.exp_name = exp_name
         scheduler.set_objective(metric, mode)
 
     # ------------------------------------------------------------- plumbing
@@ -85,7 +125,60 @@ class TrialRunner:
     def _start_trial(self, t: Trial):
         t.experiment_dir = self.experiment_dir
         t.start(self.resources)
+        self._maybe_restore(t)
         self._fetch(t)
+
+    def _maybe_restore(self, t: Trial):
+        """Resume an interrupted trial from its latest checkpoint.
+
+        The durable blob carries the iteration it was taken at, and is
+        unpacked into THIS driver's experiment_dir (the dead driver's
+        local paths are assumed gone). Trial metadata (iteration,
+        results) only rolls forward to the checkpoint if the actor
+        restore actually succeeds — a fresh start keeps clean metadata
+        instead of a stitched history."""
+        prior_results = getattr(t, "_prior_results", None)
+        if prior_results is None:
+            return
+        t._prior_results = None
+        path, ckpt_iter = None, None
+        if self.storage is not None:
+            raw = self.storage.get(self._ckpt_key(t))
+            if raw is not None:
+                try:
+                    meta = pickle.loads(raw)
+                    ckpt_iter = int(meta["iteration"])
+                    path = os.path.join(
+                        self.experiment_dir, t.trial_id,
+                        f"checkpoint_{ckpt_iter:06d}")
+                    if not os.path.exists(path):
+                        _unpack_checkpoint(meta["blob"], path)
+                except Exception:  # noqa: BLE001 — corrupt blob
+                    logger.exception("durable checkpoint of %s unusable",
+                                     t.trial_id)
+                    path, ckpt_iter = None, None
+        if path is None:
+            # same-machine resume: the local checkpoint may still exist
+            p = getattr(t, "_prior_ckpt_path", None)
+            it = getattr(t, "_prior_ckpt_iter", None)
+            if p and it is not None and os.path.exists(p):
+                path, ckpt_iter = p, int(it)
+        if path is None or ckpt_iter is None:
+            return  # fresh start
+        try:
+            ray_tpu.get(t.actor.restore_checkpoint.remote(path))
+        except Exception:  # noqa: BLE001 — fresh start is the fallback
+            logger.exception("restore of %s failed; starting fresh",
+                             t.trial_id)
+            return
+        t.iteration = ckpt_iter
+        t.results = list(prior_results[:ckpt_iter])
+        t.last_result = t.results[-1] if t.results else {}
+        t.latest_checkpoint = path
+        t.checkpoint_iteration = ckpt_iter
+
+    def _ckpt_key(self, t: Trial) -> str:
+        return f"tune/{self.exp_name}/ckpt/{t.trial_id}"
 
     def _fetch(self, t: Trial):
         self._pending[t.fetch_next()] = t
@@ -138,9 +231,18 @@ class TrialRunner:
             self.search_alg.on_trial_result(t.trial_id, metrics)
         if self.checkpoint_freq and t.iteration % self.checkpoint_freq == 0:
             try:
-                ray_tpu.get(t.actor.save_checkpoint.remote(
-                    t.checkpoint_path()))
-                t.latest_checkpoint = t.checkpoint_path()
+                path = t.checkpoint_path()
+                ray_tpu.get(t.actor.save_checkpoint.remote(path))
+                # function trainables write nothing — no checkpoint then
+                if os.path.exists(path):
+                    t.latest_checkpoint = path
+                    t.checkpoint_iteration = t.iteration
+                    if self.storage is not None:
+                        # self-describing blob: resume looks this key up
+                        # directly, no experiment-state force needed
+                        self.storage.put(self._ckpt_key(t), pickle.dumps(
+                            {"iteration": t.iteration,
+                             "blob": _pack_checkpoint(path)}))
             except Exception:  # noqa: BLE001
                 logger.exception("checkpoint of %s failed", t.trial_id)
         if done or self._hit_stop_criteria(t, metrics):
@@ -217,13 +319,22 @@ class TrialRunner:
                 "status": t.status, "results": t.results,
                 "error": t.error, "iteration": t.iteration,
                 "latest_checkpoint": getattr(t, "latest_checkpoint", None),
+                "checkpoint_iteration":
+                    getattr(t, "checkpoint_iteration", None),
             } for t in self.trials],
         }
+        blob = pickle.dumps(state)
         tmp = os.path.join(self.experiment_dir, ".experiment_state.tmp")
         with open(tmp, "wb") as f:
-            pickle.dump(state, f)
+            f.write(blob)
         os.replace(tmp, os.path.join(self.experiment_dir,
                                      "experiment_state.pkl"))
+        if self.storage is not None:
+            try:
+                self.storage.put(
+                    f"tune/{self.exp_name}/experiment_state", blob)
+            except Exception:  # noqa: BLE001 — never kill the loop
+                logger.exception("durable experiment checkpoint failed")
         if self.search_alg is not None:
             # Searcher state rides the same checkpoint cadence so a
             # killed experiment resumes its observation history too
@@ -232,21 +343,31 @@ class TrialRunner:
             tmp = os.path.join(self.experiment_dir, ".searcher_state.tmp")
             try:
                 self.search_alg.save(tmp)
+                if self.storage is not None:
+                    with open(tmp, "rb") as f:
+                        self.storage.put(
+                            f"tune/{self.exp_name}/searcher_state",
+                            f.read())
                 os.replace(tmp, os.path.join(self.experiment_dir,
                                              "searcher_state.pkl"))
             except Exception:  # noqa: BLE001 — never kill the loop
                 logger.exception("searcher checkpoint failed")
 
 
-def _restore_trials(trainable, experiment_dir: str) -> List[Trial]:
-    """Rebuild Trial objects from a persisted experiment_state.pkl:
+def _restore_trials(trainable, experiment_dir: str,
+                    state: Optional[dict] = None) -> List[Trial]:
+    """Rebuild Trial objects from a persisted experiment_state
+    (local pickle, or a pre-loaded dict from durable storage):
     completed/errored trials keep their results; interrupted ones
-    re-run (reference: TrialRunner.resume, tune/trial_runner.py)."""
+    re-run — from their latest durable checkpoint when one exists
+    (reference: TrialRunner.resume, tune/trial_runner.py +
+    durable_trainable.py restore path)."""
     import itertools
 
-    path = os.path.join(experiment_dir, "experiment_state.pkl")
-    with open(path, "rb") as f:
-        state = pickle.load(f)
+    if state is None:
+        path = os.path.join(experiment_dir, "experiment_state.pkl")
+        with open(path, "rb") as f:
+            state = pickle.load(f)
     trials: List[Trial] = []
     max_id = -1
     for rec in state["trials"]:
@@ -259,7 +380,13 @@ def _restore_trials(trainable, experiment_dir: str) -> List[Trial]:
             t.iteration = rec["iteration"]
             t.error = rec["error"]
         else:
-            t.status = PENDING  # interrupted: re-run from scratch
+            t.status = PENDING  # interrupted: re-run
+            # checkpoint-based continuation is decided at start time
+            # (TrialRunner._maybe_restore), where restore success is
+            # known; until then metadata stays fresh-start clean
+            t._prior_results = rec["results"]
+            t._prior_ckpt_path = rec.get("latest_checkpoint")
+            t._prior_ckpt_iter = rec.get("checkpoint_iteration")
         t.latest_checkpoint = rec.get("latest_checkpoint")
         trials.append(t)
         try:
@@ -282,6 +409,7 @@ def run(trainable, config: Optional[Dict[str, Any]] = None,
         checkpoint_freq: int = 0,
         seed: Optional[int] = None,
         resume: bool = False,
+        upload_dir: str = "",
         verbose: int = 1) -> ExperimentAnalysis:
     """Run an experiment; returns an ExperimentAnalysis
     (reference: tune.run, python/ray/tune/tune.py).
@@ -290,6 +418,14 @@ def run(trainable, config: Optional[Dict[str, Any]] = None,
     default expands ``config`` as grid × random (the reference's
     BasicVariantGenerator). ``resume=True`` reloads trials AND searcher
     state from a previous run of the same ``name``.
+
+    ``upload_dir`` makes the experiment DURABLE (reference:
+    durable_trainable.py + tune/syncer.py): a workflow-storage URL
+    (``file:///shared/dir``, ``kv://prefix``, ``s3://bucket/...``)
+    that experiment state, searcher state, and trial checkpoints
+    mirror into — ``resume=True`` with the same ``name`` +
+    ``upload_dir`` restores from it on ANY driver, even if the
+    original head and its local_dir are gone.
     """
     assert mode in ("max", "min"), "mode must be 'max' or 'min'"
     from ray_tpu.tune.suggest import BasicVariantGenerator
@@ -298,6 +434,10 @@ def run(trainable, config: Optional[Dict[str, Any]] = None,
     exp_name = name or f"exp_{int(time.time())}"
     experiment_dir = os.path.join(base, exp_name)
     os.makedirs(experiment_dir, exist_ok=True)
+    storage = None
+    if upload_dir:
+        from ray_tpu.workflow.storage import storage_from_url
+        storage = storage_from_url(upload_dir)
 
     if search_alg is None:
         search_alg = BasicVariantGenerator(config or {}, num_samples,
@@ -310,9 +450,21 @@ def run(trainable, config: Optional[Dict[str, Any]] = None,
     restored: List[Trial] = []
     if resume:
         state_path = os.path.join(experiment_dir, "experiment_state.pkl")
-        if os.path.exists(state_path):
-            restored = _restore_trials(trainable, experiment_dir)
         searcher_path = os.path.join(experiment_dir, "searcher_state.pkl")
+        state = None
+        if storage is not None:
+            blob = storage.get(f"tune/{exp_name}/experiment_state")
+            if blob is not None:
+                state = pickle.loads(blob)
+            sblob = storage.get(f"tune/{exp_name}/searcher_state")
+            if sblob is not None:
+                # searcher restore() reads a file path: materialize
+                with open(searcher_path, "wb") as f:
+                    f.write(sblob)
+        if state is not None:
+            restored = _restore_trials(trainable, experiment_dir, state)
+        elif os.path.exists(state_path):
+            restored = _restore_trials(trainable, experiment_dir)
         if os.path.exists(searcher_path):
             search_alg.restore(searcher_path)
 
@@ -323,7 +475,8 @@ def run(trainable, config: Optional[Dict[str, Any]] = None,
         trainable, search_alg, max_trials, scheduler, metric, mode, stop,
         resources_per_trial,
         max_concurrent_trials or max_trials, experiment_dir,
-        checkpoint_freq=checkpoint_freq, trials=restored)
+        checkpoint_freq=checkpoint_freq, trials=restored,
+        storage=storage, exp_name=exp_name)
 
     if verbose:
         logger.info("tune: up to %d trials -> %s", max_trials,
