@@ -71,12 +71,77 @@ class RayTpuClient {
         {Value::Of("timeout"), Value::Of(static_cast<int64_t>(timeout_s))},
     });
     Value reply = Call("CCallNamed", std::move(header));
-    const Value* err = reply.Find("error");
-    if (err != nullptr && err->type == Value::Type::Str)
-      throw std::runtime_error("CallNamed(" + name + "): " + err->s);
+    ThrowIfError(reply, "CallNamed(" + name + ")");
     const Value* value = reply.Find("value");
     if (value == nullptr)
       throw std::runtime_error("CallNamed(" + name + "): malformed reply");
+    return *value;
+  }
+
+  // ----- cross-language objects + named actors --------------------------
+  // ObjectRefs are opaque ids; on the wire a ref travels as the
+  // one-key map {"__rtpu_ref__": <id bytes>} (see Value Ref(id)).
+
+  // Build the wire form of an ObjectRef for use as a CallNamed /
+  // CallActor argument.
+  static Value Ref(const std::string& id) {
+    return Value::MapOf({{Value::Of("__rtpu_ref__"), Value::Bin(id)}});
+  }
+
+  // Store a msgpack-native value in the cluster; returns the opaque
+  // ObjectRef id (held server-side until Release/disconnect).
+  std::string Put(Value value) {
+    Value header = Value::MapOf({{Value::Of("value"), std::move(value)}});
+    Value reply = Call("CXPut", std::move(header));
+    ThrowIfError(reply, "Put");
+    const Value* id = reply.Find("id");
+    if (id == nullptr) throw std::runtime_error("Put: malformed reply");
+    return id->s;
+  }
+
+  // Fetch the value behind an ObjectRef id.
+  Value Get(const std::string& id, int timeout_s = 300) {
+    Value header = Value::MapOf({
+        {Value::Of("id"), Value::Bin(id)},
+        {Value::Of("timeout"), Value::Of(static_cast<int64_t>(timeout_s))},
+    });
+    Value reply = Call("CXGet", std::move(header));
+    ThrowIfError(reply, "Get");
+    const Value* value = reply.Find("value");
+    if (value == nullptr) throw std::runtime_error("Get: malformed reply");
+    return *value;
+  }
+
+  // Invoke a registered function but keep the result as a ref.
+  std::string CallNamedRef(const std::string& name,
+                           std::vector<Value> args) {
+    Value header = Value::MapOf({
+        {Value::Of("name"), Value::Of(name)},
+        {Value::Of("args"), Value::Arr(std::move(args))},
+        {Value::Of("ret_ref"), Value::Of(true)},
+    });
+    Value reply = Call("CCallNamed", std::move(header));
+    ThrowIfError(reply, "CallNamedRef(" + name + ")");
+    const Value* id = reply.Find("id");
+    if (id == nullptr)
+      throw std::runtime_error("CallNamedRef: malformed reply");
+    return id->s;
+  }
+
+  // Call a method on a NAMED actor (created by any language).
+  Value CallActor(const std::string& actor_name, const std::string& method,
+                  std::vector<Value> args, int timeout_s = 300) {
+    Value header = Value::MapOf({
+        {Value::Of("actor_name"), Value::Of(actor_name)},
+        {Value::Of("method"), Value::Of(method)},
+        {Value::Of("args"), Value::Arr(std::move(args))},
+        {Value::Of("timeout"), Value::Of(static_cast<int64_t>(timeout_s))},
+    });
+    Value reply = Call("CXActorCall", std::move(header));
+    ThrowIfError(reply, actor_name + "." + method);
+    const Value* value = reply.Find("value");
+    if (value == nullptr)
+      throw std::runtime_error("CallActor: malformed reply");
     return *value;
   }
 
@@ -112,6 +177,12 @@ class RayTpuClient {
   }
 
  private:
+  static void ThrowIfError(const Value& reply, const std::string& what) {
+    const Value* err = reply.Find("error");
+    if (err != nullptr && err->type == Value::Type::Str)
+      throw std::runtime_error(what + ": " + err->s);
+  }
+
   static void PutLE32(std::string& out, uint32_t v) {
     for (int k = 0; k < 4; ++k)
       out.push_back(static_cast<char>((v >> (8 * k)) & 0xff));

@@ -66,6 +66,58 @@ int main(int argc, char** argv) {
     }
     std::printf("unknown function raises ok\n");
 
+    // ---- objects: Put / Get round trip + ref-as-argument ----
+    std::string id = client.Put(Value::Arr(
+        {Value::Of(10), Value::Of(20), Value::Of(12)}));
+    Value back = client.Get(id);
+    if (back.type != Value::Type::Array || back.array.size() != 3 ||
+        back.array[2].i != 12) {
+      std::fprintf(stderr, "Put/Get round trip failed\n");
+      return 1;
+    }
+    std::printf("put/get round trip ok (%zu bytes id)\n", id.size());
+
+    // the stored list rides into a task BY REFERENCE
+    Value total = client.CallNamed("sum_list", {RayTpuClient::Ref(id)});
+    if (total.type != Value::Type::Int || total.i != 42) {
+      std::fprintf(stderr, "sum_list(ref) != 42\n");
+      return 1;
+    }
+    std::printf("sum_list(ref) = %lld\n", static_cast<long long>(total.i));
+
+    // a task result can stay remote and be fetched separately
+    std::string rid = client.CallNamedRef("add", {Value::Of(1),
+                                                  Value::Of(2)});
+    Value three = client.Get(rid);
+    if (three.i != 3) {
+      std::fprintf(stderr, "CallNamedRef/Get != 3\n");
+      return 1;
+    }
+    std::printf("ref-returning call ok\n");
+
+    // ---- named actors: stateful calls from C++ ----
+    Value c1 = client.CallActor("xlang_counter", "incr", {Value::Of(5)});
+    Value c2 = client.CallActor("xlang_counter", "incr", {Value::Of(7)});
+    if (c1.i != 5 || c2.i != 12) {
+      std::fprintf(stderr, "actor state wrong: %lld then %lld\n",
+                   static_cast<long long>(c1.i),
+                   static_cast<long long>(c2.i));
+      return 1;
+    }
+    std::printf("named actor incr: 5 then 12 ok\n");
+
+    bool actor_raised = false;
+    try {
+      client.CallActor("no_such_actor", "incr", {});
+    } catch (const std::runtime_error&) {
+      actor_raised = true;
+    }
+    if (!actor_raised) {
+      std::fprintf(stderr, "unknown actor did not raise\n");
+      return 1;
+    }
+    std::printf("unknown actor raises ok\n");
+
     std::printf("XLANG OK\n");
     return 0;
   } catch (const std::exception& e) {

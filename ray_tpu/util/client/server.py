@@ -74,10 +74,14 @@ class ClientServer:
             "CCancel": self.handle_cancel,
             "CRelease": self.handle_release,
             "CGcs": self.handle_gcs,
-            # cross-language entry point: call a registered Python
-            # function by NAME with msgpack-native args (the C++
-            # client in cpp/ uses only this + CPing)
+            # cross-language entry points (the C++ client in cpp/):
+            # call a registered Python function by NAME, put/get
+            # msgpack-native objects (ObjectRef = opaque id), and drive
+            # NAMED actors — all with msgpack-native values only
             "CCallNamed": self.handle_call_named,
+            "CXPut": self.handle_x_put,
+            "CXGet": self.handle_x_get,
+            "CXActorCall": self.handle_x_actor_call,
             "CPing": self.handle_ping,
         }, name="client-server")
         self._named_fn_cache: Dict[str, object] = {}
@@ -288,6 +292,26 @@ class ClientServer:
                 lambda: ray_tpu.remote(cloudpickle.loads(data)))
             self._named_fn_cache[name] = (digest, remote_fn)
 
+        st = self._state(conn)
+        try:
+            args = self._decode_x_args(st, args)
+            kwargs = {k: self._decode_x_arg(st, v)
+                      for k, v in kwargs.items()}
+        except KeyError as e:
+            return {"error": str(e)}
+
+        if header.get("ret_ref"):
+            # hand back the ObjectRef (opaque id) instead of the value:
+            # the client can pass it to later calls / CXGet it
+            def submit():
+                return remote_fn.remote(*args, **kwargs)
+
+            try:
+                ref = await self._offload(submit)
+            except Exception as e:  # noqa: BLE001
+                return {"error": f"{type(e).__name__}: {e}"}
+            return {"id": self._book(st, [ref])[0]}
+
         def run():
             ref = remote_fn.remote(*args, **kwargs)
             return ray_tpu.get(ref, timeout=header.get("timeout", 300))
@@ -300,4 +324,103 @@ class ClientServer:
             return {"error":
                     f"result of {name!r} is not msgpack-serializable "
                     f"({type(value).__name__})"}
+        return {"value": value}
+
+    # ObjectRefs cross the language boundary as one-key maps
+    # {"__rtpu_ref__": <28-byte id>} (reference role: cross-language
+    # ObjectRef exchange, python/ray/cross_language.py — the id is the
+    # only portable representation).
+    def _decode_x_arg(self, st: _ConnState, a):
+        if isinstance(a, dict) and len(a) == 1 and "__rtpu_ref__" in a:
+            return self._resolve_ref(st, self._coerce_id(a["__rtpu_ref__"]))
+        return a
+
+    def _decode_x_args(self, st: _ConnState, args):
+        return [self._decode_x_arg(st, a) for a in args]
+
+    @staticmethod
+    def _coerce_id(id_bytes) -> bytes:
+        """Client-controlled ref ids must be bytes before they reach
+        the resolver (whose miss path formats them with .hex())."""
+        if isinstance(id_bytes, bytes):
+            return id_bytes
+        raise KeyError(
+            f"ObjectRef id must be msgpack bin, got "
+            f"{type(id_bytes).__name__}")
+
+    async def handle_x_put(self, conn, header, bufs):
+        """msgpack-native put: value -> opaque ObjectRef id, held by
+        this connection's booking state until CRelease/disconnect."""
+        st = self._state(conn)
+        value = header.get("value")
+        ref = await self._offload(lambda: self._core.put(value))
+        return {"id": self._book(st, [ref])[0]}
+
+    async def handle_x_get(self, conn, header, bufs):
+        from ray_tpu.util import cross_language
+
+        st = self._state(conn)
+        try:
+            ref = self._resolve_ref(st, self._coerce_id(header["id"]))
+        except KeyError as e:
+            return {"error": str(e)}
+        try:
+            values = await self._core.get_objects_async(
+                [ref], timeout=header.get("timeout", 300))
+        except Exception as e:  # noqa: BLE001 — client sees the error
+            return {"error": f"{type(e).__name__}: {e}"}
+        value = values[0]
+        if not cross_language.check_msgpack_value(value):
+            return {"error": f"object is not msgpack-serializable "
+                             f"({type(value).__name__})"}
+        return {"value": value}
+
+    async def handle_x_actor_call(self, conn, header, bufs):
+        """Drive a NAMED actor from another language: look the handle
+        up by name, invoke a method with msgpack-native args, return
+        the msgpack-native result (reference role: cross-language
+        actors, python/ray/cross_language.py java_actor_class /
+        core_worker/lib/java — here by name over the wire protocol)."""
+        from ray_tpu.util import cross_language
+
+        st = self._state(conn)
+        name = header["actor_name"]
+        method = header["method"]
+        namespace = header.get("namespace") or None
+        try:
+            args = self._decode_x_args(st, header.get("args") or [])
+        except KeyError as e:
+            return {"error": str(e)}
+        import ray_tpu
+
+        def submit():
+            # the name lookup is a GCS round trip: cache the resolved
+            # handle per connection, dropping it on failure so a
+            # restarted/recreated actor re-resolves
+            key = ("named", name, namespace)
+            handle = st.actors.get(key)
+            if handle is None:
+                handle = ray_tpu.get_actor(name, namespace=namespace)
+                st.actors[key] = handle
+            m = getattr(handle, method, None)
+            if m is None:
+                raise AttributeError(
+                    f"actor {name!r} has no method {method!r}")
+            return m.remote(*args)
+
+        try:
+            ref = await self._offload(submit)
+            # blocking gets stay OFF the executor (handle_get's
+            # rationale): await the async path on the loop instead of
+            # pinning a thread per in-flight actor call
+            values = await self._core.get_objects_async(
+                [ref], timeout=header.get("timeout", 300))
+            value = values[0]
+        except Exception as e:  # noqa: BLE001 — client sees the error
+            st.actors.pop(("named", name, namespace), None)
+            return {"error": f"{type(e).__name__}: {e}"}
+        if not cross_language.check_msgpack_value(value):
+            return {"error": f"result of {name}.{method} is not "
+                             f"msgpack-serializable "
+                             f"({type(value).__name__})"}
         return {"value": value}
