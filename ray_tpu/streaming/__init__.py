@@ -17,10 +17,14 @@ streaming/src/; see runtime.py for the engine re-design). Usage::
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Callable, Iterable, List, Optional
 
 import ray_tpu
+from ray_tpu import exceptions as exc_mod
 from ray_tpu.streaming.runtime import Barrier, Eos, StreamOperator
+
+logger = logging.getLogger(__name__)
 
 __all__ = ["StreamingContext", "DataStream"]
 
@@ -119,8 +123,10 @@ class StreamingContext:
             ray_tpu.get(up.set_downstream.remote(down))
         return ops
 
-    def _run(self, stream: DataStream,
-             checkpoint_every: Optional[int]) -> List[Any]:
+    def _build_topology(self, stream: DataStream):
+        """Instantiate the operator actors for ``stream``; returns
+        (all_ops, heads, sources). Re-invoked wholesale by failure
+        recovery — a fresh actor set replaces the broken pipeline."""
         op_cls = ray_tpu.remote(StreamOperator)
         suffix = list(stream._stages)
         if not suffix or suffix[-1].kind != "sink":
@@ -154,6 +160,70 @@ class StreamingContext:
             heads = [all_ops[0]]
             sources = [stream._source if stream._source is not None
                        else self._source]
+        return all_ops, heads, sources
+
+    def _collect_snapshot(self, all_ops, barrier_id: int,
+                          timeout: float = 30.0) -> Optional[list]:
+        """Poll until EVERY operator has aligned ``barrier_id`` and
+        return their snapshots (driver-side copies: an operator's own
+        snapshot dies with its actor — holding them here is what makes
+        them a recovery point, the role of the reference's checkpoint
+        store in reliability/barrier_helper.h)."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            snaps = ray_tpu.get(
+                [op.snapshot.remote(barrier_id) for op in all_ops])
+            if all(s is not None for s in snaps):
+                return snaps
+            time.sleep(0.02)
+        return None
+
+    def _run(self, stream: DataStream,
+             checkpoint_every: Optional[int]) -> List[Any]:
+        """Drive the pipeline; with ``checkpoint_every`` set, operator
+        failure mid-stream triggers recovery: rebuild the actor
+        pipeline, restore every operator from the last fully-aligned
+        barrier snapshot, replay the sources from that barrier's
+        offsets — output is exactly-once (reference:
+        streaming/src/reliability/barrier_helper.h rollback)."""
+        if checkpoint_every:
+            # recovery replays sources from saved offsets: a one-shot
+            # iterator cannot be replayed (silent data loss otherwise)
+            srcs = [b._source for b in stream._branches] \
+                if stream._branches else [stream._source or self._source]
+            for s in srcs:
+                if s is not None and iter(s) is s:
+                    raise ValueError(
+                        "checkpointed streams need RE-ITERABLE sources "
+                        "(list/tuple/an __iter__ class), not a one-shot "
+                        "generator — recovery replays from offsets")
+        recovery: dict = {}  # {"barrier", "snaps", "offsets"}
+        attempts = 0
+        while True:
+            try:
+                return self._drive(stream, checkpoint_every, recovery)
+            except (exc_mod.ActorDiedError, exc_mod.WorkerCrashedError,
+                    exc_mod.RaySystemError, ConnectionError):
+                attempts += 1
+                if not checkpoint_every or "snaps" not in recovery \
+                        or attempts > 3:
+                    raise
+                # the broken pipeline's survivors must not linger
+                for op in self.operators:
+                    try:
+                        ray_tpu.kill(op)
+                    except Exception:  # noqa: BLE001 — already dead
+                        pass
+                logger.warning(
+                    "stream operator died; recovering from barrier %s "
+                    "(attempt %d)", recovery.get("barrier"), attempts)
+
+    def _drive(self, stream: DataStream,
+               checkpoint_every: Optional[int],
+               recovery: dict) -> List[Any]:
+        all_ops, heads, sources = self._build_topology(stream)
         self.operators = all_ops
         sink = all_ops[-1]
 
@@ -163,23 +233,50 @@ class StreamingContext:
             else:
                 ray_tpu.get(head.push.remote(payload))
 
+        # Resume point: restore operator state, skip replayed records.
+        offsets = [0] * len(sources)
+        sent = 0
+        barrier_id = 0
+        if recovery:
+            ray_tpu.get([op.restore.remote(snap) for op, snap in
+                         zip(all_ops, recovery["snaps"])])
+            offsets = list(recovery["offsets"])
+            sent = sum(offsets)
+            barrier_id = recovery["barrier"]
+
         # Drive every source round-robin so fan-in edges genuinely
         # interleave; barriers are injected into EVERY head at the same
         # logical point (the runtime aligns them downstream).
-        iters = [iter(s) for s in sources]
+        iters = []
+        for i, s in enumerate(sources):
+            it = iter(s)
+            for _ in range(offsets[i]):  # replay: skip consumed prefix
+                next(it)
+            iters.append(it)
+        counts = list(offsets)
         batches: List[List[Any]] = [[] for _ in sources]
         live = set(range(len(sources)))
-        sent = 0
-        barrier_id = 0
+        pending_barrier: Optional[int] = None
 
         def _inject_barrier():
-            nonlocal barrier_id
+            nonlocal barrier_id, pending_barrier
+            # collect the PREVIOUS barrier first: its alignment is done
+            # or imminent, and holding its snapshots driver-side turns
+            # it into the recovery point
+            if pending_barrier is not None and checkpoint_every:
+                snaps = self._collect_snapshot(all_ops, pending_barrier)
+                if snaps is not None:
+                    recovery.update(barrier=pending_barrier, snaps=snaps,
+                                    offsets=recovery.pop("_offsets_at",
+                                                         list(counts)))
             barrier_id += 1
             for j in range(len(sources)):
                 if batches[j]:
                     _push(heads[j], batches[j])
                     batches[j] = []
                 _push(heads[j], [Barrier(barrier_id)])
+            pending_barrier = barrier_id
+            recovery["_offsets_at"] = list(counts)
 
         while live:
             for i in list(live):
@@ -191,6 +288,7 @@ class StreamingContext:
                         batches[i] = []
                     live.discard(i)
                     continue
+                counts[i] += 1
                 sent += 1
                 if len(batches[i]) >= _BATCH:
                     _push(heads[i], batches[i])
@@ -205,12 +303,25 @@ class StreamingContext:
         # wait for EOS to reach the sink, surfacing operator failures
         import time
 
+        def _raise_op_error(msg: str):
+            # a mid-pipeline neighbor observing a dead actor reports it
+            # as "<ExcType>: ..." (runtime.py _consume_loop) — map the
+            # death types back to the recoverable class so the retry
+            # loop can rebuild instead of failing the job. Matching the
+            # TYPE PREFIX only: a user exception merely mentioning
+            # 'connection' in its text must stay non-recoverable.
+            if msg.startswith(("ActorDiedError", "WorkerCrashedError",
+                               "ConnectionError", "ConnectionResetError",
+                               "BrokenPipeError")):
+                raise exc_mod.ActorDiedError(msg)
+            raise RuntimeError(f"stream operator failed:\n{msg}")
+
         deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
             errors = ray_tpu.get([op.error.remote() for op in all_ops])
             bad = next((e for e in errors if e), None)
             if bad:
-                raise RuntimeError(f"stream operator failed:\n{bad}")
+                _raise_op_error(bad)
             if ray_tpu.get(sink.eos_done.remote()):
                 break
             time.sleep(0.02)
@@ -220,5 +331,5 @@ class StreamingContext:
         errors = ray_tpu.get([op.error.remote() for op in all_ops])
         bad = next((e for e in errors if e), None)
         if bad:  # an error that raced the EOS poll
-            raise RuntimeError(f"stream operator failed:\n{bad}")
+            _raise_op_error(bad)
         return ray_tpu.get(sink.sink_output.remote())

@@ -198,8 +198,17 @@ class StreamOperator:
                 return  # still waiting on this edge
         self._snapshots[barrier_id] = {
             "state": dict(self._state),
-            "sink_len": len(self._sink_out),
+            # the sink records themselves: recovery restores a FRESH
+            # actor to this prefix for exactly-once output (reference:
+            # barrier-checkpointed channel state,
+            # streaming/src/reliability/barrier_helper.h)
+            "sink": list(self._sink_out),
         }
+        # the driver collects barrier N-1 when injecting N: anything
+        # older is an unusable recovery point — holding it would grow
+        # O(barriers x sink) memory
+        for old in [b for b in self._snapshots if b < barrier_id - 1]:
+            del self._snapshots[old]
         if self.downstream is not None:
             await self._send([Barrier(barrier_id)])
         # unstall: stashed (post-barrier) records become ready batches
@@ -280,6 +289,13 @@ class StreamOperator:
 
     async def snapshot(self, barrier_id: int) -> Optional[dict]:
         return self._snapshots.get(barrier_id)
+
+    async def restore(self, snap: dict) -> None:
+        """Load a barrier snapshot into this (fresh) operator: reduce
+        state and the exactly-once sink prefix (reference: per-node
+        rollback from barrier checkpoints, reliability/barrier_helper.h)."""
+        self._state = dict(snap.get("state") or {})
+        self._sink_out = list(snap.get("sink") or ())
 
     async def eos_done(self) -> bool:
         return self._eos_forwarded or \
