@@ -42,6 +42,10 @@ class RayTpuConfig:
     # Enable spilling objects to disk when the store fills.
     object_spilling_enabled: bool = True
     spill_path: str = ""
+    # External spill target (reference: external_storage.py S3 via
+    # smart_open): a workflow-storage URL (file:///shared, kv://, or
+    # s3://bucket/prefix) that overrides the local spill dir.
+    spill_external_storage_url: str = ""
     # Chunk size for node-to-node object transfer.
     object_manager_chunk_size: int = 1024 * 1024
 
@@ -68,6 +72,11 @@ class RayTpuConfig:
     # Which scheduler backend the raylet uses: "host" (dict/heap reference
     # implementation) or "tpu_batched" (JAX batched frontier/scoring kernel).
     scheduler_backend: str = "host"
+    # What happens to a task no node can currently satisfy: "fail" the
+    # lease (fast feedback) or "wait" in the queue until capacity
+    # appears — dynamic resources / autoscaled nodes (the reference
+    # keeps infeasible tasks pending and warns).
+    infeasible_task_policy: str = "fail"
     # Max tasks the batched backend scores per tick.
     scheduler_batch_size: int = 4096
     # Lease reuse: keep an idle leased worker this long before returning it.

@@ -89,7 +89,8 @@ class Raylet:
         self.store = ShmStoreServer(
             capacity_bytes=config.object_store_memory,
             spill_dir=os.path.join(session_dir, "spill", self.node_id.hex()[:8]),
-            spilling_enabled=config.object_spilling_enabled)
+            spilling_enabled=config.object_spilling_enabled,
+            external_storage_url=config.spill_external_storage_url)
 
         # Structured event log (reference: util/event.h RAY_EVENT)
         from ray_tpu._private.events import EventEmitter
@@ -165,6 +166,7 @@ class Raylet:
             "CommitPGBundle": self.handle_commit_pg_bundle,
             "ReturnPGBundle": self.handle_return_pg_bundle,
             "GetNodeStats": self.handle_get_node_stats,
+            "SetResource": self.handle_set_resource,
             "DumpWorkerStacks": self.handle_dump_worker_stacks,
             "GetLogs": self.handle_get_logs,
             "Published": self.handle_published,
@@ -387,6 +389,9 @@ class Raylet:
                     "resources_total": msg["resources"],
                     "resources_available": dict(msg["resources"]),
                 }
+                # a joining node may carry capacity a WAITING
+                # (infeasible-so-far) request needs: spill it there now
+                self._schedule_tick()
             elif msg["event"] == "dead":
                 self.remote_nodes.pop(nid, None)
         return {}
@@ -645,6 +650,8 @@ class Raylet:
                 self._note_latency(req)
                 fut.set_result(({"granted": False, "spill": d.spill_address}, ()))
             elif d.action == INFEASIBLE:
+                if self.config.infeasible_task_policy == "wait":
+                    continue  # stays pending until capacity appears
                 self._pending.pop(d.req_id, None)
                 self._note_latency(req)
                 fut.set_result(({"granted": False, "infeasible": True}, ()))
@@ -1224,6 +1231,34 @@ class Raylet:
                 "max_ms": round(durs[-1] * 1e3, 3),
             }
         return out
+
+    async def handle_set_resource(self, conn, header, bufs):
+        """Dynamic custom resources (reference:
+        experimental/dynamic_resources.py set_resource → raylet-side
+        capacity update): adjust total AND available by the same delta
+        so in-flight leases keep their accounting; capacity 0 deletes.
+        The next tick dispatches anything the new capacity unblocks."""
+        name = header["name"]
+        capacity = float(header["capacity"])
+        if name == "CPU":
+            return {"ok": False, "reason": "CPU capacity is fixed"}
+        old_total = self.resources_total.get(name, 0.0)
+        delta = capacity - old_total
+        new_avail = self.resources_available.get(name, 0.0) + delta
+        if capacity <= 0.0:
+            self.resources_total.pop(name, None)
+            # available moves by the SAME delta (possibly negative:
+            # in-flight leases still owe their release), so a later
+            # re-create can never oversubscribe
+            if new_avail == 0.0:
+                self.resources_available.pop(name, None)
+            else:
+                self.resources_available[name] = new_avail
+        else:
+            self.resources_total[name] = capacity
+            self.resources_available[name] = new_avail
+        self._schedule_tick()
+        return {"ok": True, "total": self.resources_total.get(name, 0.0)}
 
     async def handle_dump_worker_stacks(self, conn, header, bufs):
         """Aggregate all-thread stack dumps from every live worker on

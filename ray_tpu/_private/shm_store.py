@@ -22,7 +22,7 @@ import struct
 import threading
 import time
 from multiprocessing import resource_tracker, shared_memory
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import msgpack
 
@@ -157,10 +157,36 @@ class ShmStoreServer:
     LocalObjectManager, src/ray/raylet/local_object_manager.h)."""
 
     def __init__(self, capacity_bytes: int, spill_dir: str = "",
-                 spilling_enabled: bool = True):
+                 spilling_enabled: bool = True,
+                 external_storage_url: str = ""):
         self.capacity = capacity_bytes
         self.spill_dir = spill_dir
-        self.spilling_enabled = spilling_enabled and bool(spill_dir)
+        # External spill target (reference: external_storage.py:71 —
+        # filesystem or S3 via smart_open; here any workflow-storage
+        # URL: file:// shared fs, kv:// cluster KV, s3://). Local
+        # spill_dir remains the default; the URL overrides it.
+        self._ext = None
+        self._ext_pool = None
+        self._ext_futures: Dict[str, Any] = {}  # key -> upload future
+        if external_storage_url:
+            if external_storage_url.startswith("kv://"):
+                # the cluster KV client needs a connected DRIVER; the
+                # raylet is not one — kv:// spill would deadlock/raise
+                raise ValueError(
+                    "spill_external_storage_url must be file:// or "
+                    "s3:// (kv:// is driver-side only)")
+            from concurrent.futures import ThreadPoolExecutor
+
+            from ray_tpu.workflow.storage import storage_from_url
+            self._ext = storage_from_url(external_storage_url)
+            # uploads/deletes run OFF the raylet loop: a burst of
+            # multi-MB network puts must not stall RPC handling or
+            # heartbeats (restore reads stay synchronous — they are
+            # demand-driven single objects on the serving path)
+            self._ext_pool = ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="rtpu-spill")
+        self.spilling_enabled = spilling_enabled and \
+            bool(spill_dir or self._ext is not None)
         if self.spill_dir:
             os.makedirs(self.spill_dir, exist_ok=True)
         # oid -> (segment_name, size, created_ts)
@@ -228,10 +254,30 @@ class ShmStoreServer:
             self._unlink(name)
         spilled = self._spilled.pop(object_id, None)
         if spilled is not None:
-            try:
-                os.unlink(spilled[0])
-            except OSError:
-                pass
+            self._delete_spilled(spilled[0])
+
+    def _delete_spilled(self, location: str) -> None:
+        if location.startswith("ext:"):
+            key = location[4:]
+            upload = self._ext_futures.pop(key, None)
+
+            def _del():
+                if upload is not None:
+                    try:  # the blob may still be uploading
+                        upload.result(timeout=60)
+                    except Exception:  # noqa: BLE001
+                        pass
+                try:
+                    self._ext.delete(key)
+                except Exception:  # noqa: BLE001 — best effort
+                    logger.exception("external spill delete failed")
+
+            self._ext_pool.submit(_del)
+            return
+        try:
+            os.unlink(location)
+        except OSError:
+            pass
 
     def _evict(self, need_bytes: int) -> None:
         """Evict LRU unpinned objects; pinned primaries are spilled to disk
@@ -261,11 +307,21 @@ class ShmStoreServer:
     def _spill(self, object_id: ObjectID) -> int:
         name, size, _ = self._objects.pop(object_id)
         self._last_access.pop(object_id, None)
-        path = os.path.join(self.spill_dir, object_id.hex())
         try:
             shm = shared_memory.SharedMemory(name=name)
-            with open(path, "wb") as f:
-                f.write(shm.buf[:size])
+            if self._ext is not None:
+                # copy to RAM + background upload: the loop thread must
+                # not block on a network put (the copy's lifetime is
+                # bounded by the 2-worker upload pool draining)
+                key = f"spill/{object_id.hex()}"
+                data = bytes(shm.buf[:size])
+                self._ext_futures[key] = self._ext_pool.submit(
+                    self._ext.put, key, data)
+                location = "ext:" + key
+            else:
+                location = os.path.join(self.spill_dir, object_id.hex())
+                with open(location, "wb") as f:
+                    f.write(shm.buf[:size])
             shm.close()
         except Exception:
             logger.exception("spill of %s failed", object_id)
@@ -273,31 +329,37 @@ class ShmStoreServer:
             return 0
         self.used -= size
         self.num_spills += 1
-        self._spilled[object_id] = (path, size)
+        self._spilled[object_id] = (location, size)
         self._unlink(name)
         return size
 
     def _restore(self, object_id: ObjectID) -> Optional[str]:
-        path, size = self._spilled[object_id]
+        location, size = self._spilled[object_id]
         if self.used + size > self.capacity:
             self._evict(self.used + size - self.capacity)
         name = f"rtpu_{secrets.token_hex(8)}"
         try:
+            if location.startswith("ext:"):
+                key = location[4:]
+                upload = self._ext_futures.pop(key, None)
+                if upload is not None:  # still in flight: wait it out
+                    upload.result(timeout=120)
+                data = self._ext.get(key)
+                if data is None:
+                    raise FileNotFoundError(location)
+            else:
+                with open(location, "rb") as f:
+                    data = f.read()
             shm = shared_memory.SharedMemory(name=name, create=True,
                                              size=max(size, 1))
             _untrack(shm)
-            with open(path, "rb") as f:
-                data = f.read()
             shm.buf[:len(data)] = data
             shm.close()
         except Exception:
             logger.exception("restore of %s failed", object_id)
             return None
         del self._spilled[object_id]
-        try:
-            os.unlink(path)
-        except OSError:
-            pass
+        self._delete_spilled(location)
         self._objects[object_id] = (name, size, time.time())
         self._last_access[object_id] = time.time()
         self.used += size
@@ -319,11 +381,8 @@ class ShmStoreServer:
         for name, _, _ in self._objects.values():
             self._unlink(name)
         self._objects.clear()
-        for path, _ in self._spilled.values():
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
+        for location, _ in self._spilled.values():
+            self._delete_spilled(location)
         self._spilled.clear()
         self.used = 0
 

@@ -1,0 +1,273 @@
+"""Cloud NodeProviders: AWS / GCP (TPU VMs) / Kubernetes.
+
+Parity target: the reference's cloud provider plugins
+(reference: python/ray/autoscaler/_private/aws/node_provider.py,
+_private/gcp/node_provider.py, _private/_kubernetes/node_provider.py)
+— tag-scoped instance discovery, create-from-template with a startup
+command that joins the cluster, and idempotent termination.
+
+TPU-first notes: ``GCPNodeProvider`` is the pod bring-up path — its
+node config can name a TPU accelerator type, and the startup script
+joins the worker to the head's GCS over DCN (``python -m ray_tpu start
+--address ...``); ICI-mesh topology inside the slice is the job of the
+training libraries, not the autoscaler.
+
+Cloud SDK clients are INJECTED (constructor argument). The default
+factory imports the real SDK (boto3 / googleapiclient / kubernetes)
+and raises a clear error when it isn't installed; tests inject fakes —
+the same seam the reference uses for its moto/mock-based provider
+tests (reference: python/ray/tests/test_autoscaler.py MockProvider
+strategy applied to real provider logic).
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+# Tag keys (reference: ray-cluster-name / ray-node-type tag scheme).
+TAG_CLUSTER = "ray-tpu-cluster"
+TAG_NODE_KIND = "ray-tpu-node-kind"
+KIND_WORKER = "worker"
+
+
+def default_start_command(gcs_address: str, num_cpus: int,
+                          resources: Optional[Dict[str, float]] = None
+                          ) -> str:
+    """The join-the-cluster command baked into instance startup
+    (reference: the ray start invocation in the autoscaler YAML's
+    worker_start_ray_commands)."""
+    cmd = (f"python -m ray_tpu start --address {gcs_address} "
+           f"--num-cpus {num_cpus}")
+    if resources:
+        pairs = ",".join(f"{k}={v}" for k, v in sorted(resources.items()))
+        cmd += f" --resources {pairs}"
+    return cmd
+
+
+class AWSNodeProvider(NodeProvider):
+    """EC2-backed workers (reference:
+    _private/aws/node_provider.py AWSNodeProvider — run_instances with
+    cluster tags, DescribeInstances filtered by tag + state,
+    terminate_instances)."""
+
+    def __init__(self, cluster_name: str, gcs_address: str,
+                 node_config: Dict[str, Any], ec2=None):
+        self.cluster_name = cluster_name
+        self.gcs_address = gcs_address
+        # e.g. {"ImageId": ..., "InstanceType": "m5.16xlarge",
+        #       "SubnetId": ..., "KeyName": ...}
+        self.node_config = dict(node_config)
+        self._ec2 = ec2 if ec2 is not None else self._real_client()
+        self._resources: Dict[str, Dict[str, float]] = {}
+
+    @staticmethod
+    def _real_client():
+        try:
+            import boto3  # type: ignore
+        except ImportError as e:
+            raise RuntimeError(
+                "AWSNodeProvider needs boto3 (not bundled); pass ec2= "
+                "explicitly or install boto3") from e
+        return boto3.client("ec2")
+
+    def non_terminated_nodes(self) -> List[str]:
+        reply = self._ec2.describe_instances(Filters=[
+            {"Name": f"tag:{TAG_CLUSTER}", "Values": [self.cluster_name]},
+            {"Name": "instance-state-name",
+             "Values": ["pending", "running"]},
+        ])
+        out = []
+        for res in reply.get("Reservations", []):
+            for inst in res.get("Instances", []):
+                out.append(inst["InstanceId"])
+        return out
+
+    def create_node(self, num_cpus: int, resources=None) -> str:
+        cfg = copy.deepcopy(self.node_config)
+        cfg.setdefault("MinCount", 1)
+        cfg.setdefault("MaxCount", 1)
+        cfg["UserData"] = "#!/bin/bash\n" + default_start_command(
+            self.gcs_address, num_cpus, resources)
+        tags = [{"Key": TAG_CLUSTER, "Value": self.cluster_name},
+                {"Key": TAG_NODE_KIND, "Value": KIND_WORKER}]
+        cfg["TagSpecifications"] = [
+            {"ResourceType": "instance", "Tags": tags}]
+        reply = self._ec2.run_instances(**cfg)
+        nid = reply["Instances"][0]["InstanceId"]
+        self._resources[nid] = {"CPU": float(num_cpus),
+                                **(resources or {})}
+        return nid
+
+    def terminate_node(self, node_id: str) -> None:
+        try:
+            self._ec2.terminate_instances(InstanceIds=[node_id])
+        except Exception:  # noqa: BLE001 — already gone: idempotent
+            pass
+        self._resources.pop(node_id, None)
+
+    def node_resources(self, node_id: str) -> Dict[str, float]:
+        return dict(self._resources.get(node_id, {"CPU": 1.0}))
+
+
+class GCPNodeProvider(NodeProvider):
+    """GCE / Cloud-TPU-VM workers (reference:
+    _private/gcp/node_provider.py GCPNodeProvider — labeled instances,
+    insert with metadata startup-script, delete). A node_config with
+    ``acceleratorType`` (e.g. v4-8) provisions TPU VMs — the path to a
+    real TPU-pod cluster bring-up."""
+
+    def __init__(self, cluster_name: str, gcs_address: str,
+                 project: str, zone: str, node_config: Dict[str, Any],
+                 compute=None):
+        self.cluster_name = cluster_name
+        self.gcs_address = gcs_address
+        self.project = project
+        self.zone = zone
+        self.node_config = dict(node_config)
+        self._compute = compute if compute is not None \
+            else self._real_client()
+        self._resources: Dict[str, Dict[str, float]] = {}
+
+    @staticmethod
+    def _real_client():
+        try:
+            import googleapiclient.discovery  # type: ignore
+        except ImportError as e:
+            raise RuntimeError(
+                "GCPNodeProvider needs google-api-python-client (not "
+                "bundled); pass compute= explicitly or install it") from e
+        return googleapiclient.discovery.build("compute", "v1")
+
+    def non_terminated_nodes(self) -> List[str]:
+        reply = self._compute.instances().list(
+            project=self.project, zone=self.zone,
+            filter=(f"labels.{TAG_CLUSTER}={self.cluster_name} AND "
+                    f"(status=RUNNING OR status=PROVISIONING OR "
+                    f"status=STAGING)")).execute()
+        return [item["name"] for item in reply.get("items", [])]
+
+    def create_node(self, num_cpus: int, resources=None) -> str:
+        name = f"ray-tpu-{self.cluster_name}-{uuid.uuid4().hex[:8]}"
+        body = copy.deepcopy(self.node_config)
+        body["name"] = name
+        body.setdefault("labels", {})[TAG_CLUSTER] = self.cluster_name
+        body["labels"][TAG_NODE_KIND] = KIND_WORKER
+        res = dict(resources or {})
+        accel = body.pop("acceleratorType", None)
+        if accel:
+            # TPU VM: the accelerator becomes a schedulable resource on
+            # the joining node (chips count from the type suffix)
+            try:
+                res.setdefault("TPU", float(accel.rsplit("-", 1)[1]))
+            except (IndexError, ValueError):
+                res.setdefault("TPU", 1.0)
+            body.setdefault("guestAccelerators", []).append(
+                {"acceleratorType": accel, "acceleratorCount": 1})
+        items = body.setdefault("metadata", {}).setdefault("items", [])
+        items.append({"key": "startup-script",
+                      "value": "#!/bin/bash\n" + default_start_command(
+                          self.gcs_address, num_cpus, res)})
+        self._compute.instances().insert(
+            project=self.project, zone=self.zone, body=body).execute()
+        self._resources[name] = {"CPU": float(num_cpus), **res}
+        return name
+
+    def terminate_node(self, node_id: str) -> None:
+        try:
+            self._compute.instances().delete(
+                project=self.project, zone=self.zone,
+                instance=node_id).execute()
+        except Exception:  # noqa: BLE001 — already gone: idempotent
+            pass
+        self._resources.pop(node_id, None)
+
+    def node_resources(self, node_id: str) -> Dict[str, float]:
+        return dict(self._resources.get(node_id, {"CPU": 1.0}))
+
+
+class KubernetesNodeProvider(NodeProvider):
+    """Pod-per-node workers (reference:
+    _private/_kubernetes/node_provider.py KubernetesNodeProvider —
+    label-selected pods in one namespace, create from a pod template,
+    delete_namespaced_pod)."""
+
+    def __init__(self, cluster_name: str, gcs_address: str,
+                 namespace: str, pod_template: Dict[str, Any],
+                 core_api=None):
+        self.cluster_name = cluster_name
+        self.gcs_address = gcs_address
+        self.namespace = namespace
+        self.pod_template = dict(pod_template)
+        self._api = core_api if core_api is not None \
+            else self._real_client()
+        self._resources: Dict[str, Dict[str, float]] = {}
+
+    @staticmethod
+    def _real_client():
+        try:
+            import kubernetes  # type: ignore
+        except ImportError as e:
+            raise RuntimeError(
+                "KubernetesNodeProvider needs the kubernetes package "
+                "(not bundled); pass core_api= explicitly") from e
+        kubernetes.config.load_incluster_config()
+        return kubernetes.client.CoreV1Api()
+
+    def _selector(self) -> str:
+        return f"{TAG_CLUSTER}={self.cluster_name}"
+
+    def non_terminated_nodes(self) -> List[str]:
+        reply = self._api.list_namespaced_pod(
+            self.namespace, label_selector=self._selector())
+        out = []
+        for pod in reply.items:
+            phase = pod.status.phase if pod.status else None
+            if phase in ("Pending", "Running"):
+                out.append(pod.metadata.name)
+        return out
+
+    def create_node(self, num_cpus: int, resources=None) -> str:
+        name = f"ray-tpu-{self.cluster_name}-{uuid.uuid4().hex[:8]}"
+        body = copy.deepcopy(self.pod_template)
+        meta = body.setdefault("metadata", {})
+        meta["name"] = name
+        meta.setdefault("labels", {})[TAG_CLUSTER] = self.cluster_name
+        meta["labels"][TAG_NODE_KIND] = KIND_WORKER
+        spec = body.setdefault("spec", {})
+        containers = spec.setdefault("containers", [{}])
+        c0 = containers[0]
+        c0.setdefault("name", "ray-tpu-node")
+        c0["command"] = ["/bin/bash", "-lc"]
+        c0["args"] = [default_start_command(
+            self.gcs_address, num_cpus, resources) + " --block"]
+        self._api.create_namespaced_pod(self.namespace, body)
+        self._resources[name] = {"CPU": float(num_cpus),
+                                 **(resources or {})}
+        return name
+
+    def terminate_node(self, node_id: str) -> None:
+        try:
+            self._api.delete_namespaced_pod(node_id, self.namespace)
+        except Exception:  # noqa: BLE001 — already gone: idempotent
+            pass
+        self._resources.pop(node_id, None)
+
+    def node_resources(self, node_id: str) -> Dict[str, float]:
+        return dict(self._resources.get(node_id, {"CPU": 1.0}))
+
+
+def wait_for_nodes(provider: NodeProvider, count: int,
+                   timeout: float = 300.0, poll: float = 2.0) -> bool:
+    """Block until the provider reports ``count`` live nodes
+    (reference: the updater's wait-for-ready loop)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if len(provider.non_terminated_nodes()) >= count:
+            return True
+        time.sleep(poll)
+    return False

@@ -1,0 +1,11 @@
+"""Experimental utilities (reference: python/ray/experimental/ —
+internal_kv, dynamic_resources, the shuffle scaling harness)."""
+
+from ray_tpu.experimental.dynamic_resources import set_resource  # noqa: F401
+from ray_tpu.experimental.shuffle import shuffle  # noqa: F401
+from ray_tpu.worker import (  # noqa: F401
+    experimental_internal_kv_del as internal_kv_del,
+    experimental_internal_kv_get as internal_kv_get,
+    experimental_internal_kv_list as internal_kv_list,
+    experimental_internal_kv_put as internal_kv_put,
+)

@@ -1,0 +1,61 @@
+"""Dynamic custom resources: change a node's capacity at runtime.
+
+Parity target: the reference's dynamic resources
+(reference: python/ray/experimental/dynamic_resources.py
+set_resource — adjust a custom resource's capacity on a live node so
+schedulable work changes without restarting raylets).
+
+``set_resource(name, capacity)`` targets the local node by default, or
+any node by id. Capacity 0 deletes the resource. The raylet adjusts
+both total and available (available moves by the same delta so leases
+already granted keep their accounting), then re-runs its scheduler
+tick — queued tasks waiting on the new resource dispatch immediately.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import ray_tpu
+
+
+def set_resource(resource_name: str, capacity: float,
+                 node_id: Optional[bytes] = None) -> bool:
+    """Set ``resource_name`` to ``capacity`` on a node (default: the
+    node this driver/worker is attached to). Returns True on success."""
+    if resource_name in ("CPU",):
+        raise ValueError("CPU capacity is fixed at node start "
+                         "(reference: set_resource rejects CPU/GPU)")
+    if capacity < 0:
+        raise ValueError("capacity must be >= 0")
+    w = ray_tpu.worker._require_connected()
+    core = w.core
+
+    async def _go():
+        address = None
+        if node_id is None or node_id == core.node_id:
+            address = core.raylet_address
+        else:
+            reply, _ = await core._gcs_call("GetAllNodeInfo", {})
+            for n in reply["nodes"]:
+                if n["node_id"] == node_id and n["alive"]:
+                    address = n["address"]
+                    break
+        if address is None:
+            raise ValueError(f"no alive node {node_id!r}")
+        from ray_tpu._private import rpc
+
+        if address == core.raylet_address:
+            conn = core.raylet_conn
+            reply, _ = await conn.call("SetResource", {
+                "name": resource_name, "capacity": float(capacity)})
+        else:
+            conn = await rpc.connect(address, peer_name="set-resource")
+            try:
+                reply, _ = await conn.call("SetResource", {
+                    "name": resource_name, "capacity": float(capacity)})
+            finally:
+                await conn.close()
+        return bool(reply.get("ok"))
+
+    return core._run(_go())

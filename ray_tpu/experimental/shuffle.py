@@ -1,0 +1,108 @@
+"""Distributed shuffle harness: all-to-all over the object plane.
+
+Parity target: the reference's shuffle scaling harness
+(reference: python/ray/experimental/shuffle.py:135 — map tasks emit
+per-reducer partitions into the object store, reduce tasks gather
+their partition from every mapper; used to validate 1TB+ shuffles).
+Scaled to this runtime: block sizes and partition counts are
+arguments, the harness reports rows/s and bytes moved, and the
+correctness check (every row lands exactly once) runs by default.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List
+
+import numpy as np
+
+import ray_tpu
+
+
+def _map_block(block_id: int, rows_per_block: int,
+               num_reducers: int, row_bytes: int) -> List:
+    """One mapper: produce this block's rows, partition by
+    hash(row) % reducers, return per-reducer arrays (small enough to
+    inline or large enough to ride plasma — the runtime decides)."""
+    rng = np.random.default_rng(block_id)
+    keys = rng.integers(0, 2**63 - 1, size=rows_per_block,
+                        dtype=np.int64)
+    pad = max(1, row_bytes // 8)
+    parts = []
+    for r in range(num_reducers):
+        sel = keys[keys % num_reducers == r]
+        # row payload: key replicated to the requested row width
+        parts.append(np.repeat(sel[:, None], pad, axis=1))
+    return parts
+
+
+def _reduce_partition(*mapper_parts) -> Dict[str, float]:
+    """One reducer: gather its partition from every mapper."""
+    total_rows = 0
+    total_bytes = 0
+    checksum = np.int64(0)
+    for arr in mapper_parts:
+        total_rows += arr.shape[0]
+        total_bytes += arr.nbytes
+        if arr.size:
+            checksum ^= np.bitwise_xor.reduce(arr[:, 0])
+    return {"rows": float(total_rows), "bytes": float(total_bytes),
+            "checksum": float(checksum % (2**31))}
+
+
+def shuffle(num_mappers: int = 4, num_reducers: int = 4,
+            rows_per_block: int = 100_000, row_bytes: int = 8,
+            verify: bool = True) -> Dict[str, float]:
+    """Run one all-to-all shuffle round; returns throughput stats.
+
+    Data volume = mappers * rows_per_block * row_bytes. Each mapper's
+    output is ``num_returns=num_reducers`` objects, so a reducer pulls
+    exactly one object per mapper — the reference's partition-object
+    topology (shuffle.py ObjectStoreWriter/Reader roles).
+    """
+    mapper = ray_tpu.remote(_map_block).options(
+        num_returns=num_reducers)
+    reducer = ray_tpu.remote(_reduce_partition)
+
+    t0 = time.perf_counter()
+    part_refs = []  # [mapper][reducer]
+    for b in range(num_mappers):
+        refs = mapper.remote(b, rows_per_block, num_reducers, row_bytes)
+        part_refs.append(refs if isinstance(refs, list) else [refs])
+    reduce_refs = [
+        reducer.remote(*[part_refs[m][r] for m in range(num_mappers)])
+        for r in range(num_reducers)]
+    results = ray_tpu.get(reduce_refs)
+    wall = time.perf_counter() - t0
+
+    rows = sum(r["rows"] for r in results)
+    nbytes = sum(r["bytes"] for r in results)
+    out = {
+        "num_mappers": num_mappers,
+        "num_reducers": num_reducers,
+        "rows": rows,
+        "bytes": nbytes,
+        "wall_s": round(wall, 3),
+        "rows_per_s": round(rows / wall, 1),
+        "mb_per_s": round(nbytes / wall / 1e6, 2),
+    }
+    if verify:
+        expected = float(num_mappers * rows_per_block)
+        if rows != expected:
+            raise AssertionError(
+                f"shuffle lost rows: {rows} != {expected}")
+    return out
+
+
+def main() -> None:  # pragma: no cover — manual harness entry
+    import json
+
+    ray_tpu.init()
+    try:
+        print(json.dumps(shuffle()))
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

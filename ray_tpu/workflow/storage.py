@@ -69,6 +69,11 @@ class Storage:
         """Immediate child names under a '/'-delimited prefix."""
         raise NotImplementedError
 
+    def delete(self, key: str) -> None:
+        """Best-effort removal (spill cleanup etc.); missing keys are
+        not an error."""
+        raise NotImplementedError
+
 
 class FilesystemStorage(Storage):
     def __init__(self, base_dir: str):
@@ -97,6 +102,12 @@ class FilesystemStorage(Storage):
             return sorted(os.listdir(self._path(prefix)))
         except FileNotFoundError:
             return []
+
+    def delete(self, key: str) -> None:
+        try:
+            os.unlink(self._path(key))
+        except OSError:
+            pass
 
 
 class KVStorage(Storage):
@@ -139,6 +150,11 @@ class KVStorage(Storage):
             out.add(rest.split("/", 1)[0])
         return sorted(out)
 
+    def delete(self, key: str) -> None:
+        import ray_tpu
+
+        ray_tpu.experimental_internal_kv_del(self._key(key))
+
 
 class S3Storage(Storage):
     """Reference-parity S3 backend (reference: workflow/storage/s3.py).
@@ -172,6 +188,17 @@ class S3Storage(Storage):
             return r["Body"].read()
         except self._s3.exceptions.NoSuchKey:
             return None
+
+    def delete(self, key: str) -> None:  # pragma: no cover
+        import logging
+
+        try:
+            self._s3.delete_object(Bucket=self.bucket,
+                                   Key=self._key(key))
+        except Exception:  # noqa: BLE001 — leak must be visible
+            logging.getLogger(__name__).warning(
+                "s3 delete of %s failed (spill blob may leak)",
+                self._key(key), exc_info=True)
 
     def list_prefix(self, prefix: str) -> List[str]:  # pragma: no cover
         base = self._key(prefix).rstrip("/") + "/"

@@ -1,0 +1,68 @@
+"""Experimental utilities: dynamic resources + the shuffle harness.
+
+Mirrors the reference's coverage (reference:
+python/ray/experimental/dynamic_resources.py used in
+tests/test_dynamic_resources-style flows; experimental/shuffle.py is
+the scaling harness the release suite runs at 1TB)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import experimental
+
+
+@pytest.fixture
+def exp_cluster():
+    # infeasible tasks WAIT for capacity (reference default): the
+    # whole point of dynamic resources
+    ray_tpu.init(num_cpus=2, _system_config={
+        "infeasible_task_policy": "wait"})
+    yield
+    ray_tpu.shutdown()
+
+
+def test_set_resource_unblocks_queued_task(exp_cluster):
+    @ray_tpu.remote(resources={"widget": 1.0})
+    def needs_widget():
+        return "made"
+
+    ref = needs_widget.remote()
+    # not schedulable yet: no node has 'widget'
+    with pytest.raises(Exception):
+        ray_tpu.get(ref, timeout=1.0)
+
+    assert experimental.set_resource("widget", 2.0)
+    assert ray_tpu.get(ref, timeout=30) == "made"
+
+    # capacity 0 deletes: the next widget task queues again (after the
+    # warm lease from the first task expires — lease reuse is scoped to
+    # the scheduling key, not re-checked against live capacity)
+    assert experimental.set_resource("widget", 0.0)
+    import time
+    time.sleep(0.6)
+    ref2 = needs_widget.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(ref2, timeout=1.0)
+    assert experimental.set_resource("widget", 1.0)
+    assert ray_tpu.get(ref2, timeout=30) == "made"
+
+
+def test_set_resource_rejects_cpu(exp_cluster):
+    with pytest.raises(ValueError):
+        experimental.set_resource("CPU", 8.0)
+
+
+def test_shuffle_harness_exact_rows(exp_cluster):
+    out = experimental.shuffle(num_mappers=3, num_reducers=3,
+                               rows_per_block=20_000, row_bytes=8)
+    assert out["rows"] == 3 * 20_000
+    assert out["rows_per_s"] > 0
+    assert out["mb_per_s"] > 0
+
+
+def test_internal_kv_reexports(exp_cluster):
+    experimental.internal_kv_put(b"exp_key", b"v1")
+    assert experimental.internal_kv_get(b"exp_key") == b"v1"
+    assert b"exp_key" in experimental.internal_kv_list(b"exp_")
+    experimental.internal_kv_del(b"exp_key")
+    assert experimental.internal_kv_get(b"exp_key") is None

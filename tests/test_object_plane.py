@@ -55,6 +55,37 @@ def test_spill_and_restore_under_pressure(tmp_path):
         ray_tpu.shutdown()
 
 
+def test_spill_to_external_storage(tmp_path):
+    """Spilling targets a workflow-storage URL instead of the local
+    session dir (reference: external_storage.py:71 — S3 via smart_open;
+    here the same seam with the file:// backend standing in for the
+    cloud bucket): spilled blobs land under the URL, restores read them
+    back, and frees delete them."""
+    import os
+
+    store_dir = tmp_path / "ext_spill"
+    ray_tpu.init(num_cpus=1, object_store_memory=4 * 1024 * 1024,
+                 _system_config={
+                     "spill_external_storage_url": f"file://{store_dir}"})
+    try:
+        mb = 1024 * 1024
+        refs = [ray_tpu.put(np.full(mb // 8, i, dtype=np.float64))
+                for i in range(6)]  # 6 MB into a 4 MB store
+        node = ray_tpu.worker.global_worker.node
+        stats = node.raylet.store.stats()
+        assert stats["num_spills"] >= 1, stats
+        # the spilled blobs are IN the external store, not the session
+        spill_keys = os.listdir(store_dir / "spill")
+        assert len(spill_keys) >= 1
+        # every value still readable — restored from external storage
+        for i, r in enumerate(refs):
+            val = ray_tpu.get(r)
+            assert val[0] == float(i) and len(val) == mb // 8
+        assert node.raylet.store.stats()["num_restores"] >= 1
+    finally:
+        ray_tpu.shutdown()
+
+
 def test_cancel_queued_task():
     """Cancelling a not-yet-running task makes get() raise
     TaskCancelledError (reference: test_cancel.py)."""
