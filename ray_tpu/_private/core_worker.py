@@ -1382,6 +1382,13 @@ class CoreWorker:
                 self._retry_or_fail_after_worker_death(spec, lw.worker_id)
             return
         reply, rbufs = fut.result()
+        # Fast path for the dominant reply shape (ok, one inline
+        # return, no deps/contained refs): batch every memory-store
+        # landing under ONE lock via put_many.
+        pending = self.pending_tasks
+        keep_lineage = self.config.lineage_reconstruction_enabled
+        put_pairs: List[tuple] = []
+        finished = 0
         for spec, (rheader, fstart, nframes) in zip(batch, reply["replies"]):
             if rheader[0] == REPLY_STOLEN:
                 # relinquished by THIS worker via StealTasks; the steal
@@ -1393,7 +1400,25 @@ class CoreWorker:
                     if not victims:
                         del state.reassigned[spec.task_id]
                 continue
+            rets = rheader[1]
+            if rheader[0] == 0 and not spec.args and len(rets) == 1 \
+                    and not rets[0][1] and not rets[0][5]:
+                entry = pending.get(spec.task_id)
+                if entry is None:
+                    continue
+                oid_b, _ip, meta, start, n, _cont = rets[0]
+                # `start` is task-relative; `fstart` locates this
+                # task's frames inside the batch buffer
+                base = fstart + start
+                put_pairs.append((ObjectID(oid_b), SerializedObject(
+                    meta, rbufs[base:base + n])))
+                finished += 1
+                self._finish_pending_entry(spec, entry, keep_lineage)
+                continue
             self._complete_task(spec, rheader, rbufs[fstart:fstart + nframes])
+        if put_pairs:
+            self.memory_store.put_many(put_pairs)
+            self.stats["tasks_finished"] += finished
         # Reuse the lease, steal for it, or (after a grace) return it.
         if state.queue:
             self._pump_scheduling_key(sc, state)
@@ -1429,16 +1454,23 @@ class CoreWorker:
                     obj.contained_refs = contained
                 self.memory_store.put(oid, obj)
         self.stats["tasks_finished"] += 1
+        if spec.args and not spec.is_actor_task():
+            self.reference_counter.update_finished_task_references(
+                [ObjectID(b) for b in spec.dependency_ids()])
+        self._finish_pending_entry(
+            spec, entry, self.config.lineage_reconstruction_enabled)
+
+    def _finish_pending_entry(self, spec: TaskSpec, entry,
+                              keep_lineage: bool) -> None:
+        """Completion tail shared by _complete_task and the batched
+        fast path: wake any recovery waiter, and drop the pending entry
+        unless lineage reconstruction needs it."""
         waiter = entry.recovery_waiter
         if waiter is not None:
             entry.recovery_waiter = None
             if not waiter.done():
                 waiter.set_result(True)
-        if spec.args and not spec.is_actor_task():
-            self.reference_counter.update_finished_task_references(
-                [ObjectID(b) for b in spec.dependency_ids()])
-        # Lineage stays for reconstruction; drop spec args to bound memory.
-        if not self.config.lineage_reconstruction_enabled:
+        if not keep_lineage:
             self.pending_tasks.pop(spec.task_id, None)
 
     def _store_error_for_task(self, spec: TaskSpec, error: BaseException):

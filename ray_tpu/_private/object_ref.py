@@ -14,7 +14,9 @@ from ray_tpu._private.ids import ObjectID
 
 
 class ObjectRef:
-    __slots__ = ("object_id", "owner_address", "_worker", "call_site", "__weakref__")
+    # no __weakref__ slot: nothing weakrefs ObjectRefs, and the header
+    # is per-task allocation cost on the submit hot path
+    __slots__ = ("object_id", "owner_address", "_worker", "call_site")
 
     def __init__(self, object_id: ObjectID, owner_address: str = "",
                  worker=None, skip_adding_local_ref: bool = False,

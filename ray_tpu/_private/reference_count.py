@@ -96,17 +96,20 @@ class ReferenceCounter:
 
     def add_owned_with_local_ref(self, object_id: ObjectID,
                                  pin_lineage: bool = False) -> None:
-        """Fused add_owned_object + add_local_reference: ONE lock round
-        trip on the per-task submit path (callers construct the ObjectRef
-        with skip_adding_local_ref=True)."""
-        with self._lock:
-            ref = self._refs.get(object_id)
-            if ref is None:
-                ref = self._refs[object_id] = Reference()
-            ref.owned = True
-            ref.owner_address = self.own_address
-            ref.local_refs += 1
-            ref.pinned_lineage = pin_lineage
+        """Fused add_owned_object + add_local_reference, LOCK-FREE on
+        the per-task submit path: the id was freshly minted by the
+        caller, so no other thread can reach this entry until the
+        submission lands on the IO loop — dict get/insert are
+        GIL-atomic, and concurrent mutations of OTHER keys don't
+        interleave with them (callers construct the ObjectRef with
+        skip_adding_local_ref=True)."""
+        ref = self._refs.get(object_id)
+        if ref is None:
+            ref = self._refs[object_id] = Reference()
+        ref.owned = True
+        ref.owner_address = self.own_address
+        ref.local_refs += 1
+        ref.pinned_lineage = pin_lineage
 
     def add_borrowed_object(self, object_id: ObjectID, owner_address: str) -> bool:
         """Returns True if this is the first borrow (caller should notify
@@ -301,23 +304,25 @@ class ReferenceCounter:
             return len(self._refs)
 
     def debug_summary(self) -> dict:
-        with self._lock:
-            return {
-                "tracked": len(self._refs),
-                "owned": sum(1 for r in self._refs.values() if r.owned),
-                "borrowed": sum(1 for r in self._refs.values()
-                                if not r.owned and r.owner_address),
-            }
+        # snapshot first: add_owned_with_local_ref inserts WITHOUT the
+        # lock (submit hot path), so a live .values() iteration could
+        # see a resize; list(dict.values()) is one atomic C call
+        refs = list(self._refs.values())
+        return {
+            "tracked": len(refs),
+            "owned": sum(1 for r in refs if r.owned),
+            "borrowed": sum(1 for r in refs
+                            if not r.owned and r.owner_address),
+        }
 
     def all_refs(self) -> Dict[str, dict]:
-        with self._lock:
-            return {
-                oid.hex(): {
-                    "owned": r.owned,
-                    "local_refs": r.local_refs,
-                    "submitted_refs": r.submitted_refs,
-                    "borrowers": sorted(r.borrowers or ()),
-                    "in_plasma": r.in_plasma,
-                }
-                for oid, r in self._refs.items()
+        return {
+            oid.hex(): {
+                "owned": r.owned,
+                "local_refs": r.local_refs,
+                "submitted_refs": r.submitted_refs,
+                "borrowers": sorted(r.borrowers or ()),
+                "in_plasma": r.in_plasma,
             }
+            for oid, r in list(self._refs.items())
+        }

@@ -20,6 +20,7 @@ import os
 import queue as queue_mod
 import threading
 import traceback
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -64,30 +65,34 @@ def _exec_span(spec: TaskSpec):
 
 
 class _BatchState:
-    """Reply aggregation for one pushed task batch: a slot array with a
-    lock-protected countdown, resolved into the single batch reply on
-    the IO loop when the last slot lands. Replaces one asyncio.Future +
-    done-callback PER TASK (profiled at several us/task) with one lock
-    acquire per task and ONE loop wakeup per batch. Slots complete from
-    the exec thread (run/error) or the IO loop (stolen/cancelled)."""
+    """Reply aggregation for one pushed task batch, resolved into the
+    single batch reply on the IO loop when the last slot lands.
+    Replaces one asyncio.Future + done-callback PER TASK (profiled at
+    several us/task). LOCK-FREE: slot claiming is dict.setdefault
+    (GIL-atomic, first writer wins — the steal-vs-exec race), the
+    countdown is deque-append + len (both atomic); a photo-finish can
+    make both completers observe fullness, which _resolve absorbs via
+    fut.done(). Slots complete from the exec thread (run/error) or the
+    IO loop (stolen/cancelled)."""
 
-    __slots__ = ("fut", "slots", "remaining", "lock", "loop")
+    __slots__ = ("fut", "slots", "n", "_done_counter", "loop")
 
     def __init__(self, loop, n: int):
         self.fut = loop.create_future()
-        self.slots: List[Optional[tuple]] = [None] * n
-        self.remaining = n
-        self.lock = threading.Lock()
+        self.slots: Dict[int, tuple] = {}
+        self.n = n
+        self._done_counter: deque = deque()
         self.loop = loop
 
+    @property
+    def remaining(self) -> int:
+        return self.n - len(self._done_counter)
+
     def complete(self, i: int, reply: tuple) -> None:
-        with self.lock:
-            if self.slots[i] is not None:
-                return  # raced (e.g. steal vs. exec): first wins
-            self.slots[i] = reply
-            self.remaining -= 1
-            done = self.remaining == 0
-        if done:
+        if self.slots.setdefault(i, reply) is not reply:
+            return  # raced (e.g. steal vs. exec): first wins
+        self._done_counter.append(None)
+        if len(self._done_counter) == self.n:
             self.loop.call_soon_threadsafe(self._resolve)
 
     def _resolve(self) -> None:
@@ -95,7 +100,8 @@ class _BatchState:
             return
         rheaders = []
         rframes: List[bytes] = []
-        for rh, rfr in self.slots:
+        for i in range(self.n):
+            rh, rfr = self.slots[i]
             rheaders.append([rh, len(rframes), len(rfr)])
             rframes.extend(rfr)
         self.fut.set_result(({"replies": rheaders}, rframes))
@@ -107,44 +113,64 @@ class StealableQueue:
     Backs the work-stealing protocol (reference: StealTasks in
     direct_task_transport.h:57 — queued-but-unstarted tasks move off a
     busy worker): the execution thread pops from the head one task at a
-    time, so everything still queued here is fair game for a thief."""
+    time, so everything still queued here is fair game for a thief.
+
+    LOCK-FREE fast path: deque.popleft/append/pop are GIL-atomic, so
+    the per-item cost is one C call; the condition variable only comes
+    out when the consumer finds the queue empty. The missed-notify
+    window is closed because the producer's ``with self._cv`` cannot be
+    entered until the consumer's wait() has released the lock — see
+    tests/test_concurrency.py for the adversarial coverage."""
 
     def __init__(self):
-        import collections
-
-        self._dq = collections.deque()
+        self._dq = deque()
         self._cv = threading.Condition()
+        self._waiting = False
 
     def put(self, item):
-        with self._cv:
-            self._dq.append(item)
-            self._cv.notify()
+        self._dq.append(item)
+        if self._waiting:
+            with self._cv:
+                self._cv.notify()
 
     def get(self):
+        try:
+            return self._dq.popleft()  # hot path: no lock
+        except IndexError:
+            pass
         with self._cv:
-            while not self._dq:
-                self._cv.wait()
-            return self._dq.popleft()
+            self._waiting = True
+            try:
+                while True:
+                    try:
+                        return self._dq.popleft()
+                    except IndexError:
+                        self._cv.wait()
+            finally:
+                self._waiting = False
 
     def get_nowait(self):
-        with self._cv:
-            if not self._dq:
-                raise queue_mod.Empty
+        try:
             return self._dq.popleft()
+        except IndexError:
+            raise queue_mod.Empty from None
 
     def empty(self) -> bool:
-        with self._cv:
-            return not self._dq
+        return not self._dq
 
     def steal(self, max_n: int):
         """Pop up to max_n items from the TAIL (newest first), returned
-        in original submission order."""
-        with self._cv:
-            out = []
-            while self._dq and len(out) < max_n:
+        in original submission order. Tail pops race benignly with the
+        consumer's head pops: on a one-item deque exactly one side wins
+        (the loser's IndexError is absorbed)."""
+        out = []
+        while len(out) < max_n:
+            try:
                 out.append(self._dq.pop())
-            out.reverse()
-            return out
+            except IndexError:
+                break
+        out.reverse()
+        return out
 
 
 class TaskExecutor:

@@ -67,8 +67,11 @@ class RemoteFunction:
         if self._fn_key is None:
             self._fn_key, self._pickled = \
                 core.function_manager.prepare(self._function)
-        core.function_manager.export_prepickled(
-            self._fn_key, self._pickled, self._function)
+        if self._template is None or self._template[0] is not core:
+            # once per (core, fn): the template cache below implies the
+            # export happened for this core already
+            core.function_manager.export_prepickled(
+                self._fn_key, self._pickled, self._function)
         if not hasattr(core, "make_task_template"):
             # ray:// client core: no template fast path — submit per call
             call_args = list(args)

@@ -98,7 +98,7 @@ def test_batch_state_slots_resolve_once():
                 time.sleep(0.001)
             assert batch.fut.done()
             assert batch.remaining == 0
-            assert all(s is not None for s in batch.slots)
+            assert sorted(batch.slots) == list(range(n))
     finally:
         loop.call_soon_threadsafe(loop.stop)
         loop_thread.join(5)

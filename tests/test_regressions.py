@@ -136,3 +136,27 @@ def test_borrower_registration(ray_start_regular):
     assert seen, "owner never learned about the borrower"
     del ref  # owner's local ref drops; borrower keeps it alive
     assert ray_tpu.get(h.read.remote()) == 12345
+
+
+def test_batched_no_arg_replies_keep_distinct_values(ray_start_regular):
+    """Multiple NO-ARG tasks with DISTINCT returns pushed as one batch:
+    each ref must land its own bytes (regression: the batched
+    completion fast path sliced reply frames with a task-relative
+    offset against the whole batch buffer, giving every task the first
+    task's value)."""
+    @ray_tpu.remote
+    def stamped():
+        # worker-global counter: every execution returns a distinct
+        # value with NO task args (args disable the fast path)
+        import builtins
+        import itertools
+        c = getattr(builtins, "_rtpu_test_counter", None)
+        if c is None:
+            c = builtins._rtpu_test_counter = itertools.count()
+        import os
+        return (os.getpid(), next(c))
+
+    refs = [stamped.remote() for _ in range(200)]
+    values = ray_tpu.get(refs)
+    assert len(set(values)) == 200, (
+        f"{200 - len(set(values))} duplicated replies")
