@@ -1837,6 +1837,15 @@ class CoreWorker:
         period = self.config.metrics_report_period_ms / 1000.0
         while not self._shutdown:
             await asyncio.sleep(period)
+            # reap shm mappings whose last zero-copy consumer view has
+            # been garbage-collected since the store detached (the
+            # park-and-sweep half of the view-release discipline —
+            # shm_store._QuietSharedMemory)
+            try:
+                from ray_tpu._private import shm_store
+                shm_store.sweep_zombies()
+            except Exception:  # noqa: BLE001 — maintenance must not die
+                pass
             if self._task_events and self.gcs_conn and not self.gcs_conn.closed:
                 events, self._task_events = self._task_events, []
                 wid = self.worker_id.hex()

@@ -42,15 +42,27 @@ _zombie_lock = threading.Lock()
 _zombies: List[shared_memory.SharedMemory] = []
 
 
+def zombie_count() -> int:
+    """Parked mappings still pinned by live consumer views (a gauge:
+    steadily growing means user code holds zero-copy values forever)."""
+    with _zombie_lock:
+        return len(_zombies)
+
+
 class _QuietSharedMemory(shared_memory.SharedMemory):
     """A SharedMemory whose close() tolerates live zero-copy consumers.
 
-    Deserialized arrays (pickle5 out-of-band buffers) may still view the
-    mapping when the store detaches; mmap.close() then raises BufferError.
-    Instead of surfacing that (or letting __del__ print it), the segment is
-    parked in a zombie list and reaped by sweep_zombies() once the consumers
-    are gone. Reference discipline: plasma client Release
-    (src/ray/object_manager/plasma/client.cc)."""
+    The view-release discipline here IS reference counting — by the
+    mmap's own buffer-export counter: every deserialized array views a
+    frame memoryview which views the mapping, so the mapping cannot be
+    (and must not be) unmapped while any such value is alive. close()
+    called while exports exist raises BufferError; the segment is
+    parked in a zombie list and reaped by sweep_zombies() — on
+    attach/detach AND periodically from the core worker's maintenance
+    loop — the moment the last consumer view is garbage-collected.
+    Reference discipline: plasma client Release
+    (src/ray/object_manager/plasma/client.cc) — there the refcount is
+    explicit; here the buffer protocol keeps it for us."""
 
     def close(self):  # noqa: D102 - see class docstring
         try:
@@ -396,4 +408,6 @@ class ShmStoreServer:
             "num_evictions": self.num_evictions,
             "num_spills": self.num_spills,
             "num_restores": self.num_restores,
+            # consumer-pinned mappings awaiting their views' GC
+            "num_zombie_mappings": zombie_count(),
         }

@@ -60,6 +60,7 @@ class ReplicaSet:
 
         timeout_s = 30.0 if timeout_s is None else timeout_s
         deadline = time.monotonic() + timeout_s
+        grace_pick_used = False
         while True:
             if not self._have_members.wait(
                     timeout=max(0.0, deadline - time.monotonic())):
@@ -99,17 +100,21 @@ class ReplicaSet:
             else:
                 # No pickable slot and nothing in flight: membership
                 # flapped mid-roll. Sleep until the next long-poll push
-                # (bounded so the deadline still applies). A push that
-                # lands at the wire re-attempts the pick even past the
-                # deadline — only a silent timeout raises.
+                # (bounded so the deadline still applies). A push
+                # landing at the wire earns exactly ONE post-deadline
+                # re-pick — so a replica restored at the buzzer is
+                # served, but continuous flapping (or another caller
+                # consuming the shared event) can't starve the timeout.
                 signaled = self._membership_changed.wait(
                     timeout=min(1.0, max(0.01,
                                          deadline - time.monotonic())))
-                if not signaled and time.monotonic() >= deadline:
-                    raise RuntimeError(
-                        f"timed out after {timeout_s}s waiting for a "
-                        f"usable replica on deployment "
-                        f"{self.deployment_name!r}")
+                if time.monotonic() >= deadline:
+                    if not signaled or grace_pick_used:
+                        raise RuntimeError(
+                            f"timed out after {timeout_s}s waiting for "
+                            f"a usable replica on deployment "
+                            f"{self.deployment_name!r}")
+                    grace_pick_used = True
 
     def _prune_locked(self, rid: str) -> List[ObjectRef]:
         """Drop completed refs from one replica's book (holds lock)."""

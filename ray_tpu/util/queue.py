@@ -55,13 +55,18 @@ class _QueueActor:
 
     async def put_block(self, item: Any,
                         timeout: Optional[float]) -> bool:
-        """Wait (server-side) for room, then append. False on timeout."""
+        """Wait (server-side) for room, then append. False on timeout.
+        The predicate is checked BEFORE the timeout applies — timeout=0
+        with room available succeeds (stdlib queue semantics)."""
         async with self._cond:
-            try:
-                await asyncio.wait_for(
-                    self._cond.wait_for(self._has_room), timeout)
-            except asyncio.TimeoutError:
-                return False
+            if not self._has_room():
+                if timeout is not None and timeout <= 0:
+                    return False
+                try:
+                    await asyncio.wait_for(
+                        self._cond.wait_for(self._has_room), timeout)
+                except asyncio.TimeoutError:
+                    return False
             self.items.append(item)
             self._cond.notify_all()
             return True
@@ -86,13 +91,19 @@ class _QueueActor:
         return out
 
     async def get_block(self, timeout: Optional[float]):
-        """Wait (server-side) for an item. None on timeout."""
+        """Wait (server-side) for an item. None on timeout. The
+        predicate is checked BEFORE the timeout applies — timeout=0
+        with items present succeeds (stdlib queue semantics)."""
         async with self._cond:
-            try:
-                await asyncio.wait_for(
-                    self._cond.wait_for(lambda: bool(self.items)), timeout)
-            except asyncio.TimeoutError:
-                return None
+            if not self.items:
+                if timeout is not None and timeout <= 0:
+                    return None
+                try:
+                    await asyncio.wait_for(
+                        self._cond.wait_for(lambda: bool(self.items)),
+                        timeout)
+                except asyncio.TimeoutError:
+                    return None
             item = self.items.popleft()
             self._cond.notify_all()
             return [item]
