@@ -129,7 +129,8 @@ class Deployment:
                  user_config: Any = None,
                  ray_actor_options: Optional[Dict] = None,
                  init_args: tuple = (), init_kwargs: Optional[dict] = None,
-                 route_prefix: Optional[str] = "__default__"):
+                 route_prefix: Optional[str] = "__default__",
+                 autoscaling_config: Optional[Dict] = None):
         self._func_or_class = func_or_class
         self.name = name
         self.num_replicas = num_replicas
@@ -141,6 +142,10 @@ class Deployment:
         self.init_kwargs = init_kwargs or {}
         # "__default__" → /<name>; None → not HTTP-routable (handle-only)
         self.route_prefix = route_prefix
+        # reference: autoscaling_policy.py BasicAutoscalingPolicy keys
+        # (min/max_replicas, scale_up/down_threshold, *_consecutive_
+        # periods, scale_up/down_num_replicas); None = fixed replicas
+        self.autoscaling_config = autoscaling_config
 
     def options(self, **overrides) -> "Deployment":
         cfg = {
@@ -151,6 +156,7 @@ class Deployment:
             "init_args": self.init_args,
             "init_kwargs": dict(self.init_kwargs),
             "route_prefix": self.route_prefix,
+            "autoscaling_config": self.autoscaling_config,
         }
         cfg.update(overrides)
         return Deployment(self._func_or_class, **cfg)
@@ -168,7 +174,8 @@ class Deployment:
             version=self.version or uuid.uuid4().hex,
             user_config=self.user_config,
             ray_actor_options=self.ray_actor_options,
-            route_prefix=self.route_prefix))
+            route_prefix=self.route_prefix,
+            autoscaling_config=self.autoscaling_config))
         _wait_http_route(self.name, self.route_prefix)
 
     def delete(self) -> None:
@@ -204,7 +211,8 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_concurrent_queries: int = 100,
                version: Optional[str] = None, user_config: Any = None,
                ray_actor_options: Optional[Dict] = None,
-               route_prefix: Optional[str] = "__default__"):
+               route_prefix: Optional[str] = "__default__",
+               autoscaling_config: Optional[Dict] = None):
     """``@serve.deployment`` decorator (bare or with options)."""
     def wrap(func_or_class):
         return Deployment(
@@ -214,7 +222,8 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
             max_concurrent_queries=max_concurrent_queries,
             version=version, user_config=user_config,
             ray_actor_options=ray_actor_options,
-            route_prefix=route_prefix)
+            route_prefix=route_prefix,
+            autoscaling_config=autoscaling_config)
 
     if _func_or_class is not None:
         return wrap(_func_or_class)
@@ -234,7 +243,8 @@ def get_deployment(name: str) -> Deployment:
         version=info["version"], user_config=info["user_config"],
         ray_actor_options=info["ray_actor_options"],
         init_args=info["init_args"], init_kwargs=info["init_kwargs"],
-        route_prefix=info.get("route_prefix"))
+        route_prefix=info.get("route_prefix"),
+        autoscaling_config=info.get("autoscaling_config"))
     return dep
 
 

@@ -43,6 +43,10 @@ class ServeController:
         self._replicas: Dict[str, List[dict]] = {}
         self._next_replica_id = 0
         self._reconciling: Dict[str, asyncio.Lock] = {}
+        # autoscaling: per-deployment consecutive-decision counters
+        # (reference: autoscaling_policy.py BasicAutoscalingPolicy)
+        self._scale_counters: Dict[str, int] = {}
+        self._autoscale_task: Optional[asyncio.Task] = None
 
     # ---- long-poll host passthrough (routers call this) ----
 
@@ -58,7 +62,8 @@ class ServeController:
                      version: Optional[str] = None,
                      user_config: Any = None,
                      ray_actor_options: Optional[dict] = None,
-                     route_prefix: Optional[str] = "__default__") -> None:
+                     route_prefix: Optional[str] = "__default__",
+                     autoscaling_config: Optional[dict] = None) -> None:
         """Create or update a deployment and reconcile to the new goal."""
         version = version or "1"
         if route_prefix == "__default__":
@@ -88,7 +93,17 @@ class ServeController:
             "user_config": user_config,
             "ray_actor_options": dict(ray_actor_options or {}),
             "route_prefix": route_prefix,
+            "autoscaling_config": dict(autoscaling_config)
+            if autoscaling_config else None,
         }
+        self._scale_counters.pop(name, None)  # fresh hysteresis per deploy
+        if autoscaling_config:
+            cfg = self._configs[name]
+            lo, hi = self._bounds(autoscaling_config)
+            cfg["num_replicas"] = max(lo, min(cfg["num_replicas"], hi))
+            if self._autoscale_task is None or self._autoscale_task.done():
+                self._autoscale_task = asyncio.get_running_loop().\
+                    create_task(self._autoscale_loop())
         # Reconcile BEFORE announcing the route: when the proxy learns a
         # new route and bootstraps its replica snapshot, replicas must
         # already be serving (reference ordering: backend_state goal
@@ -98,6 +113,7 @@ class ServeController:
 
     async def delete_deployment(self, name: str) -> None:
         self._configs.pop(name, None)
+        self._scale_counters.pop(name, None)
         await self._notify_routes()
         await self._reconcile(name)
 
@@ -219,6 +235,79 @@ class ServeController:
         self._replicas[name] = current
         await self._notify(name)  # switch routers to the new set...
         await self._drain_and_kill(outdated + extra)  # ...then drain old
+
+    # ---- autoscaling (reference: serve/autoscaling_policy.py
+    # BasicAutoscalingPolicy driven from the controller loop) ----
+
+    async def _autoscale_loop(self) -> None:
+        while any(cfg.get("autoscaling_config")
+                  for cfg in self._configs.values()):
+            await asyncio.sleep(0.25)
+            for name in list(self._configs):
+                try:
+                    await self._autoscale_one(name)
+                except Exception:  # noqa: BLE001 — loop must survive
+                    logger.exception("autoscale of %s failed", name)
+        self._autoscale_task = None
+
+    @staticmethod
+    def _bounds(ac: dict) -> tuple:
+        """(min, max) replica bounds; max_replicas <= 0 = unbounded."""
+        lo = int(ac.get("min_replicas", 1))
+        hi = ac.get("max_replicas", -1)
+        return lo, (int(hi) if hi and int(hi) > 0 else 10**9)
+
+    async def _autoscale_one(self, name: str) -> None:
+        cfg = self._configs.get(name)
+        ac = cfg.get("autoscaling_config") if cfg else None
+        if not ac:
+            return
+        replicas = self._replicas.get(name, [])
+        if not replicas:
+            return
+        # concurrent polls: one slow replica must not serialize the
+        # pass (and through the shared loop, every OTHER deployment)
+        results = await asyncio.gather(
+            *[asyncio.wait_for(_as_coro(r["handle"].stats.remote()),
+                               timeout=5.0) for r in replicas],
+            return_exceptions=True)
+        inflight = 0
+        responsive = 0
+        for res in results:
+            if isinstance(res, BaseException):
+                continue  # unresponsive != idle: excluded entirely
+            responsive += 1
+            inflight += int(res.get("inflight", 0))
+        if responsive == 0:
+            return  # no signal this round: never scale blind
+        avg = inflight / responsive
+        # the router caps replica concurrency at max_concurrent_queries,
+        # so a threshold above the cap could never fire — saturation
+        # must always count as scale-up pressure
+        up_thresh = min(float(ac.get("scale_up_threshold", 5)),
+                        float(cfg["max_concurrent_queries"]))
+        down_thresh = float(ac.get("scale_down_threshold", 1))
+        counter = self._scale_counters.get(name, 0)
+        if avg >= up_thresh:
+            counter = max(1, counter + 1)
+        elif avg <= down_thresh:
+            counter = min(-1, counter - 1)
+        else:
+            counter = 0
+        lo, hi = self._bounds(ac)
+        want = cfg["num_replicas"]
+        if counter >= int(ac.get("scale_up_consecutive_periods", 2)):
+            want = min(hi, want + int(ac.get("scale_up_num_replicas", 1)))
+            counter = 0
+        elif -counter >= int(ac.get("scale_down_consecutive_periods", 5)):
+            want = max(lo, want - int(ac.get("scale_down_num_replicas", 1)))
+            counter = 0
+        self._scale_counters[name] = counter
+        if want != cfg["num_replicas"]:
+            logger.info("autoscaling %s: %d -> %d replicas (avg load %.2f)",
+                        name, cfg["num_replicas"], want, avg)
+            cfg["num_replicas"] = want
+            await self._reconcile(name)
 
     async def _drain_and_kill(self, replicas: List[dict]) -> None:
         import ray_tpu

@@ -30,6 +30,12 @@ class Replica:
         """Health check the controller awaits before routing traffic."""
         return "ok"
 
+    async def stats(self) -> dict:
+        """Load signal for the controller's autoscaler (reference:
+        autoscaling_policy.py scale() consumes per-router queue lens —
+        here the replica self-reports concurrency)."""
+        return {"inflight": self._inflight}
+
     async def handle_request(self, method: str, args: tuple,
                              kwargs: dict):
         # Note: a DRAINING replica still serves — a router that raced

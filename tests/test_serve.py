@@ -303,3 +303,56 @@ def test_http_rolling_update_drops_no_requests(serve_cluster):
             break
         time.sleep(0.2)
     assert body == b"v2", body
+
+
+def test_autoscaling_scales_up_and_down(serve_cluster):
+    """Deployment autoscaling from replica load (reference:
+    serve/autoscaling_policy.py BasicAutoscalingPolicy — queue-length
+    thresholds with consecutive-period hysteresis, driven by the
+    controller): sustained concurrent load grows the replica set within
+    max_replicas; idle shrinks it back to min_replicas."""
+    import ray_tpu
+
+    @serve.deployment(max_concurrent_queries=2, autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3,
+        "scale_up_threshold": 1, "scale_up_consecutive_periods": 2,
+        "scale_down_threshold": 0, "scale_down_consecutive_periods": 3,
+        "scale_up_num_replicas": 1,
+    })
+    class Slow:
+        async def __call__(self, t):
+            import asyncio
+            await asyncio.sleep(t)
+            return "done"
+
+    Slow.deploy()
+    handle = Slow.get_handle()
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+
+    def replica_count():
+        snap = ray_tpu.get(
+            controller.get_replica_snapshot.remote("Slow"))
+        return len(snap["replicas"])
+
+    assert replica_count() == 1
+    # sustained load: keep ~6 slow requests in flight for several
+    # autoscale periods (0.25s each)
+    refs = [handle.remote(6.0) for _ in range(6)]
+    deadline = time.monotonic() + 30
+    grown = 1
+    while time.monotonic() < deadline:
+        grown = max(grown, replica_count())
+        if grown >= 2:
+            break
+        time.sleep(0.2)
+    assert grown >= 2, f"never scaled up (replicas={grown})"
+    assert grown <= 3  # max_replicas respected
+    ray_tpu.get(refs, timeout=60)
+
+    # idle: scale back down to min_replicas
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if replica_count() == 1:
+            break
+        time.sleep(0.2)
+    assert replica_count() == 1, "never scaled back down"
