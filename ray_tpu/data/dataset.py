@@ -356,6 +356,16 @@ class Dataset:
         return (jnp.asarray(b) for b in self.iter_batches(
             batch_size=batch_size, batch_format="numpy"))
 
+    def to_torch(self, *, batch_size: Optional[int] = None):
+        """Torch tensors (reference: python/ray/data/dataset.py:1047 to_torch):
+        the whole dataset (batch_size=None) or an iterator of batches."""
+        import torch
+
+        if batch_size is None:
+            return torch.as_tensor(self.to_numpy())
+        return (torch.as_tensor(b) for b in self.iter_batches(
+            batch_size=batch_size, batch_format="numpy"))
+
     # ------------------------------------------------------- pipeline
 
     def window(self, *, blocks_per_window: int = 2):

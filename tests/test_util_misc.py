@@ -211,3 +211,41 @@ def test_job_runtime_env_reaches_nested_tasks(tmp_path):
         assert ray_tpu.get(outer.remote()) == "yes"
     finally:
         ray_tpu.shutdown()
+
+
+def test_joblib_backend_runs_on_cluster(mp_cluster):
+    """joblib.parallel_backend('ray_tpu') routes batches to cluster
+    tasks (reference: util/joblib/ register_ray)."""
+    import os
+
+    joblib = pytest.importorskip("joblib")
+
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+
+    def f(i):
+        import os as _os
+        return (i * i, _os.getpid())
+
+    with joblib.parallel_backend("ray_tpu"):
+        out = joblib.Parallel(n_jobs=4)(
+            joblib.delayed(f)(i) for i in range(20))
+    values = [v for v, _ in out]
+    pids = {p for _, p in out}
+    assert values == [i * i for i in range(20)]
+    assert os.getpid() not in pids  # ran in workers, not the driver
+
+
+def test_dataset_to_torch(mp_cluster):
+    """to_torch parity (reference: python/ray/data/dataset.py:1047)."""
+    torch = pytest.importorskip("torch")
+
+    from ray_tpu import data
+
+    ds = data.from_items(list(range(32)))
+    t = ds.to_torch()
+    assert isinstance(t, torch.Tensor) and int(t.sum()) == sum(range(32))
+    batches = list(ds.to_torch(batch_size=10))
+    assert [len(b) for b in batches] == [10, 10, 10, 2]
+    assert all(isinstance(b, torch.Tensor) for b in batches)
