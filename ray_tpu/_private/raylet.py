@@ -15,6 +15,14 @@ Protocol surface (all framed-msgpack RPC, see rpc.py):
   GCS       : ScheduleActorCreation, KillActorWorker, PreparePGBundle,
               CommitPGBundle, ReturnPGBundle, DrainSelf
   raylets   : FetchObject (remote pull)
+  ops       : GetNodeStats, GetLogs, DumpWorkerStacks, SetResource
+
+The reference's per-node dashboard/runtime-env AGENT process
+(dashboard/agent.py + raylet/agent_manager.h:43) is folded INTO this
+raylet by design: runtime envs (working_dir packages, pip installs)
+materialize lazily in workers keyed by env hash, and the agent's
+stats/log/stack serving is the ops RPC surface above — one less
+process per node, same capabilities.
 """
 
 from __future__ import annotations
