@@ -38,6 +38,9 @@ BASELINE_TASKS_ASYNC = 13546.95   # reference microbenchmark.txt:10
 BASELINE_TASKS_SYNC = 1488.59     # microbenchmark.txt:9
 BASELINE_MULTI_CLIENT = 39337.9   # microbenchmark.txt:11
 BASELINE_ACTOR_ASYNC = 5904.3     # microbenchmark.txt:13
+BASELINE_ACTOR_SYNC = 2192.24      # microbenchmark.txt:12
+BASELINE_ACTOR_NN = 41153.18       # microbenchmark.txt:16
+BASELINE_ASYNC_ACTOR = 3350.12     # microbenchmark.txt:19
 BASELINE_PUT_PER_S = 37315.16     # microbenchmark.txt:2
 BASELINE_PUT_GBPS = 19.3          # microbenchmark.txt:7
 BASELINE_MILLION_S = 154.0        # scalability/single_node.txt
@@ -106,12 +109,56 @@ def main():
             ray_tpu.get(small_task.remote())
         return n_sync
 
+    @ray_tpu.remote
+    class AsyncCounter:
+        def __init__(self):
+            self.n = 0
+
+        async def ping(self):
+            self.n += 1
+            return self.n
+
     counter = Counter.remote()
     ray_tpu.get(counter.ping.remote())
 
     def bench_actor_async():
         ray_tpu.get([counter.ping.remote() for _ in range(n_tasks)])
         return n_tasks
+
+    n_actor_sync = max(100, n_tasks // 10)
+
+    def bench_actor_sync():
+        for _ in range(n_actor_sync):
+            ray_tpu.get(counter.ping.remote())
+        return n_actor_sync
+
+    aio = AsyncCounter.remote()
+    ray_tpu.get(aio.ping.remote())
+
+    def bench_async_actor():
+        ray_tpu.get([aio.ping.remote() for _ in range(n_tasks)])
+        return n_tasks
+
+    # n:n — the reference shape (ray_perf.py actor_multi2): cpu/2
+    # actors, m driver TASKS each fanning calls over all of them from
+    # worker processes. The 41k baseline ran 32 actors on 64 cores;
+    # this box has ONE core, so the row measures contention behavior,
+    # not scaling headroom (see hardware note in extras).
+    nn = max(1, (os.cpu_count() or 1) // 2)
+    nn_m = 4
+    nn_actors = [Counter.remote() for _ in range(nn)]
+    ray_tpu.get([a.ping.remote() for a in nn_actors])
+
+    @ray_tpu.remote
+    def nn_work(actors, k):
+        ray_tpu.get([actors[i % len(actors)].ping.remote()
+                     for i in range(k)])
+
+    def bench_actor_nn():
+        per = n_tasks
+        ray_tpu.get([nn_work.remote(nn_actors, per)
+                     for _ in range(nn_m)])
+        return per * nn_m
 
     def bench_puts():
         refs = [ray_tpu.put(i) for i in range(n_tasks)]
@@ -146,6 +193,12 @@ def main():
     tasks_sync_per_s = timeit(bench_tasks_sync, warmup=0, repeat=2)
     _trace("actor_async")
     actor_per_s = timeit(bench_actor_async)
+    _trace("actor_sync")
+    actor_sync_per_s = timeit(bench_actor_sync, warmup=0, repeat=2)
+    _trace("async_actor")
+    async_actor_per_s = timeit(bench_async_actor)
+    _trace("actor_nn")
+    actor_nn_per_s = timeit(bench_actor_nn, warmup=0, repeat=2)
     _trace("puts")
     puts_per_s = timeit(bench_puts)
     _trace("put_gb")
@@ -259,6 +312,18 @@ def main():
                 multi_per_s / BASELINE_MULTI_CLIENT, 4),
             "actor_calls_async_per_s": round(actor_per_s, 1),
             "actor_vs_baseline": round(actor_per_s / BASELINE_ACTOR_ASYNC, 4),
+            "actor_calls_sync_per_s": round(actor_sync_per_s, 1),
+            "actor_sync_vs_baseline": round(
+                actor_sync_per_s / BASELINE_ACTOR_SYNC, 4),
+            "async_actor_calls_per_s": round(async_actor_per_s, 1),
+            "async_actor_vs_baseline": round(
+                async_actor_per_s / BASELINE_ASYNC_ACTOR, 4),
+            "actor_calls_nn_per_s": round(actor_nn_per_s, 1),
+            "actor_nn_vs_baseline": round(
+                actor_nn_per_s / BASELINE_ACTOR_NN, 4),
+            "actor_nn_hardware_note": (
+                f"baseline ran 32 actors over 64 cores; this box has "
+                f"{os.cpu_count()} core(s) ({nn} actors here)"),
             "puts_per_s": round(puts_per_s, 1),
             "puts_vs_baseline": round(puts_per_s / BASELINE_PUT_PER_S, 4),
             "put_gb_per_s": round(put_gbps, 2),
