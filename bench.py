@@ -12,6 +12,9 @@ Rows vs BASELINE.md:
   - single client tasks sync   (1,488.59/s)
   - multi client tasks async   (39,337.9/s)
   - 1:1 actor calls async      (5,904.3/s)
+  - 1:1 actor calls sync       (2,192.24/s)
+  - 1:1 async-actor calls      (3,350.12/s)
+  - n:n actor calls async      (41,152.98/s)
   - single client put          (37,315.16/s)
   - single client put GB/s     (19.3 GB/s)
   - 1M-task drain              (154.0 s) + p50/p99 task sojourn latency
@@ -125,12 +128,10 @@ def main():
         ray_tpu.get([counter.ping.remote() for _ in range(n_tasks)])
         return n_tasks
 
-    n_actor_sync = max(100, n_tasks // 10)
-
     def bench_actor_sync():
-        for _ in range(n_actor_sync):
+        for _ in range(n_sync):
             ray_tpu.get(counter.ping.remote())
-        return n_actor_sync
+        return n_sync
 
     aio = AsyncCounter.remote()
     ray_tpu.get(aio.ping.remote())

@@ -59,7 +59,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
     """One (b, h, qi, ki) grid step of online-softmax attention.
 
     q_ref [1,1,bq,D]; k_ref/v_ref [1,1,bk,D]; o_ref [1,1,bq,D];
-    lse_ref [1,1,bq] per-row logsumexp (the backward's softmax key).
+    lse_ref [1,1,bq,1] per-row logsumexp (the backward's softmax key;
+    the trailing singleton keeps the block's last-two dims Mosaic-legal:
+    (bq, 1) = sublane-divisible x whole-array lane dim).
     Scratch (VMEM, persists across the innermost ki axis):
       m_ref/l_ref [bq, _LANES] lane-replicated running max / denom,
       acc_ref [bq, D] running numerator.
@@ -112,7 +114,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
         l = l_ref[:, :1]
         l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
-        lse_ref[0, 0] = m_ref[:, 0] + jnp.log(l[:, 0])
+        lse_ref[0, 0] = m_ref[:, :1] + jnp.log(l)
 
 
 def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
@@ -133,7 +135,7 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
     out, lse = pl.pallas_call(
         kernel,
         out_shape=(jax.ShapeDtypeStruct(qt.shape, q.dtype),
-                   jax.ShapeDtypeStruct((B, H, T), jnp.float32)),
+                   jax.ShapeDtypeStruct((B, H, T, 1), jnp.float32)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, D),
@@ -145,8 +147,8 @@ def _flash_forward(q, k, v, causal, sm_scale, block_q, block_k,
         ],
         out_specs=(pl.BlockSpec((1, 1, block_q, D),
                                 lambda b, h, i, j: (b, h, i, 0)),
-                   pl.BlockSpec((1, 1, block_q),
-                                lambda b, h, i, j: (b, h, i))),
+                   pl.BlockSpec((1, 1, block_q, 1),
+                                lambda b, h, i, j: (b, h, i, 0))),
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -166,8 +168,8 @@ def _bwd_tiles(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *,
     k = k_ref[0, 0].astype(jnp.float32)               # [bk, D]
     v = v_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]                               # [bq]
-    delta = dl_ref[0, 0]                              # [bq]
+    lse = lse_ref[0, 0]                               # [bq, 1]
+    delta = dl_ref[0, 0]                              # [bq, 1]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * sm_scale     # [bq, bk]
@@ -177,11 +179,11 @@ def _bwd_tiles(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *,
         kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32,
                                                        s.shape, 1)
         s = jnp.where(qpos >= kpos, s, _NEG_INF)
-    p = jnp.exp(s - lse[:, None])                     # exact softmax tile
+    p = jnp.exp(s - lse)                              # exact softmax tile
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)                # [bq, bk]
-    ds = p * (dp - delta[:, None]) * sm_scale
+    ds = p * (dp - delta) * sm_scale
     return q, k, do, p, ds
 
 
@@ -256,14 +258,15 @@ def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, block_q,
     B, T, H, D = q.shape
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
     dot = g.transpose(0, 2, 1, 3)
-    # delta_i = rowsum(dO_i * O_i): one fused XLA reduce, [B, H, T].
+    # delta_i = rowsum(dO_i * O_i): one fused XLA reduce, [B, H, T, 1]
+    # (trailing singleton matches the lse layout; see _flash_kernel doc).
     delta = jnp.einsum("bqhd,bqhd->bhq", g.astype(jnp.float32),
-                       out.astype(jnp.float32))
+                       out.astype(jnp.float32))[..., None]
     num_q, num_k = T // block_q, T // block_k
 
     qspec = pl.BlockSpec((1, 1, block_q, D), lambda b, h, j, i: (b, h, i, 0))
     kspec = pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0))
-    rowq = pl.BlockSpec((1, 1, block_q), lambda b, h, j, i: (b, h, i))
+    rowq = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, j, i: (b, h, i, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, sm_scale=sm_scale,
                           causal=causal, block_q=block_q,
@@ -280,7 +283,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, block_q,
 
     qspec2 = pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0))
     kspec2 = pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0))
-    rowq2 = pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i))
+    rowq2 = pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0))
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, sm_scale=sm_scale,
                           causal=causal, block_q=block_q,
