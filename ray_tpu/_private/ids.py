@@ -73,8 +73,11 @@ class BaseID:
         return self._bytes.hex()
 
     def __hash__(self):
+        # Plain bytes hash (no type-name tuple): ids of different types
+        # never share a table, and __eq__ still type-checks, so the only
+        # cost of a cross-type hash collision is one extra __eq__ probe.
         if self._hash is None:
-            self._hash = hash((type(self).__name__, self._bytes))
+            self._hash = hash(self._bytes)
         return self._hash
 
     def __eq__(self, other):

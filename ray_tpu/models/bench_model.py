@@ -109,6 +109,11 @@ def run(steps: int = 8) -> dict:
         return best
 
     dt = (timed(steps + 1) - timed(1)) / steps
+    if dt <= 0:
+        # Tunnel jitter swamped the differenced measurement: refuse to
+        # emit (and cache) a garbage row.
+        out["error"] = "unstable timing: differenced step time <= 0"
+        return out
 
     n_tokens = B * T
     dense_flops = 6.0 * n_params * n_tokens
@@ -162,6 +167,9 @@ def run(steps: int = 8) -> dict:
 
     t_flash = bench_attn(lambda q, k, v: flash_attention(q, k, v))
     t_ref = bench_attn(lambda q, k, v: attention(q, k, v))
+    if t_flash <= 0 or t_ref <= 0:
+        out["error"] = "unstable timing: differenced attention time <= 0"
+        return out
     fwd_flops = 4.0 * Bf * Hf * Tf * Tf * Df / 2.0
     out["flash_attention"] = {
         "shape": [Bf, Tf, Hf, Df],
