@@ -1,0 +1,704 @@
+/* _rtpu_fastpath: fused driver-side task submission.
+ *
+ * Role parity: the per-call work of CoreWorkerDirectTaskSubmitter::SubmitTask
+ * + TaskManager::AddPendingTask (reference: src/ray/core_worker/
+ * transport/direct_task_transport.cc:40, task_manager.h:101), which the
+ * reference runs in C++ behind the Cython boundary.  Here the whole
+ * template-submit chain (id mint -> TaskSpec clone -> return ObjectID ->
+ * owned-reference entry -> ObjectRef -> pending-task entry -> submit-queue
+ * append) is one C call.
+ *
+ * Design: the hot classes stay defined in Python (ids.ObjectID,
+ * reference_count.Reference, object_ref.ObjectRef, task_spec.TaskSpec,
+ * core_worker.PendingTaskEntry) so every consumer, isinstance check and
+ * pickle path is untouched; this module creates *instances* of those
+ * classes at C-struct speed by caching their __slots__ member offsets
+ * (PyMemberDescrObject->d_member->offset) once at Ctx init and writing
+ * the slots directly.  If any structural assumption fails (slot missing,
+ * not T_OBJECT_EX), Ctx() raises and the caller falls back to the pure-
+ * Python path — behavior, not performance, is never at stake.
+ *
+ * Threading: runs entirely under the GIL, same dict/deque atomicity
+ * contract as the Python path it replaces (see the lock-free notes on
+ * ReferenceCounter.add_owned_with_local_ref and _enqueue_submit).
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <structmember.h>
+#include <stdint.h>
+#include <string.h>
+
+#ifndef T_OBJECT_EX
+#define T_OBJECT_EX 16
+#endif
+
+#define TASK_ID_SIZE 24
+#define PREFIX_SIZE 16
+#define OBJECT_ID_SIZE 28
+
+/* slot offset bundles ---------------------------------------------------- */
+
+enum { /* TaskSpec slots we touch */
+    TS_task_id, TS_job_id, TS_task_type, TS_name, TS_fn_key, TS_args,
+    TS_num_returns, TS_resources, TS_max_retries, TS_retry_exceptions,
+    TS_owner_address, TS_owner_worker_id, TS_actor_id, TS_actor_counter,
+    TS_actor_creation, TS_runtime_env, TS_placement_group_id,
+    TS_placement_group_bundle_index, TS_scheduling_strategy, TS_depth,
+    TS_trace_ctx, TS__sched, TS__proto, TS_N
+};
+static const char *TS_NAMES[TS_N] = {
+    "task_id", "job_id", "task_type", "name", "fn_key", "args",
+    "num_returns", "resources", "max_retries", "retry_exceptions",
+    "owner_address", "owner_worker_id", "actor_id", "actor_counter",
+    "actor_creation", "runtime_env", "placement_group_id",
+    "placement_group_bundle_index", "scheduling_strategy", "depth",
+    "trace_ctx", "_sched", "_proto"
+};
+
+enum { OI__bytes, OI__hash, OI_N };
+static const char *OI_NAMES[OI_N] = {"_bytes", "_hash"};
+
+enum {
+    RF_owned, RF_owner_address, RF_local_refs, RF_submitted_refs,
+    RF_contained_in, RF_contains, RF_borrowers, RF_locations,
+    RF_in_plasma, RF_pinned_lineage, RF_freed, RF_size, RF_N
+};
+static const char *RF_NAMES[RF_N] = {
+    "owned", "owner_address", "local_refs", "submitted_refs",
+    "contained_in", "contains", "borrowers", "locations",
+    "in_plasma", "pinned_lineage", "freed", "size"
+};
+
+enum { OR_object_id, OR_owner_address, OR__worker, OR_call_site, OR_N };
+static const char *OR_NAMES[OR_N] = {
+    "object_id", "owner_address", "_worker", "call_site"};
+
+enum {
+    PE_spec, PE_num_retries_left, PE_return_ids, PE_dep_ids,
+    PE_lineage_pinned, PE_recovery_waiter, PE_N
+};
+static const char *PE_NAMES[PE_N] = {
+    "spec", "num_retries_left", "return_ids", "dep_ids",
+    "lineage_pinned", "recovery_waiter"};
+
+enum { SO_metadata, SO_frames, SO_contained_refs, SO_N };
+static const char *SO_NAMES[SO_N] = {
+    "metadata", "frames", "contained_refs"};
+
+/* ------------------------------------------------------------------------ */
+
+typedef struct {
+    PyObject_HEAD
+    /* live cluster state (strong refs; all owned by the worker) */
+    PyObject *worker;
+    PyObject *refs_dict;      /* ReferenceCounter._refs */
+    PyObject *pending_dict;   /* CoreWorker.pending_tasks */
+    PyObject *submit_append;  /* bound CoreWorker._submit_buffer.append */
+    PyObject *stats_dict;     /* CoreWorker.stats */
+    PyObject *own_address;    /* str */
+    PyObject *call_soon;      /* bound loop.call_soon_threadsafe */
+    PyObject *drain_fn;       /* bound CoreWorker._drain_submit_buffer */
+    /* classes */
+    PyObject *cls_taskspec, *cls_objectid, *cls_objectref,
+             *cls_reference, *cls_entry, *cls_serialized;
+    /* cached immortals / singletons */
+    PyObject *empty_tuple, *long0, *long1, *str_task;
+    PyObject *s_submit_scheduled;  /* interned attr name */
+    PyObject *s_tasks_submitted;   /* interned stats key */
+    /* slot offsets */
+    Py_ssize_t ts_off[TS_N], oi_off[OI_N], rf_off[RF_N],
+               or_off[OR_N], pe_off[PE_N], so_off[SO_N];
+    /* xorshift128+ id suffix state */
+    uint64_t rng0, rng1;
+    uint64_t submitted;
+} FastCtx;
+
+#define SLOT(obj, off) (*(PyObject **)((char *)(obj) + (off)))
+
+static inline uint64_t
+rng_next(FastCtx *c)
+{
+    uint64_t s1 = c->rng0, s0 = c->rng1;
+    c->rng0 = s0;
+    s1 ^= s1 << 23;
+    c->rng1 = s1 ^ s0 ^ (s1 >> 18) ^ (s0 >> 5);
+    return c->rng1 + s0;
+}
+
+static int
+resolve_offsets(PyObject *cls, const char **names, Py_ssize_t *out, int n)
+{
+    for (int i = 0; i < n; i++) {
+        PyObject *descr = PyObject_GetAttrString(cls, names[i]);
+        if (descr == NULL)
+            return -1;
+        if (!Py_IS_TYPE(descr, &PyMemberDescr_Type)) {
+            Py_DECREF(descr);
+            PyErr_Format(PyExc_TypeError,
+                         "%s.%s is not a __slots__ member descriptor",
+                         ((PyTypeObject *)cls)->tp_name, names[i]);
+            return -1;
+        }
+        PyMemberDef *m = ((PyMemberDescrObject *)descr)->d_member;
+        if (m->type != T_OBJECT_EX) {
+            Py_DECREF(descr);
+            PyErr_Format(PyExc_TypeError,
+                         "%s.%s: unexpected member type %d",
+                         ((PyTypeObject *)cls)->tp_name, names[i], m->type);
+            return -1;
+        }
+        out[i] = m->offset;
+        Py_DECREF(descr);
+    }
+    return 0;
+}
+
+/* allocate an instance of a slotted Python heap class; slots start NULL */
+static inline PyObject *
+alloc_instance(PyObject *cls)
+{
+    PyTypeObject *tp = (PyTypeObject *)cls;
+    return tp->tp_alloc(tp, 0);
+}
+
+static int
+FastCtx_init(FastCtx *self, PyObject *args, PyObject *kwds)
+{
+    PyObject *worker, *refs_dict, *pending_dict, *submit_buffer,
+             *stats_dict, *own_address, *call_soon, *drain_fn,
+             *cls_taskspec, *cls_objectid, *cls_objectref, *cls_reference,
+             *cls_entry, *cls_serialized, *seed;
+    static char *kwlist[] = {
+        "worker", "refs_dict", "pending_dict", "submit_buffer",
+        "stats_dict", "own_address", "call_soon_threadsafe", "drain_fn",
+        "taskspec_cls", "objectid_cls", "objectref_cls", "reference_cls",
+        "entry_cls", "serialized_cls", "seed", NULL};
+    if (!PyArg_ParseTupleAndKeywords(
+            args, kwds, "OO!O!OO!UOOOOOOOOS", kwlist,
+            &worker, &PyDict_Type, &refs_dict, &PyDict_Type, &pending_dict,
+            &submit_buffer, &PyDict_Type, &stats_dict, &own_address,
+            &call_soon, &drain_fn, &cls_taskspec, &cls_objectid,
+            &cls_objectref, &cls_reference, &cls_entry, &cls_serialized,
+            &seed))
+        return -1;
+    if (PyBytes_GET_SIZE(seed) < 16) {
+        PyErr_SetString(PyExc_ValueError, "seed must be >= 16 bytes");
+        return -1;
+    }
+    if (resolve_offsets(cls_taskspec, TS_NAMES, self->ts_off, TS_N) < 0 ||
+        resolve_offsets(cls_objectid, OI_NAMES, self->oi_off, OI_N) < 0 ||
+        resolve_offsets(cls_reference, RF_NAMES, self->rf_off, RF_N) < 0 ||
+        resolve_offsets(cls_objectref, OR_NAMES, self->or_off, OR_N) < 0 ||
+        resolve_offsets(cls_entry, PE_NAMES, self->pe_off, PE_N) < 0 ||
+        resolve_offsets(cls_serialized, SO_NAMES, self->so_off, SO_N) < 0)
+        return -1;
+
+    PyObject *append = PyObject_GetAttrString(submit_buffer, "append");
+    if (append == NULL)
+        return -1;
+    self->submit_append = append;
+
+    Py_INCREF(worker); self->worker = worker;
+    Py_INCREF(refs_dict); self->refs_dict = refs_dict;
+    Py_INCREF(pending_dict); self->pending_dict = pending_dict;
+    Py_INCREF(stats_dict); self->stats_dict = stats_dict;
+    Py_INCREF(own_address); self->own_address = own_address;
+    Py_INCREF(call_soon); self->call_soon = call_soon;
+    Py_INCREF(drain_fn); self->drain_fn = drain_fn;
+    Py_INCREF(cls_taskspec); self->cls_taskspec = cls_taskspec;
+    Py_INCREF(cls_objectid); self->cls_objectid = cls_objectid;
+    Py_INCREF(cls_objectref); self->cls_objectref = cls_objectref;
+    Py_INCREF(cls_reference); self->cls_reference = cls_reference;
+    Py_INCREF(cls_entry); self->cls_entry = cls_entry;
+    Py_INCREF(cls_serialized); self->cls_serialized = cls_serialized;
+
+    self->empty_tuple = PyTuple_New(0);
+    self->long0 = PyLong_FromLong(0);
+    self->long1 = PyLong_FromLong(1);
+    self->str_task = PyUnicode_InternFromString("task");
+    self->s_submit_scheduled =
+        PyUnicode_InternFromString("_submit_scheduled");
+    self->s_tasks_submitted =
+        PyUnicode_InternFromString("tasks_submitted");
+    if (self->empty_tuple == NULL || self->long0 == NULL ||
+        self->long1 == NULL || self->str_task == NULL ||
+        self->s_submit_scheduled == NULL ||
+        self->s_tasks_submitted == NULL)
+        return -1;
+
+    const unsigned char *sd =
+        (const unsigned char *)PyBytes_AS_STRING(seed);
+    memcpy(&self->rng0, sd, 8);
+    memcpy(&self->rng1, sd + 8, 8);
+    if (self->rng0 == 0 && self->rng1 == 0)
+        self->rng1 = 0x9e3779b97f4a7c15ULL;
+    self->submitted = 0;
+    return 0;
+}
+
+static int
+FastCtx_traverse(FastCtx *self, visitproc visit, void *arg)
+{
+    Py_VISIT(self->worker); Py_VISIT(self->refs_dict);
+    Py_VISIT(self->pending_dict); Py_VISIT(self->submit_append);
+    Py_VISIT(self->stats_dict); Py_VISIT(self->own_address);
+    Py_VISIT(self->call_soon); Py_VISIT(self->drain_fn);
+    Py_VISIT(self->cls_taskspec); Py_VISIT(self->cls_objectid);
+    Py_VISIT(self->cls_objectref); Py_VISIT(self->cls_reference);
+    Py_VISIT(self->cls_entry); Py_VISIT(self->cls_serialized);
+    return 0;
+}
+
+static int
+FastCtx_clear(FastCtx *self)
+{
+    Py_CLEAR(self->worker); Py_CLEAR(self->refs_dict);
+    Py_CLEAR(self->pending_dict); Py_CLEAR(self->submit_append);
+    Py_CLEAR(self->stats_dict); Py_CLEAR(self->own_address);
+    Py_CLEAR(self->call_soon); Py_CLEAR(self->drain_fn);
+    Py_CLEAR(self->cls_taskspec); Py_CLEAR(self->cls_objectid);
+    Py_CLEAR(self->cls_objectref); Py_CLEAR(self->cls_reference);
+    Py_CLEAR(self->cls_entry); Py_CLEAR(self->cls_serialized);
+    Py_CLEAR(self->empty_tuple); Py_CLEAR(self->long0);
+    Py_CLEAR(self->long1); Py_CLEAR(self->str_task);
+    Py_CLEAR(self->s_submit_scheduled);
+    Py_CLEAR(self->s_tasks_submitted);
+    return 0;
+}
+
+static void
+FastCtx_dealloc(FastCtx *self)
+{
+    PyObject_GC_UnTrack(self);
+    FastCtx_clear(self);
+    Py_TYPE(self)->tp_free((PyObject *)self);
+}
+
+/* submit(proto, prefix16, trace_ctx) -> [ObjectRef]
+ *
+ * Preconditions enforced by the Python caller (core_worker.
+ * submit_task_from_template): no args, num_returns == 1, normal task.
+ */
+static PyObject *
+FastCtx_submit(FastCtx *self, PyObject *const *argv, Py_ssize_t nargs)
+{
+    if (nargs != 3) {
+        PyErr_SetString(PyExc_TypeError,
+                        "submit(proto, prefix, trace_ctx)");
+        return NULL;
+    }
+    PyObject *proto = argv[0], *prefix = argv[1], *trace_ctx = argv[2];
+    if (!PyBytes_Check(prefix) || PyBytes_GET_SIZE(prefix) != PREFIX_SIZE) {
+        PyErr_SetString(PyExc_ValueError, "prefix must be 16 bytes");
+        return NULL;
+    }
+
+    PyObject *tid = NULL, *oid_b = NULL, *oid = NULL, *ref = NULL,
+             *objref = NULL, *spec = NULL, *entry = NULL,
+             *return_ids = NULL, *out = NULL, *item = NULL;
+
+    /* -- 1. mint task id (16B lineage prefix + 8 random) + return oid -- */
+    tid = PyBytes_FromStringAndSize(NULL, TASK_ID_SIZE);
+    if (tid == NULL) goto fail;
+    char *tp = PyBytes_AS_STRING(tid);
+    memcpy(tp, PyBytes_AS_STRING(prefix), PREFIX_SIZE);
+    uint64_t r = rng_next(self);
+    memcpy(tp + PREFIX_SIZE, &r, 8);
+
+    oid_b = PyBytes_FromStringAndSize(NULL, OBJECT_ID_SIZE);
+    if (oid_b == NULL) goto fail;
+    char *op = PyBytes_AS_STRING(oid_b);
+    memcpy(op, tp, TASK_ID_SIZE);
+    op[24] = 1; op[25] = 0; op[26] = 0; op[27] = 0;  /* index 1, LE */
+
+    /* -- 2. ObjectID instance (hash pre-computed: BaseID.__hash__ is
+     *       hash(self._bytes) cached in _hash) ------------------------- */
+    Py_hash_t h = PyObject_Hash(oid_b);
+    if (h == -1 && PyErr_Occurred()) goto fail;
+    oid = alloc_instance(self->cls_objectid);
+    if (oid == NULL) goto fail;
+    Py_INCREF(oid_b);
+    SLOT(oid, self->oi_off[OI__bytes]) = oid_b;
+    PyObject *hv = PyLong_FromSsize_t(h);
+    if (hv == NULL) goto fail;
+    SLOT(oid, self->oi_off[OI__hash]) = hv;
+
+    /* -- 3. owned Reference entry: owned=True, local_refs=1,
+     *       pinned_lineage=True (add_owned_with_local_ref) ------------- */
+    ref = alloc_instance(self->cls_reference);
+    if (ref == NULL) goto fail;
+    Py_INCREF(Py_True);  SLOT(ref, self->rf_off[RF_owned]) = Py_True;
+    Py_INCREF(self->own_address);
+    SLOT(ref, self->rf_off[RF_owner_address]) = self->own_address;
+    Py_INCREF(self->long1);
+    SLOT(ref, self->rf_off[RF_local_refs]) = self->long1;
+    Py_INCREF(self->long0);
+    SLOT(ref, self->rf_off[RF_submitted_refs]) = self->long0;
+    Py_INCREF(Py_None); SLOT(ref, self->rf_off[RF_contained_in]) = Py_None;
+    Py_INCREF(Py_None); SLOT(ref, self->rf_off[RF_contains]) = Py_None;
+    Py_INCREF(Py_None); SLOT(ref, self->rf_off[RF_borrowers]) = Py_None;
+    Py_INCREF(Py_None); SLOT(ref, self->rf_off[RF_locations]) = Py_None;
+    Py_INCREF(Py_False); SLOT(ref, self->rf_off[RF_in_plasma]) = Py_False;
+    Py_INCREF(Py_True);
+    SLOT(ref, self->rf_off[RF_pinned_lineage]) = Py_True;
+    Py_INCREF(Py_False); SLOT(ref, self->rf_off[RF_freed]) = Py_False;
+    Py_INCREF(self->long0); SLOT(ref, self->rf_off[RF_size]) = self->long0;
+
+    if (PyDict_SetItem(self->refs_dict, oid, ref) < 0) goto fail;
+
+    /* -- 4. TaskSpec clone (mirror of TaskSpec.clone_for) -------------- */
+    spec = alloc_instance(self->cls_taskspec);
+    if (spec == NULL) goto fail;
+    Py_INCREF(tid); SLOT(spec, self->ts_off[TS_task_id]) = tid;
+    {
+        /* fields copied from the proto by reference */
+        static const int COPY[] = {
+            TS_job_id, TS_task_type, TS_name, TS_fn_key, TS_num_returns,
+            TS_resources, TS_max_retries, TS_retry_exceptions,
+            TS_owner_address, TS_owner_worker_id, TS_actor_id,
+            TS_runtime_env, TS_placement_group_id,
+            TS_placement_group_bundle_index, TS_scheduling_strategy,
+            TS_depth, TS__sched};
+        for (size_t i = 0; i < sizeof(COPY) / sizeof(COPY[0]); i++) {
+            Py_ssize_t off = self->ts_off[COPY[i]];
+            PyObject *v = SLOT(proto, off);
+            if (v == NULL) {
+                PyErr_Format(PyExc_AttributeError,
+                             "template proto missing slot %s",
+                             TS_NAMES[COPY[i]]);
+                goto fail;
+            }
+            Py_INCREF(v);
+            SLOT(spec, off) = v;
+        }
+    }
+    Py_INCREF(self->empty_tuple);
+    SLOT(spec, self->ts_off[TS_args]) = self->empty_tuple;
+    Py_INCREF(self->long0);
+    SLOT(spec, self->ts_off[TS_actor_counter]) = self->long0;
+    Py_INCREF(Py_None);
+    SLOT(spec, self->ts_off[TS_actor_creation]) = Py_None;
+    Py_INCREF(trace_ctx);
+    SLOT(spec, self->ts_off[TS_trace_ctx]) = trace_ctx;
+    Py_INCREF(proto);
+    SLOT(spec, self->ts_off[TS__proto]) = proto;
+
+    /* -- 5. ObjectRef (skip_adding_local_ref semantics: the local ref
+     *       was taken in step 3) -------------------------------------- */
+    objref = alloc_instance(self->cls_objectref);
+    if (objref == NULL) goto fail;
+    Py_INCREF(oid); SLOT(objref, self->or_off[OR_object_id]) = oid;
+    Py_INCREF(self->own_address);
+    SLOT(objref, self->or_off[OR_owner_address]) = self->own_address;
+    Py_INCREF(self->worker);
+    SLOT(objref, self->or_off[OR__worker]) = self->worker;
+    {
+        PyObject *name = SLOT(proto, self->ts_off[TS_name]);
+        if (name == NULL) name = Py_None;
+        Py_INCREF(name);
+        SLOT(objref, self->or_off[OR_call_site]) = name;
+    }
+
+    /* -- 6. PendingTaskEntry ------------------------------------------ */
+    return_ids = PyList_New(1);
+    if (return_ids == NULL) goto fail;
+    Py_INCREF(oid);
+    PyList_SET_ITEM(return_ids, 0, oid);
+
+    entry = alloc_instance(self->cls_entry);
+    if (entry == NULL) goto fail;
+    Py_INCREF(spec); SLOT(entry, self->pe_off[PE_spec]) = spec;
+    {
+        PyObject *mr = SLOT(proto, self->ts_off[TS_max_retries]);
+        if (mr == NULL) mr = self->long0;
+        Py_INCREF(mr);
+        SLOT(entry, self->pe_off[PE_num_retries_left]) = mr;
+    }
+    SLOT(entry, self->pe_off[PE_return_ids]) = return_ids;
+    return_ids = NULL;  /* ownership moved into entry */
+    Py_INCREF(self->empty_tuple);
+    SLOT(entry, self->pe_off[PE_dep_ids]) = self->empty_tuple;
+    Py_INCREF(Py_False);
+    SLOT(entry, self->pe_off[PE_lineage_pinned]) = Py_False;
+    Py_INCREF(Py_None);
+    SLOT(entry, self->pe_off[PE_recovery_waiter]) = Py_None;
+
+    if (PyDict_SetItem(self->pending_dict, tid, entry) < 0) goto fail;
+
+    /* -- 7. stats + submit queue + loop wakeup ------------------------- */
+    self->submitted++;
+    {
+        /* introspection parity: stats["tasks_submitted"] += 1 */
+        PyObject *cur = PyDict_GetItemWithError(self->stats_dict,
+                                                self->s_tasks_submitted);
+        if (cur == NULL && PyErr_Occurred()) goto fail;
+        long n = cur ? PyLong_AsLong(cur) : 0;
+        if (n == -1 && PyErr_Occurred()) goto fail;
+        PyObject *nv = PyLong_FromLong(n + 1);
+        if (nv == NULL) goto fail;
+        int rc = PyDict_SetItem(self->stats_dict,
+                                self->s_tasks_submitted, nv);
+        Py_DECREF(nv);
+        if (rc < 0) goto fail;
+    }
+
+    item = PyTuple_Pack(2, self->str_task, spec);
+    if (item == NULL) goto fail;
+    PyObject *ar = PyObject_CallOneArg(self->submit_append, item);
+    Py_CLEAR(item);
+    if (ar == NULL) goto fail;
+    Py_DECREF(ar);
+
+    {
+        PyObject *flag =
+            PyObject_GetAttr(self->worker, self->s_submit_scheduled);
+        if (flag == NULL) goto fail;
+        int truthy = PyObject_IsTrue(flag);
+        Py_DECREF(flag);
+        if (truthy < 0) goto fail;
+        if (!truthy) {
+            if (PyObject_SetAttr(self->worker, self->s_submit_scheduled,
+                                 Py_True) < 0)
+                goto fail;
+            PyObject *cr =
+                PyObject_CallOneArg(self->call_soon, self->drain_fn);
+            if (cr == NULL) {
+                /* loop closed (shutdown): mirror the Python path */
+                if (PyErr_ExceptionMatches(PyExc_RuntimeError)) {
+                    PyErr_Clear();
+                    if (PyObject_SetAttr(self->worker,
+                                         self->s_submit_scheduled,
+                                         Py_False) < 0)
+                        goto fail;
+                } else {
+                    goto fail;
+                }
+            } else {
+                Py_DECREF(cr);
+            }
+        }
+    }
+
+    out = PyList_New(1);
+    if (out == NULL) goto fail;
+    Py_INCREF(objref);
+    PyList_SET_ITEM(out, 0, objref);
+
+    Py_DECREF(tid); Py_DECREF(oid_b); Py_DECREF(oid); Py_DECREF(ref);
+    Py_DECREF(objref); Py_DECREF(spec); Py_DECREF(entry);
+    return out;
+
+fail:
+    Py_XDECREF(tid); Py_XDECREF(oid_b); Py_XDECREF(oid); Py_XDECREF(ref);
+    Py_XDECREF(objref); Py_XDECREF(spec); Py_XDECREF(entry);
+    Py_XDECREF(return_ids); Py_XDECREF(item); Py_XDECREF(out);
+    return NULL;
+}
+
+/* complete_fast(batch, replies, rbufs, keep_lineage)
+ *     -> (put_pairs, finished, slow_indices)
+ *
+ * The dominant reply shape of _on_push_batch_done (status ok, argless
+ * spec, one inline return, no plasma / contained refs, no recovery
+ * waiter) handled in one C loop: pending-entry pop + SerializedObject
+ * build + (bytes-key, value) pair assembly for memory_store.put_many.
+ * Anything else lands its index in slow_indices for the Python handler.
+ */
+static PyObject *
+FastCtx_complete_fast(FastCtx *self, PyObject *const *argv,
+                      Py_ssize_t nargs)
+{
+    if (nargs != 4) {
+        PyErr_SetString(
+            PyExc_TypeError,
+            "complete_fast(batch, replies, rbufs, keep_lineage)");
+        return NULL;
+    }
+    PyObject *batch = argv[0], *replies = argv[1], *rbufs = argv[2];
+    int keep_lineage = PyObject_IsTrue(argv[3]);
+    if (keep_lineage < 0)
+        return NULL;
+    if (!PyList_Check(batch) || !PyList_Check(replies) ||
+        !PyList_Check(rbufs)) {
+        PyErr_SetString(PyExc_TypeError,
+                        "batch/replies/rbufs must be lists");
+        return NULL;
+    }
+    Py_ssize_t n = PyList_GET_SIZE(batch);
+    if (PyList_GET_SIZE(replies) != n) {
+        PyErr_SetString(PyExc_ValueError, "batch/replies length mismatch");
+        return NULL;
+    }
+
+    PyObject *pairs = PyList_New(0);
+    PyObject *slow = PyList_New(0);
+    PyObject *serobj = NULL, *frames = NULL, *pair = NULL;
+    long finished = 0;
+    if (pairs == NULL || slow == NULL) goto fail;
+
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *spec = PyList_GET_ITEM(batch, i);        /* borrowed */
+        PyObject *rep = PyList_GET_ITEM(replies, i);       /* borrowed */
+        /* rep = [rheader, fstart, nframes]; rheader = [status, rets] */
+        if (!PyList_Check(rep) || PyList_GET_SIZE(rep) < 2)
+            goto slow_item;
+        PyObject *rheader = PyList_GET_ITEM(rep, 0);
+        if (!PyList_Check(rheader) || PyList_GET_SIZE(rheader) < 2)
+            goto slow_item;
+        PyObject *status = PyList_GET_ITEM(rheader, 0);
+        if (!PyLong_Check(status) || PyLong_AsLong(status) != 0)
+            goto slow_item;
+        PyObject *spec_args = SLOT(spec, self->ts_off[TS_args]);
+        if (spec_args == NULL)
+            goto slow_item;
+        int argful = PyObject_IsTrue(spec_args);
+        if (argful < 0) goto fail;
+        if (argful)
+            goto slow_item;
+        PyObject *rets = PyList_GET_ITEM(rheader, 1);
+        if (!PyList_Check(rets) || PyList_GET_SIZE(rets) != 1)
+            goto slow_item;
+        PyObject *ret0 = PyList_GET_ITEM(rets, 0);
+        /* ret0 = [oid_b, in_plasma, meta, start, n, contained] */
+        if (!PyList_Check(ret0) || PyList_GET_SIZE(ret0) < 6)
+            goto slow_item;
+        int in_plasma = PyObject_IsTrue(PyList_GET_ITEM(ret0, 1));
+        int contained = PyObject_IsTrue(PyList_GET_ITEM(ret0, 5));
+        if (in_plasma < 0 || contained < 0) goto fail;
+        if (in_plasma || contained)
+            goto slow_item;
+
+        PyObject *tid = SLOT(spec, self->ts_off[TS_task_id]);
+        if (tid == NULL)
+            goto slow_item;
+        PyObject *entry = PyDict_GetItemWithError(self->pending_dict, tid);
+        if (entry == NULL) {
+            if (PyErr_Occurred()) goto fail;
+            continue;  /* already completed elsewhere (dup reply) */
+        }
+        PyObject *waiter = SLOT(entry, self->pe_off[PE_recovery_waiter]);
+        if (waiter != NULL && waiter != Py_None)
+            goto slow_item;  /* recovery in flight: Python handles wake */
+
+        PyObject *oid_b = PyList_GET_ITEM(ret0, 0);
+        PyObject *meta = PyList_GET_ITEM(ret0, 2);
+        Py_ssize_t start = PyLong_AsSsize_t(PyList_GET_ITEM(ret0, 3));
+        Py_ssize_t cnt = PyLong_AsSsize_t(PyList_GET_ITEM(ret0, 4));
+        Py_ssize_t fstart = PyLong_AsSsize_t(PyList_GET_ITEM(rep, 1));
+        if ((start == -1 || cnt == -1 || fstart == -1) && PyErr_Occurred())
+            goto fail;
+        Py_ssize_t base = fstart + start;
+        if (base < 0 || cnt < 0 || base + cnt > PyList_GET_SIZE(rbufs)) {
+            PyErr_SetString(PyExc_IndexError,
+                            "reply frame range out of bounds");
+            goto fail;
+        }
+        frames = PyList_GetSlice(rbufs, base, base + cnt);
+        if (frames == NULL) goto fail;
+
+        serobj = alloc_instance(self->cls_serialized);
+        if (serobj == NULL) goto fail;
+        Py_INCREF(meta);
+        SLOT(serobj, self->so_off[SO_metadata]) = meta;
+        SLOT(serobj, self->so_off[SO_frames]) = frames;
+        frames = NULL;  /* moved */
+        PyObject *empty = PyList_New(0);
+        if (empty == NULL) goto fail;
+        SLOT(serobj, self->so_off[SO_contained_refs]) = empty;
+
+        /* bytes key: the memory store hashes it in C */
+        pair = PyTuple_Pack(2, oid_b, serobj);
+        if (pair == NULL) goto fail;
+        Py_CLEAR(serobj);
+        if (PyList_Append(pairs, pair) < 0) goto fail;
+        Py_CLEAR(pair);
+        finished++;
+
+        if (!keep_lineage) {
+            if (PyDict_DelItem(self->pending_dict, tid) < 0)
+                goto fail;
+        }
+        continue;
+
+    slow_item:
+        {
+            PyObject *idx = PyLong_FromSsize_t(i);
+            if (idx == NULL) goto fail;
+            int rc = PyList_Append(slow, idx);
+            Py_DECREF(idx);
+            if (rc < 0) goto fail;
+        }
+    }
+
+    {
+        PyObject *fin = PyLong_FromLong(finished);
+        if (fin == NULL) goto fail;
+        PyObject *out = PyTuple_Pack(3, pairs, fin, slow);
+        Py_DECREF(fin);
+        Py_DECREF(pairs);
+        Py_DECREF(slow);
+        return out;
+    }
+
+fail:
+    Py_XDECREF(pairs); Py_XDECREF(slow); Py_XDECREF(serobj);
+    Py_XDECREF(frames); Py_XDECREF(pair);
+    return NULL;
+}
+
+static PyObject *
+FastCtx_get_submitted(FastCtx *self, void *closure)
+{
+    return PyLong_FromUnsignedLongLong(self->submitted);
+}
+
+static PyMethodDef FastCtx_methods[] = {
+    {"submit", (PyCFunction)(void (*)(void))FastCtx_submit,
+     METH_FASTCALL, "fused template-task submission"},
+    {"complete_fast", (PyCFunction)(void (*)(void))FastCtx_complete_fast,
+     METH_FASTCALL, "fused batch-reply completion (fast shape only)"},
+    {NULL, NULL, 0, NULL},
+};
+
+static PyGetSetDef FastCtx_getset[] = {
+    {"submitted", (getter)FastCtx_get_submitted, NULL,
+     "tasks submitted through the fast path", NULL},
+    {NULL, NULL, NULL, NULL, NULL},
+};
+
+static PyTypeObject FastCtx_Type = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "_rtpu_fastpath.Ctx",
+    .tp_basicsize = sizeof(FastCtx),
+    .tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC,
+    .tp_new = PyType_GenericNew,
+    .tp_init = (initproc)FastCtx_init,
+    .tp_dealloc = (destructor)FastCtx_dealloc,
+    .tp_traverse = (traverseproc)FastCtx_traverse,
+    .tp_clear = (inquiry)FastCtx_clear,
+    .tp_methods = FastCtx_methods,
+    .tp_getset = FastCtx_getset,
+    .tp_doc = "fused submit context bound to one CoreWorker",
+};
+
+static struct PyModuleDef fastpath_module = {
+    PyModuleDef_HEAD_INIT, "_rtpu_fastpath",
+    "fused driver-side submission hot path", -1, NULL,
+};
+
+PyMODINIT_FUNC
+PyInit__rtpu_fastpath(void)
+{
+    if (PyType_Ready(&FastCtx_Type) < 0)
+        return NULL;
+    PyObject *m = PyModule_Create(&fastpath_module);
+    if (m == NULL)
+        return NULL;
+    Py_INCREF(&FastCtx_Type);
+    if (PyModule_AddObject(m, "Ctx", (PyObject *)&FastCtx_Type) < 0) {
+        Py_DECREF(&FastCtx_Type);
+        Py_DECREF(m);
+        return NULL;
+    }
+    return m;
+}

@@ -11,13 +11,19 @@ synchronous public API writes small objects without an IO-loop round
 trip); blocking ``get`` runs on an event loop. A small lock closes the
 check-then-register race between a foreign-thread put and a loop-thread
 get, and waiter futures are woken on their own loop.
+
+Keying: the internal tables are keyed by the id's raw 28 bytes, not the
+ObjectID wrapper — a bytes key hashes in C (and caches), while hashing an
+ObjectID runs a Python ``__hash__`` frame on every dict operation, which
+the drain profile showed on 4+ table ops per task.  The public API takes
+either an ObjectID or its ``binary()`` bytes.
 """
 
 from __future__ import annotations
 
 import asyncio
 import threading
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List
 
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.serialization import SerializedObject
@@ -33,6 +39,10 @@ class InPlasmaSentinel:
 IN_PLASMA = InPlasmaSentinel()
 
 
+def _key(object_id) -> bytes:
+    return object_id if type(object_id) is bytes else object_id._bytes
+
+
 def _set_result_safe(fut: asyncio.Future, obj) -> None:
     if not fut.done():
         fut.set_result(obj)
@@ -43,20 +53,35 @@ def _set_exception_safe(fut: asyncio.Future, err: BaseException) -> None:
         fut.set_exception(err)
 
 
+class _Barrier:
+    """One future covering N missing objects (bulk get): lands cost one
+    dict pop + a counter decrement per object instead of a future +
+    wait_for machinery per object."""
+
+    __slots__ = ("count", "future")
+
+    def __init__(self, count: int, future: asyncio.Future):
+        self.count = count
+        self.future = future
+
+
 class MemoryStore:
     def __init__(self):
         self._lock = threading.Lock()
-        self._objects: Dict[ObjectID, object] = {}  # SerializedObject | IN_PLASMA
-        self._waiters: Dict[ObjectID, List[asyncio.Future]] = {}
-        self._object_added_callbacks: List[Callable[[ObjectID], None]] = []
+        # all keyed by the 28 raw id bytes
+        self._objects: Dict[bytes, object] = {}  # SerializedObject | IN_PLASMA
+        self._waiters: Dict[bytes, List[asyncio.Future]] = {}
+        self._barriers: Dict[bytes, List[_Barrier]] = {}
+        self._object_added_callbacks: List[Callable] = []
 
-    def add_object_added_callback(self, cb: Callable[[ObjectID], None]):
+    def add_object_added_callback(self, cb: Callable):
         self._object_added_callbacks.append(cb)
 
-    def put(self, object_id: ObjectID, obj) -> None:
+    def put(self, object_id, obj) -> None:
+        k = _key(object_id)
         with self._lock:
-            self._objects[object_id] = obj
-            waiters = self._waiters.pop(object_id, None)
+            self._objects[k] = obj
+            waiters = self._waiters.pop(k, None)
         if waiters:
             try:
                 current = asyncio.get_running_loop()
@@ -68,18 +93,35 @@ class MemoryStore:
                     _set_result_safe(fut, obj)
                 else:
                     floop.call_soon_threadsafe(_set_result_safe, fut, obj)
-        for cb in self._object_added_callbacks:
-            cb(object_id)
+        if self._barriers:
+            try:
+                current = asyncio.get_running_loop()
+            except RuntimeError:
+                current = None
+            self._land_for_barriers(k, current)
+        if self._object_added_callbacks:
+            # callbacks always receive an ObjectID, whatever key form
+            # the caller used (same contract as put_many)
+            oid = object_id if type(object_id) is not bytes \
+                else ObjectID(object_id)
+            for cb in self._object_added_callbacks:
+                cb(oid)
 
     def put_many(self, pairs) -> None:
         """Batch put: ONE lock round trip for a whole reply batch (the
-        per-task put was ~1us of the drain's completion path)."""
+        per-task put was ~1us of the drain's completion path).  ``pairs``
+        is a list of (id, obj) where the ids are HOMOGENEOUS within one
+        batch: all ObjectID or all raw bytes (the native completion path
+        passes bytes; the Python fallback passes ObjectID) — the key
+        type is sniffed from the first pair."""
+        if pairs and type(pairs[0][0]) is not bytes:
+            pairs = [(o._bytes, v) for o, v in pairs]
         with self._lock:
             self._objects.update(pairs)
             woken = []
             if self._waiters:
-                for oid, obj in pairs:
-                    ws = self._waiters.pop(oid, None)
+                for k, obj in pairs:
+                    ws = self._waiters.pop(k, None)
                     if ws:
                         woken.append((ws, obj))
         if woken:
@@ -94,50 +136,132 @@ class MemoryStore:
                         _set_result_safe(fut, obj)
                     else:
                         floop.call_soon_threadsafe(_set_result_safe, fut, obj)
+        if self._barriers:
+            try:
+                current = asyncio.get_running_loop()
+            except RuntimeError:
+                current = None
+            for k, _ in pairs:
+                self._land_for_barriers(k, current)
         if self._object_added_callbacks:
             for cb in self._object_added_callbacks:
-                for oid, _ in pairs:
-                    cb(oid)
+                for k, _ in pairs:
+                    cb(ObjectID(k))
 
-    def contains(self, object_id: ObjectID) -> bool:
-        return object_id in self._objects
-
-    def get_if_exists(self, object_id: ObjectID):
-        return self._objects.get(object_id)
-
-    async def get(self, object_id: ObjectID, timeout: float | None = None):
+    async def wait_many(self, object_ids, timeout: float | None = None
+                        ) -> None:
+        """Block until every id has SOME value present (a real object or
+        the IN_PLASMA marker).  One barrier future for the whole batch —
+        the bulk-get hot path (reference analog: the memory store's
+        GetAsync fan-in, memory_store.h:104 — but batched).  Raises
+        asyncio.TimeoutError on timeout."""
+        keys = [_key(o) for o in object_ids]
         with self._lock:
-            obj = self._objects.get(object_id)
+            objects = self._objects
+            missing = [k for k in keys if k not in objects]
+            if not missing:
+                return
+            barrier = _Barrier(len(missing),
+                               asyncio.get_running_loop().create_future())
+            setd = self._barriers.setdefault
+            for k in missing:
+                setd(k, []).append(barrier)
+        try:
+            if timeout is not None:
+                await asyncio.wait_for(barrier.future, timeout)
+            else:
+                await barrier.future
+        finally:
+            fut = barrier.future
+            clean = fut.done() and not fut.cancelled() \
+                and fut.exception() is None
+            if not clean:
+                # timeout / cancellation / failure: unhook every entry
+                # still registered (fail_waiters pops only its own key)
+                with self._lock:
+                    for k in missing:
+                        lst = self._barriers.get(k)
+                        if lst and barrier in lst:
+                            lst.remove(barrier)
+                            if not lst:
+                                del self._barriers[k]
+
+    def _land_for_barriers(self, k: bytes, current) -> None:
+        """Pops barrier entries for a landed id; count decrements happen
+        under the store lock (puts race from multiple threads).
+        ``current`` is the running loop (or None)."""
+        done = None
+        with self._lock:
+            bs = self._barriers.pop(k, None)
+            if bs:
+                for b in bs:
+                    b.count -= 1
+                    if b.count == 0:
+                        if done is None:
+                            done = []
+                        done.append(b)
+        if not done:
+            return
+        for b in done:
+            if not b.future.done():
+                floop = b.future.get_loop()
+                if floop is current:
+                    _set_result_safe(b.future, None)
+                else:
+                    floop.call_soon_threadsafe(_set_result_safe,
+                                               b.future, None)
+
+    def contains(self, object_id) -> bool:
+        return _key(object_id) in self._objects
+
+    def get_if_exists(self, object_id):
+        return self._objects.get(_key(object_id))
+
+    async def get(self, object_id, timeout: float | None = None):
+        k = _key(object_id)
+        with self._lock:
+            obj = self._objects.get(k)
             if obj is not None:
                 return obj
             fut = asyncio.get_running_loop().create_future()
-            self._waiters.setdefault(object_id, []).append(fut)
+            self._waiters.setdefault(k, []).append(fut)
         try:
             if timeout is not None:
                 return await asyncio.wait_for(fut, timeout)
             return await fut
         finally:
             with self._lock:
-                lst = self._waiters.get(object_id)
+                lst = self._waiters.get(k)
                 if lst and fut in lst:
                     lst.remove(fut)
                     if not lst:
-                        del self._waiters[object_id]
+                        del self._waiters[k]
 
-    def delete(self, object_id: ObjectID) -> None:
+    def delete(self, object_id) -> None:
         with self._lock:
-            self._objects.pop(object_id, None)
+            self._objects.pop(_key(object_id), None)
 
-    def fail_waiters(self, object_id: ObjectID, error: BaseException) -> None:
+    def fail_waiters(self, object_id, error: BaseException) -> None:
+        k = _key(object_id)
         with self._lock:
-            waiters = self._waiters.pop(object_id, None)
-        if not waiters:
+            waiters = self._waiters.pop(k, None)
+            barriers = self._barriers.pop(k, None)
+        if not waiters and not barriers:
             return
         try:
             current = asyncio.get_running_loop()
         except RuntimeError:
             current = None
-        for fut in waiters:
+        for fut in waiters or ():
+            floop = fut.get_loop()
+            if floop is current:
+                _set_exception_safe(fut, error)
+            else:
+                floop.call_soon_threadsafe(_set_exception_safe, fut, error)
+        # a failed id can never land: fail the whole batch barrier (the
+        # bulk get re-checks per id and surfaces the error path)
+        for b in barriers or ():
+            fut = b.future
             floop = fut.get_loop()
             if floop is current:
                 _set_exception_safe(fut, error)

@@ -1,0 +1,94 @@
+"""Build-and-load for the optional native (C) hot paths.
+
+The C sources live in ``cpp/`` (the same tree as the C++ cross-language
+client); they are compiled on first use into a per-interpreter cache
+directory inside the package, keyed by source hash, so editing the C
+source invalidates stale builds automatically.  Everything degrades to
+the pure-Python implementations when a compiler or the CPython headers
+are unavailable (``RAY_TPU_NO_NATIVE=1`` forces that off-switch), so the
+native path is a performance tier, never a correctness dependency.
+
+Reference role parity: the reference runs its whole submission path as
+C++ behind Cython (python/ray/_raylet.pyx); here only the measured hot
+chain is native and the orchestration stays Python.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib.util
+import logging
+import os
+import subprocess
+import sys
+import sysconfig
+import threading
+
+logger = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "cpp", "fastpath.c")
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_native_cache")
+
+_lock = threading.Lock()
+_mod = None
+_tried = False
+
+
+def load_fastpath():
+    """The ``_rtpu_fastpath`` extension module, or None (cached)."""
+    global _mod, _tried
+    if _tried:
+        return _mod
+    with _lock:
+        if _tried:
+            return _mod
+        if os.environ.get("RAY_TPU_NO_NATIVE"):
+            _tried = True
+            return None
+        try:
+            _mod = _build_and_load()
+            logger.debug("native fastpath loaded: %s", _mod.__file__)
+        except Exception as e:  # noqa: BLE001 — fall back to pure Python
+            logger.debug("native fastpath unavailable: %s", e)
+            _mod = None
+        _tried = True
+        return _mod
+
+
+def _build_and_load():
+    with open(_SRC, "rb") as f:
+        src = f.read()
+    tag = "%s-%s" % (hashlib.sha256(src).hexdigest()[:12],
+                     sys.implementation.cache_tag)
+    so_path = os.path.join(_CACHE_DIR, "_rtpu_fastpath-%s.so" % tag)
+    if not os.path.exists(so_path):
+        _compile(so_path)
+    spec = importlib.util.spec_from_file_location("_rtpu_fastpath", so_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _compile(so_path: str) -> None:
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    lock_path = os.path.join(_CACHE_DIR, ".build.lock")
+    import fcntl
+
+    with open(lock_path, "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)  # serialize concurrent workers
+        if os.path.exists(so_path):  # another process won the race
+            return
+        cc = (os.environ.get("CC") or "cc")
+        include = sysconfig.get_paths()["include"]
+        tmp = so_path + ".tmp.%d" % os.getpid()
+        cmd = [cc, "-O2", "-fPIC", "-shared", "-I", include, _SRC,
+               "-o", tmp]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                "fastpath compile failed (%s): %s" % (cc, proc.stderr[-2000:]))
+        os.replace(tmp, so_path)  # atomic publish

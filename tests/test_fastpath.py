@@ -1,0 +1,145 @@
+"""Native fused-submit path (cpp/fastpath.c).
+
+The C extension creates instances of the Python hot classes (TaskSpec,
+ObjectID, Reference, ObjectRef, PendingTaskEntry) via cached __slots__
+offsets; these tests pin the contract: byte-for-byte state parity with
+the pure-Python path, and end-to-end correctness through the whole
+runtime.  If the toolchain is missing the module must fail closed (pure
+Python), never silently corrupt — and the skip is loud, as with the C++
+cross-language client.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.native import load_fastpath
+
+
+def _require_native():
+    mod = load_fastpath()
+    if mod is None:
+        print("\nWARNING: native fastpath did not build - fused submit "
+              "path UNTESTED (pure-Python fallback covers behavior)")
+        pytest.skip("native fastpath unavailable (no compiler?)")
+    return mod
+
+
+def test_native_module_builds():
+    _require_native()
+
+
+def test_fast_path_active_and_e2e(ray_start_regular):
+    """1k argless template submissions flow through the C path and
+    produce correct results."""
+    _require_native()
+
+    @ray_tpu.remote
+    def one():
+        return 41 + 1
+
+    first = ray_tpu.get(one.remote())
+    assert first == 42
+    core = ray_tpu.worker.global_worker.core
+    assert core._fast_ctx is not None, \
+        "fast ctx should have been created by the template submit"
+    base = core._fast_ctx.submitted
+    refs = [one.remote() for _ in range(1000)]
+    assert core._fast_ctx.submitted - base == 1000
+    assert ray_tpu.get(refs) == [42] * 1000
+
+
+def test_state_parity_with_python_path(ray_start_regular):
+    """Field-by-field diff of the owner-side records produced by the C
+    and Python submit paths for the same template."""
+    _require_native()
+
+    @ray_tpu.remote
+    def blocked():
+        import time
+        time.sleep(2)  # long enough to snapshot pending state below
+        return "done"
+
+    core = ray_tpu.worker.global_worker.core
+
+    def snapshot(ref):
+        oid = ref.object_id
+        tid = oid.binary()[:24]
+        entry = core.pending_tasks[tid]
+        r = core.reference_counter._refs[oid]
+        return {
+            "ref_fields": (r.owned, r.owner_address, r.local_refs,
+                           r.submitted_refs, r.contained_in, r.contains,
+                           r.borrowers, r.locations, r.in_plasma,
+                           r.pinned_lineage, r.freed, r.size),
+            "entry": (entry.num_retries_left, len(entry.return_ids),
+                      entry.dep_ids == () or entry.dep_ids == [],
+                      entry.lineage_pinned, entry.recovery_waiter),
+            "spec": entry.spec,
+            "ret0": entry.return_ids[0],
+        }
+
+    # fast path (default)
+    fast_ref = blocked.remote()
+    assert core._fast_ctx is not None
+    fast = snapshot(fast_ref)
+
+    # forced slow path
+    saved = core._fast_ctx
+    core._fast_ctx = None
+    core._fast_ctx_failed = True
+    try:
+        slow_ref = blocked.remote()
+        slow = snapshot(slow_ref)
+    finally:
+        core._fast_ctx = saved
+        core._fast_ctx_failed = False
+
+    assert fast["ref_fields"] == slow["ref_fields"]
+    assert fast["entry"] == slow["entry"]
+    fs, ss = fast["spec"], slow["spec"]
+    for field in ("job_id", "task_type", "name", "fn_key", "num_returns",
+                  "resources", "max_retries", "retry_exceptions",
+                  "owner_address", "owner_worker_id", "actor_id",
+                  "actor_counter", "actor_creation", "runtime_env",
+                  "placement_group_id", "placement_group_bundle_index",
+                  "scheduling_strategy", "depth", "_sched"):
+        assert getattr(fs, field) == getattr(ss, field), field
+    assert fs.args == tuple(ss.args) == ()
+    assert fs.scheduling_class == ss.scheduling_class
+    # ids: same shape, distinct values
+    assert len(fs.task_id) == len(ss.task_id) == 24
+    assert fs.task_id[:16] == ss.task_id[:16]  # same lineage prefix
+    assert fs.task_id != ss.task_id
+    # return oid embeds the task id + index 1
+    assert fast["ret0"].binary() == fs.task_id + b"\x01\x00\x00\x00"
+    # ObjectID hash/eq interop between the two creation paths
+    from ray_tpu._private.ids import ObjectID
+    clone = ObjectID(fast["ret0"].binary())
+    assert clone == fast["ret0"] and hash(clone) == hash(fast["ret0"])
+    assert ray_tpu.get([fast_ref, slow_ref], timeout=60) == ["done"] * 2
+
+
+def test_ref_release_parity(ray_start_regular):
+    """Dropping the last ObjectRef from the C path releases the owned
+    object exactly like the Python path (same __del__ machinery)."""
+    _require_native()
+    import gc
+    import time
+
+    @ray_tpu.remote
+    def val():
+        return b"x" * 128
+
+    core = ray_tpu.worker.global_worker.core
+    ref = val.remote()
+    ray_tpu.get(ref)
+    oid = ref.object_id
+    assert oid in core.reference_counter._refs
+    del ref
+    gc.collect()
+    # decrefs are batched onto the io loop
+    for _ in range(100):
+        if oid not in core.reference_counter._refs:
+            break
+        time.sleep(0.05)
+    assert oid not in core.reference_counter._refs
