@@ -344,7 +344,8 @@ FastCtx_submit(FastCtx *self, PyObject *const *argv, Py_ssize_t nargs)
     Py_INCREF(Py_False); SLOT(ref, self->rf_off[RF_freed]) = Py_False;
     Py_INCREF(self->long0); SLOT(ref, self->rf_off[RF_size]) = self->long0;
 
-    if (PyDict_SetItem(self->refs_dict, oid, ref) < 0) goto fail;
+    /* bytes key: ReferenceCounter._refs hashes raw id bytes in C */
+    if (PyDict_SetItem(self->refs_dict, oid_b, ref) < 0) goto fail;
 
     /* -- 4. TaskSpec clone (mirror of TaskSpec.clone_for) -------------- */
     spec = alloc_instance(self->cls_taskspec);
@@ -582,19 +583,33 @@ FastCtx_complete_fast(FastCtx *self, PyObject *const *argv,
 
         PyObject *oid_b = PyList_GET_ITEM(ret0, 0);
         PyObject *meta = PyList_GET_ITEM(ret0, 2);
-        Py_ssize_t start = PyLong_AsSsize_t(PyList_GET_ITEM(ret0, 3));
-        Py_ssize_t cnt = PyLong_AsSsize_t(PyList_GET_ITEM(ret0, 4));
-        Py_ssize_t fstart = PyLong_AsSsize_t(PyList_GET_ITEM(rep, 1));
-        if ((start == -1 || cnt == -1 || fstart == -1) && PyErr_Occurred())
-            goto fail;
-        Py_ssize_t base = fstart + start;
-        if (base < 0 || cnt < 0 || base + cnt > PyList_GET_SIZE(rbufs)) {
-            PyErr_SetString(PyExc_IndexError,
-                            "reply frame range out of bounds");
-            goto fail;
+        if (PyList_GET_SIZE(ret0) > 6) {
+            /* inline return: payloads decoded with the reply header
+             * (task_executor INLINE_RETURN_MAX); the decoded list is
+             * fresh from msgpack, safe to adopt as .frames */
+            PyObject *il = PyList_GET_ITEM(ret0, 6);
+            if (!PyList_Check(il))
+                goto slow_item;
+            Py_INCREF(il);
+            frames = il;
+        } else {
+            Py_ssize_t start =
+                PyLong_AsSsize_t(PyList_GET_ITEM(ret0, 3));
+            Py_ssize_t cnt = PyLong_AsSsize_t(PyList_GET_ITEM(ret0, 4));
+            Py_ssize_t fstart = PyLong_AsSsize_t(PyList_GET_ITEM(rep, 1));
+            if ((start == -1 || cnt == -1 || fstart == -1) &&
+                PyErr_Occurred())
+                goto fail;
+            Py_ssize_t base = fstart + start;
+            if (base < 0 || cnt < 0 ||
+                base + cnt > PyList_GET_SIZE(rbufs)) {
+                PyErr_SetString(PyExc_IndexError,
+                                "reply frame range out of bounds");
+                goto fail;
+            }
+            frames = PyList_GetSlice(rbufs, base, base + cnt);
+            if (frames == NULL) goto fail;
         }
-        frames = PyList_GetSlice(rbufs, base, base + cnt);
-        if (frames == NULL) goto fail;
 
         serobj = alloc_instance(self->cls_serialized);
         if (serobj == NULL) goto fail;
@@ -646,6 +661,130 @@ fail:
     return NULL;
 }
 
+/* build_push(batch) -> (tails, theaders, frames)
+ *
+ * The per-spec wire-assembly loop of _push_task_batch_nowait: proto
+ * dedup (linear scan, capped — duplicate tails are legal wire, dedup is
+ * only an optimization), argless fast path, theader rows.  Python
+ * callbacks (tail_wire / _args_wire) run only once per distinct proto /
+ * per argful spec.
+ */
+#define BP_MAX_PROTOS 32
+
+static PyObject *
+FastCtx_build_push(FastCtx *self, PyObject *const *argv, Py_ssize_t nargs)
+{
+    if (nargs != 1 || !PyList_Check(argv[0])) {
+        PyErr_SetString(PyExc_TypeError, "build_push(batch: list)");
+        return NULL;
+    }
+    PyObject *batch = argv[0];
+    Py_ssize_t n = PyList_GET_SIZE(batch);
+    PyObject *tails = PyList_New(0);
+    PyObject *theaders = PyList_New(0);
+    PyObject *frames = PyList_New(0);
+    PyObject *row = NULL, *aw = NULL, *afr = NULL;
+    PyObject *seen[BP_MAX_PROTOS];
+    Py_ssize_t seen_idx[BP_MAX_PROTOS];
+    int nseen = 0;
+    if (tails == NULL || theaders == NULL || frames == NULL) goto fail;
+
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *spec = PyList_GET_ITEM(batch, i);     /* borrowed */
+        PyObject *proto = SLOT(spec, self->ts_off[TS__proto]);
+        if (proto == NULL || proto == Py_None)
+            proto = spec;
+        Py_ssize_t pidx = -1;
+        for (int k = 0; k < nseen; k++) {
+            if (seen[k] == proto) { pidx = seen_idx[k]; break; }
+        }
+        if (pidx < 0) {
+            PyObject *tail =
+                PyObject_CallMethod(proto, "tail_wire", NULL);
+            if (tail == NULL) goto fail;
+            pidx = PyList_GET_SIZE(tails);
+            int rc = PyList_Append(tails, tail);
+            Py_DECREF(tail);
+            if (rc < 0) goto fail;
+            if (nseen < BP_MAX_PROTOS) {
+                seen[nseen] = proto;
+                seen_idx[nseen] = pidx;
+                nseen++;
+            }
+        }
+        PyObject *spec_args = SLOT(spec, self->ts_off[TS_args]);
+        Py_ssize_t nafr = 0;
+        Py_ssize_t fstart = PyList_GET_SIZE(frames);
+        int argful = spec_args != NULL && PyObject_IsTrue(spec_args);
+        if (argful < 0) goto fail;
+        if (argful) {
+            PyObject *pair =
+                PyObject_CallMethod(spec, "_args_wire", NULL);
+            if (pair == NULL || !PyTuple_Check(pair) ||
+                PyTuple_GET_SIZE(pair) != 2) {
+                Py_XDECREF(pair);
+                if (!PyErr_Occurred())
+                    PyErr_SetString(PyExc_TypeError,
+                                    "_args_wire must return a 2-tuple");
+                goto fail;
+            }
+            aw = PyTuple_GET_ITEM(pair, 0); Py_INCREF(aw);
+            afr = PyTuple_GET_ITEM(pair, 1); Py_INCREF(afr);
+            Py_DECREF(pair);
+            PyObject *ext = PySequence_List(afr);
+            if (ext == NULL) goto fail;
+            nafr = PyList_GET_SIZE(ext);
+            for (Py_ssize_t j = 0; j < nafr; j++) {
+                if (PyList_Append(frames,
+                                  PyList_GET_ITEM(ext, j)) < 0) {
+                    Py_DECREF(ext);
+                    goto fail;
+                }
+            }
+            Py_DECREF(ext);
+            Py_CLEAR(afr);
+        } else {
+            aw = self->empty_tuple;
+            Py_INCREF(aw);
+        }
+        PyObject *tid = SLOT(spec, self->ts_off[TS_task_id]);
+        PyObject *tctx = SLOT(spec, self->ts_off[TS_trace_ctx]);
+        if (tid == NULL) {
+            PyErr_SetString(PyExc_AttributeError, "spec missing task_id");
+            goto fail;
+        }
+        if (tctx == NULL)
+            tctx = Py_None;
+        row = PyList_New(6);
+        if (row == NULL) goto fail;
+        PyObject *px = PyLong_FromSsize_t(pidx);
+        PyObject *fs = PyLong_FromSsize_t(fstart);
+        PyObject *na = PyLong_FromSsize_t(nafr);
+        if (px == NULL || fs == NULL || na == NULL) {
+            Py_XDECREF(px); Py_XDECREF(fs); Py_XDECREF(na);
+            goto fail;
+        }
+        PyList_SET_ITEM(row, 0, px);
+        Py_INCREF(tid);  PyList_SET_ITEM(row, 1, tid);
+        PyList_SET_ITEM(row, 2, aw); aw = NULL;  /* moved */
+        PyList_SET_ITEM(row, 3, fs);
+        PyList_SET_ITEM(row, 4, na);
+        Py_INCREF(tctx); PyList_SET_ITEM(row, 5, tctx);
+        if (PyList_Append(theaders, row) < 0) goto fail;
+        Py_CLEAR(row);
+    }
+    {
+        PyObject *out = PyTuple_Pack(3, tails, theaders, frames);
+        Py_DECREF(tails); Py_DECREF(theaders); Py_DECREF(frames);
+        return out;
+    }
+
+fail:
+    Py_XDECREF(tails); Py_XDECREF(theaders); Py_XDECREF(frames);
+    Py_XDECREF(row); Py_XDECREF(aw); Py_XDECREF(afr);
+    return NULL;
+}
+
 static PyObject *
 FastCtx_get_submitted(FastCtx *self, void *closure)
 {
@@ -657,6 +796,8 @@ static PyMethodDef FastCtx_methods[] = {
      METH_FASTCALL, "fused template-task submission"},
     {"complete_fast", (PyCFunction)(void (*)(void))FastCtx_complete_fast,
      METH_FASTCALL, "fused batch-reply completion (fast shape only)"},
+    {"build_push", (PyCFunction)(void (*)(void))FastCtx_build_push,
+     METH_FASTCALL, "fused PushTasks wire assembly for one batch"},
     {NULL, NULL, 0, NULL},
 };
 

@@ -1,0 +1,69 @@
+import time, os
+import ray_tpu
+
+ray_tpu.init(num_cpus=2)
+
+@ray_tpu.remote
+def f():
+    return b"ok"
+
+ray_tpu.get(f.remote())  # warm template + fast ctx
+core = ray_tpu.worker.global_worker.core
+tmpl = None
+import ray_tpu.remote_function as rf
+# grab the cached template proto
+tmpl = f._template[2]
+ctx = core._fast_ctx
+prefix = core._task_lineage_prefix
+
+N = 200_000
+t0 = time.perf_counter()
+for _ in range(N):
+    ctx.submit(tmpl, prefix, None)
+dt = time.perf_counter() - t0
+print(f"ctx.submit: {dt/N*1e6:.2f} us/call")
+
+# drain the flood quietly
+core.pending_tasks.clear()
+
+# build_push: synthetic batch of 440 cloned specs
+from ray_tpu._private.ids import make_task_id_bytes
+batch = [tmpl.clone_for(make_task_id_bytes(prefix), ()) for _ in range(440)]
+M = 300
+t0 = time.perf_counter()
+for _ in range(M):
+    ctx.build_push(batch)
+dt = time.perf_counter() - t0
+print(f"build_push(C): {dt/M/len(batch)*1e6:.2f} us/task")
+
+def build_py(batch):
+    tails, tail_idx, theaders, frames = [], {}, [], []
+    for spec in batch:
+        proto = spec._proto or spec
+        pidx = tail_idx.get(id(proto))
+        if pidx is None:
+            pidx = tail_idx[id(proto)] = len(tails)
+            tails.append(proto.tail_wire())
+        args_wire, afr = spec._args_wire()
+        theaders.append([pidx, spec.task_id, args_wire, len(frames), len(afr), spec.trace_ctx])
+        frames.extend(afr)
+    return tails, theaders, frames
+
+t0 = time.perf_counter()
+for _ in range(M):
+    build_py(batch)
+dt = time.perf_counter() - t0
+print(f"build_push(py): {dt/M/len(batch)*1e6:.2f} us/task")
+
+# python submit path comparison
+core._fast_ctx_saved = ctx
+core._fast_ctx = None
+core._fast_ctx_failed = True
+t0 = time.perf_counter()
+for _ in range(50_000):
+    core.submit_task_from_template(tmpl, [])
+dt = time.perf_counter() - t0
+print(f"py submit: {dt/50_000*1e6:.2f} us/call")
+core._fast_ctx = ctx
+core._fast_ctx_failed = False
+os._exit(0)

@@ -1,0 +1,43 @@
+import time, os
+import ray_tpu
+
+ray_tpu.init(num_cpus=2)
+
+@ray_tpu.remote
+def f():
+    return b"ok"
+
+ray_tpu.get(f.remote())
+core = ray_tpu.worker.global_worker.core
+tmpl = f._template[2]
+ctx = core._fast_ctx
+prefix = core._task_lineage_prefix
+
+# freeze the io-loop drain: flag stays True so submit never wakes it
+core._submit_scheduled = True
+
+N = 300_000
+t0 = time.perf_counter()
+for _ in range(N):
+    ctx.submit(tmpl, prefix, None)
+dt = time.perf_counter() - t0
+print(f"ctx.submit isolated: {dt/N*1e6:.3f} us/call")
+
+core.pending_tasks.clear(); core._submit_buffer.clear()
+core.reference_counter._refs.clear()
+
+t0 = time.perf_counter()
+for _ in range(100_000):
+    core.submit_task_from_template(tmpl, [])
+dt = time.perf_counter() - t0
+print(f"py submit isolated: {dt/100_000*1e6:.3f} us/call")
+
+# remote() wrapper overhead on top of ctx.submit
+core.pending_tasks.clear(); core._submit_buffer.clear()
+core.reference_counter._refs.clear()
+t0 = time.perf_counter()
+for _ in range(100_000):
+    f.remote()
+dt = time.perf_counter() - t0
+print(f"f.remote() isolated: {dt/100_000*1e6:.3f} us/call")
+os._exit(0)

@@ -1061,11 +1061,15 @@ class CoreWorker:
         touched_actors: Dict[bytes, ActorQueueState] = {}
         for kind, spec in items:
             if kind == "task":
-                if spec.dependency_ids():
+                # args check first: the dominant argless submit skips
+                # the dependency_ids() call entirely
+                if spec.args and spec.dependency_ids():
                     # Owned args may be pending: resolve asynchronously.
                     self.loop.create_task(self._submit_when_ready(spec))
                     continue
-                sc = spec.scheduling_class
+                sc = spec._sched  # interned at template creation
+                if sc < 0:
+                    sc = spec.scheduling_class
                 state = self.scheduling_keys.get(sc)
                 if state is None:
                     state = self.scheduling_keys[sc] = \
@@ -1396,20 +1400,25 @@ class CoreWorker:
         and attach completion handling to the reply future — no per-task
         coroutine, no per-task syscall. Static spec fields ride once per
         distinct prototype (TaskSpec.tail_wire), not once per task."""
-        tails: List[list] = []
-        tail_idx: Dict[int, int] = {}
-        theaders: List[list] = []
-        frames: List[bytes] = []
-        for spec in batch:
-            proto = spec._proto or spec
-            pidx = tail_idx.get(id(proto))
-            if pidx is None:
-                pidx = tail_idx[id(proto)] = len(tails)
-                tails.append(proto.tail_wire())
-            args_wire, afr = spec._args_wire()
-            theaders.append([pidx, spec.task_id, args_wire, len(frames),
-                             len(afr), spec.trace_ctx])
-            frames.extend(afr)
+        ctx = self._fast_ctx
+        if ctx is not None:
+            tails, theaders, frames = ctx.build_push(batch)
+        else:
+            tails_l: List[list] = []
+            tail_idx: Dict[int, int] = {}
+            theaders_l: List[list] = []
+            frames_l: List[bytes] = []
+            for spec in batch:
+                proto = spec._proto or spec
+                pidx = tail_idx.get(id(proto))
+                if pidx is None:
+                    pidx = tail_idx[id(proto)] = len(tails_l)
+                    tails_l.append(proto.tail_wire())
+                args_wire, afr = spec._args_wire()
+                theaders_l.append([pidx, spec.task_id, args_wire,
+                                   len(frames_l), len(afr), spec.trace_ctx])
+                frames_l.extend(afr)
+            tails, theaders, frames = tails_l, theaders_l, frames_l
         try:
             fut = lw.conn.call_nowait("PushTasks",
                                       {"protos": tails, "tasks": theaders},
@@ -1516,12 +1525,19 @@ class CoreWorker:
                 if entry.recovery_waiter is not None:
                     slow.append(i)
                     continue
-                oid_b, _ip, meta, start, n, _cont = rets[0]
-                # `start` is task-relative; `fstart` locates this
-                # task's frames inside the batch buffer
-                base = fstart + start
+                ret0 = rets[0]
+                oid_b, _ip, meta, start, n, _cont = ret0[:6]
+                if len(ret0) > 6:
+                    # inline return: payload frames decoded with the
+                    # reply header (task_executor INLINE_RETURN_MAX)
+                    frames = ret0[6]
+                else:
+                    # `start` is task-relative; `fstart` locates this
+                    # task's frames inside the batch buffer
+                    base = fstart + start
+                    frames = rbufs[base:base + n]
                 put_pairs.append((ObjectID(oid_b), SerializedObject(
-                    meta, rbufs[base:base + n])))
+                    meta, frames)))
                 finished += 1
                 self._finish_pending_entry(spec, entry, keep_lineage)
                 continue
@@ -1542,14 +1558,17 @@ class CoreWorker:
             self.stats["tasks_retried"] += 1
             self._queue_spec(spec)
             return
-        for oid_b, in_plasma, meta, start, n, contained_b in reply[1]:
+        for ret in reply[1]:
+            oid_b, in_plasma, meta, start, n, contained_b = ret[:6]
             oid = ObjectID(oid_b)
             if in_plasma:
                 # plasma entry: meta=node_id, start=size
                 self.reference_counter.add_location(oid, meta, start)
                 self.memory_store.put(oid, IN_PLASMA)
             else:
-                obj = SerializedObject(meta, rbufs[start:start + n])
+                frames = ret[6] if len(ret) > 6 \
+                    else rbufs[start:start + n]
+                obj = SerializedObject(meta, frames)
                 if contained_b:
                     contained = [ObjectID(b) for b in contained_b]
                     self.reference_counter.add_contained_refs(oid, contained)
@@ -1558,7 +1577,7 @@ class CoreWorker:
         self.stats["tasks_finished"] += 1
         if spec.args and not spec.is_actor_task():
             self.reference_counter.update_finished_task_references(
-                [ObjectID(b) for b in spec.dependency_ids()])
+                spec.dependency_ids())
         self._finish_pending_entry(
             spec, entry, self.config.lineage_reconstruction_enabled)
 
@@ -1589,7 +1608,7 @@ class CoreWorker:
             if not waiter.done():
                 waiter.set_result(True)
         self.reference_counter.update_finished_task_references(
-            [ObjectID(b) for b in spec.dependency_ids()])
+            spec.dependency_ids())
 
     # ------------------------------------------------------------- actors
 
@@ -1824,7 +1843,7 @@ class CoreWorker:
             self._complete_task(spec, rheader, rbufs[fstart:fstart + nframes])
             if spec.args:
                 self.reference_counter.update_finished_task_references(
-                    [ObjectID(b) for b in spec.dependency_ids()])
+                    spec.dependency_ids())
         if requeue:
             q.buffer.extendleft(reversed(requeue))
 
@@ -1848,7 +1867,7 @@ class CoreWorker:
             self._complete_task(spec, rheader, list(bufs))
             if spec.args:
                 self.reference_counter.update_finished_task_references(
-                    [ObjectID(b) for b in spec.dependency_ids()])
+                    spec.dependency_ids())
         return handler
 
     def cancel(self, ref: ObjectRef, force: bool = False):

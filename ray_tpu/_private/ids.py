@@ -146,6 +146,13 @@ class TaskID(BaseID):
 OID_SUFFIX = tuple((i + 1).to_bytes(4, "little") for i in range(64))
 
 
+def id_key(object_id) -> bytes:
+    """Raw-bytes key of an id: accepts an ObjectID (or any BaseID) or the
+    bytes themselves.  The owner-side tables (memory store, reference
+    counter) key by raw bytes so dict probes hash in C."""
+    return object_id if type(object_id) is bytes else object_id._bytes
+
+
 def make_task_id_bytes(lineage_prefix16: bytes) -> bytes:
     """task_id = 16-byte actor/lineage prefix + 8 random bytes."""
     return lineage_prefix16 + _random_bytes(TASK_ID_SIZE - ACTOR_ID_SIZE)

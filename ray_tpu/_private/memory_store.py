@@ -25,7 +25,7 @@ import asyncio
 import threading
 from typing import Callable, Dict, List
 
-from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.ids import ObjectID, id_key as _key
 from ray_tpu._private.serialization import SerializedObject
 
 
@@ -37,10 +37,6 @@ class InPlasmaSentinel:
 
 
 IN_PLASMA = InPlasmaSentinel()
-
-
-def _key(object_id) -> bytes:
-    return object_id if type(object_id) is bytes else object_id._bytes
 
 
 def _set_result_safe(fut: asyncio.Future, obj) -> None:

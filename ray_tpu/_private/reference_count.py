@@ -1,19 +1,19 @@
-"""Distributed ownership-based reference counting.
+"""Distributed reference counting: ownership, borrowing, containment.
 
-Role parity: reference ReferenceCounter (src/ray/core_worker/
-reference_count.h) — the process that creates an object (by ``put`` or by
-submitting the task that returns it) is its *owner* and tracks:
+Role parity with the reference's ReferenceCounter (reference:
+src/ray/core_worker/reference_count.h:57) — every object has exactly one
+owner (the worker that created it); other holders are borrowers who
+report to the owner; submitted-task arguments hold refs while in flight;
+values containing ObjectRefs pin the inner objects via containment
+edges. When an owned object's counts drain, release callbacks free the
+data everywhere (memory store, shm segments, remote replicas).
 
-  * local refs     — live ObjectRef instances in this process
-  * submitted refs — uses of the object as args of not-yet-finished tasks
-  * contained-in   — refs serialized inside other owned values
-  * borrowers      — remote processes holding deserialized copies of the ref
-
-The object is freeable when all four are empty. Borrower processes report
-themselves to the owner (AddBorrower) on first deserialization and notify
-it (RemoveBorrower) when their own count drops to zero — the RPC analog of
-the reference's WaitForRefRemoved long-poll protocol. Lineage (the creating
-TaskSpec) stays pinned while the object may still need reconstruction.
+Keying: the internal table is keyed by the id's raw 28 bytes (C-speed
+dict hashing — an ObjectID key would run a Python ``__hash__`` frame on
+every probe; the submit hot path does one insert per task and teardown
+does one pop per object).  Public methods accept ObjectID or raw bytes;
+callbacks always receive a real ObjectID, reconstructed on the (cold)
+release/borrow-removed paths.
 """
 
 from __future__ import annotations
@@ -22,7 +22,7 @@ import logging
 import threading
 from typing import Callable, Dict, List, Optional, Set
 
-from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.ids import ObjectID, id_key as _key
 
 logger = logging.getLogger(__name__)
 
@@ -42,8 +42,8 @@ class Reference:
         self.owner_address = ""
         self.local_refs = 0
         self.submitted_refs = 0
-        self.contained_in: Optional[Set[ObjectID]] = None
-        self.contains: Optional[Set[ObjectID]] = None
+        self.contained_in: Optional[Set[bytes]] = None
+        self.contains: Optional[Set[bytes]] = None
         self.borrowers: Optional[Set[str]] = None
         # Object data locations (node ids) — owner-resident location index,
         # the analog of OwnershipBasedObjectDirectory.
@@ -65,11 +65,11 @@ class ReferenceCounter:
 
     def __init__(self, own_address: str = ""):
         self._lock = threading.RLock()
-        self._refs: Dict[ObjectID, Reference] = {}
+        self._refs: Dict[bytes, Reference] = {}
         self.own_address = own_address
         # Fired when an owned object becomes releasable: storage layers
         # delete data; lineage unpins.
-        self._on_release: List[Callable[[ObjectID], None]] = []
+        self._on_release: List[Callable[[ObjectID, "Reference"], None]] = []
         # Fired to tell a remote owner we dropped a borrowed ref.
         self._on_borrow_removed: List[Callable[[ObjectID, str], None]] = []
 
@@ -83,18 +83,19 @@ class ReferenceCounter:
 
     # -- ownership ----------------------------------------------------------
 
-    def add_owned_object(self, object_id: ObjectID, in_plasma: bool = False,
+    def add_owned_object(self, object_id, in_plasma: bool = False,
                          pin_lineage: bool = False) -> None:
+        k = _key(object_id)
         with self._lock:
-            ref = self._refs.get(object_id)
+            ref = self._refs.get(k)
             if ref is None:
-                ref = self._refs[object_id] = Reference()
+                ref = self._refs[k] = Reference()
             ref.owned = True
             ref.owner_address = self.own_address
             ref.in_plasma = in_plasma
             ref.pinned_lineage = pin_lineage
 
-    def add_owned_with_local_ref(self, object_id: ObjectID,
+    def add_owned_with_local_ref(self, object_id,
                                  pin_lineage: bool = False) -> None:
         """Fused add_owned_object + add_local_reference, LOCK-FREE on
         the per-task submit path: the id was freshly minted by the
@@ -103,98 +104,103 @@ class ReferenceCounter:
         GIL-atomic, and concurrent mutations of OTHER keys don't
         interleave with them (callers construct the ObjectRef with
         skip_adding_local_ref=True)."""
-        ref = self._refs.get(object_id)
+        k = _key(object_id)
+        ref = self._refs.get(k)
         if ref is None:
-            ref = self._refs[object_id] = Reference()
+            ref = self._refs[k] = Reference()
         ref.owned = True
         ref.owner_address = self.own_address
         ref.local_refs += 1
         ref.pinned_lineage = pin_lineage
 
-    def add_borrowed_object(self, object_id: ObjectID, owner_address: str) -> bool:
+    def add_borrowed_object(self, object_id, owner_address: str) -> bool:
         """Returns True if this is the first borrow (caller should notify
         the owner)."""
+        k = _key(object_id)
         with self._lock:
-            ref = self._refs.get(object_id)
+            ref = self._refs.get(k)
             first = ref is None or (not ref.owned and not ref.local_refs
                                     and not ref.submitted_refs)
             if ref is None:
-                ref = self._refs[object_id] = Reference()
+                ref = self._refs[k] = Reference()
             if not ref.owned:
                 ref.owner_address = owner_address
             return first
 
-    def owner_address_of(self, object_id: ObjectID) -> str:
+    def owner_address_of(self, object_id) -> str:
         with self._lock:
-            ref = self._refs.get(object_id)
+            ref = self._refs.get(_key(object_id))
             return ref.owner_address if ref else ""
 
-    def is_owned(self, object_id: ObjectID) -> bool:
+    def is_owned(self, object_id) -> bool:
         with self._lock:
-            ref = self._refs.get(object_id)
+            ref = self._refs.get(_key(object_id))
             return bool(ref and ref.owned)
 
     # -- local refs ---------------------------------------------------------
 
-    def add_local_reference(self, object_id: ObjectID) -> None:
+    def add_local_reference(self, object_id) -> None:
         with self._lock:
-            ref = self._refs.setdefault(object_id, Reference())
+            ref = self._refs.setdefault(_key(object_id), Reference())
             ref.local_refs += 1
 
-    def remove_local_reference(self, object_id: ObjectID) -> None:
-        self._decrement(object_id, "local")
+    def remove_local_reference(self, object_id) -> None:
+        self._decrement(_key(object_id), "local")
 
     # -- submitted-task refs ------------------------------------------------
 
-    def update_submitted_task_references(self, arg_ids: List[ObjectID]) -> None:
+    def update_submitted_task_references(self, arg_ids) -> None:
         with self._lock:
             for oid in arg_ids:
-                ref = self._refs.setdefault(oid, Reference())
+                ref = self._refs.setdefault(_key(oid), Reference())
                 ref.submitted_refs += 1
 
-    def update_finished_task_references(self, arg_ids: List[ObjectID]) -> None:
+    def update_finished_task_references(self, arg_ids) -> None:
         for oid in arg_ids:
-            self._decrement(oid, "submitted")
+            self._decrement(_key(oid), "submitted")
 
     # -- containment --------------------------------------------------------
 
-    def add_contained_refs(self, outer: ObjectID, inner: List[ObjectID]) -> None:
+    def add_contained_refs(self, outer, inner) -> None:
+        ko = _key(outer)
         with self._lock:
-            outer_ref = self._refs.setdefault(outer, Reference())
+            outer_ref = self._refs.setdefault(ko, Reference())
             if outer_ref.contains is None:
                 outer_ref.contains = set()
             for oid in inner:
-                inner_ref = self._refs.setdefault(oid, Reference())
+                ki = _key(oid)
+                inner_ref = self._refs.setdefault(ki, Reference())
                 if inner_ref.contained_in is None:
                     inner_ref.contained_in = set()
-                inner_ref.contained_in.add(outer)
-                outer_ref.contains.add(oid)
+                inner_ref.contained_in.add(ko)
+                outer_ref.contains.add(ki)
 
     # -- borrowers (owner side) ---------------------------------------------
 
-    def add_borrower(self, object_id: ObjectID, borrower_address: str) -> None:
+    def add_borrower(self, object_id, borrower_address: str) -> None:
         with self._lock:
-            ref = self._refs.setdefault(object_id, Reference())
+            ref = self._refs.setdefault(_key(object_id), Reference())
             if borrower_address != self.own_address:
                 if ref.borrowers is None:
                     ref.borrowers = set()
                 ref.borrowers.add(borrower_address)
 
-    def remove_borrower(self, object_id: ObjectID, borrower_address: str) -> None:
+    def remove_borrower(self, object_id, borrower_address: str) -> None:
+        k = _key(object_id)
         with self._lock:
-            ref = self._refs.get(object_id)
+            ref = self._refs.get(k)
             if ref is None:
                 return
             if ref.borrowers:
                 ref.borrowers.discard(borrower_address)
-        self._maybe_release(object_id)
+        self._maybe_release(k)
 
     # -- locations (owner-resident object directory) ------------------------
 
-    def add_location(self, object_id: ObjectID, node_id: bytes,
+    def add_location(self, object_id, node_id: bytes,
                      size: int = 0) -> None:
         with self._lock:
-            ref = self._refs.setdefault(object_id, Reference())
+            ref = self._refs.setdefault(_key(object_id), Reference())
             if ref.locations is None:
                 ref.locations = set()
             ref.locations.add(node_id)
@@ -202,13 +208,13 @@ class ReferenceCounter:
             if size:
                 ref.size = size
 
-    def add_location_if_tracked(self, object_id: ObjectID,
+    def add_location_if_tracked(self, object_id,
                                 node_id: bytes) -> bool:
         """Like ``add_location`` but refuses to resurrect a released
         ref (a late replica report racing the owner's final release
         must not re-create the entry — the replica would leak)."""
         with self._lock:
-            ref = self._refs.get(object_id)
+            ref = self._refs.get(_key(object_id))
             if ref is None:
                 return False
             if ref.locations is None:
@@ -217,32 +223,32 @@ class ReferenceCounter:
             ref.in_plasma = True
             return True
 
-    def remove_location(self, object_id: ObjectID, node_id: bytes) -> None:
+    def remove_location(self, object_id, node_id: bytes) -> None:
         with self._lock:
-            ref = self._refs.get(object_id)
+            ref = self._refs.get(_key(object_id))
             if ref and ref.locations:
                 ref.locations.discard(node_id)
 
-    def get_locations(self, object_id: ObjectID) -> Set[bytes]:
+    def get_locations(self, object_id) -> Set[bytes]:
         with self._lock:
-            ref = self._refs.get(object_id)
+            ref = self._refs.get(_key(object_id))
             return set(ref.locations) if ref and ref.locations else set()
 
-    def location_info(self, object_id: ObjectID):
+    def location_info(self, object_id):
         """(size_bytes, sorted location node ids) for locality scheduling
         (reference: the owner-fed LocalityData in lease_policy.h)."""
         with self._lock:
-            ref = self._refs.get(object_id)
+            ref = self._refs.get(_key(object_id))
             if ref is None:
                 return 0, []
             return ref.size, sorted(ref.locations or ())
 
     # -- internals ----------------------------------------------------------
 
-    def _decrement(self, object_id: ObjectID, kind: str) -> None:
+    def _decrement(self, k: bytes, kind: str) -> None:
         notify_owner = None
         with self._lock:
-            ref = self._refs.get(object_id)
+            ref = self._refs.get(k)
             if ref is None:
                 return
             if kind == "local":
@@ -253,44 +259,46 @@ class ReferenceCounter:
                     and ref.submitted_refs == 0 and ref.owner_address):
                 notify_owner = ref.owner_address
         if notify_owner:
+            oid = ObjectID(k)
             for cb in self._on_borrow_removed:
                 try:
-                    cb(object_id, notify_owner)
+                    cb(oid, notify_owner)
                 except Exception:
                     logger.exception("borrow-removed callback failed")
-        self._maybe_release(object_id)
+        self._maybe_release(k)
 
-    def _maybe_release(self, object_id: ObjectID) -> None:
+    def _maybe_release(self, k: bytes) -> None:
         # Release callbacks receive the popped Reference record: the entry
         # leaves the table BEFORE callbacks fire (so late borrower/location
         # reports can't resurrect it), but the callback still needs the
         # ownership bit and the location set to free remote replicas.
         to_release: List[tuple] = []
         with self._lock:
-            ref = self._refs.get(object_id)
+            ref = self._refs.get(k)
             if ref is None or ref.freed or not ref.is_releasable():
                 return
             # Transitive containment walk: releasing an outer object drops
             # the containment edges on its inner objects, which may free
             # them — and their own contained objects, to any depth.
-            stack = [(object_id, ref)]
+            stack = [(k, ref)]
             while stack:
-                oid, r = stack.pop()
+                ki, r = stack.pop()
                 if r.freed:
                     continue
                 r.freed = True
-                to_release.append((oid, r))
+                to_release.append((ki, r))
                 for inner in list(r.contains or ()):
                     iref = self._refs.get(inner)
                     if iref is None:
                         continue
                     if iref.contained_in:
-                        iref.contained_in.discard(oid)
+                        iref.contained_in.discard(ki)
                     if iref.is_releasable() and not iref.freed:
                         stack.append((inner, iref))
-            for oid, _ in to_release:
-                self._refs.pop(oid, None)
-        for oid, r in to_release:
+            for ki, _ in to_release:
+                self._refs.pop(ki, None)
+        for ki, r in to_release:
+            oid = ObjectID(ki)
             for cb in self._on_release:
                 try:
                     cb(oid, r)
@@ -317,12 +325,12 @@ class ReferenceCounter:
 
     def all_refs(self) -> Dict[str, dict]:
         return {
-            oid.hex(): {
+            k.hex(): {
                 "owned": r.owned,
                 "local_refs": r.local_refs,
                 "submitted_refs": r.submitted_refs,
                 "borrowers": sorted(r.borrowers or ()),
                 "in_plasma": r.in_plasma,
             }
-            for oid, r in list(self._refs.items())
+            for k, r in list(self._refs.items())
         }

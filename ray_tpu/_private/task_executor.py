@@ -40,6 +40,12 @@ from ray_tpu._private.task_spec import (ARG_REF, ARG_VALUE, REPLY_ERROR,
 
 logger = logging.getLogger(__name__)
 
+# Returns whose serialized size fits here ride inside the msgpack reply
+# header (decoded by the owner's single C unpackb) instead of as
+# out-of-band frames; larger values keep the frame path, which writes
+# zero-copy from the worker (writev) and costs one copy on receive.
+INLINE_RETURN_MAX = 4096
+
 _task_ctx = threading.local()
 
 
@@ -496,9 +502,21 @@ class TaskExecutor:
             return [REPLY_OK, ()], []
         if spec.num_returns == 1:
             if type(result) is bytes and \
+                    len(result) <= INLINE_RETURN_MAX and \
                     len(result) <= self.core.config.max_direct_call_object_size:
-                # Fastest path: a raw-bytes return inlines with no
-                # serializer object at all.
+                # Fastest path: a small raw-bytes return rides INSIDE
+                # the msgpack reply header (7th element) — the owner's
+                # one C unpackb decodes it, skipping the out-of-band
+                # frame loop (profiled ~2.4us/task of per-frame
+                # parse+copy on the driver loop).
+                return [REPLY_OK, [
+                    [return_object_id_bytes(spec.task_id, 1), 0, META_RAW,
+                     0, 0, (), [result]],
+                ]], []
+            if type(result) is bytes and \
+                    len(result) <= self.core.config.max_direct_call_object_size:
+                # Raw-bytes return, too big to inline in the header:
+                # ride out-of-band with no serializer object at all.
                 return [REPLY_OK, [
                     [return_object_id_bytes(spec.task_id, 1), 0, META_RAW,
                      0, 1, ()],
@@ -508,10 +526,15 @@ class TaskExecutor:
             if serialized.total_bytes() <= \
                     self.core.config.max_direct_call_object_size:
                 meta, frames = serialized.to_wire()
+                contained = [r.binary() for r in serialized.contained_refs]
+                if serialized.total_bytes() <= INLINE_RETURN_MAX:
+                    return [REPLY_OK, [
+                        [return_object_id_bytes(spec.task_id, 1), 0, meta,
+                         0, 0, contained, frames],
+                    ]], []
                 return [REPLY_OK, [
                     [return_object_id_bytes(spec.task_id, 1), 0, meta, 0,
-                     len(frames),
-                     [r.binary() for r in serialized.contained_refs]],
+                     len(frames), contained],
                 ]], frames
             results = [result]
         else:

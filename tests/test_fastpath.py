@@ -65,7 +65,7 @@ def test_state_parity_with_python_path(ray_start_regular):
         oid = ref.object_id
         tid = oid.binary()[:24]
         entry = core.pending_tasks[tid]
-        r = core.reference_counter._refs[oid]
+        r = core.reference_counter._refs[oid.binary()]
         return {
             "ref_fields": (r.owned, r.owner_address, r.local_refs,
                            r.submitted_refs, r.contained_in, r.contains,
@@ -133,13 +133,13 @@ def test_ref_release_parity(ray_start_regular):
     core = ray_tpu.worker.global_worker.core
     ref = val.remote()
     ray_tpu.get(ref)
-    oid = ref.object_id
-    assert oid in core.reference_counter._refs
+    key = ref.object_id.binary()
+    assert key in core.reference_counter._refs
     del ref
     gc.collect()
     # decrefs are batched onto the io loop
     for _ in range(100):
-        if oid not in core.reference_counter._refs:
+        if key not in core.reference_counter._refs:
             break
         time.sleep(0.05)
-    assert oid not in core.reference_counter._refs
+    assert key not in core.reference_counter._refs
