@@ -715,8 +715,11 @@ FastCtx_build_push(FastCtx *self, PyObject *const *argv, Py_ssize_t nargs)
         PyObject *spec_args = SLOT(spec, self->ts_off[TS_args]);
         Py_ssize_t nafr = 0;
         Py_ssize_t fstart = PyList_GET_SIZE(frames);
-        int argful = spec_args != NULL && PyObject_IsTrue(spec_args);
-        if (argful < 0) goto fail;
+        int argful = 0;
+        if (spec_args != NULL) {
+            argful = PyObject_IsTrue(spec_args);
+            if (argful < 0) goto fail;
+        }
         if (argful) {
             PyObject *pair =
                 PyObject_CallMethod(spec, "_args_wire", NULL);

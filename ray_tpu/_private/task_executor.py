@@ -502,21 +502,19 @@ class TaskExecutor:
             return [REPLY_OK, ()], []
         if spec.num_returns == 1:
             if type(result) is bytes and \
-                    len(result) <= INLINE_RETURN_MAX and \
                     len(result) <= self.core.config.max_direct_call_object_size:
-                # Fastest path: a small raw-bytes return rides INSIDE
-                # the msgpack reply header (7th element) — the owner's
-                # one C unpackb decodes it, skipping the out-of-band
-                # frame loop (profiled ~2.4us/task of per-frame
-                # parse+copy on the driver loop).
-                return [REPLY_OK, [
-                    [return_object_id_bytes(spec.task_id, 1), 0, META_RAW,
-                     0, 0, (), [result]],
-                ]], []
-            if type(result) is bytes and \
-                    len(result) <= self.core.config.max_direct_call_object_size:
-                # Raw-bytes return, too big to inline in the header:
-                # ride out-of-band with no serializer object at all.
+                # Raw-bytes return: no serializer object at all.
+                if len(result) <= INLINE_RETURN_MAX:
+                    # Fastest path: rides INSIDE the msgpack reply
+                    # header (7th element) — the owner's one C unpackb
+                    # decodes it, skipping the out-of-band frame loop
+                    # (profiled ~2.4us/task of per-frame parse+copy on
+                    # the driver loop).
+                    return [REPLY_OK, [
+                        [return_object_id_bytes(spec.task_id, 1), 0,
+                         META_RAW, 0, 0, (), [result]],
+                    ]], []
+                # Too big to inline in the header: out-of-band frame.
                 return [REPLY_OK, [
                     [return_object_id_bytes(spec.task_id, 1), 0, META_RAW,
                      0, 1, ()],

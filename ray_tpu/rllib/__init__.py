@@ -6,11 +6,13 @@ evaluation/rollout_worker.py:105, WorkerSet evaluation/worker_set.py,
 Policy policy/policy.py). Scope: the architecture (vector envs →
 rollout-worker actors → WorkerSet → jitted learner → Tune-compatible
 Trainer) with the execution-plan dataflow layer (execution.py,
-reference: rllib/execution/* ops + trainer_template.py) and three
-algorithm shapes proving it generalizes: PPO (sync on-policy), DQN
-(replay off-policy + offline IO, reference: rllib/agents/dqn +
-rllib/execution/replay_buffer.py + rllib/offline/), and IMPALA-lite
-(async on-policy with importance weighting).
+reference: rllib/execution/* ops + trainer_template.py) and the
+algorithm families proving it generalizes: PPO (sync on-policy), A2C
+and PG (build_trainer compositions, reference: rllib/agents/a3c/a2c.py
++ agents/pg/pg.py), DQN with double-Q (replay off-policy + offline IO,
+reference: rllib/agents/dqn + rllib/execution/replay_buffer.py +
+rllib/offline/), and IMPALA-lite (async on-policy with importance
+weighting).
 """
 
 from ray_tpu.rllib import execution  # noqa: F401
@@ -22,6 +24,7 @@ from ray_tpu.rllib.policy import (  # noqa: F401
     ppo_loss,
     sample_actions,
 )
+from ray_tpu.rllib.a2c import A2CTrainer, PGTrainer  # noqa: F401
 from ray_tpu.rllib.dqn import DQNTrainer  # noqa: F401
 from ray_tpu.rllib.execution import Trainer, build_trainer  # noqa: F401
 from ray_tpu.rllib.impala import ImpalaTrainer  # noqa: F401

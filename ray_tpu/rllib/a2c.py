@@ -1,0 +1,103 @@
+"""A2C and vanilla policy gradient on the execution-plan substrate.
+
+Parity targets: the reference's A2C/A3C family and PG trainer
+(reference: rllib/agents/a3c/a2c.py, rllib/agents/pg/pg.py — both are
+trainer_template compositions over ParallelRollouts + TrainOneStep).
+Here each is literally ``build_trainer`` plus one jitted loss: A2C is
+the synchronous advantage actor-critic step; PG is REINFORCE with the
+value head as a baseline.  Both reuse the PPO rollout workers (GAE
+advantages computed worker-side) — algorithm #N is a config + a loss,
+which is the point of the execution-plan layer.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib import execution
+from ray_tpu.rllib.common import (
+    actor_critic_get_state,
+    actor_critic_set_state,
+    actor_critic_setup,
+    onpolicy_execution_plan,
+)
+from ray_tpu.rllib.policy import logits_and_value
+
+A2C_CONFIG: Dict[str, Any] = {
+    "env": "CartPole-v0",
+    "num_workers": 2,
+    "num_envs_per_worker": 8,
+    "rollout_len": 32,
+    "gamma": 0.99,
+    "lambda": 1.0,
+    "lr": 1e-3,
+    "vf_coeff": 0.5,
+    "entropy_coeff": 0.01,
+    "seed": 0,
+    # PG mode: drop the critic term from the gradient (value head
+    # still trains as a baseline) — this flag IS the difference
+    # between the two reference trainers.
+    "use_critic": True,
+}
+
+PG_CONFIG = dict(A2C_CONFIG, use_critic=False, entropy_coeff=0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("vf_coeff", "ent_coeff",
+                                             "use_critic", "lr"))
+def _a2c_update(params, opt_state, batch, *, vf_coeff, ent_coeff,
+                use_critic, lr):
+    import optax
+
+    optimizer = optax.adam(lr)
+
+    def loss_fn(p):
+        logits, value = logits_and_value(p, batch["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = logp_all[jnp.arange(logits.shape[0]), batch["actions"]]
+        if use_critic:
+            adv = batch["advantages"]
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        else:
+            # REINFORCE: discounted returns, baseline-subtracted but
+            # not bootstrapped
+            adv = batch["returns"] - jax.lax.stop_gradient(value)
+        pg = -(adv * logp).mean()
+        vf = jnp.mean((value - batch["returns"]) ** 2)
+        entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1).mean()
+        return pg + vf_coeff * vf - ent_coeff * entropy, (pg, entropy)
+
+    (loss, (pg, entropy)), grads = jax.value_and_grad(
+        loss_fn, has_aux=True)(params)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss, entropy
+
+
+def _learn(self, batch) -> Dict[str, Any]:
+    cfg = self.config
+    self.params, self._opt_state, loss, entropy = _a2c_update(
+        self.params, self._opt_state,
+        {k: jnp.asarray(v) for k, v in batch.items()},
+        vf_coeff=cfg["vf_coeff"], ent_coeff=cfg["entropy_coeff"],
+        use_critic=cfg["use_critic"], lr=cfg["lr"])
+    return {"loss": float(loss), "entropy": float(entropy)}
+
+
+def _execution_plan(self):
+    return onpolicy_execution_plan(self, lambda b: _learn(self, b))
+
+
+A2CTrainer = execution.build_trainer(
+    name="A2CTrainer", default_config=A2C_CONFIG, setup=actor_critic_setup,
+    execution_plan=_execution_plan, get_state=actor_critic_get_state,
+    set_state=actor_critic_set_state)
+
+PGTrainer = execution.build_trainer(
+    name="PGTrainer", default_config=PG_CONFIG, setup=actor_critic_setup,
+    execution_plan=_execution_plan, get_state=actor_critic_get_state,
+    set_state=actor_critic_set_state)

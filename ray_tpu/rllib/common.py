@@ -1,0 +1,61 @@
+"""Shared actor-critic trainer plumbing for the on-policy algorithms.
+
+The sync on-policy trainers (PPO, A2C, PG) differ only in their update
+function; setup / execution plan / checkpoint state are identical
+(reference: the shared trainer_template defaults in
+rllib/agents/trainer_template.py — common pieces live once, algorithms
+supply callables)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+import jax
+
+from ray_tpu.rllib import execution
+from ray_tpu.rllib.env import make_env
+from ray_tpu.rllib.policy import init_policy_params
+from ray_tpu.rllib.rollout_worker import WorkerSet
+
+
+def actor_critic_setup(self, cfg: Dict[str, Any]) -> None:
+    """Probe env → policy params + Adam state + WorkerSet + counters."""
+    import optax
+
+    probe = make_env(cfg["env"], 1)
+    self.params = init_policy_params(
+        jax.random.key(cfg["seed"]), probe.observation_size,
+        probe.num_actions)
+    self._opt_state = optax.adam(cfg["lr"]).init(self.params)
+    self.workers = WorkerSet(
+        cfg["env"], cfg["num_workers"], cfg["num_envs_per_worker"],
+        cfg["rollout_len"], cfg["gamma"], cfg["lambda"])
+    self._counters = {"timesteps_total": 0}
+
+
+def onpolicy_execution_plan(self, learn_fn: Callable[[Any], dict]):
+    """ParallelRollouts |> count |> TrainOneStep |> metrics — the sync
+    on-policy shape (reference: ppo.py's execution_plan)."""
+    rollouts = execution.ParallelRollouts(
+        self.workers.workers, mode="bulk_sync",
+        weights=lambda: self.params)
+
+    def count(batch):
+        self._counters["timesteps_total"] += len(batch["obs"])
+        return batch
+
+    it = execution.ForEach(rollouts, count)
+    it = execution.TrainOneStep(it, learn_fn)
+    return execution.StandardMetricsReporting(
+        it, self.workers.workers, self._counters)
+
+
+def actor_critic_get_state(self) -> dict:
+    return {"params": self.params, "opt_state": self._opt_state,
+            "timesteps": self._counters["timesteps_total"]}
+
+
+def actor_critic_set_state(self, state: dict) -> None:
+    self.params = state["params"]
+    self._opt_state = state["opt_state"]
+    self._counters["timesteps_total"] = state["timesteps"]

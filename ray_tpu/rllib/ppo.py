@@ -20,9 +20,13 @@ import jax
 import jax.numpy as jnp
 
 from ray_tpu.rllib import execution
-from ray_tpu.rllib.env import make_env
-from ray_tpu.rllib.policy import init_policy_params, ppo_loss
-from ray_tpu.rllib.rollout_worker import WorkerSet
+from ray_tpu.rllib.common import (
+    actor_critic_get_state,
+    actor_critic_set_state,
+    actor_critic_setup,
+    onpolicy_execution_plan,
+)
+from ray_tpu.rllib.policy import ppo_loss
 
 DEFAULT_CONFIG: Dict[str, Any] = {
     "env": "CartPole-v0",
@@ -90,32 +94,11 @@ class PPOTrainer(execution.Trainer):
     default_config = DEFAULT_CONFIG
 
     def setup(self, cfg: Dict[str, Any]) -> None:
-        import optax
-
-        probe = make_env(cfg["env"], 1)
-        self.params = init_policy_params(
-            jax.random.key(cfg["seed"]), probe.observation_size,
-            probe.num_actions)
-        self._opt_state = optax.adam(cfg["lr"]).init(self.params)
-        self.workers = WorkerSet(
-            cfg["env"], cfg["num_workers"], cfg["num_envs_per_worker"],
-            cfg["rollout_len"], cfg["gamma"], cfg["lambda"])
+        actor_critic_setup(self, cfg)
         self._key = jax.random.key(cfg["seed"] + 1)
-        self._counters = {"timesteps_total": 0}
 
     def execution_plan(self):
-        rollouts = execution.ParallelRollouts(
-            self.workers.workers, mode="bulk_sync",
-            weights=lambda: self.params)
-
-        def count(batch):
-            self._counters["timesteps_total"] += len(batch["obs"])
-            return batch
-
-        it = execution.ForEach(rollouts, count)
-        it = execution.TrainOneStep(it, self._learn_on_batch)
-        return execution.StandardMetricsReporting(
-            it, self.workers.workers, self._counters)
+        return onpolicy_execution_plan(self, self._learn_on_batch)
 
     def _learn_on_batch(self, batch) -> Dict[str, Any]:
         cfg = self.config
@@ -131,11 +114,5 @@ class PPOTrainer(execution.Trainer):
             lr=cfg["lr"])
         return {"loss": float(loss), "entropy": float(entropy)}
 
-    def get_state(self) -> dict:
-        return {"params": self.params, "opt_state": self._opt_state,
-                "timesteps": self._counters["timesteps_total"]}
-
-    def set_state(self, state: dict) -> None:
-        self.params = state["params"]
-        self._opt_state = state["opt_state"]
-        self._counters["timesteps_total"] = state["timesteps"]
+    get_state = actor_critic_get_state
+    set_state = actor_critic_set_state
