@@ -115,6 +115,20 @@ typedef struct {
 
 #define SLOT(obj, off) (*(PyObject **)((char *)(obj) + (off)))
 
+/* 28-byte oid of return index 1 of a 24-byte task id — the byte layout
+ * mirrors ids.return_object_id_bytes / OID_SUFFIX (1-based LE index). */
+static PyObject *
+derive_return_oid1(PyObject *tid)
+{
+    PyObject *oid_b = PyBytes_FromStringAndSize(NULL, OBJECT_ID_SIZE);
+    if (oid_b == NULL)
+        return NULL;
+    char *dp = PyBytes_AS_STRING(oid_b);
+    memcpy(dp, PyBytes_AS_STRING(tid), TASK_ID_SIZE);
+    dp[24] = 1; dp[25] = 0; dp[26] = 0; dp[27] = 0;
+    return oid_b;
+}
+
 static inline uint64_t
 rng_next(FastCtx *c)
 {
@@ -305,11 +319,8 @@ FastCtx_submit(FastCtx *self, PyObject *const *argv, Py_ssize_t nargs)
     uint64_t r = rng_next(self);
     memcpy(tp + PREFIX_SIZE, &r, 8);
 
-    oid_b = PyBytes_FromStringAndSize(NULL, OBJECT_ID_SIZE);
+    oid_b = derive_return_oid1(tid);
     if (oid_b == NULL) goto fail;
-    char *op = PyBytes_AS_STRING(oid_b);
-    memcpy(op, tp, TASK_ID_SIZE);
-    op[24] = 1; op[25] = 0; op[26] = 0; op[27] = 0;  /* index 1, LE */
 
     /* -- 2. ObjectID instance (hash pre-computed: BaseID.__hash__ is
      *       hash(self._bytes) cached in _hash) ------------------------- */
@@ -534,6 +545,7 @@ FastCtx_complete_fast(FastCtx *self, PyObject *const *argv,
     PyObject *pairs = PyList_New(0);
     PyObject *slow = PyList_New(0);
     PyObject *serobj = NULL, *frames = NULL, *pair = NULL;
+    PyObject *derived = NULL;  /* owner-derived oid bytes (compact rows) */
     long finished = 0;
     if (pairs == NULL || slow == NULL) goto fail;
 
@@ -560,14 +572,20 @@ FastCtx_complete_fast(FastCtx *self, PyObject *const *argv,
         if (!PyList_Check(rets) || PyList_GET_SIZE(rets) != 1)
             goto slow_item;
         PyObject *ret0 = PyList_GET_ITEM(rets, 0);
-        /* ret0 = [oid_b, in_plasma, meta, start, n, contained] */
-        if (!PyList_Check(ret0) || PyList_GET_SIZE(ret0) < 6)
+        /* ret0 = [meta, frames] (compact single return, oid derived)
+         *      | [oid_b, in_plasma, meta, start, n, contained(, frames)] */
+        if (!PyList_Check(ret0))
             goto slow_item;
-        int in_plasma = PyObject_IsTrue(PyList_GET_ITEM(ret0, 1));
-        int contained = PyObject_IsTrue(PyList_GET_ITEM(ret0, 5));
-        if (in_plasma < 0 || contained < 0) goto fail;
-        if (in_plasma || contained)
-            goto slow_item;
+        int compact = PyList_GET_SIZE(ret0) == 2;
+        if (!compact) {
+            if (PyList_GET_SIZE(ret0) < 6)
+                goto slow_item;
+            int in_plasma = PyObject_IsTrue(PyList_GET_ITEM(ret0, 1));
+            int contained = PyObject_IsTrue(PyList_GET_ITEM(ret0, 5));
+            if (in_plasma < 0 || contained < 0) goto fail;
+            if (in_plasma || contained)
+                goto slow_item;
+        }
 
         PyObject *tid = SLOT(spec, self->ts_off[TS_task_id]);
         if (tid == NULL)
@@ -581,8 +599,23 @@ FastCtx_complete_fast(FastCtx *self, PyObject *const *argv,
         if (waiter != NULL && waiter != Py_None)
             goto slow_item;  /* recovery in flight: Python handles wake */
 
-        PyObject *oid_b = PyList_GET_ITEM(ret0, 0);
-        PyObject *meta = PyList_GET_ITEM(ret0, 2);
+        PyObject *oid_b, *meta;
+        if (compact) {
+            if (!PyBytes_Check(tid) ||
+                PyBytes_GET_SIZE(tid) != TASK_ID_SIZE)
+                goto slow_item;
+            PyObject *il = PyList_GET_ITEM(ret0, 1);
+            if (!PyList_Check(il))
+                goto slow_item;
+            derived = derive_return_oid1(tid);
+            if (derived == NULL) goto fail;
+            oid_b = derived;
+            meta = PyList_GET_ITEM(ret0, 0);
+            Py_INCREF(il);
+            frames = il;
+        } else {
+        oid_b = PyList_GET_ITEM(ret0, 0);
+        meta = PyList_GET_ITEM(ret0, 2);
         if (PyList_GET_SIZE(ret0) > 6) {
             /* inline return: payloads decoded with the reply header
              * (task_executor INLINE_RETURN_MAX); the decoded list is
@@ -610,6 +643,7 @@ FastCtx_complete_fast(FastCtx *self, PyObject *const *argv,
             frames = PyList_GetSlice(rbufs, base, base + cnt);
             if (frames == NULL) goto fail;
         }
+        }
 
         serobj = alloc_instance(self->cls_serialized);
         if (serobj == NULL) goto fail;
@@ -623,6 +657,7 @@ FastCtx_complete_fast(FastCtx *self, PyObject *const *argv,
 
         /* bytes key: the memory store hashes it in C */
         pair = PyTuple_Pack(2, oid_b, serobj);
+        Py_CLEAR(derived);  /* pack holds its own ref now */
         if (pair == NULL) goto fail;
         Py_CLEAR(serobj);
         if (PyList_Append(pairs, pair) < 0) goto fail;
@@ -657,7 +692,7 @@ FastCtx_complete_fast(FastCtx *self, PyObject *const *argv,
 
 fail:
     Py_XDECREF(pairs); Py_XDECREF(slow); Py_XDECREF(serobj);
-    Py_XDECREF(frames); Py_XDECREF(pair);
+    Py_XDECREF(frames); Py_XDECREF(pair); Py_XDECREF(derived);
     return NULL;
 }
 
@@ -758,6 +793,19 @@ FastCtx_build_push(FastCtx *self, PyObject *const *argv, Py_ssize_t nargs)
         }
         if (tctx == NULL)
             tctx = Py_None;
+        if (!argful && tctx == Py_None) {
+            /* compact row [pidx, task_id]: argless + traceless */
+            Py_CLEAR(aw);
+            row = PyList_New(2);
+            if (row == NULL) goto fail;
+            PyObject *px = PyLong_FromSsize_t(pidx);
+            if (px == NULL) goto fail;
+            PyList_SET_ITEM(row, 0, px);
+            Py_INCREF(tid);  PyList_SET_ITEM(row, 1, tid);
+            if (PyList_Append(theaders, row) < 0) goto fail;
+            Py_CLEAR(row);
+            continue;
+        }
         row = PyList_New(6);
         if (row == NULL) goto fail;
         PyObject *px = PyLong_FromSsize_t(pidx);

@@ -42,6 +42,10 @@ class RemoteFunction:
         # (core, job_id, prototype TaskSpec) — see CoreWorker
         # .make_task_template; invalidated on reconnect / job adoption
         self._template = None
+        # (core, job_id, zero-arg submit closure) for the dominant
+        # no-arg single-return driver-side call — one closure call
+        # instead of re-validating the template chain per .remote()
+        self._fastcall = None
         functools.update_wrapper(self, fn)
 
     def __call__(self, *args, **kwargs):
@@ -62,6 +66,13 @@ class RemoteFunction:
         return self._demand
 
     def remote(self, *args, **kwargs):
+        if not args and not kwargs:
+            fc = self._fastcall
+            if fc is not None:
+                w = worker_mod.global_worker
+                if w is not None and fc[0] is w.core and \
+                        fc[1] == fc[0].job_id:
+                    return fc[2]()
         w = worker_mod._require_connected()
         core = w.core
         if self._fn_key is None:
@@ -118,11 +129,32 @@ class RemoteFunction:
             args = list(args) + \
                 [{"__rtpu_kwargs__": True, "kwargs": kwargs}]
         refs = core.submit_task_from_template(tmpl[2], args)
+        if self._num_returns == 1 and not self._runtime_env and \
+                core.mode == "driver" and core._fast_ctx is not None:
+            fc = self._fastcall
+            if fc is None or fc[0] is not core or fc[1] != core.job_id:
+                # (re)bind after connect/reconnect/job adoption
+                self._fastcall = (core, core.job_id,
+                                  self._make_fastcall(core, tmpl[2]))
         if self._num_returns == 0:
             return None
         if self._num_returns == 1:
             return refs[0]
         return refs
+
+    @staticmethod
+    def _make_fastcall(core, proto):
+        """Zero-arg driver-side submit closure over the native ctx
+        (everything template-validated once, here)."""
+        from ray_tpu._private.core_worker import _trace_ctx
+
+        submit = core._fast_ctx.submit
+
+        def _call0():
+            return submit(proto, core._task_lineage_prefix,
+                          _trace_ctx())[0]
+
+        return _call0
 
     def options(self, **overrides):
         """Return a copy with per-call option overrides (reference:

@@ -224,3 +224,13 @@ def to_chrome_trace(spans: List[Span]) -> List[dict]:
                  "span_id": s.span_id, "parent_id": s.parent_id,
                  "status": s.status},
     } for s in spans]
+
+
+# Importing this module ARMS the submit-path trace hook: core_worker's
+# _trace_ctx reads one global instead of probing sys.modules per task
+# (see core_worker._trace_ctx docstring for the activation contract).
+import sys as _sys
+
+from ray_tpu._private import core_worker as _core_worker_mod
+
+_core_worker_mod._tracing_mod = _sys.modules[__name__]
