@@ -102,9 +102,10 @@ typedef struct {
     PyObject *cls_taskspec, *cls_objectid, *cls_objectref,
              *cls_reference, *cls_entry, *cls_serialized;
     /* cached immortals / singletons */
-    PyObject *empty_tuple, *long0, *long1, *str_task;
+    PyObject *empty_tuple, *long0, *long1, *str_task, *str_actor;
     PyObject *s_submit_scheduled;  /* interned attr name */
     PyObject *s_tasks_submitted;   /* interned stats key */
+    PyObject *s_actor_tasks;       /* interned stats key (actor kind) */
     /* slot offsets */
     Py_ssize_t ts_off[TS_N], oi_off[OI_N], rf_off[RF_N],
                or_off[OR_N], pe_off[PE_N], so_off[SO_N];
@@ -234,10 +235,14 @@ FastCtx_init(FastCtx *self, PyObject *args, PyObject *kwds)
         PyUnicode_InternFromString("_submit_scheduled");
     self->s_tasks_submitted =
         PyUnicode_InternFromString("tasks_submitted");
+    self->str_actor = PyUnicode_InternFromString("actor");
+    self->s_actor_tasks =
+        PyUnicode_InternFromString("actor_tasks_submitted");
     if (self->empty_tuple == NULL || self->long0 == NULL ||
         self->long1 == NULL || self->str_task == NULL ||
         self->s_submit_scheduled == NULL ||
-        self->s_tasks_submitted == NULL)
+        self->s_tasks_submitted == NULL ||
+        self->str_actor == NULL || self->s_actor_tasks == NULL)
         return -1;
 
     const unsigned char *sd =
@@ -275,8 +280,10 @@ FastCtx_clear(FastCtx *self)
     Py_CLEAR(self->cls_entry); Py_CLEAR(self->cls_serialized);
     Py_CLEAR(self->empty_tuple); Py_CLEAR(self->long0);
     Py_CLEAR(self->long1); Py_CLEAR(self->str_task);
+    Py_CLEAR(self->str_actor);
     Py_CLEAR(self->s_submit_scheduled);
     Py_CLEAR(self->s_tasks_submitted);
+    Py_CLEAR(self->s_actor_tasks);
     return 0;
 }
 
@@ -288,20 +295,29 @@ FastCtx_dealloc(FastCtx *self)
     Py_TYPE(self)->tp_free((PyObject *)self);
 }
 
-/* submit(proto, prefix16, trace_ctx) -> [ObjectRef]
+/* submit(proto, prefix16, trace_ctx[, actor]) -> [ObjectRef]
  *
- * Preconditions enforced by the Python caller (core_worker.
- * submit_task_from_template): no args, num_returns == 1, normal task.
+ * Preconditions enforced by the Python callers (core_worker.
+ * submit_task_from_template / submit_actor_from_template): no args,
+ * num_returns == 1.  ``actor`` truthy routes the spec to the actor
+ * queues ("actor" submit kind + actor stats counter; for actor calls
+ * the 16-byte prefix IS the actor id — TaskID.of(ActorID) layout).
  */
 static PyObject *
 FastCtx_submit(FastCtx *self, PyObject *const *argv, Py_ssize_t nargs)
 {
-    if (nargs != 3) {
+    if (nargs != 3 && nargs != 4) {
         PyErr_SetString(PyExc_TypeError,
-                        "submit(proto, prefix, trace_ctx)");
+                        "submit(proto, prefix, trace_ctx[, actor])");
         return NULL;
     }
     PyObject *proto = argv[0], *prefix = argv[1], *trace_ctx = argv[2];
+    int actor = 0;
+    if (nargs == 4) {
+        actor = PyObject_IsTrue(argv[3]);
+        if (actor < 0)
+            return NULL;
+    }
     if (!PyBytes_Check(prefix) || PyBytes_GET_SIZE(prefix) != PREFIX_SIZE) {
         PyErr_SetString(PyExc_ValueError, "prefix must be 16 bytes");
         return NULL;
@@ -350,8 +366,13 @@ FastCtx_submit(FastCtx *self, PyObject *const *argv, Py_ssize_t nargs)
     Py_INCREF(Py_None); SLOT(ref, self->rf_off[RF_borrowers]) = Py_None;
     Py_INCREF(Py_None); SLOT(ref, self->rf_off[RF_locations]) = Py_None;
     Py_INCREF(Py_False); SLOT(ref, self->rf_off[RF_in_plasma]) = Py_False;
-    Py_INCREF(Py_True);
-    SLOT(ref, self->rf_off[RF_pinned_lineage]) = Py_True;
+    {
+        /* normal tasks pin lineage; actor returns don't (parity with
+         * _register_and_submit vs _register_and_submit_actor) */
+        PyObject *pin = actor ? Py_False : Py_True;
+        Py_INCREF(pin);
+        SLOT(ref, self->rf_off[RF_pinned_lineage]) = pin;
+    }
     Py_INCREF(Py_False); SLOT(ref, self->rf_off[RF_freed]) = Py_False;
     Py_INCREF(self->long0); SLOT(ref, self->rf_off[RF_size]) = self->long0;
 
@@ -440,21 +461,22 @@ FastCtx_submit(FastCtx *self, PyObject *const *argv, Py_ssize_t nargs)
     /* -- 7. stats + submit queue + loop wakeup ------------------------- */
     self->submitted++;
     {
-        /* introspection parity: stats["tasks_submitted"] += 1 */
-        PyObject *cur = PyDict_GetItemWithError(self->stats_dict,
-                                                self->s_tasks_submitted);
+        /* introspection parity: stats["(actor_)tasks_submitted"] += 1 */
+        PyObject *skey = actor ? self->s_actor_tasks
+                               : self->s_tasks_submitted;
+        PyObject *cur = PyDict_GetItemWithError(self->stats_dict, skey);
         if (cur == NULL && PyErr_Occurred()) goto fail;
         long n = cur ? PyLong_AsLong(cur) : 0;
         if (n == -1 && PyErr_Occurred()) goto fail;
         PyObject *nv = PyLong_FromLong(n + 1);
         if (nv == NULL) goto fail;
-        int rc = PyDict_SetItem(self->stats_dict,
-                                self->s_tasks_submitted, nv);
+        int rc = PyDict_SetItem(self->stats_dict, skey, nv);
         Py_DECREF(nv);
         if (rc < 0) goto fail;
     }
 
-    item = PyTuple_Pack(2, self->str_task, spec);
+    item = PyTuple_Pack(2, actor ? self->str_actor : self->str_task,
+                        spec);
     if (item == NULL) goto fail;
     PyObject *ar = PyObject_CallOneArg(self->submit_append, item);
     Py_CLEAR(item);

@@ -1677,12 +1677,7 @@ class CoreWorker:
                           args: List[Any], num_returns: int = 1,
                           max_task_retries: int = 0) -> List[ObjectRef]:
         # (4) backpressure: enforce max_pending_calls before queueing.
-        q = self.actor_queues.get(actor_id)
-        if q is not None and q.max_pending >= 0 and \
-                len(q.buffer) + len(q.inflight) >= q.max_pending:
-            raise exc.PendingCallsLimitExceeded(
-                f"actor has {len(q.buffer) + len(q.inflight)} pending calls "
-                f"(max_pending_calls={q.max_pending})")
+        self._check_actor_backpressure(actor_id)
         task_id = TaskID.of(ActorID(actor_id))
         prepared_args, arg_holds = self._prepare_args(args)
         spec = TaskSpec(
@@ -1692,6 +1687,51 @@ class CoreWorker:
             resources={}, max_retries=max_task_retries,
             owner_address=self.address, owner_worker_id=self.worker_id,
             actor_id=actor_id, trace_ctx=_trace_ctx())
+        return self._register_and_submit_actor(spec, arg_holds, name)
+
+    def make_actor_template(self, actor_id: bytes, fn_key: str, name: str,
+                            num_returns: int = 1,
+                            max_task_retries: int = 0) -> TaskSpec:
+        """Prototype spec for repeated calls of one actor method (the
+        actor-side twin of make_task_template): per-call work drops to
+        id mint + clone — or the native fused submit."""
+        return TaskSpec(
+            task_id=b"", job_id=self.job_id,
+            task_type=TASK_ACTOR, name=name, fn_key=fn_key,
+            args=[], num_returns=num_returns,
+            resources={}, max_retries=max_task_retries,
+            owner_address=self.address, owner_worker_id=self.worker_id,
+            actor_id=actor_id)
+
+    def _check_actor_backpressure(self, actor_id: bytes) -> None:
+        q = self.actor_queues.get(actor_id)
+        if q is not None and q.max_pending >= 0 and \
+                len(q.buffer) + len(q.inflight) >= q.max_pending:
+            raise exc.PendingCallsLimitExceeded(
+                f"actor has {len(q.buffer) + len(q.inflight)} pending calls "
+                f"(max_pending_calls={q.max_pending})")
+
+    def submit_actor_from_template(self, proto: TaskSpec
+                                   ) -> List[ObjectRef]:
+        """Arg-less actor call on a cached template (backpressure
+        checked, then the fused native path when built — single-return
+        only, same gate as submit_task_from_template)."""
+        actor_id = proto.actor_id
+        self._check_actor_backpressure(actor_id)
+        if proto.num_returns == 1:
+            ctx = self._fast_ctx
+            if ctx is None and not self._fast_ctx_failed:
+                ctx = self._make_fast_ctx()
+            if ctx is not None:
+                return ctx.submit(proto, actor_id, _trace_ctx(), True)
+        spec = proto.clone_for(make_task_id_bytes(actor_id), (),
+                               trace_ctx=_trace_ctx())
+        return self._register_and_submit_actor(spec, None, spec.name)
+
+    def _register_and_submit_actor(self, spec: TaskSpec, arg_holds,
+                                   name: str) -> List[ObjectRef]:
+        task_id = TaskID(spec.task_id)
+        num_returns = spec.num_returns
         return_ids = [task_id.object_id(i + 1) for i in range(num_returns)]
         refs = []
         for oid in return_ids:
