@@ -1687,7 +1687,7 @@ class CoreWorker:
             resources={}, max_retries=max_task_retries,
             owner_address=self.address, owner_worker_id=self.worker_id,
             actor_id=actor_id, trace_ctx=_trace_ctx())
-        return self._register_and_submit_actor(spec, arg_holds, name)
+        return self._register_and_submit_actor(spec, arg_holds)
 
     def make_actor_template(self, actor_id: bytes, fn_key: str, name: str,
                             num_returns: int = 1,
@@ -1726,10 +1726,10 @@ class CoreWorker:
                 return ctx.submit(proto, actor_id, _trace_ctx(), True)
         spec = proto.clone_for(make_task_id_bytes(actor_id), (),
                                trace_ctx=_trace_ctx())
-        return self._register_and_submit_actor(spec, None, spec.name)
+        return self._register_and_submit_actor(spec, None)
 
-    def _register_and_submit_actor(self, spec: TaskSpec, arg_holds,
-                                   name: str) -> List[ObjectRef]:
+    def _register_and_submit_actor(self, spec: TaskSpec, arg_holds
+                                   ) -> List[ObjectRef]:
         task_id = TaskID(spec.task_id)
         num_returns = spec.num_returns
         return_ids = [task_id.object_id(i + 1) for i in range(num_returns)]
@@ -1737,7 +1737,8 @@ class CoreWorker:
         for oid in return_ids:
             self.reference_counter.add_owned_with_local_ref(oid)
             refs.append(ObjectRef(oid, owner_address=self.address, worker=self,
-                                  call_site=name, skip_adding_local_ref=True))
+                                  call_site=spec.name,
+                                  skip_adding_local_ref=True))
         entry = PendingTaskEntry(spec, return_ids)
         self.pending_tasks[spec.task_id] = entry
         if entry.dep_ids:

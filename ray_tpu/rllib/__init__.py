@@ -11,8 +11,9 @@ algorithm families proving it generalizes: PPO (sync on-policy), A2C
 and PG (build_trainer compositions, reference: rllib/agents/a3c/a2c.py
 + agents/pg/pg.py), DQN with double-Q (replay off-policy + offline IO,
 reference: rllib/agents/dqn + rllib/execution/replay_buffer.py +
-rllib/offline/), and IMPALA-lite (async on-policy with importance
-weighting).
+rllib/offline/), SAC-discrete (twin critics + entropy regularization,
+reference: rllib/agents/sac), and IMPALA-lite (async on-policy with
+importance weighting).
 """
 
 from ray_tpu.rllib import execution  # noqa: F401
@@ -26,6 +27,7 @@ from ray_tpu.rllib.policy import (  # noqa: F401
 )
 from ray_tpu.rllib.a2c import A2CTrainer, PGTrainer  # noqa: F401
 from ray_tpu.rllib.dqn import DQNTrainer  # noqa: F401
+from ray_tpu.rllib.sac import SACTrainer  # noqa: F401
 from ray_tpu.rllib.execution import Trainer, build_trainer  # noqa: F401
 from ray_tpu.rllib.impala import ImpalaTrainer  # noqa: F401
 from ray_tpu.rllib.offline import JsonReader, JsonWriter  # noqa: F401

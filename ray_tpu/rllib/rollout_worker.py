@@ -159,7 +159,7 @@ class TransitionWorker:
     registry and episode bookkeeping with RolloutWorker."""
 
     def __init__(self, env_name, num_envs: int, rollout_len: int,
-                 q_fn, seed: int = 0):
+                 q_fn, seed: int = 0, stochastic: bool = False):
         self.env = make_env(env_name, num_envs)
         if not isinstance(self.env, VectorEnv):
             raise ValueError(
@@ -168,6 +168,10 @@ class TransitionWorker:
         self.num_envs = num_envs
         self.rollout_len = rollout_len
         self._q_fn = jax.jit(q_fn)
+        # stochastic=True: sample from softmax(q_fn output) — the
+        # behavior policy for entropy-regularized learners (SAC);
+        # False: epsilon-greedy over argmax (DQN family)
+        self._stochastic = stochastic
         self._rng = np.random.default_rng(seed)
         self.obs = self.env.reset(seed)
         self.params = None
@@ -189,10 +193,18 @@ class TransitionWorker:
         }
         for t in range(T):
             q = np.asarray(self._q_fn(self.params, self.obs))
-            greedy = q.argmax(axis=-1)
-            explore = self._rng.random(B) < epsilon
-            randa = self._rng.integers(0, self.env.num_actions, size=B)
-            actions = np.where(explore, randa, greedy).astype(np.int32)
+            if self._stochastic:
+                # categorical over softmax(logits): Gumbel-max trick
+                # (vectorized, no per-row choice() loop)
+                g = -np.log(-np.log(
+                    self._rng.random(q.shape) + 1e-12) + 1e-12)
+                actions = (q + g).argmax(axis=-1).astype(np.int32)
+            else:
+                greedy = q.argmax(axis=-1)
+                explore = self._rng.random(B) < epsilon
+                randa = self._rng.integers(0, self.env.num_actions,
+                                           size=B)
+                actions = np.where(explore, randa, greedy).astype(np.int32)
             nxt, reward, done = self.env.step(actions)
             sl = slice(t * B, (t + 1) * B)
             out["obs"][sl] = self.obs
