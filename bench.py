@@ -347,21 +347,19 @@ def main():
                 "task_sojourn_p50_ms": round(pct(0.50) * 1e3, 2),
                 "task_sojourn_p99_ms": round(pct(0.99) * 1e3, 2),
                 "lease_schedule_latency": lease_lat,
-                # r4 profile (cProfile over driver + worker): after the
-                # chunked RPC parser, proto-dedup push wire, template
-                # submission, and batched completion, the remaining
-                # floor is ~24us/task of pure-Python object work spread
-                # across ~15 sub-us sites (TaskSpec clone + id mint +
-                # refcount entry + ObjectRef + pending entry on the
-                # driver main thread ~12us; exec-thread spec rebuild +
-                # reply build ~6us; loop-side pump/parse/complete ~6us)
-                # all sharing ONE core on this box. The next 2-3x needs
-                # a C extension for the submit/complete records or more
-                # cores — no single Python-level site >2us remains.
+                # r4 late profile: with the C fused submit/complete/
+                # push paths (cpp/fastpath.c), compact wire rows, GC
+                # parked for the burst, and the bytes-keyed owner
+                # tables, the remaining ~16us/task of wall splits
+                # roughly driver ~11us (C submit ~2, sendmsg kernel
+                # ~2, loop pump/parse ~3, get-side deserialize ~2,
+                # wrapper+misc ~2) and workers+raylet ~5us — all
+                # sharing ONE core. No Python-level site >1us remains;
+                # the floor is now allocator + kernel copy bound.
                 "floor_note": (
-                    "~24us/task residual pure-Python object work "
-                    "across driver main thread (~12us), worker exec "
-                    "(~6us), io loop (~6us); no remaining site >2us"),
+                    "~16us/task: driver ~11us (C submit ~2, kernel "
+                    "sendmsg ~2, loop ~3, get ~2), workers+raylet "
+                    "~5us, one shared core; allocator/kernel bound"),
             },
             "model_perf": model_perf,
         },
