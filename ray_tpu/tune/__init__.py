@@ -18,6 +18,7 @@ from ray_tpu.tune.sample import (  # noqa: F401
     uniform,
 )
 from ray_tpu.tune.schedulers import (  # noqa: F401
+    PB2,
     AsyncHyperBandScheduler,
     FIFOScheduler,
     MedianStoppingRule,

@@ -187,3 +187,122 @@ class PopulationBasedTraining(TrialScheduler):
         if callable(spec):
             return spec()
         return spec
+
+
+class PB2(PopulationBasedTraining):
+    """Population-Based Bandits (public formulation: Parker-Holder et
+    al. 2020, "Provably Efficient Online Hyperparameter Optimization
+    with Population-Based Bandits"; reference role: tune/schedulers/
+    pb2.py): PBT where the EXPLORE step is a GP-UCB suggestion fit on
+    the observed (hyperparameters -> reward improvement) history
+    instead of a random perturbation.  ``hyperparam_bounds`` maps each
+    tuned key to a continuous (low, high) range; exploit/clone
+    mechanics are inherited from PBT.
+    """
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 perturbation_interval: int = 4,
+                 hyperparam_bounds: Optional[
+                     Dict[str, "tuple[float, float]"]] = None,
+                 quantile_fraction: float = 0.25,
+                 ucb_kappa: float = 1.0,
+                 num_candidates: int = 256,
+                 seed: Optional[int] = None):
+        if not hyperparam_bounds:
+            raise ValueError("PB2 requires hyperparam_bounds")
+        super().__init__(time_attr=time_attr,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations={},
+                         quantile_fraction=quantile_fraction,
+                         seed=seed)
+        # built after super() so the resamplers draw from the SEEDED
+        # self.rng, not the global random stream
+        self.mutations = {
+            k: (lambda lo=lo, hi=hi: self.rng.uniform(lo, hi))
+            for k, (lo, hi) in hyperparam_bounds.items()}
+        self.bounds = dict(hyperparam_bounds)
+        self.kappa = ucb_kappa
+        self.num_candidates = num_candidates
+        # (normalized config vector, score delta) observations; only
+        # the newest window is ever fit, so cap the memory to it
+        from collections import deque
+        self._obs_x: Any = deque(maxlen=256)
+        self._obs_y: Any = deque(maxlen=256)
+        self._prev_score: Dict[str, float] = {}
+
+    # -- data collection ---------------------------------------------------
+
+    def on_trial_result(self, runner, trial, result) -> str:
+        tid = trial.trial_id
+        score = self._score(result)
+        prev = self._prev_score.get(tid)
+        if prev is not None and math.isfinite(prev) \
+                and math.isfinite(score):
+            self._obs_x.append(self._vec(trial.config))
+            self._obs_y.append(score - prev)
+        self._prev_score[tid] = score
+        before = self.num_exploits
+        decision = super().on_trial_result(runner, trial, result)
+        if self.num_exploits != before:
+            # this trial just cloned a donor's checkpoint: its next
+            # score jump reflects the clone, not the explored config —
+            # drop the stale baseline so that delta never reaches the GP
+            self._prev_score.pop(tid, None)
+        return decision
+
+    def _vec(self, config: Dict[str, Any]) -> List[float]:
+        out = []
+        for k, (lo, hi) in self.bounds.items():
+            v = float(config.get(k, lo))
+            out.append((v - lo) / (hi - lo) if hi > lo else 0.0)
+        return out
+
+    # -- GP-UCB explore ----------------------------------------------------
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        if len(self._obs_y) < 4:
+            # cold start: PBT mutation, CLAMPED to the declared bounds
+            # (the multiplicative 0.8/1.2 branch can step outside them)
+            return self._clamp(super()._explore(config))
+        try:
+            from sklearn.gaussian_process import GaussianProcessRegressor
+            from sklearn.gaussian_process.kernels import (
+                RBF, WhiteKernel)
+        except Exception:  # noqa: BLE001 — sklearn absent: PBT fallback
+            return self._clamp(super()._explore(config))
+        import numpy as np
+
+        x = np.asarray(self._obs_x, dtype=float)
+        y = np.asarray(self._obs_y, dtype=float)
+        ystd = y.std() or 1.0
+        gp = GaussianProcessRegressor(
+            kernel=RBF(length_scale=0.3) + WhiteKernel(1e-3),
+            normalize_y=True, alpha=1e-6)
+        gp.fit(x, y / ystd)
+        cand = np.asarray([
+            [self.rng.random() for _ in self.bounds]
+            for _ in range(self.num_candidates)])
+        mean, std = gp.predict(cand, return_std=True)
+        best = cand[int(np.argmax(mean + self.kappa * std))]
+        for (k, (lo, hi)), u in zip(self.bounds.items(), best):
+            v = lo + float(u) * (hi - lo)
+            if isinstance(config.get(k), int):
+                v = self._int_in_bounds(v, lo, hi)
+            config[k] = v
+        return config
+
+    @staticmethod
+    def _int_in_bounds(v: float, lo: float, hi: float) -> int:
+        # nearest integer that still respects the DECLARED bounds
+        # (plain round() could exceed a fractional hi; a hard floor of
+        # 1 would narrow a legal lo of 0)
+        return int(min(math.floor(hi), max(math.ceil(lo), round(v))))
+
+    def _clamp(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        for k, (lo, hi) in self.bounds.items():
+            v = config.get(k)
+            if isinstance(v, (int, float)):
+                c = min(max(float(v), lo), hi)
+                config[k] = self._int_in_bounds(c, lo, hi) \
+                    if isinstance(v, int) else c
+        return config
