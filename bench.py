@@ -178,15 +178,26 @@ def main():
     def memcpy_gbps():
         """This box's raw memory bandwidth — the physical ceiling for
         the zero-copy put path (one memcpy into shm). The reference's
-        19.3 GB/s ran on m4.16xlarge-class memory."""
+        19.3 GB/s ran on m4.16xlarge-class memory.
+
+        Median over many independently-timed reps: one 4-iteration loop
+        on a noisy shared box swung the reported ceiling 4x between
+        identical runs (r4 verdict weak #7); the per-rep median is
+        stable to ~±10%."""
+        import statistics
+
         import numpy as np
 
         src = np.ones(8 * 1024 * 1024, dtype=np.float64)
         dst = np.empty_like(src)
-        t0 = time.perf_counter()
-        for _ in range(4):
+        reps = int(os.environ.get("BENCH_MEMCPY_REPS", "32"))
+        np.copyto(dst, src)  # warm page-in
+        rates = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
             np.copyto(dst, src)
-        return (4 * 64 / 1024.0) / (time.perf_counter() - t0)
+            rates.append((64 / 1024.0) / (time.perf_counter() - t0))
+        return statistics.median(rates)
 
     _trace("init done; tasks_async")
     tasks_per_s = timeit(bench_tasks_async)
@@ -364,7 +375,16 @@ def main():
             "model_perf": model_perf,
         },
     }
-    print(json.dumps(result))
+    line = json.dumps(result)
+    print(line)
+    # Persist the complete record: the driver captures only a stdout
+    # tail, which truncated half the r04 rows (verdict weak #3).
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_LAST.json"), "w") as f:
+            f.write(line + "\n")
+    except OSError:
+        pass
     return 0
 
 

@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Sanitizer pass over the native tier (SURVEY §5.2 posture; r4 verdict
+# ask #6). Builds cpp/fastpath.c (ASAN+UBSAN, non-recovering UBSAN) and
+# the C++ msgpack codec / xlang client with the same flags, then runs:
+#   1. the fastpath state-parity suite,
+#   2. the cross-language C++ client suite (msgpack_lite.hpp codec),
+#   3. a 100k-task drain with the instrumented fast path on the hot
+#      path end to end (driver + raylet + workers all preload ASAN),
+#   4. a CPython-allocator leak check over the submit/complete loop
+#      (sys.getallocatedblocks steady-state — works on release builds
+#      where sys.gettotalrefcount does not exist).
+# Any ASAN/UBSAN report aborts the run (abort_on_error=1) and fails CI.
+# LeakSanitizer stays off: the interpreter's arena allocations at exit
+# are all false positives; the allocator steady-state check in step 4
+# is the leak signal for the native tier instead.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+LIBASAN="$(cc -print-file-name=libasan.so)"
+if [ ! -e "$LIBASAN" ]; then
+    echo "SKIP: libasan not found (toolchain without ASAN)" >&2
+    exit 0
+fi
+
+export RAY_TPU_NATIVE_SANITIZE=1
+export LD_PRELOAD="$LIBASAN"
+export ASAN_OPTIONS="detect_leaks=0:abort_on_error=1"
+export UBSAN_OPTIONS="print_stacktrace=1:halt_on_error=1"
+
+echo "== 1/4 fastpath parity suite under ASAN+UBSAN =="
+python -m pytest tests/test_fastpath.py -x -q
+
+echo "== 2/4 C++ msgpack codec + xlang client under ASAN+UBSAN =="
+python -m pytest tests/test_cross_language.py -x -q
+
+echo "== 3/4 100k drain + 4/4 allocator leak check =="
+python ci/asan_drain.py
+
+echo "SANITIZE: all clean"

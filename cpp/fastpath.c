@@ -620,6 +620,18 @@ FastCtx_complete_fast(FastCtx *self, PyObject *const *argv,
         PyObject *waiter = SLOT(entry, self->pe_off[PE_recovery_waiter]);
         if (waiter != NULL && waiter != Py_None)
             goto slow_item;  /* recovery in flight: Python handles wake */
+        if (keep_lineage &&
+            SLOT(entry, self->pe_off[PE_lineage_pinned]) == Py_None) {
+            /* every return was released while the task ran
+             * (_release_lineage): nobody can get the value — skip the
+             * store put entirely (storing it would orphan the object:
+             * the release-path delete already fired) and drop the
+             * record (TaskManager::RemoveLineageReference parity). */
+            if (PyDict_DelItem(self->pending_dict, tid) < 0)
+                goto fail;
+            finished++;
+            continue;
+        }
 
         PyObject *oid_b, *meta;
         if (compact) {
@@ -689,6 +701,23 @@ FastCtx_complete_fast(FastCtx *self, PyObject *const *argv,
         if (!keep_lineage) {
             if (PyDict_DelItem(self->pending_dict, tid) < 0)
                 goto fail;
+        } else {
+            /* Lineage lifecycle (TaskManager::RemoveLineageReference
+             * parity, src/ray/core_worker/task_manager.cc): returns
+             * all released while the task was in flight
+             * (lineage_pinned is None) -> nobody can need recovery,
+             * drop the entry now; otherwise mark it
+             * completed-retained-for-lineage (True) so releasing the
+             * last return pops it (_release_lineage). */
+            PyObject *lp = SLOT(entry, self->pe_off[PE_lineage_pinned]);
+            if (lp == Py_None) {
+                if (PyDict_DelItem(self->pending_dict, tid) < 0)
+                    goto fail;
+            } else if (lp != Py_True) {
+                Py_INCREF(Py_True);
+                SLOT(entry, self->pe_off[PE_lineage_pinned]) = Py_True;
+                Py_XDECREF(lp);
+            }
         }
         continue;
 

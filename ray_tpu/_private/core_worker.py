@@ -505,9 +505,34 @@ class CoreWorker:
             att = self._attached.pop(oid, None)
         if att is not None:
             att.close()
+        if record.owned:
+            self._release_lineage(oid)
         if record.owned and record.in_plasma:
             locations = sorted(record.locations or ())
             self._fire_and_forget(self._free_remote(oid, locations))
+
+    def _release_lineage(self, oid: ObjectID) -> None:
+        """Last reference to an owned return object dropped: release the
+        creating task's lineage once NO return of that task can still
+        need reconstruction (reference:
+        TaskManager::RemoveLineageReference,
+        src/ray/core_worker/task_manager.cc). PendingTaskEntry's
+        ``lineage_pinned`` is the lifecycle flag: False = in flight,
+        True = completed + retained only for lineage, None = in flight
+        but all returns already dead (completion drops the entry)."""
+        tid_b = oid.task_id().binary()
+        entry = self.pending_tasks.get(tid_b)
+        if entry is None:
+            return
+        me = oid.binary()
+        for rid in entry.return_ids:
+            if rid.binary() != me and \
+                    self.reference_counter.has_reference(rid):
+                return  # a sibling return is still reachable
+        if entry.lineage_pinned:
+            self.pending_tasks.pop(tid_b, None)
+        elif entry.lineage_pinned is False:
+            entry.lineage_pinned = None
 
     async def _free_remote(self, oid: ObjectID, locations):
         # Primary copy may live on remote nodes too: the local raylet frees
@@ -1606,8 +1631,20 @@ class CoreWorker:
             entry.recovery_waiter = None
             if not waiter.done():
                 waiter.set_result(True)
-        if not keep_lineage:
+        if not keep_lineage or entry.lineage_pinned is None:
+            # lineage off, or every return was already released while
+            # the task ran (_release_lineage) — nobody can reconstruct
             self.pending_tasks.pop(spec.task_id, None)
+            if entry.lineage_pinned is None:
+                # the refs died before the values landed, so the
+                # release path's memory_store.delete already ran —
+                # drop the just-stored orphans (fire-and-forget tasks)
+                for rid in entry.return_ids:
+                    self.memory_store.delete(rid)
+        else:
+            # completed: the entry now lives only for lineage; the last
+            # return's release pops it (_release_lineage)
+            entry.lineage_pinned = True
 
     def _store_error_for_task(self, spec: TaskSpec, error: BaseException):
         serialized = self.serialization_context.serialize_error(error)
@@ -1615,13 +1652,13 @@ class CoreWorker:
         for i in range(spec.num_returns):
             self.memory_store.put(task_id.object_id(i + 1), serialized)
         # A recovery waiting on this task must learn the outcome NOW (the
-        # error value landed in the memory store) rather than time out.
+        # error value landed in the memory store) rather than time out;
+        # the entry then follows the normal completion lifecycle so
+        # errored tasks don't pin their records forever.
         entry = self.pending_tasks.get(spec.task_id)
-        if entry is not None and entry.recovery_waiter is not None:
-            waiter = entry.recovery_waiter
-            entry.recovery_waiter = None
-            if not waiter.done():
-                waiter.set_result(True)
+        if entry is not None:
+            self._finish_pending_entry(
+                spec, entry, self.config.lineage_reconstruction_enabled)
         self.reference_counter.update_finished_task_references(
             spec.dependency_ids())
 

@@ -63,6 +63,8 @@ def _build_and_load():
         src = f.read()
     tag = "%s-%s" % (hashlib.sha256(src).hexdigest()[:12],
                      sys.implementation.cache_tag)
+    if os.environ.get("RAY_TPU_NATIVE_SANITIZE"):
+        tag += "-san"
     so_path = os.path.join(_CACHE_DIR, "_rtpu_fastpath-%s.so" % tag)
     if not os.path.exists(so_path):
         _compile(so_path)
@@ -86,6 +88,11 @@ def _compile(so_path: str) -> None:
         tmp = so_path + ".tmp.%d" % os.getpid()
         cmd = [cc, "-O2", "-fPIC", "-shared", "-I", include, _SRC,
                "-o", tmp]
+        if os.environ.get("RAY_TPU_NATIVE_SANITIZE"):
+            # ci/sanitize.sh: ASAN+UBSAN instrumented tier (needs
+            # LD_PRELOADed libasan in the hosting interpreter).
+            cmd[1:1] = ["-g", "-fsanitize=address,undefined",
+                        "-fno-sanitize-recover=undefined"]
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=120)
         if proc.returncode != 0:

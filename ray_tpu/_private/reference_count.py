@@ -113,6 +113,12 @@ class ReferenceCounter:
         ref.local_refs += 1
         ref.pinned_lineage = pin_lineage
 
+    def has_reference(self, object_id) -> bool:
+        """Whether any reference record (local/submitted/borrowed) for
+        the object is still live. Single GIL-atomic dict probe — safe
+        without the lock from any thread."""
+        return _key(object_id) in self._refs
+
     def add_borrowed_object(self, object_id, owner_address: str) -> bool:
         """Returns True if this is the first borrow (caller should notify
         the owner)."""

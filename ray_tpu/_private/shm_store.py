@@ -21,6 +21,7 @@ import secrets
 import struct
 import threading
 import time
+import weakref
 from multiprocessing import resource_tracker, shared_memory
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -40,11 +41,24 @@ def _align8(n: int) -> int:
 
 _zombie_lock = threading.Lock()
 _zombies: List[shared_memory.SharedMemory] = []
+# Mappings whose munmap is deferred to consumer-view GC (see
+# _QuietSharedMemory.close). A WeakSet: the only strong refs to these
+# mmap objects are the consumers' buffer exports, so entries vanish
+# from the set at the exact moment the mapping is deallocated.
+_deferred: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def deferred_count() -> int:
+    """Mappings detached by the store but still pinned by live zero-copy
+    consumer views. These unmap deterministically when the last view is
+    garbage-collected (normal operation, not a leak — a steadily growing
+    value means user code holds zero-copy values forever)."""
+    return len(_deferred)
 
 
 def zombie_count() -> int:
-    """Parked mappings still pinned by live consumer views (a gauge:
-    steadily growing means user code holds zero-copy values forever)."""
+    """Parked mappings on the guarded FALLBACK path (deferred release
+    failed). Should always be 0; anything here is log-worthy."""
     with _zombie_lock:
         return len(_zombies)
 
@@ -53,21 +67,36 @@ class _QuietSharedMemory(shared_memory.SharedMemory):
     """A SharedMemory whose close() tolerates live zero-copy consumers.
 
     The view-release discipline here IS reference counting — by the
-    mmap's own buffer-export counter: every deserialized array views a
-    frame memoryview which views the mapping, so the mapping cannot be
-    (and must not be) unmapped while any such value is alive. close()
-    called while exports exist raises BufferError; the segment is
-    parked in a zombie list and reaped by sweep_zombies() — on
-    attach/detach AND periodically from the core worker's maintenance
-    loop — the moment the last consumer view is garbage-collected.
-    Reference discipline: plasma client Release
+    mmap's own buffer exports: every deserialized array views a frame
+    memoryview which views the mapping, so each consumer value holds a
+    strong reference to the mmap object. close() called while exports
+    exist therefore *drops our handles* (and closes the fd immediately)
+    instead of unmapping: the mmap object stays alive exactly as long
+    as consumer views do, and CPython's mmap deallocator munmaps it the
+    instant the last view is garbage-collected. Deterministic release,
+    no sweeping. Reference discipline: plasma client Release
     (src/ray/object_manager/plasma/client.cc) — there the refcount is
     explicit; here the buffer protocol keeps it for us."""
 
     def close(self):  # noqa: D102 - see class docstring
         try:
             shared_memory.SharedMemory.close(self)
+            return
         except BufferError:
+            pass
+        # Deferred release. SharedMemory.close() released self._buf
+        # before the mmap close raised, so only _mmap and _fd remain.
+        try:
+            mm, self._mmap = self._mmap, None
+            if self._fd >= 0:
+                os.close(self._fd)
+                self._fd = -1
+            _deferred.add(mm)
+            del mm  # consumers now hold the only strong references
+        except Exception:
+            # Fallback: park the handle whole; sweep_zombies retries.
+            logger.warning("deferred shm release failed; parking %s",
+                           getattr(self, "_name", "?"), exc_info=True)
             try:
                 with _zombie_lock:
                     _zombies.append(self)
@@ -76,8 +105,9 @@ class _QuietSharedMemory(shared_memory.SharedMemory):
 
 
 def sweep_zombies() -> int:
-    """Retry closing parked mappings whose consumers have since died.
-    Returns the number of mappings still alive."""
+    """Retry closing fallback-parked mappings whose consumers have since
+    died. Returns the number of mappings still parked. (The normal
+    deferred-release path never parks — see _QuietSharedMemory.close.)"""
     with _zombie_lock:
         parked, _zombies[:] = _zombies[:], []
     still = []
@@ -408,6 +438,8 @@ class ShmStoreServer:
             "num_evictions": self.num_evictions,
             "num_spills": self.num_spills,
             "num_restores": self.num_restores,
-            # consumer-pinned mappings awaiting their views' GC
+            # consumer-pinned mappings awaiting their views' GC (normal)
+            "num_deferred_mappings": deferred_count(),
+            # fallback-parked mappings (always 0 in healthy operation)
             "num_zombie_mappings": zombie_count(),
         }

@@ -37,9 +37,13 @@ def xlang_binary(tmp_path_factory):
               "UNTESTED in this environment", file=sys.stderr)
         pytest.skip("g++ not available — C++ xlang client UNTESTED")
     out = str(tmp_path_factory.mktemp("cpp") / "xlang_demo")
+    flags = ["-std=c++17", "-O2", "-Wall"]
+    if os.environ.get("RAY_TPU_NATIVE_SANITIZE"):
+        # ci/sanitize.sh: the msgpack codec + client run under ASAN+UBSAN
+        flags += ["-g", "-fsanitize=address,undefined",
+                  "-fno-sanitize-recover=undefined"]
     subprocess.run(
-        [gxx, "-std=c++17", "-O2", "-Wall",
-         os.path.join(CPP_DIR, "xlang_demo.cc"), "-o", out],
+        [gxx, *flags, os.path.join(CPP_DIR, "xlang_demo.cc"), "-o", out],
         check=True, timeout=300)
     return out
 

@@ -159,10 +159,12 @@ def test_lineage_reconstruction_after_node_loss():
         c.shutdown()
 
 
-def test_attachment_zombie_sweep():
+def test_attachment_deferred_release():
     """A detached mapping with live zero-copy consumers must not raise
-    BufferError (from SharedMemory.__del__) and must be unmapped once the
-    consumer dies (reference: plasma client Release discipline,
+    BufferError (from SharedMemory.__del__) and must be unmapped the
+    moment the consumer dies — deterministically, via the consumers'
+    buffer exports holding the mmap, with NO fallback parking
+    (reference: plasma client Release discipline,
     src/ray/object_manager/plasma/client.cc)."""
     import gc
 
@@ -173,15 +175,30 @@ def test_attachment_zombie_sweep():
     arr = np.arange(4096, dtype=np.float64)
     name, size = shm_store.write_segment(ctx.serialize(arr))
     try:
+        base = shm_store.deferred_count()
         att = shm_store.AttachedObject(name)
         # Zero-copy view into the mapping, as ray_tpu.get() produces.
         view = ctx.deserialize(att.metadata, att.frames)
         assert isinstance(view, np.ndarray) and view[17] == 17.0
-        att.close()  # consumer still alive: mapping parked, no BufferError
-        assert shm_store.sweep_zombies() >= 1
-        assert view[4095] == 4095.0  # still readable through the zombie
+        att.close()  # consumer still alive: unmap deferred, no BufferError
+        assert shm_store.deferred_count() == base + 1
+        assert shm_store.zombie_count() == 0  # fallback path not taken
+        assert view[4095] == 4095.0  # still readable while deferred
         del view
         gc.collect()
-        assert shm_store.sweep_zombies() == 0  # consumer gone: unmapped
+        # consumer gone: the mmap was deallocated (munmapped) with it
+        assert shm_store.deferred_count() == base
+        assert shm_store.zombie_count() == 0
     finally:
         shm_store.ShmStoreServer._unlink(name)
+
+
+@pytest.fixture(autouse=True)
+def _no_fallback_parking():
+    """Across the whole object-plane suite, the deferred-release path
+    must fully absorb consumer-pinned detaches: the fallback park list
+    stays empty (r4 verdict ask #8)."""
+    from ray_tpu._private import shm_store
+
+    yield
+    assert shm_store.zombie_count() == 0

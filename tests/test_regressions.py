@@ -160,3 +160,43 @@ def test_batched_no_arg_replies_keep_distinct_values(ray_start_regular):
     values = ray_tpu.get(refs)
     assert len(set(values)) == 200, (
         f"{200 - len(set(values))} duplicated replies")
+
+
+def test_task_records_released_with_return_refs(ray_start_regular):
+    """Owner-side task records must not accumulate forever (regression:
+    every completed entry was retained for lineage unconditionally —
+    ~88 allocator blocks/task leaked on the submit/complete loop). The
+    entry lives exactly as long as a return object is reachable
+    (reference: TaskManager::RemoveLineageReference,
+    src/ray/core_worker/task_manager.cc)."""
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    core = ray_tpu.worker.global_worker.core
+    refs = [one.remote() for _ in range(300)]
+    assert ray_tpu.get(refs) == [1] * 300
+    # retained for lineage while the return refs are live
+    assert len(core.pending_tasks) >= 300
+    del refs
+    deadline = time.time() + 15
+    while time.time() < deadline and core.pending_tasks:
+        time.sleep(0.05)
+    assert not core.pending_tasks, (
+        f"{len(core.pending_tasks)} task records leaked after release")
+
+    # fire-and-forget: returns released while in flight must also drop —
+    # including the VALUES (a completion landing after the release must
+    # not orphan the object in the memory store)
+    store_base = len(core.memory_store._objects)
+    for _ in range(300):
+        one.remote()
+    deadline = time.time() + 15
+    while time.time() < deadline and \
+            (core.pending_tasks or core.reference_counter._refs
+             or len(core.memory_store._objects) > store_base):
+        time.sleep(0.05)
+    assert not core.pending_tasks
+    assert not core.reference_counter._refs
+    assert len(core.memory_store._objects) <= store_base, (
+        f"{len(core.memory_store._objects) - store_base} orphaned values")
