@@ -1523,6 +1523,7 @@ class CoreWorker:
             self._complete_task(spec, rheader, rbufs[fstart:fstart + nframes])
         if put_pairs:
             self.memory_store.put_many(put_pairs)
+        if finished:  # lineage-skip completions carry no put pair
             self.stats["tasks_finished"] += finished
         # Reuse the lease, steal for it, or (after a grace) return it.
         if state.queue:
@@ -1553,6 +1554,14 @@ class CoreWorker:
                     continue
                 if entry.recovery_waiter is not None:
                     slow.append(i)
+                    continue
+                if keep_lineage and entry.lineage_pinned is None:
+                    # returns all released in flight: skip the store
+                    # put (it would orphan — the release-path delete
+                    # already ran) and drop the record, same contract
+                    # as the C path's skip branch
+                    pending.pop(spec.task_id, None)
+                    finished += 1
                     continue
                 if compact:
                     # [meta, frames], oid derived from the task id
@@ -1602,9 +1611,15 @@ class CoreWorker:
             oid_b, in_plasma, meta, start, n, contained_b = ret[:6]
             oid = ObjectID(oid_b)
             if in_plasma:
-                # plasma entry: meta=node_id, start=size
-                self.reference_counter.add_location(oid, meta, start)
-                self.memory_store.put(oid, IN_PLASMA)
+                # plasma entry: meta=node_id, start=size. if_tracked:
+                # refs released while the task ran must not be
+                # resurrected by the location report — free the
+                # replica instead (it has zero owners)
+                if self.reference_counter.add_location_if_tracked(
+                        oid, meta, start):
+                    self.memory_store.put(oid, IN_PLASMA)
+                else:
+                    self._fire_and_forget(self._free_remote(oid, [meta]))
             else:
                 frames = ret[6] if len(ret) > 6 \
                     else rbufs[start:start + n]

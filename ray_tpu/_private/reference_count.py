@@ -214,8 +214,8 @@ class ReferenceCounter:
             if size:
                 ref.size = size
 
-    def add_location_if_tracked(self, object_id,
-                                node_id: bytes) -> bool:
+    def add_location_if_tracked(self, object_id, node_id: bytes,
+                                size: int = 0) -> bool:
         """Like ``add_location`` but refuses to resurrect a released
         ref (a late replica report racing the owner's final release
         must not re-create the entry — the replica would leak)."""
@@ -227,6 +227,8 @@ class ReferenceCounter:
                 ref.locations = set()
             ref.locations.add(node_id)
             ref.in_plasma = True
+            if size:
+                ref.size = size
             return True
 
     def remove_location(self, object_id, node_id: bytes) -> None:

@@ -2,11 +2,15 @@
 
 Parity target: the reference's ``ray.data`` (reference:
 python/ray/data/dataset.py — Dataset :49, map_batches :131,
-repartition :305, sort :612; impl/shuffle.py simple_shuffle :16).
-Blocks are ObjectRefs to plain lists (rows) or numpy struct-dicts;
+repartition :305, sort :612; impl/shuffle.py simple_shuffle :16;
+impl/arrow_block.py:57 for the columnar block layer). Blocks are
+ObjectRefs to COLUMNAR struct-of-numpy-arrays (block.ColumnBlock) with
+exact byte sizes and vectorized sort/shuffle/groupby — rows only at
+the API edge; non-columnizable rows fall back to plain lists.
 ``to_jax``/``iter_batches`` feed device-ready arrays.
 """
 
+from ray_tpu.data.block import ColumnBlock  # noqa: F401
 from ray_tpu.data.dataset import (  # noqa: F401
     BlockMetadata,
     Dataset,

@@ -1,0 +1,203 @@
+"""Columnar blocks: struct-of-numpy-arrays with rows only at the edge.
+
+Parity target: the reference's Arrow block layer
+(reference: python/ray/data/impl/arrow_block.py:57 ArrowBlockAccessor —
+blocks are columnar tables with exact byte sizes and vectorized
+sort/shuffle/groupby). Here the columnar format is a dict of numpy
+arrays (TPU-idiomatic: ``to_jax``/``iter_batches`` hand columns to
+``jnp.asarray`` with zero conversion, and every reorganization op is a
+fancy-index/``argsort``/``searchsorted`` instead of a Python row loop).
+Arbitrary row types (nested dicts, mixed shapes) fall back to plain
+list blocks; every block helper in dataset.py accepts both.
+
+The SCALAR sentinel column holds datasets of bare values
+(``data.range``, ``from_numpy``) — one array, rows are its elements.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+SCALAR = "__value__"
+
+_SCALAR_TYPES = (int, float, bool, str, np.generic)
+
+
+class ColumnBlock:
+    """One block as named numpy columns (all the same length)."""
+
+    __slots__ = ("cols",)
+
+    def __init__(self, cols: Dict[str, np.ndarray]):
+        self.cols = cols
+
+    # -- pickling (slots classes need explicit state) --------------------
+    def __getstate__(self):
+        return self.cols
+
+    def __setstate__(self, cols):
+        self.cols = cols
+
+    # -- shape -----------------------------------------------------------
+    @property
+    def scalar(self) -> bool:
+        return SCALAR in self.cols
+
+    def __len__(self) -> int:
+        for v in self.cols.values():
+            return len(v)
+        return 0
+
+    def size_bytes(self) -> int:
+        """EXACT in-memory bytes of the numpy representation (object
+        columns add the per-element payload the pointer array hides)."""
+        total = 0
+        for arr in self.cols.values():
+            total += arr.nbytes
+            if arr.dtype == object:
+                total += sum(sys.getsizeof(o) for o in arr.flat)
+        return total
+
+    def schema(self):
+        if self.scalar:
+            return _dtype_name(self.cols[SCALAR])
+        return {k: _dtype_name(v) for k, v in self.cols.items()}
+
+    # -- vectorized ops ---------------------------------------------------
+    def key_values(self, key: Optional[str]) -> np.ndarray:
+        """The sort/partition/group key column. ``None`` means the
+        scalar column (sorting bare values, like ``sorted(rows)``)."""
+        if key is None:
+            if not self.scalar:
+                raise KeyError(
+                    "column datasets need a named sort/group key")
+            return self.cols[SCALAR]
+        return self.cols[key]
+
+    def take(self, indices: np.ndarray) -> "ColumnBlock":
+        return ColumnBlock({k: v[indices] for k, v in self.cols.items()})
+
+    def slice(self, start: int, stop: int) -> "ColumnBlock":
+        return ColumnBlock({k: v[start:stop]
+                            for k, v in self.cols.items()})
+
+    # -- the row edge -----------------------------------------------------
+    def to_rows(self) -> List[Any]:
+        if self.scalar:
+            return self.cols[SCALAR].tolist()
+        names = list(self.cols)
+        listed = [self.cols[k].tolist() for k in names]
+        return [dict(zip(names, vals)) for vals in zip(*listed)]
+
+
+def _dtype_name(arr: np.ndarray) -> str:
+    kind = arr.dtype.kind
+    if kind in "iu":
+        return "int"
+    if kind == "f":
+        return "float"
+    if kind == "b":
+        return "bool"
+    if kind in "US":
+        return "str"
+    for o in arr.flat:  # object column: name the first element's type
+        return type(o).__name__
+    return "object"
+
+
+def _column(values: list) -> Optional[np.ndarray]:
+    """values -> 1-D numpy column, or None when the values don't form
+    one (ragged arrays, nested rows)."""
+    try:
+        arr = np.asarray(values)
+    except (ValueError, TypeError, OverflowError):
+        return None
+    if arr.ndim != 1:
+        return None  # per-row ndarrays etc. stay in list blocks
+    if arr.dtype == object:
+        return None  # mixed / nested values: not a real column
+    if arr.dtype.kind == "S":
+        return None  # numpy 'S' strips trailing NULs: unsafe for bytes
+    if arr.dtype.kind == "U" and \
+            not all(isinstance(v, str) for v in values):
+        return None  # numpy coerced mixed values to strings: corrupting
+    if arr.dtype.kind == "f" and \
+            not all(isinstance(v, (float, np.floating)) for v in values):
+        return None  # int->float promotion would rewrite values
+        # (e.g. 2**60+1 rounds); mixed numerics stay row blocks
+    if arr.dtype.kind in "iu" and any(isinstance(v, bool)
+                                      for v in values):
+        return None  # [True, 2] -> int64 would turn True into 1
+    return arr
+
+
+def from_rows(rows: list) -> Union["ColumnBlock", list]:
+    """Columnize when the rows are uniform flat dicts or bare scalars;
+    otherwise return the list unchanged (legacy row block)."""
+    if isinstance(rows, ColumnBlock):
+        return rows
+    if not isinstance(rows, list) or not rows:
+        return rows
+    first = rows[0]
+    if isinstance(first, dict):
+        names = list(first)
+        if any(not isinstance(r, dict) or list(r) != names
+               for r in rows):
+            return rows
+        cols = {}
+        for k in names:
+            col = _column([r[k] for r in rows])
+            if col is None:
+                return rows
+            cols[k] = col
+        return ColumnBlock(cols)
+    if isinstance(first, _SCALAR_TYPES):
+        col = _column(rows)
+        if col is None:
+            return rows
+        return ColumnBlock({SCALAR: col})
+    return rows
+
+
+def rows_of(block) -> list:
+    """Rows view of any block (the API edge)."""
+    if isinstance(block, ColumnBlock):
+        return block.to_rows()
+    return block
+
+
+def num_rows(block) -> int:
+    return len(block)
+
+
+def split_by_partition(block: "ColumnBlock", part: np.ndarray,
+                       n: int) -> List["ColumnBlock"]:
+    """Group a block's rows by partition id (one stable sort +
+    bincount + slices) — shared by range-partition and shuffle-split."""
+    grouped = block.take(np.argsort(part, kind="stable"))
+    counts = np.bincount(part, minlength=n)
+    parts, start = [], 0
+    for c in counts[:n]:
+        parts.append(grouped.slice(start, start + int(c)))
+        start += int(c)
+    return parts
+
+
+def concat(blocks: Sequence) -> Union["ColumnBlock", list]:
+    """Merge blocks; columnar stays columnar when schemas line up."""
+    blocks = [b for b in blocks if len(b)]
+    if not blocks:
+        return []
+    if all(isinstance(b, ColumnBlock) for b in blocks):
+        names = list(blocks[0].cols)
+        if all(list(b.cols) == names for b in blocks):
+            return ColumnBlock({
+                k: np.concatenate([b.cols[k] for b in blocks])
+                for k in names})
+    out: list = []
+    for b in blocks:
+        out.extend(rows_of(b))
+    return out

@@ -3,98 +3,165 @@
 Reference: python/ray/data/dataset.py (Dataset :49). Each transform
 launches one task per block; blocks stay in the object store between
 stages (zero-copy for numpy payloads via the shm plane).
+
+Blocks are COLUMNAR (block.py ColumnBlock — struct of numpy arrays,
+reference analog: data/impl/arrow_block.py:57) whenever the rows
+columnize; sort/shuffle/partition/aggregate on them are numpy
+argsort/searchsorted/bincount instead of Python row loops, and
+``key``/``on`` accept COLUMN NAMES (vectorized) as well as callables
+(row path). Rows materialize only at the API edge (take/iter_rows).
 """
 
 from __future__ import annotations
 
 import builtins
 import functools
+import operator
 import random
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, List, Optional, Union
 
 import numpy as np
 
 import ray_tpu
+from ray_tpu.data.block import (SCALAR, ColumnBlock, concat as _concat,
+                                from_rows, rows_of, split_by_partition)
+
+KeyType = Union[None, str, Callable]
+
+
+def _key_fn(key: KeyType):
+    """Row-space accessor for a key spec (column name or callable)."""
+    if isinstance(key, str):
+        return operator.itemgetter(key)
+    return key
+
+
+def _vec_key(key: KeyType) -> bool:
+    """Keys the columnar path handles without materializing rows."""
+    return key is None or isinstance(key, str)
+
 
 # ---- block-level helpers (run inside tasks; module-level = picklable) --
 
 
 def _block_map(fn, block):
-    return [fn(r) for r in block]
+    return from_rows([fn(r) for r in rows_of(block)])
 
 
 def _block_map_batches(fn, block, fmt):
-    if fmt == "numpy":
+    if fmt == "numpy" and isinstance(block, ColumnBlock):
+        # zero row-trip: scalar blocks hand the bare array, named
+        # blocks a dict of column arrays
+        batch = block.cols[SCALAR] if block.scalar else dict(block.cols)
+    elif fmt == "numpy":
         batch = np.array(block)
     else:
-        batch = block
+        batch = rows_of(block)
     out = fn(batch)
+    if isinstance(out, dict):  # columns back in -> columnar block
+        return ColumnBlock({k: np.asarray(v) for k, v in out.items()})
     if isinstance(out, np.ndarray):
-        return list(out)
-    return list(out)
+        return ColumnBlock({SCALAR: out}) if out.ndim == 1 else list(out)
+    return from_rows(list(out))
 
 
 def _block_filter(fn, block):
-    return [r for r in block if fn(r)]
+    return from_rows([r for r in rows_of(block) if fn(r)])
 
 
 def _block_flat_map(fn, block):
     out = []
-    for r in block:
+    for r in rows_of(block):
         out.extend(fn(r))
-    return out
+    return from_rows(out)
+
+
+def _sample_block_keys(block, key, k):
+    """Up to k evenly-spaced key values from one block (boundary
+    sampling for the distributed sort) — columnar blocks never touch
+    rows."""
+    if isinstance(block, ColumnBlock) and _vec_key(key):
+        kv = block.key_values(key)
+        if len(kv) > k:
+            kv = kv[np.linspace(0, len(kv) - 1, k).astype(np.int64)]
+        return kv.tolist()
+    kf = _key_fn(key)
+    rows = rows_of(block)
+    step = max(1, len(rows) // max(1, k))
+    return [(kf(r) if kf else r) for r in rows[::step][:k]]
 
 
 def _block_sort(block, key, descending):
-    return sorted(block, key=key, reverse=descending)
+    if isinstance(block, ColumnBlock) and _vec_key(key):
+        idx = np.argsort(block.key_values(key), kind="stable")
+        return block.take(idx[::-1] if descending else idx)
+    return from_rows(sorted(rows_of(block), key=_key_fn(key),
+                            reverse=descending))
 
 
 def _block_partition(block, boundaries, key):
-    """Range-partition a sorted-input block for distributed sort."""
-    parts: List[List] = [[] for _ in range(len(boundaries) + 1)]
-    for r in block:
-        k = key(r) if key else r
+    """Range-partition one block for distributed sort."""
+    if isinstance(block, ColumnBlock) and _vec_key(key) and boundaries:
+        # partition id = number of boundaries <= key (same rule as the
+        # row loop below)
+        part = np.searchsorted(np.asarray(boundaries),
+                               block.key_values(key), side="right")
+        return split_by_partition(block, part, len(boundaries) + 1)
+    kf = _key_fn(key)
+    out: List[List] = [[] for _ in range(len(boundaries) + 1)]
+    for r in rows_of(block):
+        k = kf(r) if kf else r
         lo = 0
         for i, b in enumerate(boundaries):
             if k < b:
                 break
             lo = i + 1
-        parts[lo].append(r)
-    return parts
+        out[lo].append(r)
+    return out
 
 
 def _block_shuffle_split(block, n, seed):
+    if isinstance(block, ColumnBlock):
+        rng = np.random.default_rng(seed)
+        return split_by_partition(block, rng.integers(0, n, len(block)),
+                                  n)
     rng = random.Random(seed)
-    parts: List[List] = [[] for _ in range(n)]
+    out: List[List] = [[] for _ in range(n)]
     for r in block:
-        parts[rng.randrange(n)].append(r)
-    return parts
+        out[rng.randrange(n)].append(r)
+    return out
 
 
 def _block_shuffle(block, seed):
+    if isinstance(block, ColumnBlock):
+        rng = np.random.default_rng(seed)
+        return block.take(rng.permutation(len(block)))
     block = list(block)
     random.Random(seed).shuffle(block)
     return block
 
 
 def _merge_blocks(*parts):
-    out = []
-    for p in parts:
-        out.extend(p)
-    return out
+    return _concat(parts)
 
 
 def _merge_sorted(key, descending, *parts):
-    return sorted(_merge_blocks(*parts),
-                  key=key, reverse=descending)
+    return _block_sort(_concat(parts), key, descending)
 
 
 def _zip_blocks(a, b):
-    return list(zip(a, b))
+    return list(zip(rows_of(a), rows_of(b)))
 
 
 def _block_agg(agg, on, block):
-    vals = [on(r) if on else r for r in block]
+    if isinstance(block, ColumnBlock) and _vec_key(on):
+        if not len(block):
+            return None
+        col = block.key_values(on)
+        fn = {"sum": np.sum, "min": np.min, "max": np.max}[agg]
+        return fn(col).item()
+    of = _key_fn(on)
+    vals = [of(r) if of else r for r in rows_of(block)]
     if not vals:
         return None
     if agg == "sum":
@@ -153,7 +220,9 @@ class Dataset:
                 return m.schema
         return None
 
-    def groupby(self, key: Callable) -> "GroupedDataset":
+    def groupby(self, key: KeyType) -> "GroupedDataset":
+        """``key``: a column name (vectorized groupby on columnar
+        blocks) or a row callable."""
         return GroupedDataset(self, key)
 
     # ------------------------------------------------------------ write
@@ -205,13 +274,19 @@ class Dataset:
 
     def repartition(self, num_blocks: int) -> "Dataset":
         """Rebalance into num_blocks blocks (full rebuild, like the
-        reference's shuffle=True path)."""
-        rows = self.take_all()
-        step, rem = divmod(len(rows), num_blocks)
+        reference's shuffle=True path). Columnar inputs re-slice as
+        arrays without a row trip."""
+        fetched = ray_tpu.get(list(self._blocks))
+        merged = _concat(fetched)
+        total = len(merged)
+        step, rem = divmod(total, num_blocks)
         blocks, i = [], 0
         for b in range(num_blocks):
             n = step + (1 if b < rem else 0)
-            blocks.append(ray_tpu.put(rows[i:i + n]))
+            if isinstance(merged, ColumnBlock):
+                blocks.append(ray_tpu.put(merged.slice(i, i + n)))
+            else:
+                blocks.append(ray_tpu.put(from_rows(merged[i:i + n])))
             i += n
         return Dataset(blocks)
 
@@ -236,18 +311,23 @@ class Dataset:
                for j in range(n)]
         return Dataset(out)
 
-    def sort(self, key: Optional[Callable] = None,
+    def sort(self, key: KeyType = None,
              descending: bool = False) -> "Dataset":
         """Distributed range-partitioned sort (reference:
         data/impl/sort.py): sample boundaries, partition each block,
-        merge-sort each range."""
+        merge-sort each range. ``key``: column name (vectorized on
+        columnar blocks, like the reference's Arrow sort) or callable."""
         n = max(1, self.num_blocks)
         if n == 1:
             r = _remote(_block_sort)
             return Dataset([r.remote(self._blocks[0], key, descending)])
-        # sample boundaries from the data
-        sample = self.take(min(1000, self.count()))
-        keys = sorted((key(r) if key else r) for r in sample)
+        # sample boundaries from the data (per-block key samples; no
+        # row materialization on columnar blocks)
+        per = max(8, 1000 // n)
+        sampler = _remote(_sample_block_keys)
+        keys = sorted(k for ks in ray_tpu.get(
+            [sampler.remote(b, key, per) for b in self._blocks])
+            for k in ks)
         boundaries = [keys[min(len(keys) - 1,
                                int(len(keys) * (i + 1) / n))]
                       for i in range(n - 1)] if keys else []
@@ -291,7 +371,12 @@ class Dataset:
     def take(self, n: int = 20) -> List[Any]:
         out: List[Any] = []
         for b in self._blocks:
-            out.extend(ray_tpu.get(b))
+            blk = ray_tpu.get(b)
+            if isinstance(blk, ColumnBlock):
+                # rows materialize for the TAKEN prefix only
+                out.extend(blk.slice(0, n - len(out)).to_rows())
+            else:
+                out.extend(blk)
             if len(out) >= n:
                 return out[:n]
         return out
@@ -299,52 +384,79 @@ class Dataset:
     def take_all(self) -> List[Any]:
         out: List[Any] = []
         for block in ray_tpu.get(list(self._blocks)):
-            out.extend(block)
+            out.extend(rows_of(block))
         return out
 
     def show(self, n: int = 20) -> None:
         for r in self.take(n):
             print(r)
 
-    def sum(self, on: Optional[Callable] = None):
+    def sum(self, on: KeyType = None):
         vals = [v for v in ray_tpu.get(
             [_remote(_block_agg).remote("sum", on, b)
              for b in self._blocks]) if v is not None]
         return builtins.sum(vals) if vals else 0
 
-    def min(self, on: Optional[Callable] = None):
+    def min(self, on: KeyType = None):
         vals = [v for v in ray_tpu.get(
             [_remote(_block_agg).remote("min", on, b)
              for b in self._blocks]) if v is not None]
         return builtins.min(vals)
 
-    def max(self, on: Optional[Callable] = None):
+    def max(self, on: KeyType = None):
         vals = [v for v in ray_tpu.get(
             [_remote(_block_agg).remote("max", on, b)
              for b in self._blocks]) if v is not None]
         return builtins.max(vals)
 
-    def mean(self, on: Optional[Callable] = None):
+    def mean(self, on: KeyType = None):
         return self.sum(on) / max(1, self.count())
 
     def iter_rows(self):
         for b in self._blocks:
-            yield from ray_tpu.get(b)
+            yield from rows_of(ray_tpu.get(b))
 
     def iter_batches(self, *, batch_size: int = 256,
                      batch_format: str = "native"):
         buf: List[Any] = []
+        carry: Optional[np.ndarray] = None  # columnar remainder
         for b in self._blocks:
-            buf.extend(ray_tpu.get(b))
+            blk = ray_tpu.get(b)
+            if batch_format == "numpy" and isinstance(blk, ColumnBlock) \
+                    and blk.scalar and not buf:
+                # array-slice batches, no row materialization
+                arr = blk.cols[SCALAR]
+                if carry is not None:
+                    arr = np.concatenate([carry, arr])
+                    carry = None
+                full = (len(arr) // batch_size) * batch_size
+                for i in range(0, full, batch_size):
+                    yield arr[i:i + batch_size]
+                if full < len(arr):
+                    carry = arr[full:]
+                continue
+            if carry is not None:  # fell off the fast path mid-stream
+                buf.extend(carry.tolist())
+                carry = None
+            buf.extend(rows_of(blk))
             while len(buf) >= batch_size:
                 batch, buf = buf[:batch_size], buf[batch_size:]
                 yield (np.array(batch) if batch_format == "numpy"
                        else batch)
-        if buf:
+        if carry is not None:
+            yield carry
+        elif buf:
             yield np.array(buf) if batch_format == "numpy" else buf
 
     def to_numpy(self) -> np.ndarray:
-        return np.array(self.take_all())
+        blocks = ray_tpu.get(list(self._blocks))
+        if blocks and all(isinstance(b, ColumnBlock) and b.scalar
+                          for b in blocks):
+            return np.concatenate([b.cols[SCALAR] for b in blocks])
+        out: List[Any] = []
+        for b in blocks:
+            out.extend(rows_of(b))
+        return np.array(out)
 
     def to_jax(self, *, batch_size: Optional[int] = None):
         """Device-ready arrays: the whole dataset (batch_size=None) or
@@ -401,6 +513,11 @@ class BlockMetadata:
 def _block_meta(block):
     import sys
 
+    if isinstance(block, ColumnBlock):
+        # columnar: EXACT bytes + dtype-derived schema (reference:
+        # arrow_block.py BlockMetadata carries exact size_bytes)
+        return [len(block), block.size_bytes(),
+                block.schema() if len(block) else None]
     if block and isinstance(block[0], dict):
         schema = {k: type(v).__name__ for k, v in block[0].items()}
     elif block:
@@ -413,15 +530,58 @@ def _block_meta(block):
     return [len(block), size, schema]
 
 
-def _block_group(key_fn, agg_fn, on, block):
+def _block_group(key, agg_fn, on, block):
     # Partials NEVER apply the init seed: a key spanning blocks would
     # absorb it once per block. The seed folds in exactly once, after
     # the final merge (_group_dict_to_rows).
+    kf = _key_fn(key)
+    of = _key_fn(on)
     out = {}
-    for row in block:
-        k = key_fn(row)
-        v = on(row) if on else row
+    for row in rows_of(block):
+        k = kf(row)
+        v = of(row) if of else row
         out[k] = agg_fn(out[k], v) if k in out else v
+    return out
+
+
+def _block_group_vec(key, agg, on, block):
+    """Vectorized per-block groupby for sum/count on named columns
+    (reference: arrow GroupedDataset aggregations): one np.unique +
+    bincount instead of a per-row dict loop."""
+    if isinstance(block, ColumnBlock) and _vec_key(key) and \
+            (agg == "count" or _vec_key(on)):
+        if not len(block):
+            return {}
+        uniq, inv = np.unique(block.key_values(key),
+                              return_inverse=True)
+        if agg == "count":
+            vals = np.bincount(inv, minlength=len(uniq))
+        else:
+            col = block.key_values(on)
+            if col.dtype.kind in "iub":
+                # exact integer accumulation (bincount's float64
+                # weights would round sums above 2**53)
+                vals = np.zeros(len(uniq), dtype=np.int64)
+                np.add.at(vals, inv, col)
+            else:
+                vals = np.bincount(inv, weights=col,
+                                   minlength=len(uniq))
+        return dict(zip(uniq.tolist(), vals.tolist()))
+    kf = _key_fn(key)
+    of = _key_fn(on)
+    out: dict = {}
+    for row in rows_of(block):
+        k = kf(row) if kf else row
+        v = 1 if agg == "count" else (of(row) if of else row)
+        out[k] = out.get(k, 0) + v
+    return out
+
+
+def _merge_add_dicts(*dicts):
+    out: dict = {}
+    for d in dicts:
+        for k, v in d.items():
+            out[k] = out.get(k, 0) + v
     return out
 
 
@@ -435,11 +595,27 @@ def _merge_group_dicts(agg_fn, *dicts):
 
 class GroupedDataset:
     """``ds.groupby(key)`` → per-key aggregations (reference:
-    data/grouped_dataset.py). Hash-combine per block, tree-merge."""
+    data/grouped_dataset.py). Hash-combine per block, tree-merge;
+    column-name keys run the vectorized (bincount) path."""
 
-    def __init__(self, ds: "Dataset", key: Callable):
+    def __init__(self, ds: "Dataset", key: KeyType):
         self._ds = ds
         self._key = key
+
+    def _agg_vec(self, agg: str, on: KeyType) -> "Dataset":
+        part = _remote(_block_group_vec)
+        partials = [part.remote(self._key, agg, on, b)
+                    for b in self._ds._blocks]
+        merge = _remote(_merge_add_dicts)
+        while len(partials) > 1:  # tree reduce
+            nxt = []
+            for i in builtins.range(0, len(partials), 4):
+                group = partials[i:i + 4]
+                nxt.append(merge.remote(*group)
+                           if len(group) > 1 else group[0])
+            partials = nxt
+        items = _remote(_group_dict_to_rows).remote(partials[0])
+        return Dataset([items])
 
     def aggregate(self, agg_fn: Callable, *, on: Optional[Callable] = None,
                   init=None) -> "Dataset":
@@ -459,9 +635,13 @@ class GroupedDataset:
         return Dataset([items])
 
     def count(self) -> "Dataset":
+        if _vec_key(self._key):
+            return self._agg_vec("count", None)
         return self.aggregate(lambda a, b: a + b, on=lambda _: 1)
 
-    def sum(self, on: Optional[Callable] = None) -> "Dataset":
+    def sum(self, on: KeyType = None) -> "Dataset":
+        if _vec_key(self._key) and _vec_key(on):
+            return self._agg_vec("sum", on)
         return self.aggregate(lambda a, b: a + b, on=on)
 
 

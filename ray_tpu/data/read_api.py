@@ -18,6 +18,7 @@ from typing import Any, List, Optional, Union
 import numpy as np
 
 import ray_tpu
+from ray_tpu.data.block import SCALAR, ColumnBlock, from_rows, rows_of
 from ray_tpu.data.dataset import Dataset, _remote
 
 
@@ -37,13 +38,13 @@ def from_items(items: List[Any], parallelism: int = 8) -> Dataset:
     blocks, i = [], 0
     for b in builtins.range(n):  # module defines its own range()
         cnt = step + (1 if b < rem else 0)
-        blocks.append(ray_tpu.put(items[i:i + cnt]))
+        blocks.append(ray_tpu.put(from_rows(items[i:i + cnt])))
         i += cnt
     return Dataset(blocks)
 
 
 def _gen_range(start, stop):
-    return list(builtins.range(start, stop))
+    return ColumnBlock({SCALAR: np.arange(start, stop)})
 
 
 def range(n: int, parallelism: int = 8) -> Dataset:  # noqa: A001
@@ -93,8 +94,14 @@ def _read_binary_file(path):
 
 
 def _read(paths, reader) -> Dataset:
-    r = _remote(reader)
-    return Dataset([r.remote(p) for p in _expand(paths)])
+    r = _remote(_columnized_read)
+    return Dataset([r.remote(reader, p) for p in _expand(paths)])
+
+
+def _columnized_read(reader, path):
+    """File rows land columnar whenever they columnize (csv/json dicts
+    of scalars, numpy/text values); binary and nested rows stay lists."""
+    return from_rows(reader(path))
 
 
 def read_csv(paths) -> Dataset:
@@ -123,7 +130,8 @@ def _read_parquet_file(path, columns=None):
     import pyarrow.parquet as pq
 
     table = pq.read_table(path, columns=columns)
-    return table.to_pylist()  # rows as dicts, consistent with read_csv
+    # rows as dicts (consistent with read_csv), columnized on return
+    return from_rows(table.to_pylist())
 
 
 def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
@@ -143,9 +151,14 @@ def _write_block(path, fmt, block):
         import pyarrow as pa
         import pyarrow.parquet as pq
 
-        pq.write_table(pa.Table.from_pylist(list(block)), path)
+        if isinstance(block, ColumnBlock) and not block.scalar:
+            # columnar -> arrow without a row trip
+            pq.write_table(pa.table(
+                {k: pa.array(v) for k, v in block.cols.items()}), path)
+        else:
+            pq.write_table(pa.Table.from_pylist(rows_of(block)), path)
     elif fmt == "csv":
-        rows = list(block)
+        rows = rows_of(block)
         with open(path, "w", newline="") as f:
             if rows and isinstance(rows[0], dict):
                 w = _csv.DictWriter(f, fieldnames=list(rows[0]))
@@ -160,10 +173,13 @@ def _write_block(path, fmt, block):
                 w.writerows([[r] for r in rows])
     elif fmt == "json":
         with open(path, "w") as f:
-            for r in block:
+            for r in rows_of(block):
                 f.write(_json.dumps(r) + "\n")
     elif fmt == "numpy":
-        np.save(path, np.asarray(list(block)))
+        if isinstance(block, ColumnBlock) and block.scalar:
+            np.save(path, block.cols[SCALAR])
+        else:
+            np.save(path, np.asarray(rows_of(block)))
     else:
         raise ValueError(f"unknown write format {fmt!r}")
     return path

@@ -31,3 +31,13 @@ from ray_tpu.tune.suggest import (  # noqa: F401
     Searcher,
     TPESearcher,
 )
+
+
+def __getattr__(name):
+    # OptunaSearcher loads lazily: optuna is an optional dependency and
+    # importing ray_tpu.tune must not require it (reference analog:
+    # tune/suggest/optuna.py is only imported on use).
+    if name == "OptunaSearcher":
+        from ray_tpu.tune.optuna import OptunaSearcher
+        return OptunaSearcher
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -200,3 +200,61 @@ def test_task_records_released_with_return_refs(ray_start_regular):
     assert not core.reference_counter._refs
     assert len(core.memory_store._objects) <= store_base, (
         f"{len(core.memory_store._objects) - store_base} orphaned values")
+
+
+def test_task_records_released_python_completion_path(ray_start_regular):
+    """The pure-Python completion twin (_complete_batch_py) must apply
+    the same lineage-skip as the C fast path: fire-and-forget values
+    must not be stored after their release already ran (review r5)."""
+    core = ray_tpu.worker.global_worker.core
+    saved = core._fast_ctx
+    core._fast_ctx = None  # force _complete_batch_py
+    try:
+        @ray_tpu.remote
+        def one():
+            return 1
+
+        ray_tpu.get(one.remote())  # pipeline warm on the Python path
+        store_base = len(core.memory_store._objects)
+        finished_base = core.stats["tasks_finished"]
+        for _ in range(200):
+            one.remote()
+        deadline = time.time() + 15
+        while time.time() < deadline and \
+                (core.pending_tasks or core.reference_counter._refs
+                 or len(core.memory_store._objects) > store_base):
+            time.sleep(0.05)
+        assert not core.pending_tasks
+        assert len(core.memory_store._objects) <= store_base
+        # lineage-skip completions still count as finished
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                core.stats["tasks_finished"] < finished_base + 200:
+            time.sleep(0.05)
+        assert core.stats["tasks_finished"] >= finished_base + 200
+    finally:
+        core._fast_ctx = saved
+
+
+def test_plasma_return_released_in_flight_is_freed(ray_start_regular):
+    """A plasma-stored return whose refs died while the task ran must
+    not resurrect the reference record, and its replica must be freed
+    (review r5: add_location_if_tracked + free on untracked)."""
+    @ray_tpu.remote
+    def big():
+        import time as _t
+
+        _t.sleep(0.5)  # outlive the caller's ref
+        return np.zeros(300_000)  # well past the inline threshold
+
+    core = ray_tpu.worker.global_worker.core
+    node = ray_tpu.worker.global_worker.node
+    big.remote()  # ref dropped immediately
+    deadline = time.time() + 20
+    while time.time() < deadline and (
+            core.pending_tasks or core.reference_counter._refs
+            or node.raylet.store.stats()["num_objects"]):
+        time.sleep(0.1)
+    assert not core.reference_counter._refs, "reference resurrected"
+    assert node.raylet.store.stats()["num_objects"] == 0, \
+        "orphaned plasma replica"

@@ -401,3 +401,44 @@ def test_pb2_gp_guided_explore(ray_start_4cpu, tmp_path):
         assert 0.0 <= t["config"]["slope"] <= 2.0, t
     # the best trial still reflects the highest-slope lineage
     assert analysis.best_result()["score"] > 0
+
+
+def test_optuna_searcher_convergence(ray_start_4cpu, tmp_path):
+    """The external-searcher proof of the Searcher seam (r4 verdict ask
+    #7; reference: tune/suggest/optuna.py:41): an optuna-backed
+    searcher passes the same convergence bar as the in-tree TPE, with
+    NO TrialRunner changes. Skips loudly when optuna is absent so CI
+    shows the integration as unexercised rather than silently green."""
+    optuna = pytest.importorskip(
+        "optuna", reason="optuna not installed — the external-searcher "
+        "integration is UNEXERCISED in this environment")
+    from ray_tpu.tune.optuna import OptunaSearcher
+
+    def objective(config):
+        x, y = config["x"], config["y"]
+        tune.report(loss=(x - 0.7) ** 2 + (y + 0.3) ** 2)
+
+    space = {"x": tune.uniform(-2, 2), "y": tune.uniform(-2, 2)}
+    analysis = tune.run(
+        objective, config=space, num_samples=30,
+        search_alg=OptunaSearcher(space, seed=5),
+        metric="loss", mode="min",
+        local_dir=str(tmp_path), name="optuna",
+        max_concurrent_trials=1, verbose=0)
+    assert len(analysis.trials) == 30
+    assert analysis.best_result()["loss"] < 0.05
+    del optuna
+
+
+def test_optuna_searcher_missing_dep_message():
+    """Without optuna the wrapper must fail with an actionable
+    ImportError at construction (not at first suggest)."""
+    try:
+        import optuna  # noqa: F401
+        pytest.skip("optuna installed — covered by the convergence test")
+    except ImportError:
+        pass
+    from ray_tpu.tune import OptunaSearcher
+
+    with pytest.raises(ImportError, match="optuna"):
+        OptunaSearcher({"x": tune.uniform(0, 1)})
