@@ -144,6 +144,8 @@ def from_rows(rows: list) -> Union["ColumnBlock", list]:
     first = rows[0]
     if isinstance(first, dict):
         names = list(first)
+        if not names:
+            return rows  # empty dicts have no columns to carry length
         if any(not isinstance(r, dict) or list(r) != names
                for r in rows):
             return rows

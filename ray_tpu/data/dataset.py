@@ -59,7 +59,12 @@ def _block_map_batches(fn, block, fmt):
         batch = rows_of(block)
     out = fn(batch)
     if isinstance(out, dict):  # columns back in -> columnar block
-        return ColumnBlock({k: np.asarray(v) for k, v in out.items()})
+        cols = {k: np.atleast_1d(np.asarray(v)) for k, v in out.items()}
+        lens = {len(v) for v in cols.values()}
+        if len(lens) > 1:
+            raise ValueError(
+                f"map_batches returned ragged columns (lengths {lens})")
+        return ColumnBlock(cols)
     if isinstance(out, np.ndarray):
         return ColumnBlock({SCALAR: out}) if out.ndim == 1 else list(out)
     return from_rows(list(out))
@@ -577,12 +582,17 @@ def _block_group_vec(key, agg, on, block):
     return out
 
 
-def _merge_add_dicts(*dicts):
-    out: dict = {}
-    for d in dicts:
-        for k, v in d.items():
-            out[k] = out.get(k, 0) + v
-    return out
+def _tree_reduce(merge_remote, partials, extra_args=()):
+    """4-way tree fan-in of partial results (shared by the vectorized
+    and generic groupby paths)."""
+    while len(partials) > 1:
+        nxt = []
+        for i in builtins.range(0, len(partials), 4):
+            group = partials[i:i + 4]
+            nxt.append(merge_remote.remote(*extra_args, *group)
+                       if len(group) > 1 else group[0])
+        partials = nxt
+    return partials[0]
 
 
 def _merge_group_dicts(agg_fn, *dicts):
@@ -606,15 +616,9 @@ class GroupedDataset:
         part = _remote(_block_group_vec)
         partials = [part.remote(self._key, agg, on, b)
                     for b in self._ds._blocks]
-        merge = _remote(_merge_add_dicts)
-        while len(partials) > 1:  # tree reduce
-            nxt = []
-            for i in builtins.range(0, len(partials), 4):
-                group = partials[i:i + 4]
-                nxt.append(merge.remote(*group)
-                           if len(group) > 1 else group[0])
-            partials = nxt
-        items = _remote(_group_dict_to_rows).remote(partials[0])
+        root = _tree_reduce(_remote(_merge_group_dicts), partials,
+                            extra_args=(operator.add,))
+        items = _remote(_group_dict_to_rows).remote(root)
         return Dataset([items])
 
     def aggregate(self, agg_fn: Callable, *, on: Optional[Callable] = None,
@@ -622,16 +626,9 @@ class GroupedDataset:
         part = _remote(_block_group)
         partials = [part.remote(self._key, agg_fn, on, b)
                     for b in self._ds._blocks]
-        merge = _remote(_merge_group_dicts)
-        while len(partials) > 1:  # tree reduce
-            nxt = []
-            for i in builtins.range(0, len(partials), 4):
-                group = partials[i:i + 4]
-                nxt.append(merge.remote(agg_fn, *group)
-                           if len(group) > 1 else group[0])
-            partials = nxt
-        items = _remote(_group_dict_to_rows).remote(
-            partials[0], agg_fn, init)
+        root = _tree_reduce(_remote(_merge_group_dicts), partials,
+                            extra_args=(agg_fn,))
+        items = _remote(_group_dict_to_rows).remote(root, agg_fn, init)
         return Dataset([items])
 
     def count(self) -> "Dataset":

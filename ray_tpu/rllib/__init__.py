@@ -12,8 +12,14 @@ and PG (build_trainer compositions, reference: rllib/agents/a3c/a2c.py
 + agents/pg/pg.py), DQN with double-Q (replay off-policy + offline IO,
 reference: rllib/agents/dqn + rllib/execution/replay_buffer.py +
 rllib/offline/), SAC-discrete (twin critics + entropy regularization,
-reference: rllib/agents/sac), and IMPALA-lite (async on-policy with
-importance weighting).
+reference: rllib/agents/sac), SAC-continuous (squashed-Gaussian actor
++ twin Q(s, a) — the non-discrete action path, reference:
+rllib/agents/sac continuous), and IMPALA-lite (async on-policy with
+importance weighting). Cross-cutting seams: the model catalog
+(models.py — MLP/CNN/GRU trunks by config, reference:
+rllib/models/catalog.py:71) feeding every trainer, and the
+multi-agent stack (multi_agent.py — MultiAgentVectorEnv + per-agent
+policy mapping + MA-PPO, reference: rllib/env/multi_agent_env.py:9).
 """
 
 from ray_tpu.rllib import execution  # noqa: F401
@@ -27,7 +33,17 @@ from ray_tpu.rllib.policy import (  # noqa: F401
 )
 from ray_tpu.rllib.a2c import A2CTrainer, PGTrainer  # noqa: F401
 from ray_tpu.rllib.dqn import DQNTrainer  # noqa: F401
+from ray_tpu.rllib.models import (  # noqa: F401
+    MODEL_DEFAULTS,
+    freeze_model_config,
+)
+from ray_tpu.rllib.multi_agent import (  # noqa: F401
+    MultiAgentPPOTrainer,
+    MultiAgentRolloutWorker,
+    MultiAgentVectorEnv,
+)
 from ray_tpu.rllib.sac import SACTrainer  # noqa: F401
+from ray_tpu.rllib.sac_continuous import ContinuousSACTrainer  # noqa: F401
 from ray_tpu.rllib.execution import Trainer, build_trainer  # noqa: F401
 from ray_tpu.rllib.impala import ImpalaTrainer  # noqa: F401
 from ray_tpu.rllib.offline import JsonReader, JsonWriter  # noqa: F401

@@ -37,6 +37,7 @@ A2C_CONFIG: Dict[str, Any] = {
     "lr": 1e-3,
     "vf_coeff": 0.5,
     "entropy_coeff": 0.01,
+    "model": None,                # model-catalog config (models.py)
     "seed": 0,
     # PG mode: drop the critic term from the gradient (value head
     # still trains as a baseline) — this flag IS the difference
@@ -48,15 +49,16 @@ PG_CONFIG = dict(A2C_CONFIG, use_critic=False, entropy_coeff=0.0)
 
 
 @functools.partial(jax.jit, static_argnames=("vf_coeff", "ent_coeff",
-                                             "use_critic", "lr"))
+                                             "use_critic", "lr",
+                                             "model"))
 def _a2c_update(params, opt_state, batch, *, vf_coeff, ent_coeff,
-                use_critic, lr):
+                use_critic, lr, model=None):
     import optax
 
     optimizer = optax.adam(lr)
 
     def loss_fn(p):
-        logits, value = logits_and_value(p, batch["obs"])
+        logits, value = logits_and_value(p, batch["obs"], model)
         logp_all = jax.nn.log_softmax(logits)
         logp = logp_all[jnp.arange(logits.shape[0]), batch["actions"]]
         if use_critic:
@@ -84,7 +86,7 @@ def _learn(self, batch) -> Dict[str, Any]:
         self.params, self._opt_state,
         {k: jnp.asarray(v) for k, v in batch.items()},
         vf_coeff=cfg["vf_coeff"], ent_coeff=cfg["entropy_coeff"],
-        use_critic=cfg["use_critic"], lr=cfg["lr"])
+        use_critic=cfg["use_critic"], lr=cfg["lr"], model=self.model)
     return {"loss": float(loss), "entropy": float(entropy)}
 
 

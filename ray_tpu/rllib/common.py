@@ -19,17 +19,24 @@ from ray_tpu.rllib.rollout_worker import WorkerSet
 
 
 def actor_critic_setup(self, cfg: Dict[str, Any]) -> None:
-    """Probe env → policy params + Adam state + WorkerSet + counters."""
+    """Probe env → policy params + Adam state + WorkerSet + counters.
+    ``cfg["model"]`` (a model-catalog config dict) selects the network
+    (reference: catalog.py get_model_v2 feeding every agent)."""
     import optax
 
+    from ray_tpu.rllib.models import freeze_model_config
+
     probe = make_env(cfg["env"], 1)
+    self.model = freeze_model_config(cfg["model"]) \
+        if cfg.get("model") else None
     self.params = init_policy_params(
         jax.random.key(cfg["seed"]), probe.observation_size,
-        probe.num_actions)
+        probe.num_actions, model=self.model)
     self._opt_state = optax.adam(cfg["lr"]).init(self.params)
     self.workers = WorkerSet(
         cfg["env"], cfg["num_workers"], cfg["num_envs_per_worker"],
-        cfg["rollout_len"], cfg["gamma"], cfg["lambda"])
+        cfg["rollout_len"], cfg["gamma"], cfg["lambda"],
+        model=self.model)
     self._counters = {"timesteps_total": 0}
 
 

@@ -47,6 +47,7 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     "epsilon_decay_iters": 20,
     "double_q": True,
     "hidden": 64,
+    "model": None,                # model-catalog config (models.py)
     "seed": 0,
     "output": None,               # dir → JsonWriter episode logging
     "input": None,                # dir → offline training, no env sampling
@@ -54,7 +55,13 @@ DEFAULT_CONFIG: Dict[str, Any] = {
 
 
 def init_q_params(key, obs_size: int, num_actions: int,
-                  hidden: int = 64) -> Dict:
+                  hidden: int = 64, model=None) -> Dict:
+    """``model``: frozen catalog spec (models.freeze_model_config)
+    switches to the catalog q-net; None keeps the classic tanh MLP."""
+    if model is not None:
+        from ray_tpu.rllib.models import init_q_net
+
+        return init_q_net(model, key, obs_size, num_actions)
     k1, k2, k3 = jax.random.split(key, 3)
     init = jax.nn.initializers.orthogonal(np.sqrt(2))
     return {
@@ -67,15 +74,20 @@ def init_q_params(key, obs_size: int, num_actions: int,
     }
 
 
-def q_values(params, obs):
+def q_values(params, obs, model=None):
+    if model is not None:
+        from ray_tpu.rllib.models import q_net_forward
+
+        return q_net_forward(model, params, obs)
     h = jnp.tanh(obs @ params["w1"] + params["b1"])
     h = jnp.tanh(h @ params["w2"] + params["b2"])
     return h @ params["q"] + params["q_b"]
 
 
-@functools.partial(jax.jit, static_argnames=("gamma", "double_q", "lr"))
+@functools.partial(jax.jit, static_argnames=("gamma", "double_q", "lr",
+                                             "model"))
 def _dqn_update(params, target_params, opt_state, batches, *,
-                gamma, double_q, lr):
+                gamma, double_q, lr, model=None):
     """K Adam steps as one compiled program: lax.scan over the [K,
     batch, ...] stack of replay minibatches (Huber TD loss, double-DQN
     action selection by the online net)."""
@@ -84,11 +96,11 @@ def _dqn_update(params, target_params, opt_state, batches, *,
     optimizer = optax.adam(lr)
 
     def td_loss(p, mb):
-        q = q_values(p, mb["obs"])
+        q = q_values(p, mb["obs"], model)
         qa = q[jnp.arange(q.shape[0]), mb["actions"]]
-        q_next_target = q_values(target_params, mb["next_obs"])
+        q_next_target = q_values(target_params, mb["next_obs"], model)
         if double_q:
-            sel = jnp.argmax(q_values(p, mb["next_obs"]), axis=-1)
+            sel = jnp.argmax(q_values(p, mb["next_obs"], model), axis=-1)
             bootstrap = q_next_target[
                 jnp.arange(q_next_target.shape[0]), sel]
         else:
@@ -120,10 +132,14 @@ class DQNTrainer(execution.Trainer):
     def setup(self, cfg: Dict[str, Any]) -> None:
         import optax
 
+        from ray_tpu.rllib.models import freeze_model_config
+
         probe = make_env(cfg["env"], 1)
+        self.model = freeze_model_config(cfg["model"]) \
+            if cfg.get("model") else None
         self.params = init_q_params(
             jax.random.key(cfg["seed"]), probe.observation_size,
-            probe.num_actions, hidden=cfg["hidden"])
+            probe.num_actions, hidden=cfg["hidden"], model=self.model)
         self.target_params = self.params
         self._opt_state = optax.adam(cfg["lr"]).init(self.params)
         self._offline = cfg["input"] is not None
@@ -143,9 +159,11 @@ class DQNTrainer(execution.Trainer):
             self.workers = []
         else:
             cls = ray_tpu.remote(TransitionWorker)
+            q_fn = q_values if self.model is None else \
+                functools.partial(q_values, model=self.model)
             self.workers = [
                 cls.remote(cfg["env"], cfg["num_envs_per_worker"],
-                           cfg["rollout_len"], q_values, seed=i + 1)
+                           cfg["rollout_len"], q_fn, seed=i + 1)
                 for i in range(cfg["num_workers"])]
         self._writer = JsonWriter(cfg["output"]) if cfg["output"] else None
 
@@ -198,7 +216,7 @@ class DQNTrainer(execution.Trainer):
         self.params, self._opt_state, loss = _dqn_update(
             self.params, self.target_params, self._opt_state,
             stacked, gamma=cfg["gamma"], double_q=cfg["double_q"],
-            lr=cfg["lr"])
+            lr=cfg["lr"], model=self.model)
         return {"loss": float(loss)}
 
     def _update_target(self) -> None:

@@ -191,8 +191,72 @@ class Chain(VectorEnv):
         return self._obs(), reward, done
 
 
+class Pendulum(VectorEnv):
+    """Vectorized pendulum swing-up (the classic continuous-control
+    task, dynamics per the public equations; no gym import): obs
+    [cos θ, sin θ, θ̇], one torque action in [-2, 2], reward
+    −(θ² + 0.1·θ̇² + 0.001·u²), 200-step episodes (time-limit only).
+    The continuous-action oracle for the SAC family."""
+
+    G = 10.0
+    MASS = 1.0
+    LENGTH = 1.0
+    DT = 0.05
+    MAX_TORQUE = 2.0
+    MAX_SPEED = 8.0
+    MAX_STEPS = 200
+
+    observation_size = 3
+    continuous = True
+    action_dim = 1
+    action_low = -2.0
+    action_high = 2.0
+
+    def __init__(self, num_envs: int = 16):
+        self.num_envs = num_envs
+        self._rng = np.random.default_rng(0)
+        self._theta = None
+        self._theta_dot = None
+        self._steps = None
+
+    def _fresh(self, n: int):
+        return (self._rng.uniform(-np.pi, np.pi, size=n),
+                self._rng.uniform(-1.0, 1.0, size=n))
+
+    def _obs(self) -> np.ndarray:
+        return np.stack([np.cos(self._theta), np.sin(self._theta),
+                         self._theta_dot], axis=1).astype(np.float32)
+
+    def reset(self, seed: int = 0) -> np.ndarray:
+        self._rng = np.random.default_rng(seed)
+        self._theta, self._theta_dot = self._fresh(self.num_envs)
+        self._steps = np.zeros(self.num_envs, dtype=np.int32)
+        return self._obs()
+
+    def step(self, actions: np.ndarray):
+        u = np.clip(np.asarray(actions, dtype=np.float64).reshape(-1),
+                    -self.MAX_TORQUE, self.MAX_TORQUE)
+        th = np.mod(self._theta + np.pi, 2 * np.pi) - np.pi  # normalize
+        cost = th ** 2 + 0.1 * self._theta_dot ** 2 + 0.001 * u ** 2
+        new_dot = self._theta_dot + (
+            3 * self.G / (2 * self.LENGTH) * np.sin(self._theta)
+            + 3.0 / (self.MASS * self.LENGTH ** 2) * u) * self.DT
+        new_dot = np.clip(new_dot, -self.MAX_SPEED, self.MAX_SPEED)
+        self._theta = self._theta + new_dot * self.DT
+        self._theta_dot = new_dot
+        self._steps += 1
+        done = self._steps >= self.MAX_STEPS
+        if done.any():
+            n = int(done.sum())
+            fresh_th, fresh_dot = self._fresh(n)
+            self._theta[done] = fresh_th
+            self._theta_dot[done] = fresh_dot
+            self._steps[done] = 0
+        return self._obs(), (-cost).astype(np.float32), done
+
+
 ENV_REGISTRY = {"CartPole-v0": JaxCartPole, "CartPole-np": CartPole,
-                "Chain-v0": Chain}
+                "Chain-v0": Chain, "Pendulum-v0": Pendulum}
 
 
 def make_env(name_or_cls, num_envs: int):

@@ -35,14 +35,16 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     "rho_clip": 1.0,
     "vf_coeff": 0.5,
     "entropy_coeff": 0.01,
+    "model": None,                # model-catalog config (models.py)
     "seed": 0,
 }
 
 
 @functools.partial(jax.jit, static_argnames=("rho_clip", "vf_coeff",
-                                             "ent_coeff", "lr"))
+                                             "ent_coeff", "lr",
+                                             "model"))
 def _impala_update(params, opt_state, batch, *, rho_clip, vf_coeff,
-                   ent_coeff, lr):
+                   ent_coeff, lr, model=None):
     """One importance-weighted Adam step as a single compiled program
     (mirrors _ppo_update/_dqn_update — no per-leaf host dispatches)."""
     import optax
@@ -50,7 +52,8 @@ def _impala_update(params, opt_state, batch, *, rho_clip, vf_coeff,
     optimizer = optax.adam(lr)
     (loss, aux), grads = jax.value_and_grad(
         impala_loss, has_aux=True)(params, batch, rho_clip=rho_clip,
-                                   vf_coeff=vf_coeff, ent_coeff=ent_coeff)
+                                   vf_coeff=vf_coeff,
+                                   ent_coeff=ent_coeff, model=model)
     updates, opt_state = optimizer.update(grads, opt_state, params)
     params = optax.apply_updates(params, updates)
     return params, opt_state, loss, aux["entropy"]
@@ -64,14 +67,19 @@ class ImpalaTrainer(execution.Trainer):
     def setup(self, cfg: Dict[str, Any]) -> None:
         import optax
 
+        from ray_tpu.rllib.models import freeze_model_config
+
         probe = make_env(cfg["env"], 1)
+        self.model = freeze_model_config(cfg["model"]) \
+            if cfg.get("model") else None
         self.params = init_policy_params(
             jax.random.key(cfg["seed"]), probe.observation_size,
-            probe.num_actions)
+            probe.num_actions, model=self.model)
         self._opt_state = optax.adam(cfg["lr"]).init(self.params)
         self.workers = WorkerSet(
             cfg["env"], cfg["num_workers"], cfg["num_envs_per_worker"],
-            cfg["rollout_len"], cfg["gamma"], cfg["lambda"])
+            cfg["rollout_len"], cfg["gamma"], cfg["lambda"],
+            model=self.model)
         self._counters = {"timesteps_total": 0}
 
     def execution_plan(self):
@@ -94,7 +102,7 @@ class ImpalaTrainer(execution.Trainer):
         self.params, self._opt_state, loss, entropy = _impala_update(
             self.params, self._opt_state, jb, rho_clip=cfg["rho_clip"],
             vf_coeff=cfg["vf_coeff"], ent_coeff=cfg["entropy_coeff"],
-            lr=cfg["lr"])
+            lr=cfg["lr"], model=self.model)
         return {"loss": float(loss), "entropy": float(entropy)}
 
     def get_state(self) -> dict:

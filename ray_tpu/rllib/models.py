@@ -1,0 +1,236 @@
+"""Model catalog: network trunks chosen by config.
+
+Parity target: the reference's ModelCatalog
+(reference: rllib/models/catalog.py:71 get_model_v2 — the network is
+picked from the model config, not hand-wired per algorithm; fcnet /
+vision / recurrent variants live behind one seam). TPU-first
+re-design: a model is (init(key, obs_size) -> (params, feat_size),
+apply(params, obs) -> [B, feat]) of PURE functions over pytrees — the
+policy/Q heads attach on top, and the whole thing stays inside the
+caller's single jitted device program (the spec is a hashable frozen
+tuple, safe as a jit static argument or a trace-time constant).
+
+Trunks:
+- ``mlp``: dense stack, ``hiddens``/``activation`` from the config.
+- ``cnn``: conv stack over ``conv_input_shape`` (H, W, C) — flat obs
+  are reshaped on device; MXU-friendly NHWC convs.
+- ``gru``: recurrent encoder over a stacked observation window
+  (``seq_len`` frames flattened into the obs vector, the functional
+  analog of the reference's use_lstm wrapper) via one lax.scan.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MODEL_DEFAULTS: Dict[str, Any] = {
+    "type": "mlp",
+    "hiddens": (64, 64),
+    "activation": "tanh",
+    # cnn
+    "conv_input_shape": None,        # (H, W, C); required for type=cnn
+    "conv_filters": ((16, 4, 2), (32, 3, 2)),  # (features, kernel, stride)
+    # gru
+    "seq_len": None,                 # frames per obs window (type=gru)
+    "gru_hidden": 64,
+}
+
+
+def freeze_model_config(cfg: Optional[Dict[str, Any]]) -> tuple:
+    """Model config -> canonical hashable spec (jit-static safe).
+    Nested lists become tuples; key order is fixed."""
+    merged = dict(MODEL_DEFAULTS)
+    merged.update(cfg or {})
+    unknown = set(merged) - set(MODEL_DEFAULTS)
+    if unknown:
+        raise ValueError(f"unknown model config keys: {sorted(unknown)}")
+
+    def _freeze(v):
+        if isinstance(v, (list, tuple)):
+            return tuple(_freeze(x) for x in v)
+        return v
+
+    return tuple((k, _freeze(merged[k])) for k in sorted(merged))
+
+
+def _get(spec: tuple, key: str):
+    for k, v in spec:
+        if k == key:
+            return v
+    raise KeyError(key)
+
+
+def _act(name: str):
+    return {"tanh": jnp.tanh, "relu": jax.nn.relu,
+            "silu": jax.nn.silu}[name]
+
+
+def _dense_init(key, fan_in: int, fan_out: int, scale=None):
+    init = jax.nn.initializers.orthogonal(
+        scale if scale is not None else np.sqrt(2))
+    return {"w": init(key, (fan_in, fan_out), jnp.float32),
+            "b": jnp.zeros((fan_out,))}
+
+
+# ---------------------------------------------------------------- mlp
+
+def _mlp_init(spec, key, obs_size):
+    hiddens = _get(spec, "hiddens")
+    layers, fan_in = [], obs_size
+    for h in hiddens:
+        key, sub = jax.random.split(key)
+        layers.append(_dense_init(sub, fan_in, h))
+        fan_in = h
+    return {"layers": layers}, fan_in
+
+
+def _mlp_apply(spec, params, obs):
+    act = _act(_get(spec, "activation"))
+    h = obs
+    for layer in params["layers"]:
+        h = act(h @ layer["w"] + layer["b"])
+    return h
+
+
+# ---------------------------------------------------------------- cnn
+
+def _cnn_init(spec, key, obs_size):
+    shape = _get(spec, "conv_input_shape")
+    if shape is None:
+        raise ValueError("type=cnn needs model config conv_input_shape")
+    h, w, c = shape
+    if h * w * c != obs_size:
+        raise ValueError(
+            f"conv_input_shape {shape} != obs_size {obs_size}")
+    convs = []
+    in_ch = c
+    for feats, kernel, stride in _get(spec, "conv_filters"):
+        key, sub = jax.random.split(key)
+        fan_in = kernel * kernel * in_ch
+        convs.append({
+            "w": jax.nn.initializers.orthogonal(np.sqrt(2))(
+                sub, (kernel, kernel, in_ch, feats), jnp.float32),
+            "b": jnp.zeros((feats,)),
+        })
+        h = math.ceil(h / stride)
+        w = math.ceil(w / stride)
+        in_ch = feats
+    key, sub = jax.random.split(key)
+    hiddens = _get(spec, "hiddens")
+    feat = hiddens[-1] if hiddens else 64
+    flat = h * w * in_ch
+    return {"convs": convs, "out": _dense_init(sub, flat, feat)}, feat
+
+
+def _cnn_apply(spec, params, obs):
+    shape = _get(spec, "conv_input_shape")
+    x = obs.reshape((obs.shape[0],) + tuple(shape))
+    strides = [s for _, _, s in _get(spec, "conv_filters")]
+    for conv, stride in zip(params["convs"], strides):
+        x = jax.lax.conv_general_dilated(
+            x, conv["w"], window_strides=(stride, stride),
+            padding="SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        x = jax.nn.relu(x + conv["b"])
+    x = x.reshape(x.shape[0], -1)
+    out = params["out"]
+    return jax.nn.relu(x @ out["w"] + out["b"])
+
+
+# ---------------------------------------------------------------- gru
+
+def _gru_init(spec, key, obs_size):
+    seq_len = _get(spec, "seq_len")
+    if not seq_len:
+        raise ValueError("type=gru needs model config seq_len")
+    if obs_size % seq_len:
+        raise ValueError(f"obs_size {obs_size} not divisible by "
+                         f"seq_len {seq_len}")
+    feat_in = obs_size // seq_len
+    hidden = _get(spec, "gru_hidden")
+    ks = jax.random.split(key, 3)
+    glorot = jax.nn.initializers.glorot_uniform()
+    # fused gate weights: [z | r | h~]
+    return {
+        "wx": glorot(ks[0], (feat_in, 3 * hidden), jnp.float32),
+        "wh": glorot(ks[1], (hidden, 3 * hidden), jnp.float32),
+        "b": jnp.zeros((3 * hidden,)),
+    }, hidden
+
+
+def _gru_apply(spec, params, obs):
+    seq_len = _get(spec, "seq_len")
+    hidden = _get(spec, "gru_hidden")
+    b = obs.shape[0]
+    xs = obs.reshape(b, seq_len, -1).swapaxes(0, 1)  # [L, B, F]
+
+    def cell(h, x):
+        gates_x = x @ params["wx"] + params["b"]
+        gates_h = h @ params["wh"]
+        zx, rx, nx = jnp.split(gates_x, 3, axis=-1)
+        zh, rh, nh = jnp.split(gates_h, 3, axis=-1)
+        z = jax.nn.sigmoid(zx + zh)
+        r = jax.nn.sigmoid(rx + rh)
+        n = jnp.tanh(nx + r * nh)
+        h = (1 - z) * n + z * h
+        return h, None
+
+    h0 = jnp.zeros((b, hidden))
+    h_last, _ = jax.lax.scan(cell, h0, xs)
+    return h_last
+
+
+_TRUNKS = {"mlp": (_mlp_init, _mlp_apply),
+           "cnn": (_cnn_init, _cnn_apply),
+           "gru": (_gru_init, _gru_apply)}
+
+
+def init_trunk(spec: tuple, key, obs_size: int) -> Tuple[Dict, int]:
+    """-> (trunk params, feature size). ``spec`` from
+    freeze_model_config."""
+    return _TRUNKS[_get(spec, "type")][0](spec, key, obs_size)
+
+
+def apply_trunk(spec: tuple, params: Dict, obs) -> Any:
+    """[B, obs_size] -> [B, feat]. Pure; safe inside any jit trace
+    (``spec`` is a Python constant at trace time)."""
+    return _TRUNKS[_get(spec, "type")][1](spec, params, obs)
+
+
+# ------------------------------------------- catalog-backed policy/Q
+
+def init_actor_critic(spec: tuple, key, obs_size: int,
+                      num_actions: int) -> Dict:
+    """Trunk + pi/vf heads (the catalog twin of
+    policy.init_policy_params)."""
+    k_t, k_pi, k_vf = jax.random.split(key, 3)
+    trunk, feat = init_trunk(spec, k_t, obs_size)
+    return {
+        "trunk": trunk,
+        "pi": _dense_init(k_pi, feat, num_actions, scale=0.01),
+        "vf": _dense_init(k_vf, feat, 1),
+    }
+
+
+def actor_critic_forward(spec: tuple, params: Dict, obs):
+    """-> (logits, value), catalog twin of policy.logits_and_value."""
+    h = apply_trunk(spec, params["trunk"], obs)
+    logits = h @ params["pi"]["w"] + params["pi"]["b"]
+    value = (h @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+    return logits, value
+
+
+def init_q_net(spec: tuple, key, obs_size: int, num_actions: int) -> Dict:
+    k_t, k_q = jax.random.split(key)
+    trunk, feat = init_trunk(spec, k_t, obs_size)
+    return {"trunk": trunk,
+            "q": _dense_init(k_q, feat, num_actions, scale=0.01)}
+
+
+def q_net_forward(spec: tuple, params: Dict, obs):
+    h = apply_trunk(spec, params["trunk"], obs)
+    return h @ params["q"]["w"] + params["q"]["b"]

@@ -1,0 +1,332 @@
+"""Multi-agent RL: env protocol, per-agent policy mapping, MA-PPO.
+
+Parity target: the reference's multi-agent stack
+(reference: rllib/env/multi_agent_env.py:9 — dict-keyed obs/action/
+reward spaces per agent — and the policy-mapping machinery in
+rllib/evaluation/rollout_worker.py:105 ``policy_mapping_fn`` +
+``MultiAgentSampleBatchBuilder`` grouping transitions per POLICY).
+TPU-first re-design: every agent's env slice is BATCHED ([B, ...] like
+the single-agent VectorEnv), so each policy still does one fused
+device sampling step per rollout tick, and the learner runs one jitted
+PPO update per policy over the concatenation of all agents mapped to
+it. Policies may have DIFFERENT observation/action spaces.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib import execution
+from ray_tpu.rllib.policy import (compute_gae, init_policy_params,
+                                  sample_actions)
+from ray_tpu.rllib.ppo import _ppo_update
+
+
+class MultiAgentVectorEnv:
+    """Batched multi-agent env protocol (synchronized steps: every
+    agent acts each tick; episodes end together — the "__all__" done
+    of the reference's MultiAgentEnv).
+
+    ``agents``: {agent_id: (observation_size, num_actions)}.
+    ``reset(seed) -> {agent_id: obs [B, obs_size]}``
+    ``step({agent_id: actions [B]}) -> (obs_dict, reward_dict,
+    done [B])`` — done episodes auto-reset.
+    """
+
+    num_envs: int
+    agents: Dict[str, Tuple[int, int]]
+
+    def reset(self, seed: int = 0) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def step(self, actions: Dict[str, np.ndarray]):
+        raise NotImplementedError
+
+
+class MultiTarget(MultiAgentVectorEnv):
+    """Two-policy debug env (reference role: rllib/examples/env/
+    multi_agent.py debug envs): each agent sees a one-hot target drawn
+    from ITS OWN action space (different sizes per agent — proves the
+    per-policy spaces really are independent) and earns +1 for matching
+    it. Optimal per-agent return = MAX_STEPS. Deterministic
+    learnability oracle for the mapping + per-policy learners."""
+
+    MAX_STEPS = 8
+    AGENT_SPECS = {"alpha": 3, "beta": 5}  # agent -> num_actions
+
+    def __init__(self, num_envs: int = 8):
+        self.num_envs = num_envs
+        self.agents = {aid: (n, n) for aid, n in self.AGENT_SPECS.items()}
+        self._rng = np.random.default_rng(0)
+        self._targets: Dict[str, np.ndarray] = {}
+        self._steps = None
+
+    def _draw(self) -> None:
+        self._targets = {
+            aid: self._rng.integers(0, n, size=self.num_envs)
+            for aid, n in self.AGENT_SPECS.items()}
+
+    def _obs(self) -> Dict[str, np.ndarray]:
+        out = {}
+        for aid, n in self.AGENT_SPECS.items():
+            eye = np.eye(n, dtype=np.float32)
+            out[aid] = eye[self._targets[aid]]
+        return out
+
+    def reset(self, seed: int = 0) -> Dict[str, np.ndarray]:
+        self._rng = np.random.default_rng(seed)
+        self._steps = np.zeros(self.num_envs, dtype=np.int32)
+        self._draw()
+        return self._obs()
+
+    def step(self, actions: Dict[str, np.ndarray]):
+        rewards = {
+            aid: (np.asarray(actions[aid]) == self._targets[aid])
+            .astype(np.float32)
+            for aid in self.AGENT_SPECS}
+        self._steps += 1
+        done = self._steps >= self.MAX_STEPS
+        if done.any():
+            self._steps[done] = 0
+        self._draw()  # fresh targets every tick (and for new episodes)
+        return self._obs(), rewards, done
+
+
+MULTI_ENV_REGISTRY = {"MultiTarget-v0": MultiTarget}
+
+
+def make_multi_env(name_or_cls, num_envs: int) -> MultiAgentVectorEnv:
+    if isinstance(name_or_cls, str):
+        name_or_cls = MULTI_ENV_REGISTRY[name_or_cls]
+    return name_or_cls(num_envs=num_envs)
+
+
+def validate_policy_spaces(agents: Dict[str, Tuple[int, int]],
+                           mapping: Dict[str, str]) -> None:
+    """Agents sharing a policy must share observation/action spaces
+    (reference: the policy-spec validation in
+    rllib/agents/trainer.py validate_config) — fail at setup with a
+    clear error instead of a shape mismatch deep in a worker."""
+    by_policy: Dict[str, Tuple[str, Tuple[int, int]]] = {}
+    for aid, pid in mapping.items():
+        spaces = agents[aid]
+        seen = by_policy.setdefault(pid, (aid, spaces))
+        if seen[1] != spaces:
+            raise ValueError(
+                f"agents {seen[0]!r} {seen[1]} and {aid!r} {spaces} "
+                f"map to policy {pid!r} but have different "
+                f"(obs_size, num_actions) spaces")
+
+
+class MultiAgentRolloutWorker:
+    """Steps a MultiAgentVectorEnv with one policy per mapping entry,
+    grouping trajectories per POLICY (reference:
+    MultiAgentSampleBatchBuilder.postprocess_batch_so_far). Returns
+    {policy_id: sample batch} with GAE computed per agent stream
+    before grouping."""
+
+    def __init__(self, env_name, num_envs: int, rollout_len: int,
+                 policy_mapping: Dict[str, str], seed: int = 0,
+                 gamma: float = 0.99, lam: float = 0.95):
+        self.env = make_multi_env(env_name, num_envs)
+        self.mapping = dict(policy_mapping)
+        unknown = set(self.env.agents) - set(self.mapping)
+        if unknown:
+            raise ValueError(f"agents without a policy: {sorted(unknown)}")
+        self.rollout_len = rollout_len
+        self.gamma, self.lam = gamma, lam
+        self._key = jax.random.key(seed)
+        self.obs = self.env.reset(seed)
+        validate_policy_spaces(self.env.agents, self.mapping)
+        self.policies: Dict[str, Any] = {}
+        for aid, pid in self.mapping.items():
+            obs_size, num_actions = self.env.agents[aid]
+            if pid in self.policies:
+                continue
+            self.policies[pid] = init_policy_params(
+                jax.random.key(zlib.crc32(pid.encode()) & 0xFFFF),
+                obs_size, num_actions)
+        self._ep_return = np.zeros(num_envs, dtype=np.float32)
+        self._finished_returns: List[float] = []
+
+    def set_weights(self, policies: Dict[str, Any]) -> None:
+        self.policies.update(policies)
+
+    def sample(self) -> Dict[str, Dict[str, np.ndarray]]:
+        T, B = self.rollout_len, self.env.num_envs
+        aids = list(self.env.agents)
+        buf = {aid: {"obs": [], "actions": [], "logp": [], "value": [],
+                     "reward": []} for aid in aids}
+        dones = []
+        for _ in range(T):
+            acts = {}
+            for aid in aids:
+                self._key, sub = jax.random.split(self._key)
+                params = self.policies[self.mapping[aid]]
+                a, logp, value = sample_actions(
+                    params, jnp.asarray(self.obs[aid]), sub)
+                acts[aid] = np.asarray(a)
+                b = buf[aid]
+                b["obs"].append(self.obs[aid])
+                b["actions"].append(acts[aid])
+                b["logp"].append(np.asarray(logp))
+                b["value"].append(np.asarray(value))
+            self.obs, rewards, done = self.env.step(acts)
+            step_total = np.zeros(B, dtype=np.float32)
+            for aid in aids:
+                buf[aid]["reward"].append(rewards[aid])
+                step_total += rewards[aid]
+            dones.append(done.astype(np.float32))
+            self._ep_return += step_total
+            if done.any():
+                self._finished_returns.extend(
+                    self._ep_return[done].tolist())
+                self._ep_return[done] = 0.0
+        done_arr = np.stack(dones)
+
+        # per-agent GAE, then group by policy
+        per_policy: Dict[str, List[dict]] = {}
+        for aid in aids:
+            b = {k: np.stack(v) for k, v in buf[aid].items()}
+            # terminal bootstrap: value of the CURRENT obs under the
+            # agent's policy
+            _, _, last_value = sample_actions(
+                self.policies[self.mapping[aid]],
+                jnp.asarray(self.obs[aid]), self._key)
+            adv, ret = compute_gae(b["reward"], b["value"], done_arr,
+                                   np.asarray(last_value),
+                                   gamma=self.gamma, lam=self.lam)
+            flat = lambda a: a.reshape(-1, *a.shape[2:])  # noqa: E731
+            per_policy.setdefault(self.mapping[aid], []).append({
+                "obs": flat(b["obs"]), "actions": flat(b["actions"]),
+                "logp_old": flat(b["logp"]), "advantages": flat(adv),
+                "returns": flat(ret)})
+        return {pid: execution.concat_batches(parts)
+                for pid, parts in per_policy.items()}
+
+    def episode_returns(self, clear: bool = True) -> List[float]:
+        out = list(self._finished_returns)
+        if clear:
+            self._finished_returns.clear()
+        return out
+
+
+MA_PPO_CONFIG: Dict[str, Any] = {
+    "env": "MultiTarget-v0",
+    "num_workers": 1,
+    "num_envs_per_worker": 8,
+    "rollout_len": 32,
+    "gamma": 0.99,
+    "lambda": 0.95,
+    "lr": 3e-3,
+    "clip": 0.2,
+    "vf_coeff": 0.5,
+    "entropy_coeff": 0.01,
+    "num_sgd_epochs": 4,
+    "minibatch_size": 128,
+    "seed": 0,
+    "multiagent": {
+        # agent_id -> policy_id (reference: policy_mapping_fn; a dict
+        # here so it ships to worker actors without pickling closures)
+        "policy_mapping": None,   # default: each agent its own policy
+    },
+}
+
+
+def _ma_setup(self, cfg: Dict[str, Any]) -> None:
+    import optax
+
+    probe = make_multi_env(cfg["env"], 1)
+    mapping = (cfg.get("multiagent") or {}).get("policy_mapping") or \
+        {aid: aid for aid in probe.agents}
+    validate_policy_spaces(probe.agents, mapping)
+    self.policy_mapping = mapping
+    self.params: Dict[str, Any] = {}
+    self._opt_states: Dict[str, Any] = {}
+    self._optimizer = optax.adam(cfg["lr"])
+    for aid, pid in mapping.items():
+        if pid in self.params:
+            continue
+        obs_size, num_actions = probe.agents[aid]
+        self.params[pid] = init_policy_params(
+            jax.random.key(cfg["seed"] + (zlib.crc32(pid.encode())
+                                          & 0xFFFF)),
+            obs_size, num_actions)
+        self._opt_states[pid] = self._optimizer.init(self.params[pid])
+    cls = ray_tpu.remote(MultiAgentRolloutWorker)
+    self.workers = [
+        cls.remote(cfg["env"], cfg["num_envs_per_worker"],
+                   cfg["rollout_len"], mapping, seed=i + 1,
+                   gamma=cfg["gamma"], lam=cfg["lambda"])
+        for i in range(cfg["num_workers"])]
+    self._counters = {"timesteps_total": 0}
+    self._key = jax.random.key(cfg["seed"] + 1)
+
+
+def _ma_learn(self, batches: Dict[str, dict]) -> Dict[str, Any]:
+    """One PPO update per policy (reference: Trainer._train over the
+    policy map — each policy optimizes only its own experience)."""
+    cfg = self.config
+    out: Dict[str, Any] = {}
+    for pid, batch in batches.items():
+        num_minibatches = max(1, len(batch["obs"]) //
+                              cfg["minibatch_size"])
+        self._key, sub = jax.random.split(self._key)
+        (self.params[pid], self._opt_states[pid], loss,
+         entropy) = _ppo_update(
+            self.params[pid], self._opt_states[pid],
+            {k: jnp.asarray(v) for k, v in batch.items()}, sub,
+            num_epochs=cfg["num_sgd_epochs"],
+            num_minibatches=num_minibatches, clip=cfg["clip"],
+            vf_coeff=cfg["vf_coeff"], ent_coeff=cfg["entropy_coeff"],
+            lr=cfg["lr"])
+        out[f"policy_{pid}_loss"] = float(loss)
+        out[f"policy_{pid}_entropy"] = float(entropy)
+    return out
+
+
+def _ma_execution_plan(self):
+    def merge(dicts: List[Dict[str, dict]]) -> Dict[str, dict]:
+        merged: Dict[str, List[dict]] = {}
+        for d in dicts:
+            for pid, b in d.items():
+                merged.setdefault(pid, []).append(b)
+        return {pid: execution.concat_batches(bs)
+                for pid, bs in merged.items()}
+
+    def rollouts():
+        while True:
+            ray_tpu.get([w.set_weights.remote(self.params)
+                         for w in self.workers])
+            batches = merge(ray_tpu.get(
+                [w.sample.remote() for w in self.workers]))
+            self._counters["timesteps_total"] += sum(
+                len(b["obs"]) for b in batches.values())
+            yield batches
+
+    it = execution.TrainOneStep(rollouts(), lambda b: _ma_learn(self, b))
+    return execution.StandardMetricsReporting(
+        it, self.workers, self._counters)
+
+
+def _ma_get_state(self) -> dict:
+    return {"params": self.params, "opt_states": self._opt_states,
+            "timesteps": self._counters["timesteps_total"]}
+
+
+def _ma_set_state(self, state: dict) -> None:
+    self.params = state["params"]
+    self._opt_states = state["opt_states"]
+    self._counters["timesteps_total"] = state["timesteps"]
+
+
+MultiAgentPPOTrainer = execution.build_trainer(
+    name="MultiAgentPPOTrainer", default_config=MA_PPO_CONFIG,
+    setup=_ma_setup, execution_plan=_ma_execution_plan,
+    get_state=_ma_get_state, set_state=_ma_set_state)

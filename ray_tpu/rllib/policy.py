@@ -18,7 +18,14 @@ import numpy as np
 
 
 def init_policy_params(key, obs_size: int, num_actions: int,
-                       hidden: int = 64) -> Dict:
+                       hidden: int = 64, model=None) -> Dict:
+    """``model``: a frozen catalog spec (models.freeze_model_config)
+    switches the trunk to the catalog network (reference:
+    rllib/models/catalog.py:71); None keeps the classic tanh MLP."""
+    if model is not None:
+        from ray_tpu.rllib.models import init_actor_critic
+
+        return init_actor_critic(model, key, obs_size, num_actions)
     k1, k2, k3, k4 = jax.random.split(key, 4)
     init = jax.nn.initializers.orthogonal(np.sqrt(2))
     zinit = jax.nn.initializers.orthogonal(0.01)
@@ -39,16 +46,20 @@ def _trunk(params, obs):
     return jnp.tanh(h @ params["w2"] + params["b2"])
 
 
-def logits_and_value(params, obs):
+def logits_and_value(params, obs, model=None):
+    if model is not None:
+        from ray_tpu.rllib.models import actor_critic_forward
+
+        return actor_critic_forward(model, params, obs)
     h = _trunk(params, obs)
     return (h @ params["pi"] + params["pi_b"],
             (h @ params["vf"] + params["vf_b"])[..., 0])
 
 
-@jax.jit
-def sample_actions(params, obs, key):
+@functools.partial(jax.jit, static_argnames=("model",))
+def sample_actions(params, obs, key, model=None):
     """→ (actions, logp, value): one fused device step per env batch."""
-    logits, value = logits_and_value(params, obs)
+    logits, value = logits_and_value(params, obs, model)
     actions = jax.random.categorical(key, logits)
     logp = jax.nn.log_softmax(logits)[
         jnp.arange(logits.shape[0]), actions]
@@ -56,10 +67,11 @@ def sample_actions(params, obs, key):
 
 
 @functools.partial(jax.jit, static_argnames=("clip", "vf_coeff",
-                                             "ent_coeff"))
-def ppo_loss(params, batch, *, clip=0.2, vf_coeff=0.5, ent_coeff=0.01):
+                                             "ent_coeff", "model"))
+def ppo_loss(params, batch, *, clip=0.2, vf_coeff=0.5, ent_coeff=0.01,
+             model=None):
     """Clipped-surrogate PPO objective (standard public formulation)."""
-    logits, value = logits_and_value(params, batch["obs"])
+    logits, value = logits_and_value(params, batch["obs"], model)
     logp_all = jax.nn.log_softmax(logits)
     logp = logp_all[jnp.arange(logits.shape[0]), batch["actions"]]
     ratio = jnp.exp(logp - batch["logp_old"])
@@ -74,14 +86,14 @@ def ppo_loss(params, batch, *, clip=0.2, vf_coeff=0.5, ent_coeff=0.01):
 
 
 @functools.partial(jax.jit, static_argnames=("rho_clip", "vf_coeff",
-                                             "ent_coeff"))
+                                             "ent_coeff", "model"))
 def impala_loss(params, batch, *, rho_clip=1.0, vf_coeff=0.5,
-                ent_coeff=0.01):
+                ent_coeff=0.01, model=None):
     """Off-policy actor-critic with clipped importance weights — the
     V-trace-lite objective for async (stale-policy) batches (standard
     public IMPALA formulation, truncated-rho policy gradient; the
     value targets reuse the workers' GAE returns)."""
-    logits, value = logits_and_value(params, batch["obs"])
+    logits, value = logits_and_value(params, batch["obs"], model)
     logp_all = jax.nn.log_softmax(logits)
     logp = logp_all[jnp.arange(logits.shape[0]), batch["actions"]]
     rho = jnp.minimum(jnp.exp(logp - batch["logp_old"]), rho_clip)

@@ -41,6 +41,7 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     "entropy_coeff": 0.01,
     "num_sgd_epochs": 4,
     "minibatch_size": 256,
+    "model": None,                # model-catalog config (models.py)
     "seed": 0,
 }
 
@@ -48,9 +49,10 @@ DEFAULT_CONFIG: Dict[str, Any] = {
 @functools.partial(
     jax.jit,
     static_argnames=("num_epochs", "num_minibatches", "clip",
-                     "vf_coeff", "ent_coeff"))
+                     "vf_coeff", "ent_coeff", "model"))
 def _ppo_update(params, opt_state, batch, key, *, num_epochs,
-                num_minibatches, clip, vf_coeff, ent_coeff, lr):
+                num_minibatches, clip, vf_coeff, ent_coeff, lr,
+                model=None):
     """The whole PPO optimization phase as one compiled program:
     (epochs x minibatches) of Adam steps via nested lax.scan."""
     import optax
@@ -69,7 +71,7 @@ def _ppo_update(params, opt_state, batch, key, *, num_epochs,
         (loss, aux), grads = jax.value_and_grad(
             ppo_loss, has_aux=True)(params, sub, clip=clip,
                                     vf_coeff=vf_coeff,
-                                    ent_coeff=ent_coeff)
+                                    ent_coeff=ent_coeff, model=model)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return (params, opt_state), (loss, aux["entropy"])
@@ -111,7 +113,7 @@ class PPOTrainer(execution.Trainer):
             num_epochs=cfg["num_sgd_epochs"],
             num_minibatches=num_minibatches, clip=cfg["clip"],
             vf_coeff=cfg["vf_coeff"], ent_coeff=cfg["entropy_coeff"],
-            lr=cfg["lr"])
+            lr=cfg["lr"], model=self.model)
         return {"loss": float(loss), "entropy": float(entropy)}
 
     get_state = actor_critic_get_state

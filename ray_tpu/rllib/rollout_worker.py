@@ -25,14 +25,15 @@ from ray_tpu.rllib.policy import (
 )
 
 
-@functools.partial(jax.jit, static_argnames=("env", "T"))
-def _device_rollout(params, state, steps, key, *, env, T):
+@functools.partial(jax.jit, static_argnames=("env", "T", "model"))
+def _device_rollout(params, state, steps, key, *, env, T, model=None):
     """[T]-step rollout fully on device: scan(policy→env)."""
     def body(carry, _):
         state, steps, key = carry
         key, k_act, k_env = jax.random.split(key, 3)
         obs = env.obs(state)
-        actions, logp, value = sample_actions(params, obs, k_act)
+        actions, logp, value = sample_actions(params, obs, k_act,
+                                              model=model)
         state, steps, reward, done = env.step(state, steps, actions,
                                               k_env)
         return ((state, steps, key),
@@ -40,7 +41,7 @@ def _device_rollout(params, state, steps, key, *, env, T):
 
     (state, steps, key), traj = jax.lax.scan(
         body, (state, steps, key), None, length=T)
-    _, last_value = logits_and_value(params, env.obs(state))
+    _, last_value = logits_and_value(params, env.obs(state), model)
     return state, steps, key, traj, last_value
 
 
@@ -48,13 +49,15 @@ class RolloutWorker:
     """Runs as an actor; one instance steps ``num_envs`` episodes."""
 
     def __init__(self, env_name, num_envs: int, rollout_len: int,
-                 seed: int = 0, gamma: float = 0.99, lam: float = 0.95):
+                 seed: int = 0, gamma: float = 0.99, lam: float = 0.95,
+                 model=None):
         import jax
 
         self.env = make_env(env_name, num_envs)
         self.num_envs = num_envs
         self.rollout_len = rollout_len
         self.gamma, self.lam = gamma, lam
+        self.model = model  # frozen catalog spec (models.py) or None
         self._key = jax.random.key(seed)
         self._jax_env = not isinstance(self.env, VectorEnv)
         if self._jax_env:
@@ -64,7 +67,7 @@ class RolloutWorker:
             self.obs = self.env.reset(seed)
         self.params = init_policy_params(
             jax.random.key(0), self.env.observation_size,
-            self.env.num_actions)
+            self.env.num_actions, model=model)
         # episode-return bookkeeping for metrics
         self._ep_return = np.zeros(num_envs, dtype=np.float32)
         self._finished_returns: List[float] = []
@@ -82,7 +85,7 @@ class RolloutWorker:
         self._state, self._steps, self._key, traj, last_value = \
             _device_rollout(self.params, self._state, self._steps,
                             self._key, env=self.env,
-                            T=self.rollout_len)
+                            T=self.rollout_len, model=self.model)
         obs, actions, logp, value, reward, done = \
             (np.asarray(a) for a in traj)
         self._track_returns(reward, done)
@@ -110,7 +113,7 @@ class RolloutWorker:
         for t in range(T):
             self._key, sub = jax.random.split(self._key)
             actions, logp, value = sample_actions(
-                self.params, self.obs, sub)
+                self.params, self.obs, sub, model=self.model)
             actions = np.asarray(actions)
             obs_buf[t] = self.obs
             act_buf[t] = actions
@@ -122,7 +125,7 @@ class RolloutWorker:
         self._track_returns(rew_buf, done_buf)
 
         _, _, last_value = sample_actions(self.params, self.obs,
-                                          self._key)
+                                          self._key, model=self.model)
         adv, ret = compute_gae(rew_buf, val_buf, done_buf,
                                np.asarray(last_value),
                                gamma=self.gamma, lam=self.lam)
@@ -234,11 +237,12 @@ class WorkerSet:
     """A set of RolloutWorker actors (reference: worker_set.py:31)."""
 
     def __init__(self, env_name, num_workers: int, num_envs: int,
-                 rollout_len: int, gamma: float, lam: float):
+                 rollout_len: int, gamma: float, lam: float,
+                 model=None):
         cls = ray_tpu.remote(RolloutWorker)
         self.workers = [
             cls.remote(env_name, num_envs, rollout_len, seed=i + 1,
-                       gamma=gamma, lam=lam)
+                       gamma=gamma, lam=lam, model=model)
             for i in range(num_workers)]
 
     def sample(self) -> Dict[str, np.ndarray]:

@@ -352,3 +352,125 @@ def test_sac_discrete_learns_chain():
         trainer.set_state(state)
     finally:
         ray_tpu.shutdown()
+
+
+def test_model_catalog_trunks():
+    """Catalog seam (r4 verdict ask #3; reference:
+    rllib/models/catalog.py:71): MLP/CNN/GRU trunks build from config,
+    forward with the right shapes, and carry gradients."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.models import (actor_critic_forward,
+                                      freeze_model_config,
+                                      init_actor_critic, init_q_net,
+                                      init_trunk, q_net_forward)
+
+    key = jax.random.key(0)
+    cases = [({"type": "mlp", "hiddens": (32, 32)}, 10),
+             ({"type": "cnn", "conv_input_shape": (8, 8, 3)}, 192),
+             ({"type": "gru", "seq_len": 4, "gru_hidden": 16}, 20)]
+    for cfg, obs_size in cases:
+        spec = freeze_model_config(cfg)
+        params, feat = init_trunk(spec, key, obs_size)
+        obs = jnp.ones((5, obs_size))
+        ac = init_actor_critic(spec, key, obs_size, 3)
+        logits, value = actor_critic_forward(spec, ac, obs)
+        assert logits.shape == (5, 3) and value.shape == (5,)
+        g = jax.grad(
+            lambda p: actor_critic_forward(spec, p, obs)[0].sum())(ac)
+        assert any(float(jnp.abs(leaf).sum()) > 0
+                   for leaf in jax.tree.leaves(g)), cfg
+        q = q_net_forward(spec, init_q_net(spec, key, obs_size, 4), obs)
+        assert q.shape == (5, 4)
+    with pytest.raises(ValueError):
+        freeze_model_config({"type": "cnn", "bogus": 1})
+
+
+def test_ppo_with_catalog_model_learns():
+    """The catalog feeds the trainers end to end: PPO configured with a
+    catalog MLP (different widths than the built-in) still learns
+    cartpole."""
+    ray_tpu.init(num_cpus=2)
+    try:
+        from ray_tpu.rllib import PPOTrainer
+
+        trainer = PPOTrainer({
+            "num_workers": 2, "num_envs_per_worker": 8,
+            "rollout_len": 128, "minibatch_size": 256,
+            "num_sgd_epochs": 4, "lr": 2.5e-3,
+            "entropy_coeff": 0.005,
+            "model": {"type": "mlp", "hiddens": (64, 64)}})
+        assert "trunk" in trainer.params  # catalog layout, not classic
+        first, best = None, 0.0
+        for _ in range(20):
+            r = trainer.train()
+            m = r["episode_reward_mean"]
+            if m == m:
+                if first is None:
+                    first = m
+                best = max(best, m)
+        assert first is not None
+        assert best > max(60.0, first * 1.5), (first, best)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_multi_agent_two_policies_learn():
+    """Multi-agent API (r4 verdict ask #3; reference:
+    rllib/env/multi_agent_env.py:9 + policy mapping in
+    rollout_worker.py:105): two policies with DIFFERENT action spaces
+    learn their own tasks through the shared rollout/learner plumbing."""
+    ray_tpu.init(num_cpus=2)
+    try:
+        from ray_tpu.rllib import MultiAgentPPOTrainer
+
+        trainer = MultiAgentPPOTrainer({
+            "num_workers": 1, "rollout_len": 16,
+            "num_envs_per_worker": 8})
+        # distinct per-policy action spaces (alpha: 3, beta: 5)
+        assert trainer.params["alpha"]["pi"].shape[-1] == 3
+        assert trainer.params["beta"]["pi"].shape[-1] == 5
+        means = []
+        for _ in range(30):
+            r = trainer.train()
+            m = r["episode_reward_mean"]
+            if m == m:
+                means.append(m)
+            assert "policy_alpha_loss" in r and "policy_beta_loss" in r
+        # optimal joint return is 16 (2 agents x 8 steps); random ~4.3
+        assert means[-1] > 12.0, means
+        # save/restore round-trips the whole policy map
+        import tempfile
+
+        path = tempfile.mktemp()
+        trainer.save(path)
+        t2 = MultiAgentPPOTrainer({"num_workers": 1, "rollout_len": 16,
+                                   "num_envs_per_worker": 8})
+        t2.restore(path)
+        assert t2._iteration == trainer._iteration
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_sac_continuous_learns_pendulum():
+    """Continuous-action path (r4 verdict ask #3; reference:
+    rllib/agents/sac/sac.py continuous SAC): squashed-Gaussian SAC
+    improves pendulum swing-up from random (~-1200) to better than
+    -500 within the CI budget."""
+    ray_tpu.init(num_cpus=2)
+    try:
+        from ray_tpu.rllib import ContinuousSACTrainer
+
+        trainer = ContinuousSACTrainer({"num_workers": 1, "seed": 0})
+        means = []
+        for _ in range(150):
+            r = trainer.train()
+            m = r["episode_reward_mean"]
+            if m == m:
+                means.append(m)
+        assert len(means) >= 4
+        assert means[0] < -900.0, means  # starts near random
+        assert means[-1] > -500.0, means  # learned swing-up
+    finally:
+        ray_tpu.shutdown()
