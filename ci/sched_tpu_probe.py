@@ -1,0 +1,75 @@
+"""On-TPU scheduler-kernel tick probe (r4 verdict ask #1c).
+
+Runs a drain with ``RAY_TPU_SCHEDULER_KERNEL_DEVICE=default`` so the
+batched scheduling kernel executes on the default jax platform (the
+TPU when the tunnel is up) instead of the documented CPU default, and
+prints the raylet's tick/decision latency percentiles as one JSON
+line — the measured answer to whether the CPU default is justified.
+bench.py invokes this in a subprocess when the device probe succeeds;
+it can also be run standalone.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ["RAY_TPU_SCHEDULER_BACKEND"] = "tpu_batched"
+os.environ["RAY_TPU_SCHEDULER_KERNEL_DEVICE"] = "default"
+os.environ.setdefault("RAY_TPU_WORKER_JAX_PLATFORMS", "cpu")
+
+import ray_tpu  # noqa: E402
+
+
+def main() -> int:
+    n = int(os.environ.get("SCHED_PROBE_TASKS", "100000"))
+    ray_tpu.init(num_cpus=max(1, os.cpu_count() or 1))
+
+    @ray_tpu.remote
+    def t():
+        return b"ok"
+
+    ray_tpu.get([t.remote() for _ in range(200)])  # warm leases
+    t0 = time.perf_counter()
+    refs = [t.remote() for _ in range(n)]
+    for start in range(0, n, 20_000):
+        ray_tpu.get(refs[start:start + 20_000], timeout=600)
+    wall = time.perf_counter() - t0
+    refs = None
+
+    # Decision storm: warm-lease amortization leaves the drain with a
+    # handful of kernel invocations; distinct scheduling classes (one
+    # per unique resource demand) force one lease decision each, so
+    # the tick/decision percentiles get a real sample count.
+    storm = [t.options(num_cpus=0.01 + i * 1e-5).remote()
+             for i in range(100)]
+    ray_tpu.get(storm, timeout=600)
+    storm = None
+
+    node = ray_tpu.worker.global_worker.node
+    lat = node.raylet._latency_percentiles()
+
+    # which device actually ran the kernel (raylet shares this process)
+    from ray_tpu._private.scheduler import tpu_batched
+    dev = tpu_batched._kernel_device()
+    if dev is None:
+        import jax
+        platform = jax.devices()[0].platform
+    else:
+        platform = dev.platform
+
+    print(json.dumps({
+        "kernel_device_env": "default",
+        "kernel_platform": platform,
+        "drain_tasks": n,
+        "drain_wall_s": round(wall, 2),
+        "tasks_per_s": round(n / wall, 1),
+        "latency_percentiles": lat,
+    }))
+    ray_tpu.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

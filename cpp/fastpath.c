@@ -620,13 +620,14 @@ FastCtx_complete_fast(FastCtx *self, PyObject *const *argv,
         PyObject *waiter = SLOT(entry, self->pe_off[PE_recovery_waiter]);
         if (waiter != NULL && waiter != Py_None)
             goto slow_item;  /* recovery in flight: Python handles wake */
-        if (keep_lineage &&
-            SLOT(entry, self->pe_off[PE_lineage_pinned]) == Py_None) {
+        if (SLOT(entry, self->pe_off[PE_lineage_pinned]) == Py_None) {
             /* every return was released while the task ran
              * (_release_lineage): nobody can get the value — skip the
              * store put entirely (storing it would orphan the object:
              * the release-path delete already fired) and drop the
-             * record (TaskManager::RemoveLineageReference parity). */
+             * record (TaskManager::RemoveLineageReference parity).
+             * Applies with lineage on OR off — the put would land
+             * after the release either way. */
             if (PyDict_DelItem(self->pending_dict, tid) < 0)
                 goto fail;
             finished++;

@@ -33,8 +33,8 @@ from ray_tpu._private import rpc
 from ray_tpu._private.config import RayTpuConfig
 from ray_tpu._private.function_manager import FunctionManager
 from ray_tpu._private.ids import (
-    ACTOR_ID_SIZE, ActorID, JobID, ObjectID, TaskID, WorkerID,
-    make_task_id_bytes, return_object_id_bytes,
+    ACTOR_ID_SIZE, TASK_ID_SIZE, ActorID, JobID, ObjectID, TaskID,
+    WorkerID, make_task_id_bytes, return_object_id_bytes,
 )
 from ray_tpu._private.memory_store import IN_PLASMA, MemoryStore
 from ray_tpu._private.object_ref import ObjectRef
@@ -520,15 +520,16 @@ class CoreWorker:
         ``lineage_pinned`` is the lifecycle flag: False = in flight,
         True = completed + retained only for lineage, None = in flight
         but all returns already dead (completion drops the entry)."""
-        tid_b = oid.task_id().binary()
+        me = oid.binary()
+        tid_b = me[:TASK_ID_SIZE]  # release path is per-call hot
         entry = self.pending_tasks.get(tid_b)
         if entry is None:
             return
-        me = oid.binary()
-        for rid in entry.return_ids:
-            if rid.binary() != me and \
-                    self.reference_counter.has_reference(rid):
-                return  # a sibling return is still reachable
+        if len(entry.return_ids) > 1:
+            for rid in entry.return_ids:
+                if rid.binary() != me and \
+                        self.reference_counter.has_reference(rid):
+                    return  # a sibling return is still reachable
         if entry.lineage_pinned:
             self.pending_tasks.pop(tid_b, None)
         elif entry.lineage_pinned is False:
@@ -1555,11 +1556,13 @@ class CoreWorker:
                 if entry.recovery_waiter is not None:
                     slow.append(i)
                     continue
-                if keep_lineage and entry.lineage_pinned is None:
+                if entry.lineage_pinned is None:
                     # returns all released in flight: skip the store
                     # put (it would orphan — the release-path delete
-                    # already ran) and drop the record, same contract
-                    # as the C path's skip branch
+                    # already ran, and put_many lands AFTER the
+                    # _finish_pending_entry cleanup) and drop the
+                    # record, same contract as the C path's skip
+                    # branch. Applies with lineage on OR off.
                     pending.pop(spec.task_id, None)
                     finished += 1
                     continue

@@ -788,6 +788,12 @@ class GcsServer:
         # kill/restart bumps it (or marks DEAD), and this attempt must then
         # abandon rather than create a duplicate live incarnation.
         incarnation = actor.incarnation
+        # The deadline guards INFEASIBILITY only: while some node's
+        # total resources can hold the actor, it stays pending however
+        # long worker spawn takes (reference: pending actor creations
+        # wait indefinitely on a feasible cluster — a 1-core node
+        # serially spawning hundreds of actor workers must not fail
+        # the tail of the queue).
         deadline = time.time() + 60.0
         while time.time() < deadline:
             if actor.state in (ACTOR_DEAD, ACTOR_ALIVE) or \
@@ -797,6 +803,8 @@ class GcsServer:
                 # when the pre-crash worker survived and re-reported.
                 return
             node = self._pick_node_for_actor(resources)
+            if node is not None:
+                deadline = time.time() + 60.0  # feasible: keep pending
             if node is not None and node.conn is not None and not node.conn.closed:
                 try:
                     reply, _ = await node.conn.call(

@@ -64,6 +64,12 @@ class ParallelConfig:
     tp: Optional[str] = None
     attn: str = "auto"          # auto | local | ring | ulysses
     remat: bool = False
+    # Rematerialization policy when remat is on (jax.checkpoint
+    # policies): "full" recomputes the whole layer (minimum HBM,
+    # maximum recompute); "dots" / "dots_no_batch" save the MXU matmul
+    # outputs and recompute only the cheap elementwise work — the
+    # standard MFU/HBM middle ground on TPU.
+    remat_policy: str = "full"
     num_microbatches: Optional[int] = None
 
     def data_axes(self):
@@ -170,7 +176,14 @@ def _stack_fn(cfg, pcfg, cos, sin, positions):
         layer = functools.partial(_layer, cos=cos, sin=sin,
                                   positions=positions, cfg=cfg, pcfg=pcfg)
         if pcfg.remat:
-            layer = jax.checkpoint(layer)
+            policy = {
+                "full": None,
+                "dots": jax.checkpoint_policies.checkpoint_dots,
+                "dots_no_batch":
+                    jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+            }[pcfg.remat_policy]
+            layer = jax.checkpoint(layer, policy=policy) if policy \
+                else jax.checkpoint(layer)
 
         def body(h, lp):
             return layer(lp, h), None

@@ -111,7 +111,6 @@ def _cnn_init(spec, key, obs_size):
     in_ch = c
     for feats, kernel, stride in _get(spec, "conv_filters"):
         key, sub = jax.random.split(key)
-        fan_in = kernel * kernel * in_ch
         convs.append({
             "w": jax.nn.initializers.orthogonal(np.sqrt(2))(
                 sub, (kernel, kernel, in_ch, feats), jnp.float32),

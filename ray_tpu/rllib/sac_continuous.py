@@ -51,27 +51,25 @@ DEFAULT_CONFIG: Dict[str, Any] = {
 }
 
 
-def _dense(key, fan_in, fan_out, scale=np.sqrt(2)):
-    init = jax.nn.initializers.orthogonal(scale)
-    return {"w": init(key, (fan_in, fan_out), jnp.float32),
-            "b": jnp.zeros((fan_out,))}
-
-
 def init_actor_params(key, obs_size: int, action_dim: int,
                       hidden: int = 64) -> Dict:
+    from ray_tpu.rllib.models import _dense_init
+
     k1, k2, k3, k4 = jax.random.split(key, 4)
-    return {"l1": _dense(k1, obs_size, hidden),
-            "l2": _dense(k2, hidden, hidden),
-            "mu": _dense(k3, hidden, action_dim, scale=0.01),
-            "log_std": _dense(k4, hidden, action_dim, scale=0.01)}
+    return {"l1": _dense_init(k1, obs_size, hidden),
+            "l2": _dense_init(k2, hidden, hidden),
+            "mu": _dense_init(k3, hidden, action_dim, scale=0.01),
+            "log_std": _dense_init(k4, hidden, action_dim, scale=0.01)}
 
 
 def init_critic_params(key, obs_size: int, action_dim: int,
                        hidden: int = 64) -> Dict:
+    from ray_tpu.rllib.models import _dense_init
+
     k1, k2, k3 = jax.random.split(key, 3)
-    return {"l1": _dense(k1, obs_size + action_dim, hidden),
-            "l2": _dense(k2, hidden, hidden),
-            "q": _dense(k3, hidden, 1, scale=0.01)}
+    return {"l1": _dense_init(k1, obs_size + action_dim, hidden),
+            "l2": _dense_init(k2, hidden, hidden),
+            "q": _dense_init(k3, hidden, 1, scale=0.01)}
 
 
 def actor_forward(params, obs):

@@ -258,3 +258,36 @@ def test_plasma_return_released_in_flight_is_freed(ray_start_regular):
     assert not core.reference_counter._refs, "reference resurrected"
     assert node.raylet.store.stats()["num_objects"] == 0, \
         "orphaned plasma replica"
+
+
+def test_fire_and_forget_values_dropped_lineage_off(ray_start_regular):
+    """The released-in-flight skip must also apply with lineage
+    reconstruction DISABLED: the batched completion path stores values
+    after _finish_pending_entry's cleanup, so without the skip the
+    value would be orphaned (review r5, second pass)."""
+    core = ray_tpu.worker.global_worker.core
+    saved_lineage = core.config.lineage_reconstruction_enabled
+    core.config.lineage_reconstruction_enabled = False
+    saved_ctx = core._fast_ctx
+    try:
+        @ray_tpu.remote
+        def one():
+            return 1
+
+        for ctx in (saved_ctx, None):  # native path, then Python twin
+            core._fast_ctx = ctx
+            ray_tpu.get(one.remote())
+            store_base = len(core.memory_store._objects)
+            for _ in range(200):
+                one.remote()
+            deadline = time.time() + 15
+            while time.time() < deadline and \
+                    (core.pending_tasks or core.reference_counter._refs
+                     or len(core.memory_store._objects) > store_base):
+                time.sleep(0.05)
+            assert not core.pending_tasks, ("leak", ctx is None)
+            assert len(core.memory_store._objects) <= store_base, \
+                ("orphan", ctx is None)
+    finally:
+        core.config.lineage_reconstruction_enabled = saved_lineage
+        core._fast_ctx = saved_ctx
