@@ -55,15 +55,26 @@ _STATUS_PAGE = b"""<!doctype html>
 <h1>ray_tpu cluster <span id="ts"></span></h1><div id="err"></div>
 <h2>Cluster</h2><table id="cluster"></table>
 <h2>Nodes</h2><table id="nodes"></table>
+<h2>Object stores / hosts</h2><table id="stores"></table>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Jobs</h2><table id="jobs"></table>
 <h2>Placement groups</h2><table id="pgs"></table>
+<h2>Recent events</h2><table id="events"></table>
 <script>
 function row(tr, cells, tag) {
   var r = document.createElement('tr');
   cells.forEach(function(c){
     var td = document.createElement(tag||'td');
     if (c && c.cls) { td.textContent = c.v; td.className = c.cls; }
+    else if (c && c.links) {
+      // injection-safe anchors: node ids come from registration data
+      c.links.forEach(function(l, i){
+        if (i) td.appendChild(document.createTextNode(' '));
+        var a = document.createElement('a');
+        a.href = l.href; a.textContent = l.text;
+        td.appendChild(a);
+      });
+    }
     else td.textContent = (typeof c === 'object') ? JSON.stringify(c) : c;
     r.appendChild(td);
   });
@@ -80,11 +91,26 @@ async function tick() {
     fill('cluster', Object.keys(c), [Object.values(c)]);
     var nodes = await j('/api/nodes');
     fill('nodes', ['node_id','address','state','cpu_avail/total',
-                   'heartbeat_age_s'],
+                   'heartbeat_age_s','logs'],
       nodes.map(function(n){ return [n.node_id.slice(0,12), n.address,
         {v: n.alive ? 'ALIVE' : 'DEAD', cls: n.alive ? 'alive' : 'dead'},
         (n.resources_available.CPU||0)+'/'+(n.resources_total.CPU||0),
-        n.last_heartbeat_age_s]; }));
+        n.last_heartbeat_age_s,
+        {links: [
+          {href: '/api/logs?node_id=' + encodeURIComponent(n.node_id),
+           text: 'tail'},
+          {href: '/api/stacks?node_id=' + encodeURIComponent(n.node_id),
+           text: 'stacks'}]}]; }));
+    var mb = function(b){ return b==null ? '' : (b/1048576).toFixed(1); };
+    fill('stores', ['node_id','workers','pending','store_mb','objects',
+                    'spills','evictions','host_cpu%','host_mem_mb'],
+      nodes.map(function(n){ var s = n.stats || {};
+        return [n.node_id.slice(0,12), s.num_workers,
+          s.num_pending_leases, mb(s.store_used_bytes),
+          s.store_num_objects, s.store_num_spills,
+          s.store_num_evictions, s.host_cpu_percent,
+          mb(s.host_mem_used_bytes) + '/' +
+          mb(s.host_mem_total_bytes)]; }));
     var actors = await j('/api/actors');
     fill('actors', ['actor_id','name','class','state','restarts','node'],
       actors.map(function(a){ return [a.actor_id.slice(0,12), a.name,
@@ -97,6 +123,11 @@ async function tick() {
     fill('pgs', ['pg_id','name','strategy','state','bundles'],
       pgs.map(function(p){ return [p.pg_id.slice(0,12), p.name||'',
         p.strategy, p.state, p.bundles]; }));
+    var evs = await j('/api/events');
+    fill('events', ['time','severity','source','message'],
+      evs.slice(-25).reverse().map(function(e){ return [
+        new Date(e.timestamp*1000).toLocaleTimeString(),
+        e.severity, e.source_type, e.message]; }));
     document.getElementById('ts').textContent =
       '- ' + new Date().toLocaleTimeString();
     document.getElementById('err').textContent = '';
@@ -400,6 +431,10 @@ class GcsServer:
             })
         if route == "/api/metrics":
             return dump(self._merged_metrics())
+        if route == "/api/events":
+            # last 200 structured cluster events (reference: the
+            # dashboard's event module over event_*.log aggregation)
+            return dump(self._cluster_events[-200:])
         return (json.dumps({"error": f"unknown route {route!r}"}).encode(),
                 b"404 Not Found")
 

@@ -39,7 +39,8 @@ JOB_ENV_KEY_PREFIX = b"rtpu:job_env:"
 _module_cache: Dict[str, Dict[str, Any]] = {}
 URI_SCHEME = "pkg:"
 WHEEL_URI_SCHEME = "kvwhl:"
-SUPPORTED_KEYS = {"env_vars", "working_dir", "working_dir_uri", "pip"}
+SUPPORTED_KEYS = {"env_vars", "working_dir", "working_dir_uri", "pip",
+                  "conda"}
 MAX_PACKAGE_BYTES = 512 * 1024 * 1024
 _DEFAULT_EXCLUDES = {"__pycache__", ".git", ".venv", "node_modules"}
 
@@ -58,6 +59,16 @@ def validate_runtime_env(runtime_env: Dict[str, Any]) -> None:
         raise ValueError(
             "runtime_env['pip'] must be a list of requirement strings / "
             "local wheel paths, or a path to a requirements.txt")
+    conda = runtime_env.get("conda")
+    if conda is not None and not isinstance(conda, (dict, str)):
+        raise ValueError(
+            "runtime_env['conda'] must be an environment spec dict "
+            "(environment.yml structure), a path to an "
+            "environment.yml, or the name of an existing conda env")
+    if conda is not None and pip is not None:
+        raise ValueError(
+            "runtime_env: specify either 'conda' or 'pip', not both "
+            "(put pip deps inside the conda spec)")
 
 
 def hash_runtime_env(runtime_env: Optional[Dict[str, Any]]) -> str:
@@ -143,7 +154,8 @@ def prepare_runtime_env(runtime_env: Optional[Dict[str, Any]],
     validate_runtime_env(runtime_env)
     wd = runtime_env.get("working_dir")
     pip = runtime_env.get("pip")
-    if not wd and not pip:
+    conda = runtime_env.get("conda")
+    if not wd and not pip and not isinstance(conda, str):
         return runtime_env
     out = {k: v for k, v in runtime_env.items() if k != "working_dir"}
     if wd:
@@ -163,6 +175,14 @@ def prepare_runtime_env(runtime_env: Optional[Dict[str, Any]],
     if pip:
         out["pip"] = prepare_pip_entries(pip, kv_get, kv_put,
                                          uploaded_cache)
+    conda = runtime_env.get("conda")
+    if isinstance(conda, str) and (
+            conda.endswith((".yml", ".yaml")) or os.path.sep in conda):
+        # environment.yml path: ship its CONTENT so the env identity
+        # is the spec, not a driver-local path (reference:
+        # runtime_env/conda.py reads the file driver-side)
+        with open(os.path.expanduser(conda)) as f:
+            out["conda"] = {"__yaml__": f.read()}
     return out
 
 
@@ -322,6 +342,113 @@ def ensure_pip_env(entries, base_dir: str,
     return target
 
 
+_named_env_cache: Dict[tuple, str] = {}  # (exe, env name) -> site-packages
+
+
+def _conda_exe() -> Optional[str]:
+    """The conda executable, or None (RAY_TPU_CONDA_EXE overrides the
+    PATH lookup — tests point it at a stub; air-gapped nodes at a
+    micromamba)."""
+    import shutil
+
+    exe = os.environ.get("RAY_TPU_CONDA_EXE")
+    if exe:
+        return exe if os.path.exists(exe) else None
+    return shutil.which("conda")
+
+
+def ensure_conda_env(spec, base_dir: str) -> str:
+    """Worker-side: materialize a conda environment for the spec and
+    return its site-packages path (reference:
+    python/ray/_private/runtime_env/conda.py:154 — envs are created
+    once per node, keyed by the spec hash, shared by every worker).
+
+    ``spec``: a dict (environment.yml structure — JSON is a YAML
+    subset, so it ships verbatim), {"__yaml__": text} for a shipped
+    environment.yml, or a string naming an EXISTING conda env.
+    Activation is a sys.path prepend of the env's site-packages (the
+    same model as the pip tier — the host interpreter stays in charge;
+    ABI-incompatible python versions in the spec are the user's
+    responsibility, as with the reference's conda env python pinning).
+    """
+    import subprocess
+
+    exe = _conda_exe()
+    if exe is None:
+        raise RuntimeError(
+            "runtime_env['conda'] requested but no conda executable "
+            "found (install conda/micromamba or set RAY_TPU_CONDA_EXE)")
+    if isinstance(spec, str):
+        # existing named env: resolve its prefix via the env registry,
+        # cached for the worker's lifetime (conda CLI startup costs
+        # seconds; the name->prefix mapping is stable per node)
+        cache_key = (exe, spec)
+        cached = _named_env_cache.get(cache_key)
+        if cached is not None:
+            return cached
+        # stderr stays separate: conda warnings (version notices etc.)
+        # must not corrupt the JSON document on stdout
+        r = subprocess.run([exe, "env", "list", "--json"], text=True,
+                           timeout=120, stdout=subprocess.PIPE,
+                           stderr=subprocess.PIPE)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"conda env list failed: {(r.stderr or r.stdout)[-500:]}")
+        for prefix in json.loads(r.stdout).get("envs", []):
+            if os.path.basename(prefix) == spec:
+                sp = _conda_site_packages(prefix)
+                _named_env_cache[cache_key] = sp
+                return sp
+        raise RuntimeError(f"conda env {spec!r} not found on this node")
+
+    yaml_text = spec["__yaml__"] if "__yaml__" in spec \
+        else json.dumps(spec)  # JSON is valid YAML
+    env_key = hashlib.sha1(yaml_text.encode()).hexdigest()[:16]
+    prefix = os.path.join(base_dir, "runtime_resources", "conda", env_key)
+    if os.path.isdir(prefix):
+        return _conda_site_packages(prefix)
+    os.makedirs(os.path.dirname(prefix), exist_ok=True)
+    tmp = tempfile.mkdtemp(dir=os.path.dirname(prefix),
+                           prefix=f".{env_key}-")
+    try:
+        spec_path = os.path.join(tmp, "environment.yml")
+        with open(spec_path, "w") as f:
+            f.write(yaml_text)
+        env_prefix = os.path.join(tmp, "env")
+        r = subprocess.run(
+            [exe, "env", "create", "-p", env_prefix, "-f", spec_path,
+             "--quiet"],
+            text=True, timeout=1800, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"conda env create failed (exit {r.returncode}):\n"
+                f"{r.stdout[-2000:]}")
+        try:
+            os.rename(env_prefix, prefix)  # atomic publish
+        except OSError:
+            if not os.path.isdir(prefix):  # lost a benign race
+                raise
+    finally:
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+    return _conda_site_packages(prefix)
+
+
+def _conda_site_packages(prefix: str) -> str:
+    """The env's site-packages dir (any python version inside)."""
+    lib = os.path.join(prefix, "lib")
+    if os.path.isdir(lib):
+        for entry in sorted(os.listdir(lib)):
+            sp = os.path.join(lib, entry, "site-packages")
+            if entry.startswith("python") and os.path.isdir(sp):
+                return sp
+    sp = os.path.join(prefix, "site-packages")  # stub/minimal layout
+    if os.path.isdir(sp):
+        return sp
+    raise RuntimeError(f"no site-packages found under conda env {prefix}")
+
+
 @contextlib.contextmanager
 def activate(runtime_env: Optional[Dict[str, Any]], base_dir: str,
              kv_get: Callable[[bytes], Optional[bytes]]):
@@ -341,7 +468,15 @@ def activate(runtime_env: Optional[Dict[str, Any]], base_dir: str,
     saved_cwd = None
     pkg_dir = None
     pip_dir = None
-    if pip_entries:
+    conda_spec = runtime_env.get("conda")
+    if conda_spec:
+        # conda tier shares the pip tier's activation model: the env's
+        # site-packages rides sys.path for the task's duration
+        pip_dir = ensure_conda_env(conda_spec, base_dir)
+        sys.path.insert(0, pip_dir)
+        for mod_name, mod in _module_cache.pop(pip_dir, {}).items():
+            sys.modules.setdefault(mod_name, mod)
+    elif pip_entries:
         pip_dir = ensure_pip_env(pip_entries, base_dir, kv_get)
         sys.path.insert(0, pip_dir)
         for mod_name, mod in _module_cache.pop(pip_dir, {}).items():
@@ -398,8 +533,11 @@ def activate_persistent(runtime_env: Optional[Dict[str, Any]],
     os.environ.update(
         {str(k): str(v)
          for k, v in (runtime_env.get("env_vars") or {}).items()})
+    conda_spec = runtime_env.get("conda")
     pip_entries = runtime_env.get("pip")
-    if pip_entries:
+    if conda_spec:
+        sys.path.insert(0, ensure_conda_env(conda_spec, base_dir))
+    elif pip_entries:
         sys.path.insert(0, ensure_pip_env(pip_entries, base_dir, kv_get))
     uri = runtime_env.get("working_dir_uri")
     if uri:

@@ -296,3 +296,38 @@ def test_rpc_handler_latency_stats(obs_cluster):
     lease = handlers["RequestWorkerLease"]
     assert lease["count"] >= 1
     assert lease["max_ms"] >= lease["mean_ms"] >= 0.0
+
+
+def test_status_page_stores_and_events(obs_cluster):
+    """r5 dashboard depth: the page renders per-node object-store /
+    host tables and the recent-events feed from /api/events; nodes
+    carry logs/stacks links (reference: dashboard modules for
+    node stats + events, dashboard/modules/)."""
+    import json
+
+    addr = state.metrics_address()
+
+    def fetch(route):
+        with urllib.request.urlopen(f"http://{addr}{route}",
+                                    timeout=20) as resp:
+            assert resp.status == 200
+            return resp.read()
+
+    page = fetch("/").decode()
+    for marker in ("Object stores", "Recent events", "/api/events",
+                   "/api/logs?node_id="):
+        assert marker in page, marker
+
+    nodes = json.loads(fetch("/api/nodes"))
+    assert nodes and "store_used_bytes" in nodes[0]["stats"]
+
+    # report an event, then see it on the API the page polls
+    from ray_tpu._private import events as events_mod
+
+    w = ray_tpu.worker.global_worker
+    ev = events_mod.EventEmitter("test-source").emit(
+        "WARNING", "probe", "dashboard event probe")
+    w.core._run(w.core._gcs_call("AddClusterEvent", {"event": ev}))
+    evs = json.loads(fetch("/api/events"))
+    assert any(e.get("message") == "dashboard event probe"
+               for e in evs)

@@ -249,3 +249,91 @@ def test_dataset_to_torch(mp_cluster):
     batches = list(ds.to_torch(batch_size=10))
     assert [len(b) for b in batches] == [10, 10, 10, 2]
     assert all(isinstance(b, torch.Tensor) for b in batches)
+
+
+def test_dask_on_ray_scheduler(ray_start_regular):
+    """Dask graph-protocol scheduler (reference:
+    util/dask/scheduler.py:54 ray_dask_get): raw task-DAG dicts run as
+    cluster tasks with the runtime's own dependency resolution —
+    aliases, tuple keys, inline nested tasks, list computations. The
+    protocol is plain data, so this needs no dask install."""
+    from ray_tpu.util.dask import ray_dask_get
+
+    def inc(x):
+        return x + 1
+
+    def add(a, b):
+        return a + b
+
+    dsk = {
+        "a": 1,
+        "b": (inc, "a"),                  # 2
+        "alias": "b",
+        ("x", 0): (add, "b", 10),         # 12 (tuple key)
+        "nested": (add, (inc, "b"), 5),   # inline nested task: 8
+        "lst": [(inc, "a"), ("x", 0)],    # list computation [2, 12]
+        "tot": (sum, "lst"),              # 14
+    }
+    assert ray_dask_get(dsk, "tot") == 14
+    assert ray_dask_get(dsk, ["b", "alias", "nested"]) == [2, 2, 8]
+    assert ray_dask_get(dsk, [["b", ("x", 0)]]) == [[2, 12]]
+
+    with pytest.raises(ValueError, match="cycle"):
+        ray_dask_get({"a": "b", "b": "a"}, "a")
+
+    try:
+        import dask  # noqa: F401
+    except ImportError:
+        print("\nNOTE: dask not installed — ray_dask_get exercised on "
+              "raw graphs only (dask.compute integration UNTESTED)")
+        return
+    import dask
+
+    lazy = dask.delayed(add)(dask.delayed(inc)(1), 3)
+    assert lazy.compute(scheduler=ray_dask_get) == 5
+
+
+def test_distributed_boosting_orchestration(ray_start_regular):
+    """Data-parallel boosting seam (reference role: xgboost_ray /
+    lightgbm_ray surfaced via ray.util): sharding, one actor per
+    shard, ensemble prediction. The trainer is injected (a closed-form
+    least-squares stump) so the orchestration is fully exercised
+    without xgboost; when xgboost is installed the same path trains
+    real boosters."""
+    import numpy as np
+
+    from ray_tpu.util.xgboost import RayDMatrix, train
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(400, 3))
+    w_true = np.array([2.0, -1.0, 0.5])
+    y = X @ w_true
+
+    def lsq_trainer(params, Xs, ys, num_rounds):
+        w, *_ = np.linalg.lstsq(Xs, ys, rcond=None)
+        return w  # "model" = the weight vector
+
+    res = train({"eta": 0.1}, RayDMatrix(X, y), num_rounds=3,
+                num_actors=3, trainer=lsq_trainer,
+                predict_fn=lambda w, Xs: Xs @ w)
+    assert len(res.models) == 3
+    pred = res.predict(X[:50])
+    assert np.allclose(pred, y[:50], atol=1e-6)
+
+    # Dataset-of-dict-rows ingestion path
+    from ray_tpu import data
+
+    rows = [{"a": float(x[0]), "b": float(x[1]), "c": float(x[2]),
+             "label": float(t)} for x, t in zip(X[:100], y[:100])]
+    dm = RayDMatrix(data.from_items(rows, parallelism=2))
+    assert dm.X.shape == (100, 3) and dm.y.shape == (100,)
+
+    try:
+        import xgboost  # noqa: F401
+    except ImportError:
+        print("\nNOTE: xgboost not installed — real-booster training "
+              "UNTESTED (orchestration covered via injected trainer)")
+        return
+    res2 = train({"max_depth": 2, "objective": "reg:squarederror"},
+                 RayDMatrix(X, y), num_rounds=5, num_actors=2)
+    assert ((res2.predict(X) - y) ** 2).mean() < ((y - y.mean()) ** 2).mean()
