@@ -97,9 +97,9 @@ async function tick() {
         (n.resources_available.CPU||0)+'/'+(n.resources_total.CPU||0),
         n.last_heartbeat_age_s,
         {links: [
-          {href: '/api/logs?node_id=' + encodeURIComponent(n.node_id),
+          {href: '/api/logs?node=' + encodeURIComponent(n.node_id),
            text: 'tail'},
-          {href: '/api/stacks?node_id=' + encodeURIComponent(n.node_id),
+          {href: '/api/stacks?node=' + encodeURIComponent(n.node_id),
            text: 'stacks'}]}]; }));
     var mb = function(b){ return b==null ? '' : (b/1048576).toFixed(1); };
     fill('stores', ['node_id','workers','pending','store_mb','objects',

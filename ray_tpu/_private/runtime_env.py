@@ -176,11 +176,12 @@ def prepare_runtime_env(runtime_env: Optional[Dict[str, Any]],
         out["pip"] = prepare_pip_entries(pip, kv_get, kv_put,
                                          uploaded_cache)
     conda = runtime_env.get("conda")
-    if isinstance(conda, str) and (
-            conda.endswith((".yml", ".yaml")) or os.path.sep in conda):
+    if isinstance(conda, str) and conda.endswith((".yml", ".yaml")):
         # environment.yml path: ship its CONTENT so the env identity
         # is the spec, not a driver-local path (reference:
-        # runtime_env/conda.py reads the file driver-side)
+        # runtime_env/conda.py reads the file driver-side). Other
+        # strings pass through: env names and prefix DIRECTORIES are
+        # resolved node-side.
         with open(os.path.expanduser(conda)) as f:
             out["conda"] = {"__yaml__": f.read()}
     return out
@@ -379,22 +380,43 @@ def ensure_conda_env(spec, base_dir: str) -> str:
             "runtime_env['conda'] requested but no conda executable "
             "found (install conda/micromamba or set RAY_TPU_CONDA_EXE)")
     if isinstance(spec, str):
-        # existing named env: resolve its prefix via the env registry,
-        # cached for the worker's lifetime (conda CLI startup costs
-        # seconds; the name->prefix mapping is stable per node)
+        # existing env by name or prefix path, cached for the worker's
+        # lifetime (conda CLI startup costs seconds; the name->prefix
+        # mapping is stable per node)
         cache_key = (exe, spec)
         cached = _named_env_cache.get(cache_key)
         if cached is not None:
             return cached
-        # stderr stays separate: conda warnings (version notices etc.)
-        # must not corrupt the JSON document on stdout
-        r = subprocess.run([exe, "env", "list", "--json"], text=True,
-                           timeout=120, stdout=subprocess.PIPE,
-                           stderr=subprocess.PIPE)
-        if r.returncode != 0:
-            raise RuntimeError(
-                f"conda env list failed: {(r.stderr or r.stdout)[-500:]}")
-        for prefix in json.loads(r.stdout).get("envs", []):
+        if os.path.sep in spec:  # a prefix path, no registry lookup
+            sp = _conda_site_packages(os.path.expanduser(spec))
+            _named_env_cache[cache_key] = sp
+            return sp
+
+        def run_json(args):
+            # stderr stays separate: conda warnings (version notices
+            # etc.) must not corrupt the JSON document on stdout
+            try:
+                r = subprocess.run([exe, *args], text=True, timeout=120,
+                                   stdout=subprocess.PIPE,
+                                   stderr=subprocess.PIPE)
+            except subprocess.TimeoutExpired:
+                raise RuntimeError(
+                    f"conda {' '.join(args)} timed out (120s)") from None
+            if r.returncode != 0:
+                raise RuntimeError(f"conda {' '.join(args)} failed: "
+                                   f"{(r.stderr or r.stdout)[-500:]}")
+            return json.loads(r.stdout)
+
+        if spec == "base":
+            # the root env's prefix basename is the install dir name
+            # ('miniconda3'), never 'base' — ask conda info for it
+            prefix = run_json(["info", "--json"]).get("root_prefix")
+            if not prefix:
+                raise RuntimeError("conda info reported no root_prefix")
+            sp = _conda_site_packages(prefix)
+            _named_env_cache[cache_key] = sp
+            return sp
+        for prefix in run_json(["env", "list", "--json"]).get("envs", []):
             if os.path.basename(prefix) == spec:
                 sp = _conda_site_packages(prefix)
                 _named_env_cache[cache_key] = sp
@@ -415,11 +437,16 @@ def ensure_conda_env(spec, base_dir: str) -> str:
         with open(spec_path, "w") as f:
             f.write(yaml_text)
         env_prefix = os.path.join(tmp, "env")
-        r = subprocess.run(
-            [exe, "env", "create", "-p", env_prefix, "-f", spec_path,
-             "--quiet"],
-            text=True, timeout=1800, stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT)
+        try:
+            r = subprocess.run(
+                [exe, "env", "create", "-p", env_prefix, "-f", spec_path,
+                 "--quiet"],
+                text=True, timeout=1800, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT)
+        except subprocess.TimeoutExpired:
+            raise RuntimeError(
+                "conda env create for runtime_env timed out "
+                "(1800s)") from None
         if r.returncode != 0:
             raise RuntimeError(
                 f"conda env create failed (exit {r.returncode}):\n"

@@ -14,8 +14,9 @@ reference: rllib/agents/dqn + rllib/execution/replay_buffer.py +
 rllib/offline/), SAC-discrete (twin critics + entropy regularization,
 reference: rllib/agents/sac), SAC-continuous (squashed-Gaussian actor
 + twin Q(s, a) — the non-discrete action path, reference:
-rllib/agents/sac continuous), and IMPALA-lite (async on-policy with
-importance weighting). Cross-cutting seams: the model catalog
+rllib/agents/sac continuous), TD3 (deterministic actor, smoothed
+targets, delayed policy updates — reference: rllib/agents/ddpg/td3.py),
+and IMPALA-lite (async on-policy with importance weighting). Cross-cutting seams: the model catalog
 (models.py — MLP/CNN/GRU trunks by config, reference:
 rllib/models/catalog.py:71) feeding every trainer, and the
 multi-agent stack (multi_agent.py — MultiAgentVectorEnv + per-agent
@@ -44,6 +45,7 @@ from ray_tpu.rllib.multi_agent import (  # noqa: F401
 )
 from ray_tpu.rllib.sac import SACTrainer  # noqa: F401
 from ray_tpu.rllib.sac_continuous import ContinuousSACTrainer  # noqa: F401
+from ray_tpu.rllib.td3 import TD3Trainer  # noqa: F401
 from ray_tpu.rllib.execution import Trainer, build_trainer  # noqa: F401
 from ray_tpu.rllib.impala import ImpalaTrainer  # noqa: F401
 from ray_tpu.rllib.offline import JsonReader, JsonWriter  # noqa: F401

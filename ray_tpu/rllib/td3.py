@@ -1,0 +1,275 @@
+"""TD3: twin-delayed deterministic policy gradient (continuous).
+
+Parity target: the reference's DDPG/TD3 family
+(reference: rllib/agents/ddpg/ddpg.py + td3.py — deterministic actor
+with exploration noise, twin critics, target policy smoothing, delayed
+actor updates; standard public formulation of Fujimoto et al. 2018).
+Shares everything with SAC-continuous (sac_continuous.py): the critic
+networks, ReplayBuffer actor, execution-plan ops, Pendulum env, and
+the one-compiled-program learner shape — the delta is the
+deterministic policy, the smoothed targets, and the update delay,
+which is exactly the reference's layering (TD3 as a config patch over
+DDPG's trainer).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib import execution
+from ray_tpu.rllib.env import VectorEnv, make_env
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.sac_continuous import init_critic_params, critic_forward
+
+DEFAULT_CONFIG: Dict[str, Any] = {
+    "env": "Pendulum-v0",
+    "num_workers": 1,
+    "num_envs_per_worker": 16,
+    "rollout_len": 8,
+    "gamma": 0.99,
+    "lr": 1e-3,
+    "explore_noise": 0.1,         # behavior-policy Gaussian std (scaled)
+    "target_noise": 0.2,          # target policy smoothing std
+    "target_noise_clip": 0.5,
+    "policy_delay": 2,            # critic updates per actor update
+    "tau": 0.005,
+    "buffer_size": 100_000,
+    "learning_starts": 512,
+    "train_batch_size": 256,
+    "num_sgd_steps": 32,
+    "hidden": 64,
+    "seed": 0,
+}
+
+
+def init_det_actor_params(key, obs_size: int, action_dim: int,
+                          hidden: int = 64) -> Dict:
+    from ray_tpu.rllib.models import _dense_init
+
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {"l1": _dense_init(k1, obs_size, hidden),
+            "l2": _dense_init(k2, hidden, hidden),
+            "mu": _dense_init(k3, hidden, action_dim, scale=0.01)}
+
+
+def det_actor_forward(params, obs, scale: float):
+    h = jnp.tanh(obs @ params["l1"]["w"] + params["l1"]["b"])
+    h = jnp.tanh(h @ params["l2"]["w"] + params["l2"]["b"])
+    return scale * jnp.tanh(h @ params["mu"]["w"] + params["mu"]["b"])
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "gamma", "tau", "lr", "scale", "target_noise", "noise_clip",
+    "policy_delay"))
+def _td3_update(params, target_params, opt_state, batches, key, *,
+                gamma, tau, lr, scale, target_noise, noise_clip,
+                policy_delay):
+    """K TD3 steps as one compiled program. ``params`` = {"pi", "q1",
+    "q2"}; targets hold all three (TD3 targets the actor too)."""
+    import optax
+
+    optimizer = optax.adam(lr)
+
+    def critic_loss(p, tp, mb, k):
+        noise = jnp.clip(
+            target_noise * jax.random.normal(
+                k, mb["actions"].reshape(mb["rewards"].shape[0], -1).shape),
+            -noise_clip, noise_clip) * scale
+        a_next = jnp.clip(
+            det_actor_forward(tp["pi"], mb["next_obs"], scale) + noise,
+            -scale, scale)
+        q_t = jnp.minimum(
+            critic_forward(tp["q1"], mb["next_obs"], a_next),
+            critic_forward(tp["q2"], mb["next_obs"], a_next))
+        target = mb["rewards"] + gamma * (1.0 - mb["dones"]) * \
+            jax.lax.stop_gradient(q_t)
+        acts = mb["actions"].reshape(mb["rewards"].shape[0], -1)
+        return ((critic_forward(p["q1"], mb["obs"], acts) - target) ** 2
+                ).mean() + \
+               ((critic_forward(p["q2"], mb["obs"], acts) - target) ** 2
+                ).mean()
+
+    def actor_loss(p, mb):
+        a = det_actor_forward(p["pi"], mb["obs"], scale)
+        return -critic_forward(jax.lax.stop_gradient(p["q1"]),
+                               mb["obs"], a).mean()
+
+    def step(carry, inp):
+        p, tp, opt_state, i = carry
+        mb, k = inp
+
+        def total_loss(p):
+            c = critic_loss(p, tp, mb, k)
+            # delayed policy updates: the actor term joins every
+            # policy_delay-th step (lax.cond keeps one program)
+            a = jax.lax.cond(i % policy_delay == 0,
+                             lambda: actor_loss(p, mb),
+                             lambda: 0.0)
+            return c + a, c
+
+        (loss, c), grads = jax.value_and_grad(
+            total_loss, has_aux=True)(p)
+        updates, opt_state = optimizer.update(grads, opt_state, p)
+        p = optax.apply_updates(p, updates)
+        tp = jax.tree.map(lambda t, o: (1 - tau) * t + tau * o, tp, p)
+        return (p, tp, opt_state, i + 1), c
+
+    n_steps = jax.tree.leaves(batches)[0].shape[0]
+    keys = jax.random.split(key, n_steps)
+    (params, target_params, opt_state, _), critic_losses = jax.lax.scan(
+        step, (params, target_params, opt_state, 0), (batches, keys))
+    return params, target_params, opt_state, jnp.mean(critic_losses)
+
+
+class DetTransitionWorker:
+    """Deterministic-policy sampler with exploration noise (reference:
+    DDPG/TD3 exploration — OrnsteinUhlenbeck/Gaussian noise on the
+    deterministic action; plain Gaussian here, TD3's default)."""
+
+    def __init__(self, env_name, num_envs: int, rollout_len: int,
+                 noise: float, seed: int = 0):
+        self.env = make_env(env_name, num_envs)
+        if not isinstance(self.env, VectorEnv) or \
+                not getattr(self.env, "continuous", False):
+            raise ValueError("needs a continuous-action VectorEnv")
+        self.num_envs = num_envs
+        self.rollout_len = rollout_len
+        self._scale = float(self.env.action_high)
+        self._noise = noise * self._scale
+        self._fwd = jax.jit(functools.partial(det_actor_forward,
+                                              scale=self._scale))
+        self._rng = np.random.default_rng(seed)
+        self.obs = self.env.reset(seed)
+        self.params = None
+        self._ep_return = np.zeros(num_envs, dtype=np.float32)
+        self._finished_returns: List[float] = []
+
+    def set_weights(self, params) -> None:
+        self.params = params
+
+    def sample(self) -> Dict[str, np.ndarray]:
+        T, B = self.rollout_len, self.num_envs
+        obs_dim = self.env.observation_size
+        adim = self.env.action_dim
+        out = {
+            "obs": np.zeros((T * B, obs_dim), np.float32),
+            "actions": np.zeros((T * B, adim), np.float32),
+            "rewards": np.zeros((T * B,), np.float32),
+            "next_obs": np.zeros((T * B, obs_dim), np.float32),
+            "dones": np.zeros((T * B,), np.float32),
+        }
+        for t in range(T):
+            a = np.asarray(self._fwd(self.params, self.obs))
+            a = np.clip(a + self._rng.normal(0.0, self._noise, a.shape),
+                        -self._scale, self._scale).astype(np.float32)
+            nxt, reward, done = self.env.step(a)
+            sl = slice(t * B, (t + 1) * B)
+            out["obs"][sl] = self.obs
+            out["actions"][sl] = a.reshape(B, adim)
+            out["rewards"][sl] = reward
+            out["next_obs"][sl] = nxt
+            out["dones"][sl] = done
+            self._ep_return += reward
+            if done.any():
+                self._finished_returns.extend(
+                    self._ep_return[done].tolist())
+                self._ep_return[done] = 0.0
+            self.obs = nxt
+        return out
+
+    def episode_returns(self, clear: bool = True) -> List[float]:
+        out = list(self._finished_returns)
+        if clear:
+            self._finished_returns.clear()
+        return out
+
+
+def _setup(self, cfg: Dict[str, Any]) -> None:
+    import optax
+
+    probe = make_env(cfg["env"], 1)
+    keys = jax.random.split(jax.random.key(cfg["seed"]), 3)
+    self.params = {
+        "pi": init_det_actor_params(keys[0], probe.observation_size,
+                                    probe.action_dim, cfg["hidden"]),
+        "q1": init_critic_params(keys[1], probe.observation_size,
+                                 probe.action_dim, cfg["hidden"]),
+        "q2": init_critic_params(keys[2], probe.observation_size,
+                                 probe.action_dim, cfg["hidden"]),
+    }
+    self.target_params = jax.tree.map(lambda x: x, self.params)
+    self._opt_state = optax.adam(cfg["lr"]).init(self.params)
+    self._scale = float(probe.action_high)
+    self._key = jax.random.key(cfg["seed"] + 11)
+    self.buffer = ray_tpu.remote(ReplayBuffer).options(
+        num_cpus=0).remote(cfg["buffer_size"], seed=cfg["seed"])
+    cls = ray_tpu.remote(DetTransitionWorker)
+    self.workers = [
+        cls.remote(cfg["env"], cfg["num_envs_per_worker"],
+                   cfg["rollout_len"], cfg["explore_noise"], seed=i + 1)
+        for i in range(cfg["num_workers"])]
+    self._counters = {"timesteps_total": 0, "buffer_size": 0}
+
+
+def _ingest(self, batch):
+    self._counters["timesteps_total"] += len(batch["obs"])
+    self._counters["buffer_size"] = int(
+        ray_tpu.get(self.buffer.add.remote(batch)))
+    return batch
+
+
+def _learn(self, stacked) -> Dict[str, Any]:
+    if stacked is None:
+        return {"loss": float("nan")}
+    cfg = self.config
+    self._key, sub = jax.random.split(self._key)
+    (self.params, self.target_params, self._opt_state,
+     loss) = _td3_update(
+        self.params, self.target_params, self._opt_state, stacked, sub,
+        gamma=cfg["gamma"], tau=cfg["tau"], lr=cfg["lr"],
+        scale=self._scale, target_noise=cfg["target_noise"],
+        noise_clip=cfg["target_noise_clip"],
+        policy_delay=cfg["policy_delay"])
+    return {"loss": float(loss)}
+
+
+def _execution_plan(self):
+    cfg = self.config
+    replay = execution.Replay(
+        self.buffer, train_batch_size=cfg["train_batch_size"],
+        num_steps=cfg["num_sgd_steps"],
+        learning_starts=cfg["learning_starts"],
+        size_fn=lambda: self._counters["buffer_size"])
+    learn = execution.TrainOneStep(replay, lambda b: _learn(self, b))
+    rollouts = execution.ParallelRollouts(
+        self.workers, mode="bulk_sync",
+        weights=lambda: self.params["pi"])
+    store = execution.ForEach(rollouts, lambda b: _ingest(self, b))
+    plan = execution.Concurrently([store, learn], output=1)
+    return execution.StandardMetricsReporting(
+        plan, self.workers, self._counters)
+
+
+def _get_state(self) -> dict:
+    return {"params": self.params, "target_params": self.target_params,
+            "opt_state": self._opt_state,
+            "timesteps": self._counters["timesteps_total"]}
+
+
+def _set_state(self, state: dict) -> None:
+    self.params = state["params"]
+    self.target_params = state["target_params"]
+    self._opt_state = state["opt_state"]
+    self._counters["timesteps_total"] = state["timesteps"]
+
+
+TD3Trainer = execution.build_trainer(
+    name="TD3Trainer", default_config=DEFAULT_CONFIG, setup=_setup,
+    execution_plan=_execution_plan, get_state=_get_state,
+    set_state=_set_state)

@@ -315,7 +315,7 @@ def test_status_page_stores_and_events(obs_cluster):
 
     page = fetch("/").decode()
     for marker in ("Object stores", "Recent events", "/api/events",
-                   "/api/logs?node_id="):
+                   "/api/logs?node="):
         assert marker in page, marker
 
     nodes = json.loads(fetch("/api/nodes"))

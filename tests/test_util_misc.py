@@ -118,7 +118,9 @@ def test_runtime_env_env_vars(mp_cluster):
     a = EnvActor.remote()
     assert ray_tpu.get(a.flag.remote()) == "yes"  # persists per actor
 
-    @ray_tpu.remote(runtime_env={"conda": "env"})
+    # conda became a supported tier in r5; "container" remains outside
+    # the supported key set
+    @ray_tpu.remote(runtime_env={"container": {"image": "x"}})
     def bad():
         return 1
 
