@@ -83,8 +83,14 @@ def main():
 
     # Size the worker pool to the machine like the reference harness does
     # (ray_perf.py runs on all cores); on a small box extra worker
-    # processes only add context-switch thrash.
-    ray_tpu.init(num_cpus=max(1, os.cpu_count() or 1))
+    # processes only add context-switch thrash. The store holds the
+    # put-GB working set (16x64MB) with headroom: on the 512MB default
+    # the row measured eviction+disk-SPILL bandwidth, not puts (r5
+    # profile: write_segment runs at ~2.7GB/s; spill dominated).
+    ray_tpu.init(
+        num_cpus=max(1, os.cpu_count() or 1),
+        object_store_memory=int(os.environ.get(
+            "BENCH_STORE_MB", "2048")) * 1024 * 1024)
 
     @ray_tpu.remote
     def small_task():

