@@ -828,15 +828,34 @@ class TaskExecutor:
         reply = None
         try:
             method = self._lookup_method(spec.name)
-            args, kwargs = await asyncio.get_running_loop().run_in_executor(
-                None, lambda: self._resolve_args(spec))
+            if not spec.args:
+                # argless calls (the dominant actor-RPC shape) resolve
+                # trivially — the executor hop exists for plasma gets
+                # inside _resolve_args, which can't happen here
+                args, kwargs = self._resolve_args(spec)
+            else:
+                args, kwargs = await asyncio.get_running_loop() \
+                    .run_in_executor(None,
+                                     lambda: self._resolve_args(spec))
             result = method(*args, **kwargs)
             if inspect.isawaitable(result):
                 result = await result
-            # _build_reply may seal large returns via the sync raylet RPC
-            # path (core._run), which must not run on the IO loop thread.
-            reply = await asyncio.get_running_loop().run_in_executor(
-                None, self._build_reply, spec, result)
+            # _build_reply may seal large returns via the sync raylet
+            # RPC path (core._run), which must not block the actor
+            # user loop — but small scalars serialize far below the
+            # seal threshold and build with no RPC at all, so the
+            # common reply skips the thread hop. Guards are
+            # conservative in serialized bytes: ints are unbounded
+            # bignums (bit_length-capped) and utf-8 is up to 4B/char.
+            if result is None or isinstance(result, (bool, float)) or \
+                    (isinstance(result, int)
+                     and result.bit_length() < 512) or \
+                    (isinstance(result, (str, bytes))
+                     and len(result) * 4 < INLINE_RETURN_MAX):
+                reply = self._build_reply(spec, result)
+            else:
+                reply = await asyncio.get_running_loop().run_in_executor(
+                    None, self._build_reply, spec, result)
         except _ActorExitSignal:
             self._request_exit("actor exited via exit_actor()")
             reply = self._build_reply(spec, None)
