@@ -32,6 +32,7 @@ import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.serve.batching import batch  # noqa: F401
 from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.http_proxy import (HTTPProxy, HTTPRequest, HTTPResponse,
@@ -40,7 +41,7 @@ from ray_tpu.serve.http_proxy import (HTTPProxy, HTTPRequest, HTTPResponse,
 __all__ = [
     "start", "shutdown", "deployment", "get_deployment",
     "list_deployments", "DeploymentHandle", "HTTPRequest", "HTTPResponse",
-    "get_http_address",
+    "get_http_address", "batch",
 ]
 
 _controller = None

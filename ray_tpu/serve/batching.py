@@ -1,0 +1,132 @@
+"""Request batching: coalesce concurrent calls into one invocation.
+
+Parity target: the reference's ``@serve.batch``
+(reference: python/ray/serve/batching.py:163 — a decorator that queues
+individually-awaited calls and invokes the wrapped function once with
+the LIST of pending requests, releasing each caller with its element
+of the returned list). On TPU this is the serving pattern that
+matters: N concurrent single requests become ONE batched device
+program instead of N tiny dispatches.
+
+Usage (inside an async deployment)::
+
+    @serve.deployment
+    class Model:
+        @serve.batch(max_batch_size=16, batch_wait_timeout_s=0.01)
+        async def __call__(self, requests):   # a list arrives
+            return model_fn(jnp.stack(requests))  # list goes back
+
+        # callers still send single requests and await single results
+
+Implementation: pure asyncio on the replica's event loop — a pending
+list per (function, bound instance), flushed when it reaches
+``max_batch_size`` or when ``batch_wait_timeout_s`` elapses after the
+first enqueue. Exceptions from the batched call propagate to every
+caller in the batch.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from typing import Any, Callable, List, Optional
+
+
+class _BatchQueue:
+    """Pending calls for one batched function (per bound instance)."""
+
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout_s = timeout_s
+        self.pending: List[tuple] = []  # (request, future)
+        self._timer: Optional[asyncio.TimerHandle] = None
+
+    async def submit(self, request: Any):
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self.pending.append((request, fut))
+        if len(self.pending) >= self.max_batch_size:
+            self._flush()
+        elif self._timer is None:
+            self._timer = loop.call_later(self.timeout_s, self._flush)
+        return await fut
+
+    def _flush(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        if not self.pending:
+            return
+        batch, self.pending = self.pending, []
+        asyncio.get_running_loop().create_task(self._run(batch))
+
+    async def _run(self, batch: List[tuple]) -> None:
+        requests = [r for r, _ in batch]
+        try:
+            results = await self.fn(requests)
+            if results is None or len(results) != len(requests):
+                raise ValueError(
+                    f"batched function must return one result per "
+                    f"request ({len(requests)} in, "
+                    f"{'none' if results is None else len(results)} out)")
+        except BaseException as e:  # noqa: BLE001 — fan the error out.
+            # BaseException on purpose: a CancelledError (replica loop
+            # teardown) must still resolve every caller's future, or
+            # handle_request awaiters hang and drain() wedges the
+            # rolling update.
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+            if not isinstance(e, Exception):
+                raise  # propagate cancellation to the loop
+            return
+        for (_, fut), res in zip(batch, results):
+            if not fut.done():
+                fut.set_result(res)
+
+
+def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 10,
+          batch_wait_timeout_s: float = 0.01):
+    """``@serve.batch`` / ``@serve.batch(max_batch_size=...,
+    batch_wait_timeout_s=...)`` on an async function or method."""
+    if max_batch_size < 1:
+        raise ValueError("max_batch_size must be >= 1")
+    if batch_wait_timeout_s < 0:
+        raise ValueError("batch_wait_timeout_s must be >= 0")
+
+    def decorate(fn: Callable) -> Callable:
+        if not asyncio.iscoroutinefunction(fn):
+            raise TypeError("@serve.batch requires an async function")
+        # Queues are created lazily REPLICA-side and stored on the
+        # bound instance (methods) or the wrapper itself (functions) —
+        # no closure state, so the decorated deployment pickles to its
+        # replica actor cleanly.
+        qattr = f"_rtpu_batch_queue__{fn.__name__}"
+
+        @functools.wraps(fn)
+        async def wrapper(*args):
+            # method call: (self, request); function call: (request,)
+            if len(args) == 2:
+                instance, request = args
+            elif len(args) == 1:
+                instance, request = None, args[0]
+            else:
+                raise TypeError(
+                    "@serve.batch functions take exactly one request "
+                    "argument")
+            holder = wrapper if instance is None else instance
+            q = getattr(holder, qattr, None)
+            if q is None:
+                bound = fn if instance is None \
+                    else functools.partial(fn, instance)
+                q = _BatchQueue(bound, max_batch_size,
+                                batch_wait_timeout_s)
+                setattr(holder, qattr, q)
+            return await q.submit(request)
+
+        wrapper._rtpu_batched = True
+        return wrapper
+
+    return decorate(_func) if _func is not None else decorate
