@@ -158,6 +158,74 @@ def _zip_blocks(a, b):
     return list(zip(rows_of(a), rows_of(b)))
 
 
+def _block_limit(block, n):
+    if isinstance(block, ColumnBlock):
+        return block.slice(0, n)
+    return block[:n]
+
+
+def _block_select_columns(block, cols):
+    if isinstance(block, ColumnBlock) and not block.scalar:
+        return ColumnBlock({k: block.cols[k] for k in cols})
+    return from_rows([{k: r[k] for k in cols} for r in rows_of(block)])
+
+
+def _block_drop_columns(block, cols):
+    drop = set(cols)
+    if isinstance(block, ColumnBlock) and not block.scalar:
+        kept = {k: v for k, v in block.cols.items() if k not in drop}
+        if not kept and len(block):
+            # ColumnBlock({}) has no column to carry the row count —
+            # dropping EVERY column would silently empty the dataset
+            raise ValueError("drop_columns removed every column")
+        return ColumnBlock(kept)
+    rows = [{k: v for k, v in r.items() if k not in drop}
+            for r in rows_of(block)]
+    if rows and not rows[0]:
+        raise ValueError("drop_columns removed every column")
+    return from_rows(rows)
+
+
+def _block_add_column(block, name, fn):
+    if isinstance(block, ColumnBlock) and not block.scalar:
+        col = np.asarray(fn(dict(block.cols)))
+        if col.shape[:1] != (len(block),):
+            raise ValueError(
+                f"add_column fn returned shape {col.shape} for a "
+                f"{len(block)}-row block")
+        cols = dict(block.cols)
+        cols[name] = col
+        return ColumnBlock(cols)
+    rows = rows_of(block)
+    if not rows:
+        return block
+    # row fallback: fn still sees a columns dict, which requires
+    # UNIFORM dict rows (same keys throughout) — scalar datasets have
+    # no record to add a column to
+    names = rows[0].keys() if isinstance(rows[0], dict) else None
+    if names is None or any(not isinstance(r, dict)
+                            or r.keys() != names for r in rows):
+        raise ValueError(
+            "add_column needs a dataset of uniform dict rows")
+    cols_view = {k: np.asarray([r[k] for r in rows]) for k in names}
+    vals = np.asarray(fn(cols_view))
+    out = []
+    for r, v in zip(rows, vals):
+        r = dict(r)
+        r[name] = v.item() if hasattr(v, "item") else v
+        out.append(r)
+    return from_rows(out)
+
+
+def _block_sample(block, fraction, seed):
+    rng = np.random.default_rng(seed)
+    if isinstance(block, ColumnBlock):
+        return block.take(np.nonzero(
+            rng.random(len(block)) < fraction)[0])
+    keep = rng.random(len(block)) < fraction
+    return [r for r, k in zip(block, keep) if k]
+
+
 def _block_agg(agg, on, block):
     if isinstance(block, ColumnBlock) and _vec_key(on):
         if not len(block):
@@ -348,6 +416,57 @@ class Dataset:
         if descending:
             out = out[::-1]
         return Dataset(out)
+
+    def limit(self, n: int) -> "Dataset":
+        """First n rows (reference: dataset.py limit) — columnar
+        blocks slice without a row trip."""
+        out, have = [], 0
+        for b, m in zip(self._blocks, self._metadata()):
+            if have >= n:
+                break
+            take_n = min(m.num_rows, n - have)
+            if take_n == m.num_rows:
+                out.append(b)
+            else:
+                out.append(_remote(_block_limit).remote(b, take_n))
+            have += take_n
+        return Dataset(out)
+
+    @staticmethod
+    def _column_list(cols) -> List[str]:
+        if isinstance(cols, str):
+            # list('ab') would silently mean columns 'a' and 'b'
+            raise TypeError(
+                f"pass a list of column names, not the string {cols!r}")
+        return list(cols)
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        """Keep only the named columns (reference: map over rows; here
+        a zero-copy column subset on columnar blocks)."""
+        r = _remote(_block_select_columns)
+        cols = self._column_list(cols)
+        return Dataset([r.remote(b, cols) for b in self._blocks])
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        r = _remote(_block_drop_columns)
+        cols = self._column_list(cols)
+        return Dataset([r.remote(b, cols) for b in self._blocks])
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        """Add/replace a column computed from each batch (reference:
+        dataset.py add_column — fn receives the columnar batch)."""
+        r = _remote(_block_add_column)
+        return Dataset([r.remote(b, name, fn) for b in self._blocks])
+
+    def random_sample(self, fraction: float, *,
+                      seed: Optional[int] = None) -> "Dataset":
+        """Bernoulli row sample (reference: dataset.py random_sample)."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError("fraction must be in [0, 1]")
+        base = seed if seed is not None else random.randrange(2 ** 31)
+        r = _remote(_block_sample)
+        return Dataset([r.remote(b, fraction, base + i)
+                        for i, b in enumerate(self._blocks)])
 
     def split(self, n: int) -> List["Dataset"]:
         """Split into n datasets by whole blocks (repartitions first if

@@ -50,7 +50,10 @@ from ray_tpu.rllib.execution import Trainer, build_trainer  # noqa: F401
 from ray_tpu.rllib.impala import ImpalaTrainer  # noqa: F401
 from ray_tpu.rllib.offline import JsonReader, JsonWriter  # noqa: F401
 from ray_tpu.rllib.ppo import DEFAULT_CONFIG, PPOTrainer  # noqa: F401
-from ray_tpu.rllib.replay_buffer import ReplayBuffer  # noqa: F401
+from ray_tpu.rllib.replay_buffer import (  # noqa: F401
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+)
 from ray_tpu.rllib.rollout_worker import (  # noqa: F401
     RolloutWorker,
     TransitionWorker,

@@ -27,7 +27,8 @@ import ray_tpu
 from ray_tpu.rllib import execution
 from ray_tpu.rllib.env import make_env
 from ray_tpu.rllib.offline import JsonReader, JsonWriter
-from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.replay_buffer import (PrioritizedReplayBuffer,
+                                         ReplayBuffer)
 from ray_tpu.rllib.rollout_worker import TransitionWorker
 
 DEFAULT_CONFIG: Dict[str, Any] = {
@@ -46,6 +47,11 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     "epsilon_final": 0.05,
     "epsilon_decay_iters": 20,
     "double_q": True,
+    # prioritized replay (reference: DQN's default replay is
+    # prioritized - execution/replay_buffer.py PrioritizedReplayBuffer)
+    "prioritized_replay": False,
+    "pr_alpha": 0.6,
+    "pr_beta": 0.4,
     "hidden": 64,
     "model": None,                # model-catalog config (models.py)
     "seed": 0,
@@ -107,18 +113,25 @@ def _dqn_update(params, target_params, opt_state, batches, *,
             bootstrap = q_next_target.max(axis=-1)
         target = mb["rewards"] + gamma * (1.0 - mb["dones"]) * \
             jax.lax.stop_gradient(bootstrap)
-        return optax.huber_loss(qa, target).mean()
+        td = qa - target
+        # importance-sampling weights correct the prioritized-sampling
+        # bias; uniform replay sends no "weights" key
+        w = mb.get("weights")
+        loss = optax.huber_loss(qa, target)
+        loss = (loss * w).mean() if w is not None else loss.mean()
+        return loss, jnp.abs(td)
 
     def step(carry, mb):
         p, opt_state = carry
-        loss, grads = jax.value_and_grad(td_loss)(p, mb)
+        (loss, td_abs), grads = jax.value_and_grad(
+            td_loss, has_aux=True)(p, mb)
         updates, opt_state = optimizer.update(grads, opt_state, p)
         p = optax.apply_updates(p, updates)
-        return (p, opt_state), loss
+        return (p, opt_state), (loss, td_abs)
 
-    (params, opt_state), losses = jax.lax.scan(
+    (params, opt_state), (losses, td_abs) = jax.lax.scan(
         step, (params, opt_state), batches)
-    return params, opt_state, jnp.mean(losses)
+    return params, opt_state, jnp.mean(losses), td_abs
 
 
 class DQNTrainer(execution.Trainer):
@@ -146,8 +159,14 @@ class DQNTrainer(execution.Trainer):
         # Replay lives in its own actor so many workers can feed it and
         # its memory is isolated from the learner (reference:
         # LocalReplayBuffer actor, rllib/execution/replay_buffer.py:302).
-        self.buffer = ray_tpu.remote(ReplayBuffer).options(
-            num_cpus=0).remote(cfg["buffer_size"], seed=cfg["seed"])
+        if cfg["prioritized_replay"]:
+            self.buffer = ray_tpu.remote(PrioritizedReplayBuffer).options(
+                num_cpus=0).remote(cfg["buffer_size"], seed=cfg["seed"],
+                                   alpha=cfg["pr_alpha"],
+                                   beta=cfg["pr_beta"])
+        else:
+            self.buffer = ray_tpu.remote(ReplayBuffer).options(
+                num_cpus=0).remote(cfg["buffer_size"], seed=cfg["seed"])
         self._counters = {"timesteps_total": 0, "buffer_size": 0,
                           "epsilon": cfg["epsilon_initial"]}
         if self._offline:
@@ -213,10 +232,17 @@ class DQNTrainer(execution.Trainer):
         if stacked is None:
             return {"loss": float("nan")}
         cfg = self.config
-        self.params, self._opt_state, loss = _dqn_update(
+        # "indices" are host-side bookkeeping for priority updates —
+        # the jitted update must not trace them
+        indices = stacked.pop("indices", None)
+        self.params, self._opt_state, loss, td_abs = _dqn_update(
             self.params, self.target_params, self._opt_state,
             stacked, gamma=cfg["gamma"], double_q=cfg["double_q"],
             lr=cfg["lr"], model=self.model)
+        if indices is not None:
+            self.buffer.update_priorities.remote(
+                np.asarray(indices).reshape(-1),
+                np.asarray(td_abs).reshape(-1))
         return {"loss": float(loss)}
 
     def _update_target(self) -> None:

@@ -68,3 +68,90 @@ class ReplayBuffer:
     def stats(self) -> dict:
         return {"size": self._size, "capacity": self.capacity,
                 "num_added": self.num_added}
+
+
+class PrioritizedReplayBuffer(ReplayBuffer):
+    """Proportional prioritized replay (reference:
+    rllib/execution/replay_buffer.py PrioritizedReplayBuffer over a
+    sum-tree; standard public formulation of Schaul et al. 2016).
+
+    TPU-first posture kept: the sum tree is one numpy array and both
+    sampling (stratified draw + vectorized level-by-level descent) and
+    priority updates (unique-parent recompute per level) are batched
+    numpy — no per-transition Python objects. ``sample`` returns the
+    transitions plus ``weights`` (importance-sampling corrections,
+    normalized to max 1) and ``indices`` for ``update_priorities``.
+    """
+
+    def __init__(self, capacity: int = 100_000, seed: int = 0,
+                 alpha: float = 0.6, beta: float = 0.4,
+                 eps: float = 1e-6):
+        super().__init__(capacity, seed)
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+        self.eps = float(eps)
+        self._cap2 = 1
+        while self._cap2 < self.capacity:
+            self._cap2 *= 2
+        self._tree = np.zeros(2 * self._cap2, dtype=np.float64)
+        self._max_prio = 1.0
+
+    # -- sum tree -------------------------------------------------------
+
+    def _set_leaves(self, slots: np.ndarray, prios: np.ndarray) -> None:
+        leaf = slots + self._cap2
+        self._tree[leaf] = prios
+        level = np.unique(leaf // 2)
+        while level[0] >= 1:
+            self._tree[level] = (self._tree[2 * level]
+                                 + self._tree[2 * level + 1])
+            if level[0] == 1:
+                break
+            level = np.unique(level // 2)
+
+    def _descend(self, targets: np.ndarray) -> np.ndarray:
+        idx = np.ones(len(targets), dtype=np.int64)
+        while idx[0] < self._cap2:  # perfect tree: uniform depth
+            left = 2 * idx
+            left_sum = self._tree[left]
+            go_right = targets > left_sum
+            targets = targets - np.where(go_right, left_sum, 0.0)
+            idx = left + go_right
+        return idx - self._cap2
+
+    # -- ReplayBuffer surface -------------------------------------------
+
+    def add(self, batch: Dict[str, np.ndarray]) -> int:
+        n = len(next(iter(batch.values())))
+        start_next = self._next
+        size = super().add(batch)
+        # fresh transitions enter at the current max priority so each
+        # is sampled at least once before TD errors demote it
+        slots = (start_next + np.arange(min(n, self.capacity))) \
+            % self.capacity
+        self._set_leaves(slots, np.full(len(slots),
+                                        self._max_prio ** self.alpha))
+        return size
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        if self._size == 0:
+            raise ValueError("cannot sample from an empty buffer")
+        total = self._tree[1]
+        # stratified: one draw per equal segment of the priority mass
+        seg = total / batch_size
+        targets = (np.arange(batch_size) + self._rng.random(batch_size)
+                   ) * seg
+        idx = np.minimum(self._descend(targets), self._size - 1)
+        probs = self._tree[idx + self._cap2] / max(total, 1e-12)
+        weights = (self._size * np.maximum(probs, 1e-12)) ** -self.beta
+        out = {k: v[idx] for k, v in self._store.items()}
+        out["weights"] = (weights / weights.max()).astype(np.float32)
+        out["indices"] = idx.astype(np.int64)
+        return out
+
+    def update_priorities(self, indices: np.ndarray,
+                          td_errors: np.ndarray) -> None:
+        indices = np.asarray(indices).reshape(-1)
+        prios = np.abs(np.asarray(td_errors)).reshape(-1) + self.eps
+        self._max_prio = max(self._max_prio, float(prios.max()))
+        self._set_leaves(indices % self.capacity, prios ** self.alpha)

@@ -261,3 +261,52 @@ def test_non_columnizable_rows_fall_back(ray_start_regular):
     assert ds.filter(lambda r: len(r["a"]) == 2).count() == 10
     got = data.from_items(byt * 5, parallelism=2).take_all()
     assert got.count(b"x\x00\x00") == 5  # NULs survived
+
+
+def test_column_ops_and_limit_sample(ray_start_regular):
+    """r5 API widening (reference: dataset.py limit/add_column/
+    select_columns/drop_columns/random_sample): column ops are
+    zero-copy column subsets on columnar blocks; limit slices."""
+    import numpy as np
+
+    from ray_tpu import data
+
+    rows = [{"a": i, "b": float(i) * 2, "c": str(i)} for i in range(100)]
+    ds = data.from_items(rows, parallelism=4)
+
+    lim = ds.limit(30)
+    assert lim.count() == 30
+    assert lim.take_all() == rows[:30]
+
+    sel = ds.select_columns(["a", "b"]).take(2)
+    assert sel == [{"a": 0, "b": 0.0}, {"a": 1, "b": 2.0}]
+    drp = ds.drop_columns(["c"]).take(1)
+    assert drp == [{"a": 0, "b": 0.0}]
+
+    plus = ds.add_column("d", lambda cols: cols["a"] + cols["b"])
+    got = plus.take(3)
+    assert [r["d"] for r in got] == [0.0, 3.0, 6.0]
+    assert plus.schema()["d"] == "float"
+
+    samp = ds.random_sample(0.5, seed=7)
+    n = samp.count()
+    assert 20 <= n <= 80  # Bernoulli around 50
+    assert all(r["a"] == int(r["c"]) for r in samp.take_all())
+    assert data.range(1000).random_sample(0.0).count() == 0
+
+
+def test_column_ops_edge_cases(ray_start_regular):
+    """Review r5: guard rails on the new column ops — string column
+    args rejected, drop-all-columns errors instead of silently
+    emptying, add_column on scalar/non-uniform rows errors clearly."""
+    from ray_tpu import data
+
+    ds = data.from_items([{"a": i, "b": i} for i in range(10)],
+                         parallelism=2)
+    with pytest.raises(TypeError, match="list of column names"):
+        ds.select_columns("ab")
+    with pytest.raises(Exception, match="removed every column"):
+        ds.drop_columns(["a", "b"]).count()
+    with pytest.raises(Exception, match="uniform dict rows"):
+        data.from_items([1, 2, 3]).add_column(
+            "d", lambda c: c["x"]).count()
