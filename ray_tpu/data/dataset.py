@@ -165,6 +165,10 @@ def _block_limit(block, n):
 
 
 def _block_select_columns(block, cols):
+    if not cols:
+        # ColumnBlock({}) cannot carry a row count (same hazard
+        # drop_columns guards); match it with a clear error
+        raise ValueError("select_columns needs at least one column")
     if isinstance(block, ColumnBlock) and not block.scalar:
         return ColumnBlock({k: block.cols[k] for k in cols})
     return from_rows([{k: r[k] for k in cols} for r in rows_of(block)])
@@ -209,6 +213,10 @@ def _block_add_column(block, name, fn):
             "add_column needs a dataset of uniform dict rows")
     cols_view = {k: np.asarray([r[k] for r in rows]) for k in names}
     vals = np.asarray(fn(cols_view))
+    if vals.shape[:1] != (len(rows),):  # same contract as columnar path
+        raise ValueError(
+            f"add_column fn returned shape {vals.shape} for a "
+            f"{len(rows)}-row block")
     out = []
     for r, v in zip(rows, vals):
         r = dict(r)
@@ -419,13 +427,20 @@ class Dataset:
 
     def limit(self, n: int) -> "Dataset":
         """First n rows (reference: dataset.py limit) — columnar
-        blocks slice without a row trip."""
+        blocks slice without a row trip. Block row counts are fetched
+        INCREMENTALLY so a limit over an expensive pipeline only
+        executes the prefix blocks it needs (like take())."""
+        meta_fn = _remote(_block_meta)
         out, have = [], 0
-        for b, m in zip(self._blocks, self._metadata()):
+        for b in self._blocks:
             if have >= n:
                 break
-            take_n = min(m.num_rows, n - have)
-            if take_n == m.num_rows:
+            if self._meta is not None:
+                rows = self._meta[len(out)].num_rows
+            else:
+                rows = ray_tpu.get(meta_fn.remote(b))[0]
+            take_n = min(rows, n - have)
+            if take_n == rows:
                 out.append(b)
             else:
                 out.append(_remote(_block_limit).remote(b, take_n))

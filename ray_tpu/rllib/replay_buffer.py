@@ -99,6 +99,8 @@ class PrioritizedReplayBuffer(ReplayBuffer):
     # -- sum tree -------------------------------------------------------
 
     def _set_leaves(self, slots: np.ndarray, prios: np.ndarray) -> None:
+        if len(slots) == 0:
+            return  # empty batch: nothing to propagate
         leaf = slots + self._cap2
         self._tree[leaf] = prios
         level = np.unique(leaf // 2)
@@ -152,6 +154,8 @@ class PrioritizedReplayBuffer(ReplayBuffer):
     def update_priorities(self, indices: np.ndarray,
                           td_errors: np.ndarray) -> None:
         indices = np.asarray(indices).reshape(-1)
+        if len(indices) == 0:
+            return
         prios = np.abs(np.asarray(td_errors)).reshape(-1) + self.eps
         self._max_prio = max(self._max_prio, float(prios.max()))
         self._set_leaves(indices % self.capacity, prios ** self.alpha)

@@ -182,3 +182,49 @@ def test_memory_store_waiter_handoff_races():
     finally:
         loop.close()
     assert vals == list(range(N))
+
+
+def test_lineage_release_races_completion(ray_start_regular):
+    """r5 lifecycle under adversarial interleaving: threads racing
+    fire-and-forget submits, held-then-released refs, and gets must
+    leave NO task records, references, or store values behind — the
+    release can land before, during, or after the completion, hitting
+    the in-flight (lineage_pinned=None skip) and completed
+    (release-pops-entry) arms nondeterministically."""
+    import gc
+
+    @ray_tpu.remote
+    def val(x):
+        return x
+
+    core = ray_tpu.worker.global_worker.core
+    errors = []
+
+    def storm(tid):
+        try:
+            rng = tid * 10_000
+            for round_i in range(10):
+                # fire-and-forget: release before/while running
+                for i in range(20):
+                    val.remote(rng + i)
+                # held then dropped post-completion
+                refs = [val.remote(rng + 100 + i) for i in range(20)]
+                got = ray_tpu.get(refs, timeout=120)
+                assert got == [rng + 100 + i for i in range(20)]
+                del refs, got
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    _run_threads([lambda t=t: storm(t) for t in range(6)], timeout=240)
+    assert not errors, errors[:3]
+    gc.collect()
+    deadline = time.time() + 30
+    while time.time() < deadline and (
+            core.pending_tasks or core.reference_counter._refs
+            or core.memory_store._objects):
+        time.sleep(0.1)
+    assert not core.pending_tasks, len(core.pending_tasks)
+    assert not core.reference_counter._refs, \
+        len(core.reference_counter._refs)
+    assert not core.memory_store._objects, \
+        len(core.memory_store._objects)
