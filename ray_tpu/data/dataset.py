@@ -431,14 +431,19 @@ class Dataset:
         INCREMENTALLY so a limit over an expensive pipeline only
         executes the prefix blocks it needs (like take())."""
         meta_fn = _remote(_block_meta)
+        if not hasattr(self, "_row_counts"):
+            self._row_counts: dict = {}
         out, have = [], 0
-        for b in self._blocks:
+        for i, b in enumerate(self._blocks):
             if have >= n:
                 break
             if self._meta is not None:
-                rows = self._meta[len(out)].num_rows
+                rows = self._meta[i].num_rows
+            elif i in self._row_counts:
+                rows = self._row_counts[i]
             else:
-                rows = ray_tpu.get(meta_fn.remote(b))[0]
+                rows = self._row_counts[i] = \
+                    ray_tpu.get(meta_fn.remote(b))[0]
             take_n = min(rows, n - have)
             if take_n == rows:
                 out.append(b)

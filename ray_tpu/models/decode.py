@@ -1,0 +1,191 @@
+"""KV-cached autoregressive decoding for the flagship transformer.
+
+Parity target: the serving half of the reference's model families
+(reference: the generation utilities its RLlib/serve examples lean on;
+the training side lives in models/transformer.py). TPU-first design:
+the KV cache is a preallocated [L, B, max_len, H, Dh] pytree so every
+decode step is ONE jitted program of static shapes — `prefill` runs
+the prompt through the full-sequence layers (flash/XLA attention)
+while writing the cache, and `decode_step` attends the new token
+against the cache with a position mask (no recompute, no dynamic
+shapes). `generate` wraps both in a `lax.scan`, so an N-token
+generation is exactly two compiled programs.
+
+Oracle: greedy generate() must match per-step argmax of the FULL
+forward() on the growing prefix — tests/test_ops.py asserts this
+exactly, which pins the cache bookkeeping (rope offsets, masking,
+update slices) to the training forward's semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models.transformer import TransformerConfig
+from ray_tpu.ops.attention import flash_attention
+from ray_tpu.ops.norms import rmsnorm
+from ray_tpu.ops.rotary import apply_rotary, rope_frequencies
+
+
+def init_kv_cache(cfg: TransformerConfig, batch: int,
+                  max_len: int) -> Dict:
+    shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype),
+            "pos": jnp.zeros((), jnp.int32)}
+
+
+def _qkv(lp, h, Dh):
+    B, T = h.shape[:2]
+    q = (h @ lp["wq"]).reshape(B, T, -1, Dh)
+    k = (h @ lp["wk"]).reshape(B, T, -1, Dh)
+    v = (h @ lp["wv"]).reshape(B, T, -1, Dh)
+    return q, k, v
+
+
+def _mlp(lp, x):
+    h = rmsnorm(x, lp["mlp_norm"])
+    g = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32))
+    u = (h @ lp["w_up"]).astype(jnp.float32)
+    return x + ((g * u).astype(x.dtype) @ lp["w_down"]).astype(x.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def prefill(params, tokens, cache: Dict,
+            cfg: TransformerConfig) -> Tuple[jnp.ndarray, Dict]:
+    """Run the prompt [B, T0] through the stack, writing each layer's
+    K/V into the cache. Returns (last-token logits [B, V], cache)."""
+    B, T0 = tokens.shape
+    max_len = cache["k"].shape[2]
+    cos, sin = rope_frequencies(cfg.head_dim, max_len,
+                                theta=cfg.rope_theta)
+    positions = jnp.arange(T0)
+    x = params["embed"][tokens]
+
+    def body(x, layer_in):
+        lp, ck, cv = layer_in
+        h = rmsnorm(x, lp["attn_norm"])
+        q, k, v = _qkv(lp, h, cfg.head_dim)
+        q = apply_rotary(q, cos, sin, positions=positions)
+        k = apply_rotary(k, cos, sin, positions=positions)
+        # same kernel as the training forward's local path (Pallas on
+        # TPU, XLA fallback off-TPU) so prefill logits match forward()
+        # bit for bit and long prompts keep the blocked-VMEM property
+        o = flash_attention(q, k, v, causal=True).reshape(B, T0, -1)
+        x = x + (o @ lp["wo"]).astype(x.dtype)
+        x = _mlp(lp, x)
+        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                      (0, 0, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                      (0, 0, 0, 0))
+        return x, (ck, cv)
+
+    x, (ck, cv) = lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rmsnorm(x, params["final_norm"])
+    # bf16 matmul then f32, bit-matching the training forward's
+    # unembed so greedy decode agrees with full-forward argmax exactly
+    logits = (x[:, -1] @ params["embed"].T.astype(x.dtype)
+              ).astype(jnp.float32)
+    return logits, {"k": ck, "v": cv,
+                    "pos": jnp.asarray(T0, jnp.int32)}
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def decode_step(params, cache: Dict, token,
+                cfg: TransformerConfig) -> Tuple[jnp.ndarray, Dict]:
+    """One token [B] in, next-token logits [B, V] out; cache advances.
+    Attention runs against the full static-shape cache with a
+    position mask — a single fused device program per step."""
+    B = token.shape[0]
+    max_len = cache["k"].shape[2]
+    pos = cache["pos"]
+    cos, sin = rope_frequencies(cfg.head_dim, max_len,
+                                theta=cfg.rope_theta)
+    positions = pos[None]  # [1]
+    x = params["embed"][token][:, None, :]  # [B, 1, D]
+    sm_scale = cfg.head_dim ** -0.5
+    valid = (jnp.arange(max_len) <= pos)[None, None, :]  # [1,1,Tmax]
+
+    def body(x, layer_in):
+        lp, ck, cv = layer_in
+        h = rmsnorm(x, lp["attn_norm"])
+        q, k, v = _qkv(lp, h, cfg.head_dim)
+        q = apply_rotary(q, cos, sin, positions=positions)
+        k = apply_rotary(k, cos, sin, positions=positions)
+        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                      (0, pos, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                      (0, pos, 0, 0))
+        s = jnp.einsum("bhd,bkhd->bhk", q[:, 0], ck,
+                       preferred_element_type=jnp.float32) * sm_scale
+        s = jnp.where(valid, s, -jnp.inf)
+        # accumulation dtypes bit-match ops.attention (softmax fp32,
+        # p cast to the value dtype, p@v accumulated in fp32)
+        p = jax.nn.softmax(s, axis=-1).astype(cv.dtype)
+        o = jnp.einsum("bhk,bkhd->bhd", p, cv,
+                       preferred_element_type=jnp.float32
+                       ).astype(q.dtype)
+        x = x + (o.reshape(B, 1, -1) @ lp["wo"]).astype(x.dtype)
+        x = _mlp(lp, x)
+        return x, (ck, cv)
+
+    x, (ck, cv) = lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rmsnorm(x[:, 0], params["final_norm"])
+    logits = (x @ params["embed"].T.astype(x.dtype)
+              ).astype(jnp.float32)
+    return logits, {"k": ck, "v": cv, "pos": pos + 1}
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "steps", "temperature"))
+def _decode_loop(params, logits, cache, key, *, cfg, steps,
+                 temperature):
+    """Module-level jit: the scanned decode loop compiles ONCE per
+    (cfg, steps, temperature, shapes) across generate() calls — a
+    per-call closure would retrace every invocation."""
+    def pick(logits, k):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            k, logits / temperature).astype(jnp.int32)
+
+    def body(carry, _):
+        logits, cache, key = carry
+        key, sub = jax.random.split(key)
+        tok = pick(logits, sub)
+        logits, cache = decode_step(params, cache, tok, cfg)
+        return (logits, cache, key), tok
+
+    (_, cache, _), toks = lax.scan(
+        body, (logits, cache, key), None, length=steps)
+    return toks.swapaxes(0, 1)  # [B, steps]
+
+
+def generate(params, prompt, cfg: TransformerConfig, *, steps: int,
+             key: Optional[jax.Array] = None, temperature: float = 0.0,
+             max_len: Optional[int] = None) -> jnp.ndarray:
+    """Autoregressive sampling: greedy at temperature 0, categorical
+    otherwise (an explicit ``key`` is required then — a silent fixed
+    seed would make every call return the same completion). Returns
+    generated tokens [B, steps]. Two compiled programs total, cached
+    across calls: prefill + the scanned decode loop."""
+    B, T0 = prompt.shape
+    max_len = max_len or min(cfg.max_seq, T0 + steps)
+    if T0 + steps > max_len:
+        raise ValueError(f"prompt ({T0}) + steps ({steps}) exceeds "
+                         f"max_len ({max_len})")
+    if temperature > 0.0 and key is None:
+        raise ValueError("temperature > 0 requires an explicit key")
+    cache = init_kv_cache(cfg, B, max_len)
+    logits, cache = prefill(params, prompt, cache, cfg)
+    if key is None:
+        key = jax.random.key(0)  # unused by the greedy path
+    return _decode_loop(params, logits, cache, key, cfg=cfg,
+                        steps=steps, temperature=temperature)
