@@ -431,8 +431,6 @@ class Dataset:
         INCREMENTALLY so a limit over an expensive pipeline only
         executes the prefix blocks it needs (like take())."""
         meta_fn = _remote(_block_meta)
-        if not hasattr(self, "_row_counts"):
-            self._row_counts: dict = {}
         out, have = [], 0
         for i, b in enumerate(self._blocks):
             if have >= n:

@@ -249,6 +249,49 @@ def run(steps: int = 8) -> dict:
 
         return (timed(reps + 1) - timed(1)) / reps
 
+    # ---- KV-cached decode throughput (the serving-side metric) ----
+    def bench_decode():
+        from ray_tpu.models import decode as dec
+
+        if on_tpu:
+            dcfg, Bd, T0, steps_d = cfg, 16, 512, 64
+        else:
+            dcfg, Bd, T0, steps_d = cfg, 4, 32, 8
+        dparams = tfm.init_params(jax.random.key(3), dcfg)
+        prompt = jax.random.randint(jax.random.key(4), (Bd, T0), 0,
+                                    dcfg.vocab)
+        max_len = T0 + steps_d + 1
+
+        def run(n_steps):
+            toks = dec.generate(dparams, prompt, dcfg, steps=n_steps,
+                                max_len=max_len)
+            return int(toks[0, -1])  # host sync
+
+        run(1)
+        run(steps_d)  # compile both loop lengths
+
+        def timed(n, k=3):
+            best = float("inf")
+            for _ in range(k):
+                t0 = time.perf_counter()
+                run(n)
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        dt = (timed(steps_d) - timed(1)) / (steps_d - 1)
+        if dt <= 0:
+            return {"error": "unstable timing (delta <= 0)"}
+        return {
+            "batch": Bd, "prompt_len": T0, "steps": steps_d,
+            "per_token_ms": round(dt * 1e3, 3),
+            "tokens_per_s": round(Bd / dt, 1),
+        }
+
+    try:
+        out["decode"] = bench_decode()
+    except Exception as e:  # noqa: BLE001 — secondary row
+        out["decode"] = {"error": str(e)[:200]}
+
     t_flash = bench_attn(lambda q, k, v: flash_attention(q, k, v))
     t_ref = bench_attn(lambda q, k, v: attention(q, k, v))
     t_flash_bwd = bench_attn_bwd(

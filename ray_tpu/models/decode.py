@@ -96,12 +96,24 @@ def prefill(params, tokens, cache: Dict,
                     "pos": jnp.asarray(T0, jnp.int32)}
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
 def decode_step(params, cache: Dict, token,
                 cfg: TransformerConfig) -> Tuple[jnp.ndarray, Dict]:
     """One token [B] in, next-token logits [B, V] out; cache advances.
-    Attention runs against the full static-shape cache with a
-    position mask — a single fused device program per step."""
+    Eager-call entry with a capacity check — dynamic_update_slice
+    CLAMPS out-of-range writes, so stepping past max_len would
+    silently overwrite the last slot instead of failing."""
+    if int(cache["pos"]) >= cache["k"].shape[2]:
+        raise ValueError(
+            f"KV cache full (pos {int(cache['pos'])} of "
+            f"{cache['k'].shape[2]}); allocate a larger max_len")
+    return _decode_step_jit(params, cache, token, cfg)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _decode_step_jit(params, cache: Dict, token,
+                     cfg: TransformerConfig) -> Tuple[jnp.ndarray, Dict]:
+    """Jitted body: a single fused device program per step (attention
+    against the full static-shape cache with a position mask)."""
     B = token.shape[0]
     max_len = cache["k"].shape[2]
     pos = cache["pos"]
@@ -144,27 +156,35 @@ def decode_step(params, cache: Dict, token,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("cfg", "steps", "temperature"))
-def _decode_loop(params, logits, cache, key, *, cfg, steps,
-                 temperature):
+                   static_argnames=("cfg", "steps", "sample"))
+def _decode_loop(params, logits, cache, key, temperature, *, cfg,
+                 steps, sample):
     """Module-level jit: the scanned decode loop compiles ONCE per
-    (cfg, steps, temperature, shapes) across generate() calls — a
-    per-call closure would retrace every invocation."""
+    (cfg, steps, sample, shapes) across generate() calls — a per-call
+    closure would retrace every invocation, and a static temperature
+    would recompile per distinct float, so only the greedy/sampling
+    BRANCH is static and the magnitude is a traced operand."""
     def pick(logits, k):
-        if temperature <= 0.0:
+        if not sample:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return jax.random.categorical(
             k, logits / temperature).astype(jnp.int32)
 
-    def body(carry, _):
+    def body(carry, i):
         logits, cache, key = carry
         key, sub = jax.random.split(key)
         tok = pick(logits, sub)
-        logits, cache = decode_step(params, cache, tok, cfg)
+        # the token sampled on the LAST iteration needs no successor
+        # logits: skip its decode_step (at steps=1 this halves the
+        # per-generation device work)
+        logits, cache = lax.cond(
+            i < steps - 1,
+            lambda: _decode_step_jit(params, cache, tok, cfg),
+            lambda: (logits, cache))
         return (logits, cache, key), tok
 
     (_, cache, _), toks = lax.scan(
-        body, (logits, cache, key), None, length=steps)
+        body, (logits, cache, key), jnp.arange(steps))
     return toks.swapaxes(0, 1)  # [B, steps]
 
 
@@ -187,5 +207,8 @@ def generate(params, prompt, cfg: TransformerConfig, *, steps: int,
     logits, cache = prefill(params, prompt, cache, cfg)
     if key is None:
         key = jax.random.key(0)  # unused by the greedy path
-    return _decode_loop(params, logits, cache, key, cfg=cfg,
-                        steps=steps, temperature=temperature)
+    return _decode_loop(params, logits, cache, key,
+                        jnp.asarray(max(temperature, 1e-8),
+                                    jnp.float32),
+                        cfg=cfg, steps=steps,
+                        sample=temperature > 0.0)
