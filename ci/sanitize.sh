@@ -2,13 +2,17 @@
 # Sanitizer pass over the native tier (SURVEY §5.2 posture; r4 verdict
 # ask #6). Builds cpp/fastpath.c (ASAN+UBSAN, non-recovering UBSAN) and
 # the C++ msgpack codec / xlang client with the same flags, then runs:
-#   1. the fastpath state-parity suite,
+#   1. the fastpath state-parity suite — including the zero-copy put
+#      memcpy entry (copy_into): copies under threads, odd sizes,
+#      unaligned offsets, bounds rejection,
 #   2. the cross-language C++ client suite (msgpack_lite.hpp codec),
 #   3. a 100k-task drain with the instrumented fast path on the hot
 #      path end to end (driver + raylet + workers all preload ASAN),
 #   4. a CPython-allocator leak check over the submit/complete loop
 #      (sys.getallocatedblocks steady-state — works on release builds
-#      where sys.gettotalrefcount does not exist).
+#      where sys.gettotalrefcount does not exist),
+#   5. a put-bandwidth smoke: large puts through the instrumented
+#      zero-copy pipeline must record a NONZERO GB/s and roundtrip.
 # Any ASAN/UBSAN report aborts the run (abort_on_error=1) and fails CI.
 # LeakSanitizer stays off: the interpreter's arena allocations at exit
 # are all false positives; the allocator steady-state check in step 4
@@ -27,13 +31,37 @@ export LD_PRELOAD="$LIBASAN"
 export ASAN_OPTIONS="detect_leaks=0:abort_on_error=1"
 export UBSAN_OPTIONS="print_stacktrace=1:halt_on_error=1"
 
-echo "== 1/4 fastpath parity suite under ASAN+UBSAN =="
+echo "== 1/5 fastpath parity suite (incl. copy_into) under ASAN+UBSAN =="
 python -m pytest tests/test_fastpath.py -x -q
 
-echo "== 2/4 C++ msgpack codec + xlang client under ASAN+UBSAN =="
+echo "== 2/5 C++ msgpack codec + xlang client under ASAN+UBSAN =="
 python -m pytest tests/test_cross_language.py -x -q
 
-echo "== 3/4 100k drain + 4/4 allocator leak check =="
+echo "== 3/5 100k drain + 4/5 allocator leak check =="
 python ci/asan_drain.py
+
+echo "== 5/5 zero-copy put bandwidth smoke =="
+JAX_PLATFORMS=cpu RAY_TPU_SCHEDULER_BACKEND=host python - <<'PY'
+import time
+import numpy as np
+import ray_tpu
+
+ray_tpu.init(num_cpus=1, object_store_memory=1024 * 1024 * 1024)
+try:
+    mb16 = np.ones(2 * 1024 * 1024, dtype=np.float64)  # 16 MB
+    refs = [ray_tpu.put(mb16) for _ in range(8)]       # warm the pool
+    del refs
+    t0 = time.perf_counter()
+    refs = [ray_tpu.put(mb16) for _ in range(8)]
+    gbps = (8 * 16 / 1024.0) / (time.perf_counter() - t0)
+    assert np.array_equal(ray_tpu.get(refs[-1]), mb16), "put roundtrip"
+    assert gbps > 0, "put GB/s not recorded"
+    stats = ray_tpu.worker.global_worker.node.raylet.store.stats()
+    assert "num_recycle_hits" in stats, "recycle stats missing"
+    print(f"put smoke: {gbps:.2f} GB/s, "
+          f"recycle hits={stats['num_recycle_hits']}")
+finally:
+    ray_tpu.shutdown()
+PY
 
 echo "SANITIZE: all clean"

@@ -894,6 +894,89 @@ FastCtx_get_submitted(FastCtx *self, void *closure)
     return PyLong_FromUnsignedLongLong(self->submitted);
 }
 
+/* copy_into(dst, dst_off, src[, src_off[, nbytes]]) -> nbytes copied
+ *
+ * The data-plane memcpy of the zero-copy put pipeline
+ * (shm_store.write_segment / raylet chunk pulls): one C memcpy from any
+ * C-contiguous source buffer straight into a writable destination
+ * buffer (the mapped shm segment), with the GIL RELEASED for the whole
+ * copy.  Releasing the GIL is the point: (a) several Python threads
+ * copying different stripes of one huge frame actually run in parallel
+ * (page faults on fresh tmpfs pages and the memcpy itself both
+ * parallelize across cores), and (b) a multi-GiB put no longer stalls
+ * every other driver thread for hundreds of milliseconds.  Module-level
+ * (not a Ctx method): the store writer has no CoreWorker.
+ *
+ * Both buffers must be C-contiguous (PyBUF_SIMPLE) — pickle-5
+ * out-of-band buffers always are (PickleBuffer.raw() enforces it);
+ * anything else falls back to the pure-Python memoryview-slice path in
+ * native.py.  Bounds are checked before the GIL drops. */
+static PyObject *
+fastpath_copy_into(PyObject *module, PyObject *const *argv,
+                   Py_ssize_t nargs)
+{
+    if (nargs < 3 || nargs > 5) {
+        PyErr_SetString(
+            PyExc_TypeError,
+            "copy_into(dst, dst_off, src[, src_off[, nbytes]])");
+        return NULL;
+    }
+    Py_ssize_t dst_off = PyLong_AsSsize_t(argv[1]);
+    if (dst_off == -1 && PyErr_Occurred())
+        return NULL;
+    Py_ssize_t src_off = 0, nbytes = -1;
+    if (nargs >= 4) {
+        src_off = PyLong_AsSsize_t(argv[3]);
+        if (src_off == -1 && PyErr_Occurred())
+            return NULL;
+    }
+    if (nargs == 5) {
+        nbytes = PyLong_AsSsize_t(argv[4]);
+        if (nbytes == -1 && PyErr_Occurred())
+            return NULL;
+    }
+
+    Py_buffer dst, src;
+    if (PyObject_GetBuffer(argv[0], &dst, PyBUF_WRITABLE) < 0)
+        return NULL;
+    if (PyObject_GetBuffer(argv[2], &src, PyBUF_SIMPLE) < 0) {
+        PyBuffer_Release(&dst);
+        return NULL;
+    }
+    /* Overflow-safe bounds: offsets validated against their buffer
+     * FIRST, then lengths compared in subtraction form — the naive
+     * off + nbytes > len form overflows signed Py_ssize_t for large
+     * offsets (UB) and would wave a wild pointer through to the
+     * GIL-released memcpy. */
+    if (dst_off < 0 || src_off < 0 ||
+        src_off > src.len || dst_off > dst.len) {
+        PyBuffer_Release(&src);
+        PyBuffer_Release(&dst);
+        PyErr_SetString(PyExc_ValueError,
+                        "copy_into: offset out of bounds");
+        return NULL;
+    }
+    if (nbytes < 0)
+        nbytes = src.len - src_off;
+    if (nbytes > src.len - src_off || nbytes > dst.len - dst_off) {
+        PyBuffer_Release(&src);
+        PyBuffer_Release(&dst);
+        PyErr_SetString(PyExc_ValueError,
+                        "copy_into: offset/length out of bounds");
+        return NULL;
+    }
+    if (nbytes > 0) {
+        char *d = (char *)dst.buf + dst_off;
+        const char *s = (const char *)src.buf + src_off;
+        Py_BEGIN_ALLOW_THREADS
+        memcpy(d, s, (size_t)nbytes);
+        Py_END_ALLOW_THREADS
+    }
+    PyBuffer_Release(&src);
+    PyBuffer_Release(&dst);
+    return PyLong_FromSsize_t(nbytes);
+}
+
 static PyMethodDef FastCtx_methods[] = {
     {"submit", (PyCFunction)(void (*)(void))FastCtx_submit,
      METH_FASTCALL, "fused template-task submission"},
@@ -925,9 +1008,16 @@ static PyTypeObject FastCtx_Type = {
     .tp_doc = "fused submit context bound to one CoreWorker",
 };
 
+static PyMethodDef fastpath_functions[] = {
+    {"copy_into", (PyCFunction)(void (*)(void))fastpath_copy_into,
+     METH_FASTCALL,
+     "GIL-releasing memcpy between C-contiguous buffers"},
+    {NULL, NULL, 0, NULL},
+};
+
 static struct PyModuleDef fastpath_module = {
     PyModuleDef_HEAD_INIT, "_rtpu_fastpath",
-    "fused driver-side submission hot path", -1, NULL,
+    "fused driver-side submission hot path", -1, fastpath_functions,
 };
 
 PyMODINIT_FUNC

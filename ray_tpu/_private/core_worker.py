@@ -42,7 +42,9 @@ from ray_tpu._private.reference_count import Reference, ReferenceCounter
 from ray_tpu._private.serialization import (
     META_ERROR, SerializationContext, SerializedObject,
 )
-from ray_tpu._private.shm_store import AttachedObject, write_segment
+from ray_tpu._private.shm_store import (
+    RECYCLE_MIN_BYTES, AttachedObject, plan_segment, write_segment,
+)
 from ray_tpu._private.task_spec import (
     ARG_REF, ARG_VALUE, REPLY_ACTOR_RESTARTING, REPLY_ERROR, REPLY_STOLEN,
     TASK_ACTOR, TASK_ACTOR_CREATION, TASK_NORMAL, TaskArg, TaskSpec,
@@ -467,6 +469,10 @@ class CoreWorker:
                     "locations": sorted(
                         self.reference_counter.get_locations(oid))}
         assert isinstance(obj, SerializedObject)
+        # SNAPSHOT: a locally-put small value's frames alias the
+        # caller's buffers, which user code may mutate while the
+        # coalesced reply flush is pending — live views could send
+        # torn bytes. Small objects only (large ones are IN_PLASMA).
         meta, frames = obj.to_wire()
         return {"found": True, "in_plasma": False, "metadata": meta,
                 "contained": [r.binary() for r in obj.contained_refs]}, frames
@@ -592,7 +598,7 @@ class CoreWorker:
         if serialized.total_bytes() <= self.config.max_direct_call_object_size:
             self.memory_store.put(oid, serialized)
             return
-        segment, size = write_segment(serialized)
+        segment, size = await self._write_segment_async(serialized)
         reply, _ = await self.raylet_conn.call("SealObject", {
             "object_id": oid.binary(), "segment": segment, "size": size,
             "pin": pin})
@@ -601,6 +607,34 @@ class CoreWorker:
                 f"object {oid.hex()} ({size} bytes) does not fit in the store")
         self.reference_counter.add_location(oid, reply["node_id"], size)
         self.memory_store.put(oid, IN_PLASMA)
+
+    async def _write_segment_async(self, serialized: SerializedObject):
+        """Zero-copy segment write: lease a recycled warm segment from
+        the raylet when one fits (AllocSegment — fresh tmpfs pages are
+        the dominant cost of a cold large put), and run the fill in an
+        executor thread so the IO loop keeps pumping while the
+        (GIL-releasing, striped) memcpy of a huge object runs. The plan
+        is computed once and shared with write_segment."""
+        plan = plan_segment(serialized)
+        size = plan[3]
+        alloc = None
+        if size >= RECYCLE_MIN_BYTES and self.raylet_conn is not None:
+            try:
+                reply, _ = await self.raylet_conn.call(
+                    "AllocSegment", {"size": size}, timeout=5)
+                if reply.get("found"):
+                    alloc = (reply["segment"], reply["size"])
+            except (ConnectionError, asyncio.TimeoutError):
+                pass  # fresh segment below — the lease is an optimization
+        if size >= RECYCLE_MIN_BYTES:
+            return await asyncio.get_running_loop().run_in_executor(
+                None, write_segment, serialized, alloc, plan)
+        return write_segment(serialized, alloc, plan)
+
+    def write_segment_sync(self, serialized: SerializedObject):
+        """Blocking variant for executor-pool callers (task returns in
+        the worker): same AllocSegment lease + direct-write pipeline."""
+        return self._run(self._write_segment_async(serialized))
 
     # ---------------------------------------------------------------- get
 

@@ -58,6 +58,104 @@ def load_fastpath():
         return _mod
 
 
+# --------------------------------------------------------------------------
+# Data-plane copy engine (zero-copy put pipeline).
+#
+# ``copy_into(dst, dst_off, src)`` is the single seam every bulk byte
+# move on the object-plane write path goes through (shm segment fills,
+# chunked node-to-node pull writes).  Native tier: the GIL-releasing C
+# memcpy in cpp/fastpath.c, with copies above ``parallel_copy_threshold``
+# striped across a small daemon thread pool so page faults on fresh shm
+# pages and the memcpy itself overlap across cores — and so a multi-GiB
+# put never parks every other driver thread behind the GIL.  Fallback:
+# one pure-Python ``memoryview[slice] = view`` assignment (still a
+# single C-level memcpy, just GIL-held and single-threaded).
+# --------------------------------------------------------------------------
+
+# Stripe size for splitting one huge copy across the pool. Kept small
+# enough that a 2 GiB frame becomes many stripes (tests shrink it via
+# RAY_TPU_COPY_CHUNK_MB to exercise the chunking path cheaply).
+COPY_CHUNK_BYTES = max(1, int(os.environ.get(
+    "RAY_TPU_COPY_CHUNK_MB", "16"))) * 1024 * 1024
+# Mild oversubscription on purpose: stripes alternate between faulting
+# pages (kernel time) and streaming copies, so 2x cores keeps the
+# memory bus busy (measured 9.5 vs 7.2 GB/s warm on the 2-core box).
+_COPY_THREADS = max(2, min(8, 2 * (os.cpu_count() or 1)))
+
+_copy_pool = None
+_copy_pool_lock = threading.Lock()
+
+# Observability (asserted by tests, reported by stores): how many bulk
+# copies ran native / striped / pure-Python.
+copy_stats = {"native": 0, "striped": 0, "fallback": 0}
+
+
+def have_native_copy() -> bool:
+    mod = load_fastpath()
+    return mod is not None and hasattr(mod, "copy_into")
+
+
+def _pool():
+    global _copy_pool
+    if _copy_pool is None:
+        with _copy_pool_lock:
+            if _copy_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                _copy_pool = ThreadPoolExecutor(
+                    max_workers=_COPY_THREADS,
+                    thread_name_prefix="rtpu-copy")
+    return _copy_pool
+
+
+def _as_byte_view(buf) -> memoryview:
+    """A flat uint8 view of any contiguous buffer, copy-free."""
+    mv = buf if isinstance(buf, memoryview) else memoryview(buf)
+    if mv.format != "B" or mv.ndim != 1:
+        mv = mv.cast("B")
+    return mv
+
+
+def copy_into(dst, dst_off: int, src, chunk_bytes: int | None = None) -> int:
+    """Copy all of ``src`` (any contiguous buffer) into ``dst`` at
+    ``dst_off``; returns bytes copied. Never materializes intermediate
+    ``bytes``. ``chunk_bytes`` overrides the stripe size (tests)."""
+    mod = load_fastpath()
+    native = mod.copy_into if mod is not None and \
+        hasattr(mod, "copy_into") else None
+    chunk = chunk_bytes or COPY_CHUNK_BYTES
+    if native is not None:
+        try:
+            src_view = src
+            # nbytes, never len(): len() counts ELEMENTS for array-like
+            # buffers (1/8 of the bytes for float64) — the C entry
+            # copies raw bytes
+            n = getattr(src, "nbytes", None)
+            if n is None:
+                n = len(src)
+            if n > chunk and _COPY_THREADS > 1:
+                # Stripe the copy: each worker's native call drops the
+                # GIL, so stripes genuinely overlap.
+                futs = [
+                    _pool().submit(native, dst, dst_off + off,
+                                   src_view, off,
+                                   min(chunk, n - off))
+                    for off in range(0, n, chunk)]
+                for f in futs:
+                    f.result()
+                copy_stats["striped"] += 1
+                return n
+            copied = native(dst, dst_off, src_view, 0, n)
+            copy_stats["native"] += 1
+            return copied
+        except (BufferError, TypeError, ValueError):
+            pass  # non-contiguous/exotic buffer: pure-Python path
+    sv = _as_byte_view(src)
+    dv = _as_byte_view(dst)
+    dv[dst_off:dst_off + sv.nbytes] = sv
+    copy_stats["fallback"] += 1
+    return sv.nbytes
+
+
 def _build_and_load():
     with open(_SRC, "rb") as f:
         src = f.read()

@@ -165,6 +165,7 @@ class Raylet:
             "KillActorWorker": self.handle_kill_actor_worker,
             "ActorExited": self.handle_actor_exited,
             "SealObject": self.handle_seal_object,
+            "AllocSegment": self.handle_alloc_segment,
             "GetObjectInfo": self.handle_get_object_info,
             "EnsureObjectLocal": self.handle_ensure_object_local,
             "FetchObjectChunk": self.handle_fetch_object_chunk,
@@ -934,11 +935,22 @@ class Raylet:
             self.store.pin(oid)
         return {"ok": ok, "node_id": self.node_id.binary()}
 
+    async def handle_alloc_segment(self, conn, header, bufs):
+        """Lease a recycled warm segment to a writing client (zero-copy
+        put pipeline): the client fills it and SealObject returns it to
+        the accounted tables. No lease -> the client creates a fresh
+        segment, exactly as before this RPC existed."""
+        got = self.store.take_recycled(int(header["size"]))
+        if got is None:
+            return {"found": False}
+        return {"found": True, "segment": got[0], "size": got[1]}
+
     async def handle_get_object_info(self, conn, header, bufs):
         oid = ObjectID(header["object_id"])
         segment = self.store.lookup(oid)
         if segment is None:
             return {"found": False}
+        self.store.mark_exposed(oid)  # caller may mmap the segment
         return {"found": True, "segment": segment}
 
     async def handle_pin_object(self, conn, header, bufs):
@@ -984,25 +996,47 @@ class Raylet:
         segment = self.store.lookup(oid)
         if segment is None:
             return {"found": False}
+        # a remote raylet is mid-pull: its future chunk reads must see
+        # this exact data, so the segment can never enter the recycle
+        # pool (an owner-side free mid-pull would otherwise let a new
+        # writer overwrite the still-linked file under the puller)
+        self.store.mark_exposed(oid)
         offset = header["offset"]
         length = header["length"]
         shm = self._serve_attachments.get(segment)
         if shm is None:
-            from multiprocessing import shared_memory
-            shm = shared_memory.SharedMemory(name=segment)
-            # bounded cache: drop the oldest attachment beyond 16
-            while len(self._serve_attachments) >= 16:
-                oldest = next(iter(self._serve_attachments))
-                old = self._serve_attachments.pop(oldest)
+            from ray_tpu._private.shm_store import _QuietSharedMemory
+            # _QuietSharedMemory: cache eviction below may race an
+            # in-flight chunk send whose memoryview still pins the
+            # mapping — deferred release absorbs that instead of
+            # leaking the fd on BufferError. Attached in an executor:
+            # the MAP_POPULATE remap of a GiB-scale segment must not
+            # stall the raylet loop.
+            new_shm = await asyncio.get_running_loop().run_in_executor(
+                None, _QuietSharedMemory, segment)
+            shm = self._serve_attachments.get(segment)
+            if shm is not None:  # raced a concurrent first chunk
                 try:
-                    old.close()
+                    new_shm.close()
                 except BufferError:
-                    pass  # a concurrent chunk read still holds the buf
-            self._serve_attachments[segment] = shm
+                    pass
+            else:
+                shm = new_shm
+                # bounded cache: drop the oldest attachment beyond 16
+                while len(self._serve_attachments) >= 16:
+                    oldest = next(iter(self._serve_attachments))
+                    old = self._serve_attachments.pop(oldest)
+                    try:
+                        old.close()
+                    except BufferError:
+                        pass  # a concurrent chunk read still holds it
+                self._serve_attachments[segment] = shm
         entry = self.store._objects.get(oid)  # noqa: SLF001
         total = entry[1] if entry is not None else shm.size
         end = min(offset + length, total)
-        data = bytes(shm.buf[offset:end]) if end > offset else b""
+        # zero-copy: the chunk rides to the socket as a live view of the
+        # mapped segment — node-to-node pushes never flatten to bytes
+        data = shm.buf[offset:end] if end > offset else b""
         return {"found": True, "total_size": total}, [data]
 
     async def handle_ensure_object_local(self, conn, header, bufs):
@@ -1013,7 +1047,12 @@ class Raylet:
 
     async def _ensure_local(self, oid: ObjectID, owner_address: str) -> dict:
         if self.store.contains(oid):
-            return {"ok": True, "segment": self.store.lookup(oid)}
+            segment = self.store.lookup(oid)
+            if segment is not None:
+                # the caller will mmap this segment: it can never be
+                # recycled (zero-copy views may outlive the free)
+                self.store.mark_exposed(oid)
+                return {"ok": True, "segment": segment}
         # Dedupe concurrent pulls of the same object (reference:
         # PullManager bundles many requests for one object into one pull).
         pull = self._active_pulls.get(oid)
@@ -1068,6 +1107,7 @@ class Raylet:
                         except Exception:  # noqa: BLE001
                             pass
                     asyncio.get_running_loop().create_task(_report())
+                self.store.mark_exposed(oid)  # caller is about to mmap
                 return {"ok": True, "segment": name}
         return {"ok": False, "reason": "object not found at any location"}
 
@@ -1092,15 +1132,22 @@ class Raylet:
             await asyncio.sleep(0.005)
         self._pull_inflight_bytes += total
         try:
-            from multiprocessing import shared_memory
-            import secrets as _secrets
-            from ray_tpu._private.shm_store import _untrack
-            name = f"rtpu_{_secrets.token_hex(8)}"
-            shm = shared_memory.SharedMemory(
-                name=name, create=True, size=max(total, 1))
-            _untrack(shm)  # created here; store owns its lifetime
+            from ray_tpu._private import native
+            from ray_tpu._private.shm_store import (
+                RECYCLE_MIN_BYTES, _close_segment_owner, acquire_segment)
+            # Destination: a recycled warm segment when the local store
+            # has one (page allocation dominates cold pull writes), else
+            # a fresh MAP_POPULATE mapping; chunk writes are
+            # GIL-releasing native copies either way.
+            alloc = self.store.take_recycled(total) \
+                if total >= RECYCLE_MIN_BYTES else None
+            loop = asyncio.get_running_loop()
+            # executor: a fresh multi-GiB MAP_POPULATE create would
+            # otherwise stall the raylet loop for the whole zero-fill
+            name, owner, buf = await loop.run_in_executor(
+                None, acquire_segment, alloc, max(total, 1))
             first = rbufs[0]
-            shm.buf[:len(first)] = first
+            native.copy_into(buf, 0, first)
             offsets = list(range(chunk, total, chunk))
             window = asyncio.Semaphore(8)
 
@@ -1111,9 +1158,8 @@ class Raylet:
                         "length": chunk})
                     if not r.get("found"):
                         raise ConnectionError("object vanished mid-pull")
-                    shm.buf[off:off + len(bufs2[0])] = bufs2[0]
+                    native.copy_into(buf, off, bufs2[0])
 
-            loop = asyncio.get_running_loop()
             tasks = [loop.create_task(_fetch_at(o)) for o in offsets]
             try:
                 if tasks:
@@ -1125,10 +1171,12 @@ class Raylet:
                 for t in tasks:
                     t.cancel()
                 await asyncio.gather(*tasks, return_exceptions=True)
-                shm.close()
+                _close_segment_owner(owner, buf)
+                self.store.release_lease(name)
                 self._unlink_segment(name)
                 return None
-            shm.close()
+            _close_segment_owner(owner, buf)
+            self.store.release_lease(name)  # sealed by the caller next
             return name, total
         finally:
             self._pull_inflight_bytes -= total

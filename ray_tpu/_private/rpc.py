@@ -84,11 +84,16 @@ DRAIN_HIGH_WATER = 4 * 1024 * 1024
 
 def _pack_msg(kind: int, seq: int, method: str, header: Any,
               bufs: Sequence[bytes]) -> List[bytes]:
+    """Frames are any buffer objects (bytes, or LIVE memoryviews on
+    the zero-copy data plane — pickle-5 frames, shm chunk slices);
+    they ride to the socket as-is, never flattened. Length framing
+    uses nbytes: len(memoryview) counts elements, not bytes."""
     body = msgpack.packb([kind, seq, method, header, len(bufs)],
                          use_bin_type=True)
     parts = [_U32.pack(len(body)), body]
     for b in bufs:
-        parts.append(_U64.pack(len(b)))
+        parts.append(_U64.pack(
+            b.nbytes if isinstance(b, memoryview) else len(b)))
         parts.append(b)
     return parts
 

@@ -6,6 +6,22 @@ so large contiguous buffers (numpy / jax host arrays) travel out-of-band and
 can be mapped zero-copy out of the shared-memory store on the receive side.
 ObjectRefs and actor handles embedded in values are intercepted so the
 ownership layer can record borrows.
+
+Single-memcpy put pipeline: serialization is the FIRST pass of a
+two-pass writer. ``serialize()`` keeps every out-of-band buffer as a
+live ``PickleBuffer`` (no flattening), ``frame_views()`` exposes them
+as raw uint8 memoryviews, and ``total_bytes()`` sums their sizes so
+``shm_store.write_segment`` can size the target segment exactly and
+copy each frame straight into the mapped memory — the payload is
+traversed ONCE, by one (GIL-releasing, possibly striped) memcpy per
+frame, and no intermediate ``bytes`` is ever materialized.  The same
+discipline holds on the wire: transient sends (inline task returns,
+owner GetObject replies, chunked node-to-node pushes) use
+``wire_frames()`` — buffer objects handed to the socket as-is —
+while ``to_wire()`` keeps its flattening-copy semantics for the few
+places that need a SNAPSHOT (by-value task args held for retries).
+The measured gap put-GB/s vs host-memcpy-GB/s is tracked per round by
+``bench.py`` (``put_vs_memcpy_ceiling``).
 """
 
 from __future__ import annotations
@@ -54,8 +70,40 @@ class SerializedObject:
                 total += f.nbytes
         return total
 
+    def frame_views(self) -> List[memoryview]:
+        """Raw flat uint8 views of every frame — the no-copy second
+        input of the two-pass writer. PickleBuffer frames resolve via
+        ``.raw()`` (guaranteed 1-D C-contiguous uint8); everything else
+        is wrapped/cast without touching the payload."""
+        out = []
+        for f in self.frames:
+            if isinstance(f, pickle.PickleBuffer):
+                out.append(f.raw())
+            else:
+                mv = f if isinstance(f, memoryview) else memoryview(f)
+                if mv.format != "B" or mv.ndim != 1:
+                    mv = mv.cast("B")
+                out.append(mv)
+        return out
+
+    def wire_frames(self) -> Tuple[bytes, List[Any]]:
+        """(metadata, frames) with frames as buffer objects (bytes or
+        live memoryviews) — no flattening copy. ONLY for sends whose
+        source buffers cannot mutate before the (deferred, coalesced)
+        transport flush: freshly pickled error payloads, sealed shm
+        segments. Anything aliasing user-mutable values (inline task
+        returns, memory-store replies) must snapshot via ``to_wire()``
+        instead — a live view there can send torn bytes."""
+        return self.metadata, [
+            f if isinstance(f, bytes) else v
+            for f, v in zip(self.frames, self.frame_views())]
+
     def to_wire(self) -> Tuple[bytes, List[bytes]]:
-        """Flatten to (metadata, [bytes...]) for the RPC layer."""
+        """Flatten to (metadata, [bytes...]): an owned SNAPSHOT,
+        decoupled from the source buffers (which the caller may mutate
+        later). Large-frame hot paths use ``wire_frames()`` /
+        ``frame_views()`` — this copying form is for frames that
+        outlive the call (by-value task args held for retries)."""
         out = []
         for f in self.frames:
             if isinstance(f, pickle.PickleBuffer):

@@ -33,7 +33,6 @@ from ray_tpu._private.memory_store import IN_PLASMA
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.serialization import (META_RAW, SerializedObject,
                                             format_task_error)
-from ray_tpu._private.shm_store import write_segment
 from ray_tpu._private.ids import return_object_id_bytes
 from ray_tpu._private.task_spec import (ARG_REF, ARG_VALUE, REPLY_ERROR,
                                         REPLY_OK, REPLY_STOLEN, TaskSpec)
@@ -437,7 +436,7 @@ class TaskExecutor:
                                e: BaseException):
         serialized = self.core.serialization_context.serialize_error(
             exc.RaySystemError(f"task execution failed in the worker: {e!r}"))
-        meta, frames = serialized.to_wire()
+        meta, frames = serialized.wire_frames()
         returns = []
         frames_out: List[bytes] = []
         for i in range(max(num_returns, 1)):
@@ -532,6 +531,12 @@ class TaskExecutor:
             serialized = self.core.serialization_context.serialize(result)
             if serialized.total_bytes() <= \
                     self.core.config.max_direct_call_object_size:
+                # SNAPSHOT, not live views: the reply flush is deferred
+                # (write coalescing / backpressure) and the next actor
+                # method may mutate the returned buffers in place —
+                # live frames would send torn data. Inline returns are
+                # <= max_direct_call_object_size, so the copy is cheap;
+                # the LARGE (plasma) path below stays zero-copy.
                 meta, frames = serialized.to_wire()
                 contained = [r.binary() for r in serialized.contained_refs]
                 if serialized.total_bytes() <= INLINE_RETURN_MAX:
@@ -562,12 +567,13 @@ class TaskExecutor:
             contained = [r.binary() for r in serialized.contained_refs]
             if serialized.total_bytes() <= \
                     self.core.config.max_direct_call_object_size:
+                # snapshot: see the single-return inline comment above
                 meta, frames = serialized.to_wire()
                 start = len(frames_out)
                 frames_out.extend(frames)
                 returns.append([oid_b, 0, meta, start, len(frames), contained])
             else:
-                segment, size = write_segment(serialized)
+                segment, size = self.core.write_segment_sync(serialized)
                 reply, _ = self.core._run(self.core.raylet_conn.call(
                     "SealObject", {"object_id": oid_b,
                                    "segment": segment, "size": size,
@@ -582,7 +588,7 @@ class TaskExecutor:
         serialized = self.core.serialization_context.serialize_error(error)
         returns = []
         frames_out: List[bytes] = []
-        meta, frames = serialized.to_wire()
+        meta, frames = serialized.wire_frames()
         for i in range(max(spec.num_returns, 1)):
             start = len(frames_out)
             frames_out.extend(frames)

@@ -269,6 +269,9 @@ class Dataset:
     def __init__(self, blocks: List):
         self._blocks = list(blocks)
         self._meta = None  # cached List[BlockMetadata]
+        # per-block row counts fetched incrementally by limit() (cheaper
+        # than materializing full _metadata for a prefix-only scan)
+        self._row_counts: dict = {}
 
     # ------------------------------------------------------------ meta
 
@@ -749,6 +752,21 @@ class GroupedDataset:
         self._ds = ds
         self._key = key
 
+    def _check_on(self, on, what: str) -> None:
+        """Driver-side validation: aggregating whole rows (on=None)
+        only makes sense for scalar rows. On a named-column dataset it
+        used to surface as a remote KeyError from inside a task — fail
+        here, with the fix spelled out."""
+        if on is not None:
+            return
+        schema = self._ds.schema()
+        if isinstance(schema, dict):
+            cols = ", ".join(repr(c) for c in schema)
+            raise ValueError(
+                f"groupby(...).{what} needs on=<column> for a dataset "
+                f"with named columns ({cols}): whole dict rows cannot "
+                f"be aggregated")
+
     def _agg_vec(self, agg: str, on: KeyType) -> "Dataset":
         part = _remote(_block_group_vec)
         partials = [part.remote(self._key, agg, on, b)
@@ -760,6 +778,7 @@ class GroupedDataset:
 
     def aggregate(self, agg_fn: Callable, *, on: Optional[Callable] = None,
                   init=None) -> "Dataset":
+        self._check_on(on, "aggregate(...)")
         part = _remote(_block_group)
         partials = [part.remote(self._key, agg_fn, on, b)
                     for b in self._ds._blocks]
@@ -774,6 +793,7 @@ class GroupedDataset:
         return self.aggregate(lambda a, b: a + b, on=lambda _: 1)
 
     def sum(self, on: KeyType = None) -> "Dataset":
+        self._check_on(on, "sum()")
         if _vec_key(self._key) and _vec_key(on):
             return self._agg_vec("sum", on)
         return self.aggregate(lambda a, b: a + b, on=on)

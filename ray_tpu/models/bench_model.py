@@ -138,8 +138,10 @@ def run(steps: int = 8) -> dict:
 
     sweep_rows = []
     best = None
+    baseline = None  # the FIXED first sweep point, emitted every round
     t_sweep0 = time.perf_counter()
     for B, policy in sweep_points:
+        is_baseline_point = (B, policy) == sweep_points[0]
         if time.perf_counter() - t_sweep0 > budget_s and best is not None:
             sweep_rows.append({"batch": B, "remat": policy,
                                "skipped": "sweep budget exhausted"})
@@ -149,12 +151,18 @@ def run(steps: int = 8) -> dict:
         try:
             dt, n_params = _time_train_config(cfg, pcfg, B, T, steps)
         except Exception as e:  # noqa: BLE001 — OOM et al.
-            sweep_rows.append({"batch": B, "remat": policy,
-                               "error": str(e)[:200]})
+            row_err = {"batch": B, "remat": policy,
+                       "error": str(e)[:200]}
+            sweep_rows.append(row_err)
+            if is_baseline_point:
+                baseline = dict(row_err)
             continue
         if dt <= 0:
-            sweep_rows.append({"batch": B, "remat": policy,
-                               "error": "unstable timing (delta <= 0)"})
+            row_err = {"batch": B, "remat": policy,
+                       "error": "unstable timing (delta <= 0)"}
+            sweep_rows.append(row_err)
+            if is_baseline_point:
+                baseline = dict(row_err)
             continue
         n_tokens = B * T
         dense_flops = 6.0 * n_params * n_tokens
@@ -171,6 +179,8 @@ def run(steps: int = 8) -> dict:
             row["peak_tflops"] = peak
             row["mfu"] = round(tflops / peak, 4)
         sweep_rows.append(dict(row))
+        if is_baseline_point:
+            baseline = dict(row)
         # rank by MFU; on device kinds without a peak-TFLOPs entry
         # fall back to raw throughput so the best point still wins
         key_of = lambda r: (r.get("mfu", 0.0),  # noqa: E731
@@ -182,6 +192,13 @@ def run(steps: int = 8) -> dict:
         out["mfu_sweep"] = sweep_rows
         return out
     out["train"] = best
+    # ``train`` floats to whichever sweep point won, so round-over-round
+    # BENCH_*.json comparisons need a FIXED configuration too:
+    # train_baseline is always sweep_points[0] (the r4 configuration),
+    # even when it errored or was skipped for budget.
+    out["train_baseline"] = baseline if baseline is not None else {
+        "batch": sweep_points[0][0], "remat": sweep_points[0][1],
+        "skipped": "sweep budget exhausted"}
     if len(sweep_rows) > 1:
         out["mfu_sweep"] = sweep_rows
 

@@ -38,7 +38,10 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     "target_noise": 0.2,          # target policy smoothing std
     "target_noise_clip": 0.5,
     "policy_delay": 2,            # critic updates per actor update
-    "tau": 0.005,
+    # Polyak runs only on delayed (every policy_delay-th) steps, so tau
+    # is doubled vs the per-step-update formulation to keep the same
+    # average target tracking rate.
+    "tau": 0.01,
     "buffer_size": 100_000,
     "learning_starts": 512,
     "train_batch_size": 256,
@@ -103,22 +106,46 @@ def _td3_update(params, target_params, opt_state, batches, key, *,
     def step(carry, inp):
         p, tp, opt_state, i = carry
         mb, k = inp
+        actor_step = i % policy_delay == 0
 
         def total_loss(p):
             c = critic_loss(p, tp, mb, k)
             # delayed policy updates: the actor term joins every
             # policy_delay-th step (lax.cond keeps one program)
-            a = jax.lax.cond(i % policy_delay == 0,
+            a = jax.lax.cond(actor_step,
                              lambda: actor_loss(p, mb),
                              lambda: 0.0)
             return c + a, c
 
         (loss, c), grads = jax.value_and_grad(
             total_loss, has_aux=True)(p)
-        updates, opt_state = optimizer.update(grads, opt_state, p)
-        p = optax.apply_updates(p, updates)
-        tp = jax.tree.map(lambda t, o: (1 - tau) * t + tau * o, tp, p)
-        return (p, tp, opt_state, i + 1), c
+        updates, new_opt_state = optimizer.update(grads, opt_state, p)
+        new_p = optax.apply_updates(p, updates)
+
+        # Critic-only steps must leave the actor ALONE: zero actor
+        # grads still produce nonzero adam updates (the first/second
+        # moments from past actor steps keep emitting deltas), so the
+        # actor params — and the actor's moment state — are held
+        # frozen between delayed updates.
+        def keep(new, old):
+            return jax.tree.map(
+                lambda n, o: jnp.where(actor_step, n, o), new, old)
+
+        new_p = dict(new_p, pi=keep(new_p["pi"], p["pi"]))
+        masked_state = []
+        for ns, os_ in zip(new_opt_state, opt_state):
+            if hasattr(ns, "mu") and hasattr(ns, "nu"):
+                ns = ns._replace(
+                    mu=dict(ns.mu, pi=keep(ns.mu["pi"], os_.mu["pi"])),
+                    nu=dict(ns.nu, pi=keep(ns.nu["pi"], os_.nu["pi"])))
+            masked_state.append(ns)
+        new_opt_state = tuple(masked_state)  # optax chain state
+        # Polyak target updates are delayed with the policy (Fujimoto
+        # et al. 2018: targets move every d-th step, not every step).
+        new_tp = jax.tree.map(
+            lambda t, o: (1 - tau) * t + tau * o, tp, new_p)
+        tp = keep(new_tp, tp)
+        return (new_p, tp, new_opt_state, i + 1), c
 
     n_steps = jax.tree.leaves(batches)[0].shape[0]
     keys = jax.random.split(key, n_steps)
