@@ -287,6 +287,23 @@ def main():
             "host_memcpy_gb_per_s": round(mem_gbps, 2),
             "put_vs_memcpy_ceiling": round(put_gbps / mem_gbps, 4),
             "stats_error": str(e)}
+    # raylint gate cost (ci/lint.sh): the whole-package static-analysis
+    # pass must stay under 10 s so it can gate every round — tracked
+    # here like any other hot-path budget.
+    _trace("lint runtime")
+    try:
+        from ray_tpu._private.lint import lint_paths
+        _t0 = time.perf_counter()
+        _lint_violations, _lint_files = lint_paths(
+            [os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "ray_tpu")])
+        _lint_wall = time.perf_counter() - _t0
+        lint_row = {"files": _lint_files,
+                    "violations": len(_lint_violations),
+                    "wall_s": round(_lint_wall, 2), "budget_s": 10.0,
+                    "within_budget": _lint_wall < 10.0}
+    except Exception as e:  # noqa: BLE001 — secondary row
+        lint_row = {"error": str(e)}
     _trace("columnar data")
     try:
         columnar_row = bench_columnar_data()
@@ -437,6 +454,7 @@ def main():
             "host_memcpy_gb_per_s": round(mem_gbps, 2),
             "put_vs_memcpy_ceiling": round(put_gbps / mem_gbps, 4),
             "zero_copy_put": zero_copy_put,
+            "lint_runtime": lint_row,
             "columnar_data_1m": columnar_row,
             "scalability": scalability,
             "million_drain": {

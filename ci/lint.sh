@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# raylint hard gate: whole-runtime static analysis over the package
+# (async-blocking, lock-discipline, rpc-contract, exception-hygiene,
+# shm-lifecycle — see ray_tpu/_private/lint/RULES.md). Runs next to
+# ci/sanitize.sh on every round; any violation fails CI.
+#
+# Local runs get the text report; CI (CI=1 or --json) also writes a
+# machine-readable artifact for the build system to attach.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+ARTIFACT="${RAYLINT_ARTIFACT:-/tmp/raylint-report.json}"
+
+if [ "${CI:-}" = "1" ] || [ "${1:-}" = "--json" ]; then
+    # JSON artifact + human summary; the gate is the exit code either way.
+    if python -m ray_tpu._private.lint --format json ray_tpu/ \
+            > "$ARTIFACT"; then
+        echo "raylint: clean (artifact: $ARTIFACT)"
+    else
+        rc=$?
+        echo "raylint: violations (artifact: $ARTIFACT)" >&2
+        python - "$ARTIFACT" <<'PY'
+import json, sys
+for v in json.load(open(sys.argv[1]))["violations"]:
+    print(f"{v['path']}:{v['line']}:{v['col']}: {v['rule']}: {v['message']}",
+          file=sys.stderr)
+PY
+        exit "$rc"
+    fi
+else
+    python -m ray_tpu._private.lint ray_tpu/
+fi

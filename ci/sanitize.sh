@@ -12,7 +12,12 @@
 #      (sys.getallocatedblocks steady-state — works on release builds
 #      where sys.gettotalrefcount does not exist),
 #   5. a put-bandwidth smoke: large puts through the instrumented
-#      zero-copy pipeline must record a NONZERO GB/s and roundtrip.
+#      zero-copy pipeline must record a NONZERO GB/s and roundtrip,
+#   6. a ThreadSanitizer pass over the threaded copy_into stripes: the
+#      fastpath is rebuilt with -fsanitize=thread and driven through
+#      native.copy_into's striping pool (several GIL-released memcpys
+#      of one destination in parallel); SKIP-clean when libtsan is
+#      absent, any TSAN report fails the step (halt_on_error=1).
 # Any ASAN/UBSAN report aborts the run (abort_on_error=1) and fails CI.
 # LeakSanitizer stays off: the interpreter's arena allocations at exit
 # are all false positives; the allocator steady-state check in step 4
@@ -31,16 +36,16 @@ export LD_PRELOAD="$LIBASAN"
 export ASAN_OPTIONS="detect_leaks=0:abort_on_error=1"
 export UBSAN_OPTIONS="print_stacktrace=1:halt_on_error=1"
 
-echo "== 1/5 fastpath parity suite (incl. copy_into) under ASAN+UBSAN =="
+echo "== 1/6 fastpath parity suite (incl. copy_into) under ASAN+UBSAN =="
 python -m pytest tests/test_fastpath.py -x -q
 
-echo "== 2/5 C++ msgpack codec + xlang client under ASAN+UBSAN =="
+echo "== 2/6 C++ msgpack codec + xlang client under ASAN+UBSAN =="
 python -m pytest tests/test_cross_language.py -x -q
 
-echo "== 3/5 100k drain + 4/5 allocator leak check =="
+echo "== 3/6 100k drain + 4/6 allocator leak check =="
 python ci/asan_drain.py
 
-echo "== 5/5 zero-copy put bandwidth smoke =="
+echo "== 5/6 zero-copy put bandwidth smoke =="
 JAX_PLATFORMS=cpu RAY_TPU_SCHEDULER_BACKEND=host python - <<'PY'
 import time
 import numpy as np
@@ -63,5 +68,32 @@ try:
 finally:
     ray_tpu.shutdown()
 PY
+
+echo "== 6/6 threaded copy_into stripes under TSAN =="
+LIBTSAN="$(cc -print-file-name=libtsan.so)"
+if [ ! -e "$LIBTSAN" ]; then
+    echo "SKIP: libtsan not found (toolchain without TSAN)" >&2
+else
+    # Scoped env: TSAN and ASAN runtimes cannot coexist in one
+    # process, and the tsan-tagged .so cache entry must not collide
+    # with the asan one (native.py tags them differently).
+    env LD_PRELOAD="$LIBTSAN" RAY_TPU_NATIVE_SANITIZE=tsan \
+        TSAN_OPTIONS="halt_on_error=1" JAX_PLATFORMS=cpu \
+        python - <<'PY'
+import numpy as np
+from ray_tpu._private import native
+
+mod = native.load_fastpath()
+assert mod is not None and hasattr(mod, "copy_into"), "native tier missing"
+n = 4 << 20
+src = np.frombuffer(np.random.bytes(n), dtype=np.uint8)
+dst = bytearray(n)
+for _ in range(2):  # 16 concurrent stripes per round through the pool
+    native.copy_into(dst, 0, src, chunk_bytes=256 << 10)
+assert bytes(dst) == src.tobytes(), "striped copy corrupted data"
+assert native.copy_stats["striped"] >= 2, native.copy_stats
+print("tsan stripes clean:", dict(native.copy_stats))
+PY
+fi
 
 echo "SANITIZE: all clean"

@@ -298,7 +298,7 @@ class CoreWorker:
         try:
             self._run(self._shutdown_async(), timeout=5)
         except Exception:
-            pass
+            logger.debug("async shutdown incomplete", exc_info=True)
         if self._loop_thread is not None:
             self._loop_thread.stop()
 
@@ -312,13 +312,15 @@ class CoreWorker:
                 await self.gcs_conn.call("MarkJobFinished",
                                          {"job_id": self.job_id}, timeout=2)
             except Exception:
-                pass
+                logger.debug("MarkJobFinished at shutdown failed",
+                             exc_info=True)
         for key_state in self.scheduling_keys.values():
             for lw in key_state.workers:
                 try:
                     await self._return_lease(lw)
                 except Exception:
-                    pass
+                    logger.debug("lease return at shutdown failed",
+                                 exc_info=True)
         await self._server.close()
         for conn in list(self._owner_conns.values()):
             await conn.close()
@@ -626,10 +628,24 @@ class CoreWorker:
                     alloc = (reply["segment"], reply["size"])
             except (ConnectionError, asyncio.TimeoutError):
                 pass  # fresh segment below — the lease is an optimization
-        if size >= RECYCLE_MIN_BYTES:
-            return await asyncio.get_running_loop().run_in_executor(
-                None, write_segment, serialized, alloc, plan)
-        return write_segment(serialized, alloc, plan)
+        try:
+            if size >= RECYCLE_MIN_BYTES:
+                return await asyncio.get_running_loop().run_in_executor(
+                    None, write_segment, serialized, alloc, plan)
+            return write_segment(serialized, alloc, plan)
+        except BaseException:
+            # Seal-or-abort: a failed fill must hand the lease back, or
+            # its pages sit in the store's _lent table until the stale
+            # sweep (raylint shm-lifecycle). Best-effort one-way push —
+            # the sweep remains the backstop if the raylet is gone.
+            if alloc is not None and self.raylet_conn is not None \
+                    and not self.raylet_conn.closed:
+                try:
+                    await self.raylet_conn.push(
+                        "AbortSegment", {"segment": alloc[0]})
+                except (ConnectionError, OSError):
+                    pass  # raylet gone; stale-lease sweep reclaims
+            raise
 
     def write_segment_sync(self, serialized: SerializedObject):
         """Blocking variant for executor-pool callers (task returns in
@@ -793,6 +809,7 @@ class CoreWorker:
             await asyncio.wait_for(asyncio.shield(waiter), timeout=30.0)
         except asyncio.TimeoutError:
             return False
+        # raylint: disable=async-blocking — awaited above: a done future's result() is a non-blocking read
         return bool(waiter.result())
 
     # ---------------------------------------------------------------- wait
@@ -808,8 +825,9 @@ class CoreWorker:
         async def _await_ready(ref):
             try:
                 await self._object_available(ref)
+            # raylint: disable=exception-hygiene — errored objects count as ready (get will raise)
             except Exception:
-                pass  # errored objects count as ready (get will raise)
+                pass
             return ref
 
         tasks = {asyncio.ensure_future(_await_ready(r)): r for r in pending}
@@ -1193,6 +1211,7 @@ class CoreWorker:
             if self.reference_counter.is_owned(oid):
                 try:
                     await self.memory_store.get(oid)
+                # raylint: disable=exception-hygiene — errored deps surface at the executing worker
                 except Exception:
                     pass
         self._queue_spec(spec)
@@ -2060,8 +2079,8 @@ class CoreWorker:
             try:
                 await self._gcs_call("ReportMetrics", {
                     "reporter_id": reporter, "snapshot": snap})
-            except Exception:  # noqa: BLE001 — GCS restarting
-                pass
+            except (ConnectionError, asyncio.TimeoutError):
+                pass  # GCS restarting; next period retries
 
     async def _handle_published(self, conn, header, bufs):
         if header["channel"] == "LOGS":
@@ -2110,7 +2129,8 @@ class CoreWorker:
             try:
                 from ray_tpu._private import shm_store
                 shm_store.sweep_zombies()
-            except Exception:  # noqa: BLE001 — maintenance must not die
+            # raylint: disable=exception-hygiene — maintenance loop must not die
+            except Exception:
                 pass
             if self._task_events and self.gcs_conn and not self.gcs_conn.closed:
                 events, self._task_events = self._task_events, []

@@ -320,13 +320,14 @@ class GcsServer:
                          str(len(body)).encode() +
                          b"\r\nConnection: close\r\n\r\n" + body)
             await writer.drain()
-        except Exception:  # noqa: BLE001 — malformed scrape
+        # raylint: disable=exception-hygiene — malformed scrape: HTTP endpoint must never take down the GCS
+        except Exception:
             pass
         finally:
             try:
                 writer.close()
-            except Exception:  # noqa: BLE001
-                pass
+            except OSError:
+                pass  # peer already gone
 
     async def _dashboard_api(self, path: str):
         """Dashboard-lite: JSON cluster state straight off the GCS

@@ -61,8 +61,8 @@ class GcsJournal:
     def close(self) -> None:
         try:
             self._f.close()
-        except Exception:  # noqa: BLE001
-            pass
+        except OSError:
+            pass  # journal file already closed
 
 
 def replay(path: str) -> Iterator[Tuple[str, Any]]:

@@ -1,0 +1,41 @@
+"""raylint: repo-specific static analysis for the ray_tpu control plane.
+
+The whole control plane (gcs.py, raylet.py, rpc.py, serve/) is
+single-threaded asyncio with string-dispatched RPC handlers and
+lock-guarded shared state — exactly the layer where hidden blocking and
+contention dominate task latency ("Runtime vs Scheduler: Analyzing
+Dask's Overheads", arxiv 2010.11105) and where the ownership/RPC
+contract must hold (Ray, arxiv 1712.05889). raylint machine-checks the
+invariants that previously lived as tribal knowledge:
+
+  async-blocking     no blocking calls on the event loop
+  lock-discipline    no await/sleep under a threading lock; acyclic
+                     cross-module lock acquisition graph
+  rpc-contract       every call()/push() method string resolves to a
+                     registered handler
+  exception-hygiene  no bare/silent exception swallowing on _private/
+  shm-lifecycle      every AllocSegment lease is sealed or aborted
+
+Usage:
+    python -m ray_tpu._private.lint ray_tpu/            # text report
+    python -m ray_tpu._private.lint --format json ray_tpu/
+    python -m ray_tpu._private.lint --list-rules
+
+Suppress a finding with a pragma on the flagged line or the line above:
+    # raylint: disable=<rule>[,<rule>...] — <why>
+or a whole file with:
+    # raylint: disable-file=<rule>[,<rule>...]
+
+Exit codes: 0 clean, 1 violations found, 2 usage/internal error.
+See RULES.md (next to this file) for the rule catalogue.
+"""
+
+from ray_tpu._private.lint.engine import (  # noqa: F401
+    Module,
+    Rule,
+    Violation,
+    all_rules,
+    lint_paths,
+    lint_sources,
+    register,
+)

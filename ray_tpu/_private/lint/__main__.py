@@ -1,0 +1,7 @@
+"""CLI entry point: ``python -m ray_tpu._private.lint <paths>``."""
+
+import sys
+
+from ray_tpu._private.lint.engine import main
+
+sys.exit(main())

@@ -1,0 +1,285 @@
+"""raylint engine: rule registry, file walking, pragmas, reporting.
+
+Rules are two-phase so whole-package contracts (rpc-contract's
+client-string vs handler-registration cross-check, lock-discipline's
+cross-module acquisition graph) see every module before judging:
+
+    rule.collect(module) -> per-module violations (and side tables)
+    rule.finalize()      -> cross-module violations
+
+Pragmas are line-anchored comments, honoured for a violation on the
+same line or the line directly above it:
+
+    # raylint: disable=<rule>[,<rule>...]
+    # raylint: disable-file=<rule>[,<rule>...]   (anywhere in the file)
+
+``disable=all`` suppresses every rule at that anchor. The engine (not
+individual rules) applies suppression, so finalize()-phase violations
+honour pragmas exactly like collect()-phase ones.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+import sys
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Type
+
+_PRAGMA_RE = re.compile(
+    r"#\s*raylint:\s*(disable(?:-file)?)\s*=\s*([A-Za-z0-9_,\- ]+)")
+
+# Directories never worth parsing (caches, build artifacts).
+_SKIP_DIRS = {"__pycache__", "_native_cache", ".git", "build", "dist"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str       # path as reported (relative to the scan root)
+    line: int       # 1-indexed
+    col: int        # 0-indexed (ast convention)
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Module:
+    """One parsed source file plus the lookup tables rules share."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.syntax_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            self.syntax_error = e
+        # line -> rules disabled at that line; "all" disables every rule
+        self.line_disables: Dict[int, Set[str]] = {}
+        self.file_disables: Set[str] = set()
+        for lineno, text in enumerate(self.lines, start=1):
+            m = _PRAGMA_RE.search(text)
+            if not m:
+                continue
+            # First whitespace-delimited token per comma piece: trailing
+            # justification text ("disable=r — why") never leaks into
+            # the rule name.
+            rules = {piece.split()[0] for piece in m.group(2).split(",")
+                     if piece.strip()}
+            if m.group(1) == "disable-file":
+                self.file_disables |= rules
+            else:
+                self.line_disables.setdefault(lineno, set()).update(rules)
+
+    def suppressed(self, v: Violation) -> bool:
+        if {"all", v.rule} & self.file_disables:
+            return True
+        for anchor in (v.line, v.line - 1):
+            rules = self.line_disables.get(anchor)
+            if rules and {"all", v.rule} & rules:
+                return True
+        return False
+
+
+class Rule:
+    """Base class. Subclasses set ``name`` and override collect()
+    (per-module) and optionally finalize() (cross-module)."""
+
+    name = ""
+    description = ""
+
+    def collect(self, module: Module) -> Iterable[Violation]:
+        return ()
+
+    def finalize(self) -> Iterable[Violation]:
+        return ()
+
+
+_REGISTRY: Dict[str, Type[Rule]] = {}
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    assert cls.name and cls.name not in _REGISTRY, cls
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def all_rules() -> Dict[str, Type[Rule]]:
+    # Import side effect registers the built-in rules exactly once.
+    from ray_tpu._private.lint import rules as _rules  # noqa: F401
+    return dict(_REGISTRY)
+
+
+# ------------------------------------------------------------- AST helpers
+
+def dotted_name(node: ast.AST) -> str:
+    """'a.b.c' for Name/Attribute chains, '' for anything dynamic."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        parts.append("?")  # call()/subscript[] etc. at the chain root
+    return ".".join(reversed(parts))
+
+
+def first_str_arg(call: ast.Call) -> Optional[str]:
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+def walk_functions(tree: ast.AST):
+    """Yield (funcdef, qualname, class_name) for every function/method,
+    including nested ones."""
+    stack: List[tuple] = [(tree, [], "")]
+    while stack:
+        node, quals, cls = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = quals + [child.name]
+                yield child, ".".join(q), cls
+                stack.append((child, q, cls))
+            elif isinstance(child, ast.ClassDef):
+                stack.append((child, quals + [child.name], child.name))
+            else:
+                stack.append((child, quals, cls))
+
+
+def body_nodes(func: ast.AST):
+    """Walk a function body WITHOUT descending into nested function or
+    class definitions (their bodies run in a different context)."""
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ----------------------------------------------------------------- driver
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+            out.extend(os.path.join(root, f)
+                       for f in sorted(files) if f.endswith(".py"))
+    return out
+
+
+def lint_modules(modules: List[Module],
+                 rule_names: Optional[Sequence[str]] = None
+                 ) -> List[Violation]:
+    registry = all_rules()
+    names = list(rule_names) if rule_names else sorted(registry)
+    unknown = [n for n in names if n not in registry]
+    if unknown:
+        raise ValueError(f"unknown rule(s): {', '.join(unknown)}; "
+                         f"known: {', '.join(sorted(registry))}")
+    rules = [registry[n]() for n in names]
+    by_path = {m.path: m for m in modules}
+    violations: List[Violation] = []
+    for m in modules:
+        if m.syntax_error is not None:
+            violations.append(Violation(
+                "syntax-error", m.path, m.syntax_error.lineno or 0, 0,
+                f"file does not parse: {m.syntax_error.msg}"))
+            continue
+        for rule in rules:
+            violations.extend(rule.collect(m))
+    for rule in rules:
+        violations.extend(rule.finalize())
+    violations = [v for v in violations
+                  if v.path not in by_path or not by_path[v.path].suppressed(v)]
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return violations
+
+
+def lint_paths(paths: Sequence[str],
+               rule_names: Optional[Sequence[str]] = None
+               ) -> tuple:
+    """Returns (violations, files_scanned)."""
+    files = iter_py_files(paths)
+    modules = []
+    for f in files:
+        with open(f, "r", encoding="utf-8", errors="replace") as fh:
+            modules.append(Module(f, fh.read()))
+    return lint_modules(modules, rule_names), len(files)
+
+
+def lint_sources(sources: Dict[str, str],
+                 rule_names: Optional[Sequence[str]] = None
+                 ) -> List[Violation]:
+    """Lint in-memory {path: source} — the test-fixture entry point."""
+    return lint_modules([Module(p, s) for p, s in sources.items()],
+                        rule_names)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu._private.lint",
+        description="raylint: static analysis for the ray_tpu control "
+                    "plane (see RULES.md for the rule catalogue)")
+    parser.add_argument("paths", nargs="*", default=[],
+                        help="files or directories to lint")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--rules", default="",
+                        help="comma-separated subset of rules to run")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for name, cls in sorted(all_rules().items()):
+            print(f"{name}: {cls.description}")
+        return 0
+    if not args.paths:
+        parser.print_usage(sys.stderr)
+        print("error: no paths given", file=sys.stderr)
+        return 2
+    for p in args.paths:
+        if not os.path.exists(p):
+            print(f"error: no such path: {p}", file=sys.stderr)
+            return 2
+    rule_names = [r.strip() for r in args.rules.split(",") if r.strip()] \
+        or None
+    try:
+        violations, nfiles = lint_paths(args.paths, rule_names)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(json.dumps({
+            "violations": [v.as_dict() for v in violations],
+            "files_scanned": nfiles,
+            "rules": rule_names or sorted(all_rules()),
+        }, indent=2))
+    else:
+        for v in violations:
+            print(v.render())
+        status = "clean" if not violations else \
+            f"{len(violations)} violation(s)"
+        print(f"raylint: {nfiles} file(s), {status}")
+    return 1 if violations else 0

@@ -1,0 +1,10 @@
+"""Built-in raylint rules. Importing this package registers them all
+with the engine registry (each module calls ``engine.register``)."""
+
+from ray_tpu._private.lint.rules import (  # noqa: F401
+    async_blocking,
+    exception_hygiene,
+    lock_discipline,
+    rpc_contract,
+    shm_lifecycle,
+)

@@ -1,0 +1,140 @@
+"""async-blocking: the control-plane event loop must never block.
+
+Everything in gcs.py/raylet.py/core_worker.py/serve runs on ONE asyncio
+loop per process; a single ``time.sleep`` or synchronous I/O call inside
+an ``async def`` stalls every heartbeat, lease grant, and object
+transfer sharing that loop (this is exactly the hidden-blocking class
+"Runtime vs Scheduler" measures dominating Dask task latency).
+
+Flags, inside ``async def`` bodies (nested sync defs/lambdas excluded —
+they may legitimately run on executor threads):
+
+  * known blocking calls: ``time.sleep``, subprocess spawns/waits,
+    ``os.system``, blocking socket/DNS helpers, ``urllib`` fetches;
+  * ``<x>.result()`` with no args — a concurrent.futures-style blocking
+    join (asyncio futures want ``await``);
+  * builtin ``open()`` — synchronous file I/O on the loop;
+  * ``pickle/cloudpickle.dumps/loads`` — serialization is unbounded in
+    the argument size and runs under the GIL on the loop.
+
+Also flags ``time.sleep`` inside a loop body of a SYNC function (a
+sleep-poll): such helpers are routinely reachable from async contexts
+(async actors calling driver APIs), where they stall the actor's loop.
+Intentional driver-thread polls carry a pragma with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ray_tpu._private.lint.engine import (
+    Module, Rule, Violation, body_nodes, dotted_name, register,
+    walk_functions,
+)
+
+# Dotted-name suffixes that always block the calling thread.
+BLOCKING_CALLS = {
+    "time.sleep": "blocks the event loop; use asyncio.sleep",
+    "subprocess.run": "blocking subprocess wait on the loop",
+    "subprocess.call": "blocking subprocess wait on the loop",
+    "subprocess.check_call": "blocking subprocess wait on the loop",
+    "subprocess.check_output": "blocking subprocess wait on the loop",
+    "subprocess.getoutput": "blocking subprocess wait on the loop",
+    "os.system": "blocking subprocess wait on the loop",
+    "os.waitpid": "blocking process wait on the loop",
+    "socket.create_connection": "blocking connect on the loop",
+    "socket.gethostbyname": "blocking DNS resolution on the loop",
+    "socket.getaddrinfo": "blocking DNS resolution on the loop",
+    "urllib.request.urlopen": "blocking HTTP fetch on the loop",
+}
+
+SERIALIZE_CALLS = {
+    "pickle.dumps", "pickle.loads", "pickle.load", "pickle.dump",
+    "cloudpickle.dumps", "cloudpickle.loads",
+}
+
+
+@register
+class AsyncBlockingRule(Rule):
+    name = "async-blocking"
+    description = ("blocking calls (sleep/subprocess/IO/.result()/pickle) "
+                   "inside async def bodies, and sleep-polls in sync code")
+
+    def collect(self, module: Module) -> Iterable[Violation]:
+        out: List[Violation] = []
+        for func, qualname, _cls in walk_functions(module.tree):
+            is_async = isinstance(func, ast.AsyncFunctionDef)
+            loop_depth_nodes = _loop_body_nodes(func)
+            for node in body_nodes(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if is_async:
+                    v = self._check_async_call(module, qualname, node, name)
+                    if v is not None:
+                        out.append(v)
+                elif name.endswith("time.sleep") or name == "sleep" and \
+                        _imported_from_time(module):
+                    if id(node) in loop_depth_nodes:
+                        out.append(Violation(
+                            self.name, module.path, node.lineno,
+                            node.col_offset,
+                            f"sleep-poll loop in sync `{qualname}`: "
+                            "time.sleep in a loop stalls any async caller "
+                            "— convert to asyncio.sleep on the IO loop or "
+                            "annotate why the blocking is intentional"))
+        return out
+
+    def _check_async_call(self, module, qualname, node, name):
+        for pat, why in BLOCKING_CALLS.items():
+            if name == pat or name.endswith("." + pat):
+                return Violation(
+                    self.name, module.path, node.lineno, node.col_offset,
+                    f"`{name}` inside async def `{qualname}`: {why}")
+        if name in SERIALIZE_CALLS or \
+                any(name.endswith("." + s) for s in SERIALIZE_CALLS):
+            return Violation(
+                self.name, module.path, node.lineno, node.col_offset,
+                f"`{name}` inside async def `{qualname}`: pickling holds "
+                "the GIL on the loop for time unbounded in the payload "
+                "size — move to an executor or bound the payload")
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "result" and not node.args \
+                and not node.keywords:
+            base = dotted_name(node.func.value)
+            return Violation(
+                self.name, module.path, node.lineno, node.col_offset,
+                f"`{base}.result()` inside async def `{qualname}`: a "
+                "blocking future join on the loop deadlocks if the "
+                "result is produced by this same loop — await it")
+        if isinstance(node.func, ast.Name) and node.func.id == "open":
+            return Violation(
+                self.name, module.path, node.lineno, node.col_offset,
+                f"`open()` inside async def `{qualname}`: synchronous "
+                "file I/O on the loop — move to an executor")
+        return None
+
+
+def _loop_body_nodes(func) -> set:
+    """ids of nodes that sit inside a while/for loop body of ``func``
+    (not crossing nested function boundaries)."""
+    ids = set()
+    for node in body_nodes(func):
+        if isinstance(node, (ast.While, ast.For)):
+            stack = list(node.body) + list(node.orelse)
+            while stack:
+                n = stack.pop()
+                ids.add(id(n))
+                if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda, ast.ClassDef)):
+                    stack.extend(ast.iter_child_nodes(n))
+    return ids
+
+
+def _imported_from_time(module: Module) -> bool:
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time" and \
+                any(a.name == "sleep" for a in node.names):
+            return True
+    return False

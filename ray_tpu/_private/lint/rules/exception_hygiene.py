@@ -1,0 +1,73 @@
+"""exception-hygiene: no silent error-swallowing on control-plane paths.
+
+Ownership bugs hide behind ``except: pass``: a failed location update
+or dropped borrower registration surfaces hours later as an object
+"lost" with no trail. On ``_private/`` (the control plane) this rule
+rejects:
+
+  * bare ``except:`` — catches SystemExit/KeyboardInterrupt too;
+  * ``except Exception:`` / ``except BaseException:`` (alone or in a
+    tuple) whose body is only ``pass``/``...`` — swallow-with-no-trace.
+
+Catching Exception and logging (or re-raising, or replying with the
+error) is fine; catching NARROW exception types with ``pass`` is fine
+(e.g. ``except FileNotFoundError: pass``). Genuinely-benign broad
+swallows carry a pragma with the reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ray_tpu._private.lint.engine import (
+    Module, Rule, Violation, dotted_name, register,
+)
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(type_node: ast.AST) -> bool:
+    if type_node is None:
+        return True
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(e) for e in type_node.elts)
+    return dotted_name(type_node).rsplit(".", 1)[-1] in _BROAD
+
+
+def _is_silent(body: List[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and \
+                isinstance(stmt.value, ast.Constant) and \
+                stmt.value.value is ...:
+            continue
+        return False
+    return True
+
+
+@register
+class ExceptionHygieneRule(Rule):
+    name = "exception-hygiene"
+    description = ("bare `except:` and silent `except Exception: pass` "
+                   "on _private/ control-plane paths")
+
+    def collect(self, module: Module) -> Iterable[Violation]:
+        if "_private" not in module.path.replace("\\", "/"):
+            return ()
+        out: List[Violation] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                out.append(Violation(
+                    self.name, module.path, node.lineno, node.col_offset,
+                    "bare `except:` catches SystemExit/KeyboardInterrupt "
+                    "— name the exception types"))
+            elif _is_broad(node.type) and _is_silent(node.body):
+                out.append(Violation(
+                    self.name, module.path, node.lineno, node.col_offset,
+                    "`except Exception: pass` silently swallows control-"
+                    "plane errors — log, reply, or narrow the type"))
+        return out

@@ -1,0 +1,170 @@
+"""lock-discipline: what may happen while a threading lock is held.
+
+The stores (shm_store, memory_store, metrics) guard shared state with
+``threading.Lock`` while the control plane is asyncio: an ``await``
+under a held sync lock parks the coroutine WITH the lock taken, so
+every other thread (store writers, metrics scrapers) wedges until the
+event loop happens to resume it — a latent priority inversion that
+only ever surfaces as a flaky timeout. Sleeping under a lock is the
+same bug with a fixed duration.
+
+Checks (sync ``with <lock>:`` blocks only — ``async with`` an asyncio
+lock is the normal way to await under mutual exclusion):
+
+  * no ``await`` anywhere in the guarded block;
+  * no ``time.sleep`` / known-blocking call in the guarded block;
+  * nested acquisition of the SAME lock name (threading.Lock is not
+    reentrant — this deadlocks immediately);
+  * the cross-module lock acquisition graph (edges from syntactic
+    nesting ``with A: ... with B:``) must be acyclic. Lock identity is
+    ``module.Class.attr`` so the ordering that today lives as tribal
+    knowledge (shm_store holds 2 locks, metrics 3) is machine-checked.
+
+A with-item counts as a lock when its terminal name contains "lock" or
+"mutex" (``self._lock``, ``_zombie_lock``, ``_GLOBAL_LOCK``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Set, Tuple
+
+from ray_tpu._private.lint.engine import (
+    Module, Rule, Violation, dotted_name, register, walk_functions,
+)
+from ray_tpu._private.lint.rules.async_blocking import BLOCKING_CALLS
+
+_LOCKY = ("lock", "mutex")
+
+
+def _lock_name(expr: ast.AST) -> str:
+    """'' unless the with-item expression looks like a lock."""
+    name = dotted_name(expr)
+    terminal = name.rsplit(".", 1)[-1].lower()
+    if any(t in terminal for t in _LOCKY):
+        return name
+    return ""
+
+
+# Generic lock attribute names: presumed class-local (every store has a
+# `self._lock`), so their identity is scoped to module.Class. Anything
+# more distinctive (`_zombie_lock`, `_GLOBAL_LOCK`, `_attached_lock`)
+# names ONE conceptual lock wherever it is referenced — that unification
+# is what makes the acquisition graph cross-module.
+_GENERIC = {"lock", "_lock", "mutex", "_mutex"}
+
+
+def _lock_identity(module: Module, cls: str, name: str) -> str:
+    attr = name.rsplit(".", 1)[-1]
+    if attr not in _GENERIC:
+        return attr
+    mod = os.path.basename(module.path)[:-3]
+    if name.startswith("self.") and cls:
+        return f"{mod}.{cls}.{attr}"
+    return f"{mod}.{attr}"
+
+
+@register
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    description = ("await/blocking calls under a held threading lock; "
+                   "reentrant self-acquisition; lock-order cycles "
+                   "across the package")
+
+    def __init__(self):
+        # identity -> identity -> (path, line) witness of A held while
+        # taking B; cycles judged in finalize() over all modules.
+        self.edges: Dict[str, Dict[str, Tuple[str, int]]] = {}
+
+    def collect(self, module: Module) -> Iterable[Violation]:
+        out: List[Violation] = []
+        for func, qualname, cls in walk_functions(module.tree):
+            for node in ast.iter_child_nodes(func):
+                self._scan(module, cls, qualname, node, held=[], out=out)
+        return out
+
+    def _scan(self, module, cls, qualname, node, held, out):
+        """DFS that tracks the stack of held lock identities; stops at
+        nested function/class boundaries (new execution context)."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(node, ast.Await) and held:
+            out.append(Violation(
+                self.name, module.path, node.lineno, node.col_offset,
+                f"await while holding `{held[-1][0]}` in `{qualname}`: "
+                "the coroutine parks with the threading lock taken, "
+                "wedging every other thread that needs it"))
+        if isinstance(node, ast.Call) and held:
+            name = dotted_name(node.func)
+            for pat, _why in BLOCKING_CALLS.items():
+                if name == pat or name.endswith("." + pat):
+                    out.append(Violation(
+                        self.name, module.path, node.lineno,
+                        node.col_offset,
+                        f"blocking `{name}` while holding "
+                        f"`{held[-1][0]}` in `{qualname}`"))
+                    break
+        if isinstance(node, ast.With):
+            acquired = []
+            for item in node.items:
+                lname = _lock_name(item.context_expr)
+                if not lname:
+                    continue
+                ident = _lock_identity(module, cls, lname)
+                if any(h[1] == ident for h in held):
+                    out.append(Violation(
+                        self.name, module.path, node.lineno,
+                        node.col_offset,
+                        f"nested acquisition of `{lname}` in "
+                        f"`{qualname}`: threading.Lock is not reentrant "
+                        "— this deadlocks"))
+                for _hname, hident, _hpath, _hline in held:
+                    if hident != ident:  # self-edges are the reentrancy
+                        self.edges.setdefault(hident, {}).setdefault(
+                            ident, (module.path, node.lineno))
+                acquired.append((lname, ident, module.path, node.lineno))
+            held = held + acquired
+            for child in node.body:
+                self._scan(module, cls, qualname, child, held, out)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._scan(module, cls, qualname, child, held, out)
+
+    def finalize(self) -> Iterable[Violation]:
+        out: List[Violation] = []
+        # DFS cycle detection over the acquisition graph.
+        WHITE, GREY, BLACK = 0, 1, 2
+        color = {n: WHITE for n in self.edges}
+        stack_trace: List[str] = []
+
+        def visit(n) -> List[str]:
+            color[n] = GREY
+            stack_trace.append(n)
+            for succ in self.edges.get(n, {}):
+                c = color.get(succ, WHITE)
+                if c == GREY:
+                    return stack_trace[stack_trace.index(succ):] + [succ]
+                if c == WHITE:
+                    cyc = visit(succ)
+                    if cyc:
+                        return cyc
+            stack_trace.pop()
+            color[n] = BLACK
+            return []
+
+        for n in list(self.edges):
+            if color.get(n, WHITE) == WHITE:
+                del stack_trace[:]
+                cyc = visit(n)
+                if cyc:
+                    a, b = cyc[0], cyc[1]
+                    path, line = self.edges[a][b]
+                    out.append(Violation(
+                        self.name, path, line, 0,
+                        "lock acquisition cycle: "
+                        + " -> ".join(cyc)
+                        + " — a consistent cross-module lock order is "
+                        "required (see RULES.md)"))
+        return out

@@ -1,0 +1,93 @@
+"""shm-lifecycle: every AllocSegment lease ends in seal-or-abort.
+
+The zero-copy put pipeline (PR 1) leases recycled warm segments from
+the store (``AllocSegment`` RPC / ``take_recycled()``). A lease that is
+neither sealed (``SealObject`` / ``store.seal``) nor aborted
+(``AbortSegment`` / ``release_lease`` / ``abort_lease``) parks tmpfs
+pages in the store's ``_lent`` table until the 600 s stale-lease sweep
+— under put churn that is real memory pressure, and a writer that
+errors between lease and seal used to do exactly that.
+
+For every function that ACQUIRES a lease — a literal
+``call("AllocSegment", ...)`` or a ``take_recycled(...)`` call — the
+rule requires, in the same function:
+
+  * some reference to the seal-or-abort machinery: ``SealObject`` /
+    ``AbortSegment`` method strings, or ``seal`` / ``release_lease`` /
+    ``abort_lease`` / ``_unlink`` / the ``write_segment*`` pipeline
+    (which adopts the lease and whose callers own the seal); AND
+  * a ``try`` statement, so the abort half actually covers the error
+    exit paths, not just the straight line.
+
+Handing the lease to a remote writer (the raylet's AllocSegment
+handler returns it over RPC) is a deliberate transfer of the
+obligation — annotate such sites with a pragma naming the new owner.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ray_tpu._private.lint.engine import (
+    Module, Rule, Violation, body_nodes, dotted_name, first_str_arg,
+    register, walk_functions,
+)
+
+_ACQUIRE_STRINGS = {"AllocSegment"}
+_ACQUIRE_ATTRS = {"take_recycled"}
+_SEAL_STRINGS = {"SealObject", "AbortSegment"}
+_SEAL_NAMES = {"seal", "release_lease", "abort_lease", "_unlink",
+               "write_segment", "write_segment_sync", "acquire_segment",
+               "_acquire_segment_fd"}
+
+
+@register
+class ShmLifecycleRule(Rule):
+    name = "shm-lifecycle"
+    description = ("AllocSegment/take_recycled lease sites must pair "
+                   "with seal-or-abort on all exit paths")
+
+    def collect(self, module: Module) -> Iterable[Violation]:
+        out: List[Violation] = []
+        for func, qualname, _cls in walk_functions(module.tree):
+            acquires = []
+            has_seal = False
+            has_try = False
+            for node in body_nodes(func):
+                if isinstance(node, ast.Try):
+                    has_try = True
+                elif isinstance(node, ast.Constant) and \
+                        node.value in _SEAL_STRINGS:
+                    has_seal = True
+                elif isinstance(node, (ast.Name, ast.Attribute)):
+                    terminal = dotted_name(node).rsplit(".", 1)[-1]
+                    if terminal in _SEAL_NAMES:
+                        has_seal = True
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = dotted_name(node.func)
+                terminal = fname.rsplit(".", 1)[-1]
+                if terminal in _ACQUIRE_ATTRS or (
+                        terminal in {"call", "call_nowait"} and
+                        first_str_arg(node) in _ACQUIRE_STRINGS):
+                    acquires.append(node)
+            for node in acquires:
+                if not has_seal:
+                    out.append(Violation(
+                        self.name, module.path, node.lineno,
+                        node.col_offset,
+                        "segment lease acquired here but no seal "
+                        "(SealObject/seal) or abort (AbortSegment/"
+                        "release_lease/abort_lease) in this function — "
+                        "a failed write parks the lease until the "
+                        "stale sweep"))
+                elif not has_try:
+                    out.append(Violation(
+                        self.name, module.path, node.lineno,
+                        node.col_offset,
+                        "segment lease acquired without a try block: "
+                        "the seal-or-abort must also cover the ERROR "
+                        "exit paths (wrap the fill in try/except and "
+                        "abort the lease on failure)"))
+        return out

@@ -161,8 +161,9 @@ def _build_and_load():
         src = f.read()
     tag = "%s-%s" % (hashlib.sha256(src).hexdigest()[:12],
                      sys.implementation.cache_tag)
-    if os.environ.get("RAY_TPU_NATIVE_SANITIZE"):
-        tag += "-san"
+    san = os.environ.get("RAY_TPU_NATIVE_SANITIZE")
+    if san:
+        tag += "-tsan" if san == "tsan" else "-san"
     so_path = os.path.join(_CACHE_DIR, "_rtpu_fastpath-%s.so" % tag)
     if not os.path.exists(so_path):
         _compile(so_path)
@@ -186,7 +187,12 @@ def _compile(so_path: str) -> None:
         tmp = so_path + ".tmp.%d" % os.getpid()
         cmd = [cc, "-O2", "-fPIC", "-shared", "-I", include, _SRC,
                "-o", tmp]
-        if os.environ.get("RAY_TPU_NATIVE_SANITIZE"):
+        san = os.environ.get("RAY_TPU_NATIVE_SANITIZE")
+        if san == "tsan":
+            # ci/sanitize.sh step 6: TSAN tier for the threaded
+            # copy_into stripes (needs LD_PRELOADed libtsan).
+            cmd[1:1] = ["-g", "-fsanitize=thread"]
+        elif san:
             # ci/sanitize.sh: ASAN+UBSAN instrumented tier (needs
             # LD_PRELOADed libasan in the hosting interpreter).
             cmd[1:1] = ["-g", "-fsanitize=address,undefined",

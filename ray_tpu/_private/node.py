@@ -118,7 +118,7 @@ class Node:
         try:
             self._loop_thread.run(_stop(), timeout=10)
         except Exception:
-            pass
+            logger.debug("node stop incomplete", exc_info=True)
         self._loop_thread.stop()
         self._loop_thread = None
         if self._owns_session_dir and not os.environ.get("RAY_TPU_KEEP_SESSION_DIR"):
@@ -170,6 +170,7 @@ def main(argv=None):
 
     try:
         while True:
+            # raylint: disable=async-blocking — head daemon main thread parks forever; all work is on the IO loop thread
             time.sleep(3600)
     except KeyboardInterrupt:
         node.stop()

@@ -51,6 +51,7 @@ class ObjectRef:
         if worker is not None:
             try:
                 worker.queue_local_decref(self.object_id)
+            # raylint: disable=exception-hygiene — __del__ during interpreter teardown: anything may be half-dead
             except Exception:
                 pass
 

@@ -48,6 +48,23 @@ from ray_tpu._private.shm_store import ShmStoreServer
 
 logger = logging.getLogger(__name__)
 
+def _read_file_chunk(path: str, pos: int, limit: int = 256 * 1024) -> bytes:
+    """Bounded read at an offset — executor-thread helper so the log
+    monitor never does file I/O on the event loop."""
+    with open(path, "rb") as f:
+        f.seek(pos)
+        return f.read(limit)
+
+
+def _read_file_tail(path: str, limit: int) -> bytes:
+    """Last ``limit`` bytes of a file (executor-thread helper)."""
+    with open(path, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        f.seek(max(0, size - limit))
+        return f.read()
+
+
 WORKER_IDLE = "idle"
 WORKER_LEASED = "leased"
 WORKER_ACTOR = "actor"
@@ -166,6 +183,7 @@ class Raylet:
             "ActorExited": self.handle_actor_exited,
             "SealObject": self.handle_seal_object,
             "AllocSegment": self.handle_alloc_segment,
+            "AbortSegment": self.handle_abort_segment,
             "GetObjectInfo": self.handle_get_object_info,
             "EnsureObjectLocal": self.handle_ensure_object_local,
             "FetchObjectChunk": self.handle_fetch_object_chunk,
@@ -261,9 +279,10 @@ class Raylet:
                 path = os.path.join(log_dir, name)
                 pos = offsets.get(name, 0)
                 try:
-                    with open(path, "rb") as f:
-                        f.seek(pos)
-                        chunk = f.read(256 * 1024)
+                    # Off-loop read: log files live on local disk, and a
+                    # cold-cache 256 KiB read can stall the loop for ms.
+                    chunk = await asyncio.get_running_loop() \
+                        .run_in_executor(None, _read_file_chunk, path, pos)
                 except OSError:
                     continue
                 if not chunk:
@@ -324,7 +343,8 @@ class Raylet:
             out["host_disk_total_bytes"] = float(du.total)
             proc = psutil.Process()
             out["raylet_rss_bytes"] = float(proc.memory_info().rss)
-        except Exception:  # noqa: BLE001 — stats are best-effort
+        # raylint: disable=exception-hygiene — host stats are best-effort decoration
+        except Exception:
             pass
         # NOTE: latency percentiles are deliberately NOT computed here —
         # sorting a 64k reservoir 4x/s on the event loop would stall
@@ -528,8 +548,8 @@ class Raylet:
             except (ProcessLookupError, PermissionError, OSError):
                 try:
                     handle.proc.kill()
-                except Exception:
-                    pass
+                except OSError:
+                    pass  # process already gone
 
     # -------------------------------------------------------------- leases
 
@@ -940,10 +960,18 @@ class Raylet:
         put pipeline): the client fills it and SealObject returns it to
         the accounted tables. No lease -> the client creates a fresh
         segment, exactly as before this RPC existed."""
+        # raylint: disable=shm-lifecycle — lease ownership transfers to the remote writer: SealObject/AbortSegment (or the stale sweep) closes it
         got = self.store.take_recycled(int(header["size"]))
         if got is None:
             return {"found": False}
         return {"found": True, "segment": got[0], "size": got[1]}
+
+    async def handle_abort_segment(self, conn, header, bufs):
+        """Abort half of the lease protocol: a writer whose fill failed
+        hands the segment straight back (one-way push) instead of
+        leaving it parked in _lent until the 600 s stale sweep."""
+        self.store.abort_lease(header["segment"])
+        return {"ok": True}
 
     async def handle_get_object_info(self, conn, header, bufs):
         oid = ObjectID(header["object_id"])
@@ -978,7 +1006,8 @@ class Raylet:
             try:
                 peer = await self._peer_conn(info["address"])
                 await peer.call("FreeObject", {"object_id": oid.binary()})
-            except Exception:  # noqa: BLE001 — best-effort per peer
+            # raylint: disable=exception-hygiene — best-effort per peer; owner re-frees on next GC pass
+            except Exception:
                 pass
 
         peers = [nid for nid in header.get("locations", [])
@@ -1104,7 +1133,8 @@ class Raylet:
                                 # owner already released the object —
                                 # drop our replica
                                 self.store.free(oid)
-                        except Exception:  # noqa: BLE001
+                        # raylint: disable=exception-hygiene — owner may be gone; replica already dropped
+                        except Exception:
                             pass
                     asyncio.get_running_loop().create_task(_report())
                 self.store.mark_exposed(oid)  # caller is about to mmap
@@ -1188,8 +1218,8 @@ class Raylet:
             shm = shared_memory.SharedMemory(name=name)
             shm.close()
             shm.unlink()
-        except Exception:  # noqa: BLE001 — already gone
-            pass
+        except OSError:
+            pass  # segment already unlinked
 
     async def _peer_conn(self, address: str) -> rpc.Connection:
         conn = self._peer_raylets.get(address)
@@ -1362,12 +1392,10 @@ class Raylet:
                     "files": [{"name": f} for f in files]}
         path = os.path.join(log_dir, matches[0])
         try:
-            with open(path, "rb") as f:
-                f.seek(0, os.SEEK_END)
-                size = f.tell()
-                f.seek(max(0, size - 256 * 1024))
-                lines = f.read().decode(
-                    "utf-8", errors="replace").splitlines()[-tail:]
+            data = await asyncio.get_running_loop().run_in_executor(
+                None, _read_file_tail, path, 256 * 1024)
+            lines = data.decode("utf-8", errors="replace") \
+                .splitlines()[-tail:]
         except OSError as e:
             return {"error": str(e)}
         return {"name": matches[0], "lines": lines}

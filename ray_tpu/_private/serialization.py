@@ -14,12 +14,15 @@ as raw uint8 memoryviews, and ``total_bytes()`` sums their sizes so
 ``shm_store.write_segment`` can size the target segment exactly and
 copy each frame straight into the mapped memory — the payload is
 traversed ONCE, by one (GIL-releasing, possibly striped) memcpy per
-frame, and no intermediate ``bytes`` is ever materialized.  The same
-discipline holds on the wire: transient sends (inline task returns,
-owner GetObject replies, chunked node-to-node pushes) use
-``wire_frames()`` — buffer objects handed to the socket as-is —
-while ``to_wire()`` keeps its flattening-copy semantics for the few
-places that need a SNAPSHOT (by-value task args held for retries).
+frame, and no intermediate ``bytes`` is ever materialized.  On the
+wire, ``wire_frames()`` (buffer objects handed to the socket as-is)
+is ONLY for frames no user code can mutate after the send — error
+replies, driver-side task-arg pickles. Inline task returns and owner
+GetObject replies deliberately use ``to_wire()``'s flattening-copy
+SNAPSHOT instead: their flush is deferred by write coalescing, and
+the next actor method (or the putting caller) may mutate the returned
+buffers in place — live views would send torn bytes (see the SNAPSHOT
+comments at the call sites in task_executor.py / core_worker.py).
 The measured gap put-GB/s vs host-memcpy-GB/s is tracked per round by
 ``bench.py`` (``put_vs_memcpy_ceiling``).
 """

@@ -144,8 +144,9 @@ class _QuietSharedMemory(shared_memory.SharedMemory):
             try:
                 with _zombie_lock:
                     _zombies.append(self)
+            # raylint: disable=exception-hygiene — interpreter teardown: module globals may already be None
             except Exception:
-                pass  # interpreter teardown
+                pass
 
 
 def sweep_zombies() -> int:
@@ -160,8 +161,8 @@ def sweep_zombies() -> int:
             shared_memory.SharedMemory.close(shm)
         except BufferError:
             still.append(shm)
-        except Exception:
-            pass
+        except OSError:
+            pass  # segment already closed/unlinked elsewhere
     if still:
         with _zombie_lock:
             _zombies.extend(still)
@@ -173,6 +174,7 @@ def _untrack(shm: shared_memory.SharedMemory) -> None:
     store server, not whichever client process happened to create it."""
     try:
         resource_tracker.unregister(shm._name, "shared_memory")  # noqa: SLF001
+    # raylint: disable=exception-hygiene — tracker process may already be dead; leak is bounded by the store sweep
     except Exception:
         pass
 
@@ -493,16 +495,31 @@ def write_segment(serialized: SerializedObject,
             if not _map_cache.put(alloc[0], owner, buf):
                 _close_segment_owner(owner, buf)
             return alloc[0], total
+    lease_name = alloc[0] if alloc is not None else None
+
+    def _discard_fresh(name: str) -> None:
+        # Error-exit cleanup for segments this writer CREATED: without
+        # the unlink a failed fill (ENOSPC mid-write of a multi-GiB
+        # put) leaves a file the store never learned about linked in
+        # /dev/shm forever. A leased name is NOT unlinked — the store
+        # owns it (AbortSegment / the stale sweep reclaims).
+        if name != lease_name:
+            ShmStoreServer._unlink(name)
+
     # Tier 2: mapped write that SEEDS the cache for the next reuse of
-    # this segment name (cacheable sizes only).
+    # this segment name (cacheable sizes only). Sub-lease-size
+    # segments still write here but never seed: AllocSegment is only
+    # asked for size >= RECYCLE_MIN_BYTES, so a cached smaller mapping
+    # could never be taken — it would just pin dead pages.
     if _map_cache.enabled and size <= _map_cache.entry_cap:
         name, owner, buf = acquire_segment(alloc, size)
         try:
             _fill(buf)
         except BaseException:
             _close_segment_owner(owner, buf)
+            _discard_fresh(name)
             raise
-        if not _map_cache.put(name, owner, buf):
+        if size < RECYCLE_MIN_BYTES or not _map_cache.put(name, owner, buf):
             _close_segment_owner(owner, buf)
         return name, total
     # Tier 3: pwrite straight into the /dev/shm file — no mapping, no
@@ -513,6 +530,9 @@ def write_segment(serialized: SerializedObject,
             _pwrite_all(fd, _U32.pack(len(header)) + header, 0)
             for off, f in zip(offsets, raw_frames):
                 _pwrite_all(fd, f, off)
+        except BaseException:
+            _discard_fresh(name)
+            raise
         finally:
             os.close(fd)
         return name, total
@@ -520,6 +540,9 @@ def write_segment(serialized: SerializedObject,
     name, owner, buf = acquire_segment(alloc, size)
     try:
         _fill(buf)
+    except BaseException:
+        _discard_fresh(name)
+        raise
     finally:
         _close_segment_owner(owner, buf)
     return name, total
@@ -550,8 +573,8 @@ class AttachedObject:
         self.frames = []
         try:
             self.shm.close()
-        except Exception:
-            pass
+        except (BufferError, OSError):
+            pass  # exported views still alive; the zombie sweep retries
         sweep_zombies()
 
 
@@ -663,6 +686,15 @@ class ShmStoreServer:
         Keeps all lease bookkeeping inside the store."""
         self._lent.pop(name, None)
 
+    def abort_lease(self, name: str) -> None:
+        """AbortSegment RPC: a remote writer's fill failed — reclaim
+        the lease NOW and re-park the (still warm) segment so the next
+        put reuses its pages, instead of waiting for the stale sweep."""
+        entry = self._lent.pop(name, None)
+        if entry is None:
+            return  # already sealed, swept, or never leased here
+        self._park_segment(name, entry[0])
+
     def _park_segment(self, name: str, size_hint: int) -> None:
         """Recycle a freed segment instead of unlinking it (pool
         permitting). ``size_hint`` is the logical object size; the real
@@ -671,7 +703,12 @@ class ShmStoreServer:
             fsize = os.path.getsize(f"/dev/shm/{name}")
         except OSError:
             fsize = size_hint
-        if fsize <= 0 or self.recycle_bytes + fsize > self.recycle_cap \
+        # Size floor: AllocSegment is only requested for puts of
+        # >= RECYCLE_MIN_BYTES, so a smaller parked segment can never
+        # be leased back (take_recycled needs fsize >= size) — it
+        # would only crowd genuinely reusable segments out of the cap.
+        if fsize < RECYCLE_MIN_BYTES \
+                or self.recycle_bytes + fsize > self.recycle_cap \
                 or name in self._recycle:
             self._unlink(name)
             return
@@ -778,8 +815,9 @@ class ShmStoreServer:
                 if upload is not None:
                     try:  # the blob may still be uploading
                         upload.result(timeout=60)
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception:
+                        logger.warning("spill upload failed before delete",
+                                       exc_info=True)
                 try:
                     self._ext.delete(key)
                 except Exception:  # noqa: BLE001 — best effort

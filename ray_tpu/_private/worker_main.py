@@ -92,6 +92,7 @@ def main(argv=None):
     finally:
         try:
             core.shutdown()
+        # raylint: disable=exception-hygiene — worker exit path: nothing to report to, stderr goes to the log monitor
         except Exception:
             pass
         sys.exit(0)

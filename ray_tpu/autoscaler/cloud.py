@@ -269,5 +269,6 @@ def wait_for_nodes(provider: NodeProvider, count: int,
     while time.monotonic() < deadline:
         if len(provider.non_terminated_nodes()) >= count:
             return True
+        # raylint: disable=async-blocking — autoscaler control thread; provider APIs are sync HTTP
         time.sleep(poll)
     return False

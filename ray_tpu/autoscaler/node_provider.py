@@ -107,6 +107,7 @@ class LocalSubprocessProvider(NodeProvider):
             proc.terminate()
         deadline = time.time() + 5
         while time.time() < deadline and proc.poll() is None:
+            # raylint: disable=async-blocking — autoscaler thread waiting on SIGTERM of a local child
             time.sleep(0.05)
         if proc.poll() is None:
             proc.kill()

@@ -45,6 +45,7 @@ class NodeHandle:
                     self.raylet_address = lines[1]
                     self.session_dir = lines[2]
                     return self
+            # raylint: disable=async-blocking — test-harness boot wait on the user thread; no loop exists yet
             time.sleep(0.05)
         raise TimeoutError("node did not come up")
 
@@ -150,6 +151,7 @@ class Cluster:
         while time.time() < deadline:
             if len(self._alive_nodes()) >= want:
                 return
+            # raylint: disable=async-blocking — test-harness membership wait; subprocess polling has no event to wait on
             time.sleep(0.05)
         raise TimeoutError(f"cluster did not reach {want} nodes")
 
@@ -158,6 +160,7 @@ class Cluster:
         while time.time() < deadline:
             if len(self._alive_nodes()) == count:
                 return
+            # raylint: disable=async-blocking — test-harness membership wait; subprocess polling has no event to wait on
             time.sleep(0.05)
         raise TimeoutError(
             f"expected {count} alive nodes, have {len(self._alive_nodes())}")

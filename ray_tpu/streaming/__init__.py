@@ -177,6 +177,10 @@ class StreamingContext:
                 [op.snapshot.remote(barrier_id) for op in all_ops])
             if all(s is not None for s in snaps):
                 return snaps
+            # Driver-thread backoff between actor-state polls: each iteration
+            # submits .remote() via the sync API, which must stay OFF the IO loop
+            # (core._run rejects loop-thread callers) — never runs on the loop.
+            # raylint: disable=async-blocking — sync-API driver-thread poll (see above)
             time.sleep(0.02)
         return None
 
@@ -324,6 +328,7 @@ class StreamingContext:
                 _raise_op_error(bad)
             if ray_tpu.get(sink.eos_done.remote()):
                 break
+            # raylint: disable=async-blocking — same sync-API driver-thread poll as _collect_snapshot
             time.sleep(0.02)
         else:
             raise TimeoutError("stream did not reach EOS")

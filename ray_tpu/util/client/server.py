@@ -221,6 +221,7 @@ class ClientServer:
                     [dumps_args(v, on_ref=book, on_actor=book_actor)
                      for v in values])
         except Exception as e:  # noqa: BLE001 — ship to the client
+            # raylint: disable=async-blocking — bounded error reply (one exception object)
             return ({"ok": False}, [cloudpickle.dumps(e)])
 
     async def handle_wait(self, conn, header, bufs):

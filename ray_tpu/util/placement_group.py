@@ -10,7 +10,7 @@ join a group via the ``placement_group=`` option.
 
 from __future__ import annotations
 
-import time
+import asyncio
 from typing import Dict, List, Optional
 
 from ray_tpu import worker as worker_mod
@@ -27,18 +27,30 @@ class PlacementGroup:
 
     def ready(self, timeout: float = 30.0) -> bool:
         """Block until the group is placed (reference: pg.ready() — there
-        it returns an ObjectRef; here it blocks directly)."""
+        it returns an ObjectRef; here it blocks directly).
+
+        The poll loop runs as ONE coroutine on the worker's IO loop
+        (asyncio.sleep between GCS calls): a single thread hop for the
+        whole wait instead of two per poll, and — because nothing here
+        blocks a thread — safe to call from async actors, where the old
+        driver-thread time.sleep poll would have stalled the actor's
+        event loop via the sync API bridge."""
         w = worker_mod._require_connected()
-        deadline = time.time() + timeout
-        while time.time() < deadline:
-            reply, _ = w.core._run(w.core._gcs_call(
-                "GetPlacementGroup", {"pg_id": self.id.binary()}))
-            if reply.get("found") and reply["state"] == "CREATED":
-                return True
-            if reply.get("found") and reply["state"] == "REMOVED":
-                return False
-            time.sleep(0.05)
-        return False
+
+        async def _poll() -> bool:
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + timeout
+            while loop.time() < deadline:
+                reply, _ = await w.core._gcs_call(
+                    "GetPlacementGroup", {"pg_id": self.id.binary()})
+                if reply.get("found") and reply["state"] == "CREATED":
+                    return True
+                if reply.get("found") and reply["state"] == "REMOVED":
+                    return False
+                await asyncio.sleep(0.05)
+            return False
+
+        return w.core._run(_poll())
 
     @property
     def bundle_count(self) -> int:

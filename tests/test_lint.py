@@ -1,0 +1,429 @@
+"""raylint suite: per-rule good/bad fixtures, the pragma/reporting
+engine contract, and the self-check that the package itself is clean.
+
+The fixtures are the executable spec of each rule: every bad fixture
+must produce exactly the expected violation, every good fixture must be
+silent — so a rule that silently stops firing breaks the suite, not
+just the gate.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from ray_tpu._private.lint import lint_sources
+from ray_tpu._private.lint.engine import main as lint_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "ray_tpu")
+
+
+def run(src, rules=None, path="mod.py", extra=None):
+    sources = {path: textwrap.dedent(src)}
+    if extra:
+        sources.update({p: textwrap.dedent(s) for p, s in extra.items()})
+    return lint_sources(sources, rules)
+
+
+def rules_of(violations):
+    return [v.rule for v in violations]
+
+
+# ------------------------------------------------------------ async-blocking
+
+class TestAsyncBlocking:
+    def test_time_sleep_in_async_def(self):
+        vs = run("""
+            import time
+            async def handler():
+                time.sleep(1)
+        """, ["async-blocking"])
+        assert rules_of(vs) == ["async-blocking"]
+        assert "asyncio.sleep" in vs[0].message
+
+    def test_result_join_and_open_and_pickle(self):
+        vs = run("""
+            import pickle
+            async def handler(fut, payload):
+                x = fut.result()
+                f = open("/tmp/x")
+                data = pickle.dumps(payload)
+        """, ["async-blocking"])
+        assert len(vs) == 3
+        assert {v.line for v in vs} == {4, 5, 6}
+
+    def test_sync_poll_loop_flagged(self):
+        vs = run("""
+            import time
+            def wait_ready(deadline):
+                while time.time() < deadline:
+                    time.sleep(0.05)
+        """, ["async-blocking"])
+        assert rules_of(vs) == ["async-blocking"]
+        assert "sleep-poll" in vs[0].message
+
+    def test_clean_async_and_oneshot_sync_sleep_ok(self):
+        vs = run("""
+            import asyncio, time
+            async def handler():
+                await asyncio.sleep(1)
+            def backoff_once():
+                time.sleep(0.1)  # not in a loop: not a poll
+        """, ["async-blocking"])
+        assert vs == []
+
+    def test_nested_sync_def_not_flagged(self):
+        # sync helpers defined inside async functions typically run on
+        # executor threads — the rule must not cross the def boundary.
+        vs = run("""
+            import time
+            async def handler(loop):
+                def blocking_read():
+                    time.sleep(1)
+                    return 1
+                return await loop.run_in_executor(None, blocking_read)
+        """, ["async-blocking"])
+        assert vs == []
+
+
+# ----------------------------------------------------------- lock-discipline
+
+class TestLockDiscipline:
+    def test_await_under_lock(self):
+        vs = run("""
+            class Store:
+                async def get(self, oid):
+                    with self._lock:
+                        return await self._fetch(oid)
+        """, ["lock-discipline"])
+        assert rules_of(vs) == ["lock-discipline"]
+        assert "await while holding" in vs[0].message
+
+    def test_sleep_under_lock(self):
+        vs = run("""
+            import time
+            class Store:
+                def evict(self):
+                    with self._lock:
+                        time.sleep(0.1)
+        """, ["lock-discipline"])
+        assert rules_of(vs) == ["lock-discipline"]
+
+    def test_reentrant_acquisition(self):
+        vs = run("""
+            class Store:
+                def put(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+        """, ["lock-discipline"])
+        assert rules_of(vs) == ["lock-discipline"]
+        assert "not reentrant" in vs[0].message
+
+    def test_cross_module_lock_cycle(self):
+        vs = run("""
+            class A:
+                def f(self, other):
+                    with self._a_lock:
+                        with other._b_lock:
+                            pass
+        """, ["lock-discipline"], path="alpha.py", extra={"beta.py": """
+            class B:
+                def g(self, other):
+                    with self._b_lock:
+                        with other._a_lock:
+                            pass
+        """})
+        assert rules_of(vs) == ["lock-discipline"]
+        assert "cycle" in vs[0].message
+
+    def test_consistent_order_no_cycle(self):
+        vs = run("""
+            class A:
+                def f(self, other):
+                    with self._a_lock:
+                        with other._b_lock:
+                            pass
+                def g(self, other):
+                    with self._a_lock:
+                        with other._b_lock:
+                            pass
+        """, ["lock-discipline"])
+        assert vs == []
+
+    def test_handler_stats_benign_race_contract(self):
+        # The audited _HandlerStats decision (rpc.py): single-writer
+        # loop-thread mutation + snapshot-copy reads needs NO lock, and
+        # raylint agrees — unlocked counter cells are outside every
+        # rule's scope by design. This fixture pins that decision: if a
+        # future rule starts flagging the pattern, the allowlist
+        # conversation must happen here, not in CI triage.
+        vs = run("""
+            class HandlerStats:
+                def __init__(self):
+                    self._stats = {}
+                def note(self, method, dt):
+                    e = self._stats.get(method)
+                    if e is None:
+                        e = self._stats[method] = [0, 0.0, 0.0]
+                    e[0] += 1
+                    e[1] += dt
+                def snapshot(self):
+                    return {m: list(v) for m, v in
+                            list(self._stats.items())}
+        """)
+        assert vs == []
+
+
+# ------------------------------------------------------------- rpc-contract
+
+RPC_SERVER = """
+    from ray_tpu._private import rpc
+    class Raylet:
+        def _handlers(self):
+            return {
+                "SealObject": self.handle_seal_object,
+                "AllocSegment": self.handle_alloc_segment,
+            }
+"""
+
+
+class TestRpcContract:
+    def test_typo_method_flagged(self):
+        # Regression: the rename hazard this rule exists for — PR 1
+        # introduced the AllocSegment/SealObject pair; a typo'd client
+        # string ("SealObjcet") would have shipped as a hung await on
+        # every large put, surfacing as a flaky timeout.
+        vs = run("""
+            async def put(conn, oid):
+                reply, _ = await conn.call("SealObjcet", {"oid": oid})
+        """, ["rpc-contract"], path="client.py",
+            extra={"server.py": RPC_SERVER})
+        assert rules_of(vs) == ["rpc-contract"]
+        assert "SealObjcet" in vs[0].message
+
+    def test_matching_method_clean(self):
+        vs = run("""
+            async def put(conn, oid):
+                reply, _ = await conn.call("SealObject", {"oid": oid})
+                conn.push_nowait("AllocSegment", {"size": 1})
+        """, ["rpc-contract"], path="client.py",
+            extra={"server.py": RPC_SERVER})
+        assert vs == []
+
+    def test_update_and_keyword_registrations_count(self):
+        vs = run("""
+            async def go(core, conn):
+                core._server.handlers.update({"PushTasks": None})
+                await connect(addr, handlers={"Published": None})
+                await conn.call("PushTasks", {})
+                await conn.push("Published", {})
+        """, ["rpc-contract"])
+        assert vs == []
+
+    def test_dynamic_method_out_of_scope(self):
+        vs = run("""
+            async def forward(conn, method):
+                return await conn.call(method, {})
+        """, ["rpc-contract"], extra={"server.py": RPC_SERVER})
+        assert vs == []
+
+    def test_no_registrations_no_noise(self):
+        # A lone client module scan must not flag every call.
+        vs = run("""
+            async def put(conn):
+                await conn.call("Whatever", {})
+        """, ["rpc-contract"])
+        assert vs == []
+
+
+# -------------------------------------------------------- exception-hygiene
+
+class TestExceptionHygiene:
+    def test_bare_except(self):
+        vs = run("""
+            def f():
+                try:
+                    g()
+                except:
+                    pass
+        """, ["exception-hygiene"], path="pkg/_private/mod.py")
+        assert rules_of(vs) == ["exception-hygiene"]
+        assert "bare" in vs[0].message
+
+    def test_silent_broad_swallow(self):
+        vs = run("""
+            def f():
+                try:
+                    g()
+                except Exception:
+                    pass
+        """, ["exception-hygiene"], path="pkg/_private/mod.py")
+        assert rules_of(vs) == ["exception-hygiene"]
+
+    def test_logged_broad_and_narrow_silent_ok(self):
+        vs = run("""
+            def f():
+                try:
+                    g()
+                except Exception:
+                    logger.exception("g failed")
+                try:
+                    h()
+                except FileNotFoundError:
+                    pass
+        """, ["exception-hygiene"], path="pkg/_private/mod.py")
+        assert vs == []
+
+    def test_only_applies_to_private_paths(self):
+        vs = run("""
+            def f():
+                try:
+                    g()
+                except Exception:
+                    pass
+        """, ["exception-hygiene"], path="pkg/util/mod.py")
+        assert vs == []
+
+
+# ----------------------------------------------------------- shm-lifecycle
+
+class TestShmLifecycle:
+    def test_lease_without_seal_or_abort(self):
+        vs = run("""
+            async def write(conn, size):
+                reply, _ = await conn.call("AllocSegment", {"size": size})
+                return reply["segment"]
+        """, ["shm-lifecycle"])
+        assert rules_of(vs) == ["shm-lifecycle"]
+        assert "seal" in vs[0].message
+
+    def test_lease_with_seal_but_no_try(self):
+        vs = run("""
+            async def write(conn, size, oid):
+                reply, _ = await conn.call("AllocSegment", {"size": size})
+                await conn.call("SealObject", {"oid": oid})
+        """, ["shm-lifecycle"])
+        assert rules_of(vs) == ["shm-lifecycle"]
+        assert "try" in vs[0].message
+
+    def test_lease_sealed_under_try_clean(self):
+        vs = run("""
+            async def write(conn, size, oid):
+                reply, _ = await conn.call("AllocSegment", {"size": size})
+                try:
+                    fill(reply["segment"])
+                except BaseException:
+                    await conn.push("AbortSegment",
+                                    {"segment": reply["segment"]})
+                    raise
+                await conn.call("SealObject", {"oid": oid})
+        """, ["shm-lifecycle"])
+        assert vs == []
+
+
+# ------------------------------------------------------- engine & reporting
+
+class TestEngine:
+    def test_pragma_same_line_and_line_above(self):
+        vs = run("""
+            import time
+            async def a():
+                time.sleep(1)  # raylint: disable=async-blocking — fixture
+            async def b():
+                # raylint: disable=async-blocking — fixture
+                time.sleep(1)
+        """, ["async-blocking"])
+        assert vs == []
+
+    def test_pragma_is_rule_scoped(self):
+        vs = run("""
+            import time
+            async def a():
+                time.sleep(1)  # raylint: disable=rpc-contract
+        """, ["async-blocking"])
+        assert rules_of(vs) == ["async-blocking"]
+
+    def test_file_pragma(self):
+        vs = run("""
+            # raylint: disable-file=async-blocking
+            import time
+            async def a():
+                time.sleep(1)
+            async def b():
+                time.sleep(2)
+        """, ["async-blocking"])
+        assert vs == []
+
+    def test_syntax_error_reported(self):
+        vs = run("def broken(:\n    pass\n")
+        assert rules_of(vs) == ["syntax-error"]
+
+    def test_unknown_rule_rejected(self):
+        with pytest.raises(ValueError, match="unknown rule"):
+            run("x = 1", ["no-such-rule"])
+
+
+class TestCli:
+    def test_clean_file_exit_0(self, tmp_path, capsys):
+        f = tmp_path / "ok.py"
+        f.write_text("x = 1\n")
+        assert lint_main([str(f)]) == 0
+        assert "clean" in capsys.readouterr().out
+
+    def test_bad_file_exit_1_text_diagnostic(self, tmp_path, capsys):
+        f = tmp_path / "bad.py"
+        f.write_text("import time\nasync def f():\n    time.sleep(1)\n")
+        assert lint_main([str(f)]) == 1
+        out = capsys.readouterr().out
+        assert "bad.py:3" in out and "async-blocking" in out
+
+    def test_json_report(self, tmp_path, capsys):
+        f = tmp_path / "bad.py"
+        f.write_text("import time\nasync def f():\n    time.sleep(1)\n")
+        assert lint_main(["--format", "json", str(f)]) == 1
+        report = json.loads(capsys.readouterr().out)
+        assert report["files_scanned"] == 1
+        assert report["violations"][0]["rule"] == "async-blocking"
+        assert report["violations"][0]["line"] == 3
+
+    def test_missing_path_exit_2(self, tmp_path):
+        assert lint_main([str(tmp_path / "nope")]) == 2
+
+    def test_list_rules(self, capsys):
+        assert lint_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in ("async-blocking", "lock-discipline", "rpc-contract",
+                     "exception-hygiene", "shm-lifecycle"):
+            assert rule in out
+
+
+# ------------------------------------------------------------- self-checks
+
+class TestSelfCheck:
+    def test_package_is_clean_on_head(self):
+        """The hard gate: `python -m ray_tpu._private.lint ray_tpu/`
+        exits 0 on HEAD (exactly what ci/lint.sh runs)."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "ray_tpu._private.lint", PKG],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_rpc_contract_covers_real_handler_names(self):
+        """The package-wide scan must actually SEE the real handler
+        registrations (a collector regression would make the contract
+        rule vacuously green)."""
+        from ray_tpu._private.lint.engine import Module, all_rules
+        rule = all_rules()["rpc-contract"]()
+        for name in ("gcs.py", "raylet.py", "core_worker.py"):
+            p = os.path.join(PKG, "_private", name)
+            with open(p) as f:
+                rule.collect(Module(p, f.read()))
+        for method in ("Heartbeat", "SealObject", "AllocSegment",
+                       "AbortSegment", "GetObject", "RegisterNode"):
+            assert method in rule.registered, method
+        assert any(m == "AllocSegment" for m, *_ in rule.client_refs)

@@ -209,6 +209,38 @@ def _no_fallback_parking():
 # ---------------------------------------------------------------------------
 
 
+def test_alloc_lease_abort_returns_segment_to_pool():
+    """Seal-or-abort lease protocol (raylint shm-lifecycle): a writer
+    whose fill fails hands the segment back via abort_lease and the
+    warm pages go straight back to the recycle pool — not parked in
+    _lent until the 600 s stale sweep."""
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.serialization import SerializedObject
+    from ray_tpu._private.shm_store import ShmStoreServer, write_segment
+
+    store = ShmStoreServer(capacity_bytes=64 << 20, spilling_enabled=False)
+    payload = np.ones(1 << 20, dtype=np.uint8)
+    obj = SerializedObject(b"raw", [payload.tobytes()])
+    name, size = write_segment(obj)
+    oid = ObjectID.from_random()
+    assert store.seal(oid, name, size)
+    store.free(oid)  # unexposed -> parked in the recycle pool
+    assert name in store._recycle
+
+    got = store.take_recycled(size)
+    assert got is not None and got[0] == name
+    assert name in store._lent and name not in store._recycle
+
+    store.abort_lease(name)  # the failed-fill path (AbortSegment RPC)
+    assert name not in store._lent
+    assert name in store._recycle, "aborted lease must be re-parked"
+    # the very next lease of a similar size reuses the warm segment
+    again = store.take_recycled(size)
+    assert again is not None and again[0] == name
+    store.release_lease(name)
+    store._unlink(name)
+
+
 def test_write_segment_exact_sizing_and_roundtrip():
     """The two-pass writer sizes the segment exactly (plan == file
     size) and the attached readback deserializes bit-identical."""
