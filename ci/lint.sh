@@ -1,11 +1,17 @@
 #!/usr/bin/env bash
-# raylint hard gate: whole-runtime static analysis over the package
-# (async-blocking, lock-discipline, rpc-contract, exception-hygiene,
+# raylint hard gate: whole-program static analysis over the package
+# (async-blocking incl. transitive call-graph escalation,
+# lock-discipline, rpc-contract, rpc-schema, exception-hygiene,
 # shm-lifecycle — see ray_tpu/_private/lint/RULES.md). Runs next to
 # ci/sanitize.sh on every round; any violation fails CI.
 #
 # Local runs get the text report; CI (CI=1 or --json) also writes a
-# machine-readable artifact for the build system to attach.
+# machine-readable artifact for the build system to attach. The JSON
+# artifact carries the inferred per-method RPC schema table
+# ("rpc_schemas": method -> required/optional/reply keys) for protocol
+# debugging, plus "stale_pragmas". --stale-pragmas is warn-only by
+# design: dead `# raylint: disable=` anchors are reported but never
+# fail the gate.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -13,9 +19,16 @@ ARTIFACT="${RAYLINT_ARTIFACT:-/tmp/raylint-report.json}"
 
 if [ "${CI:-}" = "1" ] || [ "${1:-}" = "--json" ]; then
     # JSON artifact + human summary; the gate is the exit code either way.
-    if python -m ray_tpu._private.lint --format json ray_tpu/ \
-            > "$ARTIFACT"; then
+    if python -m ray_tpu._private.lint --format json --stale-pragmas \
+            ray_tpu/ > "$ARTIFACT"; then
         echo "raylint: clean (artifact: $ARTIFACT)"
+        python - "$ARTIFACT" <<'PY'
+import json, sys
+r = json.load(open(sys.argv[1]))
+print(f"raylint: {len(r['rpc_schemas'])} RPC method schemas inferred")
+for v in r["stale_pragmas"]:
+    print(f"warning: {v['path']}:{v['line']}: {v['rule']}: {v['message']}")
+PY
     else
         rc=$?
         echo "raylint: violations (artifact: $ARTIFACT)" >&2
@@ -28,5 +41,5 @@ PY
         exit "$rc"
     fi
 else
-    python -m ray_tpu._private.lint ray_tpu/
+    python -m ray_tpu._private.lint --stale-pragmas ray_tpu/
 fi

@@ -189,6 +189,12 @@ class CoreWorker:
             self._loop_thread = None
             self.loop = loop
 
+        # Warm the native copy tier at process boot (copy_into itself
+        # never builds — a cold-cache compile must not reach any event
+        # loop; here we are still on the constructing thread).
+        from ray_tpu._private import native as _native
+        _native.load_fastpath()
+
         self.memory_store = MemoryStore()
         self.reference_counter = ReferenceCounter()
         self.serialization_context = SerializationContext()

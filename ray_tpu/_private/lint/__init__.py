@@ -6,19 +6,27 @@ lock-guarded shared state — exactly the layer where hidden blocking and
 contention dominate task latency ("Runtime vs Scheduler: Analyzing
 Dask's Overheads", arxiv 2010.11105) and where the ownership/RPC
 contract must hold (Ray, arxiv 1712.05889). raylint machine-checks the
-invariants that previously lived as tribal knowledge:
+invariants that previously lived as tribal knowledge, judging on a
+whole-program substrate (symbol table + call graph + RPC index,
+callgraph.Program) built once over every scanned module:
 
-  async-blocking     no blocking calls on the event loop
+  async-blocking     no blocking calls on the event loop — directly,
+                     or transitively through resolved sync call chains
   lock-discipline    no await/sleep under a threading lock; acyclic
                      cross-module lock acquisition graph
   rpc-contract       every call()/push() method string resolves to a
                      registered handler
+  rpc-schema         literal payloads carry the keys the handler reads
+                     (schemas inferred from handler bodies); reply
+                     reads name keys some return path produces
   exception-hygiene  no bare/silent exception swallowing on _private/
   shm-lifecycle      every AllocSegment lease is sealed or aborted
 
 Usage:
     python -m ray_tpu._private.lint ray_tpu/            # text report
     python -m ray_tpu._private.lint --format json ray_tpu/
+    python -m ray_tpu._private.lint --stale-pragmas ray_tpu/
+    python -m ray_tpu._private.lint --dump-schemas ray_tpu/
     python -m ray_tpu._private.lint --list-rules
 
 Suppress a finding with a pragma on the flagged line or the line above:
@@ -35,7 +43,14 @@ from ray_tpu._private.lint.engine import (  # noqa: F401
     Rule,
     Violation,
     all_rules,
+    analyze_modules,
+    find_stale_pragmas,
     lint_paths,
     lint_sources,
+    load_modules,
     register,
+)
+from ray_tpu._private.lint.callgraph import (  # noqa: F401
+    Program,
+    build_program,
 )

@@ -1,0 +1,382 @@
+"""Whole-program substrate shared by raylint rules.
+
+One ``Program`` is built per lint run from every parsed module and
+handed to each rule via ``Rule.setup(program)``. It carries three
+layers, each conservative by construction (an edge or resolution only
+exists when the AST proves it — dynamic dispatch stays out of scope):
+
+  * **symbol table** — every function/method as a ``FunctionInfo``
+    (qualname, enclosing class, async flag, positional signature);
+  * **call graph** — edges from each function to callees the resolver
+    can pin down statically: same-module top-level calls, explicitly
+    imported names, ``mod.func`` through an imported module, and
+    ``self.method``/``cls.method`` within the enclosing class.
+    Function references passed as arguments (``run_in_executor(None,
+    f)``, ``Thread(target=f)``) are deliberately NOT edges: they hop
+    threads, which is exactly the boundary async-reachability must
+    not cross;
+  * **RPC index** — every handler registration (``RpcServer({...})``,
+    ``handlers=`` kwargs, ``.handlers.update({...})``, dicts in
+    ``*handlers*`` functions) with the handler expression resolved to
+    its ``FunctionInfo``, plus every client-side
+    ``call/push/call_nowait/push_nowait/_gcs_call`` site with its
+    header expression. rpc-contract checks name existence against it;
+    rpc-schema infers per-method header schemas from it.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private.lint.engine import (
+    Module, dotted_name, first_str_arg, walk_functions, body_nodes,
+)
+
+CLIENT_METHODS = {"call", "push", "call_nowait", "push_nowait", "_gcs_call"}
+
+
+class FunctionInfo:
+    """One function or method definition, with the signature facts
+    rules need and (after resolution) its outgoing call edges."""
+
+    __slots__ = ("path", "qualname", "node", "class_name", "is_async",
+                 "params", "has_var_pos", "has_var_kw", "calls")
+
+    def __init__(self, path: str, qualname: str, node: ast.AST,
+                 class_name: str):
+        self.path = path
+        self.qualname = qualname
+        self.node = node
+        self.class_name = class_name
+        self.is_async = isinstance(node, ast.AsyncFunctionDef)
+        args = node.args
+        self.params = [a.arg for a in args.posonlyargs + args.args]
+        self.has_var_pos = args.vararg is not None
+        self.has_var_kw = args.kwarg is not None
+        # (call node, callee FunctionInfo) — filled by _resolve_edges
+        self.calls: List[Tuple[ast.Call, "FunctionInfo"]] = []
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+    @property
+    def is_method(self) -> bool:
+        return bool(self.class_name) and bool(self.params) and \
+            self.params[0] in ("self", "cls")
+
+    def positional_params(self) -> List[str]:
+        """Positional parameter names with self/cls stripped."""
+        return self.params[1:] if self.is_method else list(self.params)
+
+    def __repr__(self):
+        return f"<fn {self.path}:{self.qualname}>"
+
+
+@dataclasses.dataclass
+class Registration:
+    """One ``"Method": <handler expr>`` entry in a registration dict."""
+    method: str
+    path: str
+    lineno: int
+    col: int
+    value_desc: str                       # dotted text of the handler expr
+    handler: Optional[FunctionInfo]       # resolved def, when provable
+    # True when the expr was `self.x` / `obj.x`, the owning class is
+    # known and base-less, and NO class anywhere defines x — i.e. the
+    # registration provably dangles (rpc-schema reports it).
+    provably_missing: bool = False
+
+
+@dataclasses.dataclass
+class ClientCall:
+    """One client-side RPC reference: conn.call("Method", header, ...)."""
+    method: str
+    kind: str                             # call/push/call_nowait/...
+    path: str
+    lineno: int
+    col: int
+    header: Optional[ast.AST]             # None when no header was passed
+
+
+class RpcIndex:
+    def __init__(self):
+        self.registrations: Dict[str, List[Registration]] = {}
+        self.client_calls: List[ClientCall] = []
+
+    @property
+    def registered_methods(self) -> Set[str]:
+        return set(self.registrations)
+
+
+class Program:
+    def __init__(self):
+        self.modules: Dict[str, Module] = {}
+        self.functions: Dict[Tuple[str, str], FunctionInfo] = {}
+        # path -> {name: fi} for module-level (non-nested) functions
+        self.module_level: Dict[str, Dict[str, FunctionInfo]] = {}
+        # class name -> {method name: [fi, ...]} across all modules
+        self.methods: Dict[str, Dict[str, List[FunctionInfo]]] = {}
+        # method name -> [fi, ...] over every class (for obj.x resolution)
+        self.any_method: Dict[str, List[FunctionInfo]] = {}
+        # module basename ("gcs") -> [path, ...]
+        self.by_basename: Dict[str, List[str]] = {}
+        # path -> {local alias: imported module basename or dotted path}
+        self.import_modules: Dict[str, Dict[str, str]] = {}
+        # path -> {local name: (module basename, original name)}
+        self.import_names: Dict[str, Dict[str, Tuple[str, str]]] = {}
+        # class name -> has non-object bases (methods may be inherited)
+        self.class_has_bases: Dict[str, bool] = {}
+        self.rpc = RpcIndex()
+
+    # -------------------------------------------------------------- lookup
+
+    def module_function(self, path: str, name: str) -> Optional[FunctionInfo]:
+        return self.module_level.get(path, {}).get(name)
+
+    def class_method(self, class_name: str, method: str,
+                     prefer_path: str = "") -> Optional[FunctionInfo]:
+        cands = self.methods.get(class_name, {}).get(method, [])
+        if not cands:
+            return None
+        for fi in cands:
+            if fi.path == prefer_path:
+                return fi
+        return cands[0] if len(cands) == 1 else None
+
+    def imported_function(self, path: str, name: str) -> Optional[FunctionInfo]:
+        """Resolve a bare name through `from mod import name`."""
+        imp = self.import_names.get(path, {}).get(name)
+        if imp is None:
+            return None
+        mod_base, orig = imp
+        return self._unique_basename_def(mod_base, orig)
+
+    def module_attr_function(self, path: str, mod_alias: str,
+                             name: str) -> Optional[FunctionInfo]:
+        """Resolve `alias.name()` through `import mod [as alias]`."""
+        base = self.import_modules.get(path, {}).get(mod_alias)
+        if base is None:
+            return None
+        return self._unique_basename_def(base, name)
+
+    def _unique_basename_def(self, mod_base: str,
+                             name: str) -> Optional[FunctionInfo]:
+        """The one module-level def of ``name`` across every file named
+        ``mod_base``.py — two same-named modules both defining it (e.g.
+        ``a/util.py`` and ``b/util.py``) are ambiguous without package
+        paths, and an edge needs proof: ambiguity resolves to None."""
+        found = None
+        for target in self.by_basename.get(mod_base, []):
+            fi = self.module_function(target, name)
+            if fi is None:
+                continue
+            if found is not None:
+                return None
+            found = fi
+        return found
+
+
+# ---------------------------------------------------------------- builders
+
+def _collect_symbols(program: Program, module: Module):
+    path = module.path
+    base = path.rsplit("/", 1)[-1]
+    if base.endswith(".py"):
+        base = base[:-3]
+    program.by_basename.setdefault(base, []).append(path)
+    program.module_level.setdefault(path, {})
+    for func, qualname, cls in walk_functions(module.tree):
+        fi = FunctionInfo(path, qualname, func, cls)
+        program.functions[(path, qualname)] = fi
+        if "." not in qualname:
+            program.module_level[path][qualname] = fi
+        if cls and qualname.endswith("." + func.name) and \
+                qualname[:-len(func.name) - 1].rsplit(".", 1)[-1] == cls:
+            program.methods.setdefault(cls, {}).setdefault(
+                func.name, []).append(fi)
+            program.any_method.setdefault(func.name, []).append(fi)
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ClassDef):
+            has_bases = any(
+                not (isinstance(b, ast.Name) and b.id == "object")
+                for b in node.bases)
+            # ORed across same-named classes: any inheriting variant
+            # makes "method not found" unprovable.
+            program.class_has_bases[node.name] = \
+                program.class_has_bases.get(node.name, False) or has_bases
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                # `import a.b as c` binds c to module a.b; a bare
+                # `import a.b` binds only the top-level package a.
+                if alias.asname:
+                    local, target = alias.asname, alias.name.rsplit(".", 1)[-1]
+                else:
+                    local = target = alias.name.split(".")[0]
+                program.import_modules.setdefault(path, {})[local] = target
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            mod_base = node.module.rsplit(".", 1)[-1]
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                local = alias.asname or alias.name
+                program.import_names.setdefault(path, {})[local] = \
+                    (mod_base, alias.name)
+                # `from pkg import mod` also enables `mod.func(...)`
+                program.import_modules.setdefault(path, {}) \
+                    .setdefault(local, alias.name)
+
+
+def _enclosing_class(node: ast.AST, parents: Dict[int, ast.AST]) -> str:
+    anc = parents.get(id(node))
+    while anc is not None:
+        if isinstance(anc, ast.ClassDef):
+            return anc.name
+        anc = parents.get(id(anc))
+    return ""
+
+
+def _resolve_callable(program: Program, path: str, expr: ast.AST,
+                      enclosing_class: str,
+                      any_method_fallback: bool = False
+                      ) -> Optional[FunctionInfo]:
+    """Resolve a callable reference expression to its def, or None.
+
+    ``any_method_fallback`` lets an unqualified ``obj.x`` match a
+    method name that is unique across the whole program. That is right
+    for handler-dict values (``"PushTasks": executor.handle_push_tasks``
+    deliberately points at one def) but far too eager for call edges
+    (``anything.join()`` must not edge into an unrelated class), so
+    edge resolution leaves it off.
+    """
+    if isinstance(expr, ast.Name):
+        return (program.module_function(path, expr.id)
+                or program.imported_function(path, expr.id))
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        base, attr = expr.value.id, expr.attr
+        if base in ("self", "cls") and enclosing_class:
+            fi = program.class_method(enclosing_class, attr,
+                                      prefer_path=path)
+            if fi is not None:
+                return fi
+            # Not on the class itself (inherited / mixed in): unique
+            # across the program still identifies it; ambiguity stays
+            # unresolved.
+            cands = program.any_method.get(attr, [])
+            return cands[0] if len(cands) == 1 else None
+        fi = program.module_attr_function(path, base, attr)
+        if fi is not None:
+            return fi
+        if any_method_fallback:
+            cands = program.any_method.get(attr, [])
+            return cands[0] if len(cands) == 1 else None
+    return None
+
+
+def _resolve_edges(program: Program, module: Module,
+                   parents: Dict[int, ast.AST]):
+    path = module.path
+    for func, qualname, cls in walk_functions(module.tree):
+        fi = program.functions[(path, qualname)]
+        for node in body_nodes(func):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _resolve_callable(program, path, node.func, cls)
+            if callee is not None and callee is not fi:
+                fi.calls.append((node, callee))
+
+
+def _is_registration(node: ast.Dict, parents: Dict[int, ast.AST]) -> bool:
+    """True when a dict literal is an RPC handler registration (the v1
+    rpc-contract heuristics, now shared program-wide)."""
+    parent = parents.get(id(node))
+    if isinstance(parent, ast.Call):
+        func_name = dotted_name(parent.func)
+        if func_name.rsplit(".", 1)[-1] == "RpcServer" and \
+                parent.args and parent.args[0] is node:
+            return True
+        for kw in parent.keywords:
+            if kw.arg == "handlers" and kw.value is node:
+                return True
+        if isinstance(parent.func, ast.Attribute) and \
+                parent.func.attr == "update" and \
+                dotted_name(parent.func.value).endswith("handlers"):
+            return True
+    if isinstance(parent, ast.keyword) and parent.arg == "handlers":
+        return True
+    if isinstance(parent, ast.Assign) and any(
+            isinstance(t, ast.Name) and "handlers" in t.id
+            for t in parent.targets):
+        return True
+    anc = parent
+    while anc is not None:
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return "handlers" in anc.name
+        if isinstance(anc, ast.ClassDef):
+            return False
+        anc = parents.get(id(anc))
+    return False
+
+
+def _index_rpc(program: Program, module: Module,
+               parents: Dict[int, ast.AST]):
+    path = module.path
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Dict) and _is_registration(node, parents):
+            cls = _enclosing_class(node, parents)
+            for key, value in zip(node.keys, node.values):
+                if not (isinstance(key, ast.Constant) and
+                        isinstance(key.value, str)):
+                    continue
+                handler = _resolve_callable(program, path, value, cls,
+                                            any_method_fallback=True)
+                missing = False
+                if handler is None and isinstance(value, ast.Attribute) \
+                        and isinstance(value.value, ast.Name):
+                    owner = cls if value.value.id in ("self", "cls") \
+                        else ""
+                    # `self.x` with no x on any class and no bases to
+                    # inherit from: the registration provably dangles.
+                    if owner and not program.class_has_bases.get(owner) \
+                            and value.attr not in program.any_method:
+                        missing = True
+                program.rpc.registrations.setdefault(key.value, []).append(
+                    Registration(key.value, path, key.lineno,
+                                 key.col_offset, dotted_name(value),
+                                 handler, missing))
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in CLIENT_METHODS:
+            method = first_str_arg(node)
+            if method is None:
+                continue
+            header: Optional[ast.AST] = None
+            if len(node.args) > 1:
+                header = node.args[1]
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "header":
+                        header = kw.value
+            program.rpc.client_calls.append(ClientCall(
+                method, node.func.attr, path, node.lineno,
+                node.col_offset, header))
+
+
+def build_program(modules: List[Module]) -> Program:
+    program = Program()
+    parsed = [m for m in modules if m.tree is not None]
+    for m in parsed:
+        program.modules[m.path] = m
+        _collect_symbols(program, m)
+    # Parent maps are per-module and needed by both late passes; edges
+    # and RPC indexing each see the full symbol table.
+    for m in parsed:
+        parents: Dict[int, ast.AST] = {}
+        for node in ast.walk(m.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+        _resolve_edges(program, m, parents)
+        _index_rpc(program, m, parents)
+    return program

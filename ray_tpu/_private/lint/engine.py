@@ -15,7 +15,17 @@ same line or the line directly above it:
 
 ``disable=all`` suppresses every rule at that anchor. The engine (not
 individual rules) applies suppression, so finalize()-phase violations
-honour pragmas exactly like collect()-phase ones.
+honour pragmas exactly like collect()-phase ones. The engine also
+tracks which pragmas actually suppressed something: ``--stale-pragmas``
+reports the anchors that suppress nothing (rule renamed, violation
+long since fixed) so pragma justifications can't rot.
+
+Since v2 the engine also hands every rule a whole-program substrate
+before collect() runs: ``callgraph.build_program`` turns the parsed
+modules into one ``Program`` (symbol table, call graph, RPC index)
+passed to each rule via ``rule.setup(program)``. rpc-schema and
+async-blocking's transitive mode are built on it; the JSON reporter
+serializes its inferred per-method schemas as ``rpc_schemas``.
 """
 
 from __future__ import annotations
@@ -50,6 +60,17 @@ class Violation:
         return dataclasses.asdict(self)
 
 
+@dataclasses.dataclass
+class Pragma:
+    """One ``# raylint: disable[-file]=`` comment anchor. ``used`` is
+    flipped by the engine when the anchor suppresses a violation — the
+    raw material of the stale-pragma report."""
+    lineno: int
+    kind: str            # "line" | "file"
+    rules: Set[str]
+    used: bool = False
+
+
 class Module:
     """One parsed source file plus the lookup tables rules share."""
 
@@ -63,9 +84,11 @@ class Module:
             self.tree = ast.parse(source, filename=path)
         except SyntaxError as e:
             self.syntax_error = e
-        # line -> rules disabled at that line; "all" disables every rule
-        self.line_disables: Dict[int, Set[str]] = {}
-        self.file_disables: Set[str] = set()
+        self.pragmas: List[Pragma] = []
+        # line -> pragmas anchored at that line; file-kind pragmas apply
+        # everywhere. "all" disables every rule at the anchor.
+        self._line_pragmas: Dict[int, List[Pragma]] = {}
+        self._file_pragmas: List[Pragma] = []
         for lineno, text in enumerate(self.lines, start=1):
             m = _PRAGMA_RE.search(text)
             if not m:
@@ -75,27 +98,42 @@ class Module:
             # the rule name.
             rules = {piece.split()[0] for piece in m.group(2).split(",")
                      if piece.strip()}
-            if m.group(1) == "disable-file":
-                self.file_disables |= rules
+            kind = "file" if m.group(1) == "disable-file" else "line"
+            pragma = Pragma(lineno, kind, rules)
+            self.pragmas.append(pragma)
+            if kind == "file":
+                self._file_pragmas.append(pragma)
             else:
-                self.line_disables.setdefault(lineno, set()).update(rules)
+                self._line_pragmas.setdefault(lineno, []).append(pragma)
 
     def suppressed(self, v: Violation) -> bool:
-        if {"all", v.rule} & self.file_disables:
-            return True
+        """True if a pragma suppresses ``v``; marks every matching
+        anchor as used (line and file anchors both, if both match)."""
+        hit = False
+        for pragma in self._file_pragmas:
+            if {"all", v.rule} & pragma.rules:
+                pragma.used = True
+                hit = True
         for anchor in (v.line, v.line - 1):
-            rules = self.line_disables.get(anchor)
-            if rules and {"all", v.rule} & rules:
-                return True
-        return False
+            for pragma in self._line_pragmas.get(anchor, ()):
+                if {"all", v.rule} & pragma.rules:
+                    pragma.used = True
+                    hit = True
+        return hit
 
 
 class Rule:
     """Base class. Subclasses set ``name`` and override collect()
-    (per-module) and optionally finalize() (cross-module)."""
+    (per-module) and optionally finalize() (cross-module). Rules that
+    need whole-program context (the call graph, the RPC index) override
+    setup(), which runs once before any collect() with the shared
+    ``callgraph.Program`` built from every parsed module."""
 
     name = ""
     description = ""
+
+    def setup(self, program) -> None:
+        pass
 
     def collect(self, module: Module) -> Iterable[Violation]:
         return ()
@@ -174,21 +212,36 @@ def body_nodes(func: ast.AST):
 # ----------------------------------------------------------------- driver
 
 def iter_py_files(paths: Sequence[str]) -> List[str]:
+    """Every .py file under ``paths``, deduplicated by realpath:
+    overlapping arguments (``ray_tpu/ ray_tpu/_private``) must not
+    double-report every violation in the overlap."""
     out: List[str] = []
+    seen: Set[str] = set()
+
+    def add(f: str):
+        real = os.path.realpath(f)
+        if real not in seen:
+            seen.add(real)
+            out.append(f)
+
     for p in paths:
         if os.path.isfile(p):
-            out.append(p)
+            add(p)
             continue
         for root, dirs, files in os.walk(p):
             dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
-            out.extend(os.path.join(root, f)
-                       for f in sorted(files) if f.endswith(".py"))
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    add(os.path.join(root, f))
     return out
 
 
-def lint_modules(modules: List[Module],
-                 rule_names: Optional[Sequence[str]] = None
-                 ) -> List[Violation]:
+def analyze_modules(modules: List[Module],
+                    rule_names: Optional[Sequence[str]] = None
+                    ) -> tuple:
+    """Run the rules over ``modules``; returns (violations, program).
+    The callgraph.Program is built once and handed to every rule via
+    setup() before any collect() runs."""
     registry = all_rules()
     names = list(rule_names) if rule_names else sorted(registry)
     unknown = [n for n in names if n not in registry]
@@ -197,6 +250,10 @@ def lint_modules(modules: List[Module],
                          f"known: {', '.join(sorted(registry))}")
     rules = [registry[n]() for n in names]
     by_path = {m.path: m for m in modules}
+    from ray_tpu._private.lint.callgraph import build_program
+    program = build_program(modules)
+    for rule in rules:
+        rule.setup(program)
     violations: List[Violation] = []
     for m in modules:
         if m.syntax_error is not None:
@@ -211,19 +268,77 @@ def lint_modules(modules: List[Module],
     violations = [v for v in violations
                   if v.path not in by_path or not by_path[v.path].suppressed(v)]
     violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
-    return violations
+    return violations, program
+
+
+def lint_modules(modules: List[Module],
+                 rule_names: Optional[Sequence[str]] = None
+                 ) -> List[Violation]:
+    return analyze_modules(modules, rule_names)[0]
+
+
+def find_stale_pragmas(modules: Sequence[Module],
+                       rule_names: Optional[Sequence[str]] = None
+                       ) -> List[Violation]:
+    """Pragma anchors that suppressed nothing in the run that just
+    completed (call AFTER analyze_modules — suppression marks usage).
+
+    A dead pragma is tribal knowledge rotting in place: the rule was
+    renamed, or the violation it justified was fixed. Reported as
+    ``stale-pragma`` findings that the CLI treats as warnings (they
+    never affect the exit code). Pragmas naming rules outside the run
+    subset are skipped — only a run that actually exercised the rule
+    can judge its pragmas."""
+    registry = set(all_rules())
+    ran = set(rule_names) if rule_names else registry
+    full_run = ran >= registry
+    out: List[Violation] = []
+    for m in modules:
+        if m.syntax_error is not None:
+            continue
+        for pragma in m.pragmas:
+            if pragma.used:
+                continue
+            names = pragma.rules
+            if "all" in names:
+                if not full_run:
+                    continue
+                reason = "suppresses nothing"
+            else:
+                unknown = names - registry
+                if unknown:
+                    if not full_run:
+                        continue
+                    reason = ("names unknown rule(s) "
+                              f"{', '.join(sorted(unknown))} — renamed?")
+                elif not names <= ran:
+                    continue     # rule not exercised: cannot judge
+                else:
+                    reason = "suppresses nothing"
+            out.append(Violation(
+                "stale-pragma", m.path, pragma.lineno, 0,
+                f"`# raylint: disable{'-file' if pragma.kind == 'file' else ''}"
+                f"={','.join(sorted(names))}` {reason} — the violation it "
+                "justified is gone; delete the pragma so the next reader "
+                "doesn't inherit a dead justification"))
+    out.sort(key=lambda v: (v.path, v.line))
+    return out
+
+
+def load_modules(paths: Sequence[str]) -> List[Module]:
+    modules = []
+    for f in iter_py_files(paths):
+        with open(f, "r", encoding="utf-8", errors="replace") as fh:
+            modules.append(Module(f, fh.read()))
+    return modules
 
 
 def lint_paths(paths: Sequence[str],
                rule_names: Optional[Sequence[str]] = None
                ) -> tuple:
     """Returns (violations, files_scanned)."""
-    files = iter_py_files(paths)
-    modules = []
-    for f in files:
-        with open(f, "r", encoding="utf-8", errors="replace") as fh:
-            modules.append(Module(f, fh.read()))
-    return lint_modules(modules, rule_names), len(files)
+    modules = load_modules(paths)
+    return lint_modules(modules, rule_names), len(modules)
 
 
 def lint_sources(sources: Dict[str, str],
@@ -248,6 +363,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--rules", default="",
                         help="comma-separated subset of rules to run")
     parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--stale-pragmas", action="store_true",
+                        help="also report `# raylint: disable=` anchors "
+                             "that suppress nothing (warn-only: never "
+                             "affects the exit code)")
+    parser.add_argument("--dump-schemas", action="store_true",
+                        help="print the inferred RPC header schema for "
+                             "every registered method as JSON and exit "
+                             "(the rpc-schema rule's view of the wire "
+                             "contract)")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -262,24 +386,44 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if not os.path.exists(p):
             print(f"error: no such path: {p}", file=sys.stderr)
             return 2
+    if args.dump_schemas:
+        from ray_tpu._private.lint.callgraph import build_program
+        from ray_tpu._private.lint.rules.rpc_schema import schemas_as_dict
+        print(json.dumps(schemas_as_dict(
+            build_program(load_modules(args.paths))), indent=2))
+        return 0
+
     rule_names = [r.strip() for r in args.rules.split(",") if r.strip()] \
         or None
+    modules = load_modules(args.paths)
     try:
-        violations, nfiles = lint_paths(args.paths, rule_names)
+        violations, program = analyze_modules(modules, rule_names)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    stale = find_stale_pragmas(modules, rule_names) \
+        if args.stale_pragmas else []
 
     if args.format == "json":
+        from ray_tpu._private.lint.rules.rpc_schema import schemas_as_dict
         print(json.dumps({
             "violations": [v.as_dict() for v in violations],
-            "files_scanned": nfiles,
+            "stale_pragmas": [v.as_dict() for v in stale],
+            "files_scanned": len(modules),
             "rules": rule_names or sorted(all_rules()),
+            # Inferred wire schema per RPC method (ci/lint.sh artifact):
+            # what each handler requires/accepts and what its replies
+            # can carry — the protocol-debugging companion table.
+            "rpc_schemas": schemas_as_dict(program),
         }, indent=2))
     else:
         for v in violations:
             print(v.render())
+        for v in stale:
+            print(f"warning: {v.render()}")
         status = "clean" if not violations else \
             f"{len(violations)} violation(s)"
-        print(f"raylint: {nfiles} file(s), {status}")
+        if stale:
+            status += f", {len(stale)} stale pragma(s) [warn-only]"
+        print(f"raylint: {len(modules)} file(s), {status}")
     return 1 if violations else 0

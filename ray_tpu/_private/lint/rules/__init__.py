@@ -6,5 +6,6 @@ from ray_tpu._private.lint.rules import (  # noqa: F401
     exception_hygiene,
     lock_discipline,
     rpc_contract,
+    rpc_schema,
     shm_lifecycle,
 )

@@ -21,6 +21,17 @@ Also flags ``time.sleep`` inside a loop body of a SYNC function (a
 sleep-poll): such helpers are routinely reachable from async contexts
 (async actors calling driver APIs), where they stall the actor's loop.
 Intentional driver-thread polls carry a pragma with a justification.
+
+Since v2 the rule also walks the shared call-graph substrate: an
+``async def`` that CALLS a sync function whose body (or a sync callee's
+body, up to 3 hops) contains a hard-blocking call — sleep, subprocess,
+socket/DNS, no-arg ``.result()`` — is flagged at the async call site,
+with the chain to the blocking line in the message. Executor hops
+(``run_in_executor(None, f)``, ``Thread(target=f)``) pass the function
+as an argument rather than calling it, so they create no edge and
+never trip the check. A pragma on the blocking line itself clears
+every async caller at once — the justification lives where the
+blocking is.
 """
 
 from __future__ import annotations
@@ -59,7 +70,18 @@ SERIALIZE_CALLS = {
 class AsyncBlockingRule(Rule):
     name = "async-blocking"
     description = ("blocking calls (sleep/subprocess/IO/.result()/pickle) "
-                   "inside async def bodies, and sleep-polls in sync code")
+                   "inside async def bodies, sleep-polls in sync code, and "
+                   "async calls into sync functions that block (call-graph "
+                   "reachability)")
+
+    def __init__(self):
+        self._program = None
+        # id(fi) -> body-scan result; helpers called from many async
+        # sites would otherwise be rescanned once per edge
+        self._body_cache: dict = {}
+
+    def setup(self, program) -> None:
+        self._program = program
 
     def collect(self, module: Module) -> Iterable[Violation]:
         out: List[Violation] = []
@@ -114,6 +136,109 @@ class AsyncBlockingRule(Rule):
                 f"`open()` inside async def `{qualname}`: synchronous "
                 "file I/O on the loop — move to an executor")
         return None
+
+    # --------------------------------------------- call-graph reachability
+
+    def finalize(self) -> Iterable[Violation]:
+        """Async defs calling sync functions that hard-block. Only the
+        sharp blocking set (sleep/subprocess/socket/no-arg .result())
+        counts here — open()/pickle stay direct-body-only, or every
+        config-reading helper would light up."""
+        out: List[Violation] = []
+        if self._program is None:
+            return out
+        for fi in self._program.functions.values():
+            if not fi.is_async:
+                continue
+            for call_node, callee in fi.calls:
+                if callee.is_async:
+                    continue        # awaited coroutine — the loop is fine
+                hit = self._find_blocking(
+                    callee, depth=3,
+                    visited={(fi.path, fi.qualname): 99})
+                if hit is None:
+                    continue
+                site_path, site_line, desc, chain = hit
+                via = " -> ".join(chain)
+                out.append(Violation(
+                    self.name, fi.path, call_node.lineno,
+                    call_node.col_offset,
+                    f"async def `{fi.qualname}` calls sync `{via}` which "
+                    f"blocks: {desc} at {site_path}:{site_line} runs on "
+                    f"the event loop — await an async variant, hop to an "
+                    f"executor, or pragma the blocking line"))
+        return out
+
+    def _find_blocking(self, fi, depth: int, visited: dict):
+        """First hard-blocking site reachable from ``fi`` through sync
+        call edges: (path, line, description, qualname chain) or None.
+
+        ``visited`` maps node -> largest remaining-depth budget it has
+        been explored with: a node first reached deep in one branch
+        must be re-entered when another branch reaches it with budget
+        to spare, or whether a within-bound chain is found would depend
+        on statement order. Cycles still terminate (re-entry always
+        carries a strictly smaller budget)."""
+        key = (fi.path, fi.qualname)
+        if visited.get(key, 0) >= depth:
+            return None
+        visited[key] = depth
+        hit = self._body_blocking(fi)
+        if hit is not None:
+            return hit
+        if depth <= 1:
+            return None
+        for _node, callee in fi.calls:
+            if callee.is_async:
+                continue
+            hit = self._find_blocking(callee, depth - 1, visited)
+            if hit is not None:
+                path, line, desc, chain = hit
+                return path, line, desc, [fi.qualname] + chain
+        return None
+
+    def _body_blocking(self, fi):
+        """First hard-blocking call directly in ``fi``'s body whose line
+        is NOT pragma-suppressed in its own module (so one pragma at the
+        blocking line clears every async caller), memoized."""
+        if id(fi) in self._body_cache:
+            return self._body_cache[id(fi)]
+        module = self._program.modules.get(fi.path)
+        result = None
+        for node in body_nodes(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            desc = None
+            if any(name == pat or name.endswith("." + pat)
+                   for pat in BLOCKING_CALLS):
+                desc = f"`{name}`"
+            elif name == "sleep" and module is not None and \
+                    _imported_from_time(module):
+                desc = "`time.sleep`"
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "result" and not node.args \
+                    and not node.keywords:
+                desc = (f"`{dotted_name(node.func)}()` "
+                        f"(blocking future join)")
+            if desc is None:
+                continue
+            if self._site_suppressed(fi.path, node.lineno):
+                continue
+            result = (fi.path, node.lineno, desc, [fi.qualname])
+            break
+        self._body_cache[id(fi)] = result
+        return result
+
+    def _site_suppressed(self, path: str, line: int) -> bool:
+        """A pragma on the blocking line (in its own module) clears all
+        async callers — the engine only sees the caller-side location,
+        so the callee-side pragma is honoured here."""
+        module = self._program.modules.get(path)
+        if module is None:
+            return False
+        probe = Violation(self.name, path, line, 0, "")
+        return module.suppressed(probe)
 
 
 def _loop_body_nodes(func) -> set:

@@ -9,30 +9,28 @@ runtime. This rule cross-checks every client-side
     core._gcs_call("Method", ...)     (the redial wrapper)
 
 string-literal method against the union of handler registrations seen
-anywhere in the scanned tree:
+anywhere in the scanned tree.
 
-  * dict literal passed to ``RpcServer({...})``;
-  * dict literal bound to a ``handlers=`` keyword (rpc.connect);
-  * ``<x>.handlers.update({...})`` (task_executor worker services);
-  * dict literal returned from / assigned inside a function whose name
-    contains "handlers" (gcs/raylet/core_worker ``_handlers()``);
-  * dict literal assigned to a variable named ``handlers``.
-
-Calls whose method is not a string literal (generic forwarders like
-``_gcs_call``'s own body) are out of scope by construction. Scan whole
-packages: registrations from one module satisfy calls from another.
+Since v2 both sides come from the shared call-graph substrate
+(callgraph.Program's RPC index) — the same registration detection
+(``RpcServer({...})``, ``handlers=`` kwargs, ``.handlers.update``,
+dicts in ``*handlers*`` functions, ``handlers = {...}`` assignments)
+also feeds rpc-schema's payload checking, so the two rules can never
+disagree about what counts as a registration. Calls whose method is
+not a string literal (generic forwarders like ``_gcs_call``'s own
+body) are out of scope by construction. Scan whole packages:
+registrations from one module satisfy calls from another.
 """
 
 from __future__ import annotations
 
-import ast
-from typing import Dict, Iterable, List, Set, Tuple
+from typing import Iterable, List
 
-from ray_tpu._private.lint.engine import (
-    Module, Rule, Violation, dotted_name, first_str_arg, register,
-)
+from ray_tpu._private.lint.engine import Rule, Violation, register
 
-CLIENT_METHODS = {"call", "push", "call_nowait", "push_nowait", "_gcs_call"}
+# Re-exported for callers that treated this module as the source of
+# truth for the client-side method-call spelling (tests, tooling).
+from ray_tpu._private.lint.callgraph import CLIENT_METHODS  # noqa: F401
 
 
 @register
@@ -42,76 +40,26 @@ class RpcContractRule(Rule):
                    "a registered RPC handler somewhere in the package")
 
     def __init__(self):
-        self.registered: Set[str] = set()
-        # (method, path, line, col, kind)
-        self.client_refs: List[Tuple[str, str, int, int, str]] = []
+        self._program = None
 
-    def collect(self, module: Module) -> Iterable[Violation]:
-        parents: Dict[int, ast.AST] = {}
-        for node in ast.walk(module.tree):
-            for child in ast.iter_child_nodes(node):
-                parents[id(child)] = node
-        for node in ast.walk(module.tree):
-            if isinstance(node, ast.Dict):
-                if self._is_registration(node, parents):
-                    for key in node.keys:
-                        if isinstance(key, ast.Constant) and \
-                                isinstance(key.value, str):
-                            self.registered.add(key.value)
-            elif isinstance(node, ast.Call) and \
-                    isinstance(node.func, ast.Attribute) and \
-                    node.func.attr in CLIENT_METHODS:
-                method = first_str_arg(node)
-                if method is not None:
-                    self.client_refs.append(
-                        (method, module.path, node.lineno,
-                         node.col_offset, node.func.attr))
-        return ()
-
-    def _is_registration(self, node: ast.Dict, parents) -> bool:
-        parent = parents.get(id(node))
-        # RpcServer({...}) positional / any f(handlers={...}) keyword
-        if isinstance(parent, ast.Call):
-            func_name = dotted_name(parent.func)
-            if func_name.rsplit(".", 1)[-1] == "RpcServer" and \
-                    parent.args and parent.args[0] is node:
-                return True
-            for kw in parent.keywords:
-                if kw.arg == "handlers" and kw.value is node:
-                    return True
-            # <x>.handlers.update({...})
-            if isinstance(parent.func, ast.Attribute) and \
-                    parent.func.attr == "update" and \
-                    dotted_name(parent.func.value).endswith("handlers"):
-                return True
-        if isinstance(parent, ast.keyword) and parent.arg == "handlers":
-            return True
-        # handlers = {...} (any scope)
-        if isinstance(parent, ast.Assign) and any(
-                isinstance(t, ast.Name) and "handlers" in t.id
-                for t in parent.targets):
-            return True
-        # return {...} / x = {...} inside def *handlers*():
-        anc = parent
-        while anc is not None:
-            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                return "handlers" in anc.name
-            if isinstance(anc, ast.ClassDef):
-                return False
-            anc = parents.get(id(anc))
-        return False
+    def setup(self, program) -> None:
+        self._program = program
 
     def finalize(self) -> Iterable[Violation]:
         out: List[Violation] = []
-        if not self.registered:
+        if self._program is None:
+            return out
+        rpc = self._program.rpc
+        registered = rpc.registered_methods
+        if not registered:
             # Linting a lone client file: no server side in scope means
             # no contract to check, not a hundred false positives.
             return out
-        for method, path, line, col, kind in self.client_refs:
-            if method not in self.registered:
+        for cc in rpc.client_calls:
+            if cc.method not in registered:
                 out.append(Violation(
-                    self.name, path, line, col,
-                    f"`{kind}(\"{method}\", ...)` has no registered "
+                    self.name, cc.path, cc.lineno, cc.col,
+                    f"`{cc.kind}(\"{cc.method}\", ...)` has no registered "
                     f"handler anywhere in the scanned tree — a renamed "
                     f"or typo'd RPC method hangs the caller at runtime"))
         return out

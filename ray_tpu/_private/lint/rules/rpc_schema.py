@@ -1,0 +1,524 @@
+"""rpc-schema: whole-program RPC header-schema inference and checking.
+
+rpc-contract (v1) proves every client method *string* has a handler;
+this rule proves the *payload* matches what the handler actually reads.
+The wire protocol is schemaless — ``call("Method", {header dict})`` —
+so a missing or typo'd header key is invisible until the handler raises
+``KeyError`` at runtime (surfacing as an opaque error reply) or, worse,
+silently takes a ``.get()`` default the caller never intended.
+
+Inference runs on the shared call-graph substrate (callgraph.Program):
+
+  server side — each registration's handler expression is resolved to
+  its ``def``; the body's uses of the header parameter (3rd positional:
+  ``(self, conn, header, bufs)``) are classified:
+
+    * ``header["k"]``                        -> k required
+    * ``header.get("k", ...)`` / ``"k" in header`` / ``header.pop("k", d)``
+                                             -> k optional
+    * truthiness / ``is None`` guards        -> ignored (benign)
+    * anything dynamic (iteration, ``header[var]``, passing ``header``
+      on, ``**header``, ``.items()``...)     -> schema OPEN: required
+      keys still hold, unknown-key checking is disabled
+
+  reply side — the same handler bodies yield a reply schema from their
+  ``return {...}`` literals (``return {...}, bufs`` counts): keys a
+  return path *can* produce (union) and keys *every* return produces
+  (intersection). Any non-literal return (a forwarded value, a Future
+  from a sync fast-path handler) marks the reply OPEN and reply checks
+  go out of scope for that method.
+
+  client side — every ``call/push/call_nowait/push_nowait/_gcs_call``
+  whose header is a dict literal with constant keys is checked:
+
+    * a key required by EVERY handler of that method but absent from
+      the literal (or the call sends no header at all) -> violation;
+    * a key no handler knows, when every handler's schema is closed
+      -> violation (with a did-you-mean suggestion).
+
+  and every ``reply["k"]`` read through a ``reply, bufs = await
+  conn.call(...)`` tuple binding — including sync bridges like
+  ``self._run(self._gcs_call(...))`` / ``run_until_complete`` /
+  ``wait_for``; a name bound from several reply calls (branches) is
+  judged against the union of their reply keys, and rebinding to a
+  non-reply value kills checking — is checked against the reply
+  union: a key NO return path ever produces is a guaranteed KeyError
+  when the reply lands.
+
+  Registrations that provably dangle (``self.x`` with no ``x`` on any
+  class and no bases to inherit it) and handlers whose signature
+  cannot accept ``(conn, header, bufs)`` are flagged at the def site —
+  both dispatch failures the string check alone cannot see.
+
+Methods with several handlers (e.g. "Published" served by raylet AND
+core worker) use union semantics: required = intersection, known =
+union, closed = all closed — a key is only an error when it is wrong
+for every server the call could reach.
+"""
+
+from __future__ import annotations
+
+import ast
+import difflib
+from typing import Dict, Iterable, List, Optional, Set
+
+from ray_tpu._private.lint.engine import (
+    Rule, Violation, body_nodes, dotted_name, first_str_arg, register,
+)
+
+# header.<method>(...) calls that keep the schema closed.
+_GET_LIKE = {"get", "pop"}
+# header.<method>(...) calls that open the schema (dynamic key use).
+_OPEN_ATTRS = {"items", "keys", "values", "update", "copy", "setdefault"}
+# Client-side calls whose result is a reply future/tuple (push/push_
+# nowait are one-way: no reply to check).
+_REPLYING = {"call", "_gcs_call"}
+# Wrappers a reply flows through unchanged on sync or timeout bridges:
+# reply, _ = self._run(self._gcs_call(...)) / wait_for(conn.call(), t).
+_BRIDGES = {"_run", "run_until_complete", "wait_for"}
+
+
+class HandlerSchema:
+    __slots__ = ("fi", "required", "optional", "open",
+                 "reply_keys", "reply_guaranteed", "reply_open")
+
+    def __init__(self, fi, required: Set[str], optional: Set[str],
+                 open_: bool, reply_keys: Set[str],
+                 reply_guaranteed: Set[str], reply_open: bool):
+        self.fi = fi
+        self.required = required
+        self.optional = optional
+        self.open = open_
+        self.reply_keys = reply_keys
+        self.reply_guaranteed = reply_guaranteed
+        self.reply_open = reply_open
+
+    @property
+    def known(self) -> Set[str]:
+        return self.required | self.optional
+
+
+class MethodSchema:
+    __slots__ = ("method", "handlers")
+
+    def __init__(self, method: str, handlers: List[HandlerSchema]):
+        self.method = method
+        self.handlers = handlers
+
+    @property
+    def required(self) -> Set[str]:
+        """Keys required by EVERY handler — the only ones a client can
+        be proven to be missing."""
+        req = None
+        for h in self.handlers:
+            req = h.required if req is None else req & h.required
+        return req or set()
+
+    @property
+    def known(self) -> Set[str]:
+        out: Set[str] = set()
+        for h in self.handlers:
+            out |= h.known
+        return out
+
+    @property
+    def closed(self) -> bool:
+        return bool(self.handlers) and all(not h.open for h in self.handlers)
+
+    @property
+    def reply_keys(self) -> Set[str]:
+        """Keys SOME return path of SOME handler can produce."""
+        out: Set[str] = set()
+        for h in self.handlers:
+            out |= h.reply_keys
+        return out
+
+    @property
+    def reply_guaranteed(self) -> Set[str]:
+        """Keys EVERY return path of EVERY handler produces."""
+        guar = None
+        for h in self.handlers:
+            guar = h.reply_guaranteed if guar is None \
+                else guar & h.reply_guaranteed
+        return guar or set()
+
+    @property
+    def reply_open(self) -> bool:
+        return any(h.reply_open for h in self.handlers) or \
+            not self.handlers
+
+    def where(self) -> str:
+        return ", ".join(sorted(
+            f"{h.fi.path}:{h.fi.node.lineno}" for h in self.handlers))
+
+
+def infer_handler_schema(fi) -> HandlerSchema:
+    """Classify every use of the handler's header parameter."""
+    pos = fi.positional_params()
+    if len(pos) < 2:
+        return HandlerSchema(fi, set(), set(), True, *_infer_reply(fi))
+    header_name = pos[1]
+    required: Set[str] = set()
+    optional: Set[str] = set()
+    open_ = False
+    # First source line of each constant-key subscript, load vs store:
+    # a write demotes a key to optional ONLY when it precedes every
+    # read — `header["k"] = default(); use(header["k"])` needs nothing
+    # from the caller, but `use(header["k"]); header["k"] = x` still
+    # KeyErrors on the first read, so the key stays required.
+    sub_loads: Dict[str, int] = {}
+    sub_stores: Dict[str, int] = {}
+    parents: Dict[int, ast.AST] = {}
+    for node in ast.walk(fi.node):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    for node in ast.walk(fi.node):
+        if not (isinstance(node, ast.Name) and node.id == header_name):
+            continue
+        parent = parents.get(id(node))
+        if isinstance(parent, ast.Subscript) and parent.value is node:
+            sl = parent.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                tab = sub_loads if isinstance(parent.ctx, ast.Load) \
+                    else sub_stores
+                tab[sl.value] = min(tab.get(sl.value, parent.lineno),
+                                    parent.lineno)
+            else:
+                open_ = True                 # header[variable]
+        elif isinstance(parent, ast.Attribute) and parent.value is node:
+            call = parents.get(id(parent))
+            is_call = isinstance(call, ast.Call) and call.func is parent
+            if is_call and parent.attr in _GET_LIKE:
+                k = call.args[0] if call.args else None
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    if parent.attr == "pop" and len(call.args) == 1 \
+                            and not call.keywords:
+                        required.add(k.value)
+                    else:
+                        optional.add(k.value)
+                else:
+                    open_ = True
+            elif is_call and parent.attr in _OPEN_ATTRS:
+                open_ = True
+            else:
+                open_ = True                 # header.foo / bound method ref
+        elif isinstance(parent, ast.Compare) and node in parent.comparators:
+            ops = parent.ops
+            if len(ops) == 1 and isinstance(ops[0], (ast.In, ast.NotIn)) \
+                    and isinstance(parent.left, ast.Constant) \
+                    and isinstance(parent.left.value, str):
+                optional.add(parent.left.value)
+            elif all(isinstance(o, (ast.Is, ast.IsNot, ast.Eq, ast.NotEq))
+                     for o in ops):
+                pass                         # `header is None` guards
+            else:
+                open_ = True
+        elif isinstance(parent, (ast.BoolOp, ast.UnaryOp)):
+            pass                             # `header or {}` / `not header`
+        elif isinstance(parent, ast.If) and parent.test is node:
+            pass                             # bare truthiness test
+        elif isinstance(parent, (ast.Assign, ast.AugAssign)) \
+                and isinstance(node.ctx, ast.Store):
+            pass                             # rebinding (`header = ...`)
+        elif isinstance(parent, ast.arguments):
+            pass                             # the parameter itself
+        else:
+            open_ = True                     # escaped: passed on, returned...
+    required.update(sub_loads)
+    for k, store_line in sub_stores.items():
+        if k not in sub_loads or store_line < sub_loads[k]:
+            optional.add(k)                  # write-first (or write-only)
+    # A guarded read (`if "k" in header: header["k"]`) is optional, not
+    # required — the membership test wins.
+    required -= optional
+    if not required and not optional and not open_:
+        # Handler never touches its header: nothing to infer — treat as
+        # open rather than flagging every caller's keys as unknown.
+        open_ = True
+    reply_keys, reply_guaranteed, reply_open = _infer_reply(fi)
+    return HandlerSchema(fi, required, optional, open_,
+                         reply_keys, reply_guaranteed, reply_open)
+
+
+def _infer_reply(fi):
+    """(keys, guaranteed, open) over the handler's own ``return``
+    statements. ``return {...}`` and ``return {...}, bufs`` literals
+    contribute keys; a bare/None return contributes none (guaranteed
+    drops to the empty set); anything else — a forwarded variable, a
+    sync fast-path handler's Future — marks the reply OPEN and callers'
+    reply-key reads are out of scope for this method."""
+    keys: Set[str] = set()
+    guaranteed: Optional[Set[str]] = None
+    open_ = False
+    for node in body_nodes(fi.node):
+        if not isinstance(node, ast.Return):
+            continue
+        value = node.value
+        if isinstance(value, ast.Tuple) and len(value.elts) == 2:
+            value = value.elts[0]   # (reply_header, bufs)
+        if value is None or (isinstance(value, ast.Constant) and
+                             value.value is None):
+            guaranteed = set()
+            continue
+        if isinstance(value, ast.Dict) and all(
+                isinstance(k, ast.Constant) and isinstance(k.value, str)
+                for k in value.keys):
+            ks = {k.value for k in value.keys}
+            keys |= ks
+            guaranteed = ks if guaranteed is None else guaranteed & ks
+        else:
+            open_ = True
+    return keys, guaranteed or set(), open_
+
+
+def infer_schemas(program) -> Dict[str, MethodSchema]:
+    """Per-method schemas over every registration in the program (also
+    the `--dump-schemas` backend). Memoized on the Program — the rule's
+    finalize pass, the JSON reporter, and bench.py all read one table
+    instead of re-walking every handler body."""
+    cached = getattr(program, "_schema_cache", None)
+    if cached is not None:
+        return cached
+    out: Dict[str, MethodSchema] = {}
+    for method, regs in program.rpc.registrations.items():
+        seen = set()
+        handlers: List[HandlerSchema] = []
+        for reg in regs:
+            fi = reg.handler
+            if fi is None:
+                continue
+            key = (fi.path, fi.qualname)
+            if key in seen:
+                continue
+            seen.add(key)
+            handlers.append(infer_handler_schema(fi))
+        if handlers:
+            out[method] = MethodSchema(method, handlers)
+    program._schema_cache = out
+    return out
+
+
+def schemas_as_dict(program) -> dict:
+    """JSON-friendly dump of the inferred contract."""
+    out = {}
+    for method, ms in sorted(infer_schemas(program).items()):
+        out[method] = {
+            "required": sorted(ms.required),
+            "optional": sorted(ms.known - ms.required),
+            "closed": ms.closed,
+            "reply": sorted(ms.reply_keys),
+            "reply_guaranteed": sorted(ms.reply_guaranteed),
+            "reply_open": ms.reply_open,
+            "handlers": sorted(
+                f"{h.fi.path}:{h.fi.node.lineno}:{h.fi.qualname}"
+                for h in ms.handlers),
+        }
+    return out
+
+
+def _unwrap_reply_call(node: ast.AST) -> Optional[ast.Call]:
+    """The client Call whose reply tuple an expression evaluates to,
+    seen through ``await`` and the known sync/timeout bridges — or None
+    when the value is not provably a reply."""
+    while True:
+        if isinstance(node, ast.Await):
+            node = node.value
+            continue
+        if isinstance(node, ast.Call):
+            term = dotted_name(node.func).rsplit(".", 1)[-1]
+            if term in _REPLYING:
+                return node
+            if term in _BRIDGES and node.args:
+                node = node.args[0]
+                continue
+        return None
+
+
+def _reply_read_events(fi):
+    """Sorted (line, col, prio, kind, name, payload) events for one
+    function: reply-tuple bindings, rebindings of the same names, and
+    constant-key subscript loads, in linear source order. A read that
+    precedes every binding (loop carry) simply goes unchecked —
+    conservative."""
+    binds = {}                       # id(Name node) -> method string
+    for node in body_nodes(fi.node):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        target = node.targets[0]
+        if isinstance(target, ast.Tuple) and len(target.elts) == 2 and \
+                isinstance(target.elts[0], ast.Name):
+            call = _unwrap_reply_call(node.value)
+            if call is not None:
+                method = first_str_arg(call)
+                if method is not None:
+                    binds[id(target.elts[0])] = method
+    if not binds:
+        return []                    # no reply in scope: skip the scan
+    events = []
+    for node in body_nodes(fi.node):
+        if isinstance(node, ast.Name) and \
+                isinstance(node.ctx, (ast.Store, ast.Del)):
+            if id(node) in binds:
+                events.append((node.lineno, node.col_offset, 0,
+                               "bind", node.id, binds[id(node)]))
+            else:
+                events.append((node.lineno, node.col_offset, 0,
+                               "kill", node.id, None))
+        elif isinstance(node, ast.Subscript) and \
+                isinstance(node.value, ast.Name) and \
+                isinstance(node.ctx, ast.Load) and \
+                isinstance(node.slice, ast.Constant) and \
+                isinstance(node.slice.value, str):
+            events.append((node.lineno, node.col_offset, 1,
+                           "read", node.value.id, node.slice.value))
+    events.sort(key=lambda e: (e[0], e[1], e[2]))
+    return events
+
+
+def _literal_keys(header: ast.AST) -> Optional[Set[str]]:
+    """Key set of a dict literal, or None when not statically knowable
+    (non-dict, `**spread`, computed keys)."""
+    if not isinstance(header, ast.Dict):
+        return None
+    keys: Set[str] = set()
+    for k in header.keys:
+        if k is None:                        # {**spread}
+            return None
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            return None
+        keys.add(k.value)
+    return keys
+
+
+@register
+class RpcSchemaRule(Rule):
+    name = "rpc-schema"
+    description = ("client header dicts must satisfy the key schema "
+                   "inferred from the registered handlers' bodies")
+
+    def __init__(self):
+        self._program = None
+
+    def setup(self, program) -> None:
+        self._program = program
+
+    def finalize(self) -> Iterable[Violation]:
+        out: List[Violation] = []
+        if self._program is None:
+            return out
+        rpc = self._program.rpc
+        schemas = infer_schemas(self._program)
+
+        seen_handlers = set()
+        for method, regs in rpc.registrations.items():
+            for reg in regs:
+                if reg.provably_missing:
+                    out.append(Violation(
+                        self.name, reg.path, reg.lineno, reg.col,
+                        f'"{method}" is registered to `{reg.value_desc}` '
+                        f"but no class in the scanned tree defines that "
+                        f"method — dispatch raises AttributeError at "
+                        f"registration time"))
+                fi = reg.handler
+                if fi is None or (fi.path, fi.qualname) in seen_handlers:
+                    continue
+                seen_handlers.add((fi.path, fi.qualname))
+                pos = fi.positional_params()
+                min_req = len(pos) - len(fi.node.args.defaults)
+                if min_req > 3 or (len(pos) < 3 and not fi.has_var_pos):
+                    out.append(Violation(
+                        self.name, fi.path, fi.node.lineno,
+                        fi.node.col_offset,
+                        f"handler `{fi.qualname}` for \"{method}\" takes "
+                        f"{len(pos)} non-self positional arg(s); dispatch "
+                        f"always calls it with (conn, header, bufs)"))
+
+        for cc in rpc.client_calls:
+            ms = schemas.get(cc.method)
+            if ms is None:
+                continue                     # existence is rpc-contract's job
+            required = ms.required
+            if cc.header is None or (isinstance(cc.header, ast.Constant)
+                                     and cc.header.value is None):
+                if required:
+                    out.append(Violation(
+                        self.name, cc.path, cc.lineno, cc.col,
+                        f'`{cc.kind}("{cc.method}")` sends no header but '
+                        f"the handler ({ms.where()}) requires key(s) "
+                        f"{_fmt(required)} — the handler raises TypeError "
+                        f"subscripting None"))
+                continue
+            keys = _literal_keys(cc.header)
+            if keys is None:
+                continue                     # dynamic header: out of scope
+            missing = required - keys
+            if missing:
+                out.append(Violation(
+                    self.name, cc.path, cc.lineno, cc.col,
+                    f'`{cc.kind}("{cc.method}", {{...}})` is missing '
+                    f"required header key(s) {_fmt(missing)} — the "
+                    f"handler ({ms.where()}) raises KeyError at runtime"))
+            if ms.closed:
+                unknown = keys - ms.known
+                for k in sorted(unknown):
+                    hint = difflib.get_close_matches(k, ms.known, n=1)
+                    suggest = f' (did you mean "{hint[0]}"?)' if hint else ""
+                    out.append(Violation(
+                        self.name, cc.path, cc.lineno, cc.col,
+                        f'`{cc.kind}("{cc.method}", {{...}})` sends key '
+                        f'"{k}" that no handler ({ms.where()}) ever reads'
+                        f"{suggest} — a typo'd key silently drops the "
+                        f"field on the floor"))
+
+        out.extend(self._reply_read_violations(schemas))
+        return out
+
+    def _reply_read_violations(self, schemas) -> List[Violation]:
+        """``reply["k"]`` reads of keys no return path produces, through
+        ``reply, bufs = await conn.call(...)`` tuple bindings.
+
+        A name bound from several reply calls (one per branch of an
+        ``if``/``try``) is checked against the UNION of those methods'
+        reply keys — linear source order cannot tell which branch ran,
+        so a key any of them can produce passes. Rebinding to a
+        non-reply value kills checking for the name from that point on.
+        """
+        out: List[Violation] = []
+        for fi in self._program.functions.values():
+            events = _reply_read_events(fi)
+            name_methods: Dict[str, Set[str]] = {}
+            for _, _, _, kind, name, payload in events:
+                if kind == "bind":
+                    name_methods.setdefault(name, set()).add(payload)
+            bound: Set[str] = set()
+            for lineno, col, _prio, kind, name, payload in events:
+                if kind == "bind":
+                    bound.add(name)
+                elif kind == "kill":
+                    bound.discard(name)
+                else:
+                    if name not in bound:
+                        continue
+                    mss = [schemas.get(m) for m in name_methods[name]]
+                    if any(ms is None or ms.reply_open for ms in mss):
+                        continue
+                    keys = set().union(*(ms.reply_keys for ms in mss))
+                    if payload in keys:
+                        continue
+                    methods = ", ".join(
+                        f'"{m}"' for m in sorted(name_methods[name]))
+                    where = "; ".join(ms.where() for ms in mss)
+                    hint = difflib.get_close_matches(payload, keys, n=1)
+                    suggest = f' (did you mean "{hint[0]}"?)' \
+                        if hint else ""
+                    out.append(Violation(
+                        self.name, fi.path, lineno, col,
+                        f'`{name}["{payload}"]` reads a reply key no '
+                        f"return path of {methods} ({where}) ever "
+                        f"produces{suggest} — a guaranteed KeyError "
+                        f"when the reply lands"))
+        return out
+
+
+def _fmt(keys: Set[str]) -> str:
+    return ", ".join(f'"{k}"' for k in sorted(keys))

@@ -38,7 +38,13 @@ _tried = False
 
 
 def load_fastpath():
-    """The ``_rtpu_fastpath`` extension module, or None (cached)."""
+    """The ``_rtpu_fastpath`` extension module, or None (cached).
+
+    May COMPILE on a cold cache (a subprocess cc run, seconds): call it
+    from process startup or an executor thread, never from an event
+    loop. The data-plane hot path (``copy_into``) deliberately goes
+    through :func:`loaded_fastpath` instead, so a cold cache can only
+    ever cost a pure-Python copy — not a compiler run on the loop."""
     global _mod, _tried
     if _tried:
         return _mod
@@ -56,6 +62,14 @@ def load_fastpath():
             _mod = None
         _tried = True
         return _mod
+
+
+def loaded_fastpath():
+    """The already-loaded extension module or None — never builds.
+    Processes opt into the native tier by warming ``load_fastpath()``
+    once at boot (raylet start does it in an executor, worker_main and
+    CoreWorker before their loops exist)."""
+    return _mod
 
 
 # --------------------------------------------------------------------------
@@ -118,8 +132,14 @@ def _as_byte_view(buf) -> memoryview:
 def copy_into(dst, dst_off: int, src, chunk_bytes: int | None = None) -> int:
     """Copy all of ``src`` (any contiguous buffer) into ``dst`` at
     ``dst_off``; returns bytes copied. Never materializes intermediate
-    ``bytes``. ``chunk_bytes`` overrides the stripe size (tests)."""
-    mod = load_fastpath()
+    ``bytes``. ``chunk_bytes`` overrides the stripe size (tests).
+
+    Uses only the ALREADY-loaded native module: raylint's transitive
+    async-blocking pass proved the old lazy ``load_fastpath()`` here
+    could inject a cold-cache compiler run (subprocess cc, seconds)
+    into the raylet event loop via the chunked-pull path. A process
+    that never warmed the native tier gets the pure-Python copy."""
+    mod = loaded_fastpath()
     native = mod.copy_into if mod is not None and \
         hasattr(mod, "copy_into") else None
     chunk = chunk_bytes or COPY_CHUNK_BYTES
@@ -141,6 +161,7 @@ def copy_into(dst, dst_off: int, src, chunk_bytes: int | None = None) -> int:
                                    min(chunk, n - off))
                     for off in range(0, n, chunk)]
                 for f in futs:
+                    # raylint: disable=async-blocking — bounded stripe join: workers are pure GIL-releasing memcpy, so the join lasts only as long as the overlapped copy (ms); an executor hop here would add latency to every large data-plane copy
                     f.result()
                 copy_stats["striped"] += 1
                 return n

@@ -202,6 +202,13 @@ class Raylet:
     # ------------------------------------------------------------- lifecycle
 
     async def start(self, gcs_address: str, listen_address: str = "") -> str:
+        # Warm the native copy tier off-loop: copy_into on the chunked
+        # pull path uses only the already-loaded module (it never
+        # builds), so the one compile a cold cache costs happens here,
+        # in an executor, before the raylet serves anything.
+        from ray_tpu._private import native
+        await asyncio.get_running_loop().run_in_executor(
+            None, native.load_fastpath)
         sock_dir = os.path.join(self.session_dir, "sockets")
         os.makedirs(sock_dir, exist_ok=True)
         if not listen_address:

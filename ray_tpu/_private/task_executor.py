@@ -193,6 +193,12 @@ class TaskExecutor:
         self._exec_thread.start()
         self._actor_instance: Any = None
         self._actor_id: bytes = b""
+        # Incarnation this worker serves, stamped by CreateActor. A
+        # PushActorTasks batch carrying a DIFFERENT incarnation is a
+        # split-brain signal (the pusher resolved a restart this worker
+        # doesn't represent): sever the connection so the pusher's
+        # conn-lost path requeues inflight and re-resolves via the GCS.
+        self._actor_incarnation = -1
         self._actor_is_asyncio = False
         self._actor_sema: Optional[asyncio.Semaphore] = None
         self._actor_pool: Optional[ThreadPoolExecutor] = None
@@ -637,6 +643,7 @@ class TaskExecutor:
                     "error": f"{type(e).__name__}: {e}\n{traceback.format_exc()}"}
         self._actor_instance = instance
         self._actor_id = header["actor_id"]
+        self._actor_incarnation = header.get("incarnation", 0)
         self._actor_is_asyncio = creation.get("is_asyncio", False)
         max_concurrency = creation.get("max_concurrency", 1)
         if self._actor_is_asyncio:
@@ -697,6 +704,20 @@ class TaskExecutor:
         moment it lands (reference: per-call replies in
         direct_actor_transport.h)."""
         loop = asyncio.get_running_loop()
+        pushed = header.get("incarnation", -1)
+        if pushed != -1 and self._actor_incarnation != -1 and \
+                pushed != self._actor_incarnation:
+            # Stale-incarnation push (the pusher thinks it is talking to
+            # a different restart generation). Executing it would run
+            # tasks on a superseded actor — drop the connection instead:
+            # the pusher's on_disconnect handler requeues its inflight
+            # entries and re-resolves the live address via the GCS.
+            logger.warning(
+                "rejecting PushActorTasks for incarnation %d "
+                "(this worker serves %d); severing connection",
+                pushed, self._actor_incarnation)
+            conn._mark_closed()
+            return {"ok": False, "reason": "stale incarnation"}
         tasks = header["tasks"]
         serial = not self._actor_is_asyncio and self._actor_pool is None
         if serial:

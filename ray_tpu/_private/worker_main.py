@@ -42,12 +42,18 @@ def main(argv=None):
     if dump_s > 0:
         faulthandler.dump_traceback_later(dump_s, repeat=True)
 
-    from ray_tpu._private import rpc
+    from ray_tpu._private import native, rpc
     from ray_tpu._private.config import RayTpuConfig, set_config
     from ray_tpu._private.core_worker import CoreWorker
     from ray_tpu._private.task_executor import TaskExecutor
     import ray_tpu.actor  # registers the actor-handle factory hook
     import ray_tpu.worker as worker_mod
+
+    # Warm the native copy tier before the loop exists: copy_into never
+    # builds (a cold-cache compile on the loop was a raylint transitive
+    # async-blocking finding), so the one place that may pay the
+    # compiler is process boot.
+    native.load_fastpath()
 
     loop = asyncio.new_event_loop()
     asyncio.set_event_loop(loop)

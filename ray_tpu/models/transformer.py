@@ -4,7 +4,8 @@ One model definition, two execution modes sharing every line of math:
 
 * **oracle** — ``ParallelConfig()`` with all axes ``None``: plain
   single-device forward (the differential-test reference).
-* **SPMD** — inside ``jax.shard_map`` over the 4-axis mesh
+* **SPMD** — inside ``shard_map`` (the version-portable accessor in
+  ray_tpu.parallel.collectives) over the 4-axis mesh
   (``ray_tpu.parallel.mesh``): Megatron-style tensor parallelism on
   ``tp`` (column-parallel QKV/gate/up, row-parallel O/down + ``psum``;
   backward fixed up by ``tp_copy``), ring or Ulysses attention on
@@ -31,7 +32,8 @@ from jax import lax
 from ray_tpu.ops.attention import flash_attention
 from ray_tpu.ops.norms import rmsnorm
 from ray_tpu.ops.rotary import apply_rotary, rope_frequencies
-from ray_tpu.parallel.collectives import tp_allreduce, tp_copy
+from ray_tpu.parallel.collectives import (axis_size, shard_map,
+                                           tp_allreduce, tp_copy)
 from ray_tpu.parallel.pipeline import pipeline_spmd
 from ray_tpu.parallel.ring_attention import ring_attention
 from ray_tpu.parallel.ulysses import ulysses_attention
@@ -257,7 +259,7 @@ def make_train_step(cfg: TransformerConfig, pcfg: ParallelConfig,
         #   divide by n_pp; pp-replicated leaves (embed, final_norm)
         #   then need their per-rank halves psum'd over pp.
         # * dp/sp — distinct data shards: pmean.
-        redundancy = float(lax.axis_size(pcfg.pp)) if pcfg.pp else 1.0
+        redundancy = float(axis_size(pcfg.pp)) if pcfg.pp else 1.0
 
         def reduce_leaf(g, spec):
             g = g / redundancy
@@ -284,7 +286,7 @@ def make_train_step(cfg: TransformerConfig, pcfg: ParallelConfig,
     opt_specs = _opt_state_specs(optimizer, cfg, pspecs)
     batch_spec = {"tokens": P(pcfg.dp, pcfg.sp),
                   "targets": P(pcfg.dp, pcfg.sp)}
-    step = jax.shard_map(
+    step = shard_map(
         local_step, mesh=mesh,
         in_specs=(pspecs, opt_specs, batch_spec),
         out_specs=(pspecs, opt_specs, P()),

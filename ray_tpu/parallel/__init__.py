@@ -29,6 +29,7 @@ from ray_tpu.parallel.collectives import (  # noqa: F401
     ppermute_ring,
     psum,
     psum_scatter,
+    shard_map,
 )
 from ray_tpu.parallel.sharding import (  # noqa: F401
     logical_to_mesh,

@@ -5,14 +5,58 @@ TPU-native equivalent of ``ray.util.collective``'s op surface
 allgather :409, reducescatter :457, broadcast :358, send/recv :514+),
 expressed as XLA collectives over mesh axis names so they compile onto
 ICI instead of going through NCCL communicators. Used inside
-``jax.shard_map``/``pjit`` bodies.
+``shard_map``/``pjit`` bodies (see :func:`shard_map` below for the
+version-portable accessor).
 """
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+# ------------------------------------------------------------- shard_map
+#
+# jax moved shard_map across versions: old releases ship it only as
+# ``jax.experimental.shard_map.shard_map`` with a ``check_rep=`` kwarg;
+# newer ones promote it to ``jax.shard_map`` and rename the kwarg to
+# ``check_vma=``. Everything in this repo (parallel schedules, the SPMD
+# train step, the differential tests) routes through this accessor so
+# the pinned jax can move in either direction without touching call
+# sites.
+
+@functools.lru_cache(maxsize=1)
+def _shard_map_impl():
+    """(callable, accepted_kwarg_names) for the hosting jax."""
+    import inspect
+
+    impl = getattr(jax, "shard_map", None)
+    if impl is None:
+        from jax.experimental.shard_map import shard_map as impl
+    try:
+        params = frozenset(inspect.signature(impl).parameters)
+    except (TypeError, ValueError):
+        params = frozenset()
+    return impl, params
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, **kwargs):
+    """Version-portable ``jax.shard_map``.
+
+    Accepts either spelling of the replication-check kwarg
+    (``check_vma=`` / ``check_rep=``) and translates to whatever the
+    hosting jax understands; every other kwarg passes through.
+    """
+    impl, params = _shard_map_impl()
+    for ours, theirs in (("check_vma", "check_rep"),
+                         ("check_rep", "check_vma")):
+        if ours in kwargs and ours not in params and theirs in params:
+            kwargs[theirs] = kwargs.pop(ours)
+    return impl(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                **kwargs)
 
 
 def psum(x, axis: str):
@@ -44,7 +88,7 @@ def all_to_all(x, axis: str, *, split_dim: int, concat_dim: int,
 
 def ppermute_ring(x, axis: str, *, shift: int = 1):
     """Rotate shards around the axis ring (ring attention's hop)."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name=axis, perm=perm)
 
@@ -54,10 +98,17 @@ def axis_index(axis: str):
 
 
 def axis_size(axis: str) -> int:
-    return lax.axis_size(axis)
-
-
-import functools
+    """Static (Python int) size of a mesh axis, version-portably:
+    ``lax.axis_size`` where it exists; on older jax the axis frame —
+    which some releases hand back as the bare int itself. Every
+    schedule needing the size for Python-level control flow (pipeline
+    step counts, ring permutations) goes through here."""
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis)
+    from jax import core
+    frame = core.axis_frame(axis)
+    return getattr(frame, "size", frame)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))

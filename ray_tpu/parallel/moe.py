@@ -7,7 +7,8 @@ and exchanged with a single tiled ``lax.all_to_all`` each way, which XLA
 lowers to ICI all-to-all. Static shapes throughout (dropped tokens pass
 through on the residual path, standard Switch-Transformer behavior).
 
-Call inside ``jax.shard_map``; x: [T_local, D]; experts sharded so each
+Call inside ``shard_map`` (ray_tpu.parallel.collectives' version-
+portable accessor); x: [T_local, D]; experts sharded so each
 rank owns E_local = E / axis_size experts.
 """
 
@@ -16,6 +17,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from ray_tpu.parallel.collectives import axis_size
 
 
 def moe_dispatch_combine(x, gate_logits, expert_fn, expert_params, *,
@@ -26,7 +29,7 @@ def moe_dispatch_combine(x, gate_logits, expert_fn, expert_params, *,
     expert_fn(params, xs): params for E_local experts with leading dim
     E_local; xs [E_local, cap_total, D] → [E_local, cap_total, D].
     """
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     T, D = x.shape
     E = gate_logits.shape[-1]
     if E % n:

@@ -8,7 +8,8 @@ hop between neighbor ranks with ``lax.ppermute`` inside a ``lax.scan``
 ``(n_stages - 1) / n_microbatches``. Differentiable: jax.grad through
 the scan yields the reverse (backward) schedule automatically.
 
-Call inside ``jax.shard_map`` over the ``pp`` axis.
+Call inside ``shard_map`` (the version-portable accessor in
+ray_tpu.parallel.collectives) over the ``pp`` axis.
 """
 
 from __future__ import annotations
@@ -18,6 +19,8 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from ray_tpu.parallel.collectives import axis_size
 
 
 # AD note (verified empirically, jax 0.9 shard_map check_vma=False):
@@ -38,7 +41,7 @@ def pipeline_spmd(stage_fn, stage_params, x, *, axis: str = "pp",
     valid on every rank (last stage's results are psum-broadcast).
     num_microbatches defaults to the pipeline depth.
     """
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     rank = lax.axis_index(axis)
     B = x.shape[0]
     M = num_microbatches or n

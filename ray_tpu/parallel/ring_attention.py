@@ -7,7 +7,8 @@ K/V shards rotate around the ``sp`` axis ring via ``lax.ppermute``
 online-softmax accumulator over its local Q shard, so attention over a
 sequence of length ``n_sp * T_local`` never materializes on one chip.
 
-Call inside ``jax.shard_map`` with q/k/v sharded on dim 1 (seq) over
+Call inside ``shard_map`` (ray_tpu.parallel.collectives' version-
+portable accessor) with q/k/v sharded on dim 1 (seq) over
 ``axis``. Shapes: [batch, seq_local, heads, head_dim].
 """
 
@@ -16,6 +17,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from ray_tpu.parallel.collectives import axis_size
 
 
 def _block_attn(q, k, v, q_pos, kv_pos, causal, sm_scale):
@@ -39,7 +42,7 @@ def _block_attn(q, k, v, q_pos, kv_pos, causal, sm_scale):
 def ring_attention(q, k, v, *, axis: str = "sp", causal: bool = True,
                    sm_scale: float | None = None):
     """Blockwise ring attention. Returns [B, T_local, H, D] in q.dtype."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     my = lax.axis_index(axis)
     B, T, H, D = q.shape
     sm_scale = sm_scale if sm_scale is not None else D ** -0.5

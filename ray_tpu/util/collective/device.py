@@ -44,8 +44,9 @@ def device_count() -> int:
 def _allreduce_fn(op: str):
     import jax
     import jax.numpy as jnp
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel.collectives import shard_map
 
     mesh = _mesh()
 
@@ -108,8 +109,9 @@ def mesh_reduce(contributions, op: str):
 @lru_cache(maxsize=1)
 def _allgather_fn():
     import jax
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel.collectives import shard_map
 
     mesh = _mesh()
 

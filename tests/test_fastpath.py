@@ -212,6 +212,9 @@ def test_copy_engine_chunking_and_fallback():
     src = np.random.default_rng(11).integers(
         0, 256, 3 * 1024 * 1024 + 17, dtype=np.uint8)
     a = bytearray(len(src) + 9)
+    # copy_into never builds (loaded_fastpath): warm explicitly so the
+    # striped-native path is what this test exercises.
+    native.load_fastpath()
     native.copy_into(a, 9, src, chunk_bytes=64 * 1024)  # many stripes
     b = bytearray(len(src) + 9)
     saved = native._mod, native._tried

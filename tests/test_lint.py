@@ -16,7 +16,10 @@ import textwrap
 import pytest
 
 from ray_tpu._private.lint import lint_sources
-from ray_tpu._private.lint.engine import main as lint_main
+from ray_tpu._private.lint.engine import (
+    Module, analyze_modules, find_stale_pragmas, iter_py_files,
+    lint_paths, main as lint_main,
+)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(REPO, "ray_tpu")
@@ -367,6 +370,87 @@ class TestEngine:
         with pytest.raises(ValueError, match="unknown rule"):
             run("x = 1", ["no-such-rule"])
 
+    def test_iter_py_files_dedupes_overlapping_paths(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        sub = pkg / "sub"
+        sub.mkdir(parents=True)
+        (pkg / "a.py").write_text("x = 1\n")
+        (sub / "b.py").write_text("y = 1\n")
+        files = iter_py_files([str(pkg), str(sub), str(pkg / "a.py")])
+        assert len(files) == 2
+        assert len({os.path.realpath(f) for f in files}) == 2
+
+    def test_overlapping_paths_report_violations_once(self, tmp_path):
+        # the regression: `lint ray_tpu/ ray_tpu/_private` used to
+        # double-report every violation in the overlap
+        (tmp_path / "bad.py").write_text(
+            "import time\nasync def f():\n    time.sleep(1)\n")
+        vs, nfiles = lint_paths([str(tmp_path), str(tmp_path)],
+                                ["async-blocking"])
+        assert nfiles == 1
+        assert rules_of(vs) == ["async-blocking"]
+
+
+# ------------------------------------------------------------ stale pragmas
+
+
+def stale_of(src, rules=None, path="mod.py"):
+    mods = [Module(path, textwrap.dedent(src))]
+    analyze_modules(mods, rules)
+    return find_stale_pragmas(mods, rules)
+
+
+class TestStalePragmas:
+    def test_live_pragma_not_reported(self):
+        assert stale_of("""
+            import time
+            async def a():
+                time.sleep(1)  # raylint: disable=async-blocking — fixture
+        """) == []
+
+    def test_dead_pragma_reported(self):
+        vs = stale_of("""
+            x = 1  # raylint: disable=async-blocking — long-fixed
+        """)
+        assert rules_of(vs) == ["stale-pragma"]
+        assert "suppresses nothing" in vs[0].message
+        assert vs[0].line == 2
+
+    def test_renamed_rule_reported(self):
+        vs = stale_of("""
+            x = 1  # raylint: disable=async-blocked — typo'd rule name
+        """)
+        assert rules_of(vs) == ["stale-pragma"]
+        assert "renamed?" in vs[0].message
+
+    def test_unexercised_rule_not_judged(self):
+        # a subset run cannot know whether the pragma still suppresses
+        vs = stale_of("""
+            x = 1  # raylint: disable=async-blocking
+        """, rules=["rpc-contract"])
+        assert vs == []
+
+    def test_dead_file_pragma_reported(self):
+        vs = stale_of("""
+            # raylint: disable-file=shm-lifecycle
+            x = 1
+        """)
+        assert rules_of(vs) == ["stale-pragma"]
+        assert "disable-file" in vs[0].message
+
+    def test_pragma_justifying_transitive_blocking_is_live(self):
+        # the transitive async-blocking pass honours (and thereby uses)
+        # a pragma at the blocking line inside a sync helper
+        assert stale_of("""
+            import time
+
+            def _inner():
+                time.sleep(1)  # raylint: disable=async-blocking — executor-only
+
+            async def handler():
+                _inner()
+        """) == []
+
 
 class TestCli:
     def test_clean_file_exit_0(self, tmp_path, capsys):
@@ -398,8 +482,49 @@ class TestCli:
         assert lint_main(["--list-rules"]) == 0
         out = capsys.readouterr().out
         for rule in ("async-blocking", "lock-discipline", "rpc-contract",
-                     "exception-hygiene", "shm-lifecycle"):
+                     "rpc-schema", "exception-hygiene", "shm-lifecycle"):
             assert rule in out
+
+    def test_stale_pragmas_flag_is_warn_only(self, tmp_path, capsys):
+        f = tmp_path / "ok.py"
+        f.write_text("x = 1  # raylint: disable=async-blocking — dead\n")
+        assert lint_main(["--stale-pragmas", str(f)]) == 0  # exit untouched
+        out = capsys.readouterr().out
+        assert "stale-pragma" in out and "warning:" in out
+        assert lint_main([str(f)]) == 0      # without the flag: silent
+        assert "stale-pragma" not in capsys.readouterr().out
+
+    def test_json_includes_rpc_schema_table(self, tmp_path, capsys):
+        (tmp_path / "server.py").write_text(textwrap.dedent("""
+            from ray_tpu._private import rpc
+
+            class Raylet:
+                def _handlers(self):
+                    return {"SealObject": self.handle_seal_object}
+
+                async def handle_seal_object(self, conn, header, bufs):
+                    oid = header["object_id"]
+                    ok = self.store.seal(oid, header["segment"],
+                                         header["size"])
+                    if ok and header.get("pin", False):
+                        self.store.pin(oid)
+                    return {"ok": ok, "node_id": self.node_id}
+        """))
+        (tmp_path / "client.py").write_text(textwrap.dedent("""
+            async def put(conn, oid, seg, size):
+                await conn.call("SealObject", {
+                    "object_id": oid, "segment": seg, "size": size})
+        """))
+        assert lint_main(["--format", "json", str(tmp_path)]) == 0
+        report = json.loads(capsys.readouterr().out)
+        seal = report["rpc_schemas"]["SealObject"]
+        assert seal["required"] == ["object_id", "segment", "size"]
+        assert seal["optional"] == ["pin"]
+        assert seal["closed"] is True
+        assert seal["reply"] == ["node_id", "ok"]
+        assert seal["reply_guaranteed"] == ["node_id", "ok"]
+        assert seal["reply_open"] is False
+        assert "stale_pragmas" in report
 
 
 # ------------------------------------------------------------- self-checks
@@ -413,17 +538,52 @@ class TestSelfCheck:
             capture_output=True, text=True, cwd=REPO, timeout=120)
         assert proc.returncode == 0, proc.stdout + proc.stderr
 
-    def test_rpc_contract_covers_real_handler_names(self):
+    def test_rpc_index_covers_real_handler_names(self):
         """The package-wide scan must actually SEE the real handler
         registrations (a collector regression would make the contract
-        rule vacuously green)."""
-        from ray_tpu._private.lint.engine import Module, all_rules
-        rule = all_rules()["rpc-contract"]()
+        rule vacuously green). Since v2 the registration detection
+        lives in the shared call-graph substrate."""
+        from ray_tpu._private.lint.engine import Module
+        from ray_tpu._private.lint.callgraph import build_program
+        mods = []
         for name in ("gcs.py", "raylet.py", "core_worker.py"):
             p = os.path.join(PKG, "_private", name)
             with open(p) as f:
-                rule.collect(Module(p, f.read()))
+                mods.append(Module(p, f.read()))
+        program = build_program(mods)
         for method in ("Heartbeat", "SealObject", "AllocSegment",
                        "AbortSegment", "GetObject", "RegisterNode"):
-            assert method in rule.registered, method
-        assert any(m == "AllocSegment" for m, *_ in rule.client_refs)
+            assert method in program.rpc.registered_methods, method
+        assert any(cc.method == "AllocSegment"
+                   for cc in program.rpc.client_calls)
+
+    def test_schema_inference_resolves_real_handlers(self):
+        """rpc-schema's whole-package inference must keep resolving the
+        real control plane: most methods get a schema, most schemas are
+        closed, and a known contract stays exact. A resolver regression
+        (handlers stop resolving, everything goes open) would otherwise
+        silently disable all payload checking."""
+        from ray_tpu._private.lint.engine import Module, iter_py_files
+        from ray_tpu._private.lint.callgraph import build_program
+        from ray_tpu._private.lint.rules.rpc_schema import infer_schemas
+        mods = []
+        for p in iter_py_files([PKG]):
+            with open(p, encoding="utf-8", errors="replace") as f:
+                mods.append(Module(p, f.read()))
+        schemas = infer_schemas(build_program(mods))
+        assert len(schemas) >= 60, sorted(schemas)
+        closed = [m for m, s in schemas.items() if s.closed]
+        assert len(closed) >= 50, closed
+        seal = schemas["SealObject"]
+        assert seal.required == {"object_id", "segment", "size"}
+        assert "pin" in seal.known and seal.closed
+        hb = schemas["Heartbeat"]
+        assert hb.required == {"node_id"}
+        assert {"resources_available", "stats"} <= hb.known
+        # Reply inference on the real control plane: the lease protocol
+        # replies are literal dicts, so reply-read checking has teeth.
+        alloc = schemas["AllocSegment"]
+        assert not alloc.reply_open
+        assert "found" in alloc.reply_guaranteed
+        assert {"segment", "size"} <= alloc.reply_keys
+        assert not seal.reply_open and {"ok"} <= seal.reply_keys

@@ -1,0 +1,634 @@
+"""raylint v2 suite: the shared call-graph substrate, rpc-schema
+inference, and async-blocking call-graph reachability.
+
+Same philosophy as test_lint.py — fixtures are the executable spec. The
+substrate tests pin the RESOLUTION RULES (what is and is not a call
+edge, how a handler expression resolves), because every v2 check's
+false-positive rate rides on those staying conservative.
+"""
+
+import json
+import textwrap
+
+from ray_tpu._private.lint import lint_sources
+from ray_tpu._private.lint.engine import Module, main as lint_main
+from ray_tpu._private.lint.callgraph import build_program
+from ray_tpu._private.lint.rules.rpc_schema import infer_schemas
+
+
+def run(src, rules=None, path="mod.py", extra=None):
+    sources = {path: textwrap.dedent(src)}
+    if extra:
+        sources.update({p: textwrap.dedent(s) for p, s in extra.items()})
+    return lint_sources(sources, rules)
+
+
+def rules_of(violations):
+    return [v.rule for v in violations]
+
+
+def program_of(src, path="mod.py", extra=None):
+    sources = {path: textwrap.dedent(src)}
+    if extra:
+        sources.update({p: textwrap.dedent(s) for p, s in extra.items()})
+    return build_program([Module(p, s) for p, s in sources.items()])
+
+
+# ------------------------------------------------------------- the substrate
+
+class TestCallGraph:
+    def test_symbols_and_async_flags(self):
+        prog = program_of("""
+            async def top():
+                pass
+            class Server:
+                def sync_m(self):
+                    pass
+                async def async_m(self):
+                    pass
+        """)
+        assert prog.functions[("mod.py", "top")].is_async
+        fi = prog.functions[("mod.py", "Server.sync_m")]
+        assert not fi.is_async and fi.class_name == "Server"
+        assert fi.is_method and fi.positional_params() == []
+        assert prog.class_method("Server", "async_m").is_async
+
+    def test_same_module_and_self_edges(self):
+        prog = program_of("""
+            def helper():
+                pass
+            class C:
+                def work(self):
+                    helper()
+                    self.other()
+                def other(self):
+                    pass
+        """)
+        work = prog.functions[("mod.py", "C.work")]
+        callees = {fi.qualname for _n, fi in work.calls}
+        assert callees == {"helper", "C.other"}
+
+    def test_import_edges_cross_module(self):
+        prog = program_of("""
+            from util import poll
+            import util
+            def a():
+                poll()
+            def b():
+                util.poll()
+        """, extra={"util.py": """
+            def poll():
+                pass
+        """})
+        for q in ("a", "b"):
+            fi = prog.functions[("mod.py", q)]
+            assert [c.path for _n, c in fi.calls] == ["util.py"], q
+
+    def test_function_as_argument_is_not_an_edge(self):
+        # run_in_executor(None, f) / Thread(target=f) hop threads —
+        # exactly what async-reachability must NOT follow.
+        prog = program_of("""
+            import threading
+            def blocking():
+                pass
+            async def h(loop):
+                await loop.run_in_executor(None, blocking)
+                threading.Thread(target=blocking).start()
+        """)
+        assert prog.functions[("mod.py", "h")].calls == []
+
+    def test_unqualified_obj_attr_is_not_an_edge(self):
+        # `anything.join()` must not edge into an unrelated class that
+        # happens to define join() — edges only come from proof.
+        prog = program_of("""
+            class Pool:
+                def join(self):
+                    pass
+            async def h(thread):
+                thread.join()
+        """)
+        assert prog.functions[("mod.py", "h")].calls == []
+
+    def test_same_basename_modules_are_ambiguous(self):
+        # Two modules both named util.py and both defining helper():
+        # basenames cannot tell them apart, so neither import resolves —
+        # an edge into the WRONG file's helper would fabricate an
+        # async-blocking violation for clean code.
+        prog = program_of("""
+            from util import helper
+            async def f():
+                helper()
+        """, extra={"a/util.py": """
+            import time
+            def helper():
+                time.sleep(1)
+        """, "b/util.py": """
+            def helper():
+                pass
+        """})
+        assert prog.functions[("mod.py", "f")].calls == []
+
+    def test_dotted_import_binds_top_package_only(self):
+        # `import pkg.util` binds the name `pkg`, NOT `util`: pkg.helper()
+        # must not resolve against util.py's helper (a false edge here
+        # fabricated an async-blocking violation for unrelated code).
+        prog = program_of("""
+            import pkg.util
+            async def f():
+                pkg.helper()
+        """, extra={"util.py": """
+            def helper():
+                import time
+                time.sleep(1)
+        """})
+        assert prog.functions[("mod.py", "f")].calls == []
+
+    def test_dotted_import_with_asname_edges(self):
+        prog = program_of("""
+            import pkg.util as u
+            def f():
+                u.poll()
+        """, extra={"util.py": """
+            def poll():
+                pass
+        """})
+        (edge,) = prog.functions[("mod.py", "f")].calls
+        assert edge[1].path == "util.py"
+
+    def test_rpc_index_resolves_handlers(self):
+        prog = program_of("""
+            from ray_tpu._private import rpc
+            class Raylet:
+                def _handlers(self):
+                    return {"Seal": self.handle_seal}
+                async def handle_seal(self, conn, header, bufs):
+                    return {"ok": header["object_id"]}
+            async def client(conn):
+                await conn.call("Seal", {"object_id": b"x"})
+        """)
+        regs = prog.rpc.registrations["Seal"]
+        assert regs[0].handler.qualname == "Raylet.handle_seal"
+        (cc,) = prog.rpc.client_calls
+        assert cc.method == "Seal" and cc.header is not None
+
+
+# --------------------------------------------------------------- rpc-schema
+
+SCHEMA_SERVER = """
+    class Raylet:
+        def _handlers(self):
+            return {
+                "Seal": self.handle_seal,
+                "Ping": self.handle_ping,
+            }
+        async def handle_seal(self, conn, header, bufs):
+            oid = header["object_id"]
+            size = header["size"]
+            if header.get("pin", False):
+                pin(oid)
+            return {"ok": True}
+        async def handle_ping(self, conn, header, bufs):
+            return {"ok": True}
+"""
+
+
+class TestRpcSchema:
+    def test_missing_required_key(self):
+        vs = run("""
+            async def put(conn, oid):
+                await conn.call("Seal", {"object_id": oid})
+        """, ["rpc-schema"], path="client.py",
+            extra={"server.py": SCHEMA_SERVER})
+        assert rules_of(vs) == ["rpc-schema"]
+        assert '"size"' in vs[0].message and "KeyError" in vs[0].message
+
+    def test_unknown_key_with_suggestion(self):
+        # The typo class rpc-contract cannot see: right method name,
+        # wrong key — the field silently drops on the floor.
+        vs = run("""
+            async def put(conn, oid, size):
+                await conn.call("Seal", {"object_id": oid, "size": size,
+                                         "pinn": True})
+        """, ["rpc-schema"], path="client.py",
+            extra={"server.py": SCHEMA_SERVER})
+        assert rules_of(vs) == ["rpc-schema"]
+        assert '"pinn"' in vs[0].message
+        assert 'did you mean "pin"' in vs[0].message
+
+    def test_exact_and_optional_clean(self):
+        vs = run("""
+            async def put(conn, oid, size):
+                await conn.call("Seal", {"object_id": oid, "size": size})
+                await conn.call("Seal", {"object_id": oid, "size": size,
+                                         "pin": True})
+        """, ["rpc-schema"], path="client.py",
+            extra={"server.py": SCHEMA_SERVER})
+        assert vs == []
+
+    def test_no_header_to_required_handler(self):
+        vs = run("""
+            async def put(conn):
+                await conn.call("Seal")
+        """, ["rpc-schema"], path="client.py",
+            extra={"server.py": SCHEMA_SERVER})
+        assert rules_of(vs) == ["rpc-schema"]
+        assert "sends no header" in vs[0].message
+
+    def test_header_ignoring_handler_is_open(self):
+        # handle_ping never reads its header — callers may send
+        # anything (there is no schema to check against).
+        vs = run("""
+            async def check(conn):
+                await conn.call("Ping", {"nonce": 1})
+        """, ["rpc-schema"], path="client.py",
+            extra={"server.py": SCHEMA_SERVER})
+        assert vs == []
+
+    def test_dynamic_header_use_opens_schema(self):
+        # Handler iterates its header: required keys still checked,
+        # unknown keys cannot be.
+        vs = run("""
+            class S:
+                def _handlers(self):
+                    return {"Put": self.handle_put}
+                async def handle_put(self, conn, header, bufs):
+                    key = header["key"]
+                    for k, v in header.items():
+                        store(k, v)
+        """, ["rpc-schema"], extra={"client.py": """
+            async def a(conn):
+                await conn.call("Put", {"anything": 1, "key": "k"})
+            async def b(conn):
+                await conn.call("Put", {"anything": 1})
+        """})
+        assert rules_of(vs) == ["rpc-schema"]
+        assert vs[0].path == "client.py" and '"key"' in vs[0].message
+
+    def test_guarded_read_is_optional(self):
+        vs = run("""
+            class S:
+                def _handlers(self):
+                    return {"Up": self.handle_up}
+                async def handle_up(self, conn, header, bufs):
+                    if "stats" in header:
+                        use(header["stats"])
+                    return {}
+        """, ["rpc-schema"], extra={"client.py": """
+            async def a(conn):
+                await conn.call("Up", {})
+        """})
+        assert vs == []
+
+    def test_write_before_read_is_optional(self):
+        # The handler supplies the key itself before ever reading it —
+        # callers need not send it.
+        vs = run("""
+            class S:
+                def _handlers(self):
+                    return {"Up": self.handle_up}
+                async def handle_up(self, conn, header, bufs):
+                    header["epoch"] = now()
+                    return {"at": header["epoch"]}
+        """, ["rpc-schema"], extra={"client.py": """
+            async def a(conn):
+                await conn.call("Up", {})
+        """})
+        assert vs == []
+
+    def test_read_before_write_stays_required(self):
+        # Reading first KeyErrors on a missing key no matter what the
+        # later write does — the write must not demote it.
+        vs = run("""
+            class S:
+                def _handlers(self):
+                    return {"Up": self.handle_up}
+                async def handle_up(self, conn, header, bufs):
+                    v = header["count"]
+                    header["count"] = v + 1
+                    return {"ok": True}
+        """, ["rpc-schema"], extra={"client.py": """
+            async def a(conn):
+                await conn.call("Up", {})
+        """})
+        assert rules_of(vs) == ["rpc-schema"]
+        assert '"count"' in vs[0].message
+
+    def test_multi_handler_union_semantics(self):
+        # "Published" served by two processes with different schemas: a
+        # key is only missing if EVERY handler requires it; a key is
+        # only unknown if NO handler knows it.
+        vs = run("""
+            class A:
+                def _handlers(self):
+                    return {"Evt": self.handle_evt}
+                async def handle_evt(self, conn, header, bufs):
+                    return header["channel"], header["node"]
+            class B:
+                def other_handlers(self):
+                    return {"Evt": self.handle_evt2}
+                async def handle_evt2(self, conn, header, bufs):
+                    return header["channel"]
+        """, ["rpc-schema"], extra={"client.py": """
+            async def ok(conn):
+                await conn.call("Evt", {"channel": "X"})
+            async def bad(conn):
+                await conn.call("Evt", {})
+        """})
+        assert rules_of(vs) == ["rpc-schema"]
+        assert vs[0].lineno if hasattr(vs[0], "lineno") else True
+        assert '"channel"' in vs[0].message and "bad" not in vs[0].message
+
+    def test_dangling_registration_flagged(self):
+        vs = run("""
+            class S:
+                def _handlers(self):
+                    return {"Gone": self.handle_gone}
+        """, ["rpc-schema"])
+        assert rules_of(vs) == ["rpc-schema"]
+        assert "AttributeError" in vs[0].message
+
+    def test_bad_handler_arity_flagged(self):
+        vs = run("""
+            class S:
+                def _handlers(self):
+                    return {"Up": self.handle_up}
+                async def handle_up(self, conn, header):
+                    return {}
+        """, ["rpc-schema"])
+        assert rules_of(vs) == ["rpc-schema"]
+        assert "(conn, header, bufs)" in vs[0].message
+
+    def test_extra_defaulted_params_ok(self):
+        vs = run("""
+            class S:
+                def _handlers(self):
+                    return {"Up": self.handle_up}
+                async def handle_up(self, conn, header, bufs, trace=None):
+                    return {}
+        """, ["rpc-schema"])
+        assert vs == []
+
+    def test_dynamic_client_header_out_of_scope(self):
+        vs = run("""
+            async def fwd(conn, header):
+                await conn.call("Seal", header)
+                await conn.call("Seal", {**header, "size": 1})
+        """, ["rpc-schema"], path="client.py",
+            extra={"server.py": SCHEMA_SERVER})
+        assert vs == []
+
+    def test_reply_key_never_produced(self):
+        vs = run("""
+            async def lease(conn, size):
+                reply, _ = await conn.call("Alloc", {"size": size})
+                return reply["segment_nam"]
+        """, ["rpc-schema"], path="client.py", extra={"server.py": """
+            class S:
+                def _handlers(self):
+                    return {"Alloc": self.handle_alloc}
+                async def handle_alloc(self, conn, header, bufs):
+                    if header["size"] > 0:
+                        return {"found": True, "segment": "x"}
+                    return {"found": False}
+        """})
+        assert rules_of(vs) == ["rpc-schema"]
+        assert "no return path" in vs[0].message
+        assert 'did you mean "segment"' in vs[0].message
+
+    def test_reply_reads_clean_and_rebinding_wins(self):
+        # possible-but-not-guaranteed keys are fine (callers guard);
+        # a rebinding of the name ends the checked region.
+        vs = run("""
+            async def lease(conn, size):
+                reply, _ = await conn.call("Alloc", {"size": size})
+                if reply["found"]:
+                    use(reply["segment"])
+                reply = other()
+                return reply["whatever"]
+        """, ["rpc-schema"], path="client.py", extra={"server.py": """
+            class S:
+                def _handlers(self):
+                    return {"Alloc": self.handle_alloc}
+                async def handle_alloc(self, conn, header, bufs):
+                    if header["size"] > 0:
+                        return {"found": True, "segment": "x"}
+                    return {"found": False}
+        """})
+        assert vs == []
+
+    def test_reply_bound_in_branches_checked_against_union(self):
+        # One name bound from two different reply calls (one per
+        # branch): a key EITHER method can produce passes — linear
+        # source order cannot tell which branch ran — while a key
+        # NEITHER produces is still flagged.
+        src = """
+            async def go(conn, fast):
+                if fast:
+                    reply, _ = await conn.call("A", {})
+                else:
+                    reply, _ = await conn.call("B", {})
+                use(reply[%s])
+        """
+        server = {"server.py": """
+            class S:
+                def _handlers(self):
+                    return {"A": self.handle_a, "B": self.handle_b}
+                async def handle_a(self, conn, header, bufs):
+                    return {"a_key": 1}
+                async def handle_b(self, conn, header, bufs):
+                    return {"b_key": 2}
+        """}
+        assert run(src % '"a_key"', ["rpc-schema"], path="client.py",
+                   extra=server) == []
+        vs = run(src % '"c_key"', ["rpc-schema"], path="client.py",
+                 extra=server)
+        assert rules_of(vs) == ["rpc-schema"]
+        assert '"A"' in vs[0].message and '"B"' in vs[0].message
+
+    def test_reply_read_through_sync_bridge(self):
+        # reply, _ = self._run(self._gcs_call(...)) — the util/client
+        # and core_worker sync-API shape.
+        vs = run("""
+            class Client:
+                def nodes(self):
+                    reply, _ = self._run(self._gcs_call(
+                        "GetAllNodeInfo", {}))
+                    return reply["node_list"]
+        """, ["rpc-schema"], path="client.py", extra={"server.py": """
+            class Gcs:
+                def _handlers(self):
+                    return {"GetAllNodeInfo": self.handle_get_all}
+                async def handle_get_all(self, conn, header, bufs):
+                    return {"nodes": []}
+        """})
+        assert rules_of(vs) == ["rpc-schema"]
+        assert 'did you mean "nodes"' in vs[0].message
+
+    def test_open_reply_out_of_scope(self):
+        # a handler that forwards a computed reply can produce keys the
+        # rule cannot enumerate — reply reads go unchecked by design.
+        vs = run("""
+            async def go(conn):
+                reply, _ = await conn.call("Fwd", {})
+                return reply["anything"]
+        """, ["rpc-schema"], path="client.py", extra={"server.py": """
+            class S:
+                def _handlers(self):
+                    return {"Fwd": self.handle_fwd}
+                async def handle_fwd(self, conn, header, bufs):
+                    reply = compute()
+                    return reply
+        """})
+        assert vs == []
+
+    def test_regression_incarnation_dead_key(self):
+        """The real finding this rule shipped with: PushActorTasks and
+        CreateActor carried an "incarnation" header key the worker-side
+        handlers never read — so stale-incarnation pushes (a split-brain
+        signal) were silently executed. The fix made the handlers read
+        and validate the key; this fixture reproduces the PRE-fix shape
+        and must stay red."""
+        vs = run("""
+            class TaskExecutor:
+                def _make(self, core):
+                    core._server.handlers.update(
+                        {"PushActorTasks": self.handle_push_actor_tasks})
+                def handle_push_actor_tasks(self, conn, header, bufs):
+                    tasks = header["tasks"]
+                    return {"ok": True}
+        """, ["rpc-schema"], path="executor.py", extra={"client.py": """
+            async def pump(q):
+                q.conn.call_nowait(
+                    "PushActorTasks",
+                    {"tasks": [], "incarnation": q.incarnation})
+        """})
+        assert rules_of(vs) == ["rpc-schema"]
+        assert '"incarnation"' in vs[0].message
+        assert vs[0].path == "client.py"
+
+
+# ----------------------------------------- async-blocking via the call graph
+
+class TestAsyncReachability:
+    def test_async_calling_blocking_sync_helper(self):
+        vs = run("""
+            import time
+            def wait_ready():
+                time.sleep(0.1)
+            async def handler():
+                wait_ready()
+        """, ["async-blocking"])
+        assert rules_of(vs) == ["async-blocking"]
+        assert vs[0].line == 6                # flagged at the CALL site
+        assert "wait_ready" in vs[0].message
+        assert "time.sleep" in vs[0].message
+
+    def test_transitive_chain_reported(self):
+        vs = run("""
+            import time
+            def inner():
+                time.sleep(0.1)
+            def outer():
+                inner()
+            async def handler():
+                outer()
+        """, ["async-blocking"])
+        assert rules_of(vs) == ["async-blocking"]
+        assert "outer -> inner" in vs[0].message
+
+    def test_transitive_detection_is_order_independent(self):
+        # c is reachable at depth 2 via a AND at depth 3 via a->b; if the
+        # first (deeper) exploration of c exhausts the budget before d,
+        # the visited set must not prune the shallower retry — whether a
+        # within-bound chain is found cannot depend on statement order.
+        template = """
+            import time
+            def d():
+                time.sleep(1)
+            def c():
+                d()
+            def b():
+                c()
+            def a():
+                %s
+            async def handler():
+                a()
+        """
+        for calls in ("c(); b()", "b(); c()"):
+            vs = run(template % calls, ["async-blocking"])
+            assert rules_of(vs) == ["async-blocking"], calls
+            assert "time.sleep" in vs[0].message
+
+    def test_pragma_at_blocking_line_clears_all_callers(self):
+        vs = run("""
+            import time
+            def bounded_join():
+                time.sleep(0.001)  # raylint: disable=async-blocking — fixture: bounded
+            async def a():
+                bounded_join()
+            async def b():
+                bounded_join()
+        """, ["async-blocking"])
+        assert vs == []
+
+    def test_executor_hop_not_flagged(self):
+        vs = run("""
+            import time
+            def blocking_read():
+                time.sleep(1)
+            async def handler(loop):
+                return await loop.run_in_executor(None, blocking_read)
+        """, ["async-blocking"])
+        assert vs == []
+
+    def test_await_of_async_callee_clean(self):
+        vs = run("""
+            import asyncio
+            async def helper():
+                await asyncio.sleep(1)
+            async def handler():
+                await helper()
+        """, ["async-blocking"])
+        assert vs == []
+
+    def test_no_arg_result_join_reachable(self):
+        vs = run("""
+            def join_all(futs):
+                for f in futs:
+                    f.result()
+            async def handler(futs):
+                join_all(futs)
+        """, ["async-blocking"])
+        assert rules_of(vs) == ["async-blocking"]
+        assert "blocking future join" in vs[0].message
+
+
+# ------------------------------------------------------------------ CLI v2
+
+class TestDumpSchemas:
+    def test_dump_schemas_json(self, tmp_path, capsys):
+        f = tmp_path / "srv.py"
+        f.write_text(textwrap.dedent("""
+            class S:
+                def _handlers(self):
+                    return {"Up": self.handle_up}
+                async def handle_up(self, conn, header, bufs):
+                    x = header["key"]
+                    y = header.get("opt")
+                    return {}
+        """))
+        assert lint_main(["--dump-schemas", str(f)]) == 0
+        dump = json.loads(capsys.readouterr().out)
+        assert dump["Up"]["required"] == ["key"]
+        assert dump["Up"]["optional"] == ["opt"]
+        assert dump["Up"]["closed"] is True
+        assert "handle_up" in dump["Up"]["handlers"][0]
+
+    def test_infer_schemas_api_shape(self):
+        prog = program_of(SCHEMA_SERVER, path="server.py")
+        schemas = infer_schemas(prog)
+        assert schemas["Seal"].required == {"object_id", "size"}
+        assert schemas["Seal"].known == {"object_id", "size", "pin"}
+        assert schemas["Seal"].closed
+        # Ping never touches header -> open, nothing enforceable.
+        assert not schemas["Ping"].closed

@@ -21,6 +21,7 @@ from ray_tpu.parallel import (
     moe_dispatch_combine,
     pipeline_spmd,
     ring_attention,
+    shard_map,
     ulysses_attention,
 )
 from ray_tpu.parallel.mesh import MeshConfig
@@ -52,7 +53,7 @@ def test_ring_attention_matches_oracle(causal):
 
     want = attention(q, k, v, causal=causal)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(ring_attention, axis="sp", causal=causal),
         mesh=mesh, in_specs=(P(None, "sp"),) * 3,
         out_specs=P(None, "sp"), check_vma=False)
@@ -67,7 +68,7 @@ def test_ulysses_matches_oracle():
     q, k, v = (jax.random.normal(kk, (B, T, H, D), jnp.float32)
                for kk in ks)
     want = attention(q, k, v, causal=True)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(ulysses_attention, axis="sp", causal=True),
         mesh=mesh, in_specs=(P(None, "sp"),) * 3,
         out_specs=P(None, "sp"), check_vma=False)
@@ -91,7 +92,7 @@ def test_pipeline_matches_sequential():
     for i in range(n_stage):
         want = jnp.tanh(want @ w[i])
 
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(pipeline_spmd, stage_fn, axis="pp",
                           num_microbatches=4),
         mesh=mesh, in_specs=(P("pp"), P(None)), out_specs=P(None),
@@ -124,7 +125,7 @@ def test_pipeline_grads_match_sequential():
         # redundant copies' cotangents, so divide by the pp size
         return jnp.sum(out * out) / n_stage
 
-    fn = jax.shard_map(
+    fn = shard_map(
         jax.grad(pipe_loss_local), mesh=mesh,
         in_specs=(P("pp"), P(None)), out_specs=P("pp"),
         check_vma=False)
@@ -152,7 +153,7 @@ def test_moe_scaled_experts_route_correctly():
         return moe_dispatch_combine(x_, l_, expert_fn, p_, axis="tp",
                                     capacity_factor=8.0)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh, in_specs=(P("tp"), P("tp"), P("tp")),
         out_specs=P("tp"), check_vma=False)
     got = jax.jit(lambda a, b, c: fn(a, b, c))(x, logits, scales)
@@ -174,7 +175,7 @@ def test_moe_identity_experts_roundtrip():
         del params
         return xs  # identity experts
 
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(moe_dispatch_combine, expert_fn=expert_fn,
                           expert_params=None, axis="tp",
                           capacity_factor=8.0),
