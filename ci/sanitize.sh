@@ -3,8 +3,9 @@
 # ask #6). Builds cpp/fastpath.c (ASAN+UBSAN, non-recovering UBSAN) and
 # the C++ msgpack codec / xlang client with the same flags, then runs:
 #   1. the fastpath state-parity suite — including the zero-copy put
-#      memcpy entry (copy_into): copies under threads, odd sizes,
-#      unaligned offsets, bounds rejection,
+#      memcpy entry (copy_into) AND the data-plane receive entry
+#      (recv_into): copies/receives under threads, odd sizes,
+#      unaligned offsets, EAGAIN/EOF contracts, bounds rejection,
 #   2. the cross-language C++ client suite (msgpack_lite.hpp codec),
 #   3. a 100k-task drain with the instrumented fast path on the hot
 #      path end to end (driver + raylet + workers all preload ASAN),
@@ -13,7 +14,11 @@
 #      where sys.gettotalrefcount does not exist),
 #   5. a put-bandwidth smoke: large puts through the instrumented
 #      zero-copy pipeline must record a NONZERO GB/s and roundtrip,
-#   6. a ThreadSanitizer pass over the threaded copy_into stripes: the
+#   6. a striped data-plane transfer smoke: a real two-raylet loopback
+#      pull with chunk payloads received through the instrumented
+#      native recv_into straight into the destination segment — the
+#      pull must roundtrip bit-exact with zero intermediate copies,
+#   7. a ThreadSanitizer pass over the threaded copy_into stripes: the
 #      fastpath is rebuilt with -fsanitize=thread and driven through
 #      native.copy_into's striping pool (several GIL-released memcpys
 #      of one destination in parallel); SKIP-clean when libtsan is
@@ -36,16 +41,16 @@ export LD_PRELOAD="$LIBASAN"
 export ASAN_OPTIONS="detect_leaks=0:abort_on_error=1"
 export UBSAN_OPTIONS="print_stacktrace=1:halt_on_error=1"
 
-echo "== 1/6 fastpath parity suite (incl. copy_into) under ASAN+UBSAN =="
+echo "== 1/7 fastpath parity suite (incl. copy_into + recv_into) under ASAN+UBSAN =="
 python -m pytest tests/test_fastpath.py -x -q
 
-echo "== 2/6 C++ msgpack codec + xlang client under ASAN+UBSAN =="
+echo "== 2/7 C++ msgpack codec + xlang client under ASAN+UBSAN =="
 python -m pytest tests/test_cross_language.py -x -q
 
-echo "== 3/6 100k drain + 4/6 allocator leak check =="
+echo "== 3/7 100k drain + 4/7 allocator leak check =="
 python ci/asan_drain.py
 
-echo "== 5/6 zero-copy put bandwidth smoke =="
+echo "== 5/7 zero-copy put bandwidth smoke =="
 JAX_PLATFORMS=cpu RAY_TPU_SCHEDULER_BACKEND=host python - <<'PY'
 import time
 import numpy as np
@@ -69,7 +74,74 @@ finally:
     ray_tpu.shutdown()
 PY
 
-echo "== 6/6 threaded copy_into stripes under TSAN =="
+echo "== 6/7 striped data-plane pull through native recv_into under ASAN =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import asyncio
+import tempfile
+import numpy as np
+from ray_tpu._private import data_channel, native
+from ray_tpu._private.config import RayTpuConfig
+from ray_tpu._private.gcs import GcsServer
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.raylet import Raylet
+from ray_tpu._private.serialization import SerializationContext
+from ray_tpu._private.shm_store import AttachedObject, write_segment
+from ray_tpu._private import rpc
+
+
+async def main():
+    cfg = RayTpuConfig.create({
+        "num_prestart_workers": 0, "event_log_enabled": False,
+        "object_manager_chunk_size": 65536})
+    tmp = tempfile.mkdtemp(prefix="rtpu_san_xfer_")
+    gcs = GcsServer(cfg)
+    gcs_addr = await gcs.start("tcp://127.0.0.1:0")
+    r0 = Raylet(cfg, 1, session_dir=tmp)
+    await r0.start(gcs_addr)
+    r1 = Raylet(cfg, 1, session_dir=tmp)
+    await r1.start(gcs_addr)
+
+    async def _locs(conn, header, bufs):
+        return {"locations": [r0.node_id.binary()]}
+
+    async def _add(conn, header, bufs):
+        return {"ok": True}
+
+    owner = rpc.RpcServer(
+        {"GetObjectLocations": _locs, "AddObjectLocation": _add},
+        name="owner")
+    owner_addr = await owner.listen("tcp://127.0.0.1:0")
+    try:
+        ctx = SerializationContext()
+        arr = np.random.default_rng(5).integers(
+            0, 255, 8_000_019, dtype=np.uint8)  # odd size: edge chunks
+        name, size = write_segment(ctx.serialize(arr))
+        oid = ObjectID.from_random()
+        assert r0.store.seal(oid, name, size)
+        data_channel.reset_stats()
+        reply = await r1._ensure_local(oid, owner_addr)
+        assert reply.get("ok"), reply
+        att = AttachedObject(reply["segment"])
+        got = ctx.deserialize(att.metadata, att.frames)
+        assert np.array_equal(got, arr), "data-plane pull corrupted data"
+        got = None
+        att.close()
+        assert data_channel.pull_stats["chunks"] > 0
+        assert data_channel.pull_stats["intermediate_copies"] == 0, \
+            data_channel.pull_stats
+        print("data-plane pull clean:", dict(data_channel.pull_stats),
+              "recv tiers:", dict(native.recv_stats))
+    finally:
+        await owner.close()
+        await r1.stop()
+        await r0.stop()
+        await gcs.stop()
+
+
+asyncio.run(main())
+PY
+
+echo "== 7/7 threaded copy_into stripes under TSAN =="
 LIBTSAN="$(cc -print-file-name=libtsan.so)"
 if [ ! -e "$LIBTSAN" ]; then
     echo "SKIP: libtsan not found (toolchain without TSAN)" >&2

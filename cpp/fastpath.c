@@ -25,8 +25,14 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 #include <structmember.h>
+#include <errno.h>
 #include <stdint.h>
 #include <string.h>
+#ifdef MS_WINDOWS
+#include <winsock2.h>
+#else
+#include <sys/socket.h>
+#endif
 
 #ifndef T_OBJECT_EX
 #define T_OBJECT_EX 16
@@ -977,6 +983,73 @@ fastpath_copy_into(PyObject *module, PyObject *const *argv,
     return PyLong_FromSsize_t(nbytes);
 }
 
+/* recv_into(fd, dst, dst_off, max_nbytes) -> nbytes received
+ *
+ * The receive half of the striped data plane (data_channel.py): ONE
+ * recv(2) from a connected socket straight into a writable destination
+ * buffer (the puller's mapped shm segment) at dst_off, with the GIL
+ * RELEASED for the in-kernel copy.  This is what makes a cross-node
+ * chunk pull single-copy: socket buffer -> segment pages, no
+ * intermediate Python ``bytes`` ever exists.
+ *
+ * Returns the byte count recv() delivered (a short read is normal —
+ * the caller loops), 0 on orderly peer EOF, or -1 when the socket is
+ * non-blocking and no data is ready (EAGAIN/EWOULDBLOCK) — the caller
+ * awaits loop readability and retries.  EINTR retries internally.
+ * Real socket errors raise OSError.  Bounds are checked in the same
+ * overflow-safe subtraction form as copy_into before the GIL drops. */
+static PyObject *
+fastpath_recv_into(PyObject *module, PyObject *const *argv,
+                   Py_ssize_t nargs)
+{
+    if (nargs != 4) {
+        PyErr_SetString(PyExc_TypeError,
+                        "recv_into(fd, dst, dst_off, max_nbytes)");
+        return NULL;
+    }
+    int fd = (int)PyLong_AsLong(argv[0]);
+    if (fd == -1 && PyErr_Occurred())
+        return NULL;
+    Py_ssize_t dst_off = PyLong_AsSsize_t(argv[2]);
+    if (dst_off == -1 && PyErr_Occurred())
+        return NULL;
+    Py_ssize_t nbytes = PyLong_AsSsize_t(argv[3]);
+    if (nbytes == -1 && PyErr_Occurred())
+        return NULL;
+
+    Py_buffer dst;
+    if (PyObject_GetBuffer(argv[1], &dst, PyBUF_WRITABLE) < 0)
+        return NULL;
+    if (dst_off < 0 || nbytes < 0 || dst_off > dst.len ||
+        nbytes > dst.len - dst_off) {
+        PyBuffer_Release(&dst);
+        PyErr_SetString(PyExc_ValueError,
+                        "recv_into: offset/length out of bounds");
+        return NULL;
+    }
+    if (nbytes == 0) {
+        PyBuffer_Release(&dst);
+        return PyLong_FromSsize_t(0);
+    }
+    char *p = (char *)dst.buf + dst_off;
+    Py_ssize_t got;
+    int err;
+    do {
+        Py_BEGIN_ALLOW_THREADS
+        got = (Py_ssize_t)recv(fd, p, (size_t)nbytes, 0);
+        err = errno;
+        Py_END_ALLOW_THREADS
+    } while (got < 0 && err == EINTR);
+    PyBuffer_Release(&dst);
+    if (got < 0) {
+        if (err == EAGAIN || err == EWOULDBLOCK)
+            return PyLong_FromSsize_t(-1);
+        errno = err;
+        return PyErr_SetFromErrno(PyExc_OSError);
+    }
+    return PyLong_FromSsize_t(got);
+}
+
 static PyMethodDef FastCtx_methods[] = {
     {"submit", (PyCFunction)(void (*)(void))FastCtx_submit,
      METH_FASTCALL, "fused template-task submission"},
@@ -1012,6 +1085,10 @@ static PyMethodDef fastpath_functions[] = {
     {"copy_into", (PyCFunction)(void (*)(void))fastpath_copy_into,
      METH_FASTCALL,
      "GIL-releasing memcpy between C-contiguous buffers"},
+    {"recv_into", (PyCFunction)(void (*)(void))fastpath_recv_into,
+     METH_FASTCALL,
+     "GIL-releasing recv(2) straight into a writable buffer at an "
+     "offset; -1 = EAGAIN, 0 = EOF"},
     {NULL, NULL, 0, NULL},
 };
 

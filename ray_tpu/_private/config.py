@@ -46,8 +46,29 @@ class RayTpuConfig:
     # smart_open): a workflow-storage URL (file:///shared, kv://, or
     # s3://bucket/prefix) that overrides the local spill dir.
     spill_external_storage_url: str = ""
-    # Chunk size for node-to-node object transfer.
+    # Chunk size for node-to-node object transfer. This is the FLOOR of
+    # the data plane's adaptive chunking (and the fixed chunk of the
+    # legacy control-plane pull): large objects scale their chunk up to
+    # data_plane_max_chunk_size so per-chunk request overhead amortizes.
     object_manager_chunk_size: int = 1024 * 1024
+    # Striped raw-socket data channels per peer for cross-node object
+    # pulls (the bulk transport under the msgpack control plane; see
+    # data_channel.py). Chunks fan out across the stripes — and across
+    # every replica-holding peer — and land directly in the destination
+    # shm mapping (one copy per chunk). 0 disables the data plane
+    # entirely: pulls fall back to chunked FetchObjectChunk RPCs on the
+    # shared control connection (the pre-data-plane path).
+    data_plane_stripes: int = 4
+    # Ceiling of the adaptive per-chunk size on the striped data plane.
+    # object_manager_chunk_size stays the floor; multi-GiB objects use
+    # chunks up to this size so the transfer is syscall-bound, not
+    # round-trip-bound.
+    data_plane_max_chunk_size: int = 8 * 1024 * 1024
+    # When every known location of an object fails mid-pull, the raylet
+    # re-queries the owner's location index ONCE after this backoff —
+    # a replica added meanwhile (e.g. by a concurrent pull elsewhere)
+    # is found instead of erroring the get.
+    pull_location_refresh_backoff_s: float = 0.2
 
     # --- scheduling ---
     # Pipeline depth CEILING for pushing tasks to a leased worker before

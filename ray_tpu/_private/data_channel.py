@@ -1,0 +1,691 @@
+"""Striped zero-copy data plane for cross-node object transfer.
+
+The control plane (rpc.py) multiplexes every RPC of a peer pair over ONE
+msgpack-framed TCP/unix stream; before this module, chunked object pulls
+rode that same stream — each chunk was decoded into a Python ``bytes``
+by the recv loop and then copied again into the destination shm segment.
+The reference separates the two planes for exactly this reason (chunked
+Push/Pull rides its own buffered path: src/ray/object_manager/
+push_manager.h + ObjectBufferPool), and the Dask overhead analysis
+(arXiv:2010.11105) shows runtime copies, not the network, capping
+transfer rates.
+
+This module is the bulk transport under that control plane:
+
+* ``DataPlaneServer`` — a raw-socket listener each raylet runs next to
+  its RPC server. Chunk requests are served with ``os.sendfile`` (via
+  ``loop.sock_sendfile``) straight from the segment's /dev/shm file to
+  the peer's socket: the sender never maps, reads, or re-buffers object
+  bytes in userspace.
+* ``DataChannelClient`` — N striped non-blocking connections per peer.
+  Chunk payloads are received DIRECTLY into the destination shm mapping
+  via the GIL-releasing native ``recv_into`` (cpp/fastpath.c, with a
+  ``socket.recv_into`` pure-Python fallback — see native.sock_recv_into):
+  exactly one kernel->segment copy per chunk, no intermediate ``bytes``.
+* ``run_striped`` — the fan-out engine: chunk offsets drain across every
+  stripe of every replica-holding peer; a failing stripe hands its chunk
+  back to the queue and retires, so the pull survives anything short of
+  every stripe dying.
+
+Wire framing (one request in flight per stripe; stripes give the
+parallelism):
+
+    request  (client -> server): [u32 len][msgpack [object_id, offset, length]]
+    response (server -> client): [u32 len][msgpack [status, payload_len]]
+                                 [payload bytes]
+    status: 0 = ok (payload_len data bytes follow), 1 = object unknown.
+
+Only chunk payloads travel here; sizes, locations, admission, sealing
+and every failure decision stay on the control plane (raylet.py
+FetchObjectMeta / EnsureObjectLocal).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import socket
+import struct
+from typing import Any, Awaitable, Callable, Deque, Dict, List, Optional
+
+import msgpack
+
+from ray_tpu._private import native
+
+logger = logging.getLogger(__name__)
+
+_U32 = struct.Struct("<I")
+
+# Hard cap on a request body: a corrupt/hostile length prefix must not
+# allocate unbounded memory on the serving raylet.
+_MAX_REQUEST_BYTES = 1 << 16
+
+STATUS_OK = 0
+STATUS_NOT_FOUND = 1
+
+# Receive-path observability (asserted by tests, reported via
+# GetNodeStats and the bench's cross_node_transfer block). ``chunks``
+# counts every cross-node chunk pulled, striped AND legacy (the
+# raylet's control-plane fallback reports here too);
+# ``intermediate_copies`` counts chunk payloads that materialized as a
+# Python ``bytes`` before reaching the destination segment — 0 on the
+# striped plane (socket -> shm is the only copy), 1 per chunk on the
+# legacy path (recv-loop bytes + copy_into).
+pull_stats = {"chunks": 0, "bytes": 0, "intermediate_copies": 0}
+serve_stats = {"chunks": 0, "bytes": 0, "sendfile": 0, "mapped": 0}
+
+
+def reset_stats() -> None:
+    for d in (pull_stats, serve_stats):
+        for k in d:
+            d[k] = 0
+
+
+def _wait_readable(sock: socket.socket) -> "asyncio.Future":
+    """Future that resolves when ``sock`` has data (loop add_reader).
+    Resolving it EXTERNALLY (set_exception — see _Stripe wake-on-close)
+    also deregisters the reader via the done callback."""
+    loop = asyncio.get_running_loop()
+    fut = loop.create_future()
+    fd = sock.fileno()
+    if fd < 0:
+        fut.set_exception(ConnectionError("socket already closed"))
+        return fut
+
+    def _ready():
+        if not fut.done():
+            fut.set_result(None)
+
+    def _on_done(f):
+        try:
+            loop.remove_reader(fd)
+        except (OSError, ValueError):
+            pass  # fd already closed/deregistered
+
+    loop.add_reader(fd, _ready)
+    fut.add_done_callback(_on_done)
+    return fut
+
+
+async def recv_exact_into(sock: socket.socket, buf, off: int,
+                          nbytes: int, waiter_box=None) -> None:
+    """Receive exactly ``nbytes`` into ``buf[off:off+nbytes]`` from a
+    non-blocking socket — the single-copy seam: the bytes land straight
+    in the caller's buffer (for chunk payloads, the mapped destination
+    segment). Tries the GIL-releasing receive first and awaits loop
+    readability only on EAGAIN. ``waiter_box`` (an object with a
+    ``waiter`` attribute, e.g. a _Stripe) exposes the parked future so
+    a LOCAL close can wake it — closing an fd silently removes it from
+    the loop's selector, so an unwoken reader would park forever."""
+    got = 0
+    while got < nbytes:
+        try:
+            n = native.sock_recv_into(sock, buf, off + got, nbytes - got)
+        except OSError as e:  # closed-under-us fd (EBADF) et al.
+            raise ConnectionError(f"data channel receive failed: {e}") \
+                from e
+        if n == -1:
+            fut = _wait_readable(sock)
+            if waiter_box is not None:
+                waiter_box.waiter = fut
+            try:
+                await fut
+            finally:
+                if waiter_box is not None:
+                    waiter_box.waiter = None
+            continue
+        if n == 0:
+            raise ConnectionError("data channel peer closed mid-frame")
+        got += n
+
+
+async def _recv_frame(sock: socket.socket, waiter_box=None) -> Any:
+    """One [u32 len][msgpack body] control frame (requests and response
+    headers — small metadata, never chunk payload)."""
+    hdr = bytearray(4)
+    await recv_exact_into(sock, hdr, 0, 4, waiter_box)
+    (blen,) = _U32.unpack(hdr)
+    if blen > _MAX_REQUEST_BYTES:
+        raise ConnectionError(f"data channel frame too large ({blen} B)")
+    body = bytearray(blen)
+    await recv_exact_into(sock, body, 0, blen, waiter_box)
+    return msgpack.unpackb(bytes(body), raw=False)
+
+
+def _pack_frame(body: Any) -> bytes:
+    payload = msgpack.packb(body, use_bin_type=True)
+    return _U32.pack(len(payload)) + payload
+
+
+def _configure(sock: socket.socket) -> None:
+    sock.setblocking(False)
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError:
+        pass  # not a TCP socket (tests may use socketpairs)
+
+
+# --------------------------------------------------------------------------
+# Sender side
+# --------------------------------------------------------------------------
+
+
+class _Source:
+    """A cached chunk source (open fd for sendfile, or a mapped
+    attachment), refcounted: concurrent serves of one segment PIN the
+    source, and eviction/free only marks it dropped — the close runs
+    when the last in-flight serve unpins, never under an active
+    sendfile."""
+
+    __slots__ = ("kind", "obj", "pins", "dropped")
+
+    def __init__(self, kind: str, obj):
+        self.kind = kind
+        self.obj = obj
+        self.pins = 0
+        self.dropped = False
+
+    def close_if_free(self) -> None:
+        if self.dropped and self.pins == 0:
+            try:
+                self.obj.close()
+            except (OSError, BufferError):
+                pass  # a live consumer view may still pin a mapping
+
+
+class DataPlaneServer:
+    """Serves chunk ranges of sealed segments over raw sockets.
+
+    Runs inside the raylet next to (and independent of) the RPC server:
+    a slow multi-GiB transfer here never queues behind — or ahead of —
+    heartbeats and lease grants on the control stream. Chunk bytes go
+    file -> socket via sendfile; where the segment is not /dev/shm-backed
+    (exotic platforms) a mapped attachment serves the range with
+    ``sock_sendall`` of a live memoryview — still no re-buffering.
+    """
+
+    # Bounded source cache: a multi-chunk pull hits the same segment
+    # many times; re-opening per chunk would sit on the hot path
+    # (mirrors the raylet's _serve_attachments bound).
+    MAX_SOURCES = 16
+
+    def __init__(self, store, host: str = "127.0.0.1"):
+        self.store = store
+        self.host = host
+        self.address = ""
+        self._sock: Optional[socket.socket] = None
+        self._accept_task: Optional[asyncio.Task] = None
+        self._conn_tasks: "set[asyncio.Task]" = set()
+        self._sources: Dict[str, _Source] = {}
+        self._closing = False
+        # per-instance counter (module serve_stats aggregates every
+        # server in the process; tests with several in-process raylets
+        # need to tell them apart)
+        self.num_chunks_served = 0
+        # Test hook: called with (object_id_bytes, offset, length) before
+        # each chunk is served (fault injection for mid-pull death tests).
+        self.on_serve: Optional[Callable[[bytes, int, int], None]] = None
+
+    async def start(self) -> str:
+        loop = asyncio.get_running_loop()
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((self.host, 0))
+        sock.listen(64)
+        sock.setblocking(False)
+        self._sock = sock
+        self.address = "%s:%d" % sock.getsockname()[:2]
+        self._accept_task = loop.create_task(self._accept_loop())
+        return self.address
+
+    async def _accept_loop(self):
+        loop = asyncio.get_running_loop()
+        while not self._closing:
+            try:
+                conn, _ = await loop.sock_accept(self._sock)
+            except asyncio.CancelledError:
+                return
+            except OSError as e:
+                if self._closing:
+                    return
+                # transient accept failure (EMFILE under high fan-in,
+                # ECONNABORTED): the listener must survive it — dying
+                # here would silently strand every future striped pull
+                # on connect timeouts while the node still advertises
+                # its data_address
+                logger.warning("data plane accept error (retrying): %r",
+                               e)
+                await asyncio.sleep(0.1)
+                continue
+            _configure(conn)
+            task = loop.create_task(self._serve_conn(conn))
+            self._conn_tasks.add(task)
+            task.add_done_callback(self._conn_tasks.discard)
+
+    async def _serve_conn(self, sock: socket.socket):
+        try:
+            while not self._closing:
+                try:
+                    req = await _recv_frame(sock)
+                except (ConnectionError, OSError):
+                    return  # peer closed / reset: normal stripe teardown
+                oid_b, offset, length = req
+                if self.on_serve is not None:
+                    self.on_serve(oid_b, offset, length)
+                try:
+                    await self._serve_chunk(sock, oid_b, int(offset),
+                                            int(length))
+                except (ConnectionError, OSError) as e:
+                    # the puller hung up mid-serve (cancelled pull /
+                    # raylet stop): routine teardown, not an error
+                    logger.debug("data plane serve aborted by peer: %r",
+                                 e)
+                    return
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.exception("data plane serve error")
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass  # already torn down
+
+    async def _serve_chunk(self, sock: socket.socket, oid_b: bytes,
+                           offset: int, length: int):
+        from ray_tpu._private.ids import ObjectID
+
+        loop = asyncio.get_running_loop()
+        entry = self.store.entry(ObjectID(oid_b))
+        if entry is None or offset < 0 or length < 0 \
+                or (entry is not None and offset > entry[1]):
+            # invalid range = hostile/corrupt peer: a negative offset
+            # would inflate ``count`` past the real payload and either
+            # hang the client stripe (short mapped slice) or EINVAL the
+            # sendfile after the OK header is on the wire
+            await loop.sock_sendall(sock,
+                                    _pack_frame([STATUS_NOT_FOUND, 0]))
+            return
+        name, total = entry
+        # a remote raylet is mid-pull: its future chunk reads must see
+        # this exact data — the segment must never enter the recycle
+        # pool while the transfer is in flight (same pin as the
+        # control-plane FetchObjectChunk serve path).
+        self.store.mark_exposed(ObjectID(oid_b))
+        end = min(offset + max(0, length), total)
+        count = max(0, end - offset)
+        src = await self._source(name)
+        if src is None:
+            # segment vanished between lookup and open (freed mid-pull)
+            await loop.sock_sendall(sock,
+                                    _pack_frame([STATUS_NOT_FOUND, 0]))
+            return
+        try:
+            await loop.sock_sendall(sock, _pack_frame([STATUS_OK, count]))
+            if count == 0:
+                return
+            if src.kind == "fd":
+                try:
+                    await loop.sock_sendfile(sock, src.obj, offset,
+                                             count, fallback=False)
+                except (asyncio.SendfileNotAvailableError,
+                        NotImplementedError):
+                    # kernel refused this fd/socket pairing: demote the
+                    # source to a mapped attachment for every later
+                    # chunk (the header is already on the wire, so
+                    # serve THIS range from the new mapping too)
+                    src = await self._demote(name, src)
+            if src.kind == "mm":
+                # zero-copy mapped path: the range rides to the socket
+                # as a live view of the attachment — never flattened
+                await loop.sock_sendall(sock, src.obj.buf[offset:end])
+                serve_stats["mapped"] += 1
+            else:
+                serve_stats["sendfile"] += 1
+        finally:
+            src.pins -= 1
+            src.close_if_free()
+        serve_stats["chunks"] += 1
+        serve_stats["bytes"] += count
+        self.num_chunks_served += 1
+
+    async def _source(self, name: str) -> Optional[_Source]:
+        """Pinned source for ``name`` (caller unpins when its send is
+        done). LRU-bounded; a dropped/evicted source closes only once
+        the last pin releases — never under an in-flight sendfile."""
+        src = self._sources.get(name)
+        if src is None or src.dropped:
+            loop = asyncio.get_running_loop()
+            try:
+                # executor: file open / MAP_POPULATE attach of a large
+                # segment must not stall the serving loop
+                kind, obj = await loop.run_in_executor(
+                    None, _open_source, name)
+            except (FileNotFoundError, OSError, ValueError):
+                return None
+            src = _Source(kind, obj)
+            cur = self._sources.get(name)
+            if cur is not None and not cur.dropped:
+                # raced a concurrent first serve during the open: keep
+                # the cached one, close ours (it has no pins yet)
+                src.dropped = True
+                src.close_if_free()
+                src = cur
+            else:
+                self._insert(name, src)
+        else:
+            # LRU touch: most recently used last
+            self._sources.pop(name, None)
+            self._sources[name] = src
+        src.pins += 1
+        return src
+
+    def _insert(self, name: str, src: _Source) -> None:
+        while len(self._sources) >= self.MAX_SOURCES:
+            oldest = next(iter(self._sources))
+            self.drop_source(oldest)
+        self._sources[name] = src
+
+    async def _demote(self, name: str, src: _Source) -> _Source:
+        """Swap a pinned fd source for a mapped attachment (sendfile
+        unavailable); returns the new source, pinned in its place."""
+        loop = asyncio.get_running_loop()
+        kind, obj = await loop.run_in_executor(None, _mm_source, name)
+        mm = _Source(kind, obj)
+        mm.pins = 1
+        old = self._sources.get(name)
+        if old is src:
+            self._sources[name] = mm
+        else:
+            # the cache moved on during the open (FreeObject dropped
+            # the entry, or LRU replaced it): don't re-cache — mark
+            # dropped so the caller's unpin closes the mapping
+            mm.dropped = True
+        src.pins -= 1
+        src.dropped = True
+        src.close_if_free()
+        return mm
+
+    def drop_source(self, name: str) -> None:
+        """Release the cached fd/mapping of a freed segment now instead
+        of waiting for LRU eviction (the raylet's FreeObject path does
+        the same for its control-plane serve attachments). In-flight
+        serves keep it pinned; the close lands on the last unpin."""
+        src = self._sources.pop(name, None)
+        if src is not None:
+            src.dropped = True
+            src.close_if_free()
+
+    async def close(self):
+        self._closing = True
+        if self._accept_task is not None:
+            self._accept_task.cancel()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        for task in list(self._conn_tasks):
+            task.cancel()
+        if self._conn_tasks:
+            await asyncio.gather(*self._conn_tasks,
+                                 return_exceptions=True)
+        for name in list(self._sources):
+            self.drop_source(name)
+
+
+def _open_source(name: str):
+    """("fd", fileobj) for the sendfile path, or ("mm", attachment)
+    where /dev/shm is unavailable (executor-thread helper)."""
+    from ray_tpu._private import shm_store
+
+    try:
+        return "fd", shm_store.open_segment_for_read(name)
+    except (FileNotFoundError, OSError):
+        return _mm_source(name)
+
+
+def _mm_source(name: str):
+    from ray_tpu._private import shm_store
+
+    return "mm", shm_store._QuietSharedMemory(name)
+
+
+# --------------------------------------------------------------------------
+# Receiver side
+# --------------------------------------------------------------------------
+
+
+class _Stripe:
+    __slots__ = ("sock", "lock", "waiter")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        # Chunk-level serialization: two concurrent PULLS sharing this
+        # cached stripe interleave whole request/response exchanges,
+        # never frames.
+        self.lock = asyncio.Lock()
+        # The fetch's parked readable-future, if any: wake-on-close
+        # target (sock.close() alone would strand the parked reader).
+        self.waiter: Optional[asyncio.Future] = None
+
+    def wake(self) -> None:
+        w = self.waiter
+        if w is not None and not w.done():
+            w.set_exception(ConnectionError(
+                "data channel closed under a parked receive"))
+
+
+class DataChannelClient:
+    """N striped raw connections to one peer's DataPlaneServer."""
+
+    def __init__(self, address: str, stripes: int):
+        self.address = address
+        self.num_stripes = max(1, stripes)
+        self.stripes: List[_Stripe] = []
+        self._closed = False
+
+    async def _dial(self, timeout: float) -> socket.socket:
+        host, _, port = self.address.rpartition(":")
+        loop = asyncio.get_running_loop()
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        _configure(sock)
+        try:
+            await asyncio.wait_for(
+                loop.sock_connect(sock, (host, int(port))), timeout)
+        except BaseException as e:
+            # BaseException: a CANCELLED dial (caller timeout, raylet
+            # stop) must close the socket too, or every cancel/retry
+            # cycle leaks an fd
+            sock.close()
+            if isinstance(e, (OSError, asyncio.TimeoutError)):
+                raise ConnectionError(
+                    f"data channel connect to {self.address}: {e}") \
+                    from e
+            raise
+        return sock
+
+    async def connect(self, timeout: float = 5.0):
+        # stripes dial CONCURRENTLY: a black-holed port costs ONE
+        # timeout, not num_stripes of them. Landed sockets accumulate
+        # in a shared list so cancellation mid-gather can close them
+        # (gather would otherwise strand completed results).
+        socks: List[socket.socket] = []
+        errs: List[BaseException] = []
+
+        async def _one():
+            try:
+                socks.append(await self._dial(timeout))
+            except ConnectionError as e:
+                errs.append(e)
+
+        try:
+            await asyncio.gather(
+                *(_one() for _ in range(self.num_stripes)))
+        except BaseException:
+            for s in socks:
+                s.close()
+            raise
+        if errs:  # all-or-nothing: a half-reachable peer is suspect
+            for s in socks:
+                s.close()
+            raise errs[0]
+        self.stripes = [_Stripe(s) for s in socks]
+        return self
+
+    async def ensure_stripes(self, timeout: float = 5.0) -> None:
+        """Re-dial stripes dropped by failures/cancelled pulls, so a
+        transient error does not leave this peer's channel permanently
+        degraded (down to one socket = up to a num_stripes-x throughput
+        loss). Best-effort: the surviving stripes keep working even
+        when the top-up fails. Landed stripes attach immediately, so a
+        cancelled top-up leaks nothing — the channel owns them."""
+        missing = self.num_stripes - len(self.stripes)
+        if missing <= 0 or self._closed:
+            return
+
+        async def _one():
+            try:
+                s = await self._dial(timeout)
+            except ConnectionError as e:
+                logger.debug("stripe top-up to %s failed: %r",
+                             self.address, e)
+                return
+            if self._closed:
+                s.close()
+            else:
+                self.stripes.append(_Stripe(s))
+
+        await asyncio.gather(*(_one() for _ in range(missing)))
+
+    @property
+    def alive(self) -> bool:
+        return bool(self.stripes) and not self._closed
+
+    async def fetch_chunk(self, stripe: _Stripe, oid_b: bytes,
+                          offset: int, length: int, dst, dst_off: int
+                          ) -> int:
+        """Fetch one chunk over ``stripe`` DIRECTLY into
+        ``dst[dst_off:dst_off+length]`` (the destination segment
+        mapping). Returns the payload size served."""
+        loop = asyncio.get_running_loop()
+        async with stripe.lock:
+            try:
+                await loop.sock_sendall(
+                    stripe.sock, _pack_frame([oid_b, offset, length]))
+                status, payload_len = await _recv_frame(stripe.sock,
+                                                        stripe)
+                if status != STATUS_OK:
+                    raise ConnectionError("object vanished mid-pull")
+                if payload_len != length:
+                    # requests are exact (the puller clamps to its
+                    # total), so a short serve means this replica's
+                    # sealed size diverged: accepting it would seal a
+                    # hole of stale segment bytes as valid object data
+                    raise ConnectionError(
+                        f"short chunk from divergent replica "
+                        f"({payload_len} != {length} at {offset})")
+                if payload_len:
+                    await recv_exact_into(stripe.sock, dst, dst_off,
+                                          payload_len, stripe)
+            except BaseException:
+                # Any failure — including cancellation — may leave
+                # unread payload on the wire: the stripe's framing is
+                # unrecoverable, so drop it rather than let a later
+                # pull read garbage.
+                self._drop_stripe(stripe)
+                raise
+        pull_stats["chunks"] += 1
+        pull_stats["bytes"] += payload_len
+        return payload_len
+
+    def _drop_stripe(self, stripe: _Stripe) -> None:
+        try:
+            stripe.sock.close()
+        except OSError:
+            pass
+        stripe.wake()  # a parked reader would never see the close
+        if stripe in self.stripes:
+            self.stripes.remove(stripe)
+
+    async def close(self):
+        self._closed = True
+        for stripe in self.stripes:
+            try:
+                stripe.sock.close()
+            except OSError:
+                pass
+            # closing an fd removes it from the selector SILENTLY: a
+            # fetch parked in _wait_readable would otherwise hang the
+            # pull forever (and pin its admission budget)
+            stripe.wake()
+        self.stripes = []
+
+
+# --------------------------------------------------------------------------
+# Fan-out engine
+# --------------------------------------------------------------------------
+
+
+async def run_striped(offsets: "Deque[int]",
+                      fetchers: List[Callable[[int], Awaitable[None]]]
+                      ) -> None:
+    """Drain ``offsets`` across ``fetchers`` concurrently (one worker
+    per fetcher — a stripe socket, or a legacy control-plane window
+    slot). A fetcher that fails hands its in-flight offset back to the
+    queue and retires for good; chunks handed back AFTER the surviving
+    workers already drained out are re-run on the surviving fetchers in
+    a follow-up round (a lost tail chunk must not void a transfer that
+    healthy stripes can finish). ConnectionError only when every
+    fetcher is dead with work remaining. On any raise — including
+    cancellation of the caller — every in-flight worker is cancelled
+    and awaited BEFORE this returns, so the caller may close the
+    destination mapping immediately after."""
+    if not fetchers:
+        raise ConnectionError("no data-plane fetchers for pull")
+    loop = asyncio.get_running_loop()
+    dead: set = set()
+    last_err: Optional[BaseException] = None
+
+    async def _worker(idx: int, fetch):
+        nonlocal last_err
+        while True:
+            try:
+                off = offsets.popleft()
+            except IndexError:
+                return
+            try:
+                await fetch(off)
+            except asyncio.CancelledError:
+                offsets.appendleft(off)
+                raise
+            except Exception as e:  # noqa: BLE001 — any stripe failure retires the stripe
+                offsets.appendleft(off)
+                dead.add(idx)
+                last_err = e
+                logger.debug("pull stripe %d retired (%d left): %r",
+                             idx, len(fetchers) - len(dead), e)
+                return
+
+    while offsets:
+        lanes = [(i, f) for i, f in enumerate(fetchers) if i not in dead]
+        if not lanes:
+            raise ConnectionError(
+                f"all pull stripes failed mid-pull: {last_err!r}"
+            ) from last_err
+        tasks = [loop.create_task(_worker(i, f)) for i, f in lanes]
+        try:
+            await asyncio.gather(*tasks)
+        except BaseException:
+            # Stop the in-flight siblings BEFORE the caller's segment
+            # goes away — an orphan receive into a closed mmap raises
+            # and leaks "exception never retrieved" noise.
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            raise
+        # offsets non-empty here means some lane died this round while
+        # the survivors had already drained out — loop: the handed-back
+        # chunks re-run on the still-healthy lanes. Terminates: every
+        # extra round strictly grows ``dead`` (a round leaves work
+        # behind only by failing at least one lane) or drains the queue.

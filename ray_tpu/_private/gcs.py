@@ -140,9 +140,12 @@ tick(); setInterval(tick, 5000);
 
 class NodeEntry:
     def __init__(self, node_id: bytes, address: str, resources: Dict[str, float],
-                 node_name: str = ""):
+                 node_name: str = "", data_address: str = ""):
         self.node_id = node_id
         self.address = address
+        # bulk-transfer (data plane) endpoint; "" = peer pulls from this
+        # node ride the control-plane chunk path
+        self.data_address = data_address
         self.node_name = node_name
         self.resources_total = dict(resources)
         self.resources_available = dict(resources)
@@ -675,19 +678,25 @@ class GcsServer:
 
     # --------------------------------------------------------------- nodes
 
+    @staticmethod
+    def _node_alive_msg(entry: NodeEntry) -> dict:
+        return {"event": "alive",
+                "node_id": entry.node_id,
+                "address": entry.address,
+                "data_address": entry.data_address,
+                "resources": entry.resources_total}
+
     async def handle_register_node(self, conn, header, bufs):
         entry = NodeEntry(header["node_id"], header["address"],
-                          header["resources"], header.get("node_name", ""))
+                          header["resources"], header.get("node_name", ""),
+                          header.get("data_address", ""))
         entry.conn = conn
         self.nodes[entry.node_id] = entry
         conn.tags["node_id"] = entry.node_id
         conn.on_disconnect.append(
             lambda c: asyncio.get_event_loop().create_task(
                 self._on_node_connection_lost(entry.node_id)))
-        await self._publish("NODE", {"event": "alive",
-                                     "node_id": entry.node_id,
-                                     "address": entry.address,
-                                     "resources": entry.resources_total})
+        await self._publish("NODE", self._node_alive_msg(entry))
         return {"ok": True, "num_nodes": len(self.nodes)}
 
     async def handle_heartbeat(self, conn, header, bufs):
@@ -712,6 +721,7 @@ class GcsServer:
     async def handle_get_all_node_info(self, conn, header, bufs):
         return {"nodes": [{
             "node_id": n.node_id, "address": n.address, "alive": n.alive,
+            "data_address": n.data_address,
             "node_name": n.node_name,
             "resources_total": n.resources_total,
             "resources_available": n.resources_available,

@@ -103,6 +103,12 @@ _copy_pool_lock = threading.Lock()
 # copies ran native / striped / pure-Python.
 copy_stats = {"native": 0, "striped": 0, "fallback": 0}
 
+# Receive half of the striped data plane (data_channel.py): how many
+# socket->buffer receives ran native vs pure-Python. Both tiers land
+# DIRECTLY in the caller's buffer — the counter is a tier probe, not a
+# copy count (each receive is exactly one kernel->buffer copy).
+recv_stats = {"native": 0, "fallback": 0}
+
 
 def have_native_copy() -> bool:
     mod = load_fastpath()
@@ -175,6 +181,39 @@ def copy_into(dst, dst_off: int, src, chunk_bytes: int | None = None) -> int:
     dv[dst_off:dst_off + sv.nbytes] = sv
     copy_stats["fallback"] += 1
     return sv.nbytes
+
+
+def sock_recv_into(sock, dst, dst_off: int, nbytes: int) -> int:
+    """One receive from ``sock`` straight into ``dst[dst_off:dst_off+
+    nbytes]``; returns the byte count received (short reads are normal
+    — callers loop), ``0`` on orderly peer EOF, or ``-1`` when the
+    non-blocking socket has no data ready (the caller awaits loop
+    readability and retries).
+
+    This is the single-copy seam of the cross-node data plane: the
+    destination is the puller's mapped shm segment, so object bytes go
+    kernel socket buffer -> segment pages with no intermediate
+    ``bytes``. Native tier: the GIL-releasing ``recv(2)`` in
+    cpp/fastpath.c (already-loaded module only, same discipline as
+    :func:`copy_into`). Fallback: ``socket.recv_into`` on a zero-copy
+    memoryview slice of the destination — still one copy, just via the
+    socket object's own machinery."""
+    mod = loaded_fastpath()
+    if mod is not None and hasattr(mod, "recv_into"):
+        try:
+            n = mod.recv_into(sock.fileno(), dst, dst_off, nbytes)
+        except (BufferError, TypeError):
+            pass  # exotic destination buffer: pure-Python path
+        else:
+            recv_stats["native"] += 1
+            return n
+    view = _as_byte_view(dst)
+    try:
+        n = sock.recv_into(view[dst_off:dst_off + nbytes])
+    except (BlockingIOError, InterruptedError):
+        return -1
+    recv_stats["fallback"] += 1
+    return n
 
 
 def _build_and_load():

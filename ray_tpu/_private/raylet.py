@@ -14,7 +14,9 @@ Protocol surface (all framed-msgpack RPC, see rpc.py):
               client-side, reference: direct_task_transport.h)
   GCS       : ScheduleActorCreation, KillActorWorker, PreparePGBundle,
               CommitPGBundle, ReturnPGBundle, DrainSelf
-  raylets   : FetchObject (remote pull)
+  raylets   : FetchObjectMeta (pull probe) + FetchObjectChunk (legacy
+              chunk serve); bulk chunk bytes ride the striped raw-socket
+              data plane (data_channel.py), never this control stream
   ops       : GetNodeStats, GetLogs, DumpWorkerStacks, SetResource
 
 The reference's per-node dashboard/runtime-env AGENT process
@@ -155,6 +157,20 @@ class Raylet:
         # Pull state (reference: PullManager): dedupe + admission control.
         self._active_pulls: Dict[ObjectID, asyncio.Task] = {}
         self._pull_inflight_bytes = 0
+        # Admission waiters park on this Condition and are notified on
+        # every pull completion (no sleep-polling on the loop).
+        self._pull_cond = asyncio.Condition()
+        # Striped data plane (data_channel.py): bulk chunk bytes ride
+        # dedicated raw sockets, never the RPC control stream.
+        self.data_server: Optional[Any] = None
+        self.data_address = ""
+        self._data_channels: Dict[str, Any] = {}
+        # Pull-side node directory for peers that registered BEFORE this
+        # raylet subscribed to NODE (the pubsub view misses them): filled
+        # on demand from the GCS, used ONLY by the pull path — the
+        # scheduler's cluster view stays the pubsub one.
+        self._node_directory: Dict[bytes, dict] = {}
+        self._node_dir_refresh: Optional[asyncio.Task] = None
         # Serve-side attachment cache: chunked pulls hit the same segment
         # many times; re-mmap'ing per chunk would sit on the transfer hot
         # path (reference: ObjectBufferPool holds chunk buffers open).
@@ -186,6 +202,7 @@ class Raylet:
             "AbortSegment": self.handle_abort_segment,
             "GetObjectInfo": self.handle_get_object_info,
             "EnsureObjectLocal": self.handle_ensure_object_local,
+            "FetchObjectMeta": self.handle_fetch_object_meta,
             "FetchObjectChunk": self.handle_fetch_object_chunk,
             "PinObject": self.handle_pin_object,
             "FreeObject": self.handle_free_object,
@@ -214,6 +231,17 @@ class Raylet:
         if not listen_address:
             listen_address = f"unix://{sock_dir}/raylet-{self.node_id.hex()[:12]}"
         self.address = await self._server.listen(listen_address)
+        if self.config.data_plane_stripes > 0:
+            # Bulk-transfer listener next to the RPC server (reference:
+            # the object manager's own server, separate from the node
+            # manager's — src/ray/object_manager/object_manager.h).
+            from ray_tpu._private.data_channel import DataPlaneServer
+            host = "127.0.0.1"
+            if self.address.startswith("tcp://"):
+                host = self.address[len("tcp://"):].rpartition(":")[0] \
+                    or host
+            self.data_server = DataPlaneServer(self.store, host=host)
+            self.data_address = await self.data_server.start()
         self.gcs_address = gcs_address
         # Full handler map on the GCS connection too: the GCS issues
         # requests (actor scheduling, PG 2PC, kills) back over this pipe.
@@ -258,6 +286,11 @@ class Raylet:
             except (ConnectionError, asyncio.TimeoutError):
                 pass
             await self.gcs_conn.close()
+        for ch in list(self._data_channels.values()):
+            await ch.close()
+        self._data_channels.clear()
+        if self.data_server is not None:
+            await self.data_server.close()
         for att in self._serve_attachments.values():
             try:
                 att.close()
@@ -389,6 +422,10 @@ class Raylet:
         await self.gcs_conn.call("RegisterNode", {
             "node_id": self.node_id.binary(),
             "address": self.address,
+            # peers learn the bulk-transfer endpoint through the NODE
+            # channel; "" = data plane disabled (pulls from this node
+            # use the control-plane chunk path)
+            "data_address": self.data_address,
             "resources": self.resources_total,
             "node_name": self.node_name,
         })
@@ -422,6 +459,7 @@ class Raylet:
             if msg["event"] == "alive":
                 self.remote_nodes[nid] = {
                     "address": msg["address"],
+                    "data_address": msg.get("data_address", ""),
                     "resources_total": msg["resources"],
                     "resources_available": dict(msg["resources"]),
                 }
@@ -429,7 +467,17 @@ class Raylet:
                 # (infeasible-so-far) request needs: spill it there now
                 self._schedule_tick()
             elif msg["event"] == "dead":
-                self.remote_nodes.pop(nid, None)
+                pub_info = self.remote_nodes.pop(nid, None)
+                dir_info = self._node_directory.pop(nid, None)
+                info = pub_info or dir_info
+                if info:
+                    # a restarted peer binds a fresh data port: the old
+                    # address key would never be looked up again, so
+                    # the stale client's stripe sockets must go now
+                    ch = self._data_channels.pop(
+                        info.get("data_address", ""), None)
+                    if ch is not None:
+                        await ch.close()
         return {}
 
     # ----------------------------------------------------------- worker pool
@@ -1002,12 +1050,17 @@ class Raylet:
                     att.close()
                 except BufferError:
                     pass
+            if self.data_server is not None:
+                self.data_server.drop_source(entry[0])
         self.store.free(oid)
 
         # Owner-supplied location list: forward the free to every other node
         # holding a copy (the owner has no raylet connections of its own).
         async def _free_on(nid: bytes):
-            info = self.remote_nodes.get(nid)
+            # _lookup_node, not remote_nodes: a replica on a peer this
+            # raylet never saw register (the pubsub late-join gap) must
+            # still be freed, exactly like it can be pulled from
+            info = await self._lookup_node(nid)
             if info is None:
                 return
             try:
@@ -1023,11 +1076,29 @@ class Raylet:
             await asyncio.gather(*[_free_on(nid) for nid in peers])
         return {"ok": True}
 
+    async def handle_fetch_object_meta(self, conn, header, bufs):
+        """Size + bulk-transfer endpoint probe that opens a pull: the
+        puller learns total_size for admission/segment sizing and the
+        data-channel address chunk requests should go to (empty = this
+        node serves chunks over the control plane only)."""
+        oid = ObjectID(header["object_id"])
+        entry = self.store.entry(oid)
+        if entry is None:
+            return {"found": False}
+        # A remote raylet is about to read chunks of this segment: it
+        # must never enter the recycle pool mid-pull (same pin as the
+        # chunk serve paths).
+        self.store.mark_exposed(oid)
+        return {"found": True, "total_size": entry[1],
+                "data_address": self.data_address}
+
     async def handle_fetch_object_chunk(self, conn, header, bufs):
-        """Serve one chunk of a remote raylet's pull (reference: the chunked
-        Push path, src/ray/object_manager/push_manager.h — chunks bounded by
-        object_manager_chunk_size so no single frame carries a whole large
-        object)."""
+        """Serve one chunk of a remote raylet's pull over the CONTROL
+        plane (reference: the chunked Push path,
+        src/ray/object_manager/push_manager.h). Retained as the
+        fallback for peers whose puller runs with the data plane
+        disabled (data_plane_stripes=0); striped pulls use the raw
+        data channel (data_channel.py) instead."""
         oid = ObjectID(header["object_id"])
         segment = self.store.lookup(oid)
         if segment is None:
@@ -1101,81 +1172,340 @@ class Raylet:
         return await asyncio.shield(pull)
 
     async def _pull_object(self, oid: ObjectID, owner_address: str) -> dict:
-        locations: List[bytes] = []
-        if owner_address:
-            try:
-                owner = await self._owner_conn(owner_address)
-                reply, _ = await owner.call("GetObjectLocations",
-                                            {"object_id": oid.binary()})
-                locations = reply.get("locations", [])
-            except ConnectionError:
-                pass
-        for nid in locations:
-            if nid == self.node_id.binary():
+        reason = "object not found at any location"
+        for round_no in range(2):
+            if round_no:
+                if not owner_address:
+                    break  # nobody to re-ask for locations
+                # Every known location failed (peer death / replica
+                # freed mid-pull). Refresh the owner's location index
+                # ONCE after a short backoff: a replica added meanwhile
+                # (e.g. by a concurrent pull elsewhere) is found
+                # instead of erroring the get.
+                await asyncio.sleep(
+                    self.config.pull_location_refresh_backoff_s)
+            locations = await self._query_locations(oid, owner_address)
+            sources = await self._pull_sources(locations)
+            if not sources:
                 continue
-            info = self.remote_nodes.get(nid)
-            if info is None:
-                continue
-            try:
-                peer = await self._peer_conn(info["address"])
-                pulled = await self._pull_chunked(oid, peer)
-            except ConnectionError:
-                continue
+            pulled = await self._pull_chunked(oid, sources)
             if pulled is None:
                 continue
             name, total = pulled
-            if self.store.seal(oid, name, total):
-                # Report the replica to the owner so its location index
-                # stays complete and FreeObject reaches this node too
-                # (reference: ObjectDirectory location adds).
-                if owner_address:
-                    async def _report(addr=owner_address):
-                        try:
-                            owner = await self._owner_conn(addr)
-                            r, _ = await owner.call(
-                                "AddObjectLocation", {
-                                    "object_id": oid.binary(),
-                                    "node_id": self.node_id.binary()})
-                            if not r.get("ok"):
-                                # owner already released the object —
-                                # drop our replica
-                                self.store.free(oid)
-                        # raylint: disable=exception-hygiene — owner may be gone; replica already dropped
-                        except Exception:
-                            pass
-                    asyncio.get_running_loop().create_task(_report())
-                self.store.mark_exposed(oid)  # caller is about to mmap
-                return {"ok": True, "segment": name}
-        return {"ok": False, "reason": "object not found at any location"}
+            if not self.store.seal(oid, name, total):
+                # distinct reason: the transfer SUCCEEDED — pointing
+                # the operator at replica locations would hide the
+                # real (local capacity) cause
+                reason = "local store refused seal (capacity)"
+                break  # retrying cannot help
+            # Report the replica to the owner so its location index
+            # stays complete and FreeObject reaches this node too
+            # (reference: ObjectDirectory location adds).
+            if owner_address:
+                async def _report(addr=owner_address):
+                    try:
+                        owner = await self._owner_conn(addr)
+                        r, _ = await owner.call(
+                            "AddObjectLocation", {
+                                "object_id": oid.binary(),
+                                "node_id": self.node_id.binary()})
+                        if not r.get("ok"):
+                            # owner already released the object —
+                            # drop our replica
+                            self.store.free(oid)
+                    # raylint: disable=exception-hygiene — owner may be gone; replica already dropped
+                    except Exception:
+                        pass
+                asyncio.get_running_loop().create_task(_report())
+            self.store.mark_exposed(oid)  # caller is about to mmap
+            return {"ok": True, "segment": name}
+        return {"ok": False, "reason": reason}
+
+    async def _query_locations(self, oid: ObjectID,
+                               owner_address: str) -> List[bytes]:
+        if not owner_address:
+            return []
+        try:
+            owner = await self._owner_conn(owner_address)
+            reply, _ = await owner.call("GetObjectLocations",
+                                        {"object_id": oid.binary()})
+            return reply.get("locations", [])
+        except ConnectionError:
+            return []
+
+    async def _lookup_node(self, nid: bytes) -> Optional[dict]:
+        """Node info for the PULL/free path: the pubsub view first,
+        then a GCS directory lookup for nodes that registered before
+        this raylet subscribed (the late-join gap) — a pull must reach
+        EVERY replica holder, not just peers whose alive event this
+        raylet happened to see. Deliberately not fed into remote_nodes:
+        the scheduler's spillback view stays pubsub-driven. Concurrent
+        cache misses (a fan-out pull probing N locations at once) share
+        ONE in-flight GetAllNodeInfo instead of stampeding the GCS."""
+        info = self.remote_nodes.get(nid) or self._node_directory.get(nid)
+        if info is not None:
+            return info
+        if self._node_dir_refresh is None or self._node_dir_refresh.done():
+            self._node_dir_refresh = asyncio.get_running_loop() \
+                .create_task(self._refresh_node_directory())
+        # shield: this caller's cancellation must not kill the refresh
+        # other concurrent lookups are waiting on
+        await asyncio.shield(self._node_dir_refresh)
+        return self.remote_nodes.get(nid) or self._node_directory.get(nid)
+
+    async def _refresh_node_directory(self) -> None:
+        try:
+            reply, _ = await self.gcs_conn.call("GetAllNodeInfo", {})
+        except ConnectionError:
+            return
+        for n in reply.get("nodes", []):
+            if not n.get("alive") or n["node_id"] == self.node_id.binary():
+                continue
+            self._node_directory.setdefault(n["node_id"], {
+                "address": n["address"],
+                "data_address": n.get("data_address", ""),
+                "resources_total": n.get("resources_total", {}),
+                "resources_available": dict(
+                    n.get("resources_available", {})),
+            })
+
+    @staticmethod
+    async def _first_plus_grace(coros, grace: float = 0.5) -> list:
+        """Run coroutines concurrently and return the truthy results —
+        but once ANY of them yields one, give the stragglers only
+        ``grace`` seconds before abandoning (cancelling) them. This is
+        how every pull-setup fan-out is bounded: a dead peer's connect
+        timeout must never gate the work the live peers can already do
+        (it costs at most ``grace`` on top of the fastest success)."""
+        tasks = [asyncio.ensure_future(c) for c in coros]
+        results: list = []
+        try:
+            pending = set(tasks)
+            while pending and not any(results):
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED)
+                for t in done:  # all done: these awaits return at once
+                    results.append(await t)
+            if pending:
+                done, _ = await asyncio.wait(pending, timeout=grace)
+                for t in done:
+                    results.append(await t)
+        finally:
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+        return [r for r in results if r]
+
+    async def _pull_sources(self, locations: List[bytes]
+                            ) -> List[Tuple[rpc.Connection, str]]:
+        """Reachable replica holders as (control conn, data_address).
+        Connects run CONCURRENTLY, first success + grace: one dead peer
+        never delays pulling from the live replicas."""
+        async def _one(nid: bytes):
+            info = await self._lookup_node(nid)
+            if info is None:
+                return None
+            try:
+                conn = await self._peer_conn(info["address"])
+            except ConnectionError:
+                return None
+            return conn, info.get("data_address", "")
+
+        candidates = [nid for nid in locations
+                      if nid != self.node_id.binary()]
+        if not candidates:
+            return []
+        return await self._first_plus_grace(_one(n) for n in candidates)
+
+    def _pull_chunk_size(self, total: int, num_peers: int) -> int:
+        """Adaptive data-plane chunk size. object_manager_chunk_size
+        stays the FLOOR (and the exact size with the data plane off);
+        large objects raise it toward data_plane_max_chunk_size so the
+        transfer is copy-bound, not request-round-trip-bound — while
+        keeping ~8 chunks per stripe so fan-out still balances."""
+        floor = self.config.object_manager_chunk_size
+        if self.config.data_plane_stripes <= 0:
+            return floor
+        lanes = self.config.data_plane_stripes * max(1, num_peers)
+        target = -(-total // (8 * lanes))  # ceil div
+        return min(max(floor, target),
+                   max(floor, self.config.data_plane_max_chunk_size))
+
+    async def _admit_pull(self, total: int, chunk: int) -> None:
+        """Pull admission control (reference: pull_manager.h:47): wait
+        — parked on the Condition, notified at every pull completion,
+        no sleep-polling — until the in-flight byte budget has room.
+
+        HONEST BUDGET: a single object LARGER than the whole budget can
+        never fit under it, so it is admitted exactly when nothing else
+        is in flight (``_pull_inflight_bytes == 0``) — oversized pulls
+        serialize with everything else instead of deadlocking the
+        admission queue (waiting for room that can never appear) or
+        stampeding the store alongside admitted pulls."""
+        budget = max(self.store.capacity // 4, chunk)
+        async with self._pull_cond:
+            await self._pull_cond.wait_for(
+                lambda: self._pull_inflight_bytes == 0 or
+                self._pull_inflight_bytes + total <= budget)
+            self._pull_inflight_bytes += total
+
+    def _notify_pull_done(self) -> None:
+        """Wake admission waiters after ``_pull_inflight_bytes``
+        dropped. The decrement itself runs synchronously in the
+        caller's ``finally`` (a cancelled task must never leak budget);
+        the Condition notify needs its lock held, so it rides a fresh
+        task that cannot be cancelled with the pull."""
+        async def _notify():
+            async with self._pull_cond:
+                self._pull_cond.notify_all()
+        asyncio.get_running_loop().create_task(_notify())
+
+    async def _data_channel(self, address: str):
+        """Cached striped data-channel client for one peer (reference:
+        ObjectManager's per-peer transfer connections). Stripes dropped
+        by failures or cancelled pulls are topped back up here, so a
+        transient error never leaves the channel permanently degraded."""
+        from ray_tpu._private.data_channel import DataChannelClient
+        ch = self._data_channels.get(address)
+        if ch is not None and ch.alive and \
+                len(ch.stripes) < ch.num_stripes:
+            await ch.ensure_stripes()
+        if ch is None or not ch.alive:
+            fresh = await DataChannelClient(
+                address, self.config.data_plane_stripes).connect()
+            ch = self._data_channels.get(address)
+            if ch is not None and ch.alive:
+                # raced a concurrent pull's connect during the await:
+                # keep the cached client, close the loser's sockets
+                await fresh.close()
+            else:
+                self._data_channels[address] = ch = fresh
+        return ch
+
+    async def _pull_fetchers(self, oid: ObjectID, found, chunk: int,
+                             total: int, buf) -> list:
+        """One fetch coroutine per transfer lane: every stripe of every
+        replica-holding peer's data channel — chunk bytes land DIRECTLY
+        in ``buf`` (the destination mapping) via the data plane's
+        recv_into, one copy per chunk — or, for peers without a data
+        channel, a window of control-plane FetchObjectChunk slots
+        (socket -> bytes -> copy_into, the pre-data-plane path)."""
+        from ray_tpu._private import native
+        oid_b = oid.binary()
+
+        async def _source_fetchers(conn, data_address):
+            channel = None
+            if data_address and self.config.data_plane_stripes > 0:
+                try:
+                    channel = await self._data_channel(data_address)
+                except ConnectionError:
+                    channel = None  # data port dead; control conn lives
+            fetchers = []
+            if channel is not None:
+                for stripe in channel.stripes:
+                    async def _fetch(off, _s=stripe, _ch=channel):
+                        await _ch.fetch_chunk(
+                            _s, oid_b, off, min(chunk, total - off),
+                            buf, off)
+                    fetchers.append(_fetch)
+            else:
+                async def _legacy(off, _conn=conn):
+                    from ray_tpu._private.data_channel import pull_stats
+                    # Control-plane lane: these frames SHARE the RPC
+                    # stream with heartbeats and lease grants, so the
+                    # adaptive data-plane chunk must never inflate them
+                    # — sub-fetch at the fixed control-plane size,
+                    # keeping the pre-data-plane bound (8 lanes x
+                    # object_manager_chunk_size bytes in flight).
+                    floor = self.config.object_manager_chunk_size
+                    end = min(off + chunk, total)
+                    sub = off
+                    while sub < end:
+                        want = min(floor, end - sub)
+                        r, bufs2 = await _conn.call("FetchObjectChunk", {
+                            "object_id": oid_b, "offset": sub,
+                            "length": want})
+                        if not r.get("found"):
+                            raise ConnectionError(
+                                "object vanished mid-pull")
+                        if len(bufs2[0]) != want:
+                            raise ConnectionError(
+                                "short chunk from divergent replica")
+                        native.copy_into(buf, sub, bufs2[0])
+                        pull_stats["chunks"] += 1
+                        pull_stats["bytes"] += want
+                        # the recv loop materialized this sub-chunk as
+                        # bytes before copy_into: one intermediate copy
+                        pull_stats["intermediate_copies"] += 1
+                        sub += want
+                # the old pull window: 8 in-flight chunks per peer
+                fetchers.extend([_legacy] * 8)
+            return fetchers
+
+        # Per-peer channel setup runs CONCURRENTLY, first success +
+        # grace: a black-holed data port's stripe-dial timeout never
+        # holds back lanes the reachable peers already have up —
+        # stragglers are abandoned (their cancelled dials close their
+        # own sockets) and the pull starts on the ready lanes.
+        per_source = await self._first_plus_grace(
+            _source_fetchers(c, d) for c, d in found)
+        return [f for lanes in per_source for f in lanes]
 
     async def _pull_chunked(self, oid: ObjectID,
-                            peer: rpc.Connection
+                            sources: List[Tuple[rpc.Connection, str]]
                             ) -> Optional[Tuple[str, int]]:
-        """Windowed chunk pull into a fresh local segment; returns
-        (segment_name, total_size) (reference: PushManager's chunk window
-        + ObjectBufferPool chunk writes). Admission: total in-flight pull
-        bytes are bounded so concurrent pulls cannot overcommit the store
-        (reference: pull_manager.h:47 admission control)."""
-        chunk = self.config.object_manager_chunk_size
-        reply, rbufs = await peer.call("FetchObjectChunk", {
-            "object_id": oid.binary(), "offset": 0, "length": chunk})
-        if not reply.get("found"):
+        """Striped, flow-controlled pull into a fresh local segment;
+        returns (segment_name, total_size) or None when no source could
+        serve the object. Chunk offsets fan out across every stripe of
+        every replica-holding peer (data_channel.run_striped); a failed
+        stripe hands its chunk to the survivors, so the pull outlives
+        anything short of every source dying (reference: PushManager's
+        chunk window + ObjectBufferPool chunk writes). Admission: total
+        in-flight pull bytes are bounded so concurrent pulls cannot
+        overcommit the store (reference: pull_manager.h:47)."""
+        from collections import deque
+
+        from ray_tpu._private import data_channel
+        from ray_tpu._private.shm_store import (
+            RECYCLE_MIN_BYTES, _close_segment_owner, acquire_segment)
+
+        # Probe every source for size + bulk endpoint (concurrently,
+        # first success + grace — a wedged-but-connected peer whose
+        # call never answers must not park the pull); unreachable or
+        # object-less sources drop out here.
+        async def _probe(conn, data_address):
+            try:
+                reply, _ = await conn.call(
+                    "FetchObjectMeta", {"object_id": oid.binary()})
+            except ConnectionError:
+                return None
+            if not reply.get("found"):
+                return None
+            return (conn, reply.get("data_address") or data_address,
+                    reply["total_size"])
+
+        probes = await self._first_plus_grace(
+            _probe(c, d) for c, d in sources)
+        found: List[Tuple[rpc.Connection, str]] = []
+        total = 0
+        for conn, data_address, t in probes:
+            if found and t != total:
+                # divergent replica (size disagrees with the first
+                # holder): chunks from it could seal wrong bytes —
+                # drop the source, the strict per-chunk length check
+                # is the backstop
+                continue
+            total = t
+            found.append((conn, data_address))
+        if not found:
             return None
-        total = reply["total_size"]
-        # admission: wait until the in-flight pull budget has room
-        budget = max(self.store.capacity // 4, chunk)
-        while self._pull_inflight_bytes > 0 and \
-                self._pull_inflight_bytes + total > budget:
-            await asyncio.sleep(0.005)
-        self._pull_inflight_bytes += total
+        chunk = self._pull_chunk_size(total, len(found))
+        await self._admit_pull(total, chunk)
         try:
-            from ray_tpu._private import native
-            from ray_tpu._private.shm_store import (
-                RECYCLE_MIN_BYTES, _close_segment_owner, acquire_segment)
             # Destination: a recycled warm segment when the local store
             # has one (page allocation dominates cold pull writes), else
-            # a fresh MAP_POPULATE mapping; chunk writes are
-            # GIL-releasing native copies either way.
+            # a fresh MAP_POPULATE mapping; chunk payloads are received
+            # straight into it.
             alloc = self.store.take_recycled(total) \
                 if total >= RECYCLE_MIN_BYTES else None
             loop = asyncio.get_running_loop()
@@ -1183,40 +1513,37 @@ class Raylet:
             # otherwise stall the raylet loop for the whole zero-fill
             name, owner, buf = await loop.run_in_executor(
                 None, acquire_segment, alloc, max(total, 1))
-            first = rbufs[0]
-            native.copy_into(buf, 0, first)
-            offsets = list(range(chunk, total, chunk))
-            window = asyncio.Semaphore(8)
+            offsets = deque(range(0, total, chunk))
+            fetchers = await self._pull_fetchers(
+                oid, found, chunk, total, buf)
 
-            async def _fetch_at(off: int):
-                async with window:
-                    r, bufs2 = await peer.call("FetchObjectChunk", {
-                        "object_id": oid.binary(), "offset": off,
-                        "length": chunk})
-                    if not r.get("found"):
-                        raise ConnectionError("object vanished mid-pull")
-                    native.copy_into(buf, off, bufs2[0])
-
-            tasks = [loop.create_task(_fetch_at(o)) for o in offsets]
-            try:
-                if tasks:
-                    await asyncio.gather(*tasks)
-            except (ConnectionError, asyncio.CancelledError):
-                # Stop the in-flight siblings BEFORE the segment goes
-                # away — an orphan write into a closed mmap raises and
-                # leaks "exception never retrieved" noise.
-                for t in tasks:
-                    t.cancel()
-                await asyncio.gather(*tasks, return_exceptions=True)
+            def _discard():
+                # run_striped cancelled AND awaited every in-flight
+                # sibling before raising, so the segment can go away
+                # now without an orphan receive landing in a closed
+                # mmap.
                 _close_segment_owner(owner, buf)
                 self.store.release_lease(name)
                 self._unlink_segment(name)
+
+            try:
+                if offsets:
+                    await data_channel.run_striped(offsets, fetchers)
+            except asyncio.CancelledError:
+                # cancellation must UNWIND (a swallowed cancel would
+                # roll into the location-refresh round and restart the
+                # whole transfer on a cancelled task)
+                _discard()
+                raise
+            except ConnectionError:
+                _discard()
                 return None
             _close_segment_owner(owner, buf)
             self.store.release_lease(name)  # sealed by the caller next
             return name, total
         finally:
             self._pull_inflight_bytes -= total
+            self._notify_pull_done()
 
     @staticmethod
     def _unlink_segment(name: str):
@@ -1231,7 +1558,9 @@ class Raylet:
     async def _peer_conn(self, address: str) -> rpc.Connection:
         conn = self._peer_raylets.get(address)
         if conn is None or conn.closed:
-            conn = await rpc.connect(address, peer_name=f"raylet@{address}")
+            conn = await rpc.connect(
+                address, peer_name=f"raylet@{address}",
+                timeout=self.config.rpc_connect_timeout_s)
             self._peer_raylets[address] = conn
         return conn
 
@@ -1408,8 +1737,18 @@ class Raylet:
         return {"name": matches[0], "lines": lines}
 
     async def handle_get_node_stats(self, conn, header, bufs):
+        from ray_tpu._private import native
+        from ray_tpu._private.data_channel import pull_stats, serve_stats
         from ray_tpu._private.rpc import handler_stats
         return {
+            "data_plane": {
+                "data_address": self.data_address,
+                "stripes": self.config.data_plane_stripes,
+                "pull": dict(pull_stats),
+                "serve": dict(serve_stats),
+                "recv_tiers": dict(native.recv_stats),
+                "pull_inflight_bytes": self._pull_inflight_bytes,
+            },
             "schedule_latency": self._latency_percentiles(),
             "rpc_handlers": handler_stats.snapshot(),
             "node_id": self.node_id.binary(),

@@ -242,6 +242,16 @@ def _attach_segment_buf(name: str):
     return shm, shm.buf
 
 
+def open_segment_for_read(name: str):
+    """An unbuffered read-only file object on a segment's /dev/shm file
+    — the sender-side seam of the cross-node data plane: os.sendfile
+    streams chunk ranges straight from these pages to the peer's socket
+    (no mapping, no userspace copy). Raises FileNotFoundError where the
+    segment is not /dev/shm-backed (exotic platforms); the data server
+    falls back to serving from a mapped attachment."""
+    return open(f"/dev/shm/{name}", "rb", buffering=0)
+
+
 def _close_segment_owner(owner, buf) -> None:
     if isinstance(owner, shared_memory.SharedMemory):
         owner.close()
@@ -766,6 +776,22 @@ class ShmStoreServer:
 
     def contains(self, object_id: ObjectID) -> bool:
         return object_id in self._objects or object_id in self._spilled
+
+    def entry(self, object_id: ObjectID) -> Optional[Tuple[str, int]]:
+        """(segment_name, logical_size) for a stored object, restoring
+        it from spill first if needed; None when unknown. The size is
+        the sealed object size, which may be smaller than the segment
+        file (recycled segments keep their larger file). NOTE: like
+        ``lookup`` (every serve path uses one of the two), a spilled
+        object restores SYNCHRONOUSLY on the calling thread — the
+        store's tables are loop-confined, so callers on the raylet loop
+        pay the restore there; making restore async is a store-wide
+        refactor, tracked as future work."""
+        name = self.lookup(object_id)
+        if name is None:
+            return None
+        e = self._objects.get(object_id)
+        return (name, e[1]) if e is not None else None
 
     # -- pinning (primary copies; owner-driven) ------------------------------
 

@@ -1,0 +1,626 @@
+"""Striped zero-copy data plane (data_channel.py + the raylet pull path).
+
+Coverage model: the reference's object-manager tests (chunked transfer,
+pull retry, admission) plus the zero-copy invariants this repo's data
+plane adds — chunk payloads land socket -> destination shm mapping with
+no intermediate ``bytes`` and no second copy, stripe failures fall
+through to surviving stripes/replicas, the admission budget is honest
+for oversized objects, and failed pulls release their segment lease.
+
+All multi-raylet tests run GCS + raylets IN-PROCESS on one loop (no
+worker subprocesses: num_prestart_workers=0), so fault injection is a
+deterministic hook, not a SIGKILL race.
+"""
+
+import asyncio
+import os
+import time
+from collections import deque
+
+import numpy as np
+import pytest
+
+from ray_tpu._private import data_channel, native, rpc
+from ray_tpu._private.config import RayTpuConfig
+from ray_tpu._private.gcs import GcsServer
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.raylet import Raylet
+from ray_tpu._private.serialization import SerializationContext
+from ray_tpu._private.shm_store import AttachedObject, write_segment
+
+BASE_CFG = {
+    "num_prestart_workers": 0,
+    "event_log_enabled": False,
+    "object_manager_chunk_size": 65536,
+    "pull_location_refresh_backoff_s": 0.05,
+    "rpc_connect_timeout_s": 1.0,
+}
+
+
+async def _boot(n_raylets, tmp, **overrides):
+    cfg = RayTpuConfig.create({**BASE_CFG, **overrides})
+    gcs = GcsServer(cfg)
+    gcs_addr = await gcs.start("tcp://127.0.0.1:0")
+    raylets = []
+    for i in range(n_raylets):
+        r = Raylet(cfg, 1, session_dir=str(tmp), node_name=f"r{i}")
+        await r.start(gcs_addr)
+        raylets.append(r)
+    # NOTE: pubsub only tells EARLIER raylets about later ones; a late
+    # joiner reaches earlier peers through the pull path's GCS node
+    # directory (Raylet._lookup_node), which these tests exercise.
+    assert len(gcs.nodes) == n_raylets
+    return gcs, raylets
+
+
+async def _teardown(gcs, raylets, owners=()):
+    for o in owners:
+        await o.close()
+    for r in raylets:
+        try:
+            await r.stop()
+        except Exception:  # noqa: BLE001 — death tests half-stop raylets
+            pass
+    await gcs.stop()
+
+
+def _owner_server(locations_fn):
+    """Stand-in for the owning core worker's location index."""
+    calls = {"n": 0}
+
+    async def _locs(conn, header, bufs):
+        calls["n"] += 1
+        return {"locations": locations_fn(calls["n"])}
+
+    async def _add(conn, header, bufs):
+        return {"ok": True}
+
+    return rpc.RpcServer({"GetObjectLocations": _locs,
+                          "AddObjectLocation": _add},
+                         name="owner"), calls
+
+
+def _seal(raylet, arr, oid=None):
+    """Write + seal ``arr`` into a raylet's store; returns (oid, ctx)."""
+    ctx = SerializationContext()
+    name, size = write_segment(ctx.serialize(arr))
+    oid = oid or ObjectID.from_random()
+    assert raylet.store.seal(oid, name, size)
+    return oid, ctx
+
+
+def _check_roundtrip(ctx, segment, arr):
+    att = AttachedObject(segment)
+    got = ctx.deserialize(att.metadata, att.frames)
+    assert np.array_equal(got, arr), "pulled payload corrupted"
+    got = None
+    att.close()
+
+
+# ---------------------------------------------------------------------------
+# the zero-copy acceptance invariant
+# ---------------------------------------------------------------------------
+
+
+def test_striped_pull_single_copy_roundtrip(tmp_path, monkeypatch):
+    """A cross-node pull over the data plane is ONE copy per chunk:
+    every payload-sized receive targets the destination segment mapping
+    directly (a memoryview of the mmap, never a bytes/bytearray temp),
+    and the old second-copy seam (native.copy_into) is never called on
+    the hot path."""
+
+    async def run():
+        gcs, (r0, r1) = await _boot(2, tmp_path)
+        owner, _ = _owner_server(lambda n: [r0.node_id.binary()])
+        owner_addr = await owner.listen("tcp://127.0.0.1:0")
+        try:
+            arr = np.random.default_rng(0).integers(
+                0, 255, 6_000_037, dtype=np.uint8)
+            oid, ctx = _seal(r0, arr)
+
+            copy_calls = []
+            orig_copy = native.copy_into
+            monkeypatch.setattr(
+                native, "copy_into",
+                lambda *a, **k: (copy_calls.append(a),
+                                 orig_copy(*a, **k))[1])
+            recv_targets = []
+            orig_recv = data_channel.recv_exact_into
+
+            async def tracing_recv(sock, buf, off, n, waiter_box=None):
+                # snapshot type + size NOW (the mapping is released
+                # when the pull closes the segment owner)
+                recv_targets.append(
+                    (type(buf), getattr(buf, "nbytes", len(buf)), n))
+                return await orig_recv(sock, buf, off, n, waiter_box)
+
+            monkeypatch.setattr(data_channel, "recv_exact_into",
+                                tracing_recv)
+            data_channel.reset_stats()
+
+            reply = await r1._ensure_local(oid, owner_addr)
+            assert reply["ok"], reply
+            _check_roundtrip(ctx, reply["segment"], arr)
+
+            assert not copy_calls, \
+                "copy_into ran on the striped chunk hot path " \
+                "(an intermediate buffer materialized)"
+            payload_recvs = [(t, size, n) for t, size, n in recv_targets
+                             if n > 4096]
+            assert payload_recvs, "no chunk payload receives traced"
+            for t, size, n in payload_recvs:
+                assert t is memoryview, \
+                    f"chunk payload received into {t}, not the " \
+                    "destination mapping"
+                assert size >= arr.nbytes
+            assert data_channel.pull_stats["chunks"] > 0
+            assert data_channel.pull_stats["intermediate_copies"] == 0
+            assert data_channel.serve_stats["chunks"] == \
+                data_channel.pull_stats["chunks"]
+            # admission + lease discipline closed out
+            assert r1._pull_inflight_bytes == 0
+            assert not r1.store._lent
+            # observability: the data_plane block reaches GetNodeStats
+            stats = await r1.handle_get_node_stats(None, {}, [])
+            assert stats["data_plane"]["pull"]["chunks"] > 0
+            assert stats["data_plane"]["data_address"]
+        finally:
+            await _teardown(gcs, [r0, r1], owners=[owner])
+
+    asyncio.run(run())
+
+
+def test_legacy_fallback_when_data_plane_disabled(tmp_path):
+    """data_plane_stripes=0 keeps the pre-data-plane behavior: chunked
+    FetchObjectChunk RPCs on the control connection (one intermediate
+    bytes copy per chunk, counted honestly) — same bytes delivered."""
+
+    async def run():
+        gcs, (r0, r1) = await _boot(2, tmp_path, data_plane_stripes=0)
+        assert r0.data_address == "" and r1.data_address == ""
+        owner, _ = _owner_server(lambda n: [r0.node_id.binary()])
+        owner_addr = await owner.listen("tcp://127.0.0.1:0")
+        try:
+            arr = np.random.default_rng(1).integers(
+                0, 255, 1_500_001, dtype=np.uint8)
+            oid, ctx = _seal(r0, arr)
+            data_channel.reset_stats()
+            reply = await r1._ensure_local(oid, owner_addr)
+            assert reply["ok"], reply
+            _check_roundtrip(ctx, reply["segment"], arr)
+            assert data_channel.pull_stats["chunks"] > 0
+            assert data_channel.pull_stats["intermediate_copies"] == \
+                data_channel.pull_stats["chunks"]
+            assert r1._pull_inflight_bytes == 0
+        finally:
+            await _teardown(gcs, [r0, r1], owners=[owner])
+
+    asyncio.run(run())
+
+
+def test_pull_fans_out_across_replica_peers(tmp_path):
+    """With two replica-holding peers, chunk offsets fan out across
+    BOTH peers' stripe sets — each serves a share of one pull."""
+
+    async def run():
+        gcs, (r0, r1, r2) = await _boot(3, tmp_path)
+        oid = ObjectID.from_random()
+        arr = np.random.default_rng(2).integers(
+            0, 255, 8_000_000, dtype=np.uint8)
+        _, ctx = _seal(r0, arr, oid)
+        _seal(r1, arr, oid)
+        owner, _ = _owner_server(
+            lambda n: [r0.node_id.binary(), r1.node_id.binary()])
+        owner_addr = await owner.listen("tcp://127.0.0.1:0")
+        try:
+            reply = await r2._ensure_local(oid, owner_addr)
+            assert reply["ok"], reply
+            _check_roundtrip(ctx, reply["segment"], arr)
+            assert r0.data_server.num_chunks_served > 0, \
+                "first replica holder served nothing"
+            assert r1.data_server.num_chunks_served > 0, \
+                "second replica holder served nothing"
+        finally:
+            await _teardown(gcs, [r0, r1, r2], owners=[owner])
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+
+def test_admission_oversized_object_waits_for_idle(tmp_path):
+    """HONEST BUDGET: an object larger than the whole in-flight budget
+    is admitted exactly when nothing else is in flight — it neither
+    deadlocks (waiting for room that can never exist) nor stampedes in
+    alongside admitted pulls. Waiters park on the Condition and wake on
+    pull completion, not on a sleep-poll."""
+
+    async def run():
+        cfg = RayTpuConfig.create(BASE_CFG)
+        r = Raylet(cfg, 1, session_dir=str(tmp_path))
+        r.store.capacity = 1 << 20  # budget = max(256 KiB, chunk)
+        chunk = 64 * 1024
+        oversized = 5 << 20  # 5 MiB >> budget
+
+        # idle store: the oversized pull is admitted immediately
+        await asyncio.wait_for(r._admit_pull(oversized, chunk), 1.0)
+        assert r._pull_inflight_bytes == oversized
+
+        # anything else — even a tiny pull — now waits for completion
+        waiter = asyncio.ensure_future(r._admit_pull(1024, chunk))
+        await asyncio.sleep(0.05)
+        assert not waiter.done(), \
+            "second pull admitted alongside an oversized one"
+
+        # pull completion (the finally of _pull_chunked): decrement,
+        # then notify the Condition
+        r._pull_inflight_bytes -= oversized
+        r._notify_pull_done()
+        await asyncio.wait_for(waiter, 1.0)
+        assert r._pull_inflight_bytes == 1024
+
+        # small pulls that FIT the budget are admitted concurrently
+        await asyncio.wait_for(r._admit_pull(2048, chunk), 1.0)
+        assert r._pull_inflight_bytes == 1024 + 2048
+        r.store.shutdown()
+
+    asyncio.run(run())
+
+
+def test_adaptive_chunk_floor_and_cap(tmp_path):
+    """object_manager_chunk_size stays the floor; large objects scale
+    the chunk up, capped at data_plane_max_chunk_size; the data plane
+    off (stripes=0) keeps the exact legacy chunk."""
+    cfg = RayTpuConfig.create({**BASE_CFG,
+                               "data_plane_stripes": 4,
+                               "data_plane_max_chunk_size": 8 << 20})
+    r = Raylet(cfg, 1, session_dir=str(tmp_path))
+    floor = cfg.object_manager_chunk_size
+    assert r._pull_chunk_size(10_000, 1) == floor
+    assert r._pull_chunk_size(floor * 8, 1) == floor
+    big = r._pull_chunk_size(1 << 30, 1)
+    assert floor < big <= 8 << 20
+    assert r._pull_chunk_size(1 << 40, 1) == 8 << 20  # capped
+    # more peers -> more lanes -> smaller per-chunk target
+    assert r._pull_chunk_size(1 << 30, 4) <= big
+    cfg0 = RayTpuConfig.create({**BASE_CFG, "data_plane_stripes": 0})
+    r0 = Raylet(cfg0, 1, session_dir=str(tmp_path))
+    assert r0._pull_chunk_size(1 << 40, 1) == floor
+    r.store.shutdown()
+    r0.store.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# failure handling
+# ---------------------------------------------------------------------------
+
+
+def test_pull_retry_refreshes_locations(tmp_path):
+    """When the first location set yields nothing, the raylet re-asks
+    the owner once after a short backoff — a replica that appeared
+    mid-pull is found instead of erroring the get."""
+
+    async def run():
+        gcs, (r0, r1) = await _boot(2, tmp_path)
+        arr = np.arange(300_000, dtype=np.float64)
+        oid, ctx = _seal(r0, arr)
+        # first query: no locations yet; refresh: the real replica
+        owner, calls = _owner_server(
+            lambda n: [] if n == 1 else [r0.node_id.binary()])
+        owner_addr = await owner.listen("tcp://127.0.0.1:0")
+        try:
+            reply = await r1._ensure_local(oid, owner_addr)
+            assert reply["ok"], reply
+            _check_roundtrip(ctx, reply["segment"], arr)
+            assert calls["n"] == 2, \
+                f"expected exactly one location refresh, saw {calls['n']}"
+        finally:
+            await _teardown(gcs, [r0, r1], owners=[owner])
+
+    asyncio.run(run())
+
+
+def test_mid_pull_peer_death_falls_through_to_replica(tmp_path):
+    """Killing one serving peer mid-pull: its stripes hand their chunks
+    to the surviving replica's stripes and the pull completes."""
+
+    async def run():
+        gcs, (r0, r1, r2) = await _boot(3, tmp_path)
+        oid = ObjectID.from_random()
+        arr = np.random.default_rng(3).integers(
+            0, 255, 8_000_000, dtype=np.uint8)
+        _, ctx = _seal(r0, arr, oid)
+        _seal(r1, arr, oid)
+        served = {"n": 0}
+
+        def dying_serve(oid_b, offset, length):
+            served["n"] += 1
+            if served["n"] > 2:  # r0 dies after serving 2 chunks
+                raise ConnectionResetError("injected mid-pull death")
+
+        r0.data_server.on_serve = dying_serve
+        owner, _ = _owner_server(
+            lambda n: [r0.node_id.binary(), r1.node_id.binary()])
+        owner_addr = await owner.listen("tcp://127.0.0.1:0")
+        try:
+            reply = await r2._ensure_local(oid, owner_addr)
+            assert reply["ok"], reply
+            _check_roundtrip(ctx, reply["segment"], arr)
+            assert r1.data_server.num_chunks_served > 0
+            assert r2._pull_inflight_bytes == 0
+            assert not r2.store._lent
+        finally:
+            await _teardown(gcs, [r0, r1, r2], owners=[owner])
+
+    asyncio.run(run())
+
+
+def test_mid_pull_total_death_fails_cleanly_releases_lease(tmp_path):
+    """Killing the ONLY serving raylet mid-pull fails the pull cleanly:
+    the leased destination segment is released (store._lent drains),
+    the segment file is unlinked, and _pull_inflight_bytes returns to
+    zero — after the one location-refresh retry."""
+
+    async def run():
+        gcs, (r0, r1) = await _boot(2, tmp_path)
+        arr = np.random.default_rng(4).integers(
+            0, 255, 4_000_000, dtype=np.uint8)
+        oid, ctx = _seal(r0, arr)
+        # Park a warm recycled segment in the PULLER's store big enough
+        # for the pull, so the failed pull exercises lease release (not
+        # just the fresh-segment path).
+        park_oid, _ = _seal(r1, arr)
+        r1.store.free(park_oid)  # unexposed -> recycle pool
+        assert r1.store._recycle, "expected a parked warm segment"
+        parked = set(r1.store._recycle)
+
+        served = {"n": 0}
+
+        def dying_serve(oid_b, offset, length):
+            served["n"] += 1
+            if served["n"] > 2:
+                # data stripes die AND the control server goes with
+                # them: the refresh round finds the peer unreachable
+                asyncio.get_running_loop().create_task(
+                    r0._server.close())
+                raise ConnectionResetError("injected total death")
+
+        r0.data_server.on_serve = dying_serve
+        owner, calls = _owner_server(lambda n: [r0.node_id.binary()])
+        owner_addr = await owner.listen("tcp://127.0.0.1:0")
+        try:
+            reply = await r1._ensure_local(oid, owner_addr)
+            assert not reply["ok"]
+            assert reply["reason"] == "object not found at any location"
+            assert calls["n"] == 2, "location refresh retry missing"
+            assert r1._pull_inflight_bytes == 0
+            assert not r1.store._lent, \
+                "failed pull left its segment lease parked"
+            # the leased segment was unlinked, not leaked
+            for name in parked:
+                assert name not in r1.store._recycle
+                assert not os.path.exists(f"/dev/shm/{name}")
+        finally:
+            await _teardown(gcs, [r0, r1], owners=[owner])
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# run_striped engine (unit)
+# ---------------------------------------------------------------------------
+
+
+def test_run_striped_failure_hands_chunks_to_survivors():
+    """A failing stripe returns its in-flight chunk to the queue; the
+    surviving stripe drains everything exactly once."""
+
+    async def run():
+        offsets = deque(range(6))
+        done = []
+
+        async def good(off):
+            await asyncio.sleep(0)
+            done.append(off)
+
+        async def bad(off):
+            raise ConnectionError("stripe died")
+
+        await data_channel.run_striped(offsets, [bad, good])
+        assert sorted(done) == list(range(6))
+        assert len(done) == 6, "a chunk was fetched twice"
+
+    asyncio.run(run())
+
+
+def test_run_striped_last_stripe_death_raises():
+    async def run():
+        async def bad(off):
+            raise ConnectionError("stripe died")
+
+        with pytest.raises(ConnectionError):
+            await data_channel.run_striped(deque([0, 1, 2]), [bad, bad])
+        with pytest.raises(ConnectionError):
+            await data_channel.run_striped(deque([0]), [])
+
+    asyncio.run(run())
+
+
+def test_run_striped_retries_handed_back_chunk_on_survivors():
+    """A chunk handed back AFTER the surviving worker already drained
+    out and exited must be re-run on the survivor (follow-up round) —
+    one lost tail chunk must not void the transfer."""
+
+    async def run():
+        offsets = deque([0, 1])
+        calls = []
+        a_done = asyncio.Event()
+
+        async def lane_a(off):
+            calls.append(("a", off))
+            a_done.set()
+
+        async def lane_b(off):
+            # hold the last chunk until A has drained out, then die
+            await a_done.wait()
+            raise ConnectionError("peer died holding the tail chunk")
+
+        await data_channel.run_striped(offsets, [lane_a, lane_b])
+        assert calls == [("a", 0), ("a", 1)], calls
+        assert not offsets
+
+    asyncio.run(run())
+
+
+def test_fetch_chunk_rejects_short_payload(tmp_path):
+    """A serve shorter than requested (replica whose sealed size
+    diverged) must fail the chunk loudly — accepting it would seal
+    stale segment bytes as valid object data."""
+
+    class _FakeStore:
+        def __init__(self, name, total):
+            self._name, self._total = name, total
+
+        def entry(self, oid):
+            return (self._name, self._total)
+
+        def mark_exposed(self, oid):
+            pass
+
+    async def run():
+        ctx = SerializationContext()
+        arr = np.arange(100_000, dtype=np.uint8)
+        name, size = write_segment(ctx.serialize(arr))
+        server = data_channel.DataPlaneServer(_FakeStore(name, size))
+        addr = await server.start()
+        ch = await data_channel.DataChannelClient(addr, 1).connect()
+        try:
+            dst = bytearray(size + 512)
+            # exact-length request serves fine
+            got = await ch.fetch_chunk(ch.stripes[0], b"x" * 28,
+                                       0, size, dst, 0)
+            assert got == size
+            with open(f"/dev/shm/{name}", "rb") as f:
+                assert bytes(dst[:size]) == f.read()
+            # a request past the replica's sealed size comes back short
+            # -> ConnectionError, never silent truncation
+            with pytest.raises(ConnectionError, match="short chunk"):
+                await ch.fetch_chunk(ch.stripes[0], b"x" * 28,
+                                     0, size + 64, dst, 0)
+        finally:
+            await ch.close()
+            await server.close()
+            from ray_tpu._private.shm_store import ShmStoreServer
+            ShmStoreServer._unlink(name)
+
+    asyncio.run(run())
+
+
+def test_mixed_fleet_legacy_lane_keeps_control_chunk_floor(tmp_path):
+    """A striped puller pulling from a peer WITHOUT a data channel
+    (data_plane_stripes=0 there) must keep control-plane frames at
+    object_manager_chunk_size — the adaptive chunk must never flood
+    the shared RPC stream that carries heartbeats and lease grants."""
+
+    async def run():
+        cfg_legacy = RayTpuConfig.create({**BASE_CFG,
+                                          "data_plane_stripes": 0})
+        cfg_striped = RayTpuConfig.create(BASE_CFG)
+        gcs = GcsServer(cfg_striped)
+        gcs_addr = await gcs.start("tcp://127.0.0.1:0")
+        r0 = Raylet(cfg_legacy, 1, session_dir=str(tmp_path))
+        await r0.start(gcs_addr)
+        r1 = Raylet(cfg_striped, 1, session_dir=str(tmp_path))
+        await r1.start(gcs_addr)
+        assert r0.data_address == "" and r1.data_address != ""
+        owner, _ = _owner_server(lambda n: [r0.node_id.binary()])
+        owner_addr = await owner.listen("tcp://127.0.0.1:0")
+        try:
+            # big enough that the striped puller's adaptive chunk would
+            # exceed the floor if it leaked onto the control lane
+            arr = np.random.default_rng(6).integers(
+                0, 255, 24_000_000, dtype=np.uint8)
+            oid, ctx = _seal(r0, arr)
+            assert r1._pull_chunk_size(arr.nbytes, 1) > \
+                cfg_striped.object_manager_chunk_size
+
+            seen = []
+            orig = r0.handle_fetch_object_chunk
+
+            async def spy(conn, header, bufs):
+                seen.append(header["length"])
+                return await orig(conn, header, bufs)
+
+            r0._server.handlers["FetchObjectChunk"] = spy
+            reply = await r1._ensure_local(oid, owner_addr)
+            assert reply["ok"], reply
+            _check_roundtrip(ctx, reply["segment"], arr)
+            assert seen, "pull did not use the control-plane fallback"
+            assert max(seen) <= cfg_striped.object_manager_chunk_size, \
+                f"control-plane frame inflated to {max(seen)} bytes"
+        finally:
+            await _teardown(gcs, [r0, r1], owners=[owner])
+
+    asyncio.run(run())
+
+
+def test_client_close_wakes_parked_receive():
+    """Closing a data channel locally must WAKE a fetch parked in
+    _wait_readable: closing an fd silently removes it from the loop's
+    selector, so an unwoken reader would park the pull forever (and
+    pin its admission budget)."""
+    import socket as socket_mod
+
+    async def run():
+        a, b = socket_mod.socketpair()
+        b.setblocking(False)
+        ch = data_channel.DataChannelClient("127.0.0.1:1", 1)
+        stripe = data_channel._Stripe(b)
+        ch.stripes = [stripe]
+        dst = bytearray(16)
+        task = asyncio.ensure_future(
+            data_channel.recv_exact_into(b, dst, 0, 16, stripe))
+        await asyncio.sleep(0.05)  # let it park on readability
+        assert not task.done()
+        await ch.close()
+        with pytest.raises(ConnectionError):
+            await asyncio.wait_for(task, 1.0)
+        a.close()
+
+    asyncio.run(run())
+
+
+def test_run_striped_cancel_cancels_inflight_siblings():
+    """Pin of the cancel-siblings-before-close discipline: cancelling
+    the pull cancels AND awaits every in-flight stripe worker before
+    run_striped unwinds — only then may the caller close the
+    destination mapping — and the in-flight chunk goes back to the
+    queue."""
+
+    async def run():
+        offsets = deque([7])
+        started = asyncio.Event()
+        observed = []
+
+        async def hang(off):
+            started.set()
+            try:
+                await asyncio.Event().wait()
+            except asyncio.CancelledError:
+                observed.append(("cancelled", off))
+                raise
+
+        task = asyncio.ensure_future(
+            data_channel.run_striped(offsets, [hang]))
+        await started.wait()
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+        # the worker saw its cancellation BEFORE run_striped returned
+        assert observed == [("cancelled", 7)]
+        assert list(offsets) == [7], "in-flight chunk not handed back"
+
+    asyncio.run(run())

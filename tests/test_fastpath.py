@@ -201,6 +201,93 @@ def test_copy_into_threaded_stripes():
     assert bytes(dst) == src.tobytes()
 
 
+def test_recv_into_bounds_offsets_eagain_eof():
+    """The GIL-releasing recv(2) entry of the striped data plane
+    (ASAN hits this via ci/sanitize.sh): payloads land at unaligned
+    offsets in the destination, EAGAIN on a dry non-blocking socket
+    reports -1 (never raises), orderly EOF reports 0, and out-of-bounds
+    offset/length pairs are rejected before any write."""
+    mod = _require_native()
+    import socket
+    import time
+
+    a, b = socket.socketpair()
+    try:
+        payload = bytes(range(256)) * 3  # 768 B
+        a.sendall(payload)
+        dst = bytearray(2048)
+        got = 0
+        while got < len(payload):  # short reads are legal
+            n = mod.recv_into(b.fileno(), dst, 7 + got, len(payload) - got)
+            assert n > 0
+            got += n
+        assert bytes(dst[7:7 + len(payload)]) == payload
+        assert dst[:7] == b"\0" * 7
+        # dry non-blocking socket: -1 (EAGAIN), no exception, no write
+        b.setblocking(False)
+        assert mod.recv_into(b.fileno(), dst, 0, 16) == -1
+        # zero-length receive is a no-op
+        assert mod.recv_into(b.fileno(), dst, 0, 0) == 0
+        # bounds rejected before the GIL drops
+        for off, ln in [(2040, 16), (-1, 4), (0, -4), (0, 1 << 40)]:
+            with pytest.raises(ValueError):
+                mod.recv_into(b.fileno(), dst, off, ln)
+        # readonly destinations are refused
+        with pytest.raises((TypeError, BufferError)):
+            mod.recv_into(b.fileno(), b"frozen", 0, 1)
+        # orderly peer shutdown: 0 = EOF
+        a.close()
+        deadline = time.time() + 2
+        while time.time() < deadline:
+            n = mod.recv_into(b.fileno(), dst, 0, 16)
+            if n != -1:
+                break
+            time.sleep(0.01)
+        assert n == 0
+        # a closed fd raises a real OSError (not -1)
+        with pytest.raises(OSError):
+            mod.recv_into(-1, dst, 0, 4)
+    finally:
+        b.close()
+
+
+def test_sock_recv_into_fallback_parity():
+    """native.sock_recv_into: the pure-Python socket.recv_into fallback
+    behaves identically to the native tier — same destination bytes,
+    same -1-on-EAGAIN contract — so a process without the native module
+    still runs the single-copy receive path."""
+    import socket
+    import time
+
+    from ray_tpu._private import native
+
+    for mask_native in (False, True):
+        a, b = socket.socketpair()
+        saved = native._mod, native._tried
+        if mask_native:
+            native._mod, native._tried = None, True
+        else:
+            native.load_fastpath()
+        try:
+            b.setblocking(False)
+            dst = bytearray(64)
+            assert native.sock_recv_into(b, dst, 0, 16) == -1  # dry
+            a.sendall(b"0123456789")
+            got = 0
+            deadline = time.time() + 2
+            while got < 10 and time.time() < deadline:
+                n = native.sock_recv_into(b, dst, 5 + got, 10 - got)
+                if n == -1:
+                    time.sleep(0.01)
+                    continue
+                got += n
+            assert bytes(dst[5:15]) == b"0123456789"
+        finally:
+            native._mod, native._tried = saved
+            a.close()
+            b.close()
+
+
 def test_copy_engine_chunking_and_fallback():
     """native.copy_into: the chunked (striped) path with a tiny stripe
     size is bit-exact, and the pure-Python fallback produces identical
