@@ -138,6 +138,32 @@ class RayTpuConfig:
     # --- observability ---
     event_log_enabled: bool = True
     metrics_report_period_ms: int = 2000
+    # Task-lifecycle event recording (task_events.py): every task gets
+    # a recorded state machine (SUBMITTED -> PENDING_LEASE ->
+    # DISPATCHED -> RUNNING -> FINISHED|FAILED plus retry/spillback
+    # annotations) surfaced by ray_tpu.state.list_tasks()/timeline().
+    # ON by default — the history must exist when the straggler
+    # happens; bench.py's task_events_overhead row pins the submit-path
+    # cost under 5%.
+    task_events_enabled: bool = True
+    # Per-process event buffer capacity (events, not bytes). When full,
+    # NEW transitions are dropped and counted (TaskEventBuffer.dropped
+    # -> GCS dropped_events) — memory stays flat, the hot path never
+    # blocks on observability. Also bounds the per-flush wire batch
+    # (the whole buffer ships each reporting period): 16384 events ~=
+    # 1.5 MB worst case.
+    task_events_buffer_size: int = 16384
+    # GCS task-table cap per job: oldest-seen tasks are evicted first
+    # and the eviction is COUNTED per job (GetTaskSummary
+    # evicted_tasks), so a truncated view always reports as truncated.
+    task_events_max_tasks_per_job: int = 8192
+    # Cluster-KV span cap for util/tracing.py exports: beyond this many
+    # stored spans the GCS evicts the OLDEST whole trace (and counts
+    # the drop in the __rtpu_trace_dropped__ KV key /
+    # tracing.dropped_span_count()) so long-running clusters with
+    # RAY_TPU_TRACE=1 don't leak the KV and its journal. 0 = unbounded
+    # (the pre-cap behavior).
+    tracing_max_spans: int = 100_000
     # Prometheus text endpoint on the GCS host (0 = auto-assign; the
     # bound address lands in the KV key __rtpu_metrics_address__).
     metrics_export_port: int = 0

@@ -45,6 +45,9 @@ from ray_tpu._private.serialization import (
 from ray_tpu._private.shm_store import (
     RECYCLE_MIN_BYTES, AttachedObject, plan_segment, write_segment,
 )
+from ray_tpu._private.task_events import (
+    DISPATCHED, FAILED, PENDING_ARGS, RETRY, SUBMITTED, TaskEventBuffer,
+)
 from ray_tpu._private.task_spec import (
     ARG_REF, ARG_VALUE, REPLY_ACTOR_RESTARTING, REPLY_ERROR, REPLY_STOLEN,
     TASK_ACTOR, TASK_ACTOR_CREATION, TASK_NORMAL, TaskArg, TaskSpec,
@@ -238,6 +241,13 @@ class CoreWorker:
         self._node_table_ts = -1e9
         self._shutdown = False
         self.task_executor = None   # set in worker mode by worker_main
+        # Task-lifecycle recorder (task_events.py): owner-side
+        # transitions land here and flush with the metrics report loop.
+        # The executor (worker mode) records RUNNING/FINISHED/FAILED
+        # into the same buffer.
+        self.task_events = TaskEventBuffer(
+            config.task_events_buffer_size,
+            enabled=config.task_events_enabled)
         self._task_events: List[dict] = []
         self._profile_flush_task = None
         self._metrics_report_task = None
@@ -313,6 +323,13 @@ class CoreWorker:
             self._profile_flush_task.cancel()
         if getattr(self, "_metrics_report_task", None):
             self._metrics_report_task.cancel()
+        if self.gcs_conn and not self.gcs_conn.closed:
+            # last task-event flush: terminal transitions observed since
+            # the previous periodic flush should outlive this process
+            try:
+                await asyncio.wait_for(self._flush_task_events(), timeout=2)
+            except (asyncio.TimeoutError, ConnectionError):
+                pass
         if self.mode == "driver" and self.gcs_conn and not self.gcs_conn.closed:
             try:
                 await self.gcs_conn.call("MarkJobFinished",
@@ -809,6 +826,9 @@ class CoreWorker:
         if entry.recovery_waiter is None:
             entry.recovery_waiter = self.loop.create_future()
             self.stats["tasks_retried"] += 1
+            if self.task_events.enabled:
+                self.task_events.record(entry.spec.task_id, RETRY,
+                                        {"reason": "lineage reconstruction"})
             self._queue_spec(entry.spec)
         waiter = entry.recovery_waiter
         try:
@@ -1011,6 +1031,10 @@ class CoreWorker:
             if ctx is None and not self._fast_ctx_failed:
                 ctx = self._make_fast_ctx()
             if ctx is not None:
+                # SUBMITTED is recorded loop-side by
+                # _drain_submit_buffer (the C path enqueues the cloned
+                # spec there like every other submission): the caller
+                # thread pays nothing for recording.
                 return ctx.submit(proto, prefix, _trace_ctx())
             prepared_args, arg_holds = (), None
         elif args:
@@ -1085,6 +1109,7 @@ class CoreWorker:
                 entry.dep_ids)
         del arg_holds  # promoted args now pinned by submitted-ref counts
         self.stats["tasks_submitted"] += 1
+        # SUBMITTED recorded loop-side by _drain_submit_buffer
         self._enqueue_submit("task", spec)
         return refs
 
@@ -1138,6 +1163,18 @@ class CoreWorker:
                 items.append(buf.popleft())
             except IndexError:
                 break
+        ev = self.task_events
+        if ev.enabled and items:
+            # SUBMITTED stamps for the whole burst, grouped by task
+            # name (one record_many per distinct template): the caller
+            # thread pays nothing, the loop pays one bulk append per
+            # burst instead of one record() per task.
+            by_name: Dict[str, list] = {}
+            for _kind, spec in items:
+                by_name.setdefault(spec.name, []).append(spec.task_id)
+            now = time.time()
+            for tname, tids in by_name.items():
+                ev.record_many(tids, SUBMITTED, tname, ts=now)
         touched_keys: Dict[int, SchedulingKeyState] = {}
         touched_actors: Dict[bytes, ActorQueueState] = {}
         for kind, spec in items:
@@ -1146,6 +1183,8 @@ class CoreWorker:
                 # the dependency_ids() call entirely
                 if spec.args and spec.dependency_ids():
                     # Owned args may be pending: resolve asynchronously.
+                    if self.task_events.enabled:
+                        self.task_events.record(spec.task_id, PENDING_ARGS)
                     self.loop.create_task(self._submit_when_ready(spec))
                     continue
                 sc = spec._sched  # interned at template creation
@@ -1482,6 +1521,10 @@ class CoreWorker:
         and attach completion handling to the reply future — no per-task
         coroutine, no per-task syscall. Static spec fields ride once per
         distinct prototype (TaskSpec.tail_wire), not once per task."""
+        ev = self.task_events
+        if ev.enabled:
+            ev.record_many([spec.task_id for spec in batch], DISPATCHED,
+                           {"worker": lw.worker_id.hex()[:12]})
         ctx = self._fast_ctx
         if ctx is not None:
             tails, theaders, frames = ctx.build_push(batch)
@@ -1534,6 +1577,9 @@ class CoreWorker:
             if entry.num_retries_left > 0:
                 entry.num_retries_left -= 1
             self.stats["tasks_retried"] += 1
+            if self.task_events.enabled:
+                self.task_events.record(spec.task_id, RETRY,
+                                        {"reason": "worker died"})
             logger.info("retrying task %s after worker death", spec.name)
             self._queue_spec(spec)
         else:
@@ -1660,6 +1706,9 @@ class CoreWorker:
             if entry.num_retries_left > 0:
                 entry.num_retries_left -= 1
             self.stats["tasks_retried"] += 1
+            if self.task_events.enabled:
+                self.task_events.record(spec.task_id, RETRY,
+                                        {"reason": "application error"})
             self._queue_spec(spec)
             return
         for ret in reply[1]:
@@ -1724,6 +1773,13 @@ class CoreWorker:
             entry.lineage_pinned = True
 
     def _store_error_for_task(self, spec: TaskSpec, error: BaseException):
+        if self.task_events.enabled:
+            # owner-observed failures (worker death, cancellation,
+            # infeasibility, dead actor): the worker never ran the task,
+            # so the terminal FAILED is stamped here
+            self.task_events.record(spec.task_id, FAILED, {
+                "reason": type(error).__name__,
+                "message": str(error)[:200]})
         serialized = self.serialization_context.serialize_error(error)
         task_id = TaskID(spec.task_id)
         for i in range(spec.num_returns):
@@ -1837,6 +1893,7 @@ class CoreWorker:
             if ctx is None and not self._fast_ctx_failed:
                 ctx = self._make_fast_ctx()
             if ctx is not None:
+                # SUBMITTED recorded loop-side (_drain_submit_buffer)
                 return ctx.submit(proto, actor_id, _trace_ctx(), True)
         spec = proto.clone_for(make_task_id_bytes(actor_id), (),
                                trace_ctx=_trace_ctx())
@@ -1860,6 +1917,7 @@ class CoreWorker:
                 entry.dep_ids)
         del arg_holds
         self.stats["actor_tasks_submitted"] += 1
+        # SUBMITTED recorded loop-side by _drain_submit_buffer
         # Seqno assignment happens at drain time in buffer order, which is
         # submission order (the receiver executes strictly by seqno). By-ref
         # args resolve at the executing worker — the owner's GetObject blocks
@@ -1888,9 +1946,13 @@ class CoreWorker:
         theaders: List[list] = []
         frames: List[bytes] = []
         batch: List[Tuple[TaskSpec, int]] = []
+        ev = self.task_events
+        ev_attrs = {"actor": q.actor_id.hex()[:12]} if ev.enabled else None
         while q.buffer:
             spec, seqno = q.buffer.popleft()
             q.inflight[seqno] = (spec, 0)
+            if ev_attrs is not None:
+                ev.record(spec.task_id, DISPATCHED, ev_attrs)
             tw, tfr = spec.to_wire()
             theaders.append([tw, seqno, len(frames), len(tfr)])
             frames.extend(tfr)
@@ -1985,6 +2047,10 @@ class CoreWorker:
                 if entry and entry.num_retries_left > 0:
                     entry.num_retries_left -= 1
                 self.stats["tasks_retried"] += 1
+                if self.task_events.enabled:
+                    self.task_events.record(
+                        spec.task_id, RETRY,
+                        {"reason": "actor connection lost"})
                 requeue.append((spec, seqno))
             else:
                 self._store_error_for_task(spec, exc.ActorDiedError(
@@ -2070,23 +2136,44 @@ class CoreWorker:
             "actor_id": actor_id, "no_restart": no_restart}))
 
     async def _metrics_report_loop(self):
-        """Ship this process's user-metric registry to the GCS on a
-        timer (reference: per-process OpenCensus exporter → metrics
-        agent, stats/metric.h + metrics_agent.py)."""
+        """Ship this process's user-metric registry AND buffered
+        task-lifecycle events to the GCS on a timer (reference:
+        per-process OpenCensus exporter → metrics agent,
+        stats/metric.h + metrics_agent.py; TaskEventBuffer's periodic
+        GCS flush, task_event_buffer.h). Task events ride this existing
+        cadence — never a per-transition RPC."""
         from ray_tpu._private import metrics as metrics_mod
 
         period = self.config.metrics_report_period_ms / 1000.0
         reporter = f"{self.mode}-{WorkerID(self.worker_id).hex()[:12]}"
+        # This CoreWorker ships the process-global registry; an
+        # in-process raylet (head node) must not ship it again.
+        metrics_mod.mark_core_reporter()
         while not self._shutdown:
             await asyncio.sleep(period)
             snap = metrics_mod.global_registry().snapshot()
-            if not snap:
-                continue
-            try:
-                await self._gcs_call("ReportMetrics", {
-                    "reporter_id": reporter, "snapshot": snap})
-            except (ConnectionError, asyncio.TimeoutError):
-                pass  # GCS restarting; next period retries
+            if snap:
+                try:
+                    await self._gcs_call("ReportMetrics", {
+                        "reporter_id": reporter, "snapshot": snap})
+                except (ConnectionError, asyncio.TimeoutError):
+                    pass  # GCS restarting; next period retries
+            await self._flush_task_events()
+
+    async def _flush_task_events(self):
+        """Drain the task-event buffer to the GCS task table (the
+        batch is bounded by the buffer capacity; a flush lost to a
+        restarting GCS is bounded event loss, by design —
+        observability never blocks or retries forever)."""
+        events, dropped = self.task_events.drain_wire()
+        if not events and not dropped:
+            return
+        try:
+            await self._gcs_call("AddTaskEvents", {
+                "events": events, "dropped": dropped,
+                "job_id": self.job_id})
+        except (ConnectionError, asyncio.TimeoutError):
+            pass  # GCS restarting; bounded loss
 
     async def _handle_published(self, conn, header, bufs):
         if header["channel"] == "LOGS":

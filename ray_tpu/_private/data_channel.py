@@ -71,7 +71,8 @@ STATUS_NOT_FOUND = 1
 # Python ``bytes`` before reaching the destination segment — 0 on the
 # striped plane (socket -> shm is the only copy), 1 per chunk on the
 # legacy path (recv-loop bytes + copy_into).
-pull_stats = {"chunks": 0, "bytes": 0, "intermediate_copies": 0}
+pull_stats = {"chunks": 0, "bytes": 0, "intermediate_copies": 0,
+              "stripe_failures": 0}
 serve_stats = {"chunks": 0, "bytes": 0, "sendfile": 0, "mapped": 0}
 
 
@@ -79,6 +80,70 @@ def reset_stats() -> None:
     for d in (pull_stats, serve_stats):
         for k in d:
             d[k] = 0
+
+
+# --------------------------------------------------------------------------
+# Prometheus-side view (metrics registry): the same counters GetNodeStats
+# reports, exported on the cluster /metrics endpoint so stripe failures,
+# per-tier transfer bytes and per-pull throughput are scrapeable — not
+# just bench counters. Registered lazily in whichever process runs the
+# data plane (the raylet); shipped to the GCS by that process's metric
+# reporter (CoreWorker loop in-process, heartbeat piggyback standalone).
+# --------------------------------------------------------------------------
+
+_prom = None
+_TIER_STRIPED = {"tier": "striped"}
+_TIER_CONTROL = {"tier": "control"}
+_TIER_SENDFILE = {"tier": "sendfile"}
+_TIER_MAPPED = {"tier": "mapped"}
+
+
+def _plane_metrics() -> dict:
+    global _prom
+    if _prom is None:
+        from ray_tpu._private import metrics as m
+        _prom = {
+            "bytes_pulled": m.Counter(
+                "ray_tpu_data_plane_bytes_pulled_total",
+                "Object bytes pulled cross-node, by transport tier "
+                "(striped raw sockets vs control-plane fallback)"),
+            "bytes_served": m.Counter(
+                "ray_tpu_data_plane_bytes_served_total",
+                "Object bytes served to peers, by serve tier "
+                "(sendfile vs mapped-sendall fallback)"),
+            "stripe_failures": m.Counter(
+                "ray_tpu_data_plane_stripe_failures_total",
+                "Pull stripes dropped by connection/framing failures"),
+            "intermediate_copies": m.Counter(
+                "ray_tpu_data_plane_intermediate_copies_total",
+                "Chunk payloads that materialized as intermediate "
+                "bytes before reaching the destination segment "
+                "(0 on the striped plane, 1/chunk on the fallback)"),
+            "pull_gb_per_s": m.Histogram(
+                "ray_tpu_data_plane_pull_gb_per_s",
+                "Per-pull end-to-end throughput (GB/s)",
+                boundaries=(0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 4.0,
+                            8.0, 16.0)),
+        }
+    return _prom
+
+
+def observe_pull(total_bytes: int, wall_s: float) -> None:
+    """One completed pull's throughput -> the Prometheus histogram."""
+    _plane_metrics()["pull_gb_per_s"].observe(
+        total_bytes / max(wall_s, 1e-9) / 1e9)
+
+
+def note_control_chunk(nbytes: int) -> None:
+    """Legacy control-plane chunk accounting (raylet fallback lanes):
+    the recv loop materialized the payload as bytes before copy_into —
+    exactly one intermediate copy per chunk."""
+    pull_stats["chunks"] += 1
+    pull_stats["bytes"] += nbytes
+    pull_stats["intermediate_copies"] += 1
+    m = _plane_metrics()
+    m["bytes_pulled"].inc(nbytes, _TIER_CONTROL)
+    m["intermediate_copies"].inc()
 
 
 def _wait_readable(sock: socket.socket) -> "asyncio.Future":
@@ -340,8 +405,10 @@ class DataPlaneServer:
                 # as a live view of the attachment — never flattened
                 await loop.sock_sendall(sock, src.obj.buf[offset:end])
                 serve_stats["mapped"] += 1
+                _plane_metrics()["bytes_served"].inc(count, _TIER_MAPPED)
             else:
                 serve_stats["sendfile"] += 1
+                _plane_metrics()["bytes_served"].inc(count, _TIER_SENDFILE)
         finally:
             src.pins -= 1
             src.close_if_free()
@@ -597,9 +664,12 @@ class DataChannelClient:
                 raise
         pull_stats["chunks"] += 1
         pull_stats["bytes"] += payload_len
+        _plane_metrics()["bytes_pulled"].inc(payload_len, _TIER_STRIPED)
         return payload_len
 
     def _drop_stripe(self, stripe: _Stripe) -> None:
+        pull_stats["stripe_failures"] += 1
+        _plane_metrics()["stripe_failures"].inc()
         try:
             stripe.sock.close()
         except OSError:

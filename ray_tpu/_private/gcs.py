@@ -24,6 +24,14 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 from ray_tpu._private import rpc
 from ray_tpu._private.config import RayTpuConfig
 from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID
+from ray_tpu._private.task_events import TaskEventTable
+
+# Exported tracing spans live under this KV prefix (util/tracing.py);
+# the GCS caps their count (config.tracing_max_spans) with oldest-trace
+# eviction so RAY_TPU_TRACE=1 on a long-running cluster cannot leak the
+# KV and its journal.
+TRACE_KV_PREFIX = b"__traces__/"
+TRACE_DROPPED_KEY = b"__rtpu_trace_dropped__"
 
 logger = logging.getLogger(__name__)
 
@@ -57,6 +65,7 @@ _STATUS_PAGE = b"""<!doctype html>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Object stores / hosts</h2><table id="stores"></table>
 <h2>Actors</h2><table id="actors"></table>
+<h2>Tasks</h2><table id="tasks"></table>
 <h2>Jobs</h2><table id="jobs"></table>
 <h2>Placement groups</h2><table id="pgs"></table>
 <h2>Recent events</h2><table id="events"></table>
@@ -116,6 +125,11 @@ async function tick() {
       actors.map(function(a){ return [a.actor_id.slice(0,12), a.name,
         a.class_name, a.state, a.num_restarts+'/'+a.max_restarts,
         a.node_id.slice(0,12)]; }));
+    var tk = await j('/api/tasks');
+    fill('tasks', ['task_id','name','state','attempt','transitions'],
+      tk.tasks.slice(-25).reverse().map(function(t){ return [
+        t.task_id.slice(0,12), t.name, t.state, t.attempt,
+        t.events.length]; }));
     var jobs = await j('/api/jobs');
     fill('jobs', jobs.length ? Object.keys(jobs[0]) : ['job_id'],
       jobs.map(function(x){ return Object.values(x); }));
@@ -200,6 +214,18 @@ class GcsServer:
         self._metric_snapshots: Dict[str, dict] = {}
         self._http_server = None
         self.metrics_address = ""
+        # Task-lifecycle table (task_events.py): per-task transition
+        # histories with a capped per-job index; fed by AddTaskEvents
+        # batches and heartbeat piggybacks, read by the state API,
+        # timeline export and the /api/tasks dashboard route.
+        self.task_events = TaskEventTable(
+            config.task_events_max_tasks_per_job)
+        # Tracing-span KV cap bookkeeping: trace_id -> {key: True}
+        # (insertion-ordered = first-span-seen order, the eviction
+        # order), plus honest drop accounting.
+        self._trace_keys: Dict[bytes, Dict[bytes, bool]] = {}
+        self._trace_span_count = 0
+        self.trace_spans_dropped = 0
 
     # ------------------------------------------------------------------ wiring
 
@@ -223,6 +249,7 @@ class GcsServer:
             "KVGet": self.handle_kv_get,
             "KVDel": self.handle_kv_del,
             "KVKeys": self.handle_kv_keys,
+            "KVGetPrefix": self.handle_kv_get_prefix,
             "Subscribe": self.handle_subscribe,
             "Publish": self.handle_publish,
             "CreatePlacementGroup": self.handle_create_placement_group,
@@ -233,6 +260,9 @@ class GcsServer:
             "GetClusterResources": self.handle_get_cluster_resources,
             "AddProfileEvents": self.handle_add_profile_events,
             "GetProfileEvents": self.handle_get_profile_events,
+            "AddTaskEvents": self.handle_add_task_events,
+            "GetTaskEvents": self.handle_get_task_events,
+            "GetTaskSummary": self.handle_get_task_summary,
             "AddClusterEvent": self.handle_add_cluster_event,
             "GetClusterEvents": self.handle_get_cluster_events,
             "ReportMetrics": self.handle_report_metrics,
@@ -433,6 +463,19 @@ class GcsServer:
                 "resources_total": total,
                 "resources_available": avail,
             })
+        if route == "/api/tasks":
+            try:
+                limit = int(params.get("limit", "200"))
+            except ValueError:
+                limit = 200
+            return dump({
+                "tasks": self.task_events.list(
+                    state=params.get("state"),
+                    name=params.get("name"),
+                    node=params.get("node"),
+                    limit=limit),
+                "summary": self.task_events.summary(),
+            })
         if route == "/api/metrics":
             return dump(self._merged_metrics())
         if route == "/api/events":
@@ -617,8 +660,25 @@ class GcsServer:
                     job["finished"] = True
             elif op == "kv_put":
                 self.kv[p["key"]] = p["value"]
+                if p["key"] == TRACE_DROPPED_KEY:
+                    # carry the pre-restart drop total forward (max:
+                    # replay-time evictions below may already have
+                    # advanced the in-process counter)
+                    try:
+                        self.trace_spans_dropped = max(
+                            self.trace_spans_dropped, int(p["value"]))
+                    except ValueError:
+                        pass
+                elif p["key"].startswith(TRACE_KV_PREFIX):
+                    # rebuild the span-cap index so the cap survives a
+                    # restart (replay runs before the journal reopens,
+                    # so eviction here deletes without re-journaling;
+                    # the boot-time compaction snapshots the result)
+                    self._note_trace_span(p["key"])
             elif op == "kv_del":
                 self.kv.pop(p["key"], None)
+                if p["key"].startswith(TRACE_KV_PREFIX):
+                    self._unindex_trace_key(p["key"])
             elif op == "actor_register":
                 actor = ActorEntry(
                     actor_id=p["actor_id"], spec_header=p["spec"],
@@ -708,6 +768,17 @@ class GcsServer:
             entry.resources_available = header["resources_available"]
         if "stats" in header:
             entry.stats = header["stats"]
+        # Piggybacked task-lifecycle events (lease queue/grant/spillback
+        # + data-plane transfers) — the raylet never pays a separate RPC.
+        if header.get("task_events") or header.get("task_events_dropped"):
+            self.task_events.ingest(header.get("task_events") or (),
+                                    header.get("task_events_dropped", 0))
+        # Standalone raylet processes ship their metric registry here
+        # (no CoreWorker reporter in-process; see metrics.core_reporter).
+        if header.get("metrics"):
+            self._metric_snapshots[
+                f"node-{header['node_id'].hex()[:12]}"] = (
+                time.time(), header["metrics"])
         return {"ok": True}
 
     async def handle_report_resource_usage(self, conn, header, bufs):
@@ -1023,7 +1094,49 @@ class GcsServer:
             return {"added": False}
         self.kv[key] = bufs[0] if bufs else b""
         self._journal_append("kv_put", {"key": key, "value": self.kv[key]})
+        if key.startswith(TRACE_KV_PREFIX):
+            self._note_trace_span(key)
         return {"added": True}
+
+    def _note_trace_span(self, key: bytes) -> None:
+        """Bound exported tracing spans (config.tracing_max_spans):
+        beyond the cap the OLDEST whole trace is evicted (its spans
+        deleted from the KV, kv_del journaled so a replay stays
+        bounded too) and the drop is counted — long-running clusters
+        with RAY_TPU_TRACE=1 must not leak the KV journal."""
+        trace_id = key[len(TRACE_KV_PREFIX):].split(b"/", 1)[0]
+        keys = self._trace_keys.setdefault(trace_id, {})
+        if key in keys:
+            return  # span overwrite: no growth
+        keys[key] = True
+        self._trace_span_count += 1
+        cap = self.config.tracing_max_spans
+        if cap <= 0 or self._trace_span_count <= cap:
+            return
+        dropped = 0
+        while self._trace_span_count > cap and len(self._trace_keys) > 1:
+            old_tid = next(iter(self._trace_keys))
+            if old_tid == trace_id:
+                break  # never evict the trace being written from under it
+            old_keys = self._trace_keys.pop(old_tid)
+            for k in old_keys:
+                if self.kv.pop(k, None) is not None:
+                    self._journal_append("kv_del", {"key": k})
+            self._trace_span_count -= len(old_keys)
+            dropped += len(old_keys)
+        if self._trace_span_count > cap:
+            # a single trace larger than the whole cap: drop the newest
+            # span rather than grow without bound (journaled like the
+            # eviction loop — a replay must not resurrect it)
+            del keys[key]
+            if self.kv.pop(key, None) is not None:
+                self._journal_append("kv_del", {"key": key})
+            self._trace_span_count -= 1
+            dropped += 1
+        if dropped:
+            self.trace_spans_dropped += dropped
+            self.kv[TRACE_DROPPED_KEY] = \
+                str(self.trace_spans_dropped).encode()
 
     async def handle_kv_get(self, conn, header, bufs):
         val = self.kv.get(header["key"])
@@ -1031,15 +1144,38 @@ class GcsServer:
             return {"found": False}
         return {"found": True}, [val]
 
+    def _unindex_trace_key(self, key: bytes) -> None:
+        """Keep the span-cap index consistent with deletions (explicit
+        clear_trace()/clear_all(), and journal-replayed kv_dels)."""
+        trace_id = key[len(TRACE_KV_PREFIX):].split(b"/", 1)[0]
+        keys = self._trace_keys.get(trace_id)
+        if keys is not None and keys.pop(key, None):
+            self._trace_span_count -= 1
+            if not keys:
+                del self._trace_keys[trace_id]
+
     async def handle_kv_del(self, conn, header, bufs):
-        existed = self.kv.pop(header["key"], None) is not None
+        key = header["key"]
+        existed = self.kv.pop(key, None) is not None
         if existed:
-            self._journal_append("kv_del", {"key": header["key"]})
+            self._journal_append("kv_del", {"key": key})
+            if key.startswith(TRACE_KV_PREFIX):
+                self._unindex_trace_key(key)
         return {"deleted": existed}
 
     async def handle_kv_keys(self, conn, header, bufs):
         prefix = header.get("prefix", b"")
         return {"keys": [k for k in self.kv if k.startswith(prefix)]}
+
+    async def handle_kv_get_prefix(self, conn, header, bufs):
+        """Bulk read of every key under a prefix in ONE round-trip.
+        The timeline's span fetch reads up to tracing_max_spans (100k)
+        entries — a per-key KVGet loop would storm the control plane
+        with 100k sequential RPCs exactly when an operator is
+        diagnosing a straggler."""
+        prefix = header.get("prefix", b"")
+        return {"pairs": [[k, v] for k, v in self.kv.items()
+                          if k.startswith(prefix)]}
 
     # ------------------------------------------------------- placement groups
 
@@ -1173,6 +1309,43 @@ class GcsServer:
         return {"placement_groups": list(self.placement_groups.values())}
 
     # --------------------------------------------------------------- events
+
+    async def handle_add_task_events(self, conn, header, bufs):
+        """One reporter's batch of task-lifecycle transitions (workers
+        and drivers flush on the metrics-report cadence; raylets ride
+        the heartbeat instead — see handle_heartbeat)."""
+        self.task_events.ingest(header.get("events") or (),
+                                header.get("dropped", 0),
+                                header.get("job_id") or b"")
+        return {"ok": True}
+
+    async def handle_get_task_events(self, conn, header, bufs):
+        """Filterable task-table dump for ray_tpu.state.list_tasks() /
+        timeline(): per-task ordered transition histories plus the
+        data-plane transfer records, with honest truncation counters."""
+        t = self.task_events
+        # transfer_limit <= 0 (or absent) means NO transfer records —
+        # list_tasks() doesn't want them; `[-0:]` would be the whole
+        # 10k-entry buffer, the opposite of the ask.
+        try:
+            transfer_limit = int(header.get("transfer_limit") or 0)
+        except (TypeError, ValueError):
+            transfer_limit = 0
+        return {
+            "tasks": t.list(state=header.get("state"),
+                            name=header.get("name"),
+                            node=header.get("node"),
+                            job_id=header.get("job_id"),
+                            limit=header.get("limit", 1000)),
+            "transfers": t.transfers[-transfer_limit:]
+            if transfer_limit > 0 else [],
+            "evicted_tasks": {k.hex() if isinstance(k, bytes) else str(k): v
+                              for k, v in t.evicted_tasks.items()},
+            "dropped_events": t.dropped_events,
+        }
+
+    async def handle_get_task_summary(self, conn, header, bufs):
+        return {"summary": self.task_events.summary()}
 
     async def handle_add_profile_events(self, conn, header, bufs):
         self._profile_events.extend(header["events"])

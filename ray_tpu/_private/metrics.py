@@ -21,7 +21,15 @@ _DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
 def percentile(sorted_seq, p: float):
     """Nearest-rank percentile of an ascending-sorted sequence (the one
     definition shared by the raylet latency stats and bench.py, so the
-    two rows stay comparable)."""
+    two rows stay comparable).
+
+    Raises ``ValueError`` on an empty sequence: the old negative-index
+    arithmetic either raised a bare ``IndexError`` (lists) or silently
+    returned the LAST element of whatever backing store a view aliased
+    — callers must guard (``raylet._pct_block`` returns ``{"count": 0}``
+    for empty reservoirs)."""
+    if not sorted_seq:
+        raise ValueError("percentile() of an empty sequence")
     return sorted_seq[min(len(sorted_seq) - 1, int(p * len(sorted_seq)))]
 
 
@@ -152,6 +160,25 @@ def global_registry() -> MetricRegistry:
         if _GLOBAL is None:
             _GLOBAL = MetricRegistry()
         return _GLOBAL
+
+
+# One shipper per process for the global registry: a CoreWorker's
+# metrics-report loop marks itself here; a raylet sharing the process
+# (in-process head) then skips shipping on its heartbeat — otherwise
+# the SAME counters would reach the GCS under two reporter ids and
+# merge_snapshots would double them. Standalone raylet processes
+# (worker nodes, headless heads) have no CoreWorker, stay unmarked, and
+# ship via heartbeat.
+_CORE_REPORTER = False
+
+
+def mark_core_reporter() -> None:
+    global _CORE_REPORTER
+    _CORE_REPORTER = True
+
+
+def core_reporter() -> bool:
+    return _CORE_REPORTER
 
 
 # ------------------------------------------------------------- rendering

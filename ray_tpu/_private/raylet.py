@@ -47,6 +47,9 @@ from ray_tpu._private.scheduler import (
     GRANT, INFEASIBLE, SPILL, WAIT, NodeView, PendingRequest, make_backend,
 )
 from ray_tpu._private.shm_store import ShmStoreServer
+from ray_tpu._private.task_events import (
+    LEASE_GRANTED, PENDING_LEASE, SPILLBACK, TRANSFER, TaskEventBuffer,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -188,6 +191,16 @@ class Raylet:
         # (queue_len, wall_s) per scheduler tick — the pure decision
         # cost of the kernel, free of queueing effects.
         self._tick_durations: Any = _deque(maxlen=65536)
+        # Task-lifecycle recorder (task_events.py): lease-queue / grant
+        # / spillback transitions for the sample task each lease request
+        # carries, plus TRANSFER records for data-plane pulls. Flushed
+        # piggybacked on the heartbeat — never its own RPC.
+        self.task_events = TaskEventBuffer(
+            config.task_events_buffer_size,
+            enabled=config.task_events_enabled)
+        self._nid12 = self.node_id.hex()[:12]
+        # per-pull throughput reservoir (GB/s), reported by GetNodeStats
+        self._pull_rates: Any = _deque(maxlen=4096)
 
     def _handlers(self):
         return {
@@ -396,14 +409,31 @@ class Raylet:
         return out
 
     async def _heartbeat_loop(self):
+        from ray_tpu._private import metrics as metrics_mod
+
         period = self.config.raylet_heartbeat_period_ms / 1000.0
         while not self._closing:
             try:
-                reply, _ = await self.gcs_conn.call("Heartbeat", {
+                hdr = {
                     "node_id": self.node_id.binary(),
                     "resources_available": self.resources_available,
                     "stats": self._heartbeat_stats(),
-                })
+                }
+                # Task-lifecycle events piggyback on the heartbeat
+                # (never their own RPC); a beat lost to a restarting
+                # GCS is bounded event loss, by design.
+                events, dropped = self.task_events.drain_wire()
+                if events or dropped:
+                    hdr["task_events"] = events
+                    hdr["task_events_dropped"] = dropped
+                if not metrics_mod.core_reporter():
+                    # standalone raylet process (worker node / headless
+                    # head): no CoreWorker ships this process's metric
+                    # registry, so the heartbeat carries it
+                    snap = metrics_mod.global_registry().snapshot()
+                    if snap:
+                        hdr["metrics"] = snap
+                reply, _ = await self.gcs_conn.call("Heartbeat", hdr)
                 if not reply.get("ok"):
                     # A restarted GCS does not know this node: re-register
                     # over the live connection (reference: raylets
@@ -620,7 +650,13 @@ class Raylet:
             env_hash=runtime_env_mod.hash_runtime_env(
                 summary.get("runtime_env")),
             arrival_ts=time.monotonic(),
+            task_id=summary.get("task_id") or b"",
         )
+        if self.task_events.enabled and req.task_id:
+            # the lease request carries the SAMPLE task at the head of
+            # the owner's queue — that task's lease wait starts here
+            self.task_events.record(req.task_id, PENDING_LEASE,
+                                    {"node": self._nid12})
         self._init_dep_state(req, summary.get("dep_info") or [])
         fut = asyncio.get_running_loop().create_future()
         fut.client = conn  # type: ignore[attr-defined]
@@ -732,6 +768,11 @@ class Raylet:
                 self.num_spillbacks += 1
                 self._pending.pop(d.req_id, None)
                 self._note_latency(req)
+                if self.task_events.enabled and req.task_id:
+                    self.task_events.record(
+                        req.task_id, SPILLBACK,
+                        {"node": self._nid12,
+                         "target": d.spill_address})
                 fut.set_result(({"granted": False, "spill": d.spill_address}, ()))
             elif d.action == INFEASIBLE:
                 if self.config.infeasible_task_policy == "wait":
@@ -774,10 +815,18 @@ class Raylet:
         self.leases[lease_id] = lease
         self._watch_lease_client(lease)
         self.num_leases_granted += 1
+        self._note_lease_granted(req, worker)
         fut.set_result(({"granted": True, "lease_id": lease_id,
                          "worker_address": worker.address,
                          "worker_id": worker.worker_id,
                          "node_id": self.node_id.binary()}, ()))
+
+    def _note_lease_granted(self, req, worker: WorkerHandle) -> None:
+        if self.task_events.enabled and req.task_id:
+            self.task_events.record(
+                req.task_id, LEASE_GRANTED,
+                {"node": self._nid12,
+                 "worker": worker.worker_id.hex()[:12]})
 
     def _try_grant_pg(self, req_id: int, req: PendingRequest, fut: asyncio.Future):
         key = (req.pg_id, req.pg_bundle)
@@ -809,6 +858,7 @@ class Raylet:
         self.leases[lease_id] = lease
         self._watch_lease_client(lease)
         self.num_leases_granted += 1
+        self._note_lease_granted(req, worker)
         fut.set_result(({"granted": True, "lease_id": lease_id,
                          "worker_address": worker.address,
                          "worker_id": worker.worker_id,
@@ -1410,7 +1460,8 @@ class Raylet:
                     fetchers.append(_fetch)
             else:
                 async def _legacy(off, _conn=conn):
-                    from ray_tpu._private.data_channel import pull_stats
+                    from ray_tpu._private.data_channel import \
+                        note_control_chunk
                     # Control-plane lane: these frames SHARE the RPC
                     # stream with heartbeats and lease grants, so the
                     # adaptive data-plane chunk must never inflate them
@@ -1432,11 +1483,11 @@ class Raylet:
                             raise ConnectionError(
                                 "short chunk from divergent replica")
                         native.copy_into(buf, sub, bufs2[0])
-                        pull_stats["chunks"] += 1
-                        pull_stats["bytes"] += want
-                        # the recv loop materialized this sub-chunk as
-                        # bytes before copy_into: one intermediate copy
-                        pull_stats["intermediate_copies"] += 1
+                        # counts the one intermediate bytes copy (the
+                        # recv loop materialized this sub-chunk before
+                        # copy_into) in pull_stats AND the Prometheus
+                        # tier counters
+                        note_control_chunk(want)
                         sub += want
                 # the old pull window: 8 in-flight chunks per peer
                 fetchers.extend([_legacy] * 8)
@@ -1501,6 +1552,7 @@ class Raylet:
             return None
         chunk = self._pull_chunk_size(total, len(found))
         await self._admit_pull(total, chunk)
+        t_pull = time.monotonic()
         try:
             # Destination: a recycled warm segment when the local store
             # has one (page allocation dominates cold pull writes), else
@@ -1540,6 +1592,19 @@ class Raylet:
                 return None
             _close_segment_owner(owner, buf)
             self.store.release_lease(name)  # sealed by the caller next
+            wall = time.monotonic() - t_pull
+            self._pull_rates.append(total / max(wall, 1e-9) / 1e9)
+            data_channel.observe_pull(total, wall)
+            if self.task_events.enabled:
+                # timeline record: the pull interval on the wall clock
+                # (ts = start), merged by ray_tpu.state.timeline() with
+                # task states and tracing spans
+                self.task_events.record(
+                    b"", TRANSFER,
+                    {"object_id": oid.hex(), "bytes": total,
+                     "dur": wall, "node": self._nid12,
+                     "sources": len(found)},
+                    ts=time.time() - wall)
             return name, total
         finally:
             self._pull_inflight_bytes -= total
@@ -1630,6 +1695,21 @@ class Raylet:
             "p99_ms": round(percentile(lat, 0.99) * 1e3, 3),
             "max_ms": round(lat[-1] * 1e3, 3),
         }
+
+    @staticmethod
+    def _rate_block(samples) -> dict:
+        """Percentile summary of a rate reservoir (units preserved —
+        unlike _pct_block there is no seconds->ms scaling). Guards the
+        empty case: metrics.percentile raises on empty input."""
+        from ray_tpu._private.metrics import percentile
+
+        rates = sorted(samples)
+        if not rates:
+            return {"count": 0}
+        return {"count": len(rates),
+                "p50": round(percentile(rates, 0.50), 3),
+                "p90": round(percentile(rates, 0.90), 3),
+                "max": round(rates[-1], 3)}
 
     def _latency_percentiles(self) -> dict:
         from ray_tpu._private.metrics import percentile
@@ -1748,6 +1828,10 @@ class Raylet:
                 "serve": dict(serve_stats),
                 "recv_tiers": dict(native.recv_stats),
                 "pull_inflight_bytes": self._pull_inflight_bytes,
+                # per-pull throughput percentiles (GB/s) from the
+                # bounded reservoir; {"count": 0} before any pull
+                "pull_throughput_gb_per_s": self._rate_block(
+                    self._pull_rates),
             },
             "schedule_latency": self._latency_percentiles(),
             "rpc_handlers": handler_stats.snapshot(),

@@ -49,6 +49,10 @@ class PendingRequest:
     deps_ready: bool = True
     # monotonic arrival time (schedule-latency accounting)
     arrival_ts: float = 0.0
+    # Sample task this lease request carries (TaskSpec.lease_summary's
+    # head-of-queue task): the anchor for the raylet's task-lifecycle
+    # events (PENDING_LEASE / LEASE_GRANTED / SPILLBACK).
+    task_id: bytes = b""
     # monotonic time of the FIRST scheduler tick that evaluated this
     # request: arrival->first_decision is pure decision latency;
     # first_decision->grant is resource wait (the two must be reported

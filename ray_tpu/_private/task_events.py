@@ -1,0 +1,343 @@
+"""Task-lifecycle event recording: per-task state machines, end to end.
+
+Role parity: the reference's task-event pipeline (reference:
+src/ray/core_worker/task_event_buffer.h TaskEventBuffer batching
+per-task status changes to the GCS task table, and the state API
+rebuilt on top of it — python/ray/util/state). Before this module the
+snapshot recorded ONE ``task:execute`` interval per task
+(core_worker.add_exec_event), so a task stuck in lease queueing,
+arg-pull or spillback was indistinguishable from one that never
+existed.
+
+Every task gets a recorded state machine::
+
+    SUBMITTED -> [PENDING_ARGS] -> PENDING_LEASE -> LEASE_GRANTED
+              -> DISPATCHED -> RUNNING -> FINISHED | FAILED(reason)
+
+with RETRY / SPILLBACK annotations. Transitions are stamped AT THE
+LAYER THAT OWNS THEM:
+
+* core_worker.py — SUBMITTED, PENDING_ARGS (arg resolution), RETRY,
+  DISPATCHED (this runtime's direct transport pushes task batches from
+  the owner, so dispatch is owner-side), owner-observed FAILED
+  (worker death, cancellation, infeasibility).
+* raylet.py — PENDING_LEASE (lease request queued), LEASE_GRANTED,
+  SPILLBACK, and TRANSFER records for data-plane pulls. Lease requests
+  carry the sample task at the head of the owner's queue
+  (TaskSpec.lease_summary), so pipelined followers that ride an
+  existing lease legitimately skip the lease states.
+* task_executor.py — RUNNING, FINISHED, FAILED(exception).
+
+Transitions accumulate in bounded per-process buffers (drop counter
+when full — never unbounded memory, never a hot-path RPC) and ship to
+the GCS task-event table in batches piggybacked on the existing
+reporting cadence: workers/drivers flush with the metrics report loop
+(``AddTaskEvents``), raylets piggyback on their heartbeat. The GCS
+keeps a capped per-job index with honest eviction counts.
+
+Recording is ON by default at state-transition granularity (the whole
+point is that the history exists when the straggler happens); disable
+with ``task_events_enabled=False`` / ``RAY_TPU_TASK_EVENTS_ENABLED=0``.
+bench.py's ``task_events_overhead`` row tracks the submit-path cost.
+
+All timestamps are ``time.time()`` (wall clock) so owner, raylet,
+worker and tracing spans merge onto ONE clock in
+``ray_tpu.state.timeline()``.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+# Canonical lifecycle states (reference: rpc::TaskStatus in gcs.proto).
+SUBMITTED = "SUBMITTED"
+PENDING_ARGS = "PENDING_ARGS"
+PENDING_LEASE = "PENDING_LEASE"
+LEASE_GRANTED = "LEASE_GRANTED"
+SPILLBACK = "SPILLBACK"
+DISPATCHED = "DISPATCHED"
+RUNNING = "RUNNING"
+FINISHED = "FINISHED"
+FAILED = "FAILED"
+# Annotation: the owner re-queued the task (worker death, application
+# error with retry_exceptions, lineage reconstruction).
+RETRY = "RETRY"
+# Data-plane pull interval (task_id is empty): merged into the
+# timeline so a trace shows submit -> lease wait -> pull -> execute.
+TRANSFER = "TRANSFER"
+
+TERMINAL_STATES = (FINISHED, FAILED)
+
+
+class TaskEventBuffer:
+    """Bounded per-process event buffer.
+
+    ``record`` is the hot-path entry: one truthiness check, one length
+    check and one GIL-atomic deque append — no lock, no RPC, no
+    formatting (wire dicts are built at drain time, off the per-task
+    path). When full, new events are DROPPED and counted; memory stays
+    flat (bench.py ``task_events_overhead`` pins both properties).
+
+    Thread model: ``record`` may run from any thread (submit threads,
+    the exec thread, the IO loop); ``drain_wire`` runs on the flushing
+    loop. The buffer is ONE deque for its whole lifetime and the drain
+    pops from the head (GIL-atomic popleft) — an append racing the
+    drain lands either in this flush or the next one, never nowhere.
+    (An earlier swap-the-list design could strand a concurrent append
+    on the already-iterated old list: silent, uncounted loss.)
+    """
+
+    __slots__ = ("capacity", "enabled", "dropped", "_dropped_flushed",
+                 "_buf")
+
+    def __init__(self, capacity: int = 65536, enabled: bool = True):
+        self.capacity = max(1, int(capacity))
+        self.enabled = enabled
+        # MONOTONIC total of dropped events. drain_wire reports deltas
+        # against _dropped_flushed instead of zeroing: a reset would
+        # race concurrent record() increments into lost (or re-reported)
+        # drop counts — the counter must stay honest exactly when drops
+        # are actively happening.
+        self.dropped = 0
+        self._dropped_flushed = 0
+        self._buf: "deque[tuple]" = deque()
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def record(self, task_id: bytes, state: str,
+               attrs: Any = None, ts: Optional[float] = None) -> None:
+        """Append one transition. ``attrs`` is a dict, a bare string
+        (shorthand for ``{"name": attrs}`` — saves a dict per submit on
+        the hot path), or None."""
+        if not self.enabled:
+            return
+        buf = self._buf
+        if len(buf) >= self.capacity:
+            self.dropped += 1
+            return
+        buf.append((task_id, state,
+                    time.time() if ts is None else ts, attrs))
+
+    def record_many(self, task_ids, state: str, attrs: Any = None,
+                    ts: Optional[float] = None) -> None:
+        """Bulk append — one timestamp read, one capacity check and one
+        ``list.extend`` for a whole batch (the DISPATCHED stamp of a
+        512-deep push batch must not cost 512 record() calls). The
+        shared ``attrs`` may alias across events: records are read-only
+        once appended."""
+        if not self.enabled:
+            return
+        if ts is None:
+            ts = time.time()
+        buf = self._buf
+        room = self.capacity - len(buf)
+        if room <= 0:
+            self.dropped += len(task_ids)
+            return
+        if len(task_ids) > room:
+            self.dropped += len(task_ids) - room
+            task_ids = task_ids[:room]
+        # list comprehension, not a generator: extend() over a genexp
+        # measured SLOWER than per-item record() on the target box
+        buf.extend([(t, state, ts, attrs) for t in task_ids])
+
+    def drain_wire(self, max_events: int = 0):
+        """-> (wire_events, dropped): up to ``max_events`` buffered
+        events (0 = everything present at entry) as wire dicts, popped
+        off the head of the live deque — a tail beyond the batch stays
+        buffered for the next flush (safe: same deque, no swap to race)
+        and the capacity check in record() keeps memory bounded
+        meanwhile. ``dropped`` is the delta of the monotonic drop total
+        since the last drain — never a counter reset, which would
+        clobber a concurrent record()'s increment. The per-flush
+        payload is bounded by ``capacity`` (events accumulated between
+        two flush periods): the default sizes a ~1.5 MB worst case."""
+        buf = self._buf
+        n = len(buf)
+        if max_events:
+            n = min(n, max_events)
+        out = []
+        for _ in range(n):
+            try:
+                t, s, ts, a = buf.popleft()
+            except IndexError:  # raced another drainer; nothing lost
+                break
+            out.append({"task_id": t, "state": s, "ts": ts, "attrs": a})
+        total = self.dropped
+        dropped = total - self._dropped_flushed
+        self._dropped_flushed = total
+        return out, dropped
+
+
+def _norm_attrs(attrs: Any) -> Optional[dict]:
+    if isinstance(attrs, str):
+        return {"name": attrs}
+    return attrs
+
+
+def _hex(b) -> str:
+    return b.hex() if isinstance(b, bytes) else (b or "")
+
+
+class TaskEventTable:
+    """GCS-side task table: per-task ordered transition history with a
+    capped per-job index (reference: GcsTaskManager's task-event
+    storage with per-job limits and honest ``num_profile_events_dropped``
+    style counters).
+
+    Eviction is FIFO per job (oldest first-seen task goes first) and
+    COUNTED per job — a truncated view is always reported as truncated,
+    never passed off as complete. Reporter-side ring-buffer drops
+    arrive with each batch and aggregate into ``dropped_events``.
+    """
+
+    MAX_TRANSFERS = 10_000
+
+    def __init__(self, max_tasks_per_job: int = 8192):
+        self.max_tasks_per_job = max(1, int(max_tasks_per_job))
+        # task_id -> record, insertion-ordered (dict semantics).
+        self._tasks: Dict[bytes, dict] = {}
+        # job_id -> task ids in first-seen order (the eviction queue).
+        self._per_job: Dict[bytes, List[bytes]] = {}
+        self.evicted_tasks: Dict[bytes, int] = {}
+        self.dropped_events = 0
+        self.transfers: List[dict] = []
+        self.transfers_dropped = 0
+
+    def num_tasks(self) -> int:
+        return len(self._tasks)
+
+    def ingest(self, events, dropped: int = 0, job_id: bytes = b"") -> None:
+        """Fold one reporter batch in. ``job_id`` is the batch-level
+        job of the reporting owner (raylet batches pass b"": their
+        events attach to records the owner's SUBMITTED creates, or to
+        a job-less record that upgrades when the owner's batch lands)."""
+        self.dropped_events += int(dropped or 0)
+        for e in events:
+            state = e.get("state")
+            attrs = _norm_attrs(e.get("attrs"))
+            if state == TRANSFER:
+                if len(self.transfers) >= self.MAX_TRANSFERS:
+                    self.transfers_dropped += 1
+                else:
+                    rec = {"ts": e.get("ts", 0.0)}
+                    rec.update(attrs or {})
+                    self.transfers.append(rec)
+                continue
+            tid = e.get("task_id") or b""
+            if not tid:
+                continue
+            rec = self._tasks.get(tid)
+            if rec is None:
+                rec = {"task_id": tid, "job_id": job_id,
+                       "name": (attrs or {}).get("name", ""),
+                       "attempt": 0, "events": []}
+                self._tasks[tid] = rec
+                self._index(tid, job_id)
+            else:
+                if attrs and attrs.get("name") and not rec["name"]:
+                    rec["name"] = attrs["name"]
+                if job_id and not rec["job_id"]:
+                    # raylet events arrived first: adopt the owner's job
+                    order = self._per_job.get(b"")
+                    if order is not None and tid in order:
+                        order.remove(tid)
+                    rec["job_id"] = job_id
+                    self._index(tid, job_id)
+            rec["events"].append((state, e.get("ts", 0.0), attrs))
+            if state == RETRY:
+                rec["attempt"] += 1
+
+    def _index(self, tid: bytes, job_id: bytes) -> None:
+        order = self._per_job.setdefault(job_id, [])
+        order.append(tid)
+        while len(order) > self.max_tasks_per_job:
+            old = order.pop(0)
+            if self._tasks.pop(old, None) is not None:
+                self.evicted_tasks[job_id] = \
+                    self.evicted_tasks.get(job_id, 0) + 1
+
+    def list(self, state: Optional[str] = None, name: Optional[str] = None,
+             node: Optional[str] = None, job_id: Optional[str] = None,
+             limit: int = 1000) -> List[dict]:
+        """Public-form records (hex ids, ts-sorted events with
+        durations), newest-submitted last, filtered then tail-limited.
+        Filters run on the RAW records and only the post-limit tail is
+        converted — the public conversion (per-task event sort + dict
+        build) must not scan the whole table on every dashboard poll.
+        ``limit`` <= 0 returns nothing (a negative limit must not alias
+        to 'the entire table'); 0 < limit bounds the tail."""
+        try:
+            limit = int(limit if limit is not None else 0)
+        except (TypeError, ValueError):
+            limit = 0
+        if limit <= 0:
+            return []
+        matched = []
+        for rec in self._tasks.values():
+            if name and name not in rec["name"]:
+                continue
+            if job_id and _hex(rec["job_id"]) != job_id:
+                continue
+            if state and _current_state(rec["events"]) != state:
+                continue
+            if node and not any(
+                    isinstance(e[2], dict) and
+                    str(e[2].get("node", "")).startswith(node)
+                    for e in rec["events"]):
+                continue
+            matched.append(rec)
+        return [task_record_to_public(r) for r in matched[-limit:]]
+
+    def summary(self) -> dict:
+        """Aggregate view for ``summary_tasks()`` / the dashboard."""
+        by_state: Dict[str, int] = {}
+        by_name: Dict[str, Dict[str, int]] = {}
+        for rec in self._tasks.values():
+            st = _current_state(rec["events"])
+            by_state[st] = by_state.get(st, 0) + 1
+            per = by_name.setdefault(rec["name"] or "?", {})
+            per[st] = per.get(st, 0) + 1
+        return {
+            "num_tasks": len(self._tasks),
+            "by_state": by_state,
+            "by_name": by_name,
+            "evicted_tasks": {_hex(k): v
+                              for k, v in self.evicted_tasks.items()},
+            "dropped_events": self.dropped_events,
+            "num_transfers": len(self.transfers),
+            "transfers_dropped": self.transfers_dropped,
+        }
+
+
+def _current_state(events) -> str:
+    """State of the latest-by-timestamp transition. A terminal state
+    wins ties (the worker's FINISHED and the owner's bookkeeping can
+    share a wall-clock microsecond)."""
+    if not events:
+        return ""
+    best = max(events, key=lambda e: (e[1], e[0] in TERMINAL_STATES))
+    return best[0]
+
+
+def task_record_to_public(rec: dict) -> dict:
+    """GCS-internal record -> API/JSON form: hex ids, events sorted by
+    timestamp, and per-hop durations (``dur`` = gap to the next
+    transition; None on the last one)."""
+    events = sorted(rec["events"], key=lambda e: e[1])
+    out_events = []
+    for i, (state, ts, attrs) in enumerate(events):
+        dur = events[i + 1][1] - ts if i + 1 < len(events) else None
+        out_events.append({"state": state, "ts": ts, "dur": dur,
+                           "attrs": attrs})
+    return {
+        "task_id": _hex(rec["task_id"]),
+        "job_id": _hex(rec["job_id"]),
+        "name": rec["name"],
+        "state": _current_state(events),
+        "attempt": rec["attempt"],
+        "events": out_events,
+    }

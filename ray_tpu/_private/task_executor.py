@@ -34,6 +34,7 @@ from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.serialization import (META_RAW, SerializedObject,
                                             format_task_error)
 from ray_tpu._private.ids import return_object_id_bytes
+from ray_tpu._private.task_events import FAILED, FINISHED, RUNNING
 from ray_tpu._private.task_spec import (ARG_REF, ARG_VALUE, REPLY_ERROR,
                                         REPLY_OK, REPLY_STOLEN, TaskSpec)
 
@@ -181,6 +182,9 @@ class StealableQueue:
 class TaskExecutor:
     def __init__(self, core: CoreWorker):
         self.core = core
+        # cached for the RUNNING-event attrs (hex per task would sit on
+        # the exec hot path)
+        self._wid12 = core.worker_id.hex()[:12]
         # Normal tasks execute serially, like a reference worker: one
         # dedicated execution thread fed by a queue. Batching the
         # reply delivery costs one loop wakeup per BURST of tasks
@@ -468,6 +472,10 @@ class TaskExecutor:
             # job-level runtime env for nested submissions)
             core.job_id = spec.job_id
             core.adopt_job_runtime_env(spec.job_id)
+        ev = core.task_events
+        if ev.enabled:
+            ev.record(spec.task_id, RUNNING,
+                      {"name": spec.name, "worker": self._wid12})
         try:
             fn = core.function_manager.fetch(spec.fn_key)
             args, kwargs = self._resolve_args(spec) if spec.args \
@@ -486,9 +494,16 @@ class TaskExecutor:
                 result = fn(*args, **kwargs)
             if profile:
                 core.add_exec_event(spec.name, spec.task_id, t0, _now())
-            return self._build_reply(spec, result)
+            reply = self._build_reply(spec, result)
+            if ev.enabled:
+                ev.record(spec.task_id, FINISHED)
+            return reply
         except Exception as e:  # noqa: BLE001
             logger.info("task %s failed:\n%s", spec.name, traceback.format_exc())
+            if ev.enabled:
+                ev.record(spec.task_id, FAILED,
+                          {"reason": type(e).__name__,
+                           "message": str(e)[:200]})
             return self._error_reply(spec, format_task_error(spec.name, e))
         finally:
             _task_ctx.task_id = b""
@@ -834,16 +849,27 @@ class TaskExecutor:
         if not self.core.job_id and spec.job_id:
             self.core.job_id = spec.job_id  # see _execute_task_sync
             self.core.adopt_job_runtime_env(spec.job_id)
+        ev = self.core.task_events
+        if ev.enabled:
+            ev.record(spec.task_id, RUNNING,
+                      {"name": spec.name, "worker": self._wid12})
         try:
             method = self._lookup_method(spec.name)
             args, kwargs = self._resolve_args(spec)
             with _exec_span(spec):
                 result = method(*args, **kwargs)
-            return self._build_reply(spec, result)
+            reply = self._build_reply(spec, result)
+            if ev.enabled:
+                ev.record(spec.task_id, FINISHED)
+            return reply
         except _ActorExitSignal:
             self._request_exit("actor exited via exit_actor()")
             return self._build_reply(spec, None)
         except Exception as e:  # noqa: BLE001
+            if ev.enabled:
+                ev.record(spec.task_id, FAILED,
+                          {"reason": type(e).__name__,
+                           "message": str(e)[:200]})
             return self._error_reply(spec, format_task_error(spec.name, e))
         finally:
             _task_ctx.task_id = b""
@@ -853,6 +879,10 @@ class TaskExecutor:
         """Runs ON THE ACTOR USER LOOP; ``fut`` and the admission
         semaphore belong to ``io_loop``."""
         reply = None
+        ev = self.core.task_events
+        if ev.enabled:
+            ev.record(spec.task_id, RUNNING,
+                      {"name": spec.name, "worker": self._wid12})
         try:
             method = self._lookup_method(spec.name)
             if not spec.args:
@@ -883,10 +913,16 @@ class TaskExecutor:
             else:
                 reply = await asyncio.get_running_loop().run_in_executor(
                     None, self._build_reply, spec, result)
+            if ev.enabled:
+                ev.record(spec.task_id, FINISHED)
         except _ActorExitSignal:
             self._request_exit("actor exited via exit_actor()")
             reply = self._build_reply(spec, None)
         except Exception as e:  # noqa: BLE001
+            if ev.enabled:
+                ev.record(spec.task_id, FAILED,
+                          {"reason": type(e).__name__,
+                           "message": str(e)[:200]})
             reply = self._error_reply(spec, format_task_error(spec.name, e))
         finally:
             # BaseException paths too (CancelledError from a user-loop

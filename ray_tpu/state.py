@@ -1,13 +1,22 @@
-"""Cluster introspection: the ``ray status`` / ``ray memory`` surface.
+"""Cluster introspection: the ``ray status`` / ``ray memory`` surface,
+plus the task-lifecycle state API (``list_tasks`` / ``summary_tasks`` /
+``timeline``).
 
 Parity target: reference python/ray/state.py + the status/memory CLI
 paths (reference: python/ray/scripts/scripts.py:1521 `ray status`,
-:1497 `ray memory` dumping the ref table via GCS).
+:1497 `ray memory` dumping the ref table via GCS) and the state API
+(reference: python/ray/util/state list_tasks over the GCS task table,
+plus ``ray timeline``'s chrome-trace export, scripts.py `ray
+timeline`). Task histories come from the GCS task-event table
+(task_events.py): every task's ordered transition history — SUBMITTED
+-> PENDING_LEASE -> DISPATCHED -> RUNNING -> FINISHED|FAILED with
+retry/spillback annotations — with per-hop durations.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List
+import json
+from typing import Dict, List, Optional
 
 import ray_tpu
 from ray_tpu import worker as worker_mod
@@ -64,6 +73,100 @@ def status() -> str:
     lines.append(f"Object store: {store_objs} objects, "
                  f"{store_bytes / (1024 ** 2):.1f} MiB used")
     return "\n".join(lines)
+
+
+def list_tasks(state: Optional[str] = None, name: Optional[str] = None,
+               node: Optional[str] = None, job_id: Optional[str] = None,
+               limit: int = 1000) -> List[dict]:
+    """Per-task lifecycle records from the GCS task table.
+
+    Each record carries the task's current ``state``, retry
+    ``attempt`` count, and the full ordered transition history::
+
+        {"task_id": hex, "job_id": hex, "name": str, "state": str,
+         "attempt": int,
+         "events": [{"state": str, "ts": float, "dur": float|None,
+                     "attrs": {...}|None}, ...]}
+
+    ``dur`` is the gap to the next transition (None on the last), so
+    "where did this task spend its time" reads straight off the list.
+    Filters: ``state`` exact (e.g. "RUNNING"), ``name`` substring,
+    ``node`` node-id-hex prefix, ``job_id`` hex. The table is capped
+    per job with counted eviction — ``summary_tasks()`` reports the
+    truncation."""
+    reply = _core().gcs_call_sync("GetTaskEvents", {
+        "state": state, "name": name, "node": node, "job_id": job_id,
+        "limit": limit})
+    return reply.get("tasks", [])
+
+
+def summary_tasks() -> dict:
+    """Aggregate task counts by state and by (name, state), plus the
+    honest loss accounting: per-job eviction counts and reporter-side
+    ring-buffer drops."""
+    reply = _core().gcs_call_sync("GetTaskSummary", {})
+    return reply.get("summary", {})
+
+
+def timeline(path: Optional[str] = None) -> List[dict]:
+    """Chrome-trace export (chrome://tracing / Perfetto "trace event"
+    JSON) merging THREE sources onto one wall clock:
+
+    * task state intervals from the GCS task table (one "X" slice per
+      transition, lasting until the next one),
+    * tracing spans exported by util/tracing.py (RAY_TPU_TRACE=1),
+    * data-plane pull/transfer intervals recorded by the raylets.
+
+    So a single trace shows submit -> lease wait -> pull -> execute.
+    Returns the event list; with ``path`` also writes it as JSON (load
+    the file directly in chrome://tracing or ui.perfetto.dev)."""
+    from ray_tpu.util import tracing
+
+    reply = _core().gcs_call_sync("GetTaskEvents", {
+        "limit": 100_000, "transfer_limit": 100_000})
+    events: List[dict] = []
+    pids: Dict[str, int] = {}
+
+    def pid_of(label: str) -> int:
+        p = pids.get(label)
+        if p is None:
+            p = pids[label] = len(pids) + 1
+            events.append({"ph": "M", "name": "process_name", "pid": p,
+                           "tid": 0, "ts": 0,
+                           "args": {"name": label}})
+        return p
+
+    for tidx, task in enumerate(reply.get("tasks", []), start=1):
+        pid = pid_of(f"tasks (job {task['job_id'] or '?'})")
+        events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                       "tid": tidx, "ts": 0,
+                       "args": {"name": f"{task['name']} "
+                                        f"{task['task_id'][:8]}"}})
+        for e in task["events"]:
+            events.append({
+                "name": e["state"], "cat": "task", "ph": "X",
+                "ts": e["ts"] * 1e6,
+                "dur": max(0.0, e["dur"] or 0.0) * 1e6,
+                "pid": pid, "tid": tidx,
+                "args": {"task_id": task["task_id"],
+                         "attempt": task["attempt"],
+                         **(e.get("attrs") or {})},
+            })
+    for tr in reply.get("transfers", []):
+        pid = pid_of(f"data-plane {tr.get('node', '?')}")
+        events.append({
+            "name": f"pull {str(tr.get('object_id', ''))[:8]}",
+            "cat": "data_plane", "ph": "X",
+            "ts": tr.get("ts", 0.0) * 1e6,
+            "dur": max(0.0, tr.get("dur", 0.0)) * 1e6,
+            "pid": pid, "tid": 0, "args": dict(tr),
+        })
+    events.extend(tracing.to_chrome_trace(tracing.all_spans()))
+    events.sort(key=lambda e: e.get("ts", 0))
+    if path:
+        with open(path, "w") as f:
+            json.dump(events, f)
+    return events
 
 
 def memory_summary() -> str:

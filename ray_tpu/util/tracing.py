@@ -175,25 +175,55 @@ def _export(span: Span) -> None:
         pass
 
 
-def get_trace(trace_id: str) -> List[Span]:
-    """All exported spans of a trace, start-time ordered."""
+def _spans_under(prefix: bytes) -> List[Span]:
+    """All spans stored under ``prefix``, start-time ordered. ONE bulk
+    GCS round-trip (KVGetPrefix): a per-key get loop over up to
+    tracing_max_spans entries would issue 100k sequential RPCs. Falls
+    back to the per-key path where the bulk RPC is unavailable
+    (ray:// thin-client cores route the experimental KV API only)."""
     import ray_tpu
+    import ray_tpu.worker as worker_mod
 
-    prefix = _KV_PREFIX + trace_id.encode() + b"/"
-    spans = []
-    for key in ray_tpu.experimental_internal_kv_list(prefix):
-        data = ray_tpu.experimental_internal_kv_get(key)
-        if data:
-            spans.append(Span.from_json(data))
+    try:
+        core = worker_mod._require_connected().core
+        reply = core.gcs_call_sync("KVGetPrefix", {"prefix": prefix})
+        datas = [v for _k, v in reply.get("pairs", [])]
+    except Exception:  # noqa: BLE001 — client mode / old GCS: fall back
+        datas = [ray_tpu.experimental_internal_kv_get(key)
+                 for key in ray_tpu.experimental_internal_kv_list(prefix)]
+    spans = [Span.from_json(data) for data in datas if data]
     spans.sort(key=lambda s: s.start_ns)
     return spans
 
 
+def get_trace(trace_id: str) -> List[Span]:
+    """All exported spans of a trace, start-time ordered."""
+    return _spans_under(_KV_PREFIX + trace_id.encode() + b"/")
+
+
+def all_spans() -> List[Span]:
+    """Every exported span across all traces, start-time ordered (the
+    timeline export merges these with task states and data-plane
+    transfer events — see ray_tpu.state.timeline)."""
+    return _spans_under(_KV_PREFIX)
+
+
+def dropped_span_count() -> int:
+    """Spans evicted by the GCS span cap (config ``tracing_max_spans``)
+    since cluster start — the honest counter behind oldest-trace
+    eviction."""
+    import ray_tpu
+
+    raw = ray_tpu.experimental_internal_kv_get(b"__rtpu_trace_dropped__")
+    return int(raw) if raw else 0
+
+
 def clear_trace(trace_id: str) -> int:
-    """Delete one trace's spans from the cluster KV. Span storage has
-    no TTL — long-running clusters with tracing enabled should clear
-    traces they have consumed (or call :func:`clear_all` periodically)
-    or the KV and its journal grow with task count."""
+    """Delete one trace's spans from the cluster KV. Span storage is
+    bounded by the GCS ``tracing_max_spans`` cap (oldest-trace eviction,
+    counted by :func:`dropped_span_count`); clearing traces you have
+    consumed (or calling :func:`clear_all` periodically) still keeps
+    the retained window focused on live work."""
     import ray_tpu
 
     n = 0

@@ -1,0 +1,108 @@
+"""Prometheus rendering / snapshot-merge edge cases (ISSUE 7
+satellite): histogram ``le`` bucket accumulation across merged
+snapshots, label escaping with quotes/newlines/backslashes, gauge
+last-writer-wins vs counter addition — plus the percentile() empty-
+sequence contract the raylet latency stats rely on.
+
+Mirrors the reference's exposition-format tests
+(python/ray/tests/test_metrics_agent.py asserting rendered lines).
+"""
+
+import pytest
+
+from ray_tpu._private.metrics import (
+    Counter, Gauge, Histogram, MetricRegistry, merge_snapshots,
+    percentile, render_prometheus,
+)
+
+
+def test_percentile_empty_raises_value_error():
+    # the old negative-index arithmetic raised a bare IndexError (or
+    # silently returned the last element of an aliased backing store)
+    with pytest.raises(ValueError):
+        percentile([], 0.5)
+    with pytest.raises(ValueError):
+        percentile((), 0.99)
+
+
+def test_percentile_nearest_rank_edges():
+    assert percentile([1, 2, 3, 4], 0.0) == 1
+    assert percentile([1, 2, 3, 4], 0.5) == 3
+    assert percentile([1, 2, 3, 4], 1.0) == 4  # index clamps to last
+    assert percentile([7], 0.99) == 7
+
+
+def test_histogram_le_buckets_accumulate_across_merged_snapshots():
+    """Bucket counts from two reporters ADD per-bucket, and rendering
+    emits CUMULATIVE le counts over the merged result."""
+    r1, r2 = MetricRegistry(), MetricRegistry()
+    h1 = Histogram("lat_s", "latency", boundaries=[0.1, 1.0], registry=r1)
+    h2 = Histogram("lat_s", "latency", boundaries=[0.1, 1.0], registry=r2)
+    h1.observe(0.05)
+    h1.observe(0.5)
+    h2.observe(0.05)
+    h2.observe(5.0)
+
+    merged = merge_snapshots([r1.snapshot(), r2.snapshot()])
+    buckets, total, count = merged["lat_s"]["values"][0][1]
+    assert buckets == [2, 1, 1]      # per-bucket addition
+    assert count == 4 and total == pytest.approx(5.6)
+
+    text = render_prometheus(merged)
+    assert 'lat_s_bucket{le="0.1"} 2' in text
+    assert 'lat_s_bucket{le="1.0"} 3' in text     # cumulative
+    assert 'lat_s_bucket{le="+Inf"} 4' in text
+    assert "lat_s_count 4" in text
+    assert "lat_s_sum 5.6" in text
+
+
+def test_label_escaping_quotes_newlines_backslashes():
+    r = MetricRegistry()
+    c = Counter("esc_total", "desc", registry=r)
+    c.inc(1, labels={"path": 'a"b\n\\c'})
+    text = render_prometheus(merge_snapshots([r.snapshot()]))
+    # exposition-format escapes: \" for quote, \n for newline, \\ for
+    # backslash — the raw characters must never reach the output line
+    assert 'esc_total{path="a\\"b\\n\\\\c"} 1' in text
+    assert "\n".join(
+        line for line in text.splitlines() if "esc_total{" in line
+    ).count("\n") == 0  # the value stayed on one line
+
+
+def test_merge_gauge_last_writer_wins_counter_adds():
+    r1, r2 = MetricRegistry(), MetricRegistry()
+    c1 = Counter("reqs_total", "d", registry=r1)
+    c2 = Counter("reqs_total", "d", registry=r2)
+    g1 = Gauge("depth", "d", registry=r1)
+    g2 = Gauge("depth", "d", registry=r2)
+    c1.inc(2)
+    c2.inc(3)
+    g1.set(1.0)
+    g2.set(9.0)
+    s1, s2 = r1.snapshot(), r2.snapshot()
+
+    merged = merge_snapshots([s1, s2])
+    assert merged["reqs_total"]["values"][0][1] == 5   # counters ADD
+    assert merged["depth"]["values"][0][1] == 9.0      # last writer
+
+    # gauge winner is snapshot ORDER, not magnitude
+    merged_rev = merge_snapshots([s2, s1])
+    assert merged_rev["reqs_total"]["values"][0][1] == 5
+    assert merged_rev["depth"]["values"][0][1] == 1.0
+
+
+def test_merge_distinct_label_sets_stay_separate():
+    r1, r2 = MetricRegistry(), MetricRegistry()
+    c1 = Counter("tiered_total", "d", registry=r1)
+    c2 = Counter("tiered_total", "d", registry=r2)
+    c1.inc(4, labels={"tier": "striped"})
+    c2.inc(6, labels={"tier": "control"})
+    c2.inc(1, labels={"tier": "striped"})
+    merged = merge_snapshots([r1.snapshot(), r2.snapshot()])
+    vals = {tuple(map(tuple, pairs)): v
+            for pairs, v in merged["tiered_total"]["values"]}
+    assert vals[(("tier", "striped"),)] == 5
+    assert vals[(("tier", "control"),)] == 6
+    text = render_prometheus(merged)
+    assert 'tiered_total{tier="striped"} 5' in text
+    assert 'tiered_total{tier="control"} 6' in text

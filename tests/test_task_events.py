@@ -1,0 +1,408 @@
+"""Task-lifecycle observability (ISSUE 7): per-task event timeline,
+GCS task table, state API and the unified chrome-trace export.
+
+Coverage model: the reference's task-event pipeline tests
+(task_event_buffer bounds + GCS task-table limits, and the state API's
+list_tasks assertions in python/ray/tests/test_state_api.py) plus this
+repo's acceptance pins — a task that fails and retries, and a task
+that spills back once, both show their FULL ordered transition history
+with durations; timeline() merges task states, tracing spans and a
+data-plane pull event from a two-raylet run into valid chrome-trace
+JSON.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import state
+from ray_tpu._private.task_events import (
+    DISPATCHED, FAILED, FINISHED, LEASE_GRANTED, PENDING_LEASE, RETRY,
+    RUNNING, SPILLBACK, SUBMITTED, TRANSFER, TaskEventBuffer,
+    TaskEventTable,
+)
+
+# ---------------------------------------------------------------------------
+# unit: the bounded per-process buffer
+# ---------------------------------------------------------------------------
+
+
+def test_buffer_bounded_with_drop_counter():
+    buf = TaskEventBuffer(capacity=8, enabled=True)
+    for i in range(20):
+        buf.record(b"t%02d" % i, SUBMITTED)
+    assert len(buf) == 8          # memory flat past capacity
+    assert buf.dropped == 12      # every overflow honestly counted
+    events, dropped = buf.drain_wire()
+    assert len(events) == 8 and dropped == 12
+    # the drop total is MONOTONIC (drain reports deltas — a reset would
+    # race concurrent records); a second drain reports nothing new
+    assert len(buf) == 0 and buf.dropped == 12
+    assert buf.drain_wire() == ([], 0)
+    # disabled recorder costs one check and records nothing
+    buf.enabled = False
+    buf.record(b"x", SUBMITTED)
+    assert len(buf) == 0 and buf.dropped == 12
+
+
+def test_buffer_capped_drain_leaves_tail_on_live_deque():
+    buf = TaskEventBuffer(capacity=100)
+    for _ in range(50):
+        buf.record(b"t", SUBMITTED)
+    # the drain pops from the head of the LIVE deque (no list swap to
+    # race concurrent records into silent loss); a tail beyond the
+    # batch cap stays buffered for the next flush, nothing is dropped
+    events, dropped = buf.drain_wire(max_events=10)
+    assert len(events) == 10 and dropped == 0 and len(buf) == 40
+    events, dropped = buf.drain_wire()
+    assert len(events) == 40 and dropped == 0 and len(buf) == 0
+    # string attrs are the hot-path name shorthand
+    buf2 = TaskEventBuffer(capacity=4)
+    buf2.record(b"t", SUBMITTED, "my_task")
+    (e,), _ = buf2.drain_wire()
+    assert e["attrs"] == "my_task" and e["state"] == SUBMITTED
+
+
+def test_buffer_record_many_bulk_caps_and_counts():
+    buf = TaskEventBuffer(capacity=5)
+    buf.record_many([b"a", b"b", b"c"], DISPATCHED, {"worker": "w"})
+    assert len(buf) == 3 and buf.dropped == 0
+    buf.record_many([b"d", b"e", b"f", b"g"], DISPATCHED)
+    assert len(buf) == 5 and buf.dropped == 2
+    buf.record_many([b"h"], DISPATCHED)
+    assert len(buf) == 5 and buf.dropped == 3
+    events, dropped = buf.drain_wire()
+    assert [e["task_id"] for e in events] == [b"a", b"b", b"c", b"d", b"e"]
+    assert dropped == 3
+
+
+# ---------------------------------------------------------------------------
+# unit: the GCS task table
+# ---------------------------------------------------------------------------
+
+
+def test_table_per_job_cap_counts_evictions():
+    t = TaskEventTable(max_tasks_per_job=3)
+    for i in range(5):
+        t.ingest([{"task_id": b"task%d" % i, "state": SUBMITTED,
+                   "ts": float(i), "attrs": "f"}], job_id=b"j1")
+    assert t.num_tasks() == 3
+    s = t.summary()
+    assert s["evicted_tasks"][b"j1".hex()] == 2
+    ids = {r["task_id"] for r in t.list()}
+    # oldest-seen evicted first
+    assert ids == {b"task2".hex(), b"task3".hex(), b"task4".hex()}
+
+
+def test_table_history_order_transfers_and_drops():
+    t = TaskEventTable(8)
+    t.ingest([
+        {"task_id": b"t1", "state": RUNNING, "ts": 2.0,
+         "attrs": {"worker": "w", "name": "f"}},
+        {"task_id": b"t1", "state": SUBMITTED, "ts": 1.0, "attrs": "f"},
+        {"task_id": b"", "state": TRANSFER, "ts": 1.5,
+         "attrs": {"object_id": "ab", "bytes": 10, "dur": 0.1}},
+        {"task_id": b"t1", "state": FINISHED, "ts": 3.0, "attrs": None},
+    ], dropped=5, job_id=b"j")
+    t.ingest([], dropped=7)
+    [rec] = t.list()
+    # events sort by timestamp regardless of arrival order
+    assert [e["state"] for e in rec["events"]] == \
+        [SUBMITTED, RUNNING, FINISHED]
+    assert rec["state"] == FINISHED and rec["name"] == "f"
+    assert rec["events"][0]["dur"] == 1.0
+    assert rec["events"][-1]["dur"] is None
+    assert t.transfers == [{"ts": 1.5, "object_id": "ab", "bytes": 10,
+                            "dur": 0.1}]
+    assert t.summary()["dropped_events"] == 12
+    # limit <= 0 means NOTHING, never "the whole table" (the [-0:]
+    # slicing trap)
+    assert t.list(limit=0) == [] and t.list(limit=-1) == []
+
+
+def test_table_retry_attempts_and_job_upgrade():
+    t = TaskEventTable(8)
+    # raylet events can land BEFORE the owner's SUBMITTED batch: the
+    # record starts job-less and adopts the job when the owner reports
+    t.ingest([{"task_id": b"tx", "state": PENDING_LEASE, "ts": 1.0,
+               "attrs": {"node": "n1"}}])
+    t.ingest([{"task_id": b"tx", "state": SUBMITTED, "ts": 0.9,
+               "attrs": "f"},
+              {"task_id": b"tx", "state": RETRY, "ts": 2.0,
+               "attrs": {"reason": "worker died"}}], job_id=b"jobA")
+    [rec] = t.list()
+    assert rec["job_id"] == b"jobA".hex()
+    assert rec["attempt"] == 1
+    assert t.list(job_id=b"jobA".hex())
+    assert not t.list(job_id=b"other".hex())
+    assert t.list(node="n1") and not t.list(node="n2")
+
+
+# ---------------------------------------------------------------------------
+# e2e: single node — lifecycle, retry-after-failure, dashboard route
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def ev_cluster():
+    info = ray_tpu.init(num_cpus=2, _system_config={
+        "metrics_report_period_ms": 200,
+        "raylet_heartbeat_period_ms": 100})
+    yield info
+    ray_tpu.shutdown()
+
+
+def _find_task(name_part, pred, timeout=25.0):
+    deadline = time.monotonic() + timeout
+    last = []
+    while time.monotonic() < deadline:
+        last = state.list_tasks(name=name_part)
+        for t in last:
+            if pred(t):
+                return t
+        time.sleep(0.2)
+    raise AssertionError(f"no task matching {name_part!r}: {last}")
+
+
+def test_list_tasks_full_lifecycle(ev_cluster):
+    @ray_tpu.remote
+    def lifecycle_probe():
+        return 41
+
+    assert ray_tpu.get(lifecycle_probe.remote()) == 41
+    t = _find_task("lifecycle_probe", lambda t: t["state"] == FINISHED)
+    states = [e["state"] for e in t["events"]]
+    assert states[0] == SUBMITTED
+    for s in (PENDING_LEASE, LEASE_GRANTED, DISPATCHED, RUNNING, FINISHED):
+        assert s in states, states
+    assert states.index(DISPATCHED) < states.index(RUNNING) \
+        < states.index(FINISHED)
+    tss = [e["ts"] for e in t["events"]]
+    assert tss == sorted(tss)
+    # every hop but the last carries its duration
+    assert all(e["dur"] is not None for e in t["events"][:-1])
+    assert t["attempt"] == 0
+
+    # summary aggregates by state and name
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        s = state.summary_tasks()
+        if s.get("by_state", {}).get(FINISHED):
+            break
+        time.sleep(0.2)
+    assert s["num_tasks"] >= 1
+    assert any("lifecycle_probe" in n for n in s["by_name"])
+
+
+def test_failed_and_retried_task_history(ev_cluster, tmp_path):
+    """Acceptance pin: a task that fails and retries shows the full
+    ordered history — ... RUNNING -> FAILED -> RETRY -> ... ->
+    RUNNING -> FINISHED — with the failure reason recorded."""
+    marker = str(tmp_path / "flaky-marker")
+
+    @ray_tpu.remote(max_retries=2, retry_exceptions=True)
+    def flaky_probe(path):
+        import os
+        if not os.path.exists(path):
+            open(path, "w").close()
+            raise ValueError("first attempt fails")
+        return "ok"
+
+    assert ray_tpu.get(flaky_probe.remote(marker)) == "ok"
+    t = _find_task(
+        "flaky_probe",
+        lambda t: t["state"] == FINISHED and
+        any(e["state"] == RETRY for e in t["events"]))
+    states = [e["state"] for e in t["events"]]
+    assert states[0] == SUBMITTED
+    assert FAILED in states and RETRY in states
+    assert states.index(FAILED) < states.index(RETRY)
+    # after the retry the task ran again and finished
+    assert states.index(RETRY) < len(states) - 1
+    assert states[-1] == FINISHED
+    assert states.count(RUNNING) == 2
+    assert t["attempt"] == 1
+    failed = next(e for e in t["events"] if e["state"] == FAILED)
+    assert failed["attrs"]["reason"] == "ValueError"
+    retried = next(e for e in t["events"] if e["state"] == RETRY)
+    assert retried["attrs"]["reason"] == "application error"
+
+
+def test_dashboard_tasks_route(ev_cluster):
+    @ray_tpu.remote
+    def dash_task_probe():
+        return 1
+
+    assert ray_tpu.get(dash_task_probe.remote()) == 1
+    addr = state.metrics_address()
+    deadline = time.monotonic() + 20
+    data = {}
+    while time.monotonic() < deadline:
+        with urllib.request.urlopen(f"http://{addr}/api/tasks?limit=50",
+                                    timeout=5) as resp:
+            assert resp.status == 200
+            data = json.loads(resp.read())
+        if any("dash_task_probe" in t["name"] for t in data.get("tasks", [])):
+            break
+        time.sleep(0.2)
+    assert any("dash_task_probe" in t["name"] for t in data["tasks"]), data
+    assert data["summary"]["num_tasks"] >= 1
+    # the status page renders the table the route feeds
+    with urllib.request.urlopen(f"http://{addr}/", timeout=5) as resp:
+        page = resp.read().decode()
+    assert "/api/tasks" in page and 'id="tasks"' in page
+
+
+def test_tracing_span_cap_evicts_oldest_trace():
+    """Satellite: tracing_max_spans bounds the span KV — oldest-trace
+    eviction with an honest dropped-span counter."""
+    from ray_tpu.util import tracing
+
+    tracing.enable()
+    try:
+        ray_tpu.init(num_cpus=1, _system_config={
+            "tracing_max_spans": 4, "num_prestart_workers": 0})
+        trace_ids = []
+        for i in range(8):
+            with tracing.trace(f"cap-span-{i}") as sp:
+                pass
+            trace_ids.append(sp.trace_id)
+        deadline = time.monotonic() + 15
+        keys = []
+        while time.monotonic() < deadline:
+            keys = ray_tpu.experimental_internal_kv_list(b"__traces__/")
+            if len(keys) <= 4 and tracing.dropped_span_count() >= 4 and \
+                    tracing.get_trace(trace_ids[-1]):
+                break
+            time.sleep(0.2)
+        assert 0 < len(keys) <= 4, keys
+        assert tracing.dropped_span_count() >= 4
+        # the newest trace survives; the oldest was evicted
+        assert tracing.get_trace(trace_ids[-1])
+        assert not tracing.get_trace(trace_ids[0])
+    finally:
+        tracing.disable()
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# e2e: two raylets — spillback history, data-plane transfer, timeline
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def cluster2():
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    c.add_node(num_cpus=2, resources={"spot": 2})
+    c.connect()
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_spillback_history_and_timeline(cluster2):
+    """Acceptance pin: a task that spills back once shows the full
+    ordered history across BOTH raylets, and timeline() emits valid
+    chrome-trace JSON merging task states, tracing spans and at least
+    one data-plane pull event."""
+    import numpy as np
+
+    from ray_tpu.util import tracing
+
+    @ray_tpu.remote(resources={"spot": 1}, num_cpus=1)
+    def spill_probe():
+        return np.ones(400_000)  # 3.2 MB -> plasma on the spot node
+
+    tracing.enable()
+    try:
+        with tracing.trace("timeline-root"):
+            arr = ray_tpu.get(spill_probe.remote())
+    finally:
+        tracing.disable()
+    assert arr.shape == (400_000,)
+
+    t = _find_task(
+        "spill_probe",
+        lambda t: t["state"] == FINISHED and
+        any(e["state"] == SPILLBACK for e in t["events"]),
+        timeout=40)
+    states = [e["state"] for e in t["events"]]
+    # head raylet: queued then spilled; spot raylet: queued then granted
+    assert states.index(SPILLBACK) < states.index(LEASE_GRANTED)
+    assert states.count(PENDING_LEASE) >= 2
+    spill = next(e for e in t["events"] if e["state"] == SPILLBACK)
+    assert spill["attrs"]["target"]  # where it spilled to
+    nodes = {(e.get("attrs") or {}).get("node")
+             for e in t["events"] if e.get("attrs")}
+    assert len({n for n in nodes if n}) >= 2, nodes
+    assert states[-1] == FINISHED
+
+    # the driver's get() pulled the 3.2MB return cross-node: the pull
+    # interval reaches the table as a TRANSFER record, and timeline()
+    # merges all three sources
+    deadline = time.monotonic() + 30
+    cats = set()
+    events = []
+    while time.monotonic() < deadline:
+        events = state.timeline()
+        cats = {e.get("cat") for e in events}
+        if "data_plane" in cats and "task" in cats and \
+                cats & {"internal", "consumer", "producer"}:
+            break
+        time.sleep(0.3)
+    assert "task" in cats, cats
+    assert "data_plane" in cats, cats
+    assert cats & {"internal", "consumer", "producer"}, cats
+    # valid chrome-trace JSON: serializable, and every slice is a
+    # complete "X" event on the shared microsecond clock
+    reloaded = json.loads(json.dumps(events))
+    for e in reloaded:
+        if e.get("ph") == "X":
+            assert "ts" in e and "dur" in e and "pid" in e and "name" in e
+    pull = next(e for e in reloaded if e.get("cat") == "data_plane")
+    assert pull["args"]["bytes"] >= 3_200_000
+
+    # satellite: data-plane metrics reach the Prometheus endpoint (the
+    # head raylet runs in a standalone process, so its registry ships
+    # piggybacked on the heartbeat) and GetNodeStats carries the
+    # stripe-failure counter + per-pull throughput block
+    addr = state.metrics_address()
+    deadline = time.monotonic() + 20
+    text = ""
+    while time.monotonic() < deadline:
+        with urllib.request.urlopen(f"http://{addr}/metrics",
+                                    timeout=5) as resp:
+            text = resp.read().decode()
+        if "ray_tpu_data_plane_bytes_pulled_total" in text:
+            break
+        time.sleep(0.3)
+    assert "ray_tpu_data_plane_bytes_pulled_total" in text
+    assert "ray_tpu_data_plane_pull_gb_per_s_bucket" in text
+
+    import asyncio
+
+    from ray_tpu._private import rpc
+
+    async def _stats(addr):
+        conn = await rpc.connect(addr, peer_name="test-stats")
+        try:
+            reply, _ = await conn.call("GetNodeStats", {})
+            return reply
+        finally:
+            await conn.close()
+
+    loop = asyncio.new_event_loop()
+    try:
+        stats = loop.run_until_complete(
+            _stats(cluster2.head.raylet_address))
+    finally:
+        loop.close()
+    plane = stats["data_plane"]
+    assert "stripe_failures" in plane["pull"]
+    assert plane["pull_throughput_gb_per_s"]["count"] >= 1
+    assert plane["pull"]["bytes"] >= 3_200_000
