@@ -226,6 +226,55 @@ def main():
             "ring_bounded": len(ring) == 1024 and ring.dropped == 3072,
         }
 
+    def bench_faultpoints_overhead():
+        """Disarmed fault-injection plane cost (ISSUE 8 acceptance):
+        every wired site pays one ``if faultpoints.armed:`` module-
+        attribute check on the hot path. Three measurements: (1) the
+        raw guard cost in ns (timeit over the exact expression), and
+        its computed fraction of one task's submit+dispatch budget —
+        the honest stand-in for "compiled out", since the only delta a
+        compiled-out build removes IS this guard; (2) interleaved
+        best-of submit throughput disarmed vs armed-with-a-never-
+        matching-point (the worst legal state short of a firing
+        fault); (3) the <2% gate over both."""
+        import timeit as _timeit
+
+        from ray_tpu._private import faultpoints as fp
+
+        assert not fp.armed, "bench must start disarmed"
+        # (1) raw guard: the per-site cost when disarmed
+        n = 2_000_000
+        guard_s = _timeit.timeit("fp.armed", globals={"fp": fp},
+                                 number=n) / n
+        # (2) interleaved submit microbench: disarmed vs armed-nomatch
+        bench_tasks_async()  # warm
+        dis_rates, armed_rates = [], []
+        for _ in range(6):
+            fp.reset()
+            t0 = time.perf_counter()
+            k = bench_tasks_async()
+            dis_rates.append(k / (time.perf_counter() - t0))
+            # arming ANY point flips the global guard: every wired
+            # site now does its registry lookup (and misses)
+            fp.arm("bench.never.fired", "drop")
+            t0 = time.perf_counter()
+            k = bench_tasks_async()
+            armed_rates.append(k / (time.perf_counter() - t0))
+        fp.reset()
+        dis, arm_rate = max(dis_rates), max(armed_rates)
+        # ~4 guarded sites on a task's submit/dispatch/reply path
+        per_task_s = 1.0 / dis
+        guard_pct = 4 * guard_s / per_task_s * 100
+        armed_delta_pct = max(0.0, dis / arm_rate - 1.0) * 100
+        return {
+            "guard_ns": round(guard_s * 1e9, 2),
+            "guard_pct_of_task": round(guard_pct, 4),
+            "disarmed_tasks_per_s": round(dis, 1),
+            "armed_nomatch_tasks_per_s": round(arm_rate, 1),
+            "armed_nomatch_delta_pct": round(armed_delta_pct, 2),
+            "within_2pct": guard_pct < 2.0,
+        }
+
     def memcpy_gbps():
         """This box's raw memory bandwidth — the physical ceiling for
         the zero-copy put path (one memcpy into shm). The reference's
@@ -310,6 +359,11 @@ def main():
         task_events_row = bench_task_events_overhead()
     except Exception as e:  # noqa: BLE001 — secondary row
         task_events_row = {"error": str(e)}
+    _trace("faultpoints_overhead")
+    try:
+        faultpoints_row = bench_faultpoints_overhead()
+    except Exception as e:  # noqa: BLE001 — secondary row
+        faultpoints_row = {"error": str(e)}
     _trace("puts")
     puts_per_s = timeit(bench_puts)
     _trace("put_gb")
@@ -515,6 +569,7 @@ def main():
             "put_vs_memcpy_ceiling": round(put_gbps / mem_gbps, 4),
             "zero_copy_put": zero_copy_put,
             "task_events_overhead": task_events_row,
+            "faultpoints_overhead": faultpoints_row,
             "cross_node_transfer": xnode_row,
             "lint_runtime": lint_row,
             "columnar_data_1m": columnar_row,

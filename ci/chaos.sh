@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Chaos soak gate: every fixed-seed fault schedule (tests/chaos.py
+# driven by tests/test_chaos.py) over the in-process data plane AND the
+# real subprocess cluster — stripe sever, corrupt chunk, short read,
+# delay storm, raylet crash, heartbeat partition, GCS restart, mixed,
+# worker kill. Runs the slow-marked schedules too (tier-1 carries only
+# the 2-schedule smoke); any invariant violation (pull hang, admission
+# budget leak, segment-lease leak, fd leak, unresurrected partitioned
+# node, dishonest task-event history) fails CI.
+#
+# Determinism contract: a schedule is fully determined by its (kind,
+# seed) pair — a failure here replays locally with exactly
+#   python -m pytest "tests/test_chaos.py::test_chaos_soak[<kind>]" -m ''
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+export RAY_TPU_WORKER_JAX_PLATFORMS="${RAY_TPU_WORKER_JAX_PLATFORMS:-cpu}"
+
+# -m '' = no marker filter: the slow soak schedules run here (the
+# tier-1 command excludes them with its own -m 'not slow').
+exec python -m pytest tests/test_chaos.py tests/test_faultpoints.py \
+    -q -p no:cacheprovider -m '' "$@"

@@ -1,0 +1,105 @@
+"""Shared retry/backoff policy: seeded exponential backoff with jitter.
+
+Before this module each retry loop in the tree rolled its own policy —
+a one-shot fixed sleep on pull location refresh, a fixed 0.2 s spin on
+raylet->GCS reconnect, 0.05/0.5 s constants in actor re-resolution.
+Under real failures those constants are either too eager (thundering
+reconnect herds against a restarting GCS) or too slow (a whole extra
+round-trip budget burnt sleeping). One policy object replaces them all
+(reference: the reference's ExponentialBackoff in
+src/ray/common/ray_config_def.h-driven retry helpers).
+
+Jitter is FULL jitter (delay drawn uniformly from [base, target]) from
+a ``random.Random`` that tests can SEED to pin the delay sequence
+(test_faultpoints pins reproducibility). Production call sites run
+unseeded — retry *timing* is not part of the chaos determinism
+contract (the chaos scheduler's *event sequence* is what replays
+byte-identically from a seed; wall-clock interleaving never was
+deterministic).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from typing import Optional
+
+
+class Backoff:
+    """Exponential-jitter delay sequence, cap + deadline aware.
+
+    Usage::
+
+        bo = Backoff(base_s=0.05, cap_s=2.0, deadline_s=60.0)
+        while not bo.expired():
+            if try_thing():
+                break
+            await bo.sleep()      # or time.sleep(bo.next_delay())
+
+    ``deadline_s`` is measured from construction (or the last
+    :meth:`reset`); ``sleep``/``next_delay`` never overshoot it — the
+    final sleep is clamped so the caller re-checks exactly at the
+    deadline instead of up to ``cap_s`` past it.
+    """
+
+    def __init__(self, base_s: float, cap_s: float,
+                 multiplier: float = 2.0,
+                 deadline_s: Optional[float] = None,
+                 seed: Optional[int] = None):
+        if base_s <= 0:
+            raise ValueError("base_s must be > 0")
+        if cap_s < base_s:
+            raise ValueError("cap_s must be >= base_s")
+        self.base_s = base_s
+        self.cap_s = cap_s
+        self.multiplier = multiplier
+        self.deadline_s = deadline_s
+        self._rng = random.Random(seed)
+        self.attempts = 0
+        self._t0 = time.monotonic()
+
+    def reset(self) -> None:
+        """Back to the first-attempt delay (a success mid-loop resets
+        the policy, so the next failure starts gentle again)."""
+        self.attempts = 0
+        self._t0 = time.monotonic()
+
+    def expired(self) -> bool:
+        return (self.deadline_s is not None and
+                time.monotonic() - self._t0 >= self.deadline_s)
+
+    def next_delay(self) -> float:
+        """The next delay in seconds (advances the sequence). Full
+        jitter: uniform in [base, min(cap, base * mult**attempt)];
+        clamped so the caller never sleeps past the deadline."""
+        target = min(self.cap_s,
+                     self.base_s * (self.multiplier ** self.attempts))
+        self.attempts += 1
+        delay = self.base_s if target <= self.base_s else \
+            self._rng.uniform(self.base_s, target)
+        if self.deadline_s is not None:
+            remaining = self.deadline_s - (time.monotonic() - self._t0)
+            delay = max(0.0, min(delay, remaining))
+        return delay
+
+    async def sleep(self) -> None:
+        await asyncio.sleep(self.next_delay())
+
+    def sleep_sync(self) -> None:
+        time.sleep(self.next_delay())
+
+
+def from_config(config, deadline_s: Optional[float] = None,
+                seed: Optional[int] = None) -> Backoff:
+    """The cluster-wide default policy off the config knobs
+    (``retry_backoff_base_s`` / ``retry_backoff_cap_s`` /
+    ``retry_backoff_multiplier``). Misconfigured knobs are clamped to
+    a sane floor rather than raising — a bad retry knob must degrade
+    pacing, never break every retry loop in the cluster."""
+    base = max(getattr(config, "retry_backoff_base_s", 0.05), 1e-3)
+    return Backoff(
+        base_s=base,
+        cap_s=max(getattr(config, "retry_backoff_cap_s", 2.0), base),
+        multiplier=getattr(config, "retry_backoff_multiplier", 2.0),
+        deadline_s=deadline_s, seed=seed)

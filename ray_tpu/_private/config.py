@@ -65,10 +65,17 @@ class RayTpuConfig:
     # round-trip-bound.
     data_plane_max_chunk_size: int = 8 * 1024 * 1024
     # When every known location of an object fails mid-pull, the raylet
-    # re-queries the owner's location index ONCE after this backoff —
-    # a replica added meanwhile (e.g. by a concurrent pull elsewhere)
-    # is found instead of erroring the get.
+    # re-queries the owner's location index after a backoff — a replica
+    # added meanwhile (e.g. by a concurrent pull elsewhere) is found
+    # instead of erroring the get. This is the BASE delay of the
+    # exponential-jitter policy (backoff.py); the refresh is attempted
+    # pull_location_refresh_attempts times.
     pull_location_refresh_backoff_s: float = 0.2
+    # How many location-refresh rounds a failing pull gets before the
+    # get errors (1 preserves the original one-shot refresh; each extra
+    # round backs off exponentially from
+    # pull_location_refresh_backoff_s up to retry_backoff_cap_s).
+    pull_location_refresh_attempts: int = 1
 
     # --- scheduling ---
     # Pipeline depth CEILING for pushing tasks to a leased worker before
@@ -134,6 +141,15 @@ class RayTpuConfig:
     gcs_journal_path: str = ""
     # How long a raylet keeps retrying to reach a restarting GCS.
     gcs_reconnect_timeout_s: float = 60.0
+    # Shared retry/backoff policy (backoff.py): every reconnect /
+    # re-resolve loop (raylet->GCS redial, actor re-resolution, pull
+    # location refresh) backs off exponentially with full jitter from
+    # this base up to this cap, so failure storms never produce
+    # fixed-interval thundering herds. Multiplier is the growth factor
+    # per attempt.
+    retry_backoff_base_s: float = 0.05
+    retry_backoff_cap_s: float = 2.0
+    retry_backoff_multiplier: float = 2.0
 
     # --- observability ---
     event_log_enabled: bool = True

@@ -143,7 +143,7 @@ class ActorQueueState:
 
     __slots__ = ("actor_id", "seqno", "conn", "address", "state", "buffer",
                  "inflight", "resolving", "incarnation", "death_cause",
-                 "max_pending", "creation_arg_holds")
+                 "death_info", "max_pending", "creation_arg_holds")
 
     def __init__(self, actor_id: bytes):
         self.actor_id = actor_id
@@ -157,6 +157,10 @@ class ActorQueueState:
         self.resolving = False
         self.incarnation = -1
         self.death_cause = ""
+        # structured death cause from the GCS actor table (see
+        # exceptions.ActorDiedError.cause) — attached to every
+        # ActorDiedError this queue raises
+        self.death_info: dict = {}
         self.max_pending = -1
 
 
@@ -752,7 +756,8 @@ class CoreWorker:
         # Borrowed: ask the owner.
         owner = ref.owner_address or self.reference_counter.owner_address_of(oid)
         if not owner:
-            raise exc.ObjectLostError(oid.hex(), "no owner known")
+            raise exc.ObjectLostError(oid.hex(), "no owner known",
+                                      cause={"kind": "NO_OWNER"})
         try:
             conn = await self._get_owner_conn(owner)
             header, frames = await conn.call(
@@ -761,12 +766,14 @@ class CoreWorker:
                 timeout=timeout)
         except ConnectionError:
             raise exc.ObjectLostError(
-                oid.hex(), f"owner {owner} unreachable") from None
+                oid.hex(), f"owner {owner} unreachable",
+                cause={"kind": "OWNER_UNREACHABLE"}) from None
         except asyncio.TimeoutError:
             raise exc.GetTimeoutError(
                 f"get() timed out waiting for {oid.hex()}") from None
         if not header.get("found"):
-            raise exc.ObjectLostError(oid.hex(), "owner no longer has object")
+            raise exc.ObjectLostError(oid.hex(), "owner no longer has object",
+                                      cause={"kind": "OWNER_RELEASED"})
         if header.get("in_plasma"):
             return await self._get_from_plasma(oid, owner)
         obj = SerializedObject(header["metadata"], frames)
@@ -785,7 +792,8 @@ class CoreWorker:
                 recovered = await self._try_recover(oid)
                 if not recovered:
                     raise exc.ObjectLostError(
-                        oid.hex(), reply.get("reason", "pull failed"))
+                        oid.hex(), reply.get("reason", "pull failed"),
+                        cause={"kind": "PULL_FAILED"})
                 # The re-executed task may have returned the value (or an
                 # error object) inline this time — prefer the memory store
                 # over another plasma round trip.
@@ -796,7 +804,9 @@ class CoreWorker:
                     "EnsureObjectLocal",
                     {"object_id": oid.binary(), "owner_address": owner_address})
                 if not reply.get("segment"):
-                    raise exc.ObjectLostError(oid.hex(), "recovery failed")
+                    raise exc.ObjectLostError(oid.hex(), "recovery failed",
+                                              cause={"kind":
+                                                     "RECOVERY_FAILED"})
             att = await asyncio.get_running_loop().run_in_executor(
                 None, AttachedObject, reply["segment"])
             with self._attached_lock:
@@ -1204,7 +1214,8 @@ class CoreWorker:
                 if q.state == "DEAD":
                     self._store_error_for_task(
                         spec, exc.ActorDiedError(
-                            q.death_cause or "actor is dead"))
+                            q.death_cause or "actor is dead",
+                            cause=q.death_info))
                     continue
                 # Seqnos assigned in buffer order == submission order (the
                 # receiver executes strictly by seqno per caller).
@@ -1777,9 +1788,18 @@ class CoreWorker:
             # owner-observed failures (worker death, cancellation,
             # infeasibility, dead actor): the worker never ran the task,
             # so the terminal FAILED is stamped here
-            self.task_events.record(spec.task_id, FAILED, {
-                "reason": type(error).__name__,
-                "message": str(error)[:200]})
+            attrs = {"reason": type(error).__name__,
+                     "message": str(error)[:200]}
+            cause = getattr(error, "cause_info", None)
+            if cause:
+                # structured death cause (ActorDiedError /
+                # ObjectLostError): state.list_tasks() shows node death
+                # vs worker crash vs restarts-exhausted, with ids
+                attrs["cause"] = {k: cause[k] for k in
+                                  ("kind", "node_id", "worker_id",
+                                   "last_failure")
+                                  if cause.get(k)}
+            self.task_events.record(spec.task_id, FAILED, attrs)
         serialized = self.serialization_context.serialize_error(error)
         task_id = TaskID(spec.task_id)
         for i in range(spec.num_returns):
@@ -1930,7 +1950,8 @@ class CoreWorker:
         if q.state == "DEAD":
             for spec, _ in q.buffer:
                 self._store_error_for_task(
-                    spec, exc.ActorDiedError(q.death_cause or "actor is dead"))
+                    spec, exc.ActorDiedError(q.death_cause or "actor is dead",
+                                             cause=q.death_info))
             q.buffer.clear()
             return
         if q.conn is None or q.conn.closed:
@@ -1969,8 +1990,16 @@ class CoreWorker:
             lambda f, batch=batch: self._on_actor_batch_done(f, q, batch))
 
     async def _resolve_actor(self, q: ActorQueueState):
+        from ray_tpu._private import backoff as backoff_mod
+
         try:
             deadline = time.monotonic() + 120.0
+            # exponential-jitter retry pacing (backoff.py): starts at
+            # the old 0.05 s fast path, backs off toward the cap while
+            # the actor is restarting / the GCS is down — no more
+            # fixed-interval polling storms from every holder of a
+            # handle to a restarting actor
+            bo = backoff_mod.from_config(self.config)
             while time.monotonic() < deadline:
                 if q.conn is not None and not q.conn.closed and \
                         q.state == "ALIVE":
@@ -1984,10 +2013,10 @@ class CoreWorker:
                     reply, _ = await self._gcs_call(
                         "GetActorInfo", {"actor_id": q.actor_id})
                 except ConnectionError:
-                    await asyncio.sleep(0.5)  # GCS still down; keep trying
+                    await bo.sleep()  # GCS still down; keep trying
                     continue
                 if not reply.get("found"):
-                    await asyncio.sleep(0.05)
+                    await bo.sleep()
                     continue
                 if reply["state"] == "ALIVE" and \
                         reply["incarnation"] != q.incarnation:
@@ -1997,7 +2026,7 @@ class CoreWorker:
                             handlers={"ActorTaskResult":
                                       self._actor_result_handler(q)})
                     except ConnectionError:
-                        await asyncio.sleep(0.05)
+                        await bo.sleep()
                         continue
                     q.address = reply["address"]
                     q.state = "ALIVE"
@@ -2018,11 +2047,13 @@ class CoreWorker:
                 if reply["state"] == "DEAD":
                     q.state = "DEAD"
                     q.death_cause = reply.get("death_cause", "actor died")
+                    q.death_info = reply.get("death_info") or {}
                     self._pump_actor_queue(q)
                     return
-                await asyncio.sleep(0.05)
+                await bo.sleep()
             q.state = "DEAD"
             q.death_cause = "timed out resolving actor location"
+            q.death_info = {"kind": "RESOLVE_TIMEOUT"}
             self._pump_actor_queue(q)
         finally:
             q.resolving = False
@@ -2054,7 +2085,8 @@ class CoreWorker:
                 requeue.append((spec, seqno))
             else:
                 self._store_error_for_task(spec, exc.ActorDiedError(
-                    "actor worker died before the call completed"))
+                    "actor worker died before the call completed",
+                    cause=q.death_info or {"kind": "WORKER_DIED"}))
         q.buffer.extendleft(reversed(requeue))
         self._pump_actor_queue(q)
 
@@ -2194,6 +2226,7 @@ class CoreWorker:
             elif msg["state"] == "DEAD":
                 q.state = "DEAD"
                 q.death_cause = msg.get("reason", "actor died")
+                q.death_info = msg.get("death_info") or {}
                 self._pump_actor_queue(q)
             elif msg["state"] == "RESTARTING":
                 q.state = "RESOLVING"

@@ -50,7 +50,7 @@ from typing import Any, Awaitable, Callable, Deque, Dict, List, Optional
 
 import msgpack
 
-from ray_tpu._private import native
+from ray_tpu._private import faultpoints, native
 
 logger = logging.getLogger(__name__)
 
@@ -287,9 +287,10 @@ class DataPlaneServer:
         # server in the process; tests with several in-process raylets
         # need to tell them apart)
         self.num_chunks_served = 0
-        # Test hook: called with (object_id_bytes, offset, length) before
-        # each chunk is served (fault injection for mid-pull death tests).
-        self.on_serve: Optional[Callable[[bytes, int, int], None]] = None
+        # Fault injection rides the faultpoints registry (point
+        # "data.serve_chunk" — raise/hook/delay, plus the
+        # site-interpreted corrupt/short/miss/sever actions applied in
+        # _serve_chunk); the old ad-hoc ``on_serve`` callback is gone.
 
     async def start(self) -> str:
         loop = asyncio.get_running_loop()
@@ -335,11 +336,19 @@ class DataPlaneServer:
                 except (ConnectionError, OSError):
                     return  # peer closed / reset: normal stripe teardown
                 oid_b, offset, length = req
-                if self.on_serve is not None:
-                    self.on_serve(oid_b, offset, length)
+                fault = None
+                if faultpoints.armed:
+                    # raise/hook faults propagate (the serving conn
+                    # tears down exactly like a mid-serve crash);
+                    # corrupt/short/miss/sever are applied below
+                    fault = await faultpoints.async_fire(
+                        "data.serve_chunk", oid=oid_b, offset=offset,
+                        length=length, server=self.address)
+                    if fault == "sever":
+                        return  # finally closes the socket mid-exchange
                 try:
                     await self._serve_chunk(sock, oid_b, int(offset),
-                                            int(length))
+                                            int(length), fault=fault)
                 except (ConnectionError, OSError) as e:
                     # the puller hung up mid-serve (cancelled pull /
                     # raylet stop): routine teardown, not an error
@@ -357,10 +366,22 @@ class DataPlaneServer:
                 pass  # already torn down
 
     async def _serve_chunk(self, sock: socket.socket, oid_b: bytes,
-                           offset: int, length: int):
+                           offset: int, length: int,
+                           fault: Optional[str] = None):
         from ray_tpu._private.ids import ObjectID
 
         loop = asyncio.get_running_loop()
+        if fault == "corrupt":
+            # corrupt-frame fault: garbage where the response header
+            # belongs. The client's framing rejects it (length prefix
+            # over _MAX_REQUEST_BYTES) and retires the stripe — the
+            # deterministic stand-in for a peer scribbling the wire.
+            await loop.sock_sendall(sock, b"\xff" * 8)
+            return
+        if fault == "miss":
+            await loop.sock_sendall(sock,
+                                    _pack_frame([STATUS_NOT_FOUND, 0]))
+            return
         entry = self.store.entry(ObjectID(oid_b))
         if entry is None or offset < 0 or length < 0 \
                 or (entry is not None and offset > entry[1]):
@@ -379,6 +400,12 @@ class DataPlaneServer:
         self.store.mark_exposed(ObjectID(oid_b))
         end = min(offset + max(0, length), total)
         count = max(0, end - offset)
+        if fault == "short" and count > 1:
+            # short-read fault: a divergent replica promising (and
+            # sending) fewer bytes than the puller asked for — the
+            # client's exact-length check must reject the chunk
+            count //= 2
+            end = offset + count
         src = await self._source(name)
         if src is None:
             # segment vanished between lookup and open (freed mid-pull)
@@ -553,6 +580,11 @@ class DataChannelClient:
         self._closed = False
 
     async def _dial(self, timeout: float) -> socket.socket:
+        if faultpoints.armed:
+            # stripe-dial fault: arm with exc=ConnectionError(...) to
+            # model an unreachable/black-holed data port
+            await faultpoints.async_fire("data.stripe_dial",
+                                         address=self.address)
         host, _, port = self.address.rpartition(":")
         loop = asyncio.get_running_loop()
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -638,6 +670,12 @@ class DataChannelClient:
         loop = asyncio.get_running_loop()
         async with stripe.lock:
             try:
+                if faultpoints.armed:
+                    # puller-side fault seam: delay storms park here
+                    # (awaited, per chunk); raise retires this stripe
+                    # through the except below like any wire failure
+                    await faultpoints.async_fire(
+                        "data.fetch_chunk", offset=offset, length=length)
                 await loop.sock_sendall(
                     stripe.sock, _pack_frame([oid_b, offset, length]))
                 status, payload_len = await _recv_frame(stripe.sock,

@@ -1,0 +1,309 @@
+"""Deterministic fault-injection plane: named fault points + arming API.
+
+Every recovery path in this repo — task retries, actor restarts, GCS
+journal replay, stripe failover, heartbeat-driven node death — exists
+because some component can fail. This module is the process-wide
+registry that lets tests (and the seeded chaos scheduler in
+tests/chaos.py) MAKE those components fail, deterministically, at the
+exact seam that owns each failure domain.
+
+Design contract:
+
+* **Zero cost disarmed.** Wired sites guard with
+  ``if faultpoints.armed:`` — one module-attribute load and a falsy
+  check on the hot path; the registry itself is only consulted once a
+  test armed something. ``armed`` is False in production by default
+  and is pinned by bench.py's ``faultpoints_overhead`` row.
+* **Deterministic.** Probabilistic points draw from a per-point
+  ``random.Random(seed)``; hit counters are exact; the same arming +
+  the same workload fires the same faults in the same order.
+* **Cross-process.** Worker/raylet/GCS subprocesses arm themselves at
+  boot from the ``RAY_TPU_FAULTPOINTS`` env var (a JSON list of arm()
+  kwargs), so "kill the worker at its 3rd task" is a deterministic
+  schedule, not a SIGKILL race.
+
+Action vocabulary (``action=``):
+
+=============  ==============================================================
+``raise``      raise ``exc`` (default :class:`FaultInjected`) at the site
+``delay``      sleep ``delay_s`` (async sites await, sync sites block)
+``kill``       ``os._exit(kill_code)`` — hard process death at the site
+``hook``       call ``hook(**ctx)`` (may itself raise) — arbitrary injection
+``drop``       site-interpreted: the message/beat/reply is silently dropped
+``sever``      site-interpreted: the owning connection is torn down
+``duplicate``  site-interpreted: the message is sent twice
+``corrupt``    site-interpreted: the payload/frame is scribbled with garbage
+``short``      site-interpreted: fewer payload bytes than promised are sent
+``miss``       site-interpreted: the allocation/lookup reports not-found
+``refuse``     site-interpreted: the operation reports failure (e.g. seal)
+=============  ==============================================================
+
+Site-interpreted actions are returned from :func:`fire` as strings; the
+wired layer applies the ones it understands (unknown actions at a site
+are ignored — arming ``corrupt`` on a point that cannot corrupt is a
+no-op, never an error).
+
+Wired point catalogue (name — owning layer — ctx keys):
+
+* ``rpc.call.send``        — rpc.py client     — method, peer
+* ``rpc.reply.send``       — rpc.py server     — method, peer
+* ``data.serve_chunk``     — data_channel.py   — oid, offset, length
+* ``data.stripe_dial``     — data_channel.py   — address
+* ``data.fetch_chunk``     — data_channel.py   — offset, length
+* ``shm.alloc``            — shm_store.py      — size
+* ``shm.seal``             — shm_store.py      — oid, size
+* ``raylet.heartbeat``     — raylet.py         — node
+* ``raylet.lease.grant``   — raylet.py         — lease_id, node
+* ``gcs.journal.append``   — gcs.py            — op
+* ``gcs.journal.replay``   — gcs.py            — op, n
+* ``task.execute``         — task_executor.py  — name, task_id
+
+Match predicates (all optional, AND-combined):
+
+* ``nth=N``    fire only on the Nth matching hit (1-based)
+* ``every=K``  fire on every Kth matching hit
+* ``after=N``  fire on every matching hit past the first N
+* ``p=F``      fire with probability F per hit (seeded RNG)
+* ``times=N``  fire at most N times, then go dormant (still counted)
+* ``match={}`` ctx filter: key -> expected value, or key -> callable(v)
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+# THE hot-path guard: wired sites check ``faultpoints.armed`` before
+# touching anything else in this module. False = production default.
+armed = False
+
+ENV_VAR = "RAY_TPU_FAULTPOINTS"
+
+# Actions fully handled inside fire(); everything else is returned to
+# the wired site to interpret.
+_GENERIC_ACTIONS = ("raise", "delay", "kill", "hook")
+SITE_ACTIONS = ("drop", "sever", "duplicate", "corrupt", "short",
+                "miss", "refuse")
+
+
+class FaultInjected(RuntimeError):
+    """Default exception raised by an armed ``raise`` action."""
+
+
+class FaultPoint:
+    """One armed fault spec at a named point (a point may hold several,
+    e.g. a delay on Heartbeat and a raise on KVPut at the same site)."""
+
+    def __init__(self, name: str, action: str, *,
+                 exc: Optional[BaseException] = None,
+                 delay_s: float = 0.0,
+                 nth: int = 0, every: int = 0, after: int = 0,
+                 p: float = 0.0, seed: int = 0, times: int = 0,
+                 match: Optional[Dict[str, Any]] = None,
+                 hook: Optional[Callable[..., Any]] = None,
+                 kill_code: int = 1):
+        if action not in _GENERIC_ACTIONS and action not in SITE_ACTIONS:
+            raise ValueError(f"unknown fault action {action!r}")
+        if action == "hook" and hook is None:
+            raise ValueError("action='hook' requires hook=")
+        self.name = name
+        self.action = action
+        self.exc = exc
+        self.delay_s = delay_s
+        self.nth = nth
+        self.every = every
+        self.after = after
+        self.p = p
+        self.times = times
+        self.match = match or {}
+        self.hook = hook
+        self.kill_code = kill_code
+        self.hits = 0    # matching-context evaluations
+        self.fires = 0   # times the action actually triggered
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    def _evaluate(self, ctx: Dict[str, Any]) -> Optional[str]:
+        """Count a hit and decide whether this spec fires for ``ctx``.
+        Returns the action name, or None."""
+        for key, want in self.match.items():
+            got = ctx.get(key)
+            if callable(want):
+                if not want(got):
+                    return None
+            elif got != want:
+                return None
+        with self._lock:
+            self.hits += 1
+            if self.times and self.fires >= self.times:
+                return None
+            if self.nth and self.hits != self.nth:
+                return None
+            if self.after and self.hits <= self.after:
+                return None
+            if self.every and self.hits % self.every != 0:
+                return None
+            if self.p and self._rng.random() >= self.p:
+                return None
+            self.fires += 1
+        return self.action
+
+
+_registry_lock = threading.Lock()
+_points: Dict[str, List[FaultPoint]] = {}
+
+
+def arm(name: str, action: str = "raise", **kwargs) -> FaultPoint:
+    """Arm a fault spec at point ``name``; returns it (tests read
+    ``.hits``/``.fires``). Arming the same name again STACKS a second
+    spec — use :func:`disarm`/:func:`reset` between scenarios."""
+    global armed
+    spec = FaultPoint(name, action, **kwargs)
+    with _registry_lock:
+        _points.setdefault(name, []).append(spec)
+        armed = True
+    logger.info("faultpoint armed: %s action=%s", name, action)
+    return spec
+
+
+def disarm(name: str) -> None:
+    """Remove every spec armed at ``name``."""
+    global armed
+    with _registry_lock:
+        _points.pop(name, None)
+        if not _points:
+            armed = False
+
+
+def reset() -> None:
+    """Disarm everything (test teardown)."""
+    global armed
+    with _registry_lock:
+        _points.clear()
+        armed = False
+
+
+def specs(name: str) -> List[FaultPoint]:
+    return list(_points.get(name, ()))
+
+
+def hits(name: str) -> int:
+    return sum(s.hits for s in _points.get(name, ()))
+
+
+def fires(name: str) -> int:
+    return sum(s.fires for s in _points.get(name, ()))
+
+
+def _apply(spec: FaultPoint, ctx: Dict[str, Any]) -> Optional[str]:
+    """Execute a generic action inline; pass site actions back."""
+    if spec.action == "raise":
+        e = spec.exc if spec.exc is not None else FaultInjected(
+            f"fault injected at {spec.name}")
+        logger.info("faultpoint %s: raising %r", spec.name, e)
+        raise e
+    if spec.action == "kill":
+        logger.warning("faultpoint %s: killing process %d", spec.name,
+                       os.getpid())
+        try:
+            import sys
+            sys.stdout.flush()
+            sys.stderr.flush()
+        except Exception:  # raylint: disable=exception-hygiene — flush is best-effort on the way out of a deliberate kill
+            pass
+        os._exit(spec.kill_code)
+    if spec.action == "hook":
+        spec.hook(**ctx)
+        return None
+    if spec.action == "delay":
+        return "delay"  # caller decides blocking vs awaited sleep
+    return spec.action
+
+
+def _fired(point: str, ctx: Dict[str, Any]):
+    """Shared firing pass for :func:`fire`/:func:`async_fire`:
+    evaluate every spec at ``point`` against ``ctx`` and execute the
+    generic actions (raise/kill/hook inside :func:`_apply`). Yields
+    ``(spec, applied)`` for the actions the CALLER must finish —
+    ``"delay"`` (blocking vs awaited sleep is the only difference
+    between the two entry points) and the site-interpreted names."""
+    point_specs = _points.get(point)
+    if not point_specs:
+        return
+    for spec in list(point_specs):
+        if spec._evaluate(ctx) is None:
+            continue
+        applied = _apply(spec, ctx)
+        if applied is not None:
+            yield spec, applied
+
+
+def fire(point: str, **ctx) -> Optional[str]:
+    """Evaluate fault point ``point`` (sync sites). Generic actions
+    execute inline (``delay`` blocks the calling thread — wire async
+    sites through :func:`async_fire` instead); the last matching
+    site-interpreted action is returned, else None. The positional
+    parameter is named ``point`` so ctx keys like ``name=`` never
+    collide."""
+    out = None
+    for spec, applied in _fired(point, ctx):
+        if applied == "delay":
+            # raylint: disable=async-blocking — injected delay IS the fault: a sync site sleeps here by design; loop-hosted sites must wire async_fire instead
+            time.sleep(spec.delay_s)
+        else:
+            out = applied
+    return out
+
+
+async def async_fire(point: str, **ctx) -> Optional[str]:
+    """:func:`fire` for event-loop sites: ``delay`` awaits instead of
+    blocking the loop."""
+    import asyncio
+
+    out = None
+    for spec, applied in _fired(point, ctx):
+        if applied == "delay":
+            await asyncio.sleep(spec.delay_s)
+        else:
+            out = applied
+    return out
+
+
+def arm_from_env(environ=None) -> int:
+    """Arm points from the ``RAY_TPU_FAULTPOINTS`` env var — a JSON
+    list of ``arm()`` kwarg dicts, e.g.::
+
+        [{"name": "task.execute", "action": "kill", "nth": 3}]
+
+    Called at worker/raylet/GCS subprocess boot so chaos schedules
+    reach processes the test did not construct directly. Unknown or
+    malformed specs are logged and skipped (a typo in a chaos schedule
+    must not take down the process it was meant to test). Returns the
+    number of points armed."""
+    raw = (environ or os.environ).get(ENV_VAR, "")
+    if not raw:
+        return 0
+    try:
+        entries = json.loads(raw)
+    except ValueError:
+        logger.error("malformed %s (not JSON): %r", ENV_VAR, raw[:200])
+        return 0
+    n = 0
+    for entry in entries if isinstance(entries, list) else []:
+        try:
+            kwargs = dict(entry)
+            name = kwargs.pop("name")
+            action = kwargs.pop("action", "raise")
+            arm(name, action, **kwargs)
+            n += 1
+        except Exception:  # noqa: BLE001 — a bad spec is skipped (and logged), never fatal
+            logger.exception("bad faultpoint spec in %s: %r", ENV_VAR,
+                             entry)
+    return n

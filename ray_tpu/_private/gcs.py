@@ -21,7 +21,7 @@ import logging
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from ray_tpu._private import rpc
+from ray_tpu._private import faultpoints, rpc
 from ray_tpu._private.config import RayTpuConfig
 from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID
 from ray_tpu._private.task_events import TaskEventTable
@@ -185,6 +185,11 @@ class ActorEntry:
         self.address = ""          # actor worker's RPC address once alive
         self.node_id = b""
         self.death_cause = ""
+        # Structured death cause (see exceptions.ActorDiedError.cause):
+        # {"kind", "message", "node_id", "worker_id", "restarts",
+        #  "max_restarts", "last_failure"} — journaled with the actor so
+        # post-restart lookups still explain the death.
+        self.death_info: dict = {}
         self.incarnation = 0
 
 
@@ -428,6 +433,7 @@ class GcsServer:
                 "address": a.address,
                 "num_restarts": a.num_restarts,
                 "max_restarts": a.max_restarts,
+                "death_kind": a.death_info.get("kind", ""),
                 "job_id": a.job_id.hex() if a.job_id else "",
             } for a in self.actors.values()])
         if route == "/api/jobs":
@@ -592,6 +598,12 @@ class GcsServer:
     def _journal_append(self, op: str, payload):
         if self.journal is not None:
             self.journal.append(op, payload)
+            if faultpoints.armed:
+                # crash window: the record is durable but the client's
+                # reply is not out yet — a ``kill`` here is the
+                # canonical "did my mutation land?" failure; client
+                # retries must be idempotent against the replayed state
+                faultpoints.fire("gcs.journal.append", op=op)
             if self.journal.size() > self.JOURNAL_COMPACT_BYTES:
                 self._compact_journal()
 
@@ -617,7 +629,8 @@ class GcsServer:
                 "incarnation": actor.incarnation,
                 "num_restarts": actor.num_restarts,
                 "max_restarts": actor.max_restarts,
-                "death_cause": actor.death_cause}))
+                "death_cause": actor.death_cause,
+                "death_info": actor.death_info}))
         for pg_id, record in self.placement_groups.items():
             records.append(("pg_upsert", {"pg_id": pg_id, "record": record}))
         return records
@@ -639,6 +652,7 @@ class GcsServer:
             "num_restarts": actor.num_restarts,
             "max_restarts": actor.max_restarts,
             "death_cause": actor.death_cause,
+            "death_info": actor.death_info,
         })
 
     def _replay_journal(self, path: str):
@@ -651,6 +665,12 @@ class GcsServer:
         max_job = 0
         for op, p in gcs_storage.replay(path):
             n += 1
+            if faultpoints.armed:
+                # replay-time crash window: a GCS that dies mid-replay
+                # must come back to a consistent (prefix) state on the
+                # next boot — the journal is append-only, so any prefix
+                # is valid
+                faultpoints.fire("gcs.journal.replay", op=op, n=n)
             if op == "job_add":
                 self.jobs[p["job_id"]] = p["record"]
                 max_job = max(max_job, p.get("job_num", 0))
@@ -700,6 +720,7 @@ class GcsServer:
                     actor.num_restarts = p["num_restarts"]
                     actor.max_restarts = p["max_restarts"]
                     actor.death_cause = p["death_cause"]
+                    actor.death_info = p.get("death_info") or {}
             elif op == "pg_upsert":
                 self.placement_groups[p["pg_id"]] = p["record"]
             elif op == "pg_remove":
@@ -753,26 +774,51 @@ class GcsServer:
         entry.conn = conn
         self.nodes[entry.node_id] = entry
         conn.tags["node_id"] = entry.node_id
-        conn.on_disconnect.append(
-            lambda c: asyncio.get_event_loop().create_task(
-                self._on_node_connection_lost(entry.node_id)))
+        # ONE disconnect callback per connection, reading the LATEST
+        # entry off the tags: a flapping node re-registers over the
+        # same live conn (the dead-node heartbeat reply forces it), and
+        # appending a closure per registration would grow the list —
+        # and retain every stale NodeEntry — without bound.
+        conn.tags["node_entry"] = entry
+        if not conn.tags.get("node_death_cb_armed"):
+            conn.tags["node_death_cb_armed"] = True
+
+            def _on_drop(c):
+                e = c.tags.get("node_entry")
+                if e is not None:
+                    asyncio.get_event_loop().create_task(
+                        self._on_node_connection_lost(e))
+
+            conn.on_disconnect.append(_on_drop)
         await self._publish("NODE", self._node_alive_msg(entry))
         return {"ok": True, "num_nodes": len(self.nodes)}
 
     async def handle_heartbeat(self, conn, header, bufs):
+        # Piggybacked task-lifecycle events ingest FIRST: the raylet
+        # drained its buffer irreversibly before this call, so an
+        # early ok=False return (unknown node after a GCS restart /
+        # dead node forcing re-registration) must not silently discard
+        # the batch — the table keys by task, not node, and "honest
+        # truncation everywhere" is the series contract.
+        if header.get("task_events") or header.get("task_events_dropped"):
+            self.task_events.ingest(header.get("task_events") or (),
+                                    header.get("task_events_dropped", 0))
         entry = self.nodes.get(header["node_id"])
         if entry is None:
             return {"ok": False, "reason": "unknown node"}
+        if not entry.alive:
+            # The node was declared dead (heartbeat partition) but its
+            # raylet is clearly alive: force a re-registration instead
+            # of silently feeding a dead entry — beats into a dead node
+            # would otherwise keep it invisible to scheduling FOREVER
+            # while the raylet believes everything is fine (chaos soak
+            # finding: heartbeat_partition schedule).
+            return {"ok": False, "reason": "node marked dead"}
         entry.last_heartbeat = time.time()
         if "resources_available" in header:
             entry.resources_available = header["resources_available"]
         if "stats" in header:
             entry.stats = header["stats"]
-        # Piggybacked task-lifecycle events (lease queue/grant/spillback
-        # + data-plane transfers) — the raylet never pays a separate RPC.
-        if header.get("task_events") or header.get("task_events_dropped"):
-            self.task_events.ingest(header.get("task_events") or (),
-                                    header.get("task_events_dropped", 0))
         # Standalone raylet processes ship their metric registry here
         # (no CoreWorker reporter in-process; see metrics.core_reporter).
         if header.get("metrics"):
@@ -814,8 +860,15 @@ class GcsServer:
         await self._mark_node_dead(header["node_id"], "drained")
         return {"ok": True}
 
-    async def _on_node_connection_lost(self, node_id: bytes):
-        await self._mark_node_dead(node_id, "connection lost")
+    async def _on_node_connection_lost(self, entry: NodeEntry):
+        if self.nodes.get(entry.node_id) is not entry:
+            # A stale connection's teardown racing a re-registration
+            # (partition recovery / reconnect): the node table already
+            # holds a FRESH entry for this node — marking it dead here
+            # would kill a live node on the old socket's word (chaos
+            # soak finding: gcs_restart + heartbeat_partition mix).
+            return
+        await self._mark_node_dead(entry.node_id, "connection lost")
 
     async def _mark_node_dead(self, node_id: bytes, reason: str):
         entry = self.nodes.get(node_id)
@@ -830,7 +883,10 @@ class GcsServer:
         # GcsActorManager::OnNodeDead).
         for actor in list(self.actors.values()):
             if actor.node_id == node_id and actor.state == ACTOR_ALIVE:
-                await self._on_actor_failure(actor, f"node died: {reason}")
+                await self._on_actor_failure(
+                    actor, f"node died: {reason}",
+                    cause={"kind": "NODE_DIED",
+                           "node_id": node_id.hex()})
 
     async def _liveness_monitor(self):
         period = self.config.raylet_heartbeat_period_ms / 1000.0
@@ -940,7 +996,8 @@ class GcsServer:
                 except ConnectionError:
                     pass
             await asyncio.sleep(0.2)
-        await self._fail_actor(actor, "no feasible node for actor")
+        await self._fail_actor(actor, "no feasible node for actor",
+                               cause={"kind": "SCHEDULING_FAILED"})
 
     async def handle_report_actor_alive(self, conn, header, bufs):
         actor = self.actors.get(header["actor_id"])
@@ -968,10 +1025,18 @@ class GcsServer:
         if header.get("expected"):
             # Graceful exit (actor_exit / job teardown): no restart.
             actor.max_restarts = actor.num_restarts
-        await self._on_actor_failure(actor, header.get("reason", "worker died"))
+        cause = header.get("cause") or {}
+        if not cause.get("kind"):
+            cause = dict(cause)
+            cause["kind"] = "ACTOR_EXITED" if header.get("expected") \
+                else "WORKER_DIED"
+        await self._on_actor_failure(actor,
+                                     header.get("reason", "worker died"),
+                                     cause=cause)
         return {"ok": True}
 
-    async def _on_actor_failure(self, actor: ActorEntry, reason: str):
+    async def _on_actor_failure(self, actor: ActorEntry, reason: str,
+                                cause: Optional[dict] = None):
         if actor.state == ACTOR_DEAD:
             return
         if actor.state == ACTOR_RESTARTING:
@@ -990,14 +1055,43 @@ class GcsServer:
                         "inf" if actor.max_restarts == -1 else actor.max_restarts)
             asyncio.get_running_loop().create_task(self._schedule_actor(actor))
         else:
-            await self._fail_actor(actor, reason)
+            cause = dict(cause or {})
+            kind = cause.get("kind") or "WORKER_DIED"
+            if actor.max_restarts > 0 and kind in ("WORKER_DIED",
+                                                   "NODE_DIED"):
+                # the actor HAD a restart budget and an INVOLUNTARY
+                # failure burnt the last of it: the headline cause is
+                # exhaustion, the final failure rides along so
+                # operators still see what kept killing it. Voluntary
+                # ends (ACTOR_EXITED / KILLED / CREATION_FAILED) keep
+                # their own kind — a graceful exit after a past restart
+                # is not "restarts exhausted".
+                exhausted = {"kind": "RESTARTS_EXHAUSTED",
+                             "last_failure": kind}
+                for key in ("node_id", "worker_id"):
+                    # only truthy ids: an empty placeholder would block
+                    # _fail_actor's setdefault from filling the known id
+                    if cause.get(key):
+                        exhausted[key] = cause[key]
+                cause = exhausted
+            await self._fail_actor(actor, reason, cause)
 
-    async def _fail_actor(self, actor: ActorEntry, reason: str):
+    async def _fail_actor(self, actor: ActorEntry, reason: str,
+                          cause: Optional[dict] = None):
         actor.state = ACTOR_DEAD
         actor.death_cause = reason
+        info = dict(cause or {})
+        info.setdefault("kind", "WORKER_DIED")
+        info.setdefault("node_id",
+                        actor.node_id.hex() if actor.node_id else "")
+        info["message"] = reason
+        info["restarts"] = actor.num_restarts
+        info["max_restarts"] = actor.max_restarts
+        actor.death_info = info
         self._journal_actor(actor)
         await self._publish("ACTOR", {
             "actor_id": actor.actor_id, "state": ACTOR_DEAD, "reason": reason,
+            "death_info": info,
             "incarnation": actor.incarnation})
 
     async def handle_get_actor_info(self, conn, header, bufs):
@@ -1006,7 +1100,8 @@ class GcsServer:
             return {"found": False}
         return {"found": True, "state": actor.state, "address": actor.address,
                 "name": actor.name, "incarnation": actor.incarnation,
-                "death_cause": actor.death_cause, "node_id": actor.node_id}
+                "death_cause": actor.death_cause,
+                "death_info": actor.death_info, "node_id": actor.node_id}
 
     async def handle_get_named_actor(self, conn, header, bufs):
         key = (header.get("namespace") or "", header["name"])
@@ -1051,9 +1146,11 @@ class GcsServer:
         # fail outright, or go through the restart path when allowed.
         if actor.state != ACTOR_DEAD:
             if no_restart:
-                await self._fail_actor(actor, "killed via KillActor")
+                await self._fail_actor(actor, "killed via KillActor",
+                                       cause={"kind": "KILLED"})
             else:
-                await self._on_actor_failure(actor, "killed via KillActor")
+                await self._on_actor_failure(actor, "killed via KillActor",
+                                             cause={"kind": "KILLED"})
         return {"ok": True}
 
     # --------------------------------------------------------------- jobs

@@ -144,6 +144,10 @@ def main(argv=None):
     args = parser.parse_args(argv)
 
     logging.basicConfig(level="INFO")
+    # Deterministic fault schedules reach standalone GCS/raylet
+    # processes through the environment (chaos + fault-tolerance tests).
+    from ray_tpu._private import faultpoints
+    faultpoints.arm_from_env()
     resources = {}
     if args.resources:
         for kv in args.resources.split(","):

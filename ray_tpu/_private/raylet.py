@@ -39,6 +39,8 @@ import sys
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from ray_tpu._private import backoff as backoff_mod
+from ray_tpu._private import faultpoints
 from ray_tpu._private import rpc
 from ray_tpu._private import runtime_env as runtime_env_mod
 from ray_tpu._private.config import RayTpuConfig
@@ -414,6 +416,18 @@ class Raylet:
         period = self.config.raylet_heartbeat_period_ms / 1000.0
         while not self._closing:
             try:
+                if faultpoints.armed:
+                    # heartbeat-partition fault: ``drop`` suppresses the
+                    # beat (fired BEFORE the event drain, so no task
+                    # events are lost to a skipped beat); enough
+                    # consecutive drops make the GCS declare this node
+                    # dead — the re-registration path below must then
+                    # resurrect it once beats resume.
+                    act = await faultpoints.async_fire(
+                        "raylet.heartbeat", node=self._nid12)
+                    if act == "drop":
+                        await asyncio.sleep(period)
+                        continue
                 hdr = {
                     "node_id": self.node_id.binary(),
                     "resources_available": self.resources_available,
@@ -464,9 +478,13 @@ class Raylet:
     async def _reconnect_gcs(self) -> bool:
         """Dial the (restarting) GCS until it answers, then re-register
         (reference: gcs_server_address_updater + raylet re-registration
-        on GCS failover)."""
-        deadline = time.time() + self.config.gcs_reconnect_timeout_s
-        while not self._closing and time.time() < deadline:
+        on GCS failover). Redials back off exponentially with jitter
+        (backoff.py) instead of the old fixed 0.2 s spin — a cluster of
+        raylets must not stampede a GCS mid-journal-replay in
+        lockstep."""
+        bo = backoff_mod.from_config(
+            self.config, deadline_s=self.config.gcs_reconnect_timeout_s)
+        while not self._closing and not bo.expired():
             try:
                 conn = await rpc.connect(
                     self.gcs_address, handlers=self._handlers(),
@@ -477,7 +495,7 @@ class Raylet:
                             self.node_id.hex()[:8])
                 return True
             except ConnectionError:
-                await asyncio.sleep(0.2)
+                await bo.sleep()
         return False
 
     async def handle_published(self, conn, header, bufs):
@@ -608,6 +626,9 @@ class Raylet:
                     await self.gcs_conn.call("ReportActorDeath", {
                         "actor_id": handle.actor_id,
                         "reason": "worker process died",
+                        "cause": {"kind": "WORKER_DIED",
+                                  "node_id": self.node_id.hex(),
+                                  "worker_id": worker_id.hex()},
                         "expected": False})
                 except ConnectionError:
                     pass
@@ -816,10 +837,28 @@ class Raylet:
         self._watch_lease_client(lease)
         self.num_leases_granted += 1
         self._note_lease_granted(req, worker)
+        if faultpoints.armed and self._fault_lease_grant(lease):
+            return
         fut.set_result(({"granted": True, "lease_id": lease_id,
                          "worker_address": worker.address,
                          "worker_id": worker.worker_id,
                          "node_id": self.node_id.binary()}, ()))
+
+    def _fault_lease_grant(self, lease: LeaseEntry) -> bool:
+        """Lease-grant crash window (point ``raylet.lease.grant``):
+        the lease is fully booked but the reply never reaches the
+        client. ``sever`` closes the client's connection — the
+        owner-liveness watch must then reclaim the worker and the
+        resources; ``kill``/``raise`` execute inside fire(). Returns
+        True when the grant reply must not be sent."""
+        act = faultpoints.fire("raylet.lease.grant",
+                               lease_id=lease.lease_id, node=self._nid12)
+        if act == "sever" and lease.client is not None:
+            lease.client._mark_closed()
+            return True
+        if act == "drop":
+            return True
+        return False
 
     def _note_lease_granted(self, req, worker: WorkerHandle) -> None:
         if self.task_events.enabled and req.task_id:
@@ -859,6 +898,8 @@ class Raylet:
         self._watch_lease_client(lease)
         self.num_leases_granted += 1
         self._note_lease_granted(req, worker)
+        if faultpoints.armed and self._fault_lease_grant(lease):
+            return
         fut.set_result(({"granted": True, "lease_id": lease_id,
                          "worker_address": worker.address,
                          "worker_id": worker.worker_id,
@@ -978,6 +1019,9 @@ class Raylet:
             await self.gcs_conn.call("ReportActorDeath", {
                 "actor_id": header["actor_id"],
                 "reason": reply.get("error", "actor constructor failed"),
+                "cause": {"kind": "CREATION_FAILED",
+                          "node_id": self.node_id.hex(),
+                          "worker_id": worker.worker_id.hex()},
                 "expected": True})
             return {"ok": True}
         alive_reply, _ = await self.gcs_conn.call("ReportActorAlive", {
@@ -1223,17 +1267,26 @@ class Raylet:
 
     async def _pull_object(self, oid: ObjectID, owner_address: str) -> dict:
         reason = "object not found at any location"
-        for round_no in range(2):
+        attempts = max(0, self.config.pull_location_refresh_attempts)
+        # floor at 1 ms: pull_location_refresh_backoff_s = 0 ("refresh
+        # immediately") was valid before the backoff policy and must
+        # stay valid — Backoff itself rejects a non-positive base
+        base = max(self.config.pull_location_refresh_backoff_s, 1e-3)
+        bo = backoff_mod.Backoff(
+            base_s=base,
+            cap_s=max(self.config.retry_backoff_cap_s, base),
+            multiplier=self.config.retry_backoff_multiplier)
+        for round_no in range(1 + attempts):
             if round_no:
                 if not owner_address:
                     break  # nobody to re-ask for locations
                 # Every known location failed (peer death / replica
                 # freed mid-pull). Refresh the owner's location index
-                # ONCE after a short backoff: a replica added meanwhile
-                # (e.g. by a concurrent pull elsewhere) is found
-                # instead of erroring the get.
-                await asyncio.sleep(
-                    self.config.pull_location_refresh_backoff_s)
+                # after a backoff (exponential-jitter across rounds,
+                # pull_location_refresh_attempts of them): a replica
+                # added meanwhile (e.g. by a concurrent pull elsewhere)
+                # is found instead of erroring the get.
+                await bo.sleep()
             locations = await self._query_locations(oid, owner_address)
             sources = await self._pull_sources(locations)
             if not sources:

@@ -29,6 +29,8 @@ from typing import Any, Awaitable, Callable, Dict, List, Optional, Sequence, Tup
 import cloudpickle
 import msgpack
 
+from ray_tpu._private import faultpoints
+
 logger = logging.getLogger(__name__)
 
 
@@ -234,6 +236,25 @@ class Connection:
         fut = asyncio.get_running_loop().create_future()
         self._pending[seq] = fut
         fut.add_done_callback(lambda f: self._pending.pop(seq, None))
+        if faultpoints.armed:
+            # fault plane: a dropped request is never written (the
+            # caller's timeout governs), a duplicated one is written
+            # twice (handler idempotence probe), a severed connection
+            # fails every pending future right here. NOTE: this is a
+            # sync seam on the loop thread, so an armed ``delay``
+            # blocks the WHOLE loop — deliberately: it models loop
+            # occupancy/GIL stalls (the failure mode the heartbeat
+            # timeout was widened for), not per-message latency.
+            act = faultpoints.fire("rpc.call.send", method=method,
+                                   peer=self.peer_name)
+            if act == "drop":
+                return fut
+            if act == "sever":
+                self._mark_closed()
+                return fut
+            if act == "duplicate":
+                self._write_nowait(
+                    _pack_msg(KIND_REQUEST, seq, method, header, bufs))
         self._write_nowait(_pack_msg(KIND_REQUEST, seq, method, header, bufs))
         return fut
 
@@ -333,10 +354,30 @@ class Connection:
             rheader, rbufs = result
         else:
             rheader, rbufs = result, ()
+        if faultpoints.armed and self._fault_reply(method):
+            return
         try:
             self._write_nowait(_pack_msg(KIND_REPLY, seq, method, rheader, rbufs))
         except (ConnectionError, OSError):
             self._mark_closed()
+
+    def _fault_reply(self, method: str) -> bool:
+        """Server-side reply fault seam (both the sync fast path and
+        the task-wrapped path route through here): True = the reply
+        must NOT be sent. ``drop`` loses only the reply — the handler
+        already ran, so the caller's retry probes idempotence; ``sever``
+        tears the connection down mid-reply (the reference failure for
+        "did my mutation land?" client logic). Sync seam on the loop
+        thread: an armed ``delay`` here stalls the whole loop by
+        design (loop-occupancy fault), like ``rpc.call.send``."""
+        act = faultpoints.fire("rpc.reply.send", method=method,
+                               peer=self.peer_name)
+        if act == "drop":
+            return True
+        if act == "sever":
+            self._mark_closed()
+            return True
+        return False
 
     def _reply_error_nowait(self, seq: int, method: str, e: BaseException):
         try:
@@ -390,6 +431,8 @@ class Connection:
                 rheader, rbufs = result
             else:
                 rheader, rbufs = result, ()
+            if faultpoints.armed and self._fault_reply(method):
+                return
             await self._send(_pack_msg(KIND_REPLY, seq, method, rheader, rbufs))
         except (ConnectionError, OSError):
             self._mark_closed()
@@ -432,6 +475,16 @@ class Connection:
 
     async def close(self):
         self._mark_closed()
+        # Reap the recv loop on an EXTERNAL close: the transport
+        # teardown delivers it EOF eventually, but a loop shutting down
+        # right after close() (chaos teardown, tests) would otherwise
+        # destroy a still-pending task and log noise. The loop's own
+        # finally path never reaches here (it IS the current task).
+        task = self._recv_task
+        if task is not None and not task.done() and \
+                task is not asyncio.current_task():
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
 
 
 class RpcServer:

@@ -39,7 +39,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import msgpack
 
-from ray_tpu._private import native
+from ray_tpu._private import faultpoints, native
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.serialization import SerializedObject
 
@@ -663,6 +663,12 @@ class ShmStoreServer:
         """Lease a parked segment whose file can hold ``size`` bytes
         (bounded slack so a huge segment is never burned on a small
         object). Returns (name, file_size) or None."""
+        if faultpoints.armed and \
+                faultpoints.fire("shm.alloc", size=size) == "miss":
+            # alloc fault: the pool reports empty — callers must fall
+            # back to a fresh segment exactly as on a real miss
+            self.num_recycle_misses += 1
+            return None
         now = time.time()
         for name, (fsize, ts) in list(self._lent.items()):
             # Generous horizon: a live-but-slow writer (multi-GiB fill
@@ -737,6 +743,13 @@ class ShmStoreServer:
         return freed
 
     def seal(self, object_id: ObjectID, segment_name: str, size: int) -> bool:
+        if faultpoints.armed and faultpoints.fire(
+                "shm.seal", oid=object_id.hex(), size=size) == "refuse":
+            # seal fault: the store refuses the segment (capacity-style
+            # failure) — the writer's abort/error path must run
+            self._lent.pop(segment_name, None)
+            self._unlink(segment_name)
+            return False
         self._lent.pop(segment_name, None)
         if os.path.isdir("/dev/shm") and \
                 not os.path.exists(f"/dev/shm/{segment_name}"):

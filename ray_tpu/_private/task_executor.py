@@ -25,7 +25,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu import exceptions as exc
-from ray_tpu._private import rpc
+from ray_tpu._private import faultpoints, rpc
 from ray_tpu._private import runtime_env as runtime_env_mod
 from ray_tpu._private.core_worker import CoreWorker
 from ray_tpu._private.ids import ObjectID, TaskID
@@ -477,6 +477,15 @@ class TaskExecutor:
             ev.record(spec.task_id, RUNNING,
                       {"name": spec.name, "worker": self._wid12})
         try:
+            if faultpoints.armed:
+                # worker-death fault seam (armed via RAY_TPU_FAULTPOINTS
+                # in the spawning test's env): ``kill`` here IS the
+                # deterministic "worker dies at its Nth task"; ``raise``
+                # is an injected application error (retry_exceptions
+                # path). Fired after RUNNING so the task-event history
+                # shows the death honestly.
+                faultpoints.fire("task.execute", name=spec.name,
+                                 task_id=spec.task_id.hex())
             fn = core.function_manager.fetch(spec.fn_key)
             args, kwargs = self._resolve_args(spec) if spec.args \
                 else ((), {})

@@ -42,7 +42,7 @@ def main(argv=None):
     if dump_s > 0:
         faulthandler.dump_traceback_later(dump_s, repeat=True)
 
-    from ray_tpu._private import native, rpc
+    from ray_tpu._private import faultpoints, native, rpc
     from ray_tpu._private.config import RayTpuConfig, set_config
     from ray_tpu._private.core_worker import CoreWorker
     from ray_tpu._private.task_executor import TaskExecutor
@@ -54,6 +54,10 @@ def main(argv=None):
     # async-blocking finding), so the one place that may pay the
     # compiler is process boot.
     native.load_fastpath()
+    # Deterministic fault schedules (e.g. "die at the 3rd task") are
+    # armed from the spawning test's environment — a seeded plan, not a
+    # SIGKILL race.
+    faultpoints.arm_from_env()
 
     loop = asyncio.new_event_loop()
     asyncio.set_event_loop(loop)

@@ -62,13 +62,45 @@ class WorkerCrashedError(RayTpuError):
     """The worker process executing the task died unexpectedly."""
 
 
+def _format_cause(cause: dict) -> str:
+    """Render a structured death cause for the message tail:
+    ``[WORKER_DIED node=ab12cd worker=ef34..]``."""
+    if not cause:
+        return ""
+    parts = [str(cause.get("kind", "UNKNOWN"))]
+    for key, label in (("node_id", "node"), ("worker_id", "worker"),
+                       ("last_failure", "after"), ("restarts", "restarts")):
+        v = cause.get(key)
+        if v not in (None, "", 0) or (key == "restarts" and v == 0 and
+                                      cause.get("kind") ==
+                                      "RESTARTS_EXHAUSTED"):
+            parts.append(f"{label}={v}")
+    return " [" + " ".join(parts) + "]"
+
+
 class ActorDiedError(RayTpuError):
     """The actor is dead: creation failed, it exhausted restarts, or its
-    node/worker died and max_restarts was 0."""
+    node/worker died and max_restarts was 0.
 
-    def __init__(self, reason: str = "actor died"):
+    ``cause`` is the structured death cause recorded by the GCS actor
+    table (and stamped into the task-event FAILED record shown by
+    ``ray_tpu.state.list_tasks()``)::
+
+        {"kind": "NODE_DIED" | "WORKER_DIED" | "RESTARTS_EXHAUSTED"
+                 | "CREATION_FAILED" | "ACTOR_EXITED" | "KILLED",
+         "node_id": hex, "worker_id": hex, "message": str,
+         "restarts": int, "max_restarts": int,
+         "last_failure": str}   # RESTARTS_EXHAUSTED: the final straw
+    """
+
+    def __init__(self, reason: str = "actor died", cause: dict | None = None):
         self.reason = reason
-        super().__init__(reason)
+        self.cause_info = dict(cause or {})
+        super().__init__(reason + _format_cause(self.cause_info))
+
+    @property
+    def cause_kind(self) -> str:
+        return str(self.cause_info.get("kind", ""))
 
 
 # Alias matching the reference's name.
@@ -77,12 +109,23 @@ RayActorError = ActorDiedError
 
 class ObjectLostError(RayTpuError):
     """All copies of the object were lost and reconstruction failed or was
-    disabled."""
+    disabled.
 
-    def __init__(self, object_id_hex: str = "", reason: str = ""):
+    ``cause`` mirrors :class:`ActorDiedError`'s structured death cause,
+    with object-plane kinds: ``NO_OWNER`` / ``OWNER_UNREACHABLE`` /
+    ``OWNER_RELEASED`` / ``PULL_FAILED`` / ``RECOVERY_FAILED``."""
+
+    def __init__(self, object_id_hex: str = "", reason: str = "",
+                 cause: dict | None = None):
         self.object_id_hex = object_id_hex
         self.reason = reason
-        super().__init__(f"object {object_id_hex} lost: {reason}")
+        self.cause_info = dict(cause or {})
+        super().__init__(f"object {object_id_hex} lost: {reason}"
+                         + _format_cause(self.cause_info))
+
+    @property
+    def cause_kind(self) -> str:
+        return str(self.cause_info.get("kind", ""))
 
 
 class ObjectStoreFullError(RayTpuError):

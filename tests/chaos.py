@@ -1,0 +1,504 @@
+"""Seeded chaos scheduler: randomized fault schedules over a live cluster.
+
+The fault-injection plane (ray_tpu/_private/faultpoints.py) makes every
+failure domain injectable; this module drives it with SEEDED schedules
+so "the cluster survives chaos" is a deterministic, replayable test
+instead of a flaky SIGKILL race:
+
+* :func:`make_schedule` expands ``(kind, seed)`` into an explicit event
+  list — same seed, byte-identical schedule, always. A failing run is
+  replayed by its seed alone.
+* :class:`DataPlaneChaos` runs an IN-PROCESS GCS + N raylets (no worker
+  subprocesses — the same harness shape as test_data_channel) through a
+  schedule while a workload of seals, cross-node pulls and frees runs.
+  Covers: stripe sever, corrupt chunk, short read, delay storm, raylet
+  crash, heartbeat partition, GCS restart, and the mixed schedule.
+* :func:`run_task_schedule` boots a REAL cluster (``ray_tpu.init`` +
+  worker subprocesses) and soaks the task/actor retry machinery under
+  deterministic worker deaths (``task.execute`` kill faults armed
+  through the environment).
+
+Global invariants asserted after every event and at the end of every
+schedule (the acceptance bar for all recovery paths):
+
+1. no pull/get hangs past its bound — it returns or raises typed;
+2. pull-admission budgets return to zero;
+3. no leaked segment leases (``store._lent`` drains);
+4. chaos-created shm segments are unlinked by teardown;
+5. the process fd count returns to its pre-run level (small slack);
+6. (task soak) the task-event table records an honest FAILED/RETRY
+   history for every disrupted task.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu._private import data_channel, faultpoints, rpc
+from ray_tpu._private.config import RayTpuConfig
+from ray_tpu._private.gcs import GcsServer
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.raylet import Raylet
+from ray_tpu._private.serialization import SerializationContext
+from ray_tpu._private.shm_store import AttachedObject, write_segment
+
+# One pull may ride out a heartbeat partition + a location-refresh
+# backoff round on a loaded 2-core CI box; anything past this bound is
+# a hang, which is exactly what the soak exists to catch.
+PULL_BOUND_S = 30.0
+
+CHAOS_CFG = {
+    "num_prestart_workers": 0,
+    "event_log_enabled": False,
+    "object_manager_chunk_size": 65536,
+    "data_plane_stripes": 2,
+    "object_store_memory": 128 * 1024 * 1024,
+    "pull_location_refresh_backoff_s": 0.05,
+    "retry_backoff_base_s": 0.02,
+    "retry_backoff_cap_s": 0.25,
+    "rpc_connect_timeout_s": 1.0,
+    "raylet_heartbeat_period_ms": 50,
+    "num_heartbeats_timeout": 4,
+    "gcs_reconnect_timeout_s": 15.0,
+}
+
+SCHEDULE_KINDS = (
+    "stripe_sever", "corrupt_chunk", "short_read", "delay_storm",
+    "raylet_kill", "heartbeat_partition", "gcs_restart", "mixed",
+    "worker_kill",
+)
+
+# Event vocabulary for the data-plane harness. Each entry generates a
+# (op, params) drawn deterministically from the schedule RNG.
+_KIND_OPS = {
+    "stripe_sever": ["sever_serve"],
+    "corrupt_chunk": ["corrupt_serve"],
+    "short_read": ["short_serve"],
+    "delay_storm": ["delay_fetch", "delay_serve"],
+    "raylet_kill": ["kill_raylet"],
+    "heartbeat_partition": ["partition"],
+    "gcs_restart": ["gcs_restart"],
+    "mixed": ["sever_serve", "corrupt_serve", "short_serve",
+              "delay_fetch", "partition", "gcs_restart", "kill_raylet"],
+}
+
+
+def make_schedule(kind: str, seed: int, rounds: int = 8,
+                  n_raylets: int = 3) -> List[dict]:
+    """Expand (kind, seed) into an explicit, replayable event list.
+
+    Pure function of its arguments: the SAME seed always yields the
+    byte-identical schedule (pinned by test_chaos's determinism test).
+    Events are keyed by the workload round BEFORE which they apply;
+    ``target`` indexes the raylet they hit (resolved to whatever is
+    still alive at run time)."""
+    if kind not in _KIND_OPS and kind != "worker_kill":
+        raise ValueError(f"unknown schedule kind {kind!r}")
+    if kind == "worker_kill":
+        # the worker-kill schedule is carried by the RAY_TPU_FAULTPOINTS
+        # env arming in run_task_schedule, not by harness events
+        return []
+    rng = random.Random(seed)
+    events: List[dict] = []
+    ops = _KIND_OPS[kind]
+    kills = 0
+    for step in range(1, rounds):
+        if rng.random() < 0.6:
+            op = rng.choice(ops)
+            ev: Dict[str, Any] = {"step": step, "op": op,
+                                  "target": rng.randrange(n_raylets)}
+            if op in ("sever_serve", "corrupt_serve", "short_serve"):
+                ev["after"] = rng.randrange(0, 3)
+                ev["times"] = rng.randrange(1, 4)
+            elif op in ("delay_fetch", "delay_serve"):
+                ev["delay_s"] = round(rng.uniform(0.01, 0.08), 3)
+                ev["times"] = rng.randrange(4, 16)
+            elif op == "partition":
+                # long enough that the GCS declares the node dead
+                # (period 50 ms x timeout 4 beats), short enough that
+                # the node heals within the same schedule
+                ev["beats"] = rng.randrange(8, 14)
+            elif op == "kill_raylet":
+                if kills >= 1 or step < 2:
+                    continue  # keep >= 2 nodes alive, let the run warm up
+                kills += 1
+            events.append(ev)
+    return events
+
+
+def schedules_equal(a: List[dict], b: List[dict]) -> bool:
+    return json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+def _fd_count() -> int:
+    return len(os.listdir("/proc/self/fd"))
+
+
+class DataPlaneChaos:
+    """In-process GCS + raylets under a chaos schedule, with a pull
+    workload and per-round invariant checks."""
+
+    def __init__(self, kind: str, seed: int, tmp: str,
+                 rounds: int = 8, n_raylets: int = 3):
+        self.kind = kind
+        self.seed = seed
+        self.tmp = str(tmp)
+        self.rounds = rounds
+        self.n_raylets = n_raylets
+        self.schedule = make_schedule(kind, seed, rounds, n_raylets)
+        self.log: List[dict] = []      # executed events (deterministic)
+        self.outcomes: List[str] = []  # per-round workload results
+        self.cfg = RayTpuConfig.create({
+            **CHAOS_CFG,
+            "gcs_journal_path": os.path.join(self.tmp,
+                                             f"chaos_{kind}_{seed}.journal"),
+        })
+        self.gcs: Optional[GcsServer] = None
+        self.gcs_port = 0
+        self.raylets: List[Raylet] = []
+        self.dead: set = set()         # indices of crashed raylets
+        self.holders: Dict[bytes, List[bytes]] = {}  # oid -> node ids
+        self.owner: Optional[rpc.RpcServer] = None
+        self.owner_addr = ""
+        self.ctx = SerializationContext()
+
+    # -------------------------------------------------------------- setup
+
+    async def _boot(self):
+        self.gcs = GcsServer(self.cfg)
+        addr = await self.gcs.start("tcp://127.0.0.1:0")
+        self.gcs_port = int(addr.rsplit(":", 1)[1])
+        self.gcs_address = addr
+        for i in range(self.n_raylets):
+            r = Raylet(self.cfg, 1, session_dir=self.tmp,
+                       node_name=f"chaos-r{i}")
+            await r.start(addr)
+            self.raylets.append(r)
+
+        async def _locs(conn, header, bufs):
+            oid = header["object_id"]
+            return {"locations": list(self.holders.get(oid, []))}
+
+        async def _add(conn, header, bufs):
+            self.holders.setdefault(header["object_id"], []).append(
+                header["node_id"])
+            return {"ok": True}
+
+        self.owner = rpc.RpcServer(
+            {"GetObjectLocations": _locs, "AddObjectLocation": _add},
+            name="chaos-owner")
+        self.owner_addr = await self.owner.listen("tcp://127.0.0.1:0")
+
+    def _live(self) -> List[Tuple[int, Raylet]]:
+        return [(i, r) for i, r in enumerate(self.raylets)
+                if i not in self.dead]
+
+    # -------------------------------------------------------------- events
+
+    async def _apply_event(self, ev: dict):
+        live = self._live()
+        idx, target = live[ev["target"] % len(live)]
+        self.log.append({**ev, "resolved_target": idx})
+        op = ev["op"]
+        if op in ("sever_serve", "corrupt_serve", "short_serve"):
+            action = {"sever_serve": "raise", "corrupt_serve": "corrupt",
+                      "short_serve": "short"}[op]
+            kwargs: Dict[str, Any] = {
+                "after": ev["after"], "times": ev["times"],
+                "match": {"server": target.data_server.address}}
+            if action == "raise":
+                kwargs["exc"] = ConnectionResetError(
+                    f"chaos sever @{idx}")
+            faultpoints.arm("data.serve_chunk", action, **kwargs)
+        elif op == "delay_serve":
+            faultpoints.arm(
+                "data.serve_chunk", "delay", delay_s=ev["delay_s"],
+                times=ev["times"],
+                match={"server": target.data_server.address})
+        elif op == "delay_fetch":
+            faultpoints.arm("data.fetch_chunk", "delay",
+                            delay_s=ev["delay_s"], times=ev["times"])
+        elif op == "partition":
+            faultpoints.arm("raylet.heartbeat", "drop",
+                            times=ev["beats"],
+                            match={"node": target._nid12})
+        elif op == "kill_raylet":
+            await self._crash_raylet(idx, target)
+        elif op == "gcs_restart":
+            await self._restart_gcs()
+        else:
+            raise AssertionError(f"unhandled chaos op {op!r}")
+
+    async def _crash_raylet(self, idx: int, r: Raylet):
+        """Abrupt raylet death: servers and connections drop with no
+        DrainNode — the GCS must notice via connection loss/heartbeat
+        timeout, peers via the NODE dead event."""
+        self.dead.add(idx)
+        r._closing = True
+        if r._hb_task:
+            r._hb_task.cancel()
+        if getattr(r, "_log_monitor_task", None):
+            r._log_monitor_task.cancel()
+        await r._server.close()
+        if r.gcs_conn and not r.gcs_conn.closed:
+            await r.gcs_conn.close()
+        if r.data_server is not None:
+            await r.data_server.close()
+        for ch in list(r._data_channels.values()):
+            await ch.close()
+        r._data_channels.clear()
+        # the dead node's replicas are gone for pull purposes
+        nid = r.node_id.binary()
+        for oid in list(self.holders):
+            if nid in self.holders[oid]:
+                self.holders[oid].remove(nid)
+
+    async def _restart_gcs(self):
+        """SIGKILL-equivalent GCS bounce on the same port: journaled
+        state replays, raylets re-register through their reconnect
+        backoff, pubsub subscribers re-subscribe."""
+        await self.gcs.stop()
+        self.gcs = GcsServer(self.cfg)
+        await self.gcs.start(f"tcp://127.0.0.1:{self.gcs_port}")
+
+    # ------------------------------------------------------------ workload
+
+    def _seal(self, r: Raylet, arr: np.ndarray, oid: ObjectID) -> None:
+        name, size = write_segment(self.ctx.serialize(arr))
+        assert r.store.seal(oid, name, size)
+        self.holders.setdefault(oid.binary(), []).append(
+            r.node_id.binary())
+
+    async def _workload_round(self, rng: random.Random, step: int):
+        live = self._live()
+        if len(live) < 2:
+            self.outcomes.append("skipped:single-node")
+            return
+        size = rng.randrange(300_000, 2_500_000)
+        arr = np.frombuffer(
+            rng.getrandbits(8 * size).to_bytes(size, "little"),
+            dtype=np.uint8)
+        oid = ObjectID.from_random()
+        # never seal on every live node — the puller must be distinct
+        n_src = min(len(live) - 1, 2 if rng.random() < 0.5 else 1)
+        srcs = rng.sample(live, n_src)
+        for _, r in srcs:
+            self._seal(r, arr, oid)
+        candidates = [e for e in live if e not in srcs]
+        _, dst = rng.choice(candidates)
+        try:
+            reply = await asyncio.wait_for(
+                dst._ensure_local(oid, self.owner_addr), PULL_BOUND_S)
+        except asyncio.TimeoutError:
+            raise AssertionError(
+                f"PULL HANG past {PULL_BOUND_S}s at step {step} "
+                f"(kind={self.kind} seed={self.seed})") from None
+        if reply.get("ok"):
+            att = AttachedObject(reply["segment"])
+            got = self.ctx.deserialize(att.metadata, att.frames)
+            assert np.array_equal(got, arr), \
+                f"corrupted pull at step {step} (kind={self.kind} " \
+                f"seed={self.seed})"
+            got = None
+            att.close()
+            self.outcomes.append("ok")
+        else:
+            # typed, reasoned failure is an acceptable outcome under
+            # chaos — a hang or corruption is not
+            assert reply.get("reason"), "failure without a reason"
+            self.outcomes.append(f"failed:{reply['reason']}")
+        # free everywhere so the store never fills across rounds
+        for _, r in live:
+            r.store.free(oid)
+        self.holders.pop(oid.binary(), None)
+
+    # ----------------------------------------------------------- invariants
+
+    def _check_round_invariants(self, step: int):
+        for i, r in self._live():
+            assert r._pull_inflight_bytes == 0, \
+                f"admission budget leaked on r{i} at step {step}: " \
+                f"{r._pull_inflight_bytes}"
+            assert not r.store._lent, \
+                f"segment lease leaked on r{i} at step {step}: " \
+                f"{dict(r.store._lent)}"
+
+    async def _check_partition_healed(self):
+        """Every partitioned (but never crashed) node must be ALIVE in
+        the GCS again once its beats resume — the resurrect path."""
+        partitioned = {e["resolved_target"] for e in self.log
+                       if e["op"] == "partition"} - self.dead
+        for idx in partitioned:
+            nid = self.raylets[idx].node_id.binary()
+            deadline = asyncio.get_running_loop().time() + 10.0
+            while asyncio.get_running_loop().time() < deadline:
+                entry = self.gcs.nodes.get(nid)
+                if entry is not None and entry.alive:
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                raise AssertionError(
+                    f"partitioned node r{idx} never resurrected "
+                    f"(kind={self.kind} seed={self.seed})")
+
+    # --------------------------------------------------------------- run
+
+    async def run(self) -> List[dict]:
+        rng = random.Random(self.seed ^ 0x5EED)
+        by_step: Dict[int, List[dict]] = {}
+        for ev in self.schedule:
+            by_step.setdefault(ev["step"], []).append(ev)
+        await self._boot()
+        try:
+            for step in range(self.rounds):
+                for ev in by_step.get(step, ()):
+                    await self._apply_event(ev)
+                await self._workload_round(rng, step)
+                self._check_round_invariants(step)
+            await self._check_partition_healed()
+        finally:
+            faultpoints.reset()
+            await self._teardown()
+        return self.log
+
+    async def _teardown(self):
+        if self.owner is not None:
+            await self.owner.close()
+        for i, r in enumerate(self.raylets):
+            try:
+                if i in self.dead:
+                    r.store.shutdown()  # crashed node's segments
+                else:
+                    await r.stop()
+            except Exception:  # noqa: BLE001 — teardown after injected chaos
+                pass
+        if self.gcs is not None:
+            await self.gcs.stop()
+
+
+def run_data_plane_schedule(kind: str, seed: int, tmp,
+                            rounds: int = 8) -> Tuple[List[dict],
+                                                      List[str]]:
+    """One schedule end to end, with the fd-leak bracket. Returns
+    (event_log, workload_outcomes)."""
+    fd_before = _fd_count()
+    harness = DataPlaneChaos(kind, seed, tmp, rounds=rounds)
+
+    asyncio.run(harness.run())
+
+    # Teardown closed every socket/segment this run opened: the process
+    # fd table must come back to its pre-run level. Slack covers
+    # allocator/executor-thread fds the loop may keep warm.
+    fd_after = _fd_count()
+    assert fd_after <= fd_before + 8, \
+        f"fd leak: {fd_before} -> {fd_after} (kind={kind} seed={seed})"
+    assert any(o == "ok" for o in harness.outcomes), \
+        f"chaos starved the workload completely: {harness.outcomes}"
+    return harness.log, harness.outcomes
+
+
+# ---------------------------------------------------------------------------
+# task/actor soak (real cluster: ray_tpu.init + worker subprocesses)
+# ---------------------------------------------------------------------------
+
+
+def run_task_schedule(seed: int, kill_nth: int = 6,
+                      n_tasks: int = 16) -> dict:
+    """Soak the task-retry and actor-restart paths under deterministic
+    worker deaths: every spawned worker is armed (via the environment)
+    to die at its ``kill_nth``-th task. The invariant is the chaos
+    bar, not a success guarantee: every get() resolves within its
+    bound to either the correct value or a TYPED error
+    (WorkerCrashedError once retries exhaust is honest behavior), some
+    tasks do survive via retries, and the task-event table records a
+    RETRY/FAILED history for the disrupted ones. Returns summary
+    counters for the caller to log."""
+    import ray_tpu
+    from ray_tpu import exceptions as exc_mod
+
+    os.environ[faultpoints.ENV_VAR] = json.dumps(
+        [{"name": "task.execute", "action": "kill", "nth": kill_nth}])
+    try:
+        ray_tpu.init(num_cpus=2)
+        rng = random.Random(seed)
+
+        @ray_tpu.remote(max_retries=8)
+        def work(x):
+            return x * 2
+
+        @ray_tpu.remote(max_restarts=2, max_task_retries=4)
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+        # Waves, not one burst: normal-task replies are batched
+        # all-or-nothing, so a worker dying mid-batch loses its
+        # completed-but-unreported results too (at-least-once — they
+        # retry). One 16-task burst against die-at-6th workers would
+        # burn every retry on the requeue cascade; waves keep batches
+        # under the kill threshold so retries can actually win, which
+        # is also the shape of real sync-loop drivers.
+        xs = list(range(n_tasks))
+        rng.shuffle(xs)  # seed-determined submission order
+        n_ok = n_crashed = 0
+        wave = 4
+        for w0 in range(0, n_tasks, wave):
+            chunk = xs[w0:w0 + wave]
+            refs = [work.remote(i) for i in chunk]
+            for x, ref in zip(chunk, refs):
+                try:
+                    # the bound: resolves (either way) or the soak hangs
+                    assert ray_tpu.get(ref, timeout=120) == x * 2
+                    n_ok += 1
+                except exc_mod.WorkerCrashedError:
+                    n_crashed += 1  # typed, honest: retries exhausted
+        assert n_ok > n_tasks // 2, \
+            f"worker-death chaos starved the workload: {n_ok}/{n_tasks}"
+
+        c = Counter.remote()
+        bumps = []
+        for _ in range(6):
+            try:
+                bumps.append(ray_tpu.get(c.bump.remote(), timeout=120))
+            except exc_mod.ActorDiedError as e:
+                # restarts can exhaust under kill-every-Nth-task chaos;
+                # the error must carry its structured cause
+                assert e.cause_kind, "untyped actor death under chaos"
+                break
+        assert bumps, "actor never served a single call"
+
+        # honesty invariant: the disrupted tasks' histories show the
+        # deaths — at least one RETRY or FAILED record must exist
+        import time as time_mod
+
+        import ray_tpu.state as state_mod
+
+        # owner-side RETRY records flush on the metrics-report cadence
+        # (2 s): poll the table instead of racing the reporter
+        n_retry = 0
+        deadline = time_mod.time() + 15.0
+        while time_mod.time() < deadline and n_retry == 0:
+            records = state_mod.list_tasks(limit=1000)
+            n_retry = sum(
+                1 for t in records
+                for e in t["events"] if e["state"] in ("RETRY", "FAILED"))
+            if n_retry == 0:
+                time_mod.sleep(0.5)
+        assert n_retry > 0, \
+            "workers died but the task-event table shows no " \
+            "RETRY/FAILED history"
+        return {"tasks": n_tasks, "ok": n_ok, "crashed": n_crashed,
+                "bumps": bumps, "retry_or_failed_events": n_retry}
+    finally:
+        os.environ.pop(faultpoints.ENV_VAR, None)
+        ray_tpu.shutdown()

@@ -27,6 +27,15 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 import ray_tpu  # noqa: E402
+from ray_tpu._private import faultpoints  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faultpoints():
+    """No fault armed by one test may leak into the next (the registry
+    is process-wide by design)."""
+    yield
+    faultpoints.reset()
 
 
 @pytest.fixture

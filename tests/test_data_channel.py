@@ -20,7 +20,7 @@ from collections import deque
 import numpy as np
 import pytest
 
-from ray_tpu._private import data_channel, native, rpc
+from ray_tpu._private import data_channel, faultpoints, native, rpc
 from ray_tpu._private.config import RayTpuConfig
 from ray_tpu._private.gcs import GcsServer
 from ray_tpu._private.ids import ObjectID
@@ -323,6 +323,98 @@ def test_pull_retry_refreshes_locations(tmp_path):
     asyncio.run(run())
 
 
+def test_corrupt_chunk_frame_retires_stripe_pull_survives(tmp_path):
+    """A peer scribbling a chunk response frame (faultpoint
+    ``data.serve_chunk`` corrupt): the client's framing rejects the
+    garbage, retires that stripe, and the surviving stripes finish the
+    pull with CORRECT bytes — corruption never reaches the sealed
+    segment."""
+
+    async def run():
+        gcs, (r0, r1) = await _boot(2, tmp_path)
+        arr = np.random.default_rng(11).integers(
+            0, 255, 3_000_000, dtype=np.uint8)
+        oid, ctx = _seal(r0, arr)
+        spec = faultpoints.arm(
+            "data.serve_chunk", "corrupt", nth=2,
+            match={"server": r0.data_server.address})
+        owner, _ = _owner_server(lambda n: [r0.node_id.binary()])
+        owner_addr = await owner.listen("tcp://127.0.0.1:0")
+        try:
+            before = data_channel.pull_stats["stripe_failures"]
+            reply = await r1._ensure_local(oid, owner_addr)
+            assert reply["ok"], reply
+            _check_roundtrip(ctx, reply["segment"], arr)
+            assert spec.fires == 1, "corrupt fault never fired"
+            assert data_channel.pull_stats["stripe_failures"] > before
+            assert r1._pull_inflight_bytes == 0
+            assert not r1.store._lent
+        finally:
+            await _teardown(gcs, [r0, r1], owners=[owner])
+
+    asyncio.run(run())
+
+
+def test_short_chunk_rejected_pull_survives(tmp_path):
+    """A replica serving FEWER payload bytes than promised (faultpoint
+    ``data.serve_chunk`` short — the divergent-replica failure): the
+    exact-length check rejects the chunk, the stripe retires, and the
+    pull completes bit-exact on the survivors."""
+
+    async def run():
+        gcs, (r0, r1) = await _boot(2, tmp_path)
+        arr = np.random.default_rng(12).integers(
+            0, 255, 3_000_000, dtype=np.uint8)
+        oid, ctx = _seal(r0, arr)
+        spec = faultpoints.arm(
+            "data.serve_chunk", "short", nth=1,
+            match={"server": r0.data_server.address})
+        owner, _ = _owner_server(lambda n: [r0.node_id.binary()])
+        owner_addr = await owner.listen("tcp://127.0.0.1:0")
+        try:
+            reply = await r1._ensure_local(oid, owner_addr)
+            assert reply["ok"], reply
+            _check_roundtrip(ctx, reply["segment"], arr)
+            assert spec.fires == 1, "short fault never fired"
+            assert r1._pull_inflight_bytes == 0
+            assert not r1.store._lent
+        finally:
+            await _teardown(gcs, [r0, r1], owners=[owner])
+
+    asyncio.run(run())
+
+
+def test_stripe_dial_fault_falls_back_to_control_plane(tmp_path):
+    """Every stripe dial to a peer failing (faultpoint
+    ``data.stripe_dial``): the pull must still complete over the
+    control-plane FetchObjectChunk fallback lanes — a dead data port
+    on a live node degrades throughput, never correctness."""
+
+    async def run():
+        gcs, (r0, r1) = await _boot(2, tmp_path)
+        arr = np.random.default_rng(13).integers(
+            0, 255, 1_500_000, dtype=np.uint8)
+        oid, ctx = _seal(r0, arr)
+        faultpoints.arm(
+            "data.stripe_dial", "raise",
+            exc=ConnectionError("chaos: data port black-holed"),
+            match={"address": r0.data_address})
+        owner, _ = _owner_server(lambda n: [r0.node_id.binary()])
+        owner_addr = await owner.listen("tcp://127.0.0.1:0")
+        try:
+            before = data_channel.pull_stats["intermediate_copies"]
+            reply = await r1._ensure_local(oid, owner_addr)
+            assert reply["ok"], reply
+            _check_roundtrip(ctx, reply["segment"], arr)
+            # the fallback lanes materialize one bytes copy per chunk —
+            # proof the control plane carried the transfer
+            assert data_channel.pull_stats["intermediate_copies"] > before
+        finally:
+            await _teardown(gcs, [r0, r1], owners=[owner])
+
+    asyncio.run(run())
+
+
 def test_mid_pull_peer_death_falls_through_to_replica(tmp_path):
     """Killing one serving peer mid-pull: its stripes hand their chunks
     to the surviving replica's stripes and the pull completes."""
@@ -334,14 +426,13 @@ def test_mid_pull_peer_death_falls_through_to_replica(tmp_path):
             0, 255, 8_000_000, dtype=np.uint8)
         _, ctx = _seal(r0, arr, oid)
         _seal(r1, arr, oid)
-        served = {"n": 0}
-
-        def dying_serve(oid_b, offset, length):
-            served["n"] += 1
-            if served["n"] > 2:  # r0 dies after serving 2 chunks
-                raise ConnectionResetError("injected mid-pull death")
-
-        r0.data_server.on_serve = dying_serve
+        # faultpoints registry (the old ad-hoc on_serve hook is gone):
+        # r0's data server dies on every serve past its 2nd — matched
+        # per-server so r1 keeps serving
+        faultpoints.arm(
+            "data.serve_chunk", "raise", after=2,
+            exc=ConnectionResetError("injected mid-pull death"),
+            match={"server": r0.data_server.address})
         owner, _ = _owner_server(
             lambda n: [r0.node_id.binary(), r1.node_id.binary()])
         owner_addr = await owner.listen("tcp://127.0.0.1:0")
@@ -379,7 +470,7 @@ def test_mid_pull_total_death_fails_cleanly_releases_lease(tmp_path):
 
         served = {"n": 0}
 
-        def dying_serve(oid_b, offset, length):
+        def dying_serve(**ctx):
             served["n"] += 1
             if served["n"] > 2:
                 # data stripes die AND the control server goes with
@@ -388,7 +479,10 @@ def test_mid_pull_total_death_fails_cleanly_releases_lease(tmp_path):
                     r0._server.close())
                 raise ConnectionResetError("injected total death")
 
-        r0.data_server.on_serve = dying_serve
+        # hook action on the registry: arbitrary injection logic (the
+        # migration target for the old per-server on_serve callback)
+        faultpoints.arm("data.serve_chunk", "hook", hook=dying_serve,
+                        match={"server": r0.data_server.address})
         owner, calls = _owner_server(lambda n: [r0.node_id.binary()])
         owner_addr = await owner.listen("tcp://127.0.0.1:0")
         try:

@@ -1,9 +1,13 @@
 """Fault-tolerance tests: task retries, worker death, actor restarts.
 
 Parity model: reference python/ray/tests/test_failure.py,
-test_actor_failures.py, test_component_failures.py.
+test_actor_failures.py, test_component_failures.py. Deterministic
+fault injection rides the faultpoints registry
+(ray_tpu/_private/faultpoints.py); the chaos soak that shakes these
+paths at random lives in tests/test_chaos.py.
 """
 
+import asyncio
 import os
 import time
 
@@ -11,6 +15,7 @@ import pytest
 
 import ray_tpu
 from ray_tpu import exceptions as exc
+from ray_tpu._private import faultpoints
 
 
 def test_task_retry_on_worker_death(ray_start_regular):
@@ -144,3 +149,222 @@ def test_abrupt_driver_exit_releases_leases(ray_start_regular):
 
     assert ray_tpu.get(
         [alive.remote() for _ in range(20)], timeout=60) == ["ok"] * 20
+
+
+def test_actor_death_carries_structured_cause(ray_start_regular):
+    """RayActorError/ActorDiedError exposes a structured death cause
+    (worker crash vs restarts-exhausted, with ids) sourced from the GCS
+    actor table — not just a prose string."""
+    @ray_tpu.remote(max_restarts=0)
+    class Mortal:
+        def die(self):
+            os._exit(1)
+
+        def ping(self):
+            return "pong"
+
+    m = Mortal.remote()
+    assert ray_tpu.get(m.ping.remote(), timeout=30) == "pong"
+    m.die.remote()
+    with pytest.raises(exc.ActorDiedError) as ei:
+        ray_tpu.get(m.ping.remote(), timeout=60)
+    # the call in flight at conn-loss fails immediately with the kind;
+    # once the GCS actor table has the death, later calls carry the
+    # full structured cause (node id etc.)
+    assert ei.value.cause_kind == "WORKER_DIED", ei.value.cause_info
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            ray_tpu.get(m.ping.remote(), timeout=30)
+            raise AssertionError("dead actor served a call")
+        except exc.ActorDiedError as e2:
+            if e2.cause_info.get("node_id"):
+                assert e2.cause_kind == "WORKER_DIED", e2.cause_info
+                break
+        time.sleep(0.2)
+    else:
+        raise AssertionError("death cause never carried the node id")
+    # restarts-exhausted is its own kind, with the final straw attached
+    @ray_tpu.remote(max_restarts=1)
+    class Doomed:
+        def die(self):
+            os._exit(1)
+
+        def ping(self):
+            return "pong"
+
+    d = Doomed.remote()
+    assert ray_tpu.get(d.ping.remote(), timeout=30) == "pong"
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        d.die.remote()
+        try:
+            ray_tpu.get(d.ping.remote(), timeout=60)
+        except exc.ActorDiedError as e:
+            # a ping in flight at the conn loss fails with the
+            # transient kind; keep killing until the TERMINAL cause
+            # (restart budget burnt) comes back from the actor table
+            if e.cause_kind == "RESTARTS_EXHAUSTED":
+                assert e.cause_info.get("last_failure") == \
+                    "WORKER_DIED", e.cause_info
+                break
+        time.sleep(0.2)  # restart budget not burnt yet; kill again
+    else:
+        raise AssertionError("actor never exhausted its restart budget")
+
+
+def test_worker_kill_at_nth_task_via_env_faultpoint(monkeypatch):
+    """The cross-process arming path end to end: RAY_TPU_FAULTPOINTS is
+    set BEFORE init, so every worker the cluster ever spawns
+    (prestarted included) dies at its 5th task; retries land on fresh
+    workers and win. Deterministic schedule, not a SIGKILL race — and
+    the driver's retry counter proves the kills actually fired."""
+    import json
+
+    monkeypatch.setenv(faultpoints.ENV_VAR, json.dumps(
+        [{"name": "task.execute", "action": "kill", "nth": 5}]))
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote(max_retries=4)
+        def step(x):
+            return x * 3
+
+        # waves keep batches under the kill threshold so completed
+        # results ship before deaths and retried batches can finish
+        for wave in range(5):
+            xs = list(range(wave * 3, wave * 3 + 3))
+            assert ray_tpu.get([step.remote(x) for x in xs],
+                               timeout=120) == [x * 3 for x in xs]
+        core = ray_tpu.worker.global_worker.core
+        assert core.stats["tasks_retried"] > 0, \
+            "no worker death observed — the armed kill never fired, " \
+            "the test proved nothing"
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# chaos-soak findings, pinned deterministically (in-process control plane)
+# ---------------------------------------------------------------------------
+
+
+def test_partitioned_node_resurrects_after_heartbeats_resume(tmp_path):
+    """Chaos finding (heartbeat_partition schedule): a node declared
+    dead by heartbeat timeout used to stay dead FOREVER even after its
+    beats resumed — handle_heartbeat fed the dead entry and reported
+    ok. Pinned: suppressed beats (faultpoint ``raylet.heartbeat``
+    drop) -> GCS declares the node dead -> beats resume -> the raylet
+    re-registers and the node is alive again."""
+    from ray_tpu._private.config import RayTpuConfig
+    from ray_tpu._private.gcs import GcsServer
+    from ray_tpu._private.raylet import Raylet
+
+    async def run():
+        cfg = RayTpuConfig.create({
+            "num_prestart_workers": 0, "event_log_enabled": False,
+            "raylet_heartbeat_period_ms": 50,
+            "num_heartbeats_timeout": 4,
+            "retry_backoff_base_s": 0.02,
+            "retry_backoff_cap_s": 0.2,
+        })
+        gcs = GcsServer(cfg)
+        addr = await gcs.start("tcp://127.0.0.1:0")
+        r = Raylet(cfg, 1, session_dir=str(tmp_path))
+        await r.start(addr)
+        nid = r.node_id.binary()
+        try:
+            faultpoints.arm("raylet.heartbeat", "drop", times=8,
+                            match={"node": r._nid12})
+            deadline = asyncio.get_running_loop().time() + 10
+            while gcs.nodes[nid].alive:
+                assert asyncio.get_running_loop().time() < deadline, \
+                    "GCS never declared the silent node dead"
+                await asyncio.sleep(0.05)
+            # beats resume once the 8 armed drops are spent: the
+            # ok=False heartbeat reply must drive a re-registration
+            deadline = asyncio.get_running_loop().time() + 10
+            while not gcs.nodes[nid].alive:
+                assert asyncio.get_running_loop().time() < deadline, \
+                    "node never resurrected after the partition healed"
+                await asyncio.sleep(0.05)
+        finally:
+            faultpoints.reset()
+            await r.stop()
+            await gcs.stop()
+
+    asyncio.run(run())
+
+
+def test_graceful_exit_after_restart_keeps_its_own_cause():
+    """Review finding, pinned: an actor that restarted in the past and
+    then exits GRACEFULLY must die as ACTOR_EXITED — the expected-exit
+    path sets max_restarts = num_restarts, which used to trip the
+    restarts-exhausted rewrite. Exhaustion is reserved for involuntary
+    deaths, and it back-fills the known node id even when the reported
+    cause carried an empty placeholder."""
+    from ray_tpu._private.config import RayTpuConfig
+    from ray_tpu._private.gcs import (ACTOR_ALIVE, ACTOR_DEAD, ActorEntry,
+                                      GcsServer)
+
+    async def run():
+        gcs = GcsServer(RayTpuConfig.create({"event_log_enabled": False}))
+        await gcs.start("tcp://127.0.0.1:0")
+        try:
+            graceful = ActorEntry(b"\x0a" * 16, {}, [], max_restarts=5)
+            graceful.state = ACTOR_ALIVE
+            graceful.num_restarts = 1  # restarted once in its life
+            gcs.actors[graceful.actor_id] = graceful
+            await gcs.handle_report_actor_death(None, {
+                "actor_id": graceful.actor_id,
+                "reason": "actor exited", "expected": True}, [])
+            assert graceful.state == ACTOR_DEAD
+            assert graceful.death_info["kind"] == "ACTOR_EXITED", \
+                graceful.death_info
+
+            doomed = ActorEntry(b"\x0b" * 16, {}, [], max_restarts=1)
+            doomed.state = ACTOR_ALIVE
+            doomed.num_restarts = 1  # budget already burnt
+            doomed.node_id = b"\x0c" * 16
+            gcs.actors[doomed.actor_id] = doomed
+            await gcs.handle_report_actor_death(None, {
+                "actor_id": doomed.actor_id,
+                "reason": "worker died", "expected": False,
+                # empty node_id placeholder must not mask the known id
+                "cause": {"kind": "WORKER_DIED", "node_id": ""}}, [])
+            assert doomed.death_info["kind"] == "RESTARTS_EXHAUSTED"
+            assert doomed.death_info["last_failure"] == "WORKER_DIED"
+            assert doomed.death_info["node_id"] == doomed.node_id.hex()
+        finally:
+            await gcs.stop()
+
+    asyncio.run(run())
+
+
+def test_stale_node_connection_cannot_kill_reregistered_node(tmp_path):
+    """Chaos finding (gcs_restart + partition mix): the disconnect
+    callback of a node's OLD connection raced its re-registration and
+    marked the FRESH entry dead. Pinned: after a re-register, tearing
+    down a stale entry's connection must not touch the live entry."""
+    from ray_tpu._private.config import RayTpuConfig
+    from ray_tpu._private.gcs import GcsServer, NodeEntry
+
+    async def run():
+        cfg = RayTpuConfig.create({"event_log_enabled": False})
+        gcs = GcsServer(cfg)
+        await gcs.start("tcp://127.0.0.1:0")
+        try:
+            nid = b"\x01" * 16
+            stale = NodeEntry(nid, "tcp://127.0.0.1:1", {"CPU": 1.0})
+            fresh = NodeEntry(nid, "tcp://127.0.0.1:2", {"CPU": 1.0})
+            gcs.nodes[nid] = fresh
+            # the stale connection's teardown fires against the table
+            # that has already moved on: must be a no-op
+            await gcs._on_node_connection_lost(stale)
+            assert gcs.nodes[nid].alive, \
+                "stale connection teardown killed the re-registered node"
+            await gcs._on_node_connection_lost(fresh)
+            assert not gcs.nodes[nid].alive  # the live entry still can die
+        finally:
+            await gcs.stop()
+
+    asyncio.run(run())

@@ -1,0 +1,303 @@
+"""Fault-injection plane (faultpoints.py) + shared backoff (backoff.py).
+
+The registry is the substrate every chaos schedule and fault-tolerance
+test stands on, so its own contract is pinned first: deterministic
+predicates, exact counters, zero-cost disarmed, env arming for
+subprocesses, and the wired rpc/shm seams behaving as advertised.
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from ray_tpu._private import backoff as backoff_mod
+from ray_tpu._private import faultpoints as fp
+from ray_tpu._private import rpc
+
+
+# ---------------------------------------------------------------------------
+# registry contract
+# ---------------------------------------------------------------------------
+
+
+def test_disarmed_is_inert():
+    assert fp.armed is False
+    # fire on an unarmed point: no registry churn, returns None
+    assert fp.fire("nonexistent.point", anything=1) is None
+
+
+def test_arm_disarm_reset_toggle_armed():
+    fp.arm("a.point")
+    assert fp.armed
+    fp.arm("b.point")
+    fp.disarm("a.point")
+    assert fp.armed  # b still armed
+    fp.disarm("b.point")
+    assert not fp.armed
+    fp.arm("c.point")
+    fp.reset()
+    assert not fp.armed and not fp.specs("c.point")
+
+
+def test_raise_default_and_custom_exc():
+    fp.arm("p.raise")
+    with pytest.raises(fp.FaultInjected):
+        fp.fire("p.raise")
+    fp.reset()
+    fp.arm("p.raise", "raise", exc=ConnectionResetError("boom"))
+    with pytest.raises(ConnectionResetError):
+        fp.fire("p.raise")
+
+
+def test_nth_fires_exactly_once():
+    spec = fp.arm("p.nth", "raise", nth=3)
+    assert fp.fire("p.nth") is None
+    assert fp.fire("p.nth") is None
+    with pytest.raises(fp.FaultInjected):
+        fp.fire("p.nth")
+    assert fp.fire("p.nth") is None  # only the 3rd
+    assert spec.hits == 4 and spec.fires == 1
+
+
+def test_every_and_after_and_times():
+    spec = fp.arm("p.every", "drop", every=2)
+    got = [fp.fire("p.every") for _ in range(6)]
+    assert got == [None, "drop", None, "drop", None, "drop"]
+    fp.reset()
+    spec = fp.arm("p.after", "drop", after=2)
+    got = [fp.fire("p.after") for _ in range(5)]
+    assert got == [None, None, "drop", "drop", "drop"]
+    fp.reset()
+    spec = fp.arm("p.times", "drop", times=2)
+    got = [fp.fire("p.times") for _ in range(5)]
+    assert got == ["drop", "drop", None, None, None]
+    assert spec.hits == 5 and spec.fires == 2
+
+
+def test_probability_is_seeded_and_deterministic():
+    fp.arm("p.prob", "drop", p=0.5, seed=42)
+    run1 = [fp.fire("p.prob") for _ in range(32)]
+    fp.reset()
+    fp.arm("p.prob", "drop", p=0.5, seed=42)
+    run2 = [fp.fire("p.prob") for _ in range(32)]
+    assert run1 == run2, "same seed must fire the same hits"
+    assert 0 < run1.count("drop") < 32
+
+
+def test_match_filters_value_and_callable():
+    spec = fp.arm("p.match", "drop",
+                  match={"method": "Heartbeat", "n": lambda v: v > 3})
+    assert fp.fire("p.match", method="KVPut", n=10) is None
+    assert fp.fire("p.match", method="Heartbeat", n=1) is None
+    assert fp.fire("p.match", method="Heartbeat", n=5) == "drop"
+    # non-matching contexts are not even counted as hits
+    assert spec.hits == 1
+
+
+def test_stacked_specs_one_point():
+    fp.arm("p.stack", "drop", nth=1)
+    fp.arm("p.stack", "sever", nth=2)
+    assert fp.fire("p.stack") == "drop"
+    assert fp.fire("p.stack") == "sever"
+    assert fp.fire("p.stack") is None
+
+
+def test_hook_action_receives_ctx_and_may_raise():
+    seen = []
+
+    def hook(**ctx):
+        seen.append(ctx)
+        if len(seen) >= 2:
+            raise ConnectionResetError("hook says die")
+
+    fp.arm("p.hook", "hook", hook=hook)
+    fp.fire("p.hook", offset=0)
+    with pytest.raises(ConnectionResetError):
+        fp.fire("p.hook", offset=4096)
+    assert seen == [{"offset": 0}, {"offset": 4096}]
+
+
+def test_delay_sync_and_async():
+    fp.arm("p.delay", "delay", delay_s=0.05)
+    t0 = time.monotonic()
+    assert fp.fire("p.delay") is None  # delay is consumed, not returned
+    assert time.monotonic() - t0 >= 0.045
+
+    async def run():
+        t0 = time.monotonic()
+        assert await fp.async_fire("p.delay") is None
+        assert time.monotonic() - t0 >= 0.045
+
+    asyncio.run(run())
+
+
+def test_arm_from_env_good_and_malformed():
+    env = {fp.ENV_VAR: json.dumps([
+        {"name": "task.execute", "action": "kill", "nth": 3},
+        {"name": "p.env", "action": "drop"},
+        {"bogus": "no name key — skipped, not fatal"},
+    ])}
+    assert fp.arm_from_env(env) == 2
+    assert fp.specs("task.execute")[0].nth == 3
+    assert fp.fire("p.env") == "drop"
+    fp.reset()
+    assert fp.arm_from_env({fp.ENV_VAR: "not json"}) == 0
+    assert fp.arm_from_env({}) == 0
+    assert not fp.armed
+
+
+def test_unknown_action_rejected():
+    with pytest.raises(ValueError):
+        fp.arm("p.bad", "explode")
+    with pytest.raises(ValueError):
+        fp.arm("p.bad", "hook")  # hook without hook=
+
+
+# ---------------------------------------------------------------------------
+# wired seams: rpc drop / duplicate / sever, reply drop / sever
+# ---------------------------------------------------------------------------
+
+
+def _echo_server():
+    calls = {"n": 0}
+
+    async def echo(conn, header, bufs):
+        calls["n"] += 1
+        return {"echo": header, "n": calls["n"]}
+
+    return rpc.RpcServer({"Echo": echo}, name="echo"), calls
+
+
+def test_rpc_call_drop_and_duplicate_and_sever():
+    async def run():
+        server, calls = _echo_server()
+        addr = await server.listen("tcp://127.0.0.1:0")
+        conn = await rpc.connect(addr)
+        try:
+            # duplicate: the handler runs twice for one logical call —
+            # the idempotence probe for retried control-plane mutations
+            fp.arm("rpc.call.send", "duplicate", match={"method": "Echo"})
+            reply, _ = await conn.call("Echo", {"x": 1})
+            await asyncio.sleep(0.05)  # let the duplicate's task land
+            assert calls["n"] == 2
+            fp.reset()
+
+            # drop: the request is never written; the caller's timeout
+            # is the only way out (no hang past its bound)
+            fp.arm("rpc.call.send", "drop", match={"method": "Echo"})
+            with pytest.raises(asyncio.TimeoutError):
+                await conn.call("Echo", {"x": 2}, timeout=0.2)
+            assert calls["n"] == 2
+            fp.reset()
+
+            # sever: pending futures fail with ConnectionError NOW
+            fp.arm("rpc.call.send", "sever", match={"method": "Echo"})
+            with pytest.raises(ConnectionError):
+                await conn.call("Echo", {"x": 3}, timeout=5)
+        finally:
+            fp.reset()
+            await conn.close()
+            await server.close()
+
+    asyncio.run(run())
+
+
+def test_rpc_reply_drop_and_sever():
+    async def run():
+        server, calls = _echo_server()
+        addr = await server.listen("tcp://127.0.0.1:0")
+        conn = await rpc.connect(addr)
+        try:
+            # reply drop: the handler RAN (mutation landed) but the
+            # caller never hears back — retry-idempotence territory
+            fp.arm("rpc.reply.send", "drop", nth=1,
+                   match={"method": "Echo"})
+            with pytest.raises(asyncio.TimeoutError):
+                await conn.call("Echo", {"x": 1}, timeout=0.2)
+            assert calls["n"] == 1
+            reply, _ = await conn.call("Echo", {"x": 2}, timeout=5)
+            assert reply["n"] == 2  # connection still healthy after drop
+            fp.reset()
+
+            # reply sever: connection dies mid-reply; the caller sees a
+            # typed ConnectionError, never a hang
+            fp.arm("rpc.reply.send", "sever", match={"method": "Echo"})
+            with pytest.raises(ConnectionError):
+                await conn.call("Echo", {"x": 3}, timeout=5)
+        finally:
+            fp.reset()
+            await conn.close()
+            await server.close()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# wired seams: shm alloc miss / seal refuse
+# ---------------------------------------------------------------------------
+
+
+def test_shm_seal_refuse_and_alloc_miss(tmp_path):
+    import numpy as np
+
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.serialization import SerializationContext
+    from ray_tpu._private.shm_store import ShmStoreServer, write_segment
+
+    store = ShmStoreServer(capacity_bytes=64 << 20,
+                           spill_dir=str(tmp_path), spilling_enabled=False)
+    ctx = SerializationContext()
+    name, size = write_segment(ctx.serialize(np.arange(1000)))
+    fp.arm("shm.seal", "refuse", nth=1)
+    oid = ObjectID.from_random()
+    assert store.seal(oid, name, size) is False, "armed seal must refuse"
+    assert not store.contains(oid)
+    # next seal (new segment) works — the fault fired once
+    name2, size2 = write_segment(ctx.serialize(np.arange(1000)))
+    assert store.seal(oid, name2, size2) is True
+    fp.reset()
+
+    fp.arm("shm.alloc", "miss")
+    assert store.take_recycled(1 << 20) is None
+    fp.reset()
+    store.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# backoff.py contract
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_growth_cap_and_determinism():
+    b1 = backoff_mod.Backoff(0.1, 1.0, multiplier=2.0, seed=7)
+    b2 = backoff_mod.Backoff(0.1, 1.0, multiplier=2.0, seed=7)
+    d1 = [b1.next_delay() for _ in range(8)]
+    d2 = [b2.next_delay() for _ in range(8)]
+    assert d1 == d2, "seeded backoff must be reproducible"
+    assert d1[0] == pytest.approx(0.1)  # first delay = base exactly
+    assert all(0.1 <= d <= 1.0 for d in d1)
+
+
+def test_backoff_deadline_clamps_and_expires():
+    b = backoff_mod.Backoff(0.5, 10.0, deadline_s=0.05, seed=1)
+    time.sleep(0.06)
+    assert b.expired()
+    assert b.next_delay() == 0.0  # clamped: never sleeps past deadline
+
+
+def test_backoff_reset():
+    b = backoff_mod.Backoff(0.05, 5.0, seed=3)
+    for _ in range(6):
+        b.next_delay()
+    b.reset()
+    assert b.attempts == 0
+    assert b.next_delay() == pytest.approx(0.05)
+
+
+def test_backoff_rejects_bad_params():
+    with pytest.raises(ValueError):
+        backoff_mod.Backoff(0.0, 1.0)
+    with pytest.raises(ValueError):
+        backoff_mod.Backoff(1.0, 0.5)
