@@ -520,6 +520,11 @@ def main():
             ray_tpu.shutdown()
         except Exception:  # noqa: BLE001
             pass
+    _trace("worker spawn")
+    try:
+        worker_spawn_row = _worker_spawn_row()
+    except Exception as e:  # noqa: BLE001 — secondary row
+        worker_spawn_row = {"error": str(e)}
     _trace("cross-node transfer")
     try:
         xnode_row = _cross_node_transfer()
@@ -570,6 +575,7 @@ def main():
             "zero_copy_put": zero_copy_put,
             "task_events_overhead": task_events_row,
             "faultpoints_overhead": faultpoints_row,
+            "worker_spawn": worker_spawn_row,
             "cross_node_transfer": xnode_row,
             "lint_runtime": lint_row,
             "columnar_data_1m": columnar_row,
@@ -734,6 +740,79 @@ def _scalability_rows() -> dict:
         return out
     finally:
         ray_tpu.shutdown()
+
+
+def _worker_spawn_row() -> dict:
+    """Spawn-to-registered latency, cold ``Popen`` vs zygote fork
+    (zygote.py): the same in-process GCS+raylet harness runs both
+    paths, timing ``_start_worker_process`` until the worker's
+    RegisterWorker lands (state IDLE). The zygote's first lap is
+    reported separately — it includes the template's one-time preload
+    bill — and the steady-state speedup is the acceptance gate (>=5x):
+    actor creation and chaos-kill recovery both ride this path."""
+    import asyncio
+    import shutil
+    import statistics
+    import tempfile
+
+    from ray_tpu._private.config import RayTpuConfig
+    from ray_tpu._private.gcs import GcsServer
+    from ray_tpu._private.raylet import WORKER_IDLE, Raylet
+
+    async def _measure(zygote: bool, n: int) -> list:
+        tmp = tempfile.mkdtemp(prefix="rtpu-spawnbench-")
+        cfg = RayTpuConfig.create({
+            "num_prestart_workers": 0,
+            "worker_zygote_enabled": zygote,
+            "event_log_enabled": False})
+        gcs = GcsServer(cfg)
+        addr = await gcs.start("tcp://127.0.0.1:0")
+        r = Raylet(cfg, 1, session_dir=tmp)
+        await r.start(addr)
+        laps = []
+        try:
+            for _ in range(n):
+                t0 = time.perf_counter()
+                r._start_worker_process(force=True)
+                while not any(w.state == WORKER_IDLE
+                              for w in r.workers.values()):
+                    await asyncio.sleep(0.001)
+                    if time.perf_counter() - t0 > 120:
+                        raise RuntimeError(
+                            f"spawn never registered (zygote={zygote})")
+                laps.append(time.perf_counter() - t0)
+                # kill + pop (the explicit pop is the worker-pool
+                # contract: _on_worker_disconnect no-ops on DEAD
+                # handles), then wait for the corpse so laps never
+                # overlap
+                dead = list(r.workers.values())
+                for w in dead:
+                    r._kill_worker(w)
+                    r.workers.pop(w.worker_id, None)
+                t0 = time.perf_counter()
+                while any(w.proc is not None and w.proc.poll() is None
+                          for w in dead) and \
+                        time.perf_counter() - t0 < 30:
+                    await asyncio.sleep(0.002)
+        finally:
+            await r.stop()
+            await gcs.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+        return laps
+
+    n = int(os.environ.get("BENCH_SPAWN_REPS", "5"))
+    cold = asyncio.run(_measure(False, n))
+    zyg = asyncio.run(_measure(True, n + 1))
+    cold_s = statistics.median(cold)
+    zyg_s = statistics.median(zyg[1:])  # lap 0 pays the template boot
+    return {
+        "cold_spawn_ms": round(cold_s * 1e3, 1),
+        "zygote_spawn_ms": round(zyg_s * 1e3, 1),
+        "zygote_first_spawn_ms": round(zyg[0] * 1e3, 1),
+        "speedup": round(cold_s / zyg_s, 1),
+        "gate": ">=5x zygote vs cold spawn-to-registered",
+        "gate_ok": cold_s / zyg_s >= 5.0,
+    }
 
 
 def _cross_node_transfer() -> dict:

@@ -19,5 +19,15 @@ export RAY_TPU_WORKER_JAX_PLATFORMS="${RAY_TPU_WORKER_JAX_PLATFORMS:-cpu}"
 
 # -m '' = no marker filter: the slow soak schedules run here (the
 # tier-1 command excludes them with its own -m 'not slow').
-exec python -m pytest tests/test_chaos.py tests/test_faultpoints.py \
+python -m pytest tests/test_chaos.py tests/test_faultpoints.py \
     -q -p no:cacheprovider -m '' "$@"
+
+# The full run above already soaks worker_kill with the zygote ENABLED
+# (worker_zygote_enabled defaults on): die-at-Nth-task schedules,
+# killpg teardown, the no-zombie and fd brackets all hold when every
+# worker is a fork of the template. This second run pins the
+# cold-Popen path the same way (it is the fallback and the TPU-worker
+# default), including the per-spawn log-fd regression bracket.
+exec env RAY_TPU_WORKER_ZYGOTE_ENABLED=0 python -m pytest \
+    tests/test_chaos.py::test_chaos_soak_worker_kill \
+    -q -p no:cacheprovider -m ''

@@ -118,6 +118,24 @@ class RayTpuConfig:
     # leases then never pay process-start latency). 0 disables.
     num_prestart_workers: int = -1
     worker_register_timeout_s: float = 30.0
+    # Zygote worker factory (zygote.py): one forkserver-style template
+    # process per raylet pre-imports the worker module graph and
+    # pre-builds the native fastpath, then fork()s per spawn request —
+    # worker/actor startup and post-kill recovery become milliseconds
+    # instead of a full interpreter boot (bench.py worker_spawn row).
+    # Takes effect only where forking is safe: Linux, and ONLY when the
+    # workers run a forkable platform — raylets whose workers use a TPU
+    # platform (RAY_TPU_WORKER_JAX_PLATFORMS contains "tpu"/"axon", or
+    # is empty = inherit) always cold-Popen, because an initialized
+    # accelerator client must never be forked. Cold Popen is also the
+    # automatic fallback when the template dies mid-session.
+    worker_zygote_enabled: bool = True
+    # Comma list of EXTRA modules the zygote pre-imports on top of the
+    # default worker graph (core_worker, task_executor, rpc,
+    # serialization, worker_main + the ray_tpu package). Keep entries
+    # fork-safe: no threads, no event loops, no accelerator backends at
+    # import time (jax is deliberately absent from the default list).
+    zygote_preload_modules: str = ""
 
     # --- liveness / fault tolerance ---
     raylet_heartbeat_period_ms: int = 250

@@ -27,6 +27,17 @@ _rng = random.Random(int.from_bytes(os.urandom(16), "little"))
 def _random_bytes(n: int) -> bytes:
     return _rng.getrandbits(n * 8).to_bytes(n, "little")
 
+
+def reseed() -> None:
+    """Re-key the module RNG from fresh entropy.
+
+    A zygote-forked worker (zygote.py) inherits the template process's
+    Mersenne state byte-for-byte — without this every fork would draw
+    the SAME object/task id suffixes and collide in the owner tables.
+    Called from the forked child before any id is drawn."""
+    global _rng
+    _rng = random.Random(int.from_bytes(os.urandom(16), "little"))
+
 JOB_ID_SIZE = 4
 ACTOR_ID_SIZE = 16
 TASK_ID_SIZE = 24

@@ -84,6 +84,8 @@ class WorkerHandle:
         self.worker_id = worker_id
         self.pid = pid
         self.proc = proc
+        # "zygote" | "popen" | "" (externally started / not yet known)
+        self.spawned_via = ""
         self.address = ""
         self.conn: Optional[rpc.Connection] = None
         self.state = WORKER_STARTING
@@ -136,6 +138,16 @@ class Raylet:
         self._req_counter = itertools.count(1)
         self.max_workers = int(config.max_workers_per_node or max(1, int(num_cpus)))
         self._num_starting = 0
+        # Zygote worker factory (zygote.py): one pre-imported template
+        # process this raylet fork()s workers from. Launched at node
+        # boot when workers are prestarted, else on first demand; once
+        # it fails, every later spawn stays on the cold-Popen path.
+        self._zygote: Optional[Any] = None
+        self._zygote_failed = False
+        # Live async reapers for SIGKILLed/“disconnected” worker procs —
+        # kept so stop() can await the stragglers instead of leaking
+        # zombies past the raylet's lifetime.
+        self._reap_tasks: Set[asyncio.Task] = set()
 
         # Pending lease requests in arrival order: req_id -> (PendingRequest,
         # reply future). The scheduler seam consumes this queue each tick.
@@ -287,8 +299,17 @@ class Raylet:
         if getattr(self, "_log_monitor_task", None):
             self._log_monitor_task.cancel()
         self.events.close()
+        procs = []
         for w in list(self.workers.values()):
             self._kill_worker(w)
+            if w.proc is not None:
+                procs.append(w.proc)
+        await self._reap_procs(procs)
+        for t in list(self._reap_tasks):
+            t.cancel()
+        if self._zygote is not None:
+            await self._zygote.close()
+            self._zygote = None
         await self._server.close()
         if self.gcs_conn and not self.gcs_conn.closed:
             # Graceful departure: tell the GCS we're draining so a planned
@@ -542,10 +563,40 @@ class Raylet:
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
         worker_id = WorkerID.from_random()
-        out = open(os.path.join(
-            log_dir, f"worker-{worker_id.hex()[:12]}.log"), "ab")
-        env = dict(os.environ)
-        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
+        log_path = os.path.join(
+            log_dir, f"worker-{worker_id.hex()[:12]}.log")
+        handle = WorkerHandle(worker_id.binary(), 0, None)
+        self.workers[worker_id.binary()] = handle
+        if self._zygote_eligible():
+            # Fast path: fork the pre-imported template (zygote.py) —
+            # spawn-to-registered is milliseconds instead of a full
+            # interpreter boot. The pid lands asynchronously; the
+            # handle is already registered so the pool accounting and
+            # RegisterWorker see one consistent STARTING worker.
+            try:
+                self._ensure_zygote()
+            except (OSError, subprocess.SubprocessError) as e:
+                # launch itself failed (fork pressure, bad log dir):
+                # same contract as a death mid-session — cold Popen for
+                # this spawn and all later ones
+                self._zygote_failed = True
+                self._zygote = None
+                logger.warning("zygote launch failed (%r); cold-Popen "
+                               "fallback engaged", e)
+                self._popen_worker(handle, worker_id.hex(), log_path)
+                return
+            asyncio.get_event_loop().create_task(
+                self._spawn_via_zygote(handle, worker_id.hex(), log_path))
+        else:
+            self._popen_worker(handle, worker_id.hex(), log_path)
+
+    def _worker_env_overrides(
+            self, worker_id_hex: str) -> Dict[str, Optional[str]]:
+        """Per-spawn environment deltas (None = unset), shared by both
+        spawn paths: applied onto this process's env for a cold Popen
+        and onto the template's env by a zygote-forked child."""
+        ov: Dict[str, Optional[str]] = {
+            "RAY_TPU_WORKER_ID": worker_id_hex}
         # Workers default to CPU jax (RAY_TPU_WORKER_JAX_PLATFORMS="",
         # i.e. empty, keeps the inherited platform for TPU workers).
         # This must OVERRIDE any inherited JAX_PLATFORMS — and when the
@@ -553,23 +604,144 @@ class Raylet:
         # so a wedged TPU transport can never hang worker startup
         # (observed: device-backend bring-up blocking indefinitely,
         # which turns into actor-resolve timeouts).
-        worker_platforms = env.get("RAY_TPU_WORKER_JAX_PLATFORMS", "cpu")
+        worker_platforms = os.environ.get(
+            "RAY_TPU_WORKER_JAX_PLATFORMS", "cpu")
         if worker_platforms:
-            env["JAX_PLATFORMS"] = worker_platforms
+            ov["JAX_PLATFORMS"] = worker_platforms
             if "tpu" not in worker_platforms and \
                     "axon" not in worker_platforms:
-                env.pop("PALLAS_AXON_POOL_IPS", None)
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_main",
-             "--raylet-address", self.address,
-             "--gcs-address", self.gcs_address,
-             "--node-id", self.node_id.hex(),
-             "--worker-id", worker_id.hex(),
-             "--session-dir", self.session_dir],
-            stdout=out, stderr=subprocess.STDOUT, env=env,
-            start_new_session=True)
-        handle = WorkerHandle(worker_id.binary(), proc.pid, proc)
-        self.workers[worker_id.binary()] = handle
+                ov["PALLAS_AXON_POOL_IPS"] = None
+        # Fault arming is per-SPAWN state: forward the env var's value
+        # as of RIGHT NOW, so a schedule armed after node boot reaches
+        # zygote-forked children too (the template's baked-in env may
+        # predate the arming) and a disarmed var is unset, not stale.
+        ov[faultpoints.ENV_VAR] = os.environ.get(faultpoints.ENV_VAR)
+        return ov
+
+    def _popen_worker(self, handle: WorkerHandle, worker_id_hex: str,
+                      log_path: str) -> None:
+        """Cold spawn: fresh interpreter via Popen (the pre-zygote path,
+        kept as the universal fallback)."""
+        env = dict(os.environ)
+        for k, v in self._worker_env_overrides(worker_id_hex).items():
+            if v is None:
+                env.pop(k, None)
+            else:
+                env[k] = v
+        out = open(log_path, "ab")
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.worker_main",
+                 "--raylet-address", self.address,
+                 "--gcs-address", self.gcs_address,
+                 "--node-id", self.node_id.hex(),
+                 "--worker-id", worker_id_hex,
+                 "--session-dir", self.session_dir],
+                stdout=out, stderr=subprocess.STDOUT, env=env,
+                start_new_session=True)
+        finally:
+            # Popen dup'd the fd into the child: the parent's copy used
+            # to leak one fd per spawn for the raylet's lifetime
+            # (pinned by the chaos fd bracket in run_task_schedule).
+            out.close()
+        handle.pid = proc.pid
+        handle.proc = proc
+        handle.spawned_via = "popen"
+
+    # ------------------------------------------------------ zygote factory
+
+    def _zygote_eligible(self) -> bool:
+        """Whether spawns may ride the fork-fast path right now. Cold
+        Popen covers everything else: knob off, template already
+        failed, non-Linux, or accelerator-platform workers (an
+        initialized accelerator client must never be forked; empty
+        RAY_TPU_WORKER_JAX_PLATFORMS means the worker inherits the
+        raylet's platform, so it must be assumed TPU)."""
+        if not self.config.worker_zygote_enabled or self._zygote_failed:
+            return False
+        if not sys.platform.startswith("linux"):
+            return False
+        platforms = os.environ.get("RAY_TPU_WORKER_JAX_PLATFORMS", "cpu")
+        if not platforms or "tpu" in platforms or "axon" in platforms:
+            return False
+        return True
+
+    def _ensure_zygote(self) -> None:
+        """Launch the template once. With prestarted workers (the
+        default) this happens during ``start()``'s prestart loop, i.e.
+        at node boot; the launch itself is just fork+exec — the
+        template pays its import bill concurrently while early spawn
+        requests queue in the socketpair buffer."""
+        if self._zygote is not None:
+            return
+        from ray_tpu._private.zygote import ZygoteClient
+        env = dict(os.environ)
+        # The template imports the worker graph under the WORKER
+        # platform env (cpu-only per _zygote_eligible), so nothing
+        # accelerator-shaped can initialize pre-fork.
+        for k, v in self._worker_env_overrides("").items():
+            if k == "RAY_TPU_WORKER_ID":
+                continue
+            if v is None:
+                env.pop(k, None)
+            else:
+                env[k] = v
+        self._zygote = ZygoteClient.launch(
+            session_dir=self.session_dir, env=env,
+            preload=self.config.zygote_preload_modules,
+            tag=self.node_id.hex()[:12])
+        logger.info("zygote template launched (pid %s)",
+                    self._zygote.proc.pid)
+
+    async def _spawn_via_zygote(self, handle: WorkerHandle,
+                                worker_id_hex: str, log_path: str) -> None:
+        from ray_tpu._private.zygote import ZygoteError, ZygoteProc
+        zygote = self._zygote
+        if zygote is None:
+            # a concurrent spawn failed and tore the factory down
+            # between this task's creation and execution
+            if not (self._closing or handle.state == WORKER_DEAD):
+                self._popen_worker(handle, worker_id_hex, log_path)
+            return
+        try:
+            pid = await asyncio.wait_for(
+                zygote.spawn(
+                    worker_id=worker_id_hex, log_path=log_path,
+                    env_overrides=self._worker_env_overrides(worker_id_hex),
+                    argv={"raylet_address": self.address,
+                          "gcs_address": self.gcs_address,
+                          "node_id": self.node_id.hex(),
+                          "worker_id": worker_id_hex,
+                          "session_dir": self.session_dir}),
+                # strictly tighter than worker_register_timeout_s: the
+                # actor-creation path waits that long for a registered
+                # worker, so a wedged-but-alive template must fail over
+                # to cold Popen with enough budget left for the Popen
+                # worker to boot and register inside the same deadline
+                timeout=max(2.0, self.config.worker_register_timeout_s / 3))
+        except (ZygoteError, asyncio.TimeoutError, OSError) as e:
+            # Zygote dead or wedged: engage the cold-Popen fallback for
+            # this spawn and every later one (no template respawn —
+            # deterministic behavior for the rest of the session).
+            self._zygote_failed = True
+            self._zygote = None
+            logger.warning("zygote spawn failed (%r); cold-Popen "
+                           "fallback engaged", e)
+            if zygote is not None:
+                await zygote.close()
+            if self._closing or handle.state == WORKER_DEAD or \
+                    self.workers.get(handle.worker_id) is not handle:
+                return
+            self._popen_worker(handle, worker_id_hex, log_path)
+            return
+        handle.pid = pid
+        handle.proc = ZygoteProc(pid)
+        handle.spawned_via = "zygote"
+        if handle.state == WORKER_DEAD:
+            # torn down before the template reported the pid: the kill
+            # that already ran had nothing to signal — finish it now
+            handle.proc.kill()
+            self._reap_proc_async(handle.proc)
 
     def _alive_worker_count(self) -> int:
         """Workers counted against the task-worker pool cap. Actor workers
@@ -592,6 +764,10 @@ class Raylet:
             self.workers[wid] = handle
         else:
             self._num_starting = max(0, self._num_starting - 1)
+            if not handle.pid:
+                # zygote spawn whose pid report is still in flight on
+                # the socketpair — the worker itself knows its pid
+                handle.pid = header.get("pid", 0)
         handle.address = header["address"]
         handle.conn = conn
         handle.state = WORKER_IDLE
@@ -620,6 +796,10 @@ class Raylet:
             self._give_back(getattr(handle, "actor_resources", {}),
                             getattr(handle, "actor_pg_key", None))
             handle.actor_resources = {}
+        # A worker that exited on its own (or was killed by something
+        # else) still needs its status collected — _kill_worker never
+        # ran for it.
+        self._reap_proc_async(handle.proc)
         if prev_state == WORKER_ACTOR and handle.actor_id and not self._closing:
             async def _report():
                 try:
@@ -656,6 +836,47 @@ class Raylet:
                     handle.proc.kill()
                 except OSError:
                     pass  # process already gone
+            self._reap_proc_async(handle.proc)
+
+    def _reap_proc_async(self, proc) -> None:
+        """Collect a dead worker process's exit status: SIGKILLed and
+        crashed workers were never wait()ed, so their zombies
+        accumulated for the raylet's lifetime (pinned by the chaos
+        worker_kill no-zombie invariant). ``Popen.poll()`` reaps
+        raylet-parented children; a ``ZygoteProc``'s zombie belongs to
+        — and is reaped by — the zygote template."""
+        if proc is None or proc.poll() is not None:
+            return
+        if self._closing:
+            return  # stop()'s _reap_procs sweep collects everything
+
+        async def _reap(bound_s: float = 10.0):
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + bound_s
+            while proc.poll() is None and loop.time() < deadline:
+                await asyncio.sleep(0.05)
+            if proc.poll() is None:
+                logger.warning("worker pid %s still alive %.0fs after "
+                               "kill/disconnect", proc.pid, bound_s)
+
+        task = asyncio.get_event_loop().create_task(_reap())
+        self._reap_tasks.add(task)
+        task.add_done_callback(self._reap_tasks.discard)
+
+    async def _reap_procs(self, procs: List[Any],
+                          timeout_s: float = 2.0) -> None:
+        """Bounded shutdown sweep: stop() tears the loop down right
+        after, so the async reapers can't be trusted to finish — poll
+        (= waitpid WNOHANG for Popen) until every proc is collected or
+        the bound expires."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout_s
+        pending = [p for p in procs if p is not None and p.poll() is None]
+        while pending and loop.time() < deadline:
+            await asyncio.sleep(0.02)
+            pending = [p for p in pending if p.poll() is None]
+        for p in pending:
+            logger.warning("worker pid %s unreaped at raylet stop", p.pid)
 
     # -------------------------------------------------------------- leases
 

@@ -3,6 +3,15 @@
 Role parity: reference python/ray/workers/default_worker.py — boots a core
 worker in worker mode, registers with the local raylet, then serves task
 pushes until told to exit or the raylet connection drops.
+
+Two spawn paths share :func:`boot_worker`:
+
+* cold start — ``python -m ray_tpu._private.worker_main`` (this module's
+  ``main``): a fresh interpreter pays the full import graph + fastpath
+  warm-up before booting;
+* zygote fork — zygote.py forks its pre-imported template process and
+  the child calls :func:`boot_worker` directly (imports and the native
+  fastpath are already warm, so spawn-to-registered is milliseconds).
 """
 
 from __future__ import annotations
@@ -14,18 +23,18 @@ import os
 import sys
 
 
-def main(argv=None):
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--raylet-address", required=True)
-    parser.add_argument("--gcs-address", required=True)
-    parser.add_argument("--node-id", required=True)
-    parser.add_argument("--worker-id", required=True)
-    parser.add_argument("--session-dir", required=True)
-    parser.add_argument("--log-level", default="INFO")
-    args = parser.parse_args(argv)
+def boot_worker(args) -> None:
+    """Boot a worker in THIS process and serve until the raylet goes away.
 
+    ``args`` carries ``raylet_address``, ``gcs_address``, ``node_id``,
+    ``worker_id``, ``session_dir`` and ``log_level`` (an argparse
+    namespace from ``main`` or a SimpleNamespace from a zygote fork).
+    Never returns: exits the process when the serve loop ends.
+    """
+    # force=True: a zygote-forked child inherits the template's root
+    # logger handlers; the per-worker format must still win.
     logging.basicConfig(
-        level=args.log_level,
+        level=getattr(args, "log_level", "INFO"), force=True,
         format=f"[worker {args.worker_id[:8]}] %(levelname)s %(name)s: %(message)s")
 
     # Debug aids: periodic all-thread stack dumps to the worker log,
@@ -42,7 +51,7 @@ def main(argv=None):
     if dump_s > 0:
         faulthandler.dump_traceback_later(dump_s, repeat=True)
 
-    from ray_tpu._private import faultpoints, native, rpc
+    from ray_tpu._private import faultpoints, native
     from ray_tpu._private.config import RayTpuConfig, set_config
     from ray_tpu._private.core_worker import CoreWorker
     from ray_tpu._private.task_executor import TaskExecutor
@@ -52,11 +61,13 @@ def main(argv=None):
     # Warm the native copy tier before the loop exists: copy_into never
     # builds (a cold-cache compile on the loop was a raylint transitive
     # async-blocking finding), so the one place that may pay the
-    # compiler is process boot.
+    # compiler is process boot. A zygote fork already has it warm —
+    # load_fastpath is a cached no-op then.
     native.load_fastpath()
     # Deterministic fault schedules (e.g. "die at the 3rd task") are
     # armed from the spawning test's environment — a seeded plan, not a
-    # SIGKILL race.
+    # SIGKILL race. For zygote forks the raylet forwards the CURRENT
+    # env value per spawn, so arming stays per-spawn, not per-template.
     faultpoints.arm_from_env()
 
     loop = asyncio.new_event_loop()
@@ -106,6 +117,17 @@ def main(argv=None):
         except Exception:
             pass
         sys.exit(0)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--raylet-address", required=True)
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--worker-id", required=True)
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--log-level", default="INFO")
+    boot_worker(parser.parse_args(argv))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,467 @@
+"""Zygote worker factory: fork-fast worker and actor startup.
+
+Every cold worker spawn pays a full CPython boot, the whole
+``ray_tpu._private`` import graph and a ``native.load_fastpath()``
+warm-up — seconds per process, which dominates actor creation and
+post-kill recovery (the scalability bench measured ~2.5 actors/s,
+almost all interpreter startup). The zygote is a forkserver-style
+template process, one per raylet, that pays those fixed costs ONCE:
+
+* it pre-imports the worker module graph (``core_worker``,
+  ``task_executor``, ``rpc``, ``serialization`` + a configurable
+  preload list) and pre-builds the native fastpath;
+* then blocks SINGLE-THREADED — no event loop, no threads, so there is
+  never a lock or a loop to corrupt across ``fork()`` — on a unix
+  socketpair waiting for spawn requests;
+* per request it ``fork()``s; the child applies env overrides (so
+  ``JAX_PLATFORMS`` / ``RAY_TPU_FAULTPOINTS`` arming still work
+  per-spawn), redirects stdout/stderr to its own log file, starts a
+  fresh session/process group (the raylet's ``killpg`` teardown and
+  chaos kill schedules keep working), re-keys ``random`` and the id
+  RNG, and enters the same :func:`worker_main.boot_worker` path a cold
+  start uses;
+* the zygote reaps its forked children (``waitpid`` WNOHANG between
+  requests) and reports child pids back to the raylet.
+
+Fork-safety rules (why this is sound): the template never creates an
+event loop, never starts a thread, and never initializes an
+accelerator backend — the worker import graph is jax-free by
+construction, and raylets whose workers run a TPU platform never use
+the zygote at all (an initialized accelerator client must never be
+forked). Cold ``Popen`` remains the fallback everywhere: zygote dead,
+non-Linux, or ``worker_zygote_enabled=False``.
+
+Wire protocol (one request, one reply, strictly in order): 4-byte
+big-endian length + JSON. The zygote sends a ``{"ready": true}``
+banner after preloading; requests sent earlier simply queue in the
+socket buffer, so the raylet can fire prestart spawns at boot without
+waiting for the template.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import importlib
+import json
+import logging
+import os
+import random
+import select
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+# The import graph worker_main pays on a cold start. ``ray_tpu`` pulls
+# the driver-surface modules (worker, actor, remote_function) the boot
+# path touches; the rest are the private hot-path modules. Deliberately
+# jax-free: importing jax starts backend threads, which would break the
+# single-threaded fork-safety contract above.
+DEFAULT_PRELOAD = (
+    "ray_tpu",
+    "ray_tpu._private.rpc",
+    "ray_tpu._private.serialization",
+    "ray_tpu._private.core_worker",
+    "ray_tpu._private.task_executor",
+    "ray_tpu._private.worker_main",
+)
+
+
+class ZygoteError(RuntimeError):
+    """The zygote is gone or refused a spawn (caller falls back to Popen)."""
+
+
+# ---------------------------------------------------------------------------
+# framing (blocking side — the zygote process)
+# ---------------------------------------------------------------------------
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None  # EOF: the raylet went away
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> Optional[dict]:
+    head = _recv_exact(sock, 4)
+    if head is None:
+        return None
+    body = _recv_exact(sock, struct.unpack("!I", head)[0])
+    if body is None:
+        return None
+    return json.loads(body)
+
+
+def _send_frame(sock: socket.socket, msg: dict) -> None:
+    payload = json.dumps(msg).encode()
+    sock.sendall(struct.pack("!I", len(payload)) + payload)
+
+
+# ---------------------------------------------------------------------------
+# zygote process (template side)
+# ---------------------------------------------------------------------------
+
+
+def _reap_children() -> None:
+    """waitpid(WNOHANG) drain: forked workers the raylet SIGKILLed (or
+    that exited on their own) are children of the ZYGOTE, not the
+    raylet — without this they would sit as zombies for the template's
+    lifetime."""
+    while True:
+        try:
+            pid, _status = os.waitpid(-1, os.WNOHANG)
+        except ChildProcessError:
+            return  # no children at all
+        if pid == 0:
+            return  # children exist but none exited yet
+
+
+def _child_main(sock: socket.socket, req: Dict[str, Any]) -> None:
+    """The forked worker: tear off the template's identity, then enter
+    the shared boot path. NEVER returns — ``os._exit`` always, so a
+    failure can't fall back into the zygote's serve loop."""
+    status = 70  # EX_SOFTWARE unless boot exits with its own code
+    try:
+        # Fresh session + process group: the raylet's killpg-based
+        # teardown and the chaos kill schedules address this child
+        # alone, exactly like a Popen(start_new_session=True) worker.
+        os.setsid()
+        sock.close()
+        for k, v in (req.get("env") or {}).items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = str(v)
+        # The child owns its log file; stdout/stderr swing over before
+        # anything can print, same contract as the Popen stdout= dup.
+        log_fd = os.open(req["log_path"],
+                         os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        os.dup2(log_fd, 1)
+        os.dup2(log_fd, 2)
+        os.close(log_fd)
+        # fork() copies the template's RNG state byte-for-byte: re-key
+        # every stream a worker draws from (jitter, sampling, and the
+        # id-suffix RNG — shared state would collide object ids).
+        random.seed(int.from_bytes(os.urandom(16), "little"))
+        from ray_tpu._private import ids
+        ids.reseed()
+
+        import types
+
+        from ray_tpu._private.worker_main import boot_worker
+
+        argv = req["argv"]
+        boot_worker(types.SimpleNamespace(
+            raylet_address=argv["raylet_address"],
+            gcs_address=argv["gcs_address"],
+            node_id=argv["node_id"],
+            worker_id=argv["worker_id"],
+            session_dir=argv["session_dir"],
+            log_level=argv.get("log_level", "INFO")))
+        status = 0  # boot_worker sys.exit()s; not normally reached
+    except SystemExit as e:
+        status = e.code if isinstance(e.code, int) else 0
+    except BaseException:  # noqa: BLE001 — last-resort child report: the traceback goes to the worker log, then the process dies
+        traceback.print_exc()
+        try:
+            sys.stderr.flush()
+        except OSError:
+            pass
+    finally:
+        os._exit(status)
+
+
+def _spawn_child(sock: socket.socket, req: Dict[str, Any]) -> int:
+    pid = os.fork()
+    if pid == 0:
+        _child_main(sock, req)  # never returns
+        os._exit(70)  # unreachable belt-and-braces
+    return pid
+
+
+def serve(sock: socket.socket, preload: List[str]) -> None:
+    t0 = time.monotonic()
+    errors: List[str] = []
+    for name in preload:
+        try:
+            importlib.import_module(name)
+        except Exception as e:  # noqa: BLE001 — a bad preload entry must not kill the factory; reported in the ready banner
+            logger.warning("zygote preload %s failed: %r", name, e)
+            errors.append(f"{name}: {e!r}")
+    from ray_tpu._private import native
+
+    native.load_fastpath()  # children inherit the warm copy tier
+    _send_frame(sock, {"ready": True, "pid": os.getpid(),
+                       "preload_s": round(time.monotonic() - t0, 3),
+                       "preload_errors": errors})
+    logger.info("zygote ready in %.2fs (pid %d, %d modules preloaded)",
+                time.monotonic() - t0, os.getpid(), len(preload))
+    while True:
+        _reap_children()
+        # Still single-threaded-blocking — the timeout only bounds how
+        # long a dead child can sit unreaped while no requests arrive.
+        readable, _, _ = select.select([sock], [], [], 0.5)
+        if not readable:
+            continue
+        req = _recv_frame(sock)
+        if req is None:
+            break  # EOF: the raylet is gone — exit with it
+        op = req.get("op")
+        try:
+            if op == "spawn":
+                pid = _spawn_child(sock, req)
+                _send_frame(sock, {"ok": True, "pid": pid})
+            elif op == "ping":
+                _send_frame(sock, {"ok": True, "pid": os.getpid(),
+                                   "preload_errors": errors})
+            elif op == "exit":
+                break
+            else:
+                _send_frame(sock, {"ok": False,
+                                   "error": f"unknown op {op!r}"})
+        except (OSError, ConnectionError) as e:
+            # fork failure (EAGAIN) or the raylet vanished mid-reply:
+            # report if the pipe still works, otherwise exit.
+            logger.error("zygote request %r failed: %r", op, e)
+            try:
+                _send_frame(sock, {"ok": False, "error": repr(e)})
+            except (OSError, ConnectionError):
+                break
+    _reap_children()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--sock-fd", type=int, required=True,
+                        help="inherited socketpair fd the raylet holds "
+                             "the other end of")
+    parser.add_argument("--preload", default="",
+                        help="comma list of extra modules to pre-import "
+                             "on top of the default worker graph")
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=os.environ.get("RAY_TPU_ZYGOTE_LOG_LEVEL", "INFO"),
+        format="[zygote] %(levelname)s %(name)s: %(message)s")
+    # A terminated raylet closes the socketpair and EOF ends the serve
+    # loop; SIGTERM is only the belt-and-braces external teardown.
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+    preload = list(DEFAULT_PRELOAD)
+    for name in args.preload.split(","):
+        name = name.strip()
+        if name and name not in preload:
+            preload.append(name)
+    sock = socket.socket(fileno=args.sock_fd)
+    sock.setblocking(True)
+    try:
+        serve(sock, preload)
+    finally:
+        sock.close()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# raylet side
+# ---------------------------------------------------------------------------
+
+
+class ZygoteProc:
+    """Popen-shaped handle for a zygote-FORKED worker.
+
+    The raylet is not the child's parent (the zygote is), so
+    ``waitpid`` is unavailable here: liveness comes from
+    ``/proc/<pid>/stat`` and a zombie (state ``Z``, awaiting the
+    zygote's reap pass) already counts as exited. ``kill`` matches the
+    Popen surface the raylet's teardown uses."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self.returncode: Optional[int] = None
+
+    def poll(self) -> Optional[int]:
+        if self.returncode is not None:
+            return self.returncode
+        try:
+            with open(f"/proc/{self.pid}/stat", "rb") as f:
+                state = f.read().rpartition(b") ")[2][:1]
+        except OSError:
+            state = b""
+        if state in (b"", b"Z", b"X"):
+            # gone, zombie, or dead: the exit status lives with the
+            # zygote — report the SIGKILL shape teardown expects.
+            self.returncode = -signal.SIGKILL
+        return self.returncode
+
+    def kill(self) -> None:
+        try:
+            os.kill(self.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+class ZygoteClient:
+    """Raylet-side handle on the template process: launch, spawn
+    requests over the socketpair (asyncio streams, serialized — the
+    zygote answers strictly in order), and teardown."""
+
+    def __init__(self, proc: subprocess.Popen, sock: socket.socket,
+                 log_path: str):
+        self.proc = proc
+        self.log_path = log_path
+        self._sock: Optional[socket.socket] = sock
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._lock = asyncio.Lock()
+        self._ready_banner: Optional[dict] = None
+        # Set when an exchange was interrupted after its request was
+        # written: the reply is (or may be) still in flight, so any
+        # later read would adopt the WRONG frame — the stream is
+        # strictly request/reply ordered. A broken client only errors;
+        # the raylet tears it down and falls back to cold Popen.
+        self._broken = False
+
+    @classmethod
+    def launch(cls, *, session_dir: str, env: Dict[str, str],
+               preload: str = "", tag: str = "") -> "ZygoteClient":
+        """Popen the template. Cheap (~fork+exec): the expensive preload
+        happens inside the zygote while the raylet keeps serving;
+        spawn requests sent meanwhile queue in the socket buffer."""
+        log_dir = os.path.join(session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        log_path = os.path.join(log_dir, f"zygote-{tag or os.getpid()}.log")
+        parent, child = socket.socketpair()
+        cmd = [sys.executable, "-m", "ray_tpu._private.zygote",
+               "--sock-fd", str(child.fileno())]
+        if preload:
+            cmd += ["--preload", preload]
+        out = open(log_path, "ab")
+        try:
+            proc = subprocess.Popen(
+                cmd, stdout=out, stderr=subprocess.STDOUT, env=env,
+                pass_fds=(child.fileno(),), start_new_session=True)
+        finally:
+            out.close()  # Popen dup'd it — the parent copy must not leak
+            child.close()
+        return cls(proc, parent, log_path)
+
+    @property
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    async def _ensure_stream(self) -> None:
+        if self._reader is None:
+            self._reader, self._writer = await asyncio.open_connection(
+                sock=self._sock)
+            # the transport owns the fd now; drop our direct handle so
+            # nothing can double-close it
+            self._sock = None
+
+    async def _read_frame(self) -> dict:
+        try:
+            head = await self._reader.readexactly(4)
+            body = await self._reader.readexactly(
+                struct.unpack("!I", head)[0])
+        except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
+            raise ZygoteError(f"zygote connection lost: {e!r}") from None
+        return json.loads(body)
+
+    async def _call(self, msg: dict) -> dict:
+        async with self._lock:
+            if self._broken:
+                raise ZygoteError("zygote stream out of sync "
+                                  "(a prior exchange was interrupted)")
+            await self._ensure_stream()
+            try:
+                if self._ready_banner is None:
+                    banner = await self._read_frame()
+                    if not banner.get("ready"):
+                        raise ZygoteError(
+                            f"zygote sent {banner!r} before its ready "
+                            f"banner")
+                    if banner.get("preload_errors"):
+                        logger.warning("zygote preload errors: %s",
+                                       banner["preload_errors"])
+                    self._ready_banner = banner
+                payload = json.dumps(msg).encode()
+                try:
+                    self._writer.write(
+                        struct.pack("!I", len(payload)) + payload)
+                    await self._writer.drain()
+                except (ConnectionError, OSError) as e:
+                    raise ZygoteError(
+                        f"zygote write failed: {e!r}") from None
+                return await self._read_frame()
+            except (asyncio.CancelledError, ZygoteError):
+                # cancelled (caller timeout) or failed mid-exchange: a
+                # reply may still land later — no caller may ever read
+                # this stream again or it would mis-pair frames
+                self._broken = True
+                raise
+
+    async def spawn(self, *, worker_id: str, log_path: str,
+                    env_overrides: Dict[str, Optional[str]],
+                    argv: Dict[str, str]) -> int:
+        """Fork one worker; returns its pid (the child is already
+        booting toward RegisterWorker when this resolves)."""
+        reply = await self._call({"op": "spawn", "worker_id": worker_id,
+                                  "log_path": log_path,
+                                  "env": env_overrides, "argv": argv})
+        if not reply.get("ok"):
+            raise ZygoteError(reply.get("error", "spawn refused"))
+        return int(reply["pid"])
+
+    async def ping(self) -> dict:
+        return await self._call({"op": "ping"})
+
+    async def close(self) -> None:
+        """Graceful teardown: EOF ends the serve loop, then a bounded
+        non-blocking reap of the template (its own forked children are
+        either already dead or reparented to init when it exits)."""
+        self._close_pipe()
+        for _ in range(100):
+            if self.proc.poll() is not None:
+                return
+            await asyncio.sleep(0.02)
+        try:
+            self.proc.kill()
+        except OSError:
+            pass
+        for _ in range(50):
+            if self.proc.poll() is not None:
+                return
+            await asyncio.sleep(0.02)
+        logger.warning("zygote pid %s did not exit at close", self.proc.pid)
+
+    def kill(self) -> None:
+        """Abrupt sync teardown (crash-style harnesses): SIGKILL the
+        template and drop the pipe; poll() reaps the zombie."""
+        self._close_pipe()
+        try:
+            self.proc.kill()
+        except OSError:
+            pass
+        self.proc.poll()
+
+    def _close_pipe(self) -> None:
+        try:
+            if self._writer is not None:
+                self._writer.close()
+            elif self._sock is not None:
+                self._sock.close()
+                self._sock = None
+        except OSError:
+            pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,9 +25,14 @@ schedule (the acceptance bar for all recovery paths):
 2. pull-admission budgets return to zero;
 3. no leaked segment leases (``store._lent`` drains);
 4. chaos-created shm segments are unlinked by teardown;
-5. the process fd count returns to its pre-run level (small slack);
+5. the process fd count returns to its pre-run level (small slack) —
+   the task soak brackets the REAL cluster too, which pins the
+   per-spawn worker-log fd leak the cold Popen path used to have;
 6. (task soak) the task-event table records an honest FAILED/RETRY
-   history for every disrupted task.
+   history for every disrupted task;
+7. (task soak) no zombie children survive shutdown: killed workers are
+   reaped by the raylet (Popen path) or the zygote template (fork
+   path), never left for the process's lifetime.
 """
 
 from __future__ import annotations
@@ -140,6 +145,26 @@ def _fd_count() -> int:
     return len(os.listdir("/proc/self/fd"))
 
 
+def _zombie_children() -> List[int]:
+    """Pids of zombie children of THIS process. A SIGKILLed worker that
+    nobody wait()s stays a zombie for the parent's lifetime — the
+    raylet must reap on kill/disconnect (and the zygote template reaps
+    its own forked workers)."""
+    me = os.getpid()
+    zombies: List[int] = []
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit():
+            continue
+        try:
+            with open(f"/proc/{entry}/stat", "rb") as f:
+                rest = f.read().rpartition(b") ")[2].split()
+        except OSError:
+            continue  # raced a process exit
+        if rest[:1] == [b"Z"] and int(rest[1]) == me:
+            zombies.append(int(entry))
+    return zombies
+
+
 class DataPlaneChaos:
     """In-process GCS + raylets under a chaos schedule, with a pull
     workload and per-round invariant checks."""
@@ -246,6 +271,11 @@ class DataPlaneChaos:
         if getattr(r, "_log_monitor_task", None):
             r._log_monitor_task.cancel()
         await r._server.close()
+        if r._zygote is not None:
+            # abrupt death takes the worker factory with it (no
+            # graceful EOF drain — this is a crash)
+            r._zygote.kill()
+            r._zygote = None
         if r.gcs_conn and not r.gcs_conn.closed:
             await r.gcs_conn.close()
         if r.data_server is not None:
@@ -422,6 +452,7 @@ def run_task_schedule(seed: int, kill_nth: int = 6,
     import ray_tpu
     from ray_tpu import exceptions as exc_mod
 
+    fd_before = _fd_count()
     os.environ[faultpoints.ENV_VAR] = json.dumps(
         [{"name": "task.execute", "action": "kill", "nth": kill_nth}])
     try:
@@ -497,8 +528,28 @@ def run_task_schedule(seed: int, kill_nth: int = 6,
         assert n_retry > 0, \
             "workers died but the task-event table shows no " \
             "RETRY/FAILED history"
-        return {"tasks": n_tasks, "ok": n_ok, "crashed": n_crashed,
-                "bumps": bumps, "retry_or_failed_events": n_retry}
+        summary = {"tasks": n_tasks, "ok": n_ok, "crashed": n_crashed,
+                   "bumps": bumps, "retry_or_failed_events": n_retry}
     finally:
         os.environ.pop(faultpoints.ENV_VAR, None)
         ray_tpu.shutdown()
+
+    # Post-shutdown process-hygiene invariants for the REAL cluster.
+    # Zombies: every chaos-killed worker must have been reaped (by the
+    # raylet for Popen spawns, by the zygote for forked spawns) — a
+    # short grace window covers kills still settling at shutdown.
+    import time as time_mod
+    deadline = time_mod.time() + 5.0
+    zombies = _zombie_children()
+    while zombies and time_mod.time() < deadline:
+        time_mod.sleep(0.1)
+        zombies = _zombie_children()
+    assert not zombies, \
+        f"unreaped worker zombies survive shutdown: {zombies}"
+    # Fd bracket: the head raylet ran in-process, so a per-spawn leak
+    # (e.g. the worker-log fd the parent used to keep open per Popen)
+    # shows up right here across the dozens of spawns chaos causes.
+    fd_after = _fd_count()
+    assert fd_after <= fd_before + 8, \
+        f"fd leak across the task soak: {fd_before} -> {fd_after}"
+    return summary
