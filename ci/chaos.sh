@@ -3,7 +3,10 @@
 # driven by tests/test_chaos.py) over the in-process data plane AND the
 # real subprocess cluster — stripe sever, corrupt chunk, short read,
 # delay storm, raylet crash, heartbeat partition, GCS restart, mixed,
-# worker kill. Runs the slow-marked schedules too (tier-1 carries only
+# worker kill, OOM storm (seeded simulated-RSS ramps through the node
+# memory watchdog: kills, OOM retries, lease backpressure — asserting
+# the raylet/GCS survive every event).
+# Runs the slow-marked schedules too (tier-1 carries only
 # the 2-schedule smoke); any invariant violation (pull hang, admission
 # budget leak, segment-lease leak, fd leak, unresurrected partitioned
 # node, dishonest task-event history) fails CI.

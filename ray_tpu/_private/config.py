@@ -137,6 +137,39 @@ class RayTpuConfig:
     # import time (jax is deliberately absent from the default list).
     zygote_preload_modules: str = ""
 
+    # --- memory watchdog (memory_monitor.py) ---
+    # Master switch for the raylet-side node memory watchdog. On (the
+    # default) the raylet polls node memory on its heartbeat cadence
+    # and, above memory_usage_threshold, runs the ordered degradation
+    # sequence: store spill/evict pressure relief, then SIGKILL of the
+    # most-recently-started retriable task's worker (surfaced to the
+    # owner as a retriable OutOfMemoryError), plus lease backpressure
+    # (new lease requests spill to other nodes or get a typed
+    # retry-later) — instead of letting the kernel OOM killer shoot a
+    # random process (often the raylet or GCS) and take the node down.
+    memory_monitor_enabled: bool = True
+    # Node-memory usage fraction above which the watchdog engages
+    # (reference: RAY_memory_usage_threshold, default 0.95). Usage is
+    # cgroup-aware: a container's memory limit wins over the host
+    # total, so the threshold tracks the boundary the kernel OOM
+    # killer actually enforces.
+    memory_usage_threshold: float = 0.95
+    # Minimum seconds between watchdog evaluations. The poll rides the
+    # raylet heartbeat loop (no extra thread/timer), so the effective
+    # cadence is max(this, raylet_heartbeat_period_ms). Each poll does
+    # a handful of µs-scale procfs reads; bench.py's
+    # memory_monitor_overhead row pins the cost under 2%.
+    memory_monitor_interval_s: float = 0.5
+    # Dedicated retry budget for watchdog OOM kills, SEPARATE from
+    # max_retries: a task killed for memory pressure did nothing wrong
+    # and shouldn't burn its worker-crash budget, but unbounded OOM
+    # retries of a genuinely ballooning task would thrash the node
+    # forever. Retries are paced with the shared exponential-jitter
+    # backoff (backoff.py). 0 = never retry OOM kills; -1 = unlimited.
+    # Non-retriable tasks (max_retries=0) always surface
+    # OutOfMemoryError immediately.
+    task_oom_retries: int = 3
+
     # --- liveness / fault tolerance ---
     raylet_heartbeat_period_ms: int = 250
     # 10s of silence marks a node dead (reference default ≈3s; wider

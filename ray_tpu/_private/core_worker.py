@@ -80,7 +80,8 @@ class PendingTaskEntry:
     pending-task table, src/ray/core_worker/task_manager.h)."""
 
     __slots__ = ("spec", "num_retries_left", "return_ids", "dep_ids",
-                 "lineage_pinned", "recovery_waiter")
+                 "lineage_pinned", "recovery_waiter", "oom_retries_left",
+                 "oom_backoff")
 
     def __init__(self, spec: TaskSpec, return_ids: List[ObjectID]):
         self.spec = spec
@@ -93,6 +94,14 @@ class PendingTaskEntry:
         # Future resolved on the next completion of this task (set by
         # object recovery while it waits for the re-execution).
         self.recovery_waiter = None
+        # Dedicated memory-watchdog retry budget (config
+        # task_oom_retries), SEPARATE from num_retries_left: an OOM
+        # kill is the node's fault, not the task's. None = not yet
+        # initialized — the first OOM kill fills it from config, and
+        # the C fastpath (cpp/fastpath.c) leaves these two slots UNSET
+        # entirely, so every reader goes through getattr defaults.
+        self.oom_retries_left = None
+        self.oom_backoff = None
 
 
 class LeasedWorker:
@@ -218,6 +227,15 @@ class CoreWorker:
         self.gcs_conn: Optional[rpc.Connection] = None
         self._gcs_reconnect_lock = asyncio.Lock()
         self.raylet_conn: Optional[rpc.Connection] = None
+        # worker_id -> (monotonic ts, structured WORKER_OOM cause),
+        # recorded by a raylet's WorkerOOMKilled call before its memory
+        # watchdog kills a worker this owner leased (the ack-then-kill
+        # ordering means the cause is here before the worker socket
+        # drops). Bounded, and time-bounded at lookup: a kill the
+        # raylet's re-grant guard ABORTED leaves an entry with no
+        # matching death — without the age check, that worker's later
+        # unrelated crash would be misclassified as an OOM kill.
+        self._oom_worker_kills: Dict[bytes, tuple] = {}
         self._server = rpc.RpcServer(self._owner_handlers(), name=f"cw-{mode}")
         self.address = ""
         self._owner_conns: Dict[str, rpc.Connection] = {}
@@ -466,7 +484,14 @@ class CoreWorker:
             raise RuntimeError("attempted self-connection for owner RPC")
         conn = self._owner_conns.get(address)
         if conn is None or conn.closed:
-            conn = await rpc.connect(address, peer_name=f"owner@{address}")
+            # Share the server's handler dict (same as raylet_conn): a
+            # REMOTE raylet this owner leased from must be able to call
+            # back over this pipe — e.g. WorkerOOMKilled before a
+            # watchdog kill, which classifies the death as a retriable
+            # OutOfMemoryError instead of a generic worker crash.
+            conn = await rpc.connect(address,
+                                     handlers=self._server.handlers,
+                                     peer_name=f"owner@{address}")
             self._owner_conns[address] = conn
         return conn
 
@@ -479,9 +504,24 @@ class CoreWorker:
             "AddObjectLocation": self._handle_add_object_location,
             "AddBorrower": self._handle_add_borrower,
             "RemoveBorrower": self._handle_remove_borrower,
+            "WorkerOOMKilled": self._handle_worker_oom_killed,
             "Ping": self._handle_ping,
         }
         return handlers
+
+    async def _handle_worker_oom_killed(self, conn, header, bufs):
+        """Raylet push: the node memory watchdog is killing a worker
+        this owner leased. Recording the cause BEFORE the worker socket
+        drops lets _retry_or_fail_after_worker_death classify the death
+        as a retriable OutOfMemoryError (dedicated task_oom_retries
+        budget) instead of a generic worker crash."""
+        cause = header.get("cause") or {"kind": "WORKER_OOM"}
+        self._oom_worker_kills[header["worker_id"]] = \
+            (time.monotonic(), cause)
+        while len(self._oom_worker_kills) > 64:
+            self._oom_worker_kills.pop(
+                next(iter(self._oom_worker_kills)))
+        return {}
 
     async def _handle_ping(self, conn, header, bufs):
         return {"ok": True, "mode": self.mode}
@@ -1382,25 +1422,54 @@ class CoreWorker:
     async def _request_lease(self, sc: int, state: SchedulingKeyState,
                              raylet_address: str, depth: int = 0):
         try:
-            sample = state.queue[0] if state.queue else None
-            summary = sample.lease_summary() if sample is not None else {
-                "task_id": b"", "scheduling_class": sc,
-                "resources": state.resources, "deps": [],
-                "strategy": "DEFAULT", "pg_id": b"", "pg_bundle": -1,
-                "runtime_env": None, "depth": 0, "name": ""}
-            if sample is not None:
-                dep_info = self._dep_info(sample)
-                summary["dep_info"] = dep_info
-                if dep_info and depth == 0 and \
-                        raylet_address == self.raylet_address:
-                    target = await self._best_locality_raylet(dep_info)
-                    if target:
-                        raylet_address = target
+            def _build_summary():
+                sample = state.queue[0] if state.queue else None
+                if sample is None:
+                    return {
+                        "task_id": b"", "scheduling_class": sc,
+                        "resources": state.resources, "deps": [],
+                        "strategy": "DEFAULT", "pg_id": b"",
+                        "pg_bundle": -1, "runtime_env": None,
+                        "depth": 0, "name": "", "retriable": False}
+                s = sample.lease_summary()
+                s["dep_info"] = self._dep_info(sample)
+                return s
+
+            summary = _build_summary()
+            dep_info = summary.get("dep_info")
+            if dep_info and depth == 0 and \
+                    raylet_address == self.raylet_address:
+                target = await self._best_locality_raylet(dep_info)
+                if target:
+                    raylet_address = target
             if raylet_address == self.raylet_address:
                 conn = self.raylet_conn
             else:
                 conn = await self._get_owner_conn(raylet_address)
-            reply, _ = await conn.call("RequestWorkerLease", {"summary": summary})
+            bo = None
+            while True:
+                reply, _ = await conn.call("RequestWorkerLease",
+                                           {"summary": summary})
+                if not reply.get("retry_later"):
+                    break
+                # Typed lease backpressure: the raylet is above its
+                # memory threshold and admits no new work. Back off
+                # with jitter and re-request while this scheduling
+                # class still has backlog (pressure clears when the
+                # watchdog frees memory or the work drains elsewhere);
+                # once the queue empties, stop asking.
+                if self._shutdown or not state.queue:
+                    state.pending_lease -= 1
+                    return
+                if bo is None:
+                    from ray_tpu._private import backoff as backoff_mod
+                    bo = backoff_mod.from_config(self.config)
+                await bo.sleep()
+                # re-sample the CURRENT queue head: the task sampled
+                # before the backoff may have completed (stolen,
+                # cancelled) — its task-events and retriable flag must
+                # not be stamped onto whatever runs next
+                summary = _build_summary()
         except (ConnectionError, asyncio.CancelledError):
             state.pending_lease -= 1
             return
@@ -1430,6 +1499,20 @@ class CoreWorker:
                 await self._return_lease(lw)
         elif reply.get("spill") and depth < 4:
             await self._request_lease(sc, state, reply["spill"], depth + 1)
+        elif reply.get("spill"):
+            # Spill chain exhausted — e.g. mutually memory-pressured
+            # nodes bouncing the request between each other (each zeroes
+            # only its OWN availability in the backpressure view). The
+            # old silent drop left the queue stranded with
+            # pending_lease=0 and nothing to re-pump it. Back off, then
+            # start over from the HOME raylet: pressure clears and the
+            # home node re-admits (or re-spills somewhere healthy).
+            state.pending_lease -= 1
+            if state.queue and not self._shutdown:
+                from ray_tpu._private import backoff as backoff_mod
+                await backoff_mod.from_config(self.config).sleep()
+                if state.queue and not self._shutdown:
+                    self._pump_scheduling_key(sc, state)
         elif reply.get("infeasible"):
             state.pending_lease -= 1
             self._fail_queued_tasks(state, exc.RaySystemError(
@@ -1584,6 +1667,17 @@ class CoreWorker:
                 del state.reassigned[spec.task_id]
             return
         entry = self.pending_tasks.get(spec.task_id)
+        # OOM classification is only trusted close to the notify: the
+        # SIGKILL follows the owner's ack within ~1s, so a much older
+        # entry means the kill was aborted (re-grant guard) and THIS
+        # death has some other cause.
+        rec = self._oom_worker_kills.get(via_worker_id) \
+            if via_worker_id else None
+        oom_cause = rec[1] if rec is not None and \
+            time.monotonic() - rec[0] < 5.0 else None
+        if oom_cause is not None:
+            self._retry_or_fail_after_oom_kill(spec, entry, oom_cause)
+            return
         if entry is not None and entry.num_retries_left != 0:
             if entry.num_retries_left > 0:
                 entry.num_retries_left -= 1
@@ -1597,6 +1691,44 @@ class CoreWorker:
             self._store_error_for_task(
                 spec, exc.WorkerCrashedError(
                     f"worker died executing {spec.name}"))
+
+    def _retry_or_fail_after_oom_kill(self, spec: TaskSpec, entry,
+                                      cause: dict):
+        """Worker was killed by a node's memory watchdog: retry under
+        the DEDICATED ``task_oom_retries`` budget (an OOM kill is the
+        node's pressure, not the task's bug — the generic worker-crash
+        budget survives), paced by the shared exponential-jitter
+        backoff so a genuinely ballooning task can't hot-loop
+        kill/retry against a node that is still at the threshold.
+        Exhausted budget — or a non-retriable task — surfaces a typed
+        :class:`~ray_tpu.exceptions.OutOfMemoryError` carrying the
+        watchdog's cause (node/worker ids + per-worker RSS snapshot)."""
+        left = getattr(entry, "oom_retries_left", None) \
+            if entry is not None else None
+        if left is None:
+            # first OOM for this task (or a C-fastpath entry whose
+            # slots were never initialized): budget comes from config
+            left = self.config.task_oom_retries
+        if entry is not None and spec.max_retries != 0 and left != 0:
+            entry.oom_retries_left = left - 1 if left > 0 else left
+            self.stats["tasks_retried"] += 1
+            if self.task_events.enabled:
+                self.task_events.record(spec.task_id, RETRY,
+                                        {"reason": "worker OOM-killed"})
+            bo = getattr(entry, "oom_backoff", None)
+            if bo is None:
+                from ray_tpu._private import backoff as backoff_mod
+                bo = entry.oom_backoff = backoff_mod.from_config(
+                    self.config)
+            delay = bo.next_delay()
+            logger.info("retrying task %s in %.2fs after watchdog OOM "
+                        "kill", spec.name, delay)
+            self.loop.call_later(delay, self._queue_spec, spec)
+        else:
+            self._store_error_for_task(
+                spec, exc.OutOfMemoryError(
+                    f"worker running {spec.name} was killed by the "
+                    f"node memory watchdog", cause=cause))
 
     def _on_push_batch_done(self, fut: asyncio.Future, sc: int,
                             state: SchedulingKeyState, lw: LeasedWorker,

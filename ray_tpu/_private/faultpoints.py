@@ -57,6 +57,9 @@ Wired point catalogue (name — owning layer — ctx keys):
 * ``gcs.journal.append``   — gcs.py            — op
 * ``gcs.journal.replay``   — gcs.py            — op, n
 * ``task.execute``         — task_executor.py  — name, task_id
+* ``memory.poll``          — memory_monitor.py — node, sim, pids
+* ``memory.kill``          — memory_monitor.py — node, worker, pid
+* ``lease.backpressure``   — raylet.py         — node
 
 Match predicates (all optional, AND-combined):
 

@@ -93,6 +93,15 @@ class WorkerHandle:
         self.actor_id: bytes = b""
         self.job_id: bytes = b""
         self.started_at = time.time()
+        # Memory-watchdog victim ordering (memory_monitor.py): when the
+        # current lease was granted, and whether its sample task is
+        # retriable — the watchdog kills the NEWEST retriable leased
+        # worker first and never touches non-retriable work.
+        self.leased_at = 0.0
+        self.lease_retriable = False
+        # set once the watchdog dispatched this worker to the async
+        # owner-acked kill path (prevents double-selection)
+        self.oom_kill_pending = False
         # Runtime env this worker last activated: leases prefer a match
         # (reference: worker_pool.h:135 runtime_env_hash PopWorker key).
         self.env_hash: str = ""
@@ -215,6 +224,29 @@ class Raylet:
         self._nid12 = self.node_id.hex()[:12]
         # per-pull throughput reservoir (GB/s), reported by GetNodeStats
         self._pull_rates: Any = _deque(maxlen=4096)
+        # Host-stats collection handles, cached once: importing psutil
+        # and constructing a fresh Process() every heartbeat wasted
+        # ~100us/beat, and cpu_percent(interval=None) on a fresh
+        # object has no "last call" to diff against (first sample is
+        # meaningless 0.0) — the cached handle makes the since-last-
+        # call sample real from the second beat on.
+        try:
+            import psutil as _psutil
+            self._psutil = _psutil
+            self._psutil_proc = _psutil.Process()
+            _psutil.cpu_percent(interval=None)  # prime the diff sample
+        except Exception:  # noqa: BLE001 — host stats are best-effort decoration
+            self._psutil = None
+            self._psutil_proc = None
+        # Node memory watchdog (memory_monitor.py): polled from the
+        # heartbeat loop; turns memory pressure into ordered relief ->
+        # retriable OOM kill -> lease backpressure instead of letting
+        # the kernel OOM killer shoot a random process.
+        from ray_tpu._private.memory_monitor import MemoryMonitor
+        self.memory_monitor = MemoryMonitor(
+            config, self.store, self._nid12,
+            workers=lambda: self.workers.values(),
+            kill_worker=self._oom_kill_worker)
 
     def _handlers(self):
         return {
@@ -406,22 +438,36 @@ class Raylet:
             "store_num_spills": s["num_spills"],
             "store_num_evictions": s["num_evictions"],
         }
-        try:
-            import psutil
-
-            # interval=None: non-blocking since-last-call sample
-            out["host_cpu_percent"] = psutil.cpu_percent(interval=None)
-            vm = psutil.virtual_memory()
-            out["host_mem_used_bytes"] = float(vm.used)
-            out["host_mem_total_bytes"] = float(vm.total)
-            du = psutil.disk_usage(self.session_dir or "/")
-            out["host_disk_used_bytes"] = float(du.used)
-            out["host_disk_total_bytes"] = float(du.total)
-            proc = psutil.Process()
-            out["raylet_rss_bytes"] = float(proc.memory_info().rss)
-        # raylint: disable=exception-hygiene — host stats are best-effort decoration
-        except Exception:
-            pass
+        mon = self.memory_monitor
+        if mon is not None:
+            # watchdog state rides every beat (flat, same style as the
+            # spill/eviction counters): per-worker RSS sum, pressure
+            # flag, cumulative kill/backpressure counts — all honest
+            # (monotonic counters, last-poll gauges).
+            out["workers_rss_bytes"] = sum(
+                mon.workers_rss.values())
+            out["memory_pressure"] = mon.pressure
+            out["memory_usage_fraction"] = round(mon.usage_fraction, 4)
+            out["memory_monitor_kills"] = mon.kills
+            out["lease_backpressure_rejects"] = mon.backpressure_rejects
+        if self._psutil is not None:
+            try:
+                # cached module + Process handle (set at __init__):
+                # interval=None is a non-blocking since-last-call
+                # sample, real because init primed the first call
+                out["host_cpu_percent"] = \
+                    self._psutil.cpu_percent(interval=None)
+                vm = self._psutil.virtual_memory()
+                out["host_mem_used_bytes"] = float(vm.used)
+                out["host_mem_total_bytes"] = float(vm.total)
+                du = self._psutil.disk_usage(self.session_dir or "/")
+                out["host_disk_used_bytes"] = float(du.used)
+                out["host_disk_total_bytes"] = float(du.total)
+                out["raylet_rss_bytes"] = float(
+                    self._psutil_proc.memory_info().rss)
+            # raylint: disable=exception-hygiene — host stats are best-effort decoration
+            except Exception:
+                pass
         # NOTE: latency percentiles are deliberately NOT computed here —
         # sorting a 64k reservoir 4x/s on the event loop would stall
         # heartbeats under load; GetNodeStats computes them on demand.
@@ -437,6 +483,24 @@ class Raylet:
         period = self.config.raylet_heartbeat_period_ms / 1000.0
         while not self._closing:
             try:
+                # Memory watchdog rides the heartbeat cadence (interval
+                # gate inside poll) — BEFORE the heartbeat-drop fault
+                # seam: a partitioned node must still protect itself
+                # from the kernel OOM killer. Shielded: a watchdog
+                # error (an armed hook that raises, an exotic procfs)
+                # must degrade to a missed poll, never take down the
+                # heartbeat loop — that would convert memory pressure
+                # into the node death the watchdog exists to prevent.
+                try:
+                    was_pressure = self.memory_monitor.pressure
+                    self.memory_monitor.poll()
+                    if was_pressure and not self.memory_monitor.pressure:
+                        # pressure cleared: re-evaluate whatever the
+                        # backpressure window parked (PG leases stay
+                        # pending through it — nothing else ticks them)
+                        self._schedule_tick()
+                except Exception:  # noqa: BLE001 — missed poll < dead node
+                    logger.exception("memory watchdog poll failed")
                 if faultpoints.armed:
                     # heartbeat-partition fault: ``drop`` suppresses the
                     # beat (fired BEFORE the event drain, so no task
@@ -893,7 +957,16 @@ class Raylet:
                 summary.get("runtime_env")),
             arrival_ts=time.monotonic(),
             task_id=summary.get("task_id") or b"",
+            retriable=bool(summary.get("retriable", False)),
         )
+        if self.memory_monitor.pressure:
+            # Lease backpressure (watchdog sequence step 3): above the
+            # memory threshold this node admits NO new work — it would
+            # only be killed. Spill to a node with capacity when one
+            # exists (the existing spillback path drains work off the
+            # hot node), else a typed retry-later the owner backs off
+            # on (backoff.py pacing, core_worker._request_lease).
+            return self._memory_backpressure_reply(req)
         if self.task_events.enabled and req.task_id:
             # the lease request carries the SAMPLE task at the head of
             # the owner's queue — that task's lease wait starts here
@@ -964,6 +1037,112 @@ class Raylet:
         if entry and not entry[1].done():
             entry[1].cancel()
 
+    # ------------------------------------------------- memory watchdog seams
+
+    def _backpressure_views(self) -> List[NodeView]:
+        """Cluster view with the LOCAL node's availability zeroed: the
+        scheduler's own spillback scoring then picks drain targets for
+        backpressured leases exactly like an ordinary saturated-node
+        spill."""
+        views = self._node_views()
+        for v in views:
+            if v.is_local:
+                v.available = {k: 0.0 for k in v.available}
+        return views
+
+    def _memory_backpressure_reply(self, req: PendingRequest,
+                                   views: Optional[List[NodeView]] = None
+                                   ) -> dict:
+        """The reply for a lease request rejected under memory pressure.
+        Reuses the real scheduler for target choice (see
+        _backpressure_views; a tick-time flush passes the view list in
+        so it is built once per tick, not once per request).
+        PG-targeted requests can't move (the bundle's node was fixed at
+        PG creation) — they always get retry-later."""
+        self.memory_monitor.note_backpressure()
+        if faultpoints.armed:
+            faultpoints.fire("lease.backpressure", node=self._nid12)
+        if not req.pg_id:
+            if views is None:
+                views = self._backpressure_views()
+            decisions = self.backend.schedule(
+                [req], views, self.config.scheduler_spread_threshold)
+            if decisions and decisions[0].action == SPILL:
+                self.num_spillbacks += 1
+                if self.task_events.enabled and req.task_id:
+                    self.task_events.record(
+                        req.task_id, SPILLBACK,
+                        {"node": self._nid12,
+                         "target": decisions[0].spill_address,
+                         "reason": "memory_pressure"})
+                return {"granted": False,
+                        "spill": decisions[0].spill_address}
+        return {"granted": False, "retry_later": True,
+                "reason": "node memory pressure"}
+
+    def _oom_kill_worker(self, handle: WorkerHandle, cause: dict) -> None:
+        """Watchdog kill (memory_monitor.py step 2), dispatched async:
+        the SIGKILL must not land before the owner KNOWS this death is
+        an OOM kill."""
+        asyncio.get_event_loop().create_task(
+            self._oom_kill_worker_async(handle, cause))
+
+    async def _oom_kill_worker_async(self, handle: WorkerHandle,
+                                     cause: dict) -> None:
+        """Tell the lease's owner FIRST and wait for its ack — a
+        fire-and-forget push races the worker-socket EOF the SIGKILL
+        produces, and the owner's retry decision runs on whichever
+        arrives first. Only once the owner has recorded the cause (so
+        the death is retried under the dedicated task_oom_retries
+        budget as OutOfMemoryError, not the generic worker-crash
+        budget) does the SIGKILL go out. An unreachable/slow owner
+        bounds the wait at 1 s: the kill proceeds and the death
+        degrades honestly to a generic WorkerCrashedError retry."""
+        lease_id = handle.lease_id
+        if handle.state != WORKER_LEASED or lease_id is None or \
+                self.workers.get(handle.worker_id) is not handle:
+            handle.oom_kill_pending = False
+            return  # died / returned / replaced since the poll selected it
+        lease = self.leases.get(lease_id)
+        if lease is not None and lease.client is not None and \
+                not lease.client.closed:
+            try:
+                await asyncio.wait_for(lease.client.call(
+                    "WorkerOOMKilled", {
+                        "worker_id": handle.worker_id,
+                        "cause": cause}), timeout=1.0)
+            # raylint: disable=exception-hygiene — best-effort notify: an owner that can't ack still gets a typed (generic) worker-crash retry
+            except Exception:
+                pass
+        # Re-grant guard: the lease may have completed during the ack
+        # wait and the worker gone idle — or been re-leased to a
+        # DIFFERENT owner that was never notified. Killing now would
+        # shoot an innocent task and burn its generic crash budget:
+        # abort, let the next poll re-evaluate on fresh state.
+        if handle.state != WORKER_LEASED or handle.lease_id != lease_id \
+                or self.workers.get(handle.worker_id) is not handle:
+            handle.oom_kill_pending = False
+            return
+        self.memory_monitor.note_kill()
+        self.events.emit(
+            "WARNING", "WORKER_OOM_KILLED",
+            f"memory watchdog killed worker "
+            f"{handle.worker_id.hex()[:12]}",
+            pid=handle.pid, node=self._nid12,
+            usage_fraction=cause.get("usage_fraction"),
+            rss=cause.get("workers_rss", {}).get(
+                handle.worker_id.hex()[:12]))
+        # _kill_worker pre-sets WORKER_DEAD, which makes the later
+        # socket-EOF hit _on_worker_disconnect's early return — so the
+        # disconnect path would never reclaim this handle. Do the full
+        # teardown here, like every other _kill_worker call site: lease
+        # released (resources returned), handle dropped from the table.
+        self._kill_worker(handle)
+        if lease_id in self.leases:
+            self._release_lease(lease_id, worker_alive=False)
+        self.workers.pop(handle.worker_id, None)
+        self._schedule_tick()
+
     def _schedule_tick(self):
         if self._tick_scheduled or self._closing:
             return
@@ -973,6 +1152,25 @@ class Raylet:
     def _run_tick(self):
         self._tick_scheduled = False
         if self._closing or not self._pending:
+            return
+        if self.memory_monitor.pressure:
+            # Backpressure covers requests queued BEFORE the threshold
+            # crossing too: flush them with the same spill/retry-later
+            # reply so they drain to other nodes instead of waiting to
+            # be granted into a node that would kill them. PG-targeted
+            # requests stay pending — their bundle is reserved HERE so
+            # they can't move — but are NOT granted either: they park
+            # until the pressure clears (the heartbeat loop ticks on
+            # the pressure->clear transition).
+            bp_views = self._backpressure_views()
+            for rid in sorted(self._pending.keys()):
+                req, fut = self._pending[rid]
+                if req.pg_id or fut.done():
+                    continue
+                self._pending.pop(rid)
+                self._note_latency(req)
+                fut.set_result((self._memory_backpressure_reply(
+                    req, views=bp_views), ()))
             return
         # PG-targeted requests bypass node scoring: the bundle's node was
         # fixed at PG creation (reference: placement-group scheduling
@@ -1052,6 +1250,8 @@ class Raylet:
             self.resources_available[k] = self.resources_available.get(k, 0.0) - v
         worker.state = WORKER_LEASED
         worker.lease_id = lease_id
+        worker.leased_at = time.monotonic()
+        worker.lease_retriable = req.retriable
         client = getattr(fut, "client", None)
         lease = LeaseEntry(lease_id, worker, req.resources, client)
         self.leases[lease_id] = lease
@@ -1112,6 +1312,8 @@ class Raylet:
         lease_id = next(self._lease_counter)
         worker.state = WORKER_LEASED
         worker.lease_id = lease_id
+        worker.leased_at = time.monotonic()
+        worker.lease_retriable = req.retriable
         lease = LeaseEntry(lease_id, worker, req.resources,
                            getattr(fut, "client", None))
         lease.pg_key = key  # type: ignore[attr-defined]
@@ -2117,9 +2319,15 @@ class Raylet:
             "workers": [{
                 "worker_id": w.worker_id, "pid": w.pid, "state": w.state,
                 "actor_id": w.actor_id,
+                # last watchdog poll's RSS sample (0 before any poll)
+                "rss_bytes": self.memory_monitor.workers_rss.get(
+                    w.worker_id.hex()[:12], 0),
             } for w in self.workers.values()],
             "num_pending_leases": len(self._pending),
             "num_leases_granted": self.num_leases_granted,
             "num_spillbacks": self.num_spillbacks,
             "store": self.store.stats(),
+            # watchdog state: per-worker RSS, pressure flag, cumulative
+            # kill/backpressure counts + last-64 action history
+            "memory_monitor": self.memory_monitor.snapshot(),
         }

@@ -59,6 +59,11 @@ class PendingRequest:
     # separately — on a saturated node the latter measures queue depth,
     # not the kernel).
     first_decision_ts: float = 0.0
+    # Whether the sample task is retriable (max_retries != 0): the
+    # granted worker inherits this as its memory-watchdog victim
+    # eligibility (memory_monitor.py kills only retriable work).
+    # Defaults False so a summary without the field never enables kills.
+    retriable: bool = False
 
 
 @dataclass

@@ -869,6 +869,19 @@ class ShmStoreServer:
         except OSError:
             pass
 
+    def relieve_memory_pressure(self, need_bytes: int) -> int:
+        """Node-memory-watchdog hook (memory_monitor.py): free up to
+        ``need_bytes`` of tmpfs pages — recycle pool first (parked
+        segments are free memory, not data), then the normal LRU
+        evict/spill path. Returns the bytes actually released, so the
+        watchdog can tell whether relief resolved the pressure crossing
+        before it considers killing a worker."""
+        if need_bytes <= 0:
+            return 0
+        before = self.used + self.recycle_bytes
+        self._evict(need_bytes)
+        return max(0, before - (self.used + self.recycle_bytes))
+
     def _evict(self, need_bytes: int) -> None:
         """Evict LRU unpinned objects; pinned primaries are spilled to disk
         instead of dropped when spilling is on. The recycle pool drains

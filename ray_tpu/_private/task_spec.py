@@ -342,4 +342,8 @@ class TaskSpec:
             "runtime_env": self.runtime_env,
             "depth": self.depth,
             "name": self.name,
+            # memory-watchdog victim eligibility: only workers running
+            # retriable work may be OOM-killed (memory_monitor.py).
+            # Sample-task approximation, like every summary field.
+            "retriable": self.max_retries != 0,
         }

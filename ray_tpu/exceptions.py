@@ -128,6 +128,34 @@ class ObjectLostError(RayTpuError):
         return str(self.cause_info.get("kind", ""))
 
 
+class OutOfMemoryError(RayTpuError):
+    """The node memory watchdog killed the worker executing the task.
+
+    Raised at ``get`` once the task's dedicated OOM retry budget
+    (``task_oom_retries``) is exhausted — or immediately for a
+    non-retriable task (``max_retries=0``). Unlike a kernel OOM kill,
+    this is an *ordered* eviction: store spill/evict pressure relief ran
+    first, the raylet and GCS survive, and the kill is retriable.
+
+    ``cause`` mirrors :class:`ActorDiedError`'s structured death cause::
+
+        {"kind": "WORKER_OOM", "node_id": hex, "worker_id": hex,
+         "usage_fraction": float, "threshold": float,
+         "workers_rss": {worker_id12: rss_bytes, ...},  # at kill time
+         "message": str}
+    """
+
+    def __init__(self, reason: str = "worker killed by the node memory "
+                 "watchdog", cause: dict | None = None):
+        self.reason = reason
+        self.cause_info = dict(cause or {})
+        super().__init__(reason + _format_cause(self.cause_info))
+
+    @property
+    def cause_kind(self) -> str:
+        return str(self.cause_info.get("kind", ""))
+
+
 class ObjectStoreFullError(RayTpuError):
     """The shared-memory object store cannot fit the object even after
     eviction and spilling."""

@@ -33,6 +33,38 @@ def node_stats() -> List[dict]:
     return reply.get("nodes", [])
 
 
+def summary_nodes() -> List[dict]:
+    """Per-node summary rows built from the heartbeat-carried stats:
+    resource totals, worker/store occupancy, and the memory-watchdog
+    state — per-node ``workers_rss_bytes`` (sum of worker RSS at the
+    last watchdog poll), the ``memory_pressure`` flag, and the honest
+    cumulative ``memory_monitor_kills`` / ``lease_backpressure_rejects``
+    counts (same counter style as the spill/eviction stats)."""
+    out = []
+    for n in node_stats():
+        s = n.get("stats", {})
+        nid = n["node_id"].hex() if isinstance(n["node_id"], bytes) \
+            else str(n["node_id"])
+        out.append({
+            "node_id": nid,
+            "node_name": n.get("node_name", ""),
+            "alive": n.get("alive", False),
+            "resources_total": n.get("resources_total", {}),
+            "resources_available": n.get("resources_available", {}),
+            "num_workers": s.get("num_workers", 0),
+            "store_used_bytes": s.get("store_used_bytes", 0),
+            "store_num_spills": s.get("store_num_spills", 0),
+            "store_num_evictions": s.get("store_num_evictions", 0),
+            "workers_rss_bytes": s.get("workers_rss_bytes", 0),
+            "memory_pressure": s.get("memory_pressure", False),
+            "memory_usage_fraction": s.get("memory_usage_fraction", 0.0),
+            "memory_monitor_kills": s.get("memory_monitor_kills", 0),
+            "lease_backpressure_rejects":
+                s.get("lease_backpressure_rejects", 0),
+        })
+    return out
+
+
 def metrics_address() -> str:
     """host:port of the cluster's Prometheus text endpoint."""
     addr = ray_tpu.experimental_internal_kv_get(

@@ -76,7 +76,7 @@ CHAOS_CFG = {
 SCHEDULE_KINDS = (
     "stripe_sever", "corrupt_chunk", "short_read", "delay_storm",
     "raylet_kill", "heartbeat_partition", "gcs_restart", "mixed",
-    "worker_kill",
+    "worker_kill", "oom_storm",
 )
 
 # Event vocabulary for the data-plane harness. Each entry generates a
@@ -103,11 +103,15 @@ def make_schedule(kind: str, seed: int, rounds: int = 8,
     Events are keyed by the workload round BEFORE which they apply;
     ``target`` indexes the raylet they hit (resolved to whatever is
     still alive at run time)."""
-    if kind not in _KIND_OPS and kind != "worker_kill":
+    if kind not in _KIND_OPS and kind not in ("worker_kill", "oom_storm"):
         raise ValueError(f"unknown schedule kind {kind!r}")
     if kind == "worker_kill":
         # the worker-kill schedule is carried by the RAY_TPU_FAULTPOINTS
         # env arming in run_task_schedule, not by harness events
+        return []
+    if kind == "oom_storm":
+        # the OOM storm is carried by the seeded simulated-RSS plan in
+        # run_oom_storm_schedule (a memory.poll hook), not harness events
         return []
     rng = random.Random(seed)
     events: List[dict] = []
@@ -552,4 +556,140 @@ def run_task_schedule(seed: int, kill_nth: int = 6,
     fd_after = _fd_count()
     assert fd_after <= fd_before + 8, \
         f"fd leak across the task soak: {fd_before} -> {fd_after}"
+    return summary
+
+
+# ---------------------------------------------------------------------------
+# OOM storm (real cluster: seeded simulated-RSS ramps + concurrent waves)
+# ---------------------------------------------------------------------------
+
+
+def run_oom_storm_schedule(seed: int, rounds: int = 4,
+                           tasks_per_round: int = 16) -> dict:
+    """Soak the memory-watchdog degradation sequence: a SEEDED plan of
+    node-usage ramps (bursts above ``memory_usage_threshold``, then
+    recovery valleys) plus per-poll simulated-RSS spikes on a
+    seed-drawn live worker, all while waves of tasks submit and drain
+    concurrently. The invariant is the chaos bar: every ``get``
+    resolves within its bound to the correct value or a TYPED error
+    (``OutOfMemoryError`` with ``cause_kind=WORKER_OOM`` once the
+    dedicated budget exhausts; ``WorkerCrashedError`` for generic
+    deaths), the pressure always clears, budgets drain, no fd/zombie
+    leaks — and the raylet and GCS survive every event (the kernel OOM
+    killer's roulette is exactly what the watchdog exists to replace:
+    every kill in the watchdog's history must name a WORKER pid, never
+    the control plane's)."""
+    import ray_tpu
+    import ray_tpu.state as state_mod
+    from ray_tpu import exceptions as exc_mod
+
+    fd_before = _fd_count()
+    rng = random.Random(seed)
+    # Deterministic pressure plan, one usage fraction per watchdog
+    # poll: each round contributes a high burst (the storm) then a
+    # long valley (recovery), so kills/backpressure DO happen and the
+    # backpressured work always gets admitted again. Past the plan's
+    # end the node stays healthy, so the final waves drain.
+    plan: List[float] = []
+    for _ in range(rounds):
+        plan += [round(rng.uniform(0.96, 0.995), 4)] * rng.randrange(6, 12)
+        plan += [round(rng.uniform(0.2, 0.6), 4)] * rng.randrange(20, 30)
+    victim_draws = [rng.random() for _ in range(len(plan))]
+    step = {"i": 0}
+
+    def hook(sim, pids, **ctx):
+        i = step["i"]
+        step["i"] = i + 1
+        frac = plan[i] if i < len(plan) else 0.3
+        sim["usage_fraction"] = frac
+        if frac > 0.9 and pids:
+            # seed-drawn victim: one live worker's simulated RSS ramps
+            # (the draw sequence is deterministic; which pid it lands
+            # on resolves at run time, like resolved_target above)
+            draw = victim_draws[i] if i < len(victim_draws) else 0.0
+            sim["rss_by_pid"] = {pids[int(draw * len(pids)) % len(pids)]:
+                                 8 << 30}
+
+    try:
+        ray_tpu.init(num_cpus=2, _system_config={
+            "raylet_heartbeat_period_ms": 50,
+            "memory_monitor_interval_s": 0.02,
+            "retry_backoff_base_s": 0.02,
+            "retry_backoff_cap_s": 0.25,
+            "metrics_report_period_ms": 200,
+            "task_oom_retries": 8,
+            "idle_lease_keepalive_s": 0.05,
+        })
+        raylet = ray_tpu.worker.global_worker.node.raylet
+        mon = raylet.memory_monitor
+        faultpoints.arm("memory.poll", "hook", hook=hook)
+
+        @ray_tpu.remote(max_retries=8)
+        def slow_double(x, delay_s):
+            import time as time_mod
+            time_mod.sleep(delay_s)
+            return x * 2
+
+        n_ok = n_oom = n_crashed = 0
+        me = os.getpid()
+        for round_no in range(rounds):
+            wave = [(rng.randrange(1000),
+                     round(rng.uniform(0.02, 0.08), 3))
+                    for _ in range(tasks_per_round)]
+            refs = [slow_double.remote(x, d) for x, d in wave]
+            for (x, _d), ref in zip(wave, refs):
+                try:
+                    # the bound: resolves (either way) or the soak hangs
+                    assert ray_tpu.get(ref, timeout=120) == x * 2
+                    n_ok += 1
+                except exc_mod.OutOfMemoryError as e:
+                    # typed, honest: dedicated OOM budget exhausted,
+                    # structured cause attached
+                    assert e.cause_kind == "WORKER_OOM", \
+                        f"untyped OOM death: {e.cause_info}"
+                    n_oom += 1
+                except exc_mod.WorkerCrashedError:
+                    n_crashed += 1  # lost-notify fallback path: typed too
+            # per-round invariants (the standard chaos bar)
+            assert raylet._pull_inflight_bytes == 0, \
+                f"admission budget leaked at round {round_no}"
+            assert not raylet.store._lent, \
+                f"segment lease leaked at round {round_no}"
+            # raylet + GCS survive every event: both still serve (the
+            # in-process head shares the driver pid), the GCS still
+            # shows the node alive, and every watchdog kill named a
+            # WORKER pid — never the control plane's
+            assert not raylet._closing, "raylet died under the storm"
+            assert any(n["alive"] for n in state_mod.node_stats()), \
+                "GCS lost the node under the storm"
+            assert all(h["pid"] != me for h in mon.history
+                       if h["action"] == "kill"), \
+                "watchdog shot the raylet/GCS process"
+        assert n_ok > tasks_per_round * rounds // 2, \
+            f"OOM storm starved the workload: {n_ok} ok"
+        assert mon.kills + mon.backpressure_rejects > 0, \
+            "storm never engaged the watchdog (vacuous soak)"
+        summary = {"seed": seed, "ok": n_ok, "oom": n_oom,
+                   "crashed": n_crashed, "kills": mon.kills,
+                   "backpressure_rejects": mon.backpressure_rejects,
+                   "relief_bytes": mon.relief_bytes,
+                   "polls": mon.polls}
+    finally:
+        faultpoints.reset()
+        ray_tpu.shutdown()
+
+    # Post-shutdown process hygiene, same bar as run_task_schedule:
+    # every watchdog-killed worker must be reaped, and the fd table
+    # returns to its pre-run level.
+    import time as time_mod
+    deadline = time_mod.time() + 5.0
+    zombies = _zombie_children()
+    while zombies and time_mod.time() < deadline:
+        time_mod.sleep(0.1)
+        zombies = _zombie_children()
+    assert not zombies, \
+        f"unreaped OOM-killed workers survive shutdown: {zombies}"
+    fd_after = _fd_count()
+    assert fd_after <= fd_before + 8, \
+        f"fd leak across the OOM storm: {fd_before} -> {fd_after}"
     return summary

@@ -15,8 +15,8 @@ rest are ``slow`` and run via ci/chaos.sh.
 import pytest
 
 from chaos import (
-    make_schedule, run_data_plane_schedule, run_task_schedule,
-    schedules_equal,
+    make_schedule, run_data_plane_schedule, run_oom_storm_schedule,
+    run_task_schedule, schedules_equal,
 )
 
 # Pinned seeds: chosen once, frozen forever. Changing a seed is
@@ -31,6 +31,7 @@ SEEDS = {
     "gcs_restart": 1707,
     "mixed": 1808,
     "worker_kill": 1909,
+    "oom_storm": 2010,
 }
 
 
@@ -38,7 +39,7 @@ def test_schedule_generation_is_deterministic():
     """Same (kind, seed) -> byte-identical schedule; different seeds ->
     different schedules (the RNG actually reaches the events)."""
     for kind, seed in SEEDS.items():
-        if kind == "worker_kill":
+        if kind in ("worker_kill", "oom_storm"):
             continue
         a = make_schedule(kind, seed)
         b = make_schedule(kind, seed)
@@ -91,3 +92,13 @@ def test_chaos_soak(kind, tmp_path):
 def test_chaos_soak_worker_kill():
     summary = run_task_schedule(SEEDS["worker_kill"])
     assert summary["retry_or_failed_events"] > 0
+
+
+@pytest.mark.slow
+def test_chaos_soak_oom_storm():
+    """Seeded simulated-RSS ramps + concurrent submissions: every get
+    resolves (value or typed error), the raylet/GCS survive every
+    event, and the watchdog actually engaged (kills or backpressure
+    rejects > 0 — non-vacuous)."""
+    summary = run_oom_storm_schedule(SEEDS["oom_storm"])
+    assert summary["kills"] + summary["backpressure_rejects"] > 0
