@@ -479,87 +479,26 @@ def main():
 
     _trace(f"multi_client done ({multi_per_s:.0f}/s); drain")
     # ---- the 1M-task drain (scalability row + latency percentiles) ----
-    # Driver-side GC policy for the 1M-object working set: generational
-    # collection is DISABLED for the bounded burst (young-gen passes
-    # re-scan the ~million live pending-task records — measured 24% of
-    # drain wall at 1M scale: 44.9k -> 55.9k tasks/s) and re-enabled
-    # with a full collect right after. App-level tuning, same as any
-    # large-heap Python service (the runtime's own records are acyclic;
-    # refcounting frees them promptly either way).
-    import gc
-    gc.collect()
-    gc.freeze()
-    gc.disable()
     num_drain = int(os.environ.get("BENCH_NUM_DRAIN", "1000000"))
-    probe_every = max(1, num_drain // 128)
-    probes = []
-    probes_lock = threading.Lock()
-    probe_futs = []
-    refs = []
-    chunk = 20_000
-    t0 = time.perf_counter()
-    submitted = 0
-
-    def _probe_done(_f, t):
-        with probes_lock:
-            probes.append(time.perf_counter() - t)
-
-    while submitted < num_drain:
-        n = min(chunk, num_drain - submitted)
-        refs.extend(small_task.remote() for _ in range(n))
-        submitted += n
-        while len(probe_futs) < submitted // probe_every:
-            t_probe = time.perf_counter()
-            fut = small_task.remote().future()
-            fut.add_done_callback(
-                functools.partial(_probe_done, t=t_probe))
-            probe_futs.append(fut)
-    drain_timed_out = False
-    for start in range(0, len(refs), chunk):
-        try:
-            # generous per-chunk guard: a wedged cluster must still let
-            # the bench emit its JSON line rather than hang the driver
-            ray_tpu.get(refs[start:start + chunk],
-                        timeout=float(os.environ.get(
-                            "BENCH_CHUNK_TIMEOUT", "300")))
-        except Exception:  # noqa: BLE001 — GetTimeoutError et al.
-            drain_timed_out = True
-            num_drain = start  # completed portion only
-            try:  # wedge forensics (BENCH_TRACE only)
-                r = ray_tpu.worker.global_worker.node.raylet
-                _trace(f"avail={r.resources_available} "
-                       f"pending={len(r._pending)} "
-                       f"leases={[(lid, e.resources) for lid, e in r.leases.items()]} "
-                       f"workers={[(w.state, w.job_id.hex()[:6], w.lease_id) for w in r.workers.values()]}")
-            except Exception as e:  # noqa: BLE001
-                _trace(f"forensics failed: {e}")
-            break
-    drain_wall = time.perf_counter() - t0
-    _trace(f"drain done in {drain_wall:.1f}s timeout={drain_timed_out}")
-    refs = None
-    gc.enable()
-    gc.collect()
-    # quiesce the probe callbacks, then read under the lock — wait()
-    # can return (timeout, or waiter woken pre-callback) while a late
-    # completion is still appending
-    concurrent.futures.wait(probe_futs, timeout=60)
-    with probes_lock:
-        probes = sorted(probes)
-
-    from ray_tpu._private.metrics import percentile
-
-    def pct(p):
-        return percentile(probes, p) if probes else 0.0
-
-    # raylet-side lease-decision latency percentiles
-    lease_lat = {}
-    try:
-        node = ray_tpu.worker.global_worker.node
-        lease_lat = node.raylet._latency_percentiles()
-    except Exception:  # noqa: BLE001
-        pass
+    drain_row = _drain_run(small_task, num_drain)
+    _trace(f"drain done in {drain_row['wall_s']}s "
+           f"timeout={drain_row['timed_out']}")
 
     ray_tpu.shutdown()
+
+    # ---- credits-off drain: same run config, lease_credits_enabled=0,
+    # so the streaming-lease speedup is measured IN-TREE on every bench
+    # run instead of against a historical baseline row.
+    _trace("credits-off drain")
+    try:
+        credits_off_row = _credits_off_drain(num_drain)
+    except Exception as e:  # noqa: BLE001 — comparison row must not kill bench
+        credits_off_row = {"error": str(e)}
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+    _trace("credits-off drain done")
 
     _trace("scalability envelope")
     try:
@@ -632,16 +571,10 @@ def main():
             "columnar_data_1m": columnar_row,
             "scalability": scalability,
             "million_drain": {
-                "num_tasks": num_drain,
-                "timed_out": drain_timed_out,
-                "wall_s": round(drain_wall, 1),
-                "tasks_per_s": round(num_drain / drain_wall, 1),
-                "vs_baseline_154s": round(
-                    BASELINE_MILLION_S / drain_wall
-                    * (num_drain / 1_000_000), 4),
-                "task_sojourn_p50_ms": round(pct(0.50) * 1e3, 2),
-                "task_sojourn_p99_ms": round(pct(0.99) * 1e3, 2),
-                "lease_schedule_latency": lease_lat,
+                **drain_row,
+                # same workload, same box, lease_credits_enabled=0 —
+                # the streaming-lease delta measured in-tree
+                "credits_off": credits_off_row,
                 # r4 late profile: with the C fused submit/complete/
                 # push paths (cpp/fastpath.c), compact wire rows, GC
                 # parked for the burst, and the bytes-keyed owner
@@ -1080,6 +1013,187 @@ def _model_bench() -> dict:
             "every bench-time probe found the tunnel down "
             f"(see probe_attempts; {len(attempts)} attempts this run)")
     return out
+
+
+def _drain_run(small_task, num_drain: int) -> dict:
+    """One bounded-burst drain of ``num_drain`` argless tasks against
+    the LIVE cluster, with sojourn probes (one per ~1/128th of the
+    burst). Shared by the primary million_drain row and the
+    credits-off comparison row so both measure the identical workload.
+
+    Driver-side GC policy for the 1M-object working set: generational
+    collection is DISABLED for the bounded burst (young-gen passes
+    re-scan the ~million live pending-task records — measured 24% of
+    drain wall at 1M scale: 44.9k -> 55.9k tasks/s) and re-enabled
+    with a full collect right after. App-level tuning, same as any
+    large-heap Python service (the runtime's own records are acyclic;
+    refcounting frees them promptly either way)."""
+    import gc
+
+    import ray_tpu
+
+    # Measurement hygiene: the drain row reports the DRAIN's latency
+    # population and grant/dispatch DELTAS, not the session-cumulative
+    # reservoirs/counters (which carry every cold worker-boot grant and
+    # every earlier bench stage's dispatches since init and would skew
+    # both the percentiles and the credit hit-rate).
+    base = {"credit_dispatches": 0, "legacy_dispatches": 0,
+            "credit_grants": 0, "legacy_grants": 0, "credit_revoked": 0}
+    try:
+        w0 = ray_tpu.worker.global_worker
+        r = w0.node.raylet
+        for res in (r._sched_latencies, r._decision_latencies,
+                    r._grant_waits, r._tick_durations):
+            res.clear()
+        base["credit_grants"] = r.num_credit_grants
+        base["legacy_grants"] = r.num_leases_granted
+        base["credit_revoked"] = r.num_credit_revoked
+        base["credit_dispatches"] = w0.core.stats.get(
+            "credit_dispatches", 0)
+        base["legacy_dispatches"] = w0.core.stats.get(
+            "legacy_dispatches", 0)
+    except Exception:  # noqa: BLE001 — stats are decoration
+        pass
+    gc.collect()
+    gc.freeze()
+    gc.disable()
+    probe_every = max(1, num_drain // 128)
+    probes = []
+    probes_lock = threading.Lock()
+    probe_futs = []
+    refs = []
+    chunk = 20_000
+    t0 = time.perf_counter()
+    submitted = 0
+
+    def _probe_done(_f, t):
+        with probes_lock:
+            probes.append(time.perf_counter() - t)
+
+    while submitted < num_drain:
+        n = min(chunk, num_drain - submitted)
+        refs.extend(small_task.remote() for _ in range(n))
+        submitted += n
+        while len(probe_futs) < submitted // probe_every:
+            t_probe = time.perf_counter()
+            fut = small_task.remote().future()
+            fut.add_done_callback(
+                functools.partial(_probe_done, t=t_probe))
+            probe_futs.append(fut)
+    drain_timed_out = False
+    for start in range(0, len(refs), chunk):
+        try:
+            # generous per-chunk guard: a wedged cluster must still let
+            # the bench emit its JSON line rather than hang the driver
+            ray_tpu.get(refs[start:start + chunk],
+                        timeout=float(os.environ.get(
+                            "BENCH_CHUNK_TIMEOUT", "300")))
+        except Exception:  # noqa: BLE001 — GetTimeoutError et al.
+            drain_timed_out = True
+            num_drain = start  # completed portion only
+            try:  # wedge forensics (BENCH_TRACE only)
+                r = ray_tpu.worker.global_worker.node.raylet
+                _trace(f"avail={r.resources_available} "
+                       f"pending={len(r._pending)} "
+                       f"leases={[(lid, e.resources) for lid, e in r.leases.items()]} "
+                       f"workers={[(w.state, w.job_id.hex()[:6], w.lease_id) for w in r.workers.values()]}")
+            except Exception as e:  # noqa: BLE001
+                _trace(f"forensics failed: {e}")
+            break
+    drain_wall = time.perf_counter() - t0
+    refs = None  # noqa: F841 — drop the 1M-ref list before re-enabling GC
+    gc.enable()
+    gc.collect()
+    # quiesce the probe callbacks, then read under the lock — wait()
+    # can return (timeout, or waiter woken pre-callback) while a late
+    # completion is still appending
+    concurrent.futures.wait(probe_futs, timeout=60)
+    with probes_lock:
+        probes = sorted(probes)
+
+    from ray_tpu._private.metrics import percentile
+
+    def pct(p):
+        return percentile(probes, p) if probes else 0.0
+
+    # raylet-side lease latency percentiles + streaming-lease counters
+    # (grant/dispatch numbers are DELTAS over the drain interval, per
+    # the baseline snapshot above, so the row is comparable to the
+    # credits-off row's fresh session)
+    lease_lat = {}
+    lease_credit = {}
+    try:
+        w = ray_tpu.worker.global_worker
+        lease_lat = w.node.raylet._latency_percentiles()
+        # EVERY counter in the row is the drain-interval delta — a row
+        # mixing deltas with session-cumulative values would read as
+        # self-contradictory (e.g. more revokes than grants)
+        lease_lat["credit_grants"] = \
+            lease_lat.get("credit_grants", 0) - base["credit_grants"]
+        lease_lat["legacy_grants"] = \
+            lease_lat.get("legacy_grants", 0) - base["legacy_grants"]
+        lease_credit = dict(w.node.raylet._credit_stats())
+        lease_credit["granted_total"] -= base["credit_grants"]
+        lease_credit["legacy_grants_total"] -= base["legacy_grants"]
+        lease_credit["revoked_total"] -= base["credit_revoked"]
+        tot = lease_credit["granted_total"] + \
+            lease_credit["legacy_grants_total"]
+        lease_credit["credit_grant_rate"] = round(
+            lease_credit["granted_total"] / tot, 4) if tot else 0.0
+    except Exception:  # noqa: BLE001 — stats are decoration
+        pass
+    try:
+        # owner-side per-TASK dispatch split: the credit hit-rate the
+        # acceptance criteria track (credit_dispatches/legacy_grants)
+        w = ray_tpu.worker.global_worker
+        cd = w.core.stats.get("credit_dispatches", 0) - \
+            base["credit_dispatches"]
+        ld = w.core.stats.get("legacy_dispatches", 0) - \
+            base["legacy_dispatches"]
+        lease_credit["credit_dispatches"] = cd
+        lease_credit["legacy_dispatches"] = ld
+        lease_credit["credit_hit_rate"] = \
+            round(cd / (cd + ld), 4) if cd + ld else 0.0
+    except Exception:  # noqa: BLE001
+        pass
+    return {
+        "num_tasks": num_drain,
+        "timed_out": drain_timed_out,
+        "wall_s": round(drain_wall, 1),
+        "tasks_per_s": round(num_drain / drain_wall, 1),
+        "vs_baseline_154s": round(
+            BASELINE_MILLION_S / drain_wall
+            * (num_drain / 1_000_000), 4),
+        "task_sojourn_p50_ms": round(pct(0.50) * 1e3, 2),
+        "task_sojourn_p99_ms": round(pct(0.99) * 1e3, 2),
+        "lease_schedule_latency": lease_lat,
+        "lease_credit": lease_credit,
+    }
+
+
+def _credits_off_drain(num_drain: int) -> dict:
+    """The comparison row: a fresh single-node cluster with
+    ``lease_credits_enabled=0`` (everything else identical) running the
+    same drain, so the streaming-lease delta is proven in-tree on the
+    same box and commit."""
+    import ray_tpu
+
+    ray_tpu.init(
+        num_cpus=max(1, os.cpu_count() or 1),
+        object_store_memory=int(os.environ.get(
+            "BENCH_STORE_MB", "2048")) * 1024 * 1024,
+        _system_config={"lease_credits_enabled": False})
+    try:
+        @ray_tpu.remote
+        def small_task():
+            return b"ok"
+
+        # warm the pool like the primary row (which drains last, after
+        # every other row has exercised the workers)
+        ray_tpu.get([small_task.remote() for _ in range(2000)])
+        return _drain_run(small_task, num_drain)
+    finally:
+        ray_tpu.shutdown()
 
 
 def _multi_client(n_tasks: int) -> float:

@@ -31,6 +31,19 @@ python -m pytest tests/test_chaos.py tests/test_faultpoints.py \
 # worker is a fork of the template. This second run pins the
 # cold-Popen path the same way (it is the fallback and the TPU-worker
 # default), including the per-spawn log-fd regression bracket.
-exec env RAY_TPU_WORKER_ZYGOTE_ENABLED=0 python -m pytest \
+env RAY_TPU_WORKER_ZYGOTE_ENABLED=0 python -m pytest \
     tests/test_chaos.py::test_chaos_soak_worker_kill \
+    -q -p no:cacheprovider -m ''
+
+# Streaming leases are ON by default, so the full run above soaked
+# every schedule (worker_kill, raylet kills, oom_storm, and the new
+# credit_revoke revocation paths) over the credit plane. This final
+# run pins the schedules that exercise the lease protocol with credits
+# OFF — the legacy request/grant path must keep passing the identical
+# recovery bar (the fallback is a first-class mode, not dead code).
+exec env RAY_TPU_LEASE_CREDITS_ENABLED=0 python -m pytest \
+    tests/test_chaos.py::test_chaos_soak_worker_kill \
+    tests/test_chaos.py::test_chaos_soak_oom_storm \
+    tests/test_chaos.py::test_chaos_soak_credit_raylet_kill \
+    "tests/test_chaos.py::test_chaos_soak[raylet_kill]" \
     -q -p no:cacheprovider -m ''

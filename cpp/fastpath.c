@@ -754,13 +754,16 @@ fail:
     return NULL;
 }
 
-/* build_push(batch) -> (tails, theaders, frames)
+/* build_push(batch) -> (tails, theaders, frames, task_ids)
  *
  * The per-spec wire-assembly loop of _push_task_batch_nowait: proto
  * dedup (linear scan, capped — duplicate tails are legal wire, dedup is
  * only an optimization), argless fast path, theader rows.  Python
  * callbacks (tail_wire / _args_wire) run only once per distinct proto /
- * per argful spec.
+ * per argful spec.  ``task_ids`` is the batch's id list in order, so
+ * the caller's dispatch stamp (DISPATCHED / CREDIT_DISPATCHED under
+ * streaming leases) needs no Python per-spec loop — the credit
+ * dispatch path stays free of per-task Python work end to end.
  */
 #define BP_MAX_PROTOS 32
 
@@ -776,11 +779,14 @@ FastCtx_build_push(FastCtx *self, PyObject *const *argv, Py_ssize_t nargs)
     PyObject *tails = PyList_New(0);
     PyObject *theaders = PyList_New(0);
     PyObject *frames = PyList_New(0);
+    PyObject *tids = PyList_New(n);
     PyObject *row = NULL, *aw = NULL, *afr = NULL;
     PyObject *seen[BP_MAX_PROTOS];
     Py_ssize_t seen_idx[BP_MAX_PROTOS];
     int nseen = 0;
-    if (tails == NULL || theaders == NULL || frames == NULL) goto fail;
+    if (tails == NULL || theaders == NULL || frames == NULL ||
+        tids == NULL)
+        goto fail;
 
     for (Py_ssize_t i = 0; i < n; i++) {
         PyObject *spec = PyList_GET_ITEM(batch, i);     /* borrowed */
@@ -849,6 +855,8 @@ FastCtx_build_push(FastCtx *self, PyObject *const *argv, Py_ssize_t nargs)
             PyErr_SetString(PyExc_AttributeError, "spec missing task_id");
             goto fail;
         }
+        Py_INCREF(tid);
+        PyList_SET_ITEM(tids, i, tid);
         if (tctx == NULL)
             tctx = Py_None;
         if (!argful && tctx == Py_None) {
@@ -883,13 +891,15 @@ FastCtx_build_push(FastCtx *self, PyObject *const *argv, Py_ssize_t nargs)
         Py_CLEAR(row);
     }
     {
-        PyObject *out = PyTuple_Pack(3, tails, theaders, frames);
+        PyObject *out = PyTuple_Pack(4, tails, theaders, frames, tids);
         Py_DECREF(tails); Py_DECREF(theaders); Py_DECREF(frames);
+        Py_DECREF(tids);
         return out;
     }
 
 fail:
     Py_XDECREF(tails); Py_XDECREF(theaders); Py_XDECREF(frames);
+    Py_XDECREF(tids);
     Py_XDECREF(row); Py_XDECREF(aw); Py_XDECREF(afr);
     return NULL;
 }

@@ -110,6 +110,36 @@ class RayTpuConfig:
     # Lease reuse: keep an idle leased worker this long before returning it.
     idle_worker_lease_timeout_ms: int = 2000
 
+    # --- streaming lease credits ---
+    # Master switch for streaming leases. On (the default) the raylet
+    # pre-grants each owner a revocable CREDIT WINDOW of worker slots
+    # per scheduling class — leases as a flow-controlled stream instead
+    # of a per-lease request/grant ping-pong. The owner's submit path
+    # (including the C fastpath) dispatches tasks against local credits
+    # with zero control-plane round-trips on the hot path and falls
+    # back to the legacy RequestWorkerLease path when credits are
+    # exhausted, revoked, or this knob is off. Wire frames:
+    # GrantLeaseCredits (raylet -> owner push: credits + window target,
+    # issued on demand registration and renewed on the heartbeat
+    # cadence) and RevokeLeaseCredits (raylet -> owner call: the owner
+    # relinquishes the listed credits it is not using; in-use ones are
+    # kept and reconciled on a later beat). Memory pressure (PR10)
+    # zeroes and revokes windows BEFORE lease backpressure engages —
+    # revocation is a first-class recovery path, chaos-soaked by the
+    # credit_revoke schedule.
+    lease_credits_enabled: bool = True
+    # Ceiling on credit worker-slots outstanding per (owner connection,
+    # scheduling class). The actual window is sized from the owner's
+    # reported backlog and the REAL scheduler view (cluster slot
+    # capacity for the window's resource shape), clamped by this.
+    lease_credit_window_max: int = 64
+    # Unused-credit reclaim cadence: a window whose demand report is
+    # older than this gets its outstanding credits offered back via
+    # RevokeLeaseCredits on the next heartbeat (the owner keeps the
+    # ones it is actively using). Bounds how long an idle owner can
+    # park pool slots it no longer needs.
+    lease_credit_stale_s: float = 2.0
+
     # --- worker pool ---
     # Hard cap on workers started per node (0 = num_cpus).
     max_workers_per_node: int = 0

@@ -46,7 +46,8 @@ from ray_tpu._private.shm_store import (
     RECYCLE_MIN_BYTES, AttachedObject, plan_segment, write_segment,
 )
 from ray_tpu._private.task_events import (
-    DISPATCHED, FAILED, PENDING_ARGS, RETRY, SUBMITTED, TaskEventBuffer,
+    CREDIT_DISPATCHED, DISPATCHED, FAILED, PENDING_ARGS, RETRY, SUBMITTED,
+    TaskEventBuffer,
 )
 from ray_tpu._private.task_spec import (
     ARG_REF, ARG_VALUE, REPLY_ACTOR_RESTARTING, REPLY_ERROR, REPLY_STOLEN,
@@ -106,7 +107,8 @@ class PendingTaskEntry:
 
 class LeasedWorker:
     __slots__ = ("address", "lease_id", "node_id", "conn", "inflight",
-                 "raylet_address", "worker_id", "idle_timer")
+                 "raylet_address", "worker_id", "idle_timer",
+                 "via_credit", "on_drop")
 
     def __init__(self, address, lease_id, node_id, conn, raylet_address, worker_id):
         self.address = address
@@ -118,6 +120,16 @@ class LeasedWorker:
         self.inflight = 0
         # cancellable keepalive TimerHandle while idle (exactly one)
         self.idle_timer = None
+        # True when this worker arrived as a streamed lease credit
+        # (GrantLeaseCredits) rather than a RequestWorkerLease grant —
+        # its dispatches count as credit hits and stamp
+        # CREDIT_DISPATCHED, and RevokeLeaseCredits may reclaim it.
+        self.via_credit = False
+        # the on_disconnect callback registered for this worker, kept
+        # so deliberate teardown (idle return, credit revocation) can
+        # unregister it — a revoked credit must not fire the
+        # worker-died retry path against a healthy worker
+        self.on_drop = None
 
 
 class SchedulingKeyState:
@@ -125,13 +137,34 @@ class SchedulingKeyState:
     queues in direct_task_transport.h)."""
 
     __slots__ = ("queue", "workers", "pending_lease", "resources",
-                 "steal_pending", "reassigned", "last_grant_ts")
+                 "steal_pending", "reassigned", "last_grant_ts",
+                 "credit_target", "cluster_slots", "last_demand_ts",
+                 "activating")
 
     def __init__(self, resources):
         self.queue: deque[TaskSpec] = deque()
         self.workers: List[LeasedWorker] = []
         self.pending_lease = 0
         self.resources = resources
+        # Streaming-lease window target announced by the raylet
+        # (GrantLeaseCredits.window_target): the breadth this class may
+        # hold. -1 = unknown (probe with ONE legacy request — it
+        # carries the backlog that opens the window). Bounds how many
+        # legacy lease requests the pump parks at the raylet; parked
+        # requests beyond the cluster's capacity were exactly the
+        # 200-700ms grant_wait tail streaming leases exist to kill.
+        self.credit_target = -1
+        # cluster-wide slot bound from the same push: how many legacy
+        # requests may park at the raylet for spillback BEYOND the
+        # streamed local slots (remote capacity still flows through
+        # the existing spill machinery)
+        self.cluster_slots = -1
+        # last ReportLeaseDemand push (paced refresh, see the pump)
+        self.last_demand_ts = 0.0
+        # credits announced for this class whose worker dial is still
+        # in flight: counted as expected breadth by the pump so a
+        # racing legacy request doesn't grab the same pool slot
+        self.activating = 0
         # Work stealing (reference: direct_task_transport.h:57): at most
         # one outstanding StealTasks per key. ``reassigned`` maps a
         # stolen task_id -> a multiset (list, repeats allowed) of VICTIM
@@ -280,7 +313,17 @@ class CoreWorker:
         self.stats = {"tasks_submitted": 0, "tasks_finished": 0,
                       "tasks_retried": 0, "tasks_stolen": 0,
                       "actor_tasks_submitted": 0,
-                      "puts": 0, "gets": 0}
+                      "puts": 0, "gets": 0,
+                      # streaming leases: per-task dispatch split (the
+                      # owner-side credit hit-rate) + window traffic
+                      "credit_dispatches": 0, "legacy_dispatches": 0,
+                      "lease_credits_received": 0,
+                      "lease_credits_activated": 0,
+                      "lease_credits_revoked": 0}
+        # lease_ids of credits whose worker connect is still in flight:
+        # a concurrent RevokeLeaseCredits must not report these as
+        # released (the raylet would re-lease the worker under us)
+        self._activating_credits: set = set()
 
         # Native fused submit path (cpp/fastpath.c), created lazily on
         # the first template submission (needs self.address, i.e. post-
@@ -505,9 +548,161 @@ class CoreWorker:
             "AddBorrower": self._handle_add_borrower,
             "RemoveBorrower": self._handle_remove_borrower,
             "WorkerOOMKilled": self._handle_worker_oom_killed,
+            "GrantLeaseCredits": self._handle_grant_lease_credits,
+            "RevokeLeaseCredits": self._handle_revoke_lease_credits,
             "Ping": self._handle_ping,
         }
         return handlers
+
+    # ------------------------------------------------- streaming leases
+
+    async def _handle_grant_lease_credits(self, conn, header, bufs):
+        """Raylet push: pre-granted worker slots for one scheduling
+        class plus the window target. Each credit is activated (worker
+        socket dialed) EAGERLY here, off the submit path — by the time
+        the pump dispatches against it there is zero control-plane work
+        left, which is the whole point of the stream."""
+        if self._shutdown:
+            return {}
+        sc = header["sched_class"]
+        state = self.scheduling_keys.get(sc)
+        if state is None:
+            state = self.scheduling_keys[sc] = SchedulingKeyState(
+                header.get("resources") or {})
+        if header["raylet_address"] == self.raylet_address:
+            # Only the HOME raylet's window sizes the pump's stream
+            # floor and legacy-band clamp: in spillback clusters a
+            # remote raylet pushes its own (differently-sized) window
+            # each beat, and last-push-wins would flap the breadth
+            # every heartbeat. Remote credits still activate below —
+            # they just don't steer the local policy.
+            state.credit_target = int(header["window_target"])
+            state.cluster_slots = int(header.get(
+                "cluster_slots", header["window_target"]))
+        for cr in header.get("credits", ()):
+            self.stats["lease_credits_received"] += 1
+            self._activating_credits.add(cr["lease_id"])
+            state.activating += 1
+            asyncio.get_running_loop().create_task(
+                self._activate_credit(sc, state, cr,
+                                      header["raylet_address"]))
+        return {}
+
+    async def _activate_credit(self, sc: int, state: SchedulingKeyState,
+                               cr: dict, raylet_address: str) -> None:
+        lid = cr["lease_id"]
+        try:
+            try:
+                wconn = await rpc.connect(cr["worker_address"],
+                                          peer_name="leased-worker")
+            except ConnectionError:
+                state.activating = max(0, state.activating - 1)
+                if state.queue:
+                    # the expected breadth shrank: re-evaluate (the
+                    # pump may now fire a legacy fallback request)
+                    self._pump_scheduling_key(sc, state)
+                # dead worker (or its whole node): hand the slot back
+                # so it isn't parked; a dead raylet makes this a no-op
+                # and its conn-drop already reclaimed everything.
+                # worker_died=True: the dial failed, so this is a death
+                # report, NOT a voluntary return — it must neither
+                # decay the window's demand (the backlog is still
+                # there) nor mark a dead worker idle for re-grant.
+                self._activating_credits.discard(lid)
+                try:
+                    if raylet_address == self.raylet_address:
+                        rconn = self.raylet_conn
+                    else:
+                        rconn = await self._get_owner_conn(raylet_address)
+                    await rconn.call("ReturnWorker", {
+                        "lease_id": lid, "worker_died": True})
+                except (ConnectionError, RuntimeError):
+                    pass
+                return
+            if lid not in self._activating_credits or self._shutdown:
+                # revoked (or shutting down) while the dial was in
+                # flight: don't adopt a worker the raylet reclaimed
+                state.activating = max(0, state.activating - 1)
+                await wconn.close()
+                return
+            state.activating = max(0, state.activating - 1)
+            lw = LeasedWorker(cr["worker_address"], lid, cr["node_id"],
+                              wconn, raylet_address, cr["worker_id"])
+            lw.via_credit = True
+            state.workers.append(lw)
+            state.last_grant_ts = time.monotonic()
+
+            def _on_drop(c, _lw=lw):
+                self._on_leased_worker_died(sc, state, _lw)
+
+            lw.on_drop = _on_drop
+            wconn.on_disconnect.append(_on_drop)
+            self.stats["lease_credits_activated"] += 1
+            if state.queue:
+                self._pump_scheduling_key(sc, state)
+            elif not self._try_steal(sc, state):
+                self._schedule_idle_return(sc, state, lw)
+        finally:
+            self._activating_credits.discard(lid)
+
+    async def _handle_revoke_lease_credits(self, conn, header, bufs):
+        """Raylet call: give back up to ``max_release`` of the listed
+        credits. Only credits NOT in use are relinquished — in-flight
+        batches finish and busy workers stay leased (the raylet
+        re-offers on a later beat). Under ``memory_pressure`` idle
+        credits are released even when this class still has backlog:
+        the queue falls back to legacy requests, which the pressured
+        raylet answers with spill/retry-later — draining work off the
+        hot node is the recovery, so the owner must not cling to its
+        slots there. Ids we never saw (a chaos-dropped grant push) or
+        already returned are confirmed released so the raylet's ledger
+        reconciles."""
+        ids = set(header["lease_ids"])
+        try:
+            max_release = int(header.get("max_release", len(ids)))
+        except (TypeError, ValueError):
+            max_release = len(ids)
+        aggressive = header.get("reason") == "memory_pressure"
+        released: List[int] = []
+        seen: set = set()
+        # snapshot: the awaited conn.close below yields to the loop,
+        # where a first-submit of a new remote function may create a
+        # scheduling class mid-iteration
+        for sc, state in list(self.scheduling_keys.items()):
+            for lw in list(state.workers):
+                if lw.lease_id not in ids or not lw.via_credit:
+                    continue
+                seen.add(lw.lease_id)
+                if len(released) >= max_release or lw.inflight > 0:
+                    continue
+                if state.queue and not aggressive:
+                    continue  # about to be used; keep it
+                if not aggressive and lw.idle_timer is not None:
+                    # inside its idle-keepalive grace: the keepalive's
+                    # own ReturnWorker (or the next burst) decides,
+                    # exactly like a legacy lease — the raylet's
+                    # periodic reconcile offer must not defeat
+                    # warm-lease reuse for sync-loop callers
+                    continue
+                state.workers.remove(lw)
+                if lw.idle_timer is not None:
+                    lw.idle_timer.cancel()
+                    lw.idle_timer = None
+                # unregister the death watch FIRST: this close is a
+                # revocation, not a worker death — firing the retry
+                # path would double-return the lease as worker_died
+                # and strand a healthy worker in the LEASED state
+                if lw.on_drop is not None and \
+                        lw.on_drop in lw.conn.on_disconnect:
+                    lw.conn.on_disconnect.remove(lw.on_drop)
+                await lw.conn.close()
+                released.append(lw.lease_id)
+        for lid in ids - seen:
+            if lid not in self._activating_credits and \
+                    len(released) < max_release:
+                released.append(lid)
+        self.stats["lease_credits_revoked"] += len(released)
+        return {"released": released}
 
     async def _handle_worker_oom_killed(self, conn, header, bufs):
         """Raylet push: the node memory watchdog is killing a worker
@@ -1333,21 +1528,102 @@ class CoreWorker:
         requests bounded by backlog, direct_task_transport.h)."""
         cap = self.config.max_tasks_in_flight_per_worker
         max_pending = self.config.max_pending_leases_per_scheduling_class
+        credits_on = self.config.lease_credits_enabled
+        stale_s = self.config.lease_credit_stale_s
         while state.queue:
             qlen = len(state.queue)
             # target worker count for this backlog (breadth first)
             want = min(max(1, qlen // 8), max_pending)
-            while len(state.workers) + state.pending_lease < want:
+            floor = 0
+            if credits_on:
+                # Streaming leases. Until the raylet announces a window
+                # (credit_target < 0), probe with ONE legacy request —
+                # it carries the backlog that opens the window and
+                # keeps locality-aware targeting intact. After that:
+                #   * breadth is clamped to the raylet's cluster-wide
+                #     slot bound — parking legacy requests beyond real
+                #     capacity WAS the 200-700ms grant_wait tail;
+                #   * the first min(want, window_target) slots are
+                #     RESERVED for the credit stream while it is live
+                #     (credits activating, workers held, or a grant
+                #     within the stale period) — the stream fills them
+                #     with zero request/grant round-trips;
+                #   * legacy requests fire only for the remainder
+                #     (remote capacity, reached through the existing
+                #     park-and-spill machinery) or when the stream has
+                #     gone silent (raylet restarted, pressure zeroed
+                #     the window, grant push lost) — the fallback lane.
+                tgt = state.credit_target
+                if tgt < 0:
+                    want = min(want, 1)
+                else:
+                    want = min(want, max(1, state.cluster_slots))
+                    stream_live = state.activating > 0 or \
+                        bool(state.workers) or \
+                        time.monotonic() - state.last_grant_ts < stale_s
+                    if stream_live:
+                        floor = min(want, tgt)
+                now = time.monotonic()
+                expected0 = len(state.workers) + state.activating
+                if tgt >= 0 and \
+                        self.raylet_conn is not None and \
+                        not self.raylet_conn.closed and \
+                        (now - state.last_demand_ts > stale_s / 2 or
+                         (expected0 == 0 and
+                          now - state.last_demand_ts > 0.01)):
+                    # paced backlog refresh (kept off the per-task
+                    # path): renews the window mid-drain, and a
+                    # zero-worker burst start kicks it immediately so
+                    # the stream restarts without waiting out the pace
+                    state.last_demand_ts = now
+                    head = state.queue[0]
+                    from ray_tpu._private import runtime_env as _re
+                    try:
+                        self.raylet_conn.push_nowait(
+                            "ReportLeaseDemand", {
+                                "sched_class": sc, "backlog": qlen,
+                                "resources": state.resources,
+                                # same env key the legacy summary
+                                # carries: a window (re)created from
+                                # this push must keep the warm-pool
+                                # runtime-env affinity
+                                "env_hash": _re.hash_runtime_env(
+                                    head.runtime_env),
+                                "retriable": head.max_retries != 0})
+                    except ConnectionError:
+                        pass  # raylet gone; lease path handles retries
+            while True:
+                expected = len(state.workers) + state.pending_lease + \
+                    state.activating
+                if expected >= want or \
+                        state.pending_lease >= want - floor:
+                    # enough breadth, or the legacy band is full: only
+                    # (want - floor) legacy requests may be in flight —
+                    # the stream owns the floor, and a partially-filled
+                    # stream must not block the remote-spill band
+                    break
                 state.pending_lease += 1
                 self.loop.create_task(
                     self._request_lease(sc, state, self.raylet_address))
             worker = min((w for w in state.workers if w.inflight < cap),
                          key=lambda w: w.inflight, default=None)
             if worker is None:
-                if state.pending_lease == 0:
-                    state.pending_lease += 1
-                    self.loop.create_task(
-                        self._request_lease(sc, state, self.raylet_address))
+                if state.pending_lease == 0 and state.activating == 0:
+                    if floor:
+                        # deferred to the stream with nothing in
+                        # flight: guard against a silent stream (lost
+                        # demand push / raylet restart) — re-pump after
+                        # the stale period, by when stream_live has
+                        # expired and the legacy fallback fires
+                        if not self._shutdown:
+                            self.loop.call_later(
+                                stale_s, self._pump_scheduling_key,
+                                sc, state)
+                    else:
+                        state.pending_lease += 1
+                        self.loop.create_task(
+                            self._request_lease(sc, state,
+                                                self.raylet_address))
                 return
             # Batch sizing: fair share over current+expected workers
             # while grants are ARRIVING (breadth phase); once they stop
@@ -1355,11 +1631,12 @@ class CoreWorker:
             # lease requests just sit pending — deepen to the cap so
             # wire batches stay large (tail batches shrinking with the
             # fair share measured a ~20% throughput loss).
-            growing = state.pending_lease > 0 and \
-                time.monotonic() - state.last_grant_ts < 0.05
+            growing = (state.pending_lease > 0 or state.activating > 0) \
+                and time.monotonic() - state.last_grant_ts < 0.05
             if growing:
                 share = qlen // max(
-                    1, len(state.workers) + state.pending_lease)
+                    1, len(state.workers) + state.pending_lease +
+                    state.activating)
                 target = min(cap, max(8, share))
             else:
                 target = cap
@@ -1430,9 +1707,13 @@ class CoreWorker:
                         "resources": state.resources, "deps": [],
                         "strategy": "DEFAULT", "pg_id": b"",
                         "pg_bundle": -1, "runtime_env": None,
-                        "depth": 0, "name": "", "retriable": False}
+                        "depth": 0, "name": "", "retriable": False,
+                        "backlog": 0}
                 s = sample.lease_summary()
                 s["dep_info"] = self._dep_info(sample)
+                # streaming leases: the backlog opens/refreshes this
+                # owner's credit window at the serving raylet
+                s["backlog"] = len(state.queue)
                 return s
 
             summary = _build_summary()
@@ -1486,8 +1767,9 @@ class CoreWorker:
             state.workers.append(lw)
             state.pending_lease -= 1
             state.last_grant_ts = time.monotonic()
-            wconn.on_disconnect.append(
-                lambda c: self._on_leased_worker_died(sc, state, lw))
+            lw.on_drop = \
+                lambda c: self._on_leased_worker_died(sc, state, lw)
+            wconn.on_disconnect.append(lw.on_drop)
             if state.queue:
                 self._pump_scheduling_key(sc, state)
             elif not self._try_steal(sc, state):
@@ -1607,6 +1889,11 @@ class CoreWorker:
         except ConnectionError:
             pass
         if not lw.conn.closed:
+            # deliberate return: unhook the death watch first so the
+            # close doesn't fire a spurious worker-died ReturnWorker
+            if lw.on_drop is not None and \
+                    lw.on_drop in lw.conn.on_disconnect:
+                lw.conn.on_disconnect.remove(lw.on_drop)
             await lw.conn.close()
 
     def _push_task_batch_nowait(self, sc: int, state: SchedulingKeyState,
@@ -1615,20 +1902,20 @@ class CoreWorker:
         and attach completion handling to the reply future — no per-task
         coroutine, no per-task syscall. Static spec fields ride once per
         distinct prototype (TaskSpec.tail_wire), not once per task."""
-        ev = self.task_events
-        if ev.enabled:
-            ev.record_many([spec.task_id for spec in batch], DISPATCHED,
-                           {"worker": lw.worker_id.hex()[:12]})
         ctx = self._fast_ctx
         if ctx is not None:
-            tails, theaders, frames = ctx.build_push(batch)
+            # C wire assembly also hands back the task-id list so the
+            # dispatch stamp below needs no Python per-spec loop
+            tails, theaders, frames, tids = ctx.build_push(batch)
         else:
             tails_l: List[list] = []
             tail_idx: Dict[int, int] = {}
             theaders_l: List[list] = []
             frames_l: List[bytes] = []
+            tids = []
             for spec in batch:
                 proto = spec._proto or spec
+                tids.append(spec.task_id)
                 pidx = tail_idx.get(id(proto))
                 if pidx is None:
                     pidx = tail_idx[id(proto)] = len(tails_l)
@@ -1641,6 +1928,20 @@ class CoreWorker:
                                    len(frames_l), len(afr), spec.trace_ctx])
                 frames_l.extend(afr)
             tails, theaders, frames = tails_l, theaders_l, frames_l
+        # owner-side credit hit-rate: per-task dispatch split between
+        # streamed credits and legacy request/grant leases
+        self.stats["credit_dispatches" if lw.via_credit
+                   else "legacy_dispatches"] += len(batch)
+        ev = self.task_events
+        if ev.enabled:
+            # CREDIT_DISPATCHED marks the hop that replaced the lease
+            # round-trip — grant_wait stays honestly measured (a credit
+            # hit is visible as such, never passed off as a zero-wait
+            # legacy grant)
+            ev.record_many(tids,
+                           CREDIT_DISPATCHED if lw.via_credit
+                           else DISPATCHED,
+                           {"worker": lw.worker_id.hex()[:12]})
         try:
             fut = lw.conn.call_nowait("PushTasks",
                                       {"protos": tails, "tasks": theaders},

@@ -60,6 +60,10 @@ Wired point catalogue (name — owning layer — ctx keys):
 * ``memory.poll``          — memory_monitor.py — node, sim, pids
 * ``memory.kill``          — memory_monitor.py — node, worker, pid
 * ``lease.backpressure``   — raylet.py         — node
+* ``lease.credit.grant``   — raylet.py         — node, sched_class, n
+* ``lease.credit.revoke``  — raylet.py         — node, sched_class,
+  reason, n (``drop`` loses the grant push / revoke call — the ledger
+  must reconcile on a later heartbeat beat)
 
 Match predicates (all optional, AND-combined):
 

@@ -518,7 +518,14 @@ class GcsServer:
             ("num_pending_leases", "ray_tpu_node_pending_leases",
              "Lease requests queued"),
             ("num_leases_granted", "ray_tpu_node_leases_granted_total",
-             "Leases granted"),
+             "Legacy (request/grant) leases granted"),
+            ("num_credit_grants", "ray_tpu_node_lease_credits_total",
+             "Streamed lease credits granted"),
+            ("num_credit_revoked",
+             "ray_tpu_node_lease_credits_revoked_total",
+             "Streamed lease credits revoked/reclaimed"),
+            ("num_credit_windows", "ray_tpu_node_credit_windows",
+             "Live streaming-lease credit windows"),
             ("num_spillbacks", "ray_tpu_node_spillbacks_total",
              "Lease requests spilled to other nodes"),
             ("store_used_bytes", "ray_tpu_object_store_bytes_used",

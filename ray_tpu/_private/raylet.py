@@ -11,7 +11,12 @@ Protocol surface (all framed-msgpack RPC, see rpc.py):
   workers   : RegisterWorker, ActorExited, SealObject, GetObjectInfo,
               EnsureObjectLocal, PinObject, FreeObject
   clients   : RequestWorkerLease, ReturnWorker (lease pipelining is
-              client-side, reference: direct_task_transport.h)
+              client-side, reference: direct_task_transport.h);
+              streaming leases: ReportLeaseDemand (owner -> raylet
+              push, backlog refresh), GrantLeaseCredits (raylet ->
+              owner push, pre-granted worker slots + window target),
+              RevokeLeaseCredits (raylet -> owner call, reclaim of
+              unused credits)
   GCS       : ScheduleActorCreation, KillActorWorker, PreparePGBundle,
               CommitPGBundle, ReturnPGBundle, DrainSelf
   raylets   : FetchObjectMeta (pull probe) + FetchObjectChunk (legacy
@@ -116,6 +121,37 @@ class LeaseEntry:
         self.client = client
 
 
+class CreditWindow:
+    """Per-(owner connection, scheduling class) streaming-lease state:
+    how many pre-granted worker slots this owner may hold, sized from
+    its reported backlog and the real scheduler view, renewed on the
+    heartbeat cadence and revocable at any time (memory pressure zeroes
+    the window; stale demand shrinks it). A credit is an ordinary
+    LeaseEntry — owner-liveness reclaim, ReturnWorker, and the memory
+    watchdog's victim ordering all see it exactly like a legacy lease."""
+
+    __slots__ = ("conn", "sched_class", "resources", "env_hash",
+                 "retriable", "demand", "demand_ts", "lease_ids",
+                 "target", "last_revoke_ts", "revoking")
+
+    def __init__(self, conn: rpc.Connection, sched_class: int,
+                 resources: Dict[str, float], env_hash: str,
+                 retriable: bool):
+        self.conn = conn
+        self.sched_class = sched_class
+        self.resources = resources
+        self.env_hash = env_hash
+        # Victim eligibility for the memory watchdog (sample-task
+        # approximation, same as the legacy lease summary's flag).
+        self.retriable = retriable
+        self.demand = 0          # last reported backlog (tasks)
+        self.demand_ts = 0.0     # when that report landed (monotonic)
+        self.lease_ids: Set[int] = set()  # outstanding credits
+        self.target = -1         # last window target pushed to the owner
+        self.last_revoke_ts = 0.0
+        self.revoking = False
+
+
 class Raylet:
     def __init__(self, config: RayTpuConfig, num_cpus: float,
                  custom_resources: Optional[Dict[str, float]] = None,
@@ -203,6 +239,15 @@ class Raylet:
         self._serve_attachments: Dict[str, Any] = {}
         self.num_leases_granted = 0
         self.num_spillbacks = 0
+        # Streaming-lease credit windows: (id(owner conn), scheduling
+        # class) -> CreditWindow. Issuance rides demand registration
+        # (RequestWorkerLease backlog / ReportLeaseDemand pushes) plus
+        # the heartbeat cadence; every credit is accounted as a real
+        # LeaseEntry against resources_available — never a side ledger.
+        self._credit_windows: Dict[Tuple[int, int], CreditWindow] = {}
+        self._credit_topup_scheduled = False
+        self.num_credit_grants = 0
+        self.num_credit_revoked = 0
         # Schedule latency (request arrival -> decision dispatched), a
         # bounded reservoir for percentile reporting (reference: the
         # north-star p50/p99 schedule-latency metric, BASELINE.json).
@@ -252,6 +297,7 @@ class Raylet:
         return {
             "RegisterWorker": self.handle_register_worker,
             "RequestWorkerLease": self.handle_request_worker_lease,
+            "ReportLeaseDemand": self.handle_report_lease_demand,
             "ReturnWorker": self.handle_return_worker,
             "ScheduleActorCreation": self.handle_schedule_actor_creation,
             "KillActorWorker": self.handle_kill_actor_worker,
@@ -432,6 +478,9 @@ class Raylet:
             "num_workers": self._alive_worker_count(),
             "num_pending_leases": len(self._pending),
             "num_leases_granted": self.num_leases_granted,
+            "num_credit_grants": self.num_credit_grants,
+            "num_credit_revoked": self.num_credit_revoked,
+            "num_credit_windows": len(self._credit_windows),
             "num_spillbacks": self.num_spillbacks,
             "store_used_bytes": s["used_bytes"],
             "store_num_objects": s["num_objects"],
@@ -501,6 +550,16 @@ class Raylet:
                         self._schedule_tick()
                 except Exception:  # noqa: BLE001 — missed poll < dead node
                     logger.exception("memory watchdog poll failed")
+                # Streaming-lease window maintenance rides the same
+                # beat, right after the watchdog poll: a pressure
+                # crossing zeroes/revokes credit windows IMMEDIATELY —
+                # before any lease backpressure decision — and stale
+                # windows shrink here. Shielded like the watchdog: a
+                # credit bug must cost a missed beat, not the node.
+                try:
+                    self._credit_beat()
+                except Exception:  # noqa: BLE001 — missed beat < dead node
+                    logger.exception("lease-credit beat failed")
                 if faultpoints.armed:
                     # heartbeat-partition fault: ``drop`` suppresses the
                     # beat (fired BEFORE the event drain, so no task
@@ -838,6 +897,8 @@ class Raylet:
         conn.tags["worker_id"] = wid
         conn.on_disconnect.append(lambda c: self._on_worker_disconnect(wid))
         self._schedule_tick()
+        # a fresh idle worker may fill a credit-window deficit
+        self._schedule_credit_topup()
         return {"ok": True, "node_id": self.node_id.binary(),
                 "config": self.config.to_json()}
 
@@ -966,7 +1027,16 @@ class Raylet:
             # exists (the existing spillback path drains work off the
             # hot node), else a typed retry-later the owner backs off
             # on (backoff.py pacing, core_worker._request_lease).
+            # Credit windows were already zeroed/revoked by the
+            # heartbeat's _credit_beat the moment pressure crossed —
+            # revocation comes BEFORE rejection, never instead of it.
             return self._memory_backpressure_reply(req)
+        if self.config.lease_credits_enabled and not req.pg_id:
+            # The request's backlog opens/refreshes this owner's credit
+            # window; the legacy grant below still proceeds (it IS the
+            # bootstrap probe) and the topup books the remaining slots.
+            self._note_credit_demand(conn, req,
+                                     summary.get("backlog"))
         if self.task_events.enabled and req.task_id:
             # the lease request carries the SAMPLE task at the head of
             # the owner's queue — that task's lease wait starts here
@@ -1329,9 +1399,52 @@ class Raylet:
                          "node_id": self.node_id.binary()}, ()))
 
     async def handle_return_worker(self, conn, header, bufs):
+        lease = self.leases.get(header["lease_id"])
+        if lease is not None and not header.get("worker_died", False):
+            cw = getattr(lease, "credit_window", None)
+            w = self._credit_windows.get(cw) if cw is not None else None
+            if w is not None:
+                # A VOLUNTARY credit return is the demand-decay signal:
+                # the owner's queue drained (it never returns credit
+                # workers while it has backlog), so the window must not
+                # be topped back up from the just-freed worker — that
+                # would churn grant/idle/return cycles until the demand
+                # report went stale.
+                w.demand = 0
+                w.demand_ts = time.monotonic()
         self._release_lease(header["lease_id"],
                             worker_alive=not header.get("worker_died", False))
         return {"ok": True}
+
+    async def handle_report_lease_demand(self, conn, header, bufs):
+        """Owner -> raylet backlog refresh (one-way push, paced by the
+        owner to ~2/stale-period per scheduling class): keeps a live
+        window from going stale mid-drain and lets a queue that grew
+        WITHOUT a legacy lease request still open a window."""
+        if not self.config.lease_credits_enabled or \
+                self.memory_monitor.pressure:
+            return {}
+        key = (id(conn), header["sched_class"])
+        w = self._credit_windows.get(key)
+        if w is None:
+            w = CreditWindow(conn, header["sched_class"],
+                             dict(header.get("resources") or {}),
+                             header.get("env_hash", ""),
+                             bool(header.get("retriable", False)))
+            self._credit_windows[key] = w
+            conn.on_disconnect.append(
+                lambda c, k=key: self._credit_windows.pop(k, None))
+        w.demand = int(header.get("backlog", 0))
+        w.demand_ts = time.monotonic()
+        # the refresh carries the CURRENT queue head's properties:
+        # victim eligibility and env affinity must track the live
+        # backlog, not whatever task bootstrapped the window
+        # (scheduling classes key on (resources, fn_key) only —
+        # max_retries and runtime_env vary within one class)
+        w.env_hash = header.get("env_hash", w.env_hash)
+        w.retriable = bool(header.get("retriable", w.retriable))
+        self._schedule_credit_topup()
+        return {}
 
     def _watch_lease_client(self, lease: LeaseEntry):
         """Reclaim a granted lease if its owner's connection drops.
@@ -1367,6 +1480,11 @@ class Raylet:
         if cb is not None and lease.client is not None and \
                 cb in lease.client.on_disconnect:
             lease.client.on_disconnect.remove(cb)
+        cw = getattr(lease, "credit_window", None)
+        if cw is not None:
+            win = self._credit_windows.get(cw)
+            if win is not None:
+                win.lease_ids.discard(lease_id)
         pg_key = getattr(lease, "pg_key", None)
         if pg_key is not None and pg_key in self._pg_available:
             for k, v in lease.resources.items():
@@ -1381,6 +1499,286 @@ class Raylet:
         if worker_alive and w.state == WORKER_LEASED:
             w.state = WORKER_IDLE
         self._schedule_tick()
+        # the freed slot may satisfy another window's deficit (no-op
+        # when demand is stale or decayed — target tracks demand)
+        self._schedule_credit_topup()
+
+    # ------------------------------------------------- streaming leases
+
+    def _note_credit_demand(self, conn, req: PendingRequest,
+                            backlog) -> None:
+        """Open/refresh the credit window a lease request's backlog
+        describes. Old-protocol clients send no backlog — they simply
+        never get a window (pure legacy behavior)."""
+        if backlog is None or conn is None or conn.closed:
+            return
+        key = (id(conn), req.scheduling_class)
+        w = self._credit_windows.get(key)
+        if w is None:
+            w = CreditWindow(conn, req.scheduling_class,
+                             dict(req.resources), req.env_hash,
+                             req.retriable)
+            self._credit_windows[key] = w
+            conn.on_disconnect.append(
+                lambda c, k=key: self._credit_windows.pop(k, None))
+        w.demand = int(backlog)
+        w.demand_ts = time.monotonic()
+        w.env_hash = req.env_hash
+        w.retriable = req.retriable
+        self._schedule_credit_topup()
+
+    def _schedule_credit_topup(self) -> None:
+        if self._credit_topup_scheduled or self._closing or \
+                not self._credit_windows:
+            return
+        self._credit_topup_scheduled = True
+        asyncio.get_event_loop().call_soon(self._credit_topup)
+
+    def _credit_window_target(self, w: CreditWindow) -> Tuple[int, int]:
+        """Window sizing from the REAL scheduler view. Returns
+        ``(local, cluster)`` slot targets: the owner's breadth
+        heuristic (~one worker per 8 queued tasks) clamped by the slot
+        capacity for this resource shape on THIS node (what this raylet
+        can stream) and across the whole cluster view (how many legacy
+        requests the owner may park for spillback beyond the stream),
+        both bounded by the per-window ceiling. Pressure or stale
+        demand zeroes both — an owner that stopped reporting backlog
+        must not keep slots."""
+        if self.memory_monitor.pressure or w.demand <= 0:
+            return 0, 0
+        if time.monotonic() - w.demand_ts > \
+                self.config.lease_credit_stale_s:
+            return 0, 0
+
+        def _slots(view: NodeView) -> int:
+            per = None
+            for k, need in w.resources.items():
+                if need <= 0:
+                    continue
+                n = int(view.total.get(k, 0.0) / need + 1e-9)
+                per = n if per is None else min(per, n)
+            if per is None:  # zero-resource shape: CPU slots bound it
+                per = int(view.total.get("CPU", 0.0)) or 1
+            return per
+
+        local = 0
+        cluster = 0
+        for v in self._node_views():
+            n = _slots(v)
+            cluster += n
+            if v.is_local:
+                local += n
+        want = max(1, w.demand // 8)
+        cap = self.config.lease_credit_window_max
+        return (max(0, min(cap, want, local)),
+                max(0, min(cap, want, cluster)))
+
+    def _credit_topup(self) -> None:
+        """Book credits up to each live window's target and stream them
+        to the owner (one GrantLeaseCredits push per window per round,
+        piggybacking the window target so the owner stops parking
+        legacy lease requests beyond it). Every credit books a real
+        worker + resources through the same accounting as _try_grant."""
+        self._credit_topup_scheduled = False
+        if self._closing or not self.config.lease_credits_enabled or \
+                self.memory_monitor.pressure:
+            return
+        for key, w in list(self._credit_windows.items()):
+            if w.conn is None or w.conn.closed:
+                self._credit_windows.pop(key, None)
+                continue
+            target, cluster = self._credit_window_target(w)
+            credits: List[dict] = []
+            while len(w.lease_ids) < target:
+                cr = self._grant_credit(w)
+                if cr is None:
+                    break
+                credits.append(cr)
+            deficit = target - len(w.lease_ids)
+            if deficit > 0:
+                # pool ramp-up parity with the legacy path (which
+                # starts one worker per parked request): kick off a
+                # spawn per unfilled slot NOW — _start_worker_process
+                # no-ops at the cap, and each registration re-triggers
+                # the topup. Serial one-spawn-per-beat ramping measured
+                # 20% off the 1M-drain wall on a many-core box.
+                for _ in range(deficit):
+                    self._start_worker_process()
+            if not credits and target == w.target:
+                continue  # nothing new to announce
+            w.target = target
+            if faultpoints.armed and faultpoints.fire(
+                    "lease.credit.grant", node=self._nid12,
+                    sched_class=w.sched_class,
+                    n=len(credits)) == "drop":
+                # grant push lost: the leases stay booked against this
+                # owner; the stale-revoke beat reconciles them (the
+                # owner replies "released" for ids it never received)
+                continue
+            try:
+                w.conn.push_nowait("GrantLeaseCredits", {
+                    "sched_class": w.sched_class,
+                    "raylet_address": self.address,
+                    "window_target": target,
+                    "cluster_slots": cluster,
+                    "resources": w.resources,
+                    "credits": credits})
+            except ConnectionError:
+                pass  # disconnect callbacks reclaim the booked leases
+
+    def _grant_credit(self, w: CreditWindow) -> Optional[dict]:
+        """Book ONE credit: idle worker + resources -> LeaseEntry,
+        exactly like _try_grant minus the pending request. Returns the
+        wire credit dict, or None when the pool/capacity can't serve
+        one right now (a worker spawn may be kicked off for later)."""
+        for k, v in w.resources.items():
+            if v > 0 and self.resources_available.get(k, 0.0) + 1e-9 < v:
+                return None
+        worker = self._pop_idle_worker(w.env_hash)
+        if worker is None:
+            if self._alive_worker_count() + self._num_starting < \
+                    self.max_workers:
+                self._start_worker_process()
+            return None
+        worker.env_hash = w.env_hash
+        lease_id = next(self._lease_counter)
+        for k, v in w.resources.items():
+            self.resources_available[k] = \
+                self.resources_available.get(k, 0.0) - v
+        worker.state = WORKER_LEASED
+        worker.lease_id = lease_id
+        worker.leased_at = time.monotonic()
+        worker.lease_retriable = w.retriable
+        lease = LeaseEntry(lease_id, worker, dict(w.resources), w.conn)
+        lease.credit_window = (id(w.conn), w.sched_class)  # type: ignore[attr-defined]
+        self.leases[lease_id] = lease
+        self._watch_lease_client(lease)
+        w.lease_ids.add(lease_id)
+        self.num_credit_grants += 1
+        # Per-GRANT latency sample (credit grants included): how long
+        # this window's current demand waited for the slot. Keeps the
+        # grant_wait reservoirs reflecting the grant population instead
+        # of the handful of legacy requests a credit-served drain makes.
+        wait = time.monotonic() - w.demand_ts
+        self._sched_latencies.append(wait)
+        self._grant_waits.append(wait)
+        return {"lease_id": lease_id,
+                "worker_address": worker.address,
+                "worker_id": worker.worker_id,
+                "node_id": self.node_id.binary()}
+
+    def _credit_beat(self) -> None:
+        """Heartbeat-cadence window maintenance: prune dead-conn
+        windows, zero + revoke everything under memory pressure (the
+        watchdog's poll ran just before this), offer back the excess of
+        over-target windows, and top up under-target ones."""
+        if not self.config.lease_credits_enabled or \
+                not self._credit_windows:
+            return
+        now = time.monotonic()
+        pressure = self.memory_monitor.pressure
+        for key, w in list(self._credit_windows.items()):
+            if w.conn is None or w.conn.closed:
+                self._credit_windows.pop(key, None)
+                continue
+            target = 0 if pressure else self._credit_window_target(w)[0]
+            if pressure and w.target != 0:
+                # tell the owner its window is gone so it falls back to
+                # legacy requests (which get the typed backpressure
+                # reply and spill/back off) instead of waiting on a
+                # stream that will not flow
+                w.target = 0
+                try:
+                    w.conn.push_nowait("GrantLeaseCredits", {
+                        "sched_class": w.sched_class,
+                        "raylet_address": self.address,
+                        "window_target": 0,
+                        "cluster_slots": 0,
+                        "resources": w.resources,
+                        "credits": []})
+                except ConnectionError:
+                    continue
+            excess = len(w.lease_ids) - target
+            if w.lease_ids and not w.revoking and \
+                    (pressure or now - w.last_revoke_ts >=
+                     self.config.lease_credit_stale_s):
+                # Offer the window's credits back on every stale
+                # period — not just when over target. The owner keeps
+                # what it is using; what comes back is the excess,
+                # idle-with-no-backlog slots, AND any PHANTOM credits
+                # a dropped grant push booked that the owner never
+                # heard of (it confirms unknown ids as released) — the
+                # reconciliation a lost push depends on, which a
+                # demand-fresh at-target window would otherwise never
+                # trigger.
+                max_release = len(w.lease_ids) \
+                    if (pressure or excess <= 0) else excess
+                w.last_revoke_ts = now
+                w.revoking = True
+                asyncio.get_event_loop().create_task(
+                    self._revoke_credits(
+                        w, list(w.lease_ids), max_release,
+                        "memory_pressure" if pressure
+                        else "window_resize"))
+            if excess < 0 and not pressure:
+                self._schedule_credit_topup()
+
+    async def _revoke_credits(self, w: CreditWindow, lease_ids: List[int],
+                              max_release: int, reason: str) -> None:
+        """Offer ``lease_ids`` back to the owner (which relinquishes up
+        to ``max_release`` it is not using; under ``memory_pressure``
+        it releases idle credits even with backlog — draining work off
+        this node IS the recovery) and reclaim what came back. A lost
+        or unanswered revoke is safe: the credits stay valid and a
+        later beat re-offers them; a dead owner's credits come back
+        through the lease-client liveness watch."""
+        try:
+            if faultpoints.armed and faultpoints.fire(
+                    "lease.credit.revoke", node=self._nid12,
+                    sched_class=w.sched_class, reason=reason,
+                    n=len(lease_ids)) == "drop":
+                return
+            try:
+                reply, _ = await w.conn.call(
+                    "RevokeLeaseCredits",
+                    {"lease_ids": lease_ids,
+                     "max_release": max_release,
+                     "reason": reason},
+                    timeout=2.0)
+            except (ConnectionError, asyncio.TimeoutError):
+                return
+            for lid in reply.get("released", ()):
+                if lid in w.lease_ids and lid in self.leases:
+                    self.num_credit_revoked += 1
+                    self._release_lease(lid)
+                else:
+                    # an id the owner never received (dropped grant
+                    # push) or already returned: reconcile the ledger
+                    w.lease_ids.discard(lid)
+                    if lid in self.leases:
+                        self.num_credit_revoked += 1
+                        self._release_lease(lid)
+        finally:
+            w.revoking = False
+
+    def _credit_stats(self) -> dict:
+        outstanding = sum(len(w.lease_ids)
+                          for w in self._credit_windows.values())
+        total = self.num_credit_grants + self.num_leases_granted
+        return {
+            "enabled": self.config.lease_credits_enabled,
+            "windows": len(self._credit_windows),
+            "outstanding": outstanding,
+            "granted_total": self.num_credit_grants,
+            "revoked_total": self.num_credit_revoked,
+            "legacy_grants_total": self.num_leases_granted,
+            # share of all lease grants that were streamed credits —
+            # the raylet-side credit hit-rate (the owner-side per-TASK
+            # dispatch split lives in CoreWorker.stats
+            # credit_dispatches / legacy_dispatches)
+            "credit_grant_rate": round(
+                self.num_credit_grants / total, 4) if total else 0.0,
+        }
 
     # -------------------------------------------------------------- actors
 
@@ -2191,6 +2589,12 @@ class Raylet:
         from ray_tpu._private.metrics import percentile
 
         out = self._pct_block(self._sched_latencies)
+        # grant-population split: streamed credit grants vs legacy
+        # request/grant round-trips (both feed the reservoirs above, so
+        # the percentiles reflect the whole grant population — not just
+        # the handful of legacy requests a credit-served drain makes)
+        out["credit_grants"] = self.num_credit_grants
+        out["legacy_grants"] = self.num_leases_granted
         if not out["count"]:
             return out
         # arrival->first-decision (kernel responsiveness) vs
@@ -2326,6 +2730,8 @@ class Raylet:
             "num_pending_leases": len(self._pending),
             "num_leases_granted": self.num_leases_granted,
             "num_spillbacks": self.num_spillbacks,
+            # streaming-lease window state + credit hit-rate
+            "lease_credits": self._credit_stats(),
             "store": self.store.stats(),
             # watchdog state: per-worker RSS, pressure flag, cumulative
             # kill/backpressure counts + last-64 action history

@@ -275,7 +275,22 @@ class Connection:
     def push_nowait(self, method: str, header: Any = None,
                     bufs: Sequence[bytes] = ()):
         """One-way message from the loop thread, coalesced like replies
-        (used for streamed per-task actor results)."""
+        (used for streamed per-task actor results and the streaming-
+        lease pushes: GrantLeaseCredits, ReportLeaseDemand). Routes
+        through the same ``rpc.call.send`` fault seam as requests so
+        chaos schedules can drop/sever/duplicate the one-way lanes too
+        — a lost credit grant is a first-class failure mode."""
+        if faultpoints.armed:
+            act = faultpoints.fire("rpc.call.send", method=method,
+                                   peer=self.peer_name)
+            if act == "drop":
+                return
+            if act == "sever":
+                self._mark_closed()
+                return
+            if act == "duplicate":
+                self._write_nowait(
+                    _pack_msg(KIND_PUSH, 0, method, header, bufs))
         self._write_nowait(_pack_msg(KIND_PUSH, 0, method, header, bufs))
 
     async def _recv_loop(self):

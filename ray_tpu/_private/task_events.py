@@ -14,13 +14,20 @@ Every task gets a recorded state machine::
     SUBMITTED -> [PENDING_ARGS] -> PENDING_LEASE -> LEASE_GRANTED
               -> DISPATCHED -> RUNNING -> FINISHED | FAILED(reason)
 
-with RETRY / SPILLBACK annotations. Transitions are stamped AT THE
-LAYER THAT OWNS THEM:
+with RETRY / SPILLBACK annotations — and, under streaming leases,
+``CREDIT_DISPATCHED`` in place of ``DISPATCHED`` for tasks pushed to a
+credit-granted worker: those tasks legitimately have NO
+PENDING_LEASE/LEASE_GRANTED hops (the lease round-trip is exactly what
+the credit window eliminated), and the distinct state keeps
+``grant_wait`` honestly measured — a credit dispatch is never counted
+as a zero-wait legacy grant, and a missing lease hop is visibly a
+credit hit, not a recording gap. Transitions are stamped AT THE LAYER
+THAT OWNS THEM:
 
 * core_worker.py — SUBMITTED, PENDING_ARGS (arg resolution), RETRY,
-  DISPATCHED (this runtime's direct transport pushes task batches from
-  the owner, so dispatch is owner-side), owner-observed FAILED
-  (worker death, cancellation, infeasibility).
+  DISPATCHED / CREDIT_DISPATCHED (this runtime's direct transport
+  pushes task batches from the owner, so dispatch is owner-side),
+  owner-observed FAILED (worker death, cancellation, infeasibility).
 * raylet.py — PENDING_LEASE (lease request queued), LEASE_GRANTED,
   SPILLBACK, and TRANSFER records for data-plane pulls. Lease requests
   carry the sample task at the head of the owner's queue
@@ -58,6 +65,9 @@ PENDING_LEASE = "PENDING_LEASE"
 LEASE_GRANTED = "LEASE_GRANTED"
 SPILLBACK = "SPILLBACK"
 DISPATCHED = "DISPATCHED"
+# Dispatch against a pre-granted lease credit (streaming leases): the
+# task skipped the PENDING_LEASE/LEASE_GRANTED hops by design.
+CREDIT_DISPATCHED = "CREDIT_DISPATCHED"
 RUNNING = "RUNNING"
 FINISHED = "FINISHED"
 FAILED = "FAILED"

@@ -10,7 +10,10 @@ plus ``ray timeline``'s chrome-trace export, scripts.py `ray
 timeline`). Task histories come from the GCS task-event table
 (task_events.py): every task's ordered transition history — SUBMITTED
 -> PENDING_LEASE -> DISPATCHED -> RUNNING -> FINISHED|FAILED with
-retry/spillback annotations — with per-hop durations.
+retry/spillback annotations — with per-hop durations. Tasks dispatched
+against a streaming-lease credit record CREDIT_DISPATCHED instead of
+DISPATCHED and legitimately skip the PENDING_LEASE/LEASE_GRANTED hops
+(the credit window replaced that round-trip).
 """
 
 from __future__ import annotations

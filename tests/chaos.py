@@ -76,7 +76,7 @@ CHAOS_CFG = {
 SCHEDULE_KINDS = (
     "stripe_sever", "corrupt_chunk", "short_read", "delay_storm",
     "raylet_kill", "heartbeat_partition", "gcs_restart", "mixed",
-    "worker_kill", "oom_storm",
+    "worker_kill", "oom_storm", "credit_revoke",
 )
 
 # Event vocabulary for the data-plane harness. Each entry generates a
@@ -103,7 +103,8 @@ def make_schedule(kind: str, seed: int, rounds: int = 8,
     Events are keyed by the workload round BEFORE which they apply;
     ``target`` indexes the raylet they hit (resolved to whatever is
     still alive at run time)."""
-    if kind not in _KIND_OPS and kind not in ("worker_kill", "oom_storm"):
+    if kind not in _KIND_OPS and kind not in (
+            "worker_kill", "oom_storm", "credit_revoke"):
         raise ValueError(f"unknown schedule kind {kind!r}")
     if kind == "worker_kill":
         # the worker-kill schedule is carried by the RAY_TPU_FAULTPOINTS
@@ -112,6 +113,10 @@ def make_schedule(kind: str, seed: int, rounds: int = 8,
     if kind == "oom_storm":
         # the OOM storm is carried by the seeded simulated-RSS plan in
         # run_oom_storm_schedule (a memory.poll hook), not harness events
+        return []
+    if kind == "credit_revoke":
+        # the streaming-lease schedule is carried by the seeded
+        # per-round disruption plan in run_credit_revoke_schedule
         return []
     rng = random.Random(seed)
     events: List[dict] = []
@@ -557,6 +562,274 @@ def run_task_schedule(seed: int, kill_nth: int = 6,
     assert fd_after <= fd_before + 8, \
         f"fd leak across the task soak: {fd_before} -> {fd_after}"
     return summary
+
+
+# ---------------------------------------------------------------------------
+# streaming-lease revocation soak (credit_revoke)
+# ---------------------------------------------------------------------------
+
+
+def run_credit_revoke_schedule(seed: int, rounds: int = 4,
+                               tasks_per_round: int = 16) -> dict:
+    """Soak every streaming-lease recovery path against a REAL cluster
+    (in-process head, worker subprocesses, credits ON — the default):
+
+    * per-round seeded disruptions: force-revoke every credit window
+      mid-flight (in-use credits must be KEPT and finish; idle ones
+      reclaimed), drop a GrantLeaseCredits push (booked leases the
+      owner never heard about must reconcile on a later beat), drop a
+      RevokeLeaseCredits call (the revoke must converge on a later
+      beat);
+    * kill an OWNER subprocess holding live credits: the raylet must
+      reclaim every slot (no leaked pool capacity);
+    * the raylet-kill leg (owner falls back to spillback/legacy when a
+      node with outstanding credits dies) lives in
+      run_credit_raylet_kill_schedule — it needs the multi-node
+      Cluster harness.
+
+    Invariants (the chaos bar): every get resolves in bound to the
+    correct value, credits actually engaged (non-vacuous), windows
+    drain, ``_lent`` drains, pool capacity returns to total, no
+    fd/zombie leaks, no hung submits."""
+    import ray_tpu
+
+    fd_before = _fd_count()
+    rng = random.Random(seed)
+    disruptions = [rng.choice(["revoke_all", "drop_grant", "drop_revoke"])
+                   for _ in range(rounds)]
+    summary: Dict[str, Any] = {"seed": seed, "disruptions": disruptions,
+                               "ok": 0, "revoked": 0}
+    try:
+        ray_tpu.init(num_cpus=2, _system_config={
+            "raylet_heartbeat_period_ms": 50,
+            "lease_credit_stale_s": 0.4,
+            "idle_lease_keepalive_s": 0.05,
+            "retry_backoff_base_s": 0.02,
+            "retry_backoff_cap_s": 0.25,
+        })
+        node = ray_tpu.worker.global_worker.node
+        raylet = node.raylet
+
+        @ray_tpu.remote(max_retries=8)
+        def slow_double(x, delay_s):
+            import time as time_mod
+            time_mod.sleep(delay_s)
+            return x * 2
+
+        async def _force_revoke_all(reason: str) -> int:
+            n = 0
+            for key, w in list(raylet._credit_windows.items()):
+                if w.conn is None or w.conn.closed or w.revoking \
+                        or not w.lease_ids:
+                    continue
+                w.revoking = True
+                ids = list(w.lease_ids)
+                n += len(ids)
+                await raylet._revoke_credits(w, ids, len(ids), reason)
+            return n
+
+        for round_no in range(rounds):
+            disruption = disruptions[round_no]
+            if disruption == "drop_grant":
+                faultpoints.arm("lease.credit.grant", "drop", times=1)
+            elif disruption == "drop_revoke":
+                faultpoints.arm("lease.credit.revoke", "drop", times=1)
+            wave = [(rng.randrange(1000),
+                     round(rng.uniform(0.02, 0.08), 3))
+                    for _ in range(tasks_per_round)]
+            refs = [slow_double.remote(x, d) for x, d in wave]
+            if disruption == "revoke_all":
+                # mid-flight revocation: in-use credits are kept (the
+                # running tasks finish), idle ones come back
+                import time as time_mod
+                time_mod.sleep(0.05)
+                summary["revoked"] += node._loop_thread.run(
+                    _force_revoke_all("chaos_revoke"), timeout=10)
+            for (x, _d), ref in zip(wave, refs):
+                assert ray_tpu.get(ref, timeout=120) == x * 2, \
+                    f"wrong value under {disruption} at round {round_no}"
+                summary["ok"] += 1
+            faultpoints.reset()
+            # per-round invariants (the standard chaos bar)
+            assert raylet._pull_inflight_bytes == 0
+            assert not raylet.store._lent, \
+                f"segment lease leaked at round {round_no}"
+
+        # non-vacuous: the stream must actually have engaged
+        stats = raylet._credit_stats()
+        assert stats["granted_total"] > 0, \
+            f"credit stream never engaged: {stats}"
+        summary["granted_total"] = stats["granted_total"]
+        summary["revoked_total"] = stats["revoked_total"]
+
+        # ---- owner kill while holding live credits --------------------
+        import subprocess
+        import sys as sys_mod
+        import time as time_mod
+
+        gcs = ray_tpu.worker.global_worker.core.gcs_address
+        script = (
+            "import os, sys, time\n"
+            "import ray_tpu\n"
+            f"ray_tpu.init(address={gcs!r})\n"
+            "@ray_tpu.remote(max_retries=0)\n"
+            "def hold(s):\n"
+            "    import time\n"
+            "    time.sleep(s)\n"
+            "    return s\n"
+            # enough tasks to lease every slot; long enough to outlive
+            # the parent's SIGKILL decision
+            "refs = [hold.remote(30) for _ in range(4)]\n"
+            "time.sleep(1.0)\n"
+            "print('HOLDING', flush=True)\n"
+            "time.sleep(60)\n")
+        proc = subprocess.Popen(
+            [sys_mod.executable, "-c", script],
+            stdout=subprocess.PIPE, text=True, env=dict(os.environ))
+        try:
+            line = proc.stdout.readline()
+            assert "HOLDING" in line, \
+                f"owner subprocess never came up: {line!r}"
+            # the foreign owner must actually hold leases before we
+            # shoot it (leased slots show as missing CPU capacity)
+            deadline = time_mod.time() + 20
+            while time_mod.time() < deadline and \
+                    raylet.resources_available.get("CPU", 0) > 0:
+                time_mod.sleep(0.05)
+            held = raylet.resources_available.get("CPU", 0)
+            proc.kill()
+            proc.wait(timeout=10)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+        assert held == 0, \
+            f"owner subprocess never leased the pool (avail CPU {held})"
+        # reclaim: owner-liveness watch must return every slot — no
+        # leaked pool capacity, no orphan leases, windows pruned
+        deadline = time_mod.time() + 30
+        while time_mod.time() < deadline:
+            if raylet.resources_available == raylet.resources_total \
+                    and not raylet.leases:
+                break
+            time_mod.sleep(0.1)
+        assert raylet.resources_available == raylet.resources_total, \
+            f"pool capacity leaked after owner kill: " \
+            f"{raylet.resources_available} != {raylet.resources_total}"
+        assert not raylet.leases, \
+            f"orphan leases after owner kill: {list(raylet.leases)}"
+        assert all(not w.lease_ids
+                   for w in raylet._credit_windows.values()), \
+            "credit window still holds slots of a dead owner"
+        # no hung submits: the surviving driver still gets work done
+        assert ray_tpu.get(slow_double.remote(21, 0.01), timeout=60) == 42
+        summary["owner_kill"] = "reclaimed"
+    finally:
+        faultpoints.reset()
+        ray_tpu.shutdown()
+
+    # post-shutdown process hygiene (same bar as the other real-cluster
+    # soaks): reaped workers, fd table back to its pre-run level
+    import time as time_mod
+    deadline = time_mod.time() + 5.0
+    zombies = _zombie_children()
+    while zombies and time_mod.time() < deadline:
+        time_mod.sleep(0.1)
+        zombies = _zombie_children()
+    assert not zombies, \
+        f"unreaped workers survive the credit_revoke soak: {zombies}"
+    fd_after = _fd_count()
+    assert fd_after <= fd_before + 8, \
+        f"fd leak across credit_revoke: {fd_before} -> {fd_after}"
+    return summary
+
+
+def run_credit_raylet_kill_schedule(seed: int) -> dict:
+    """The multi-node leg of the credit_revoke schedule: SIGKILL a
+    worker-node raylet while owners hold outstanding credits/leases on
+    it. The owner must fall back to the spillback/legacy path (retries
+    land on the surviving head), every get resolves to the correct
+    value, and the head's pool capacity is fully restored."""
+    import time as time_mod
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    rng = random.Random(seed)
+    c = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    c.add_node(num_cpus=2)
+    summary: Dict[str, Any] = {"seed": seed}
+    try:
+        c.connect()
+
+        @ray_tpu.remote(max_retries=8)
+        def slow_double(x, delay_s):
+            import time as time_mod
+            time_mod.sleep(delay_s)
+            return x * 2
+
+        # more backlog than the head can hold: breadth spills to node2,
+        # whose raylet then holds leases/credits for this owner
+        wave = [(rng.randrange(1000), round(rng.uniform(0.1, 0.3), 3))
+                for _ in range(24)]
+        refs = [slow_double.remote(x, d) for x, d in wave]
+        # wait until node2 actually granted something (leases or
+        # streamed credits) so the kill hits a node with outstanding
+        # grants — otherwise the round is vacuous
+        node2 = c.nodes[-1]
+        granted = {}
+        deadline = time_mod.time() + 30
+        while time_mod.time() < deadline:
+            try:
+                stats = _raylet_stats_sync(node2.raylet_address)
+            except Exception:  # noqa: BLE001 — node still booting
+                stats = {}
+            granted = {
+                "leases": stats.get("num_leases_granted", 0),
+                "credits": stats.get("lease_credits", {}).get(
+                    "granted_total", 0)}
+            if granted["leases"] + granted["credits"] > 0:
+                break
+            time_mod.sleep(0.05)
+        assert granted["leases"] + granted["credits"] > 0, \
+            "node2 never granted a lease/credit — vacuous kill"
+        summary["node2_granted"] = granted
+        node2.kill()
+        # every submit resolves to the right value via the fallback
+        # path (no hangs, no wrong results)
+        for (x, _d), ref in zip(wave, refs):
+            assert ray_tpu.get(ref, timeout=120) == x * 2
+        summary["ok"] = len(wave)
+        # head pool fully restored once the surviving work drains
+        head_stats = {}
+        deadline = time_mod.time() + 30
+        while time_mod.time() < deadline:
+            head_stats = _raylet_stats_sync(c.head.raylet_address)
+            if head_stats["resources_available"] == \
+                    head_stats["resources_total"]:
+                break
+            time_mod.sleep(0.1)
+        assert head_stats["resources_available"] == \
+            head_stats["resources_total"], \
+            f"head pool leaked after raylet kill: {head_stats}"
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+    return summary
+
+
+def _raylet_stats_sync(raylet_address: str) -> dict:
+    """GetNodeStats over a throwaway connection/loop (test helper)."""
+    async def _q():
+        conn = await rpc.connect(raylet_address, peer_name="chaos-stats",
+                                 timeout=5.0)
+        try:
+            reply, _ = await conn.call("GetNodeStats", {}, timeout=5.0)
+            return reply
+        finally:
+            await conn.close()
+
+    return asyncio.run(_q())
 
 
 # ---------------------------------------------------------------------------

@@ -15,8 +15,9 @@ rest are ``slow`` and run via ci/chaos.sh.
 import pytest
 
 from chaos import (
-    make_schedule, run_data_plane_schedule, run_oom_storm_schedule,
-    run_task_schedule, schedules_equal,
+    make_schedule, run_credit_raylet_kill_schedule,
+    run_credit_revoke_schedule, run_data_plane_schedule,
+    run_oom_storm_schedule, run_task_schedule, schedules_equal,
 )
 
 # Pinned seeds: chosen once, frozen forever. Changing a seed is
@@ -32,6 +33,7 @@ SEEDS = {
     "mixed": 1808,
     "worker_kill": 1909,
     "oom_storm": 2010,
+    "credit_revoke": 2111,
 }
 
 
@@ -39,7 +41,7 @@ def test_schedule_generation_is_deterministic():
     """Same (kind, seed) -> byte-identical schedule; different seeds ->
     different schedules (the RNG actually reaches the events)."""
     for kind, seed in SEEDS.items():
-        if kind in ("worker_kill", "oom_storm"):
+        if kind in ("worker_kill", "oom_storm", "credit_revoke"):
             continue
         a = make_schedule(kind, seed)
         b = make_schedule(kind, seed)
@@ -92,6 +94,30 @@ def test_chaos_soak(kind, tmp_path):
 def test_chaos_soak_worker_kill():
     summary = run_task_schedule(SEEDS["worker_kill"])
     assert summary["retry_or_failed_events"] > 0
+
+
+@pytest.mark.slow
+def test_chaos_soak_credit_revoke():
+    """Streaming-lease revocation soak: seeded mid-flight window
+    revokes, dropped grant/revoke pushes (ledger reconciliation), and
+    an owner subprocess SIGKILLed while holding live credits — every
+    get resolves correctly, the stream provably engaged, and the pool
+    reclaims every slot. Runs with credits ON (the default); ci/chaos.sh
+    re-runs the worker_kill/oom_storm/raylet-kill soaks with
+    RAY_TPU_LEASE_CREDITS_ENABLED=0 to pin the legacy path too."""
+    summary = run_credit_revoke_schedule(SEEDS["credit_revoke"])
+    assert summary["granted_total"] > 0
+    assert summary["owner_kill"] == "reclaimed"
+
+
+@pytest.mark.slow
+def test_chaos_soak_credit_raylet_kill():
+    """Kill a worker-node raylet while owners hold outstanding
+    grants on it: the owner falls back to the spillback/legacy path,
+    every task resolves to the correct value, and the surviving head's
+    pool capacity is fully restored."""
+    summary = run_credit_raylet_kill_schedule(SEEDS["credit_revoke"])
+    assert summary["ok"] == 24
 
 
 @pytest.mark.slow

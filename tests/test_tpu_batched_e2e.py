@@ -63,7 +63,12 @@ def test_tpu_batched_stress_10k_pending():
         "scheduler_backend": "tpu_batched",
         # shallow pipelines force many concurrent lease requests — the
         # point is scheduler pressure, not transport batching
-        "max_tasks_in_flight_per_worker": 32})
+        "max_tasks_in_flight_per_worker": 32,
+        # streaming leases deliberately keep the pending-lease queue
+        # SHALLOW (that is their whole job); this test's subject is the
+        # batched scheduler kernel under a deep queue, so it pins the
+        # legacy request/grant path
+        "lease_credits_enabled": False})
     try:
         node = ray_tpu.worker.global_worker.node
         backend = node.raylet.backend
