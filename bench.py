@@ -443,12 +443,14 @@ def main():
             "stats_error": str(e)}
     # raylint gate cost (ci/lint.sh): the whole-PROGRAM static-analysis
     # pass (symbol table + call graph + rpc-schema inference + the
-    # transitive async-blocking escalation included) must stay under
-    # 10 s so it can gate every round — tracked here like any other
+    # transitive async-blocking escalation included) PLUS the schemagen
+    # drift gate (stub regeneration + golden diff) must stay under 10 s
+    # so they can gate every round — tracked here like any other
     # hot-path budget.
     _trace("lint runtime")
     try:
         from ray_tpu._private.lint import analyze_modules, load_modules
+        from ray_tpu._private.lint import schemagen as schemagen_mod
         from ray_tpu._private.lint.rules.rpc_schema import infer_schemas
         _t0 = time.perf_counter()
         _mods = load_modules(
@@ -456,11 +458,20 @@ def main():
                           "ray_tpu")])
         _lint_violations, _program = analyze_modules(_mods)
         _lint_wall = time.perf_counter() - _t0
+        # drift gate on the SAME program (ci/lint.sh re-infers; the
+        # marginal generator cost is what this sub-row isolates)
+        _t1 = time.perf_counter()
+        _drift = schemagen_mod.check_program(_program)
+        _gen_wall = time.perf_counter() - _t1
         lint_row = {"files": len(_mods),
                     "violations": len(_lint_violations),
                     "rpc_methods_inferred": len(infer_schemas(_program)),
-                    "wall_s": round(_lint_wall, 2), "budget_s": 10.0,
-                    "within_budget": _lint_wall < 10.0}
+                    "protocol_version": schemagen_mod.PROTOCOL_VERSION,
+                    "schemagen_s": round(_gen_wall, 3),
+                    "drift_clean": not _drift,
+                    "wall_s": round(_lint_wall + _gen_wall, 2),
+                    "budget_s": 10.0,
+                    "within_budget": _lint_wall + _gen_wall < 10.0}
     except Exception as e:  # noqa: BLE001 — secondary row
         lint_row = {"error": str(e)}
     _trace("columnar data")

@@ -5,7 +5,10 @@
 # delay storm, raylet crash, heartbeat partition, GCS restart, mixed,
 # worker kill, OOM storm (seeded simulated-RSS ramps through the node
 # memory watchdog: kills, OOM retries, lease backpressure — asserting
-# the raylet/GCS survive every event).
+# the raylet/GCS survive every event), and the mixed_version rolling-
+# upgrade smoke (an old-schema raylet speaking v1 stubs compiled from
+# tests/fixtures/rpc_schemas_v1.json against the current GCS through a
+# seeded gcs_restart — version negotiation recorded in node info).
 # Runs the slow-marked schedules too (tier-1 carries only
 # the 2-schedule smoke); any invariant violation (pull hang, admission
 # budget leak, segment-lease leak, fd leak, unresurrected partitioned

@@ -9,9 +9,15 @@
 # machine-readable artifact for the build system to attach. The JSON
 # artifact carries the inferred per-method RPC schema table
 # ("rpc_schemas": method -> required/optional/reply keys) for protocol
-# debugging, plus "stale_pragmas". --stale-pragmas is warn-only by
-# design: dead `# raylint: disable=` anchors are reported but never
-# fail the gate.
+# debugging, "protocol_version" (what the generated stubs speak), plus
+# "stale_pragmas". --stale-pragmas is warn-only by design: dead
+# `# raylint: disable=` anchors are reported but never fail the gate.
+#
+# The schema DRIFT GATE rides the same run (--drift-check, one parse +
+# one program build for both): lint/schemagen.py re-infers every RPC
+# schema and fails with a diff when _private/protocol.py or the
+# checked-in golden (lint/rpc_schemas_golden.json) no longer match —
+# editing a handler's wire schema without regenerating cannot land.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -20,26 +26,30 @@ ARTIFACT="${RAYLINT_ARTIFACT:-/tmp/raylint-report.json}"
 if [ "${CI:-}" = "1" ] || [ "${1:-}" = "--json" ]; then
     # JSON artifact + human summary; the gate is the exit code either way.
     if python -m ray_tpu._private.lint --format json --stale-pragmas \
-            ray_tpu/ > "$ARTIFACT"; then
-        echo "raylint: clean (artifact: $ARTIFACT)"
+            --drift-check ray_tpu/ > "$ARTIFACT"; then
+        echo "raylint: clean, schemas in sync (artifact: $ARTIFACT)"
         python - "$ARTIFACT" <<'PY'
 import json, sys
 r = json.load(open(sys.argv[1]))
-print(f"raylint: {len(r['rpc_schemas'])} RPC method schemas inferred")
+print(f"raylint: {len(r['rpc_schemas'])} RPC method schemas inferred "
+      f"(protocol version {r['protocol_version']})")
 for v in r["stale_pragmas"]:
     print(f"warning: {v['path']}:{v['line']}: {v['rule']}: {v['message']}")
 PY
     else
         rc=$?
-        echo "raylint: violations (artifact: $ARTIFACT)" >&2
+        echo "raylint: violations or schema drift (artifact: $ARTIFACT)" >&2
         python - "$ARTIFACT" <<'PY'
 import json, sys
-for v in json.load(open(sys.argv[1]))["violations"]:
+r = json.load(open(sys.argv[1]))
+for v in r["violations"]:
     print(f"{v['path']}:{v['line']}:{v['col']}: {v['rule']}: {v['message']}",
           file=sys.stderr)
+for line in r.get("schema_drift", []):
+    print(line, file=sys.stderr)
 PY
         exit "$rc"
     fi
 else
-    python -m ray_tpu._private.lint --stale-pragmas ray_tpu/
+    python -m ray_tpu._private.lint --stale-pragmas --drift-check ray_tpu/
 fi

@@ -29,7 +29,7 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu import exceptions as exc
-from ray_tpu._private import rpc
+from ray_tpu._private import protocol, rpc
 from ray_tpu._private.config import RayTpuConfig
 from ray_tpu._private.function_manager import FunctionManager
 from ray_tpu._private.ids import (
@@ -564,28 +564,29 @@ class CoreWorker:
         left, which is the whole point of the stream."""
         if self._shutdown:
             return {}
-        sc = header["sched_class"]
+        req = protocol.GrantLeaseCreditsRequest.from_header(header)
+        sc = req.sched_class
         state = self.scheduling_keys.get(sc)
         if state is None:
             state = self.scheduling_keys[sc] = SchedulingKeyState(
-                header.get("resources") or {})
-        if header["raylet_address"] == self.raylet_address:
+                req.get("resources") or {})
+        if req.raylet_address == self.raylet_address:
             # Only the HOME raylet's window sizes the pump's stream
             # floor and legacy-band clamp: in spillback clusters a
             # remote raylet pushes its own (differently-sized) window
             # each beat, and last-push-wins would flap the breadth
             # every heartbeat. Remote credits still activate below —
             # they just don't steer the local policy.
-            state.credit_target = int(header["window_target"])
-            state.cluster_slots = int(header.get(
-                "cluster_slots", header["window_target"]))
-        for cr in header.get("credits", ()):
+            state.credit_target = int(req.window_target)
+            state.cluster_slots = int(req.get(
+                "cluster_slots", req.window_target))
+        for cr in req.get("credits", ()):
             self.stats["lease_credits_received"] += 1
             self._activating_credits.add(cr["lease_id"])
             state.activating += 1
             asyncio.get_running_loop().create_task(
                 self._activate_credit(sc, state, cr,
-                                      header["raylet_address"]))
+                                      req.raylet_address))
         return {}
 
     async def _activate_credit(self, sc: int, state: SchedulingKeyState,
@@ -614,8 +615,11 @@ class CoreWorker:
                         rconn = self.raylet_conn
                     else:
                         rconn = await self._get_owner_conn(raylet_address)
-                    await rconn.call("ReturnWorker", {
-                        "lease_id": lid, "worker_died": True})
+                    await rconn.call(
+                        "ReturnWorker",
+                        protocol.ReturnWorkerRequest(
+                            lease_id=lid,
+                            worker_died=True).to_header())
                 except (ConnectionError, RuntimeError):
                     pass
                 return
@@ -657,12 +661,13 @@ class CoreWorker:
         slots there. Ids we never saw (a chaos-dropped grant push) or
         already returned are confirmed released so the raylet's ledger
         reconciles."""
-        ids = set(header["lease_ids"])
+        req = protocol.RevokeLeaseCreditsRequest.from_header(header)
+        ids = set(req.lease_ids)
         try:
-            max_release = int(header.get("max_release", len(ids)))
+            max_release = int(req.get("max_release", len(ids)))
         except (TypeError, ValueError):
             max_release = len(ids)
-        aggressive = header.get("reason") == "memory_pressure"
+        aggressive = req.get("reason") == "memory_pressure"
         released: List[int] = []
         seen: set = set()
         # snapshot: the awaited conn.close below yields to the loop,
@@ -702,7 +707,8 @@ class CoreWorker:
                     len(released) < max_release:
                 released.append(lid)
         self.stats["lease_credits_revoked"] += len(released)
-        return {"released": released}
+        return protocol.RevokeLeaseCreditsReply(
+            released=released).to_header()
 
     async def _handle_worker_oom_killed(self, conn, header, bufs):
         """Raylet push: the node memory watchdog is killing a worker
@@ -1580,16 +1586,18 @@ class CoreWorker:
                     from ray_tpu._private import runtime_env as _re
                     try:
                         self.raylet_conn.push_nowait(
-                            "ReportLeaseDemand", {
-                                "sched_class": sc, "backlog": qlen,
-                                "resources": state.resources,
+                            "ReportLeaseDemand",
+                            protocol.ReportLeaseDemandRequest(
+                                sched_class=sc, backlog=qlen,
+                                resources=state.resources,
                                 # same env key the legacy summary
                                 # carries: a window (re)created from
                                 # this push must keep the warm-pool
                                 # runtime-env affinity
-                                "env_hash": _re.hash_runtime_env(
+                                env_hash=_re.hash_runtime_env(
                                     head.runtime_env),
-                                "retriable": head.max_retries != 0})
+                                retriable=head.max_retries != 0,
+                            ).to_header())
                     except ConnectionError:
                         pass  # raylet gone; lease path handles retries
             while True:
@@ -1729,8 +1737,10 @@ class CoreWorker:
                 conn = await self._get_owner_conn(raylet_address)
             bo = None
             while True:
-                reply, _ = await conn.call("RequestWorkerLease",
-                                           {"summary": summary})
+                reply, _ = await conn.call(
+                    "RequestWorkerLease",
+                    protocol.RequestWorkerLeaseRequest(
+                        summary=summary).to_header())
                 if not reply.get("retry_later"):
                     break
                 # Typed lease backpressure: the raylet is above its
@@ -1884,8 +1894,11 @@ class CoreWorker:
                 conn = self.raylet_conn
             else:
                 conn = await self._get_owner_conn(lw.raylet_address)
-            await conn.call("ReturnWorker", {
-                "lease_id": lw.lease_id, "worker_died": worker_died})
+            await conn.call(
+                "ReturnWorker",
+                protocol.ReturnWorkerRequest(
+                    lease_id=lw.lease_id,
+                    worker_died=worker_died).to_header())
         except ConnectionError:
             pass
         if not lw.conn.closed:
@@ -2634,9 +2647,11 @@ class CoreWorker:
         if not events and not dropped:
             return
         try:
-            await self._gcs_call("AddTaskEvents", {
-                "events": events, "dropped": dropped,
-                "job_id": self.job_id})
+            await self._gcs_call(
+                "AddTaskEvents",
+                protocol.AddTaskEventsRequest(
+                    events=events, dropped=dropped,
+                    job_id=self.job_id).to_header())
         except (ConnectionError, asyncio.TimeoutError):
             pass  # GCS restarting; bounded loss
 

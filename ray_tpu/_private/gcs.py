@@ -21,7 +21,7 @@ import logging
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from ray_tpu._private import faultpoints, rpc
+from ray_tpu._private import faultpoints, protocol, rpc
 from ray_tpu._private.config import RayTpuConfig
 from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID
 from ray_tpu._private.task_events import TaskEventTable
@@ -167,6 +167,11 @@ class NodeEntry:
         self.alive = True
         self.conn: Optional[rpc.Connection] = None
         self.stats: dict = {}  # last heartbeat-piggybacked node stats
+        # RegisterNode version handshake: what the node advertised and
+        # what both sides agreed to speak (rolling upgrades: min of the
+        # two; a pre-versioning raylet registers as version 1)
+        self.protocol_version = protocol.MIN_PROTOCOL_VERSION
+        self.negotiated_protocol_version = protocol.MIN_PROTOCOL_VERSION
 
 
 class ActorEntry:
@@ -423,6 +428,9 @@ class GcsServer:
                 "last_heartbeat_age_s":
                     round(time.time() - n.last_heartbeat, 3),
                 "stats": n.stats,
+                "protocol_version": n.protocol_version,
+                "negotiated_protocol_version":
+                    n.negotiated_protocol_version,
             } for n in self.nodes.values()])
         if route == "/api/actors":
             return dump([{
@@ -775,9 +783,23 @@ class GcsServer:
                 "resources": entry.resources_total}
 
     async def handle_register_node(self, conn, header, bufs):
-        entry = NodeEntry(header["node_id"], header["address"],
-                          header["resources"], header.get("node_name", ""),
-                          header.get("data_address", ""))
+        req = protocol.RegisterNodeRequest.from_header(header)
+        entry = NodeEntry(req.node_id, req.address,
+                          req.resources, req.get("node_name", ""),
+                          req.get("data_address", ""))
+        # Version handshake: the stub's compat default decodes a
+        # pre-versioning raylet as version 1; both sides speak the min.
+        # protocol_version records what the node ADVERTISED (a v3 node
+        # must be visible as v3 even while we clamp to v2), negotiated
+        # what the pair actually speaks — both in node info so a
+        # rolling upgrade is observable.
+        try:
+            entry.protocol_version = int(req.protocol_version)
+        except (TypeError, ValueError):
+            entry.protocol_version = protocol.MIN_PROTOCOL_VERSION
+        entry.negotiated_protocol_version = \
+            protocol.negotiate(entry.protocol_version)
+        conn.peer_protocol_version = entry.negotiated_protocol_version
         entry.conn = conn
         self.nodes[entry.node_id] = entry
         conn.tags["node_id"] = entry.node_id
@@ -798,21 +820,27 @@ class GcsServer:
 
             conn.on_disconnect.append(_on_drop)
         await self._publish("NODE", self._node_alive_msg(entry))
-        return {"ok": True, "num_nodes": len(self.nodes)}
+        return protocol.RegisterNodeReply(
+            ok=True, num_nodes=len(self.nodes),
+            protocol_version=protocol.PROTOCOL_VERSION,
+            negotiated_protocol_version=entry.negotiated_protocol_version,
+        ).to_header()
 
     async def handle_heartbeat(self, conn, header, bufs):
+        req = protocol.HeartbeatRequest.from_header(header)
         # Piggybacked task-lifecycle events ingest FIRST: the raylet
         # drained its buffer irreversibly before this call, so an
         # early ok=False return (unknown node after a GCS restart /
         # dead node forcing re-registration) must not silently discard
         # the batch — the table keys by task, not node, and "honest
         # truncation everywhere" is the series contract.
-        if header.get("task_events") or header.get("task_events_dropped"):
-            self.task_events.ingest(header.get("task_events") or (),
-                                    header.get("task_events_dropped", 0))
-        entry = self.nodes.get(header["node_id"])
+        if req.get("task_events") or req.get("task_events_dropped"):
+            self.task_events.ingest(req.get("task_events") or (),
+                                    req.get("task_events_dropped", 0))
+        entry = self.nodes.get(req.node_id)
         if entry is None:
-            return {"ok": False, "reason": "unknown node"}
+            return protocol.HeartbeatReply(
+                ok=False, reason="unknown node").to_header()
         if not entry.alive:
             # The node was declared dead (heartbeat partition) but its
             # raylet is clearly alive: force a re-registration instead
@@ -820,19 +848,20 @@ class GcsServer:
             # would otherwise keep it invisible to scheduling FOREVER
             # while the raylet believes everything is fine (chaos soak
             # finding: heartbeat_partition schedule).
-            return {"ok": False, "reason": "node marked dead"}
+            return protocol.HeartbeatReply(
+                ok=False, reason="node marked dead").to_header()
         entry.last_heartbeat = time.time()
-        if "resources_available" in header:
-            entry.resources_available = header["resources_available"]
-        if "stats" in header:
-            entry.stats = header["stats"]
+        if req.resources_available is not protocol.UNSET:
+            entry.resources_available = req.resources_available
+        if req.stats is not protocol.UNSET:
+            entry.stats = req.stats
         # Standalone raylet processes ship their metric registry here
         # (no CoreWorker reporter in-process; see metrics.core_reporter).
-        if header.get("metrics"):
+        if req.get("metrics"):
             self._metric_snapshots[
-                f"node-{header['node_id'].hex()[:12]}"] = (
-                time.time(), header["metrics"])
-        return {"ok": True}
+                f"node-{req.node_id.hex()[:12]}"] = (
+                time.time(), req.metrics)
+        return protocol.HeartbeatReply(ok=True).to_header()
 
     async def handle_report_resource_usage(self, conn, header, bufs):
         entry = self.nodes.get(header["node_id"])
@@ -849,6 +878,10 @@ class GcsServer:
             "node_name": n.node_name,
             "resources_total": n.resources_total,
             "resources_available": n.resources_available,
+            # the RegisterNode version handshake, observable per node
+            # (rolling-upgrade visibility)
+            "protocol_version": n.protocol_version,
+            "negotiated_protocol_version": n.negotiated_protocol_version,
         } for n in self.nodes.values()]}
 
     async def handle_get_cluster_resources(self, conn, header, bufs):
@@ -1418,10 +1451,11 @@ class GcsServer:
         """One reporter's batch of task-lifecycle transitions (workers
         and drivers flush on the metrics-report cadence; raylets ride
         the heartbeat instead — see handle_heartbeat)."""
-        self.task_events.ingest(header.get("events") or (),
-                                header.get("dropped", 0),
-                                header.get("job_id") or b"")
-        return {"ok": True}
+        req = protocol.AddTaskEventsRequest.from_header(header)
+        self.task_events.ingest(req.get("events") or (),
+                                req.get("dropped", 0),
+                                req.get("job_id") or b"")
+        return protocol.AddTaskEventsReply(ok=True).to_header()
 
     async def handle_get_task_events(self, conn, header, bufs):
         """Filterable task-table dump for ray_tpu.state.list_tasks() /

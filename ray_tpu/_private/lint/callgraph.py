@@ -21,7 +21,14 @@ exists when the AST proves it — dynamic dispatch stays out of scope):
     its ``FunctionInfo``, plus every client-side
     ``call/push/call_nowait/push_nowait/_gcs_call`` site with its
     header expression. rpc-contract checks name existence against it;
-    rpc-schema infers per-method header schemas from it.
+    rpc-schema infers per-method header schemas from it;
+  * **stub index** — every generated protocol stub class (a ClassDef
+    declaring ``_REQUIRED``/``_OPTIONAL`` string-set class attrs, the
+    shape ``lint/schemagen.py`` emits into ``_private/protocol.py``)
+    with its declared schema. rpc-schema resolves
+    ``X.from_header(header)`` / ``return X(...).to_header()`` through
+    it so a migrated handler keeps a CLOSED inferred schema, and
+    protocol-stub checks stub constructor kwargs against it.
 """
 
 from __future__ import annotations
@@ -91,6 +98,29 @@ class Registration:
 
 
 @dataclasses.dataclass
+class StubClassInfo:
+    """One generated protocol stub class (the ``schemagen.py`` shape:
+    ``_REQUIRED``/``_OPTIONAL`` frozenset-of-str class attrs plus
+    ``METHOD``/``KIND``/``_OPEN``/``_COMPAT_DEFAULTS``). The declared
+    sets ARE the wire schema — rpc-schema reads them instead of the
+    (absent) literal header accesses in a stub-migrated handler."""
+    name: str
+    path: str
+    lineno: int
+    method: str                           # METHOD attr; "" = base class
+    kind: str                             # "request" | "reply" | ""
+    required: frozenset
+    optional: frozenset
+    open: bool = False
+    compat_defaults: Dict[str, object] = dataclasses.field(
+        default_factory=dict)
+
+    @property
+    def known(self) -> frozenset:
+        return self.required | self.optional
+
+
+@dataclasses.dataclass
 class ClientCall:
     """One client-side RPC reference: conn.call("Method", header, ...)."""
     method: str
@@ -129,6 +159,10 @@ class Program:
         self.import_names: Dict[str, Dict[str, Tuple[str, str]]] = {}
         # class name -> has non-object bases (methods may be inherited)
         self.class_has_bases: Dict[str, bool] = {}
+        # stub class name -> StubClassInfo (None-valued when two
+        # same-named stub classes declare DIFFERENT schemas: ambiguity
+        # resolves to "not provable", like every other layer here)
+        self._stub_classes: Dict[str, Optional[StubClassInfo]] = {}
         self.rpc = RpcIndex()
 
     # -------------------------------------------------------------- lookup
@@ -162,6 +196,16 @@ class Program:
             return None
         return self._unique_basename_def(base, name)
 
+    def stub_class(self, name: str) -> Optional[StubClassInfo]:
+        """The unique stub class called ``name``, or None (unknown or
+        ambiguously multi-defined)."""
+        return self._stub_classes.get(name)
+
+    def stub_classes(self):
+        """Every unambiguous stub class, name-sorted."""
+        return [info for _, info in sorted(self._stub_classes.items())
+                if info is not None]
+
     def _unique_basename_def(self, mod_base: str,
                              name: str) -> Optional[FunctionInfo]:
         """The one module-level def of ``name`` across every file named
@@ -180,6 +224,64 @@ class Program:
 
 
 # ---------------------------------------------------------------- builders
+
+def _const_str_set(node: ast.AST) -> Optional[frozenset]:
+    """``frozenset({"a", ...})`` / ``{"a", ...}`` / ``frozenset()`` as a
+    frozenset of strings, or None when not statically that shape."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id in ("frozenset", "set"):
+        if not node.args and not node.keywords:
+            return frozenset()
+        if len(node.args) != 1 or node.keywords:
+            return None
+        node = node.args[0]
+    if not isinstance(node, (ast.Set, ast.List, ast.Tuple)):
+        return None
+    out = set()
+    for e in node.elts:
+        if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+            return None
+        out.add(e.value)
+    return frozenset(out)
+
+
+def _stub_class_of(node: ast.ClassDef, path: str) -> Optional[StubClassInfo]:
+    """Parse ``node`` as a generated protocol stub class, or None. The
+    qualifying shape is exactly what schemagen emits: ``_REQUIRED`` and
+    ``_OPTIONAL`` as constant string sets (everything else optional)."""
+    attrs: Dict[str, ast.AST] = {}
+    for st in node.body:
+        if isinstance(st, ast.Assign) and len(st.targets) == 1 and \
+                isinstance(st.targets[0], ast.Name):
+            attrs[st.targets[0].id] = st.value
+    if "_REQUIRED" not in attrs or "_OPTIONAL" not in attrs:
+        return None
+    required = _const_str_set(attrs["_REQUIRED"])
+    optional = _const_str_set(attrs["_OPTIONAL"])
+    if required is None or optional is None:
+        return None
+
+    def _const(name, default):
+        v = attrs.get(name)
+        if isinstance(v, ast.Constant):
+            return v.value
+        return default
+
+    compat: Dict[str, object] = {}
+    cd = attrs.get("_COMPAT_DEFAULTS")
+    if isinstance(cd, ast.Dict):
+        try:
+            compat = ast.literal_eval(cd)
+        except ValueError:
+            compat = {}
+    return StubClassInfo(
+        name=node.name, path=path, lineno=node.lineno,
+        method=str(_const("METHOD", "") or ""),
+        kind=str(_const("KIND", "") or ""),
+        required=required, optional=optional,
+        open=bool(_const("_OPEN", False)),
+        compat_defaults=compat)
+
 
 def _collect_symbols(program: Program, module: Module):
     path = module.path
@@ -207,6 +309,25 @@ def _collect_symbols(program: Program, module: Module):
             # makes "method not found" unprovable.
             program.class_has_bases[node.name] = \
                 program.class_has_bases.get(node.name, False) or has_bases
+            stub = _stub_class_of(node, path)
+            if stub is not None:
+                prior = program._stub_classes.get(node.name)
+                if node.name in program._stub_classes and (
+                        prior is None or
+                        (prior.required, prior.optional, prior.open,
+                         prior.method, prior.kind,
+                         prior.compat_defaults) !=
+                        (stub.required, stub.optional, stub.open,
+                         stub.method, stub.kind,
+                         stub.compat_defaults)):
+                    # two same-named stub classes with ANY schema
+                    # difference — compat defaults included, since
+                    # retiring an overlay changes only those — are not
+                    # provable; last-write-wins would make the golden
+                    # depend on scan order
+                    program._stub_classes[node.name] = None
+                else:
+                    program._stub_classes[node.name] = stub
         elif isinstance(node, ast.Import):
             for alias in node.names:
                 # `import a.b as c` binds c to module a.b; a bare

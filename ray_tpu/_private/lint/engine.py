@@ -372,6 +372,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "every registered method as JSON and exit "
                              "(the rpc-schema rule's view of the wire "
                              "contract)")
+    parser.add_argument("--drift-check", action="store_true",
+                        help="also run the schemagen drift gate "
+                             "(generated protocol.py + schema golden vs "
+                             "the current inference) on the SAME parsed "
+                             "program — the single-pass ci/lint.sh gate; "
+                             "drift fails the run like a violation")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -389,8 +395,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.dump_schemas:
         from ray_tpu._private.lint.callgraph import build_program
         from ray_tpu._private.lint.rules.rpc_schema import schemas_as_dict
+        # sort_keys so repeated runs (any hash seed) emit byte-identical
+        # output — the golden the schemagen drift gate diffs against is
+        # derived from this table
         print(json.dumps(schemas_as_dict(
-            build_program(load_modules(args.paths))), indent=2))
+            build_program(load_modules(args.paths))), indent=2,
+            sort_keys=True))
         return 0
 
     rule_names = [r.strip() for r in args.rules.split(",") if r.strip()] \
@@ -403,27 +413,42 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 2
     stale = find_stale_pragmas(modules, rule_names) \
         if args.stale_pragmas else []
+    drift: List[str] = []
+    if args.drift_check:
+        from ray_tpu._private.lint.schemagen import check_program
+        drift = check_program(program)
 
     if args.format == "json":
         from ray_tpu._private.lint.rules.rpc_schema import schemas_as_dict
+        from ray_tpu._private.lint.schemagen import PROTOCOL_VERSION
         print(json.dumps({
             "violations": [v.as_dict() for v in violations],
             "stale_pragmas": [v.as_dict() for v in stale],
             "files_scanned": len(modules),
             "rules": rule_names or sorted(all_rules()),
+            # The wire version the generated stubs speak (see
+            # _private/protocol.py + lint/schemagen.py).
+            "protocol_version": PROTOCOL_VERSION,
+            # Drift-gate findings (--drift-check): empty = handlers,
+            # protocol.py and the schema golden agree.
+            "schema_drift": drift,
             # Inferred wire schema per RPC method (ci/lint.sh artifact):
             # what each handler requires/accepts and what its replies
             # can carry — the protocol-debugging companion table.
             "rpc_schemas": schemas_as_dict(program),
-        }, indent=2))
+        }, indent=2, sort_keys=True))
     else:
         for v in violations:
             print(v.render())
         for v in stale:
             print(f"warning: {v.render()}")
+        for line in drift:
+            print(line, file=sys.stderr)
         status = "clean" if not violations else \
             f"{len(violations)} violation(s)"
         if stale:
             status += f", {len(stale)} stale pragma(s) [warn-only]"
+        if args.drift_check:
+            status += ", schema drift" if drift else ", schemas in sync"
         print(f"raylint: {len(modules)} file(s), {status}")
-    return 1 if violations else 0
+    return 1 if violations or drift else 0

@@ -5,6 +5,7 @@ from ray_tpu._private.lint.rules import (  # noqa: F401
     async_blocking,
     exception_hygiene,
     lock_discipline,
+    protocol_stub,
     rpc_contract,
     rpc_schema,
     shm_lifecycle,

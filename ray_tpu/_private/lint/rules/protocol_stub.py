@@ -1,0 +1,123 @@
+"""protocol-stub: generated methods must speak through their stubs.
+
+schemagen promotes the inferred RPC schemas to source of truth by
+emitting typed stubs into ``_private/protocol.py``. This rule is the
+migration ratchet and the stub-usage checker that keeps them honest:
+
+* a client call to a GENERATED method (one with a request stub) that
+  still passes a **literal header dict** is flagged — the stub exists
+  precisely so those dicts are deleted, and a literal dict silently
+  bypasses the constructor's required-field enforcement. Dynamic
+  headers (a forwarded variable, ``stub.to_header()``) pass.
+* a **stub constructor call** with keyword arguments is checked against
+  the class's declared schema: an unknown field (typo — the value would
+  be dropped on the floor at runtime by ``TypeError``, or worse survive
+  a ``**``-forwarding refactor) and a missing required field are both
+  reported at the call site, with did-you-mean hints. Positional
+  arguments are flagged too: generated ``__init__`` is keyword-only.
+
+The generated-method set is discovered from the scanned tree itself
+(classes with the schemagen stub shape — see callgraph.StubClassInfo),
+so fixture trees without stubs are naturally out of scope and the rule
+needs no knowledge of where protocol.py lives.
+"""
+
+from __future__ import annotations
+
+import ast
+import difflib
+from typing import Dict, Iterable, List
+
+from ray_tpu._private.lint.engine import (
+    Module, Rule, Violation, dotted_name, register,
+)
+from ray_tpu._private.lint.rules.rpc_schema import _literal_keys
+
+
+@register
+class ProtocolStubRule(Rule):
+    name = "protocol-stub"
+    description = ("calls to schemagen-generated methods must use the "
+                   "typed protocol stubs, and stub constructors must "
+                   "match the declared schema")
+
+    def __init__(self):
+        self._program = None
+        self._by_method: Dict[str, object] = {}
+        self._by_class: Dict[str, object] = {}
+
+    def setup(self, program) -> None:
+        self._program = program
+        for info in program.stub_classes():
+            if info.method and info.kind == "request":
+                self._by_method[info.method] = info
+            if info.method:
+                self._by_class[info.name] = info
+
+    def collect(self, module: Module) -> Iterable[Violation]:
+        if not self._by_class or module.tree is None:
+            return ()
+        out: List[Violation] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cls_name = dotted_name(node.func).rsplit(".", 1)[-1]
+            info = self._by_class.get(cls_name)
+            if info is None or module.path == info.path:
+                continue      # protocol.py itself never constructs stubs
+            out.extend(self._check_ctor(module, node, info))
+        return out
+
+    def _check_ctor(self, module: Module, node: ast.Call,
+                    info) -> Iterable[Violation]:
+        out: List[Violation] = []
+        if node.args:
+            out.append(Violation(
+                self.name, module.path, node.lineno, node.col_offset,
+                f"`{info.name}(...)` takes keyword-only field "
+                f"arguments — positional args raise TypeError at "
+                f"runtime"))
+        present = set()
+        has_spread = False
+        for kw in node.keywords:
+            if kw.arg is None:
+                has_spread = True          # **kwargs: fields unknowable
+                continue
+            present.add(kw.arg)
+            if kw.arg not in info.known:
+                hint = difflib.get_close_matches(kw.arg, info.known, n=1)
+                suggest = f' (did you mean "{hint[0]}"?)' if hint else ""
+                out.append(Violation(
+                    self.name, module.path, node.lineno, node.col_offset,
+                    f'`{info.name}(...)` sets unknown field '
+                    f'"{kw.arg}"{suggest} — the generated schema for '
+                    f'"{info.method}" does not declare it'))
+        missing = info.required - present
+        if missing and not has_spread and not node.args:
+            keys = ", ".join(f'"{k}"' for k in sorted(missing))
+            out.append(Violation(
+                self.name, module.path, node.lineno, node.col_offset,
+                f"`{info.name}(...)` omits required field(s) {keys} — "
+                f"encode is strict even for keys with decode-side "
+                f"compat defaults"))
+        return out
+
+    def finalize(self) -> Iterable[Violation]:
+        out: List[Violation] = []
+        if self._program is None or not self._by_method:
+            return out
+        for cc in self._program.rpc.client_calls:
+            info = self._by_method.get(cc.method)
+            if info is None or cc.header is None:
+                continue
+            if _literal_keys(cc.header) is None and \
+                    not isinstance(cc.header, ast.Dict):
+                continue                   # dynamic header: stub output
+            out.append(Violation(
+                self.name, cc.path, cc.lineno, cc.col,
+                f'`{cc.kind}("{cc.method}", {{...}})` passes a literal '
+                f"header dict to a generated method — construct "
+                f"protocol.{info.name}(...) and send .to_header() "
+                f"instead (stubs are the schema source of truth; see "
+                f"_private/protocol.py)"))
+        return out

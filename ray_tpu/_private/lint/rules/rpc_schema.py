@@ -80,11 +80,13 @@ _BRIDGES = {"_run", "run_until_complete", "wait_for"}
 
 class HandlerSchema:
     __slots__ = ("fi", "required", "optional", "open",
-                 "reply_keys", "reply_guaranteed", "reply_open")
+                 "reply_keys", "reply_guaranteed", "reply_open",
+                 "compat_defaults")
 
     def __init__(self, fi, required: Set[str], optional: Set[str],
                  open_: bool, reply_keys: Set[str],
-                 reply_guaranteed: Set[str], reply_open: bool):
+                 reply_guaranteed: Set[str], reply_open: bool,
+                 compat_defaults: Optional[dict] = None):
         self.fi = fi
         self.required = required
         self.optional = optional
@@ -92,6 +94,9 @@ class HandlerSchema:
         self.reply_keys = reply_keys
         self.reply_guaranteed = reply_guaranteed
         self.reply_open = reply_open
+        # required keys a generated stub decodes with a default when a
+        # pre-deprecation-window peer omits them (see schemagen.py)
+        self.compat_defaults = dict(compat_defaults or {})
 
     @property
     def known(self) -> Set[str]:
@@ -147,19 +152,45 @@ class MethodSchema:
         return any(h.reply_open for h in self.handlers) or \
             not self.handlers
 
+    @property
+    def compat_defaults(self) -> dict:
+        out: dict = {}
+        for h in self.handlers:
+            out.update(h.compat_defaults)
+        return out
+
     def where(self) -> str:
         return ", ".join(sorted(
             f"{h.fi.path}:{h.fi.node.lineno}" for h in self.handlers))
 
 
-def infer_handler_schema(fi) -> HandlerSchema:
+def _stub_of_call(program, call: ast.Call, attr: str):
+    """The StubClassInfo behind ``<Class>.<attr>(...)`` — e.g.
+    ``protocol.HeartbeatRequest.from_header(h)`` — or None."""
+    if program is None:
+        return None
+    dotted = dotted_name(call.func)
+    parts = dotted.split(".")
+    if len(parts) < 2 or parts[-1] != attr:
+        return None
+    return program.stub_class(parts[-2])
+
+
+def infer_handler_schema(fi, program=None) -> HandlerSchema:
     """Classify every use of the handler's header parameter."""
     pos = fi.positional_params()
     if len(pos) < 2:
-        return HandlerSchema(fi, set(), set(), True, *_infer_reply(fi))
+        return HandlerSchema(fi, set(), set(), True,
+                             *_infer_reply(fi, program))
     header_name = pos[1]
     required: Set[str] = set()
     optional: Set[str] = set()
+    # contributions read off generated stub classes the header is
+    # decoded through (X.from_header(header)): the stub's declared
+    # schema IS the handler's schema for those keys
+    stub_required: Set[str] = set()
+    stub_optional: Set[str] = set()
+    stub_compat: Dict[str, object] = {}
     open_ = False
     # First source line of each constant-key subscript, load vs store:
     # a write demotes a key to optional ONLY when it precedes every
@@ -222,6 +253,18 @@ def infer_handler_schema(fi) -> HandlerSchema:
             pass                             # rebinding (`header = ...`)
         elif isinstance(parent, ast.arguments):
             pass                             # the parameter itself
+        elif isinstance(parent, ast.Call) and parent.args and \
+                parent.args[0] is node and \
+                (stub := _stub_of_call(program, parent,
+                                       "from_header")) is not None:
+            # `X.from_header(header)`: the generated stub's declared
+            # schema speaks for the handler — a stub-migrated handler
+            # stays CLOSED instead of degrading to open on "escape".
+            stub_required |= stub.required
+            stub_optional |= stub.optional
+            stub_compat.update(stub.compat_defaults)
+            if stub.open:
+                open_ = True
         else:
             open_ = True                     # escaped: passed on, returned...
     required.update(sub_loads)
@@ -231,34 +274,262 @@ def infer_handler_schema(fi) -> HandlerSchema:
     # A guarded read (`if "k" in header: header["k"]`) is optional, not
     # required — the membership test wins.
     required -= optional
+    # Stub-declared keys merge LAST, and the stub's required set wins
+    # over a literal optional access of the same key: the generated
+    # class is the source of truth for the keys it declares.
+    required |= stub_required
+    optional = (optional | stub_optional) - required
     if not required and not optional and not open_:
         # Handler never touches its header: nothing to infer — treat as
         # open rather than flagging every caller's keys as unknown.
         open_ = True
-    reply_keys, reply_guaranteed, reply_open = _infer_reply(fi)
+    reply_keys, reply_guaranteed, reply_open = _infer_reply(fi, program)
     return HandlerSchema(fi, required, optional, open_,
-                         reply_keys, reply_guaranteed, reply_open)
+                         reply_keys, reply_guaranteed, reply_open,
+                         stub_compat)
 
 
-def _infer_reply(fi):
+class _DictBuild:
+    """One local name bound (exactly once) to a dict literal and grown
+    by constant-key subscript stores — ``reply = {}; reply["k"] = v;
+    return reply``. Tracks which keys EVERY return sees (the literal's
+    keys plus unconditional stores that precede the first return) vs
+    keys some path can add."""
+    __slots__ = ("binds", "keys", "guaranteed", "open",
+                 "first_return_line", "escaped")
+
+    def __init__(self):
+        self.binds = 0
+        self.keys: Set[str] = set()
+        self.guaranteed: Set[str] = set()
+        self.open = False
+        self.first_return_line = None
+        self.escaped = False
+
+
+def _return_value(node: ast.Return):
+    value = node.value
+    if isinstance(value, ast.Tuple) and len(value.elts) == 2:
+        value = value.elts[0]   # (reply_header, bufs)
+    return value
+
+
+def _incremental_dicts(fi) -> Dict[str, _DictBuild]:
+    """Names provably holding an incrementally-built reply dict (see
+    _DictBuild). Conservative: any rebinding, deletion, or use beyond
+    subscripts / ``.get`` / membership tests / the return itself drops
+    the name — the old behavior (reply OPEN) takes over."""
+    builds: Dict[str, _DictBuild] = {}
+
+    def visit(st, conditional):
+        if isinstance(st, ast.Assign) and len(st.targets) == 1:
+            t = st.targets[0]
+            if isinstance(t, ast.Name):
+                if isinstance(st.value, ast.Dict):
+                    rec = builds.setdefault(t.id, _DictBuild())
+                    rec.binds += 1
+                    for k in st.value.keys:
+                        if isinstance(k, ast.Constant) and \
+                                isinstance(k.value, str):
+                            rec.keys.add(k.value)
+                            rec.guaranteed.add(k.value)
+                        else:
+                            rec.open = True   # {**spread} / computed key
+                elif t.id in builds:
+                    builds[t.id].binds += 1   # rebound away: kill below
+            elif isinstance(t, ast.Subscript) and \
+                    isinstance(t.value, ast.Name) and \
+                    t.value.id in builds:
+                rec = builds[t.value.id]
+                sl = t.slice
+                if isinstance(sl, ast.Constant) and \
+                        isinstance(sl.value, str):
+                    rec.keys.add(sl.value)
+                    if not conditional and (
+                            rec.first_return_line is None or
+                            st.lineno < rec.first_return_line):
+                        rec.guaranteed.add(sl.value)
+                else:
+                    rec.open = True           # reply[var] = ...
+        elif isinstance(st, ast.Assign):
+            # multi-target (`reply = other = {}`) aliases the dict:
+            # every Name target counts as an un-provable binding
+            for t in st.targets:
+                if isinstance(t, ast.Name):
+                    builds.setdefault(t.id, _DictBuild()).binds += 2
+        elif isinstance(st, ast.AnnAssign) and \
+                isinstance(st.target, ast.Name) and \
+                st.target.id in builds:
+            builds[st.target.id].binds += 1   # annotated rebinding
+        elif isinstance(st, ast.Delete):
+            for t in st.targets:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id in builds:
+                    rec = builds[t.value.id]
+                    sl = t.slice
+                    if isinstance(sl, ast.Constant) and \
+                            isinstance(sl.value, str):
+                        # a (possibly conditional) delete: the key may
+                        # still appear on some path, but is no longer
+                        # guaranteed on every one
+                        rec.guaranteed.discard(sl.value)
+                    else:
+                        rec.guaranteed.clear()  # del reply[var]
+        elif isinstance(st, ast.Return):
+            value = _return_value(st)
+            if isinstance(value, ast.Name) and value.id in builds:
+                rec = builds[value.id]
+                if rec.first_return_line is None:
+                    rec.first_return_line = st.lineno
+
+    def walk(stmts, conditional):
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            visit(st, conditional)
+            always = isinstance(st, (ast.With, ast.AsyncWith))
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(st, attr, None)
+                if sub:
+                    walk(sub, conditional or not always)
+            for h in getattr(st, "handlers", None) or ():
+                walk(h.body, True)
+
+    walk(fi.node.body, False)
+    if not builds:
+        return builds
+    # Escape scan: a tracked name used anywhere beyond the benign set
+    # (subscript base, `.get`, membership test, the return) may leak
+    # the dict to code that mutates it — not provable, drop it.
+    parents: Dict[int, ast.AST] = {}
+    for node in body_nodes(fi.node):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    for node in body_nodes(fi.node):
+        if not (isinstance(node, ast.Name) and node.id in builds):
+            continue
+        rec = builds[node.id]
+        parent = parents.get(id(node))
+        if isinstance(parent, ast.Subscript) and parent.value is node:
+            continue
+        if isinstance(parent, ast.Return):
+            continue
+        if isinstance(parent, ast.Tuple) and \
+                isinstance(parents.get(id(parent)), ast.Return) and \
+                len(parent.elts) == 2 and parent.elts[0] is node:
+            continue                          # return reply, bufs
+        if isinstance(parent, ast.Attribute) and parent.value is node \
+                and parent.attr == "get":
+            continue
+        if isinstance(parent, ast.Compare) and node in parent.comparators \
+                and len(parent.ops) == 1 and \
+                isinstance(parent.ops[0], (ast.In, ast.NotIn)):
+            continue
+        if isinstance(parent, ast.Assign) and node in parent.targets:
+            # the bind itself; note `other[k] = reply` has the name as
+            # the VALUE, falls through, and correctly counts as an
+            # aliasing escape
+            continue
+        rec.escaped = True
+    # A nested def/lambda referencing the name can mutate the dict
+    # after every linear-order fact above was collected (body_nodes
+    # deliberately does not descend into them): that is an escape.
+    for node in body_nodes(fi.node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            for inner in ast.walk(node):
+                if isinstance(inner, ast.Name) and inner.id in builds:
+                    builds[inner.id].escaped = True
+    # Bound EXACTLY once means once across EVERY store of the name,
+    # not just dict-literal ones: `reply = cached(); if x: reply =
+    # {...}; return reply` must not pass off the literal branch alone.
+    store_counts: Dict[str, int] = {}
+    for node in body_nodes(fi.node):
+        if isinstance(node, ast.Name) and \
+                isinstance(node.ctx, (ast.Store, ast.Del)):
+            store_counts[node.id] = store_counts.get(node.id, 0) + 1
+    return {name: rec for name, rec in builds.items()
+            if rec.binds == 1 and store_counts.get(name, 0) == 1
+            and not rec.escaped}
+
+
+def _stub_ctor_binds(fi, program) -> Dict[str, object]:
+    """Names bound exactly once to a stub constructor — ``rep =
+    XReply(...); ...; return rep.to_header()``."""
+    if program is None:
+        return {}
+    binds: Dict[str, list] = {}
+    for node in body_nodes(fi.node):
+        if isinstance(node, ast.Name) and \
+                isinstance(node.ctx, (ast.Store, ast.Del)):
+            binds.setdefault(node.id, []).append(None)
+    out: Dict[str, object] = {}
+    for node in body_nodes(fi.node):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            continue
+        name = node.targets[0].id
+        if len(binds.get(name, ())) != 1:
+            continue
+        dotted = dotted_name(node.value.func)
+        stub = program.stub_class(dotted.rsplit(".", 1)[-1])
+        if stub is not None:
+            out[name] = stub
+    return out
+
+
+def _infer_reply(fi, program=None):
     """(keys, guaranteed, open) over the handler's own ``return``
-    statements. ``return {...}`` and ``return {...}, bufs`` literals
-    contribute keys; a bare/None return contributes none (guaranteed
-    drops to the empty set); anything else — a forwarded variable, a
-    sync fast-path handler's Future — marks the reply OPEN and callers'
-    reply-key reads are out of scope for this method."""
+    statements. Contributors, per return path:
+
+    * ``return {...}`` / ``return {...}, bufs`` literals;
+    * ``return X(...).to_header()`` (directly or through a name bound
+      once to the constructor) where X is a generated protocol stub:
+      the stub's required set is guaranteed, required+optional are the
+      producible keys;
+    * ``return reply`` where ``reply`` is a provably local
+      incrementally-built dict (``reply = {}; reply["k"] = v``);
+    * a bare/None return contributes none (guaranteed drops to the
+      empty set).
+
+    Anything else — a forwarded argument, a Future from a sync
+    fast-path handler — marks the reply OPEN and callers' reply-key
+    reads are out of scope for this method."""
     keys: Set[str] = set()
     guaranteed: Optional[Set[str]] = None
     open_ = False
+    inc = _incremental_dicts(fi)
+    ctor_binds = _stub_ctor_binds(fi, program)
     for node in body_nodes(fi.node):
         if not isinstance(node, ast.Return):
             continue
-        value = node.value
-        if isinstance(value, ast.Tuple) and len(value.elts) == 2:
-            value = value.elts[0]   # (reply_header, bufs)
+        value = _return_value(node)
         if value is None or (isinstance(value, ast.Constant) and
                              value.value is None):
             guaranteed = set()
+            continue
+        if isinstance(value, ast.Call) and \
+                isinstance(value.func, ast.Attribute) and \
+                value.func.attr == "to_header" and not value.args:
+            inner = value.func.value
+            stub = None
+            if isinstance(inner, ast.Call):
+                dotted = dotted_name(inner.func)
+                stub = program.stub_class(dotted.rsplit(".", 1)[-1]) \
+                    if program is not None else None
+            elif isinstance(inner, ast.Name):
+                stub = ctor_binds.get(inner.id)
+            if stub is not None:
+                keys |= stub.required | stub.optional
+                g = set(stub.required)
+                guaranteed = g if guaranteed is None else guaranteed & g
+                if stub.open:
+                    open_ = True
+                continue
+            open_ = True
             continue
         if isinstance(value, ast.Dict) and all(
                 isinstance(k, ast.Constant) and isinstance(k.value, str)
@@ -266,6 +537,13 @@ def _infer_reply(fi):
             ks = {k.value for k in value.keys}
             keys |= ks
             guaranteed = ks if guaranteed is None else guaranteed & ks
+        elif isinstance(value, ast.Name) and value.id in inc:
+            rec = inc[value.id]
+            keys |= rec.keys
+            guaranteed = set(rec.guaranteed) if guaranteed is None \
+                else guaranteed & rec.guaranteed
+            if rec.open:
+                open_ = True
         else:
             open_ = True
     return keys, guaranteed or set(), open_
@@ -291,7 +569,7 @@ def infer_schemas(program) -> Dict[str, MethodSchema]:
             if key in seen:
                 continue
             seen.add(key)
-            handlers.append(infer_handler_schema(fi))
+            handlers.append(infer_handler_schema(fi, program))
         if handlers:
             out[method] = MethodSchema(method, handlers)
     program._schema_cache = out
@@ -299,13 +577,19 @@ def infer_schemas(program) -> Dict[str, MethodSchema]:
 
 
 def schemas_as_dict(program) -> dict:
-    """JSON-friendly dump of the inferred contract."""
+    """JSON-friendly dump of the inferred contract. Every collection is
+    sorted and every value is plain JSON so two runs over the same tree
+    — whatever the hash seed or argument order — emit byte-identical
+    output; the schemagen drift gate diffs this table against its
+    checked-in golden."""
     out = {}
     for method, ms in sorted(infer_schemas(program).items()):
         out[method] = {
             "required": sorted(ms.required),
             "optional": sorted(ms.known - ms.required),
             "closed": ms.closed,
+            "compat_defaults": {k: ms.compat_defaults[k]
+                                for k in sorted(ms.compat_defaults)},
             "reply": sorted(ms.reply_keys),
             "reply_guaranteed": sorted(ms.reply_guaranteed),
             "reply_open": ms.reply_open,

@@ -1,0 +1,648 @@
+"""schemagen: generated typed RPC stubs + the schema drift gate.
+
+raylint's rpc-schema rule *infers* a wire schema for every RPC method
+(``--dump-schemas``). This module promotes that inference from lint
+artifact to source of truth:
+
+* ``python -m ray_tpu._private.lint.schemagen ray_tpu/`` runs the
+  inference over the tree, applies the ``OVERLAYS`` evolution table,
+  and (re)generates two checked-in artifacts:
+
+    - ``ray_tpu/_private/protocol.py`` — one slots-based typed
+      request/reply stub per method in ``GENERATE`` (near-zero-overhead
+      ``to_header``/``from_header``, required/optional/open-key
+      semantics, ``PROTOCOL_VERSION``, per-method compat rules);
+    - ``ray_tpu/_private/lint/rpc_schemas_golden.json`` — the full
+      normalized schema table for EVERY method (line numbers stripped,
+      everything sorted, byte-stable across runs).
+
+* ``--check`` (the ci/lint.sh drift gate) re-runs the inference and
+  fails with a diff when either artifact is stale: editing a handler's
+  schema without regenerating cannot land.
+
+The loop closes through the inference itself: a handler migrated to
+``X.from_header(header)`` / ``return XReply(...).to_header()`` is
+inferred FROM the stub's declared ``_REQUIRED``/``_OPTIONAL`` sets
+(callgraph.StubClassInfo), so regeneration over a fully-migrated tree
+is a fixed point. Schema evolution happens by editing a handler (a new
+literal key read unions into the stub's schema on regen) or by adding
+an ``OVERLAYS`` entry, then regenerating.
+
+Compat rules (enforced by the generated ``from_header``):
+
+* unknown keys are tolerated by default (dropped for closed schemas,
+  preserved in ``_extras`` for open ones) — old receivers survive new
+  senders;
+* a required-key ADDITION must ship with a deprecation-window default
+  in ``OVERLAYS`` (emitted as ``_COMPAT_DEFAULTS``): the decoder fills
+  the default when a pre-window peer omits the key — new receivers
+  survive old senders. After one release window the entry is retired
+  and the key becomes hard-required.
+
+``--from-snapshot`` builds the stub module from a saved golden instead
+of live inference — the bootstrap path, and how the two-version interop
+tests materialize an OLD protocol from a fixture snapshot.
+"""
+
+from __future__ import annotations
+
+import argparse
+import difflib
+import io
+import json
+import keyword
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Sequence
+
+PROTOCOL_VERSION = 2
+MIN_PROTOCOL_VERSION = 1
+
+# Methods that get generated stubs (the rest stay literal-dict and are
+# only drift-gated through the golden). Growing this tuple + regen +
+# migrating the call sites the protocol-stub rule then flags is the
+# whole mechanical migration recipe.
+GENERATE = (
+    "AddTaskEvents",
+    "GrantLeaseCredits",
+    "Heartbeat",
+    "RegisterNode",
+    "ReportLeaseDemand",
+    "RequestWorkerLease",
+    "ReturnWorker",
+    "RevokeLeaseCredits",
+)
+
+# Schema evolution overlays, applied on top of the inference. "require"
+# adds a key to the required set WITH a deprecation-window decode
+# default (the compat rule for required-key additions). Retire entries
+# after one release window to make the key hard-required.
+OVERLAYS: Dict[str, dict] = {
+    "RegisterNode": {
+        # v2: nodes advertise their protocol version at registration;
+        # a v1 raylet omits both sides and decodes as version 1.
+        "request": {"require": {"protocol_version": 1}},
+        "reply": {"require": {"protocol_version": 1,
+                              "negotiated_protocol_version": 1}},
+    },
+}
+
+_LINT_DIR = os.path.dirname(os.path.abspath(__file__))
+GOLDEN_PATH = os.path.join(_LINT_DIR, "rpc_schemas_golden.json")
+PROTOCOL_PATH = os.path.normpath(
+    os.path.join(_LINT_DIR, os.pardir, "protocol.py"))
+
+_IDENT_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+# Names the stub machinery owns; a wire key colliding with one cannot
+# become a slot.
+_RESERVED = {"METHOD", "KIND", "get", "to_header", "from_header",
+             "_REQUIRED", "_OPTIONAL", "_COMPAT_DEFAULTS", "_OPEN",
+             "_extras"}
+
+
+def _norm_path(path: str) -> str:
+    """Repo-stable handler path: strip any absolute prefix up to the
+    package root so goldens diff cleanly across checkouts. Greedy match
+    anchors on the LAST ``ray_tpu/`` component — a checkout under an
+    ancestor directory that happens to be named ray_tpu must not leak
+    into the golden."""
+    return re.sub(r"^.*(ray_tpu/)", r"\1", path.replace(os.sep, "/"))
+
+
+def _norm_handler(entry: str) -> str:
+    """``path:lineno:qualname`` -> ``path:qualname`` — line numbers
+    must never gate CI (editing unrelated code above a handler moves
+    them)."""
+    parts = entry.split(":")
+    if len(parts) >= 3 and parts[1].isdigit():
+        parts.pop(1)
+    return _norm_path(":".join(parts))
+
+
+def _side(required: Sequence[str], optional: Sequence[str], open_: bool,
+          compat: Optional[dict] = None) -> dict:
+    compat = compat or {}
+    return {
+        "required": sorted(required),
+        "optional": sorted(set(optional) - set(required)),
+        "open": bool(open_),
+        "compat_defaults": {k: compat[k] for k in sorted(compat)},
+    }
+
+
+def normalize_dump(dump: dict) -> dict:
+    """``schemas_as_dict`` output -> the normalized golden spec:
+    ``{method: {handlers, request: {...}, reply: {...}}}``.
+
+    Deliberately DROPS the dump's inference-side ``compat_defaults``
+    (which reflect the checked-in stubs' ``_COMPAT_DEFAULTS``): compat
+    defaults originate ONLY from ``OVERLAYS``, applied after this. If
+    the stubs fed their own compat back through the inference,
+    retiring an overlay entry would regenerate the identical stub and
+    a deprecation window could never actually close."""
+    spec = {}
+    for method, d in sorted(dump.items()):
+        spec[method] = {
+            "handlers": sorted(_norm_handler(h) for h in d["handlers"]),
+            "request": _side(d["required"], d["optional"],
+                             not d["closed"]),
+            "reply": _side(d["reply_guaranteed"],
+                           set(d["reply"]) - set(d["reply_guaranteed"]),
+                           d["reply_open"]),
+        }
+    return spec
+
+
+def apply_overlays(spec: dict,
+                   overlays: Optional[Dict[str, dict]] = None) -> dict:
+    overlays = OVERLAYS if overlays is None else overlays
+    for method, sides in overlays.items():
+        ms = spec.get(method)
+        if ms is None:
+            continue
+        for side_name, ops in sides.items():
+            side = ms[side_name]
+            for key, default in ops.get("require", {}).items():
+                if key not in side["required"]:
+                    side["required"] = sorted(side["required"] + [key])
+                side["optional"] = sorted(
+                    set(side["optional"]) - {key})
+                side["compat_defaults"][key] = default
+            side["compat_defaults"] = {
+                k: side["compat_defaults"][k]
+                for k in sorted(side["compat_defaults"])}
+    return spec
+
+
+def build_spec(program) -> dict:
+    """Inference -> normalized spec with overlays applied (the thing
+    the golden stores and the drift gate recomputes)."""
+    from ray_tpu._private.lint.rules.rpc_schema import schemas_as_dict
+    return apply_overlays(normalize_dump(schemas_as_dict(program)))
+
+
+def spec_from_paths(paths: Sequence[str]) -> dict:
+    from ray_tpu._private.lint.callgraph import build_program
+    from ray_tpu._private.lint.engine import load_modules
+    return build_spec(build_program(load_modules(paths)))
+
+
+def spec_from_snapshot(snapshot: dict) -> dict:
+    """A saved golden (``{"protocol_version", "methods"}``) or a raw
+    ``--dump-schemas`` table -> spec. No overlays: a snapshot is
+    already post-evolution for its version."""
+    methods = snapshot.get("methods", snapshot)
+    first = next(iter(methods.values()), None)
+    if first is not None and "request" not in first:
+        return normalize_dump(methods)
+    return {m: methods[m] for m in sorted(methods)}
+
+
+def emit_golden(spec: dict, version: int = PROTOCOL_VERSION) -> str:
+    return json.dumps({"protocol_version": version, "methods": spec},
+                      indent=2, sort_keys=True) + "\n"
+
+
+# ------------------------------------------------------------- emission
+
+def _check_keys(method: str, side: dict) -> Optional[str]:
+    for k in side["required"] + side["optional"]:
+        if not _IDENT_RE.match(k) or keyword.iskeyword(k) \
+                or k in _RESERVED:
+            return (f"{method}: wire key {k!r} cannot become a slot "
+                    f"(not an identifier, or reserved)")
+    return None
+
+
+def _wrap(prefix: str, items: Sequence[str], suffix: str) -> str:
+    """``prefix + ", ".join(items) + suffix`` wrapped at 79 cols with
+    continuation lines aligned under the opening paren."""
+    one = prefix + ", ".join(items) + suffix
+    if len(one) <= 79 or not items:
+        return one
+    pad = " " * len(prefix)
+    lines = [prefix + items[0]]
+    for item in items[1:]:
+        candidate = lines[-1] + ", " + item
+        if len(candidate) <= 77:
+            lines[-1] = candidate
+        else:
+            lines[-1] += ","
+            lines.append(pad + item)
+    lines[-1] += suffix
+    return "\n".join(lines)
+
+
+def _fmt_set(values: Sequence[str], indent: str) -> str:
+    if not values:
+        return "frozenset()"
+    inner = ", ".join(f'"{v}"' for v in sorted(values))
+    one = f"frozenset({{{inner}}})"
+    if len(one) + len(indent) <= 72:
+        return one
+    lines = ",\n".join(f'{indent}    "{v}"' for v in sorted(values))
+    return "frozenset({\n" + lines + f",\n{indent}}})"
+
+
+def _emit_class(out: io.StringIO, method: str, kind: str, side: dict,
+                handlers: Sequence[str]) -> str:
+    cls = method + ("Request" if kind == "request" else "Reply")
+    req = sorted(side["required"])
+    opt = sorted(side["optional"])
+    open_ = side["open"]
+    compat = side["compat_defaults"]
+    fields = req + opt
+    w = out.write
+    w(f"\n\nclass {cls}(_StubBase):\n")
+    w(f'    """{kind.capitalize()} stub for the ``{method}`` RPC.\n')
+    if handlers:
+        w("\n")
+        for h in handlers:
+            w(f"    Handler: ``{h}``.\n")
+    w('    """\n\n')
+    w(f'    METHOD = "{method}"\n')
+    w(f'    KIND = "{kind}"\n')
+    w(f"    _REQUIRED = {_fmt_set(req, '    ')}\n")
+    w(f"    _OPTIONAL = {_fmt_set(opt, '    ')}\n")
+    # repr, not json.dumps: a bool/None default must land as
+    # True/False/None in the generated source, never true/false/null
+    w("    _COMPAT_DEFAULTS = "
+      f"{repr({k: compat[k] for k in sorted(compat)})}\n")
+    w(f"    _OPEN = {open_}\n")
+    slots = list(fields) + (["_extras"] if open_ else [])
+    w(_wrap("    __slots__ = (", [f'"{s}"' for s in slots],
+            ",)" if len(slots) == 1 else ")") + "\n")
+    # __init__: required keys are strict on ENCODE even when a compat
+    # default exists — only the decoder tolerates their absence.
+    params = ["self"]
+    if fields or open_:
+        params.append("*")
+    params += req + [f"{k}=UNSET" for k in opt]
+    if open_:
+        params.append("extras=None")
+    w("\n" + _wrap("    def __init__(", params, "):") + "\n")
+    if not fields and not open_:
+        w("        pass\n")
+    for k in fields:
+        w(f"        self.{k} = {k}\n")
+    if open_:
+        w("        self._extras = dict(extras) if extras else {}\n")
+    # to_header
+    w("\n    def to_header(self):\n")
+    if open_:
+        w("        h = dict(self._extras)\n")
+        for k in req:
+            w(f'        h["{k}"] = self.{k}\n')
+    elif req:
+        w("        h = {\n")
+        for k in req:
+            w(f'            "{k}": self.{k},\n')
+        w("        }\n")
+    else:
+        w("        h = {}\n")
+    for k in opt:
+        w(f"        if self.{k} is not UNSET:\n")
+        w(f'            h["{k}"] = self.{k}\n')
+    w("        return h\n")
+    # from_header
+    w("\n    @classmethod\n")
+    w("    def from_header(cls, header):\n")
+    if open_:
+        w("        return _decode_slow(cls, header)\n")
+        return cls
+    w("        self = cls.__new__(cls)\n")
+    if req:
+        w("        try:\n")
+        for k in req:
+            w(f'            self.{k} = header["{k}"]\n')
+        w("        except (KeyError, TypeError):\n")
+        w("            return _decode_slow(cls, header)\n")
+    else:
+        w("        if not isinstance(header, dict):\n")
+        w("            return _decode_slow(cls, header)\n")
+    for k in opt:
+        w(f'        self.{k} = header.get("{k}", UNSET)\n')
+    w("        return self\n")
+    return cls
+
+
+_MODULE_HEAD = '''\
+"""Typed control-plane protocol stubs. GENERATED — DO NOT EDIT.
+
+Generated by ``ray_tpu/_private/lint/schemagen.py`` from the rpc-schema
+inference (see that module for the full wire/compat rules). To change a
+method's schema, edit its handler (or a schemagen OVERLAYS entry) and
+regenerate; ci/lint.sh fails on any drift between the handlers, this
+module, and the schema golden:
+
+    python -m ray_tpu._private.lint.schemagen ray_tpu/
+
+Semantics shared by every stub:
+
+* ``to_header()`` emits required fields always and optional fields only
+  when set; ``X.from_header(h).to_header() == h`` for any valid ``h``.
+* ``from_header()`` tolerates unknown keys (compat rule: old receivers
+  must survive new senders), fills ``_COMPAT_DEFAULTS`` for required
+  keys a pre-deprecation-window peer omits, and raises a typed
+  ``ProtocolError`` for anything else missing.
+* Absent optional fields read as the ``UNSET`` sentinel; ``stub.get(
+  "field", default)`` mirrors ``dict.get``.
+"""
+
+PROTOCOL_VERSION = {version}
+MIN_PROTOCOL_VERSION = {min_version}
+
+
+class _Unset:
+    """Singleton marking an optional field absent from the frame."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<UNSET>"
+
+    def __bool__(self):
+        return False
+
+
+UNSET = _Unset()
+
+
+class ProtocolError(TypeError):
+    """A frame violating a generated method schema (missing required
+    key with no compat default, or a non-dict header)."""
+
+    def __init__(self, method, kind, detail):
+        super().__init__(f"{{method}} {{kind}}: {{detail}}")
+        self.method = method
+        self.kind = kind
+        self.detail = detail
+
+
+def negotiate(peer_version):
+    """The protocol version two peers speak: min(ours, theirs), floored
+    at MIN_PROTOCOL_VERSION (an unparseable/absent advertisement reads
+    as the floor — the pre-versioning wire)."""
+    try:
+        pv = int(peer_version)
+    except (TypeError, ValueError):
+        pv = MIN_PROTOCOL_VERSION
+    return max(MIN_PROTOCOL_VERSION, min(PROTOCOL_VERSION, pv))
+
+
+def _decode_slow(cls, header):
+    """Shared miss-path decode: compat defaults, typed errors, open-
+    schema extras. The generated fast paths are plain subscripts and
+    only fall through here on a miss."""
+    if not isinstance(header, dict):
+        raise ProtocolError(
+            cls.METHOD, cls.KIND,
+            f"header is {{type(header).__name__}}, not a dict")
+    self = cls.__new__(cls)
+    missing = []
+    for k in sorted(cls._REQUIRED):
+        if k in header:
+            setattr(self, k, header[k])
+        elif k in cls._COMPAT_DEFAULTS:
+            # deprecation-window tolerance: a peer predating this
+            # required key decodes as the documented default
+            setattr(self, k, cls._COMPAT_DEFAULTS[k])
+        else:
+            missing.append(k)
+    if missing:
+        raise ProtocolError(cls.METHOD, cls.KIND,
+                            "missing required key(s) " + ", ".join(missing))
+    for k in sorted(cls._OPTIONAL):
+        setattr(self, k, header.get(k, UNSET))
+    if cls._OPEN:
+        known = cls._REQUIRED | cls._OPTIONAL
+        self._extras = {{k: v for k, v in header.items()
+                        if k not in known}}
+    return self
+
+
+class _StubBase:
+    """Base for the generated stubs (slots-only; near-zero overhead)."""
+
+    __slots__ = ()
+
+    METHOD = ""
+    KIND = ""
+    _REQUIRED = frozenset()
+    _OPTIONAL = frozenset()
+    _COMPAT_DEFAULTS = {{}}
+    _OPEN = False
+
+    def get(self, name, default=None):
+        """``dict.get`` for optional fields: default when UNSET."""
+        value = getattr(self, name, UNSET)
+        return default if value is UNSET else value
+
+    def to_header(self):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{{type(self).__name__}}({{self.to_header()!r}})"
+
+    def __eq__(self, other):
+        return type(other) is type(self) and \\
+            other.to_header() == self.to_header()
+
+    __hash__ = None
+'''
+
+
+def emit_protocol(spec: dict, version: int = PROTOCOL_VERSION,
+                  generate: Sequence[str] = GENERATE) -> str:
+    """The full generated module source for ``generate`` methods found
+    in ``spec`` (a missing method is an error: the handler family a
+    stub anchors must exist)."""
+    missing = [m for m in generate if m not in spec]
+    if missing:
+        raise ValueError(
+            f"cannot generate stubs for unregistered method(s): "
+            f"{', '.join(missing)} — no handler found by inference")
+    out = io.StringIO()
+    out.write(_MODULE_HEAD.format(version=version,
+                                  min_version=MIN_PROTOCOL_VERSION))
+    entries = []
+    for method in sorted(generate):
+        ms = spec[method]
+        err = _check_keys(method, ms["request"]) or \
+            (None if ms["reply"]["open"]
+             else _check_keys(method, ms["reply"]))
+        if err:
+            raise ValueError(err)
+        req_cls = _emit_class(out, method, "request", ms["request"],
+                              ms["handlers"])
+        reply = ms["reply"]
+        if not reply["open"] and (reply["required"] or reply["optional"]):
+            reply_cls = _emit_class(out, method, "reply", reply,
+                                    ms["handlers"])
+        else:
+            # open reply (e.g. future-based handlers) or a bare-{} ack:
+            # nothing to type on the reply side
+            reply_cls = "None"
+        entries.append((method, req_cls, reply_cls))
+    out.write("\n\n# method -> (request stub, reply stub or None)\n")
+    out.write("GENERATED_METHODS = {\n")
+    for method, req_cls, reply_cls in entries:
+        out.write(f'    "{method}": ({req_cls}, {reply_cls}),\n')
+    out.write("}\n")
+    return out.getvalue()
+
+
+def compile_protocol(source: str, name: str = "_ray_tpu_protocol_gen"):
+    """Exec a generated module source into a fresh module object — how
+    the interop tests materialize an OLD protocol from a snapshot."""
+    import types
+
+    mod = types.ModuleType(name)
+    exec(compile(source, f"<{name}>", "exec"), mod.__dict__)
+    return mod
+
+
+# ----------------------------------------------------------- drift gate
+
+def _diff(expected: str, actual: str, what: str) -> List[str]:
+    lines = list(difflib.unified_diff(
+        actual.splitlines(), expected.splitlines(),
+        fromfile=f"{what} (checked in)", tofile=f"{what} (regenerated)",
+        lineterm="", n=2))
+    return lines[:120]
+
+
+def check_program(program, golden_path: str = GOLDEN_PATH,
+                  protocol_path: str = PROTOCOL_PATH,
+                  generate: Optional[Sequence[str]] = None) -> List[str]:
+    """Drift findings for an already-built Program; [] = in sync."""
+    findings: List[str] = []
+    try:
+        spec = build_spec(program)
+    except ValueError as e:
+        return [f"schema inference failed: {e}"]
+    try:
+        with open(golden_path, "r", encoding="utf-8") as f:
+            golden_text = f.read()
+    except OSError:
+        golden_text = ""
+    # Emit at the CURRENT version: bumping PROTOCOL_VERSION without
+    # regenerating is itself drift (both artifacts stamp the version).
+    expected_golden = emit_golden(spec, PROTOCOL_VERSION)
+    if golden_text != expected_golden:
+        findings.append(
+            f"schema golden is stale: {golden_path} no longer matches "
+            f"the schemas inferred from the handlers")
+        findings.extend(_diff(expected_golden, golden_text,
+                              os.path.basename(golden_path)))
+    try:
+        expected_proto = emit_protocol(
+            spec, PROTOCOL_VERSION,
+            GENERATE if generate is None else generate)
+    except ValueError as e:
+        findings.append(f"stub generation failed: {e}")
+        return findings
+    try:
+        with open(protocol_path, "r", encoding="utf-8") as f:
+            proto_text = f.read()
+    except OSError:
+        proto_text = ""
+    if proto_text != expected_proto:
+        findings.append(
+            f"generated stubs are stale: {protocol_path} does not "
+            f"match what the current handler schemas generate")
+        findings.extend(_diff(expected_proto, proto_text,
+                              os.path.basename(protocol_path)))
+    if findings:
+        findings.append(
+            "regenerate with: python -m ray_tpu._private.lint.schemagen "
+            "ray_tpu/")
+    return findings
+
+
+def check_paths(paths: Sequence[str], golden_path: str = GOLDEN_PATH,
+                protocol_path: str = PROTOCOL_PATH) -> List[str]:
+    from ray_tpu._private.lint.callgraph import build_program
+    from ray_tpu._private.lint.engine import load_modules
+    return check_program(build_program(load_modules(paths)),
+                         golden_path, protocol_path)
+
+
+# ------------------------------------------------------------------ CLI
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu._private.lint.schemagen",
+        description="generate (or drift-check) the typed control-plane "
+                    "protocol stubs from the rpc-schema inference")
+    parser.add_argument("paths", nargs="*", default=[],
+                        help="files or directories to infer from "
+                             "(default: ray_tpu/)")
+    parser.add_argument("--check", action="store_true",
+                        help="verify protocol.py and the schema golden "
+                             "match the current inference; exit 1 on "
+                             "drift (the ci/lint.sh gate)")
+    parser.add_argument("--from-snapshot", metavar="FILE",
+                        help="generate from a saved schema snapshot "
+                             "instead of live inference")
+    parser.add_argument("--stdout", action="store_true",
+                        help="print the generated module instead of "
+                             "writing the checked-in files")
+    parser.add_argument("--version", type=int, default=PROTOCOL_VERSION,
+                        help="protocol version to stamp (snapshot "
+                             "builds; default: current)")
+    args = parser.parse_args(argv)
+
+    paths = args.paths or ["ray_tpu"]
+    if args.from_snapshot:
+        with open(args.from_snapshot, "r", encoding="utf-8") as f:
+            snap = json.load(f)
+        version = snap.get("protocol_version", args.version) \
+            if isinstance(snap, dict) else args.version
+        spec = spec_from_snapshot(snap)
+        source = emit_protocol(
+            spec, version, [m for m in GENERATE if m in spec])
+        if args.stdout:
+            sys.stdout.write(source)
+            return 0
+        print("error: --from-snapshot requires --stdout (snapshot "
+              "builds never overwrite the checked-in protocol)",
+              file=sys.stderr)
+        return 2
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"error: no such path: {p}", file=sys.stderr)
+            return 2
+
+    if args.check:
+        findings = check_paths(paths)
+        for line in findings:
+            print(line, file=sys.stderr)
+        if findings:
+            print("schemagen: DRIFT — handlers, protocol.py and the "
+                  "golden disagree (see diff above)", file=sys.stderr)
+            return 1
+        print("schemagen: protocol.py and schema golden in sync "
+              f"(protocol version {PROTOCOL_VERSION})")
+        return 0
+
+    spec = spec_from_paths(paths)
+    source = emit_protocol(spec)
+    golden = emit_golden(spec)
+    if args.stdout:
+        sys.stdout.write(source)
+        return 0
+    with open(PROTOCOL_PATH, "w", encoding="utf-8") as f:
+        f.write(source)
+    with open(GOLDEN_PATH, "w", encoding="utf-8") as f:
+        f.write(golden)
+    print(f"schemagen: wrote {PROTOCOL_PATH} "
+          f"({len([m for m in GENERATE if m in spec])} methods) and "
+          f"{GOLDEN_PATH} ({len(spec)} schemas, "
+          f"protocol version {PROTOCOL_VERSION})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,6 +46,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import backoff as backoff_mod
 from ray_tpu._private import faultpoints
+from ray_tpu._private import protocol
 from ray_tpu._private import rpc
 from ray_tpu._private import runtime_env as runtime_env_mod
 from ray_tpu._private.config import RayTpuConfig
@@ -209,6 +210,9 @@ class Raylet:
         self._pg_available: Dict[Tuple[bytes, int], Dict[str, float]] = {}
 
         self.gcs_conn: Optional[rpc.Connection] = None
+        # wire version agreed with the GCS at registration (RegisterNode
+        # handshake); MIN until the first register completes
+        self.negotiated_protocol_version = protocol.MIN_PROTOCOL_VERSION
         self._server = rpc.RpcServer(self._handlers(), name="raylet")
         self.address = ""
         self._peer_raylets: Dict[str, rpc.Connection] = {}
@@ -572,27 +576,27 @@ class Raylet:
                     if act == "drop":
                         await asyncio.sleep(period)
                         continue
-                hdr = {
-                    "node_id": self.node_id.binary(),
-                    "resources_available": self.resources_available,
-                    "stats": self._heartbeat_stats(),
-                }
+                beat = protocol.HeartbeatRequest(
+                    node_id=self.node_id.binary(),
+                    resources_available=self.resources_available,
+                    stats=self._heartbeat_stats())
                 # Task-lifecycle events piggyback on the heartbeat
                 # (never their own RPC); a beat lost to a restarting
                 # GCS is bounded event loss, by design.
                 events, dropped = self.task_events.drain_wire()
                 if events or dropped:
-                    hdr["task_events"] = events
-                    hdr["task_events_dropped"] = dropped
+                    beat.task_events = events
+                    beat.task_events_dropped = dropped
                 if not metrics_mod.core_reporter():
                     # standalone raylet process (worker node / headless
                     # head): no CoreWorker ships this process's metric
                     # registry, so the heartbeat carries it
                     snap = metrics_mod.global_registry().snapshot()
                     if snap:
-                        hdr["metrics"] = snap
-                reply, _ = await self.gcs_conn.call("Heartbeat", hdr)
-                if not reply.get("ok"):
+                        beat.metrics = snap
+                reply, _ = await self.gcs_conn.call(
+                    "Heartbeat", beat.to_header())
+                if not protocol.HeartbeatReply.from_header(reply).ok:
                     # A restarted GCS does not know this node: re-register
                     # over the live connection (reference: raylets
                     # re-register after GCS failover).
@@ -607,16 +611,26 @@ class Raylet:
             await asyncio.sleep(period)
 
     async def _register_with_gcs(self):
-        await self.gcs_conn.call("RegisterNode", {
-            "node_id": self.node_id.binary(),
-            "address": self.address,
-            # peers learn the bulk-transfer endpoint through the NODE
-            # channel; "" = data plane disabled (pulls from this node
-            # use the control-plane chunk path)
-            "data_address": self.data_address,
-            "resources": self.resources_total,
-            "node_name": self.node_name,
-        })
+        reply, _ = await self.gcs_conn.call(
+            "RegisterNode",
+            protocol.RegisterNodeRequest(
+                node_id=self.node_id.binary(),
+                address=self.address,
+                # peers learn the bulk-transfer endpoint through the
+                # NODE channel; "" = data plane disabled (pulls from
+                # this node use the control-plane chunk path)
+                data_address=self.data_address,
+                resources=self.resources_total,
+                node_name=self.node_name,
+                protocol_version=protocol.PROTOCOL_VERSION).to_header())
+        # Version handshake: a pre-versioning GCS's reply decodes as
+        # version 1 via the stub's compat defaults; everything this
+        # node sends afterwards must fit the NEGOTIATED version.
+        rep = protocol.RegisterNodeReply.from_header(reply)
+        self.negotiated_protocol_version = \
+            protocol.negotiate(rep.negotiated_protocol_version)
+        self.gcs_conn.peer_protocol_version = \
+            protocol.negotiate(rep.protocol_version)
         await self.gcs_conn.call("Subscribe", {"channel": "NODE"})
 
     async def _reconnect_gcs(self) -> bool:
@@ -1006,7 +1020,8 @@ class Raylet:
     # -------------------------------------------------------------- leases
 
     async def handle_request_worker_lease(self, conn, header, bufs):
-        summary = header["summary"]
+        summary = protocol.RequestWorkerLeaseRequest.from_header(
+            header).summary
         req = PendingRequest(
             req_id=next(self._req_counter),
             scheduling_class=summary["scheduling_class"],
@@ -1399,8 +1414,9 @@ class Raylet:
                          "node_id": self.node_id.binary()}, ()))
 
     async def handle_return_worker(self, conn, header, bufs):
-        lease = self.leases.get(header["lease_id"])
-        if lease is not None and not header.get("worker_died", False):
+        req = protocol.ReturnWorkerRequest.from_header(header)
+        lease = self.leases.get(req.lease_id)
+        if lease is not None and not req.get("worker_died", False):
             cw = getattr(lease, "credit_window", None)
             w = self._credit_windows.get(cw) if cw is not None else None
             if w is not None:
@@ -1412,9 +1428,9 @@ class Raylet:
                 # report went stale.
                 w.demand = 0
                 w.demand_ts = time.monotonic()
-        self._release_lease(header["lease_id"],
-                            worker_alive=not header.get("worker_died", False))
-        return {"ok": True}
+        self._release_lease(req.lease_id,
+                            worker_alive=not req.get("worker_died", False))
+        return protocol.ReturnWorkerReply(ok=True).to_header()
 
     async def handle_report_lease_demand(self, conn, header, bufs):
         """Owner -> raylet backlog refresh (one-way push, paced by the
@@ -1424,25 +1440,26 @@ class Raylet:
         if not self.config.lease_credits_enabled or \
                 self.memory_monitor.pressure:
             return {}
-        key = (id(conn), header["sched_class"])
+        req = protocol.ReportLeaseDemandRequest.from_header(header)
+        key = (id(conn), req.sched_class)
         w = self._credit_windows.get(key)
         if w is None:
-            w = CreditWindow(conn, header["sched_class"],
-                             dict(header.get("resources") or {}),
-                             header.get("env_hash", ""),
-                             bool(header.get("retriable", False)))
+            w = CreditWindow(conn, req.sched_class,
+                             dict(req.get("resources") or {}),
+                             req.get("env_hash", ""),
+                             bool(req.get("retriable", False)))
             self._credit_windows[key] = w
             conn.on_disconnect.append(
                 lambda c, k=key: self._credit_windows.pop(k, None))
-        w.demand = int(header.get("backlog", 0))
+        w.demand = int(req.get("backlog", 0))
         w.demand_ts = time.monotonic()
         # the refresh carries the CURRENT queue head's properties:
         # victim eligibility and env affinity must track the live
         # backlog, not whatever task bootstrapped the window
         # (scheduling classes key on (resources, fn_key) only —
         # max_retries and runtime_env vary within one class)
-        w.env_hash = header.get("env_hash", w.env_hash)
-        w.retriable = bool(header.get("retriable", w.retriable))
+        w.env_hash = req.get("env_hash", w.env_hash)
+        w.retriable = bool(req.get("retriable", w.retriable))
         self._schedule_credit_topup()
         return {}
 
@@ -1616,13 +1633,15 @@ class Raylet:
                 # owner replies "released" for ids it never received)
                 continue
             try:
-                w.conn.push_nowait("GrantLeaseCredits", {
-                    "sched_class": w.sched_class,
-                    "raylet_address": self.address,
-                    "window_target": target,
-                    "cluster_slots": cluster,
-                    "resources": w.resources,
-                    "credits": credits})
+                w.conn.push_nowait(
+                    "GrantLeaseCredits",
+                    protocol.GrantLeaseCreditsRequest(
+                        sched_class=w.sched_class,
+                        raylet_address=self.address,
+                        window_target=target,
+                        cluster_slots=cluster,
+                        resources=w.resources,
+                        credits=credits).to_header())
             except ConnectionError:
                 pass  # disconnect callbacks reclaim the booked leases
 
@@ -1689,13 +1708,15 @@ class Raylet:
                 # stream that will not flow
                 w.target = 0
                 try:
-                    w.conn.push_nowait("GrantLeaseCredits", {
-                        "sched_class": w.sched_class,
-                        "raylet_address": self.address,
-                        "window_target": 0,
-                        "cluster_slots": 0,
-                        "resources": w.resources,
-                        "credits": []})
+                    w.conn.push_nowait(
+                        "GrantLeaseCredits",
+                        protocol.GrantLeaseCreditsRequest(
+                            sched_class=w.sched_class,
+                            raylet_address=self.address,
+                            window_target=0,
+                            cluster_slots=0,
+                            resources=w.resources,
+                            credits=[]).to_header())
                 except ConnectionError:
                     continue
             excess = len(w.lease_ids) - target
@@ -1741,13 +1762,15 @@ class Raylet:
             try:
                 reply, _ = await w.conn.call(
                     "RevokeLeaseCredits",
-                    {"lease_ids": lease_ids,
-                     "max_release": max_release,
-                     "reason": reason},
+                    protocol.RevokeLeaseCreditsRequest(
+                        lease_ids=lease_ids,
+                        max_release=max_release,
+                        reason=reason).to_header(),
                     timeout=2.0)
             except (ConnectionError, asyncio.TimeoutError):
                 return
-            for lid in reply.get("released", ()):
+            rep = protocol.RevokeLeaseCreditsReply.from_header(reply)
+            for lid in rep.released:
                 if lid in w.lease_ids and lid in self.leases:
                     self.num_credit_revoked += 1
                     self._release_lease(lid)

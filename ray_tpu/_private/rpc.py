@@ -11,6 +11,13 @@ Wire format per message:
     [u32 body_len][msgpack body][u64 buf_len + raw bytes] * nbufs
     body = [kind, seq, method, header, nbufs]
 kinds: 0=request 1=reply 2=error 3=push (one-way).
+
+Headers for the high-traffic methods are typed through the generated
+stubs in ``_private/protocol.py`` (schema-checked at lint time by the
+rpc-schema/protocol-stub rules, drift-gated by lint/schemagen.py). The
+protocol version negotiated at registration lands on
+``Connection.peer_protocol_version``; the envelope itself never changes
+shape, so mixed-version peers always frame-interoperate.
 """
 
 from __future__ import annotations
@@ -177,6 +184,14 @@ class Connection:
         # only ONE drain waiter per transport (single _drain_waiter slot).
         self._drain_lock = asyncio.Lock()
         self.on_disconnect: List[Callable[["Connection"], None]] = []
+        # Wire-protocol version negotiated with this peer (see
+        # _private/protocol.py). Stamped by the registration handshakes
+        # (GCS RegisterNode sets it server-side, the raylet sets it on
+        # its gcs_conn from the reply); None = peer never advertised,
+        # treat as MIN_PROTOCOL_VERSION. The transport itself is
+        # deliberately version-blind — versioning rides header keys,
+        # never the envelope, so old and new framing interoperate.
+        self.peer_protocol_version: Optional[int] = None
         # Arbitrary per-connection state stamped by services (worker id etc).
         self.tags: Dict[str, Any] = {}
         self._recv_task: Optional[asyncio.Task] = None

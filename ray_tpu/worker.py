@@ -318,6 +318,9 @@ def nodes() -> List[dict]:
             "NodeID": n["node_id"].hex(), "Alive": n["alive"],
             "NodeName": n["node_name"], "Address": n["address"],
             "Resources": n["resources_total"],
+            # wire version agreed at RegisterNode (rolling-upgrade
+            # visibility; absent key = pre-versioning GCS)
+            "ProtocolVersion": n.get("negotiated_protocol_version", 1),
         })
     return out
 

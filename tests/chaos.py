@@ -76,7 +76,7 @@ CHAOS_CFG = {
 SCHEDULE_KINDS = (
     "stripe_sever", "corrupt_chunk", "short_read", "delay_storm",
     "raylet_kill", "heartbeat_partition", "gcs_restart", "mixed",
-    "worker_kill", "oom_storm", "credit_revoke",
+    "worker_kill", "oom_storm", "credit_revoke", "mixed_version",
 )
 
 # Event vocabulary for the data-plane harness. Each entry generates a
@@ -104,7 +104,8 @@ def make_schedule(kind: str, seed: int, rounds: int = 8,
     ``target`` indexes the raylet they hit (resolved to whatever is
     still alive at run time)."""
     if kind not in _KIND_OPS and kind not in (
-            "worker_kill", "oom_storm", "credit_revoke"):
+            "worker_kill", "oom_storm", "credit_revoke",
+            "mixed_version"):
         raise ValueError(f"unknown schedule kind {kind!r}")
     if kind == "worker_kill":
         # the worker-kill schedule is carried by the RAY_TPU_FAULTPOINTS
@@ -117,6 +118,10 @@ def make_schedule(kind: str, seed: int, rounds: int = 8,
     if kind == "credit_revoke":
         # the streaming-lease schedule is carried by the seeded
         # per-round disruption plan in run_credit_revoke_schedule
+        return []
+    if kind == "mixed_version":
+        # the rolling-upgrade soak draws its restart round and beat
+        # cadence inside MixedVersionHarness from the seed
         return []
     rng = random.Random(seed)
     events: List[dict] = []
@@ -965,4 +970,229 @@ def run_oom_storm_schedule(seed: int, rounds: int = 4,
     fd_after = _fd_count()
     assert fd_after <= fd_before + 8, \
         f"fd leak across the OOM storm: {fd_before} -> {fd_after}"
+    return summary
+
+
+# ---------------------------------------------------------------------------
+# mixed-version interop (old-schema raylet against the current GCS)
+# ---------------------------------------------------------------------------
+
+V1_SNAPSHOT_PATH = os.path.join(os.path.dirname(__file__), "fixtures",
+                                "rpc_schemas_v1.json")
+
+
+def load_protocol_snapshot(path: str = V1_SNAPSHOT_PATH):
+    """Compile the typed stubs an OLD node shipped with, straight from
+    a checked-in schema snapshot fixture (schemagen's --from-snapshot
+    path): the interop tests speak yesterday's wire format through
+    yesterday's actual generated code, not a hand-rolled imitation."""
+    from ray_tpu._private.lint import schemagen
+
+    with open(path, "r", encoding="utf-8") as f:
+        snap = json.load(f)
+    version = snap.get("protocol_version", 1)
+    spec = schemagen.spec_from_snapshot(snap)
+    src = schemagen.emit_protocol(
+        spec, version, [m for m in schemagen.GENERATE if m in spec])
+    return schemagen.compile_protocol(src, f"ray_tpu_protocol_v{version}")
+
+
+class OldSchemaRaylet:
+    """A wire-level 'raylet' speaking a PAST protocol version with the
+    real raylet's recovery semantics (redial a restarted GCS,
+    re-register when told it is unknown/dead). Its frames carry exactly
+    the v1 key set — no protocol_version — so the current GCS must
+    decode it through the deprecation-window compat defaults."""
+
+    def __init__(self, proto, gcs_address: str):
+        from ray_tpu._private.ids import NodeID
+
+        self.proto = proto
+        self.gcs_address = gcs_address
+        self.node_id = NodeID.from_random().binary()
+        self.conn: Optional[rpc.Connection] = None
+        self.reregisters = 0
+
+    async def connect_and_register(self):
+        self.conn = await rpc.connect(self.gcs_address, handlers={},
+                                      peer_name="old-raylet",
+                                      timeout=10.0)
+        return await self.register()
+
+    async def register(self):
+        reply, _ = await self.conn.call(
+            "RegisterNode",
+            self.proto.RegisterNodeRequest(
+                node_id=self.node_id,
+                address="tcp://127.0.0.1:9",   # never dialed
+                resources={"CPU": 1.0}).to_header())
+        # the v2 reply carries version keys this stub never heard of:
+        # unknown-key tolerance must decode it anyway
+        rep = self.proto.RegisterNodeReply.from_header(reply)
+        assert rep.ok, "old-schema registration rejected"
+        return rep
+
+    async def beat(self) -> bool:
+        try:
+            reply, _ = await self.conn.call(
+                "Heartbeat",
+                self.proto.HeartbeatRequest(
+                    node_id=self.node_id).to_header(),
+                timeout=5.0)
+        except (ConnectionError, asyncio.TimeoutError):
+            # restarted GCS: redial + re-register, like a real raylet
+            self.reregisters += 1
+            await self.connect_and_register()
+            return True
+        rep = self.proto.HeartbeatReply.from_header(reply)
+        if not rep.ok:
+            # unknown node / marked dead: the reply contract says
+            # re-register over the live connection
+            self.reregisters += 1
+            await self.register()
+        return rep.ok
+
+    async def add_task_events(self):
+        reply, _ = await self.conn.call(
+            "AddTaskEvents",
+            self.proto.AddTaskEventsRequest(
+                events=[], dropped=0).to_header())
+        assert self.proto.AddTaskEventsReply.from_header(reply).ok
+
+    async def probe_raylet(self, raylet_address: str):
+        """Lease-family frames against a CURRENT raylet: a v1
+        ReturnWorker for a lease it never granted (idempotent no-op)
+        and a v1 ReportLeaseDemand for an unsatisfiable shape (opens a
+        window without booking workers). Both must decode and answer."""
+        conn = await rpc.connect(raylet_address, handlers={},
+                                 peer_name="old-owner", timeout=10.0)
+        try:
+            reply, _ = await conn.call(
+                "ReturnWorker",
+                self.proto.ReturnWorkerRequest(
+                    lease_id=10 ** 9).to_header())
+            assert self.proto.ReturnWorkerReply.from_header(reply).ok
+            await conn.push(
+                "ReportLeaseDemand",
+                self.proto.ReportLeaseDemandRequest(
+                    sched_class=1, backlog=0,
+                    resources={"MIXED_VERSION_PROBE": 1.0}).to_header())
+        finally:
+            await conn.close()
+
+    async def close(self):
+        if self.conn is not None and not self.conn.closed:
+            await self.conn.close()
+
+
+class MixedVersionHarness:
+    """In-process GCS + one REAL (current-protocol) raylet + one
+    old-schema raylet, run through seeded heartbeat/task-event/lease
+    rounds with a GCS restart at a seed-drawn round. The rolling-
+    upgrade invariants: both nodes end ALIVE in the node table, the
+    version negotiation is recorded per node (1 for the old node,
+    PROTOCOL_VERSION for the new one), and the old node re-registered
+    through the restart."""
+
+    def __init__(self, seed: int, tmp, rounds: int = 5):
+        self.seed = seed
+        self.rounds = rounds
+        self.tmp = str(tmp)
+        self.cfg = RayTpuConfig.create({
+            **CHAOS_CFG,
+            "gcs_journal_path": os.path.join(
+                self.tmp, f"mixedver_{seed}.journal"),
+        })
+        self.gcs: Optional[GcsServer] = None
+        self.gcs_port = 0
+        self.gcs_address = ""
+        self.raylet: Optional[Raylet] = None
+        self.old: Optional[OldSchemaRaylet] = None
+        self.log: List[dict] = []
+
+    async def _boot(self):
+        self.gcs = GcsServer(self.cfg)
+        addr = await self.gcs.start("tcp://127.0.0.1:0")
+        self.gcs_port = int(addr.rsplit(":", 1)[1])
+        self.gcs_address = addr
+        self.raylet = Raylet(self.cfg, 1, session_dir=self.tmp,
+                             node_name="mixedver-new")
+        await self.raylet.start(addr)
+        self.old = OldSchemaRaylet(load_protocol_snapshot(), addr)
+        await self.old.connect_and_register()
+
+    async def _restart_gcs(self):
+        await self.gcs.stop()
+        self.gcs = GcsServer(self.cfg)
+        await self.gcs.start(f"tcp://127.0.0.1:{self.gcs_port}")
+
+    async def _await_alive(self, node_id: bytes, bound_s: float = 15.0):
+        deadline = asyncio.get_running_loop().time() + bound_s
+        while asyncio.get_running_loop().time() < deadline:
+            e = self.gcs.nodes.get(node_id)
+            if e is not None and e.alive:
+                return e
+            await asyncio.sleep(0.05)
+        raise AssertionError(
+            f"node {node_id.hex()[:8]} never (re)appeared alive "
+            f"(seed={self.seed})")
+
+    async def run(self) -> dict:
+        from ray_tpu._private import protocol as cur
+
+        rng = random.Random(self.seed ^ 0xA11CE)
+        restart_round = rng.randrange(1, self.rounds)
+        await self._boot()
+        try:
+            for rnd in range(self.rounds):
+                if rnd == restart_round:
+                    self.log.append({"round": rnd, "op": "gcs_restart"})
+                    await self._restart_gcs()
+                for _ in range(rng.randrange(2, 5)):
+                    await self.old.beat()
+                    await asyncio.sleep(0.02)
+                await self.old.add_task_events()
+                self.log.append({"round": rnd, "op": "beats"})
+                # the old node must be alive at VERSION 1 every round
+                e = await self._await_alive(self.old.node_id)
+                assert e.negotiated_protocol_version == 1, \
+                    f"old node negotiated {e.negotiated_protocol_version}"
+            # lease-family v1 frames against the live current raylet
+            await self.old.probe_raylet(self.raylet.address)
+            # the real raylet re-registered through the restart with
+            # the CURRENT version, visible in node info
+            e_new = await self._await_alive(self.raylet.node_id.binary())
+            assert e_new.negotiated_protocol_version == \
+                cur.PROTOCOL_VERSION
+            assert self.raylet.negotiated_protocol_version == \
+                cur.PROTOCOL_VERSION
+            assert self.old.reregisters >= 1, \
+                "the restart never forced the old node to re-register"
+            return {"seed": self.seed, "rounds": self.rounds,
+                    "restart_round": restart_round,
+                    "old_reregisters": self.old.reregisters}
+        finally:
+            await self._teardown()
+
+    async def _teardown(self):
+        if self.old is not None:
+            await self.old.close()
+        try:
+            if self.raylet is not None:
+                await self.raylet.stop()
+        except Exception:  # noqa: BLE001 — teardown after injected chaos
+            pass
+        if self.gcs is not None:
+            await self.gcs.stop()
+
+
+def run_mixed_version_schedule(seed: int, tmp, rounds: int = 5) -> dict:
+    """One mixed-version rolling-restart soak, fd-bracketed like every
+    other schedule."""
+    fd_before = _fd_count()
+    harness = MixedVersionHarness(seed, tmp, rounds=rounds)
+    summary = asyncio.run(harness.run())
+    fd_after = _fd_count()
+    assert fd_after <= fd_before + 8, \
+        f"fd leak across mixed-version soak: {fd_before} -> {fd_after}"
     return summary

@@ -17,7 +17,8 @@ import pytest
 from chaos import (
     make_schedule, run_credit_raylet_kill_schedule,
     run_credit_revoke_schedule, run_data_plane_schedule,
-    run_oom_storm_schedule, run_task_schedule, schedules_equal,
+    run_mixed_version_schedule, run_oom_storm_schedule,
+    run_task_schedule, schedules_equal,
 )
 
 # Pinned seeds: chosen once, frozen forever. Changing a seed is
@@ -34,6 +35,7 @@ SEEDS = {
     "worker_kill": 1909,
     "oom_storm": 2010,
     "credit_revoke": 2111,
+    "mixed_version": 2212,
 }
 
 
@@ -41,7 +43,8 @@ def test_schedule_generation_is_deterministic():
     """Same (kind, seed) -> byte-identical schedule; different seeds ->
     different schedules (the RNG actually reaches the events)."""
     for kind, seed in SEEDS.items():
-        if kind in ("worker_kill", "oom_storm", "credit_revoke"):
+        if kind in ("worker_kill", "oom_storm", "credit_revoke",
+                    "mixed_version"):
             continue
         a = make_schedule(kind, seed)
         b = make_schedule(kind, seed)
@@ -108,6 +111,20 @@ def test_chaos_soak_credit_revoke():
     summary = run_credit_revoke_schedule(SEEDS["credit_revoke"])
     assert summary["granted_total"] > 0
     assert summary["owner_kill"] == "reclaimed"
+
+
+@pytest.mark.slow
+def test_chaos_soak_mixed_version(tmp_path):
+    """Rolling-upgrade soak: an old-schema raylet (v1 stubs compiled
+    from the checked-in snapshot fixture) and a current raylet run
+    heartbeat/task-event/lease traffic against the current GCS through
+    a seeded gcs_restart. Both nodes end alive with their negotiated
+    protocol versions recorded in node info, and the restart provably
+    forced the old node through re-registration."""
+    summary = run_mixed_version_schedule(SEEDS["mixed_version"],
+                                         tmp_path)
+    assert summary["old_reregisters"] >= 1
+    assert summary["restart_round"] >= 1
 
 
 @pytest.mark.slow

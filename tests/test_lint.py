@@ -482,7 +482,8 @@ class TestCli:
         assert lint_main(["--list-rules"]) == 0
         out = capsys.readouterr().out
         for rule in ("async-blocking", "lock-discipline", "rpc-contract",
-                     "rpc-schema", "exception-hygiene", "shm-lifecycle"):
+                     "rpc-schema", "exception-hygiene", "shm-lifecycle",
+                     "protocol-stub"):
             assert rule in out
 
     def test_stale_pragmas_flag_is_warn_only(self, tmp_path, capsys):

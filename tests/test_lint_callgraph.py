@@ -632,3 +632,83 @@ class TestDumpSchemas:
         assert schemas["Seal"].closed
         # Ping never touches header -> open, nothing enforceable.
         assert not schemas["Ping"].closed
+
+
+# ------------------------------------------------------- stub-class substrate
+
+class TestStubClassIndex:
+    STUB = """
+        class FrobRequest:
+            METHOD = "Frob"
+            KIND = "request"
+            _REQUIRED = frozenset({"alpha", "beta"})
+            _OPTIONAL = frozenset({"gamma"})
+            _COMPAT_DEFAULTS = {"beta": 0}
+            _OPEN = False
+    """
+
+    def test_stub_class_parsed(self):
+        prog = program_of(self.STUB)
+        info = prog.stub_class("FrobRequest")
+        assert info is not None
+        assert info.method == "Frob" and info.kind == "request"
+        assert info.required == {"alpha", "beta"}
+        assert info.optional == {"gamma"}
+        assert info.compat_defaults == {"beta": 0}
+        assert not info.open
+        assert [i.name for i in prog.stub_classes()] == ["FrobRequest"]
+
+    def test_non_stub_classes_stay_out(self):
+        prog = program_of("""
+            class NotAStub:
+                METHOD = "X"
+            class Dynamic:
+                _REQUIRED = frozenset(compute())
+                _OPTIONAL = frozenset()
+        """)
+        assert prog.stub_class("NotAStub") is None
+        assert prog.stub_class("Dynamic") is None
+
+    def test_same_name_different_schema_is_ambiguous(self):
+        prog = program_of(self.STUB, extra={"other.py": """
+            class FrobRequest:
+                METHOD = "Frob"
+                KIND = "request"
+                _REQUIRED = frozenset({"different"})
+                _OPTIONAL = frozenset()
+        """})
+        assert prog.stub_class("FrobRequest") is None
+
+    def test_same_name_same_schema_resolves(self):
+        prog = program_of(self.STUB, extra={"copy.py": self.STUB})
+        assert prog.stub_class("FrobRequest") is not None
+
+    def test_from_header_through_unknown_class_stays_open(self):
+        # no stub class in the tree: the header escapes into a call,
+        # schema must degrade to open exactly as before
+        prog = program_of("""
+            class S:
+                def _handlers(self):
+                    return {"Frob": self.handle_frob}
+                async def handle_frob(self, conn, header, bufs):
+                    req = Mystery.from_header(header)
+                    return {}
+        """)
+        assert not infer_schemas(prog)["Frob"].closed
+
+    def test_from_header_merges_with_literal_reads(self):
+        # a half-migrated handler (stub decode + a stray literal read)
+        # unions both sources — that union is what the drift gate sees
+        prog = program_of("""
+            class S:
+                def _handlers(self):
+                    return {"Frob": self.handle_frob}
+                async def handle_frob(self, conn, header, bufs):
+                    req = FrobRequest.from_header(header)
+                    extra = header["delta"]
+                    return {}
+        """, extra={"proto.py": self.STUB})
+        ms = infer_schemas(prog)["Frob"]
+        assert ms.closed
+        assert ms.required == {"alpha", "beta", "delta"}
+        assert ms.known == {"alpha", "beta", "gamma", "delta"}

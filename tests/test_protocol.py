@@ -1,0 +1,677 @@
+"""Schema-checked control plane: generated stubs, the schemagen drift
+gate, the protocol-stub lint rule, and two-version interop.
+
+Coverage map:
+
+* round-trip contract for EVERY generated stub (``to_header`` ->
+  ``from_header`` identity, required-key enforcement raises typed
+  ``ProtocolError``, unknown keys tolerated, compat defaults filled);
+* the drift gate: a handler schema edit without regeneration fails
+  ``schemagen.check_program`` with a diff, and the REAL tree is in
+  sync (the ci/lint.sh gate, exercised in-process);
+* ``--dump-schemas`` determinism across hash seeds (the golden must
+  diff cleanly run-to-run);
+* protocol-stub rule: literal header dicts to generated methods and
+  malformed stub constructor calls are flagged;
+* stub-aware rpc-schema inference: a ``from_header``-migrated handler
+  keeps a CLOSED schema, stub returns type the reply, and the
+  incrementally-built-dict reply pattern no longer degrades to open;
+* rolling upgrade: an old-schema raylet (stubs compiled from the
+  checked-in v1 snapshot fixture) interoperates with the current GCS
+  and raylet through a GCS restart, with the version negotiation
+  recorded in node info (MixedVersionHarness).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from ray_tpu._private import protocol
+from ray_tpu._private.lint import schemagen
+from ray_tpu._private.lint.callgraph import build_program
+from ray_tpu._private.lint.engine import Module, lint_sources
+from ray_tpu._private.lint.rules.rpc_schema import infer_schemas
+
+from chaos import (
+    MixedVersionHarness, V1_SNAPSHOT_PATH, load_protocol_snapshot,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _stub_classes():
+    for method, pair in sorted(protocol.GENERATED_METHODS.items()):
+        for cls in pair:
+            if cls is not None:
+                yield method, cls
+
+
+def _full_header(cls):
+    h = {k: f"req-{k}" for k in sorted(cls._REQUIRED)}
+    h.update({k: f"opt-{k}" for k in sorted(cls._OPTIONAL)})
+    return h
+
+
+# ---------------------------------------------------------------------------
+# generated stub contract (every stub, driven off GENERATED_METHODS)
+# ---------------------------------------------------------------------------
+
+class TestStubRoundTrip:
+    def test_generated_methods_cover_the_lease_family(self):
+        methods = set(protocol.GENERATED_METHODS)
+        assert {"RegisterNode", "Heartbeat", "RequestWorkerLease",
+                "ReturnWorker", "ReportLeaseDemand", "GrantLeaseCredits",
+                "RevokeLeaseCredits", "AddTaskEvents"} <= methods
+
+    def test_to_from_header_identity_required_only(self):
+        for method, cls in _stub_classes():
+            h = {k: f"v-{k}" for k in sorted(cls._REQUIRED)}
+            assert cls.from_header(dict(h)).to_header() == h, method
+
+    def test_to_from_header_identity_all_fields(self):
+        for method, cls in _stub_classes():
+            h = _full_header(cls)
+            stub = cls.from_header(dict(h))
+            assert stub.to_header() == h, method
+            # and the constructor path agrees with the decode path
+            assert cls(**h) == stub, method
+
+    def test_missing_required_raises_typed(self):
+        for method, cls in _stub_classes():
+            hard = sorted(set(cls._REQUIRED) - set(cls._COMPAT_DEFAULTS))
+            for k in hard:
+                h = _full_header(cls)
+                del h[k]
+                with pytest.raises(protocol.ProtocolError) as ei:
+                    cls.from_header(h)
+                assert ei.value.method == method
+                assert k in str(ei.value)
+
+    def test_none_header_raises_typed_not_attribute_error(self):
+        for method, cls in _stub_classes():
+            if not cls._REQUIRED:
+                continue
+            with pytest.raises(protocol.ProtocolError):
+                cls.from_header(None)
+
+    def test_unknown_keys_tolerated(self):
+        # compat rule: an OLD receiver must survive a NEW sender's
+        # extra keys — decode succeeds, known fields intact
+        for method, cls in _stub_classes():
+            h = _full_header(cls)
+            stub = cls.from_header({**h, "__key_from_the_future__": 1})
+            for k in cls._REQUIRED:
+                assert getattr(stub, k) == h[k], method
+
+    def test_absent_optional_reads_as_unset_and_get_defaults(self):
+        for method, cls in _stub_classes():
+            if not cls._OPTIONAL:
+                continue
+            h = {k: f"v-{k}" for k in sorted(cls._REQUIRED)}
+            stub = cls.from_header(h)
+            k = sorted(cls._OPTIONAL)[0]
+            assert getattr(stub, k) is protocol.UNSET
+            assert stub.get(k) is None
+            assert stub.get(k, 41) == 41
+            assert not protocol.UNSET    # falsy sentinel
+
+    def test_compat_defaults_fill_for_old_peers(self):
+        # RegisterNode's protocol_version is required-with-compat:
+        # strict on encode, defaulted on decode (deprecation window)
+        req = protocol.RegisterNodeRequest.from_header({
+            "node_id": b"n", "address": "tcp://x", "resources": {}})
+        assert req.protocol_version == 1
+        with pytest.raises(TypeError):
+            # encode side stays strict: the kwarg is NOT defaulted
+            protocol.RegisterNodeRequest(
+                node_id=b"n", address="tcp://x", resources={})
+
+    def test_negotiate(self):
+        cur = protocol.PROTOCOL_VERSION
+        assert protocol.negotiate(1) == 1
+        assert protocol.negotiate(cur) == cur
+        assert protocol.negotiate(cur + 5) == cur       # newer peer
+        assert protocol.negotiate(None) == protocol.MIN_PROTOCOL_VERSION
+        assert protocol.negotiate("bogus") == \
+            protocol.MIN_PROTOCOL_VERSION
+        assert protocol.negotiate(-3) == protocol.MIN_PROTOCOL_VERSION
+
+
+# ---------------------------------------------------------------------------
+# drift gate
+# ---------------------------------------------------------------------------
+
+FIXTURE_SRC = """
+class S:
+    def _handlers(self):
+        return {"Frob": self.handle_frob}
+
+    async def handle_frob(self, conn, header, bufs):
+        x = header["alpha"]
+        y = header.get("beta")
+        return {"ok": True}
+"""
+
+
+def _fixture_program(src):
+    return build_program([Module("srv.py", textwrap.dedent(src))])
+
+
+class TestDriftGate:
+    def test_in_sync_fixture_tree_passes(self, tmp_path):
+        spec = schemagen.build_spec(_fixture_program(FIXTURE_SRC))
+        golden = tmp_path / "golden.json"
+        proto = tmp_path / "protocol.py"
+        golden.write_text(schemagen.emit_golden(spec))
+        proto.write_text(schemagen.emit_protocol(spec, generate=["Frob"]))
+        findings = schemagen.check_program(
+            _fixture_program(FIXTURE_SRC), str(golden), str(proto),
+            generate=["Frob"])
+        assert findings == []
+
+    def test_unregenerated_handler_edit_fails_with_diff(self, tmp_path):
+        spec = schemagen.build_spec(_fixture_program(FIXTURE_SRC))
+        golden = tmp_path / "golden.json"
+        proto = tmp_path / "protocol.py"
+        golden.write_text(schemagen.emit_golden(spec))
+        proto.write_text(schemagen.emit_protocol(spec, generate=["Frob"]))
+        edited = FIXTURE_SRC.replace('header["alpha"]',
+                                     'header["gamma"]')
+        findings = schemagen.check_program(
+            _fixture_program(edited), str(golden), str(proto),
+            generate=["Frob"])
+        text = "\n".join(findings)
+        assert "stale" in text
+        assert "gamma" in text          # the diff names the drifted key
+        assert "regenerate" in text
+
+    def test_real_tree_is_in_sync(self):
+        # the ci/lint.sh gate, in-process: handlers, protocol.py and
+        # the checked-in golden all agree on HEAD
+        findings = schemagen.check_paths(
+            [os.path.join(REPO_ROOT, "ray_tpu")])
+        assert findings == [], "\n".join(findings)
+
+    def test_protocol_module_states_it_is_generated(self):
+        src = open(os.path.join(
+            REPO_ROOT, "ray_tpu", "_private", "protocol.py")).read()
+        head = src.split('"""')[1]
+        assert "GENERATED" in head and "DO NOT EDIT" in head
+        assert "schemagen" in head
+
+
+class TestDumpDeterminism:
+    def test_dump_schemas_byte_identical_across_hash_seeds(self):
+        # sorted output is the contract the golden diff depends on:
+        # two runs under different hash seeds must emit identical bytes
+        paths = [os.path.join(REPO_ROOT, "ray_tpu", "_private", f)
+                 for f in ("gcs.py", "raylet.py", "core_worker.py",
+                           "protocol.py")]
+        outs = []
+        for seed in ("1", "2"):
+            env = {**os.environ, "PYTHONHASHSEED": seed}
+            outs.append(subprocess.run(
+                [sys.executable, "-m", "ray_tpu._private.lint",
+                 "--dump-schemas", *paths],
+                env=env, cwd=REPO_ROOT, capture_output=True,
+                check=True).stdout)
+        assert outs[0] == outs[1]
+        # and reversing the path order changes nothing either
+        rev = subprocess.run(
+            [sys.executable, "-m", "ray_tpu._private.lint",
+             "--dump-schemas", *reversed(paths)],
+            env={**os.environ, "PYTHONHASHSEED": "3"}, cwd=REPO_ROOT,
+            capture_output=True, check=True).stdout
+        assert rev == outs[0]
+
+
+# ---------------------------------------------------------------------------
+# protocol-stub rule + stub-aware inference (fixture trees)
+# ---------------------------------------------------------------------------
+
+STUB_MODULE = """
+class PingRequest:
+    METHOD = "Ping"
+    KIND = "request"
+    _REQUIRED = frozenset({"ping_id"})
+    _OPTIONAL = frozenset({"note"})
+    _COMPAT_DEFAULTS = {}
+    _OPEN = False
+
+class PingReply:
+    METHOD = "Ping"
+    KIND = "reply"
+    _REQUIRED = frozenset({"ok"})
+    _OPTIONAL = frozenset({"detail"})
+    _COMPAT_DEFAULTS = {}
+    _OPEN = False
+"""
+
+SERVER_MODULE = """
+from proto import PingRequest, PingReply
+
+class S:
+    def _handlers(self):
+        return {"Ping": self.handle_ping}
+
+    async def handle_ping(self, conn, header, bufs):
+        req = PingRequest.from_header(header)
+        return PingReply(ok=True).to_header()
+"""
+
+
+def _tree(client_src):
+    return {"proto.py": textwrap.dedent(STUB_MODULE),
+            "srv.py": textwrap.dedent(SERVER_MODULE),
+            "client.py": textwrap.dedent(client_src)}
+
+
+def _rule_hits(vs, rule):
+    return [v for v in vs if v.rule == rule]
+
+
+class TestProtocolStubRule:
+    def test_literal_dict_to_generated_method_flagged(self):
+        vs = lint_sources(_tree("""
+            async def go(conn):
+                await conn.call("Ping", {"ping_id": 1})
+        """), ["protocol-stub"])
+        hits = _rule_hits(vs, "protocol-stub")
+        assert len(hits) == 1
+        assert "PingRequest" in hits[0].message
+        assert hits[0].path == "client.py"
+
+    def test_stub_call_site_is_clean(self):
+        vs = lint_sources(_tree("""
+            from proto import PingRequest
+            async def go(conn):
+                await conn.call(
+                    "Ping", PingRequest(ping_id=1, note="x").to_header())
+        """), ["protocol-stub"])
+        assert _rule_hits(vs, "protocol-stub") == []
+
+    def test_unknown_ctor_field_flagged_with_hint(self):
+        vs = lint_sources(_tree("""
+            from proto import PingRequest
+            async def go(conn):
+                await conn.call(
+                    "Ping", PingRequest(ping_id=1, noet="x").to_header())
+        """), ["protocol-stub"])
+        hits = _rule_hits(vs, "protocol-stub")
+        assert len(hits) == 1
+        assert 'unknown field "noet"' in hits[0].message
+        assert 'did you mean "note"' in hits[0].message
+
+    def test_missing_required_ctor_field_flagged(self):
+        vs = lint_sources(_tree("""
+            from proto import PingRequest
+            async def go(conn):
+                await conn.call(
+                    "Ping", PingRequest(note="x").to_header())
+        """), ["protocol-stub"])
+        hits = _rule_hits(vs, "protocol-stub")
+        assert len(hits) == 1
+        assert 'required field(s) "ping_id"' in hits[0].message
+
+    def test_positional_ctor_args_flagged(self):
+        vs = lint_sources(_tree("""
+            from proto import PingRequest
+            async def go(conn):
+                await conn.call("Ping", PingRequest(1).to_header())
+        """), ["protocol-stub"])
+        hits = _rule_hits(vs, "protocol-stub")
+        assert any("keyword-only" in h.message for h in hits)
+
+    def test_spread_ctor_skips_missing_check(self):
+        vs = lint_sources(_tree("""
+            from proto import PingRequest
+            async def go(conn, kw):
+                await conn.call("Ping", PingRequest(**kw).to_header())
+        """), ["protocol-stub"])
+        assert _rule_hits(vs, "protocol-stub") == []
+
+    def test_methods_without_stubs_stay_out_of_scope(self):
+        vs = lint_sources({
+            "srv.py": textwrap.dedent("""
+                class S:
+                    def _handlers(self):
+                        return {"Other": self.handle_other}
+                    async def handle_other(self, conn, header, bufs):
+                        return {"ok": header["x"]}
+            """),
+            "client.py": textwrap.dedent("""
+                async def go(conn):
+                    await conn.call("Other", {"x": 1})
+            """)}, ["protocol-stub"])
+        assert _rule_hits(vs, "protocol-stub") == []
+
+    def test_real_package_is_fully_migrated(self):
+        # the migration ratchet holds on HEAD: no literal header dict
+        # reaches any generated method anywhere in the package
+        from ray_tpu._private.lint.engine import lint_paths
+        vs, _ = lint_paths([os.path.join(REPO_ROOT, "ray_tpu")],
+                           ["protocol-stub"])
+        assert vs == [], [v.render() for v in vs]
+
+
+class TestStubAwareInference:
+    def test_from_header_handler_stays_closed(self):
+        program = build_program([
+            Module("proto.py", textwrap.dedent(STUB_MODULE)),
+            Module("srv.py", textwrap.dedent(SERVER_MODULE))])
+        ms = infer_schemas(program)["Ping"]
+        assert ms.required == {"ping_id"}
+        assert ms.known == {"ping_id", "note"}
+        assert ms.closed
+        # reply typed through the stub return
+        assert ms.reply_guaranteed == {"ok"}
+        assert ms.reply_keys == {"ok", "detail"}
+        assert not ms.reply_open
+
+    def test_compat_defaults_surface_in_dump(self):
+        stub = STUB_MODULE.replace(
+            "    _COMPAT_DEFAULTS = {}\n    _OPEN = False\n\nclass PingReply",
+            '    _COMPAT_DEFAULTS = {"ping_id": 0}\n    _OPEN = False\n'
+            "\nclass PingReply")
+        program = build_program([
+            Module("proto.py", textwrap.dedent(stub)),
+            Module("srv.py", textwrap.dedent(SERVER_MODULE))])
+        from ray_tpu._private.lint.rules.rpc_schema import schemas_as_dict
+        d = schemas_as_dict(program)["Ping"]
+        assert d["compat_defaults"] == {"ping_id": 0}
+
+    def test_overlay_retirement_actually_retires(self):
+        # compat defaults originate ONLY from schemagen OVERLAYS:
+        # a stub's checked-in _COMPAT_DEFAULTS must NOT feed back
+        # through the inference into the regenerated spec, or deleting
+        # an overlay entry (the documented deprecation-window
+        # retirement) would regenerate the identical stub forever
+        stub = STUB_MODULE.replace(
+            "    _COMPAT_DEFAULTS = {}\n    _OPEN = False\n\nclass PingReply",
+            '    _COMPAT_DEFAULTS = {"ping_id": 0}\n    _OPEN = False\n'
+            "\nclass PingReply")
+        program = build_program([
+            Module("proto.py", textwrap.dedent(stub)),
+            Module("srv.py", textwrap.dedent(SERVER_MODULE))])
+        from ray_tpu._private.lint.rules.rpc_schema import \
+            schemas_as_dict
+        spec = schemagen.apply_overlays(
+            schemagen.normalize_dump(schemas_as_dict(program)), {})
+        # no overlay -> regenerated stub goes hard-required
+        assert spec["Ping"]["request"]["compat_defaults"] == {}
+        src = schemagen.emit_protocol(spec, generate=["Ping"])
+        mod = schemagen.compile_protocol(src, "proto_retired")
+        assert mod.PingRequest._COMPAT_DEFAULTS == {}
+        with pytest.raises(mod.ProtocolError):
+            mod.PingRequest.from_header({})
+
+    def test_closure_mutation_stays_open(self):
+        # a nested def referencing the dict can mutate it after the
+        # linear scan: not provable, stays open
+        program = build_program([Module("srv.py", textwrap.dedent("""
+            class S:
+                def _handlers(self):
+                    return {"Stats": self.handle_stats}
+
+                async def handle_stats(self, conn, header, bufs):
+                    reply = {"ok": True}
+                    def fill():
+                        reply["extra"] = 1
+                    self.defer(fill)
+                    return reply
+        """))])
+        assert infer_schemas(program)["Stats"].reply_open
+
+    def test_incremental_dict_reply_is_closed(self):
+        # satellite: `reply = {}; reply["k"] = v; return reply` must
+        # not degrade to an open reply and weaken the drift gate
+        program = build_program([Module("srv.py", textwrap.dedent("""
+            class S:
+                def _handlers(self):
+                    return {"Stats": self.handle_stats}
+
+                async def handle_stats(self, conn, header, bufs):
+                    reply = {"ok": True}
+                    reply["count"] = 3
+                    if header.get("verbose"):
+                        reply["detail"] = "much"
+                    return reply
+        """))])
+        ms = infer_schemas(program)["Stats"]
+        assert not ms.reply_open
+        assert ms.reply_keys == {"ok", "count", "detail"}
+        # conditional store is producible but not guaranteed
+        assert ms.reply_guaranteed == {"ok", "count"}
+
+    def test_incremental_dict_reply_read_violation(self):
+        vs = lint_sources({"srv.py": textwrap.dedent("""
+            class S:
+                def _handlers(self):
+                    return {"Stats": self.handle_stats}
+
+                async def handle_stats(self, conn, header, bufs):
+                    reply = {}
+                    reply["count"] = 3
+                    return reply
+
+                async def use(self, conn):
+                    reply, _ = await conn.call("Stats", {})
+                    return reply["cuont"]
+        """)}, ["rpc-schema"])
+        assert any("cuont" in v.message and "count" in v.message
+                   for v in vs)
+
+    def test_escaped_incremental_dict_stays_open(self):
+        # the dict leaks to a helper that may mutate it: not provable,
+        # keep the old open behavior
+        program = build_program([Module("srv.py", textwrap.dedent("""
+            def mutate(d):
+                d["injected"] = 1
+
+            class S:
+                def _handlers(self):
+                    return {"Stats": self.handle_stats}
+
+                async def handle_stats(self, conn, header, bufs):
+                    reply = {}
+                    reply["count"] = 3
+                    mutate(reply)
+                    return reply
+        """))])
+        assert infer_schemas(program)["Stats"].reply_open
+
+    def test_deleted_key_is_not_guaranteed(self):
+        # `del reply["k"]` must drop the key from the guaranteed set —
+        # a generated reply stub would otherwise declare it required
+        # and ProtocolError on every legitimate reply
+        program = build_program([Module("srv.py", textwrap.dedent("""
+            class S:
+                def _handlers(self):
+                    return {"Stats": self.handle_stats}
+
+                async def handle_stats(self, conn, header, bufs):
+                    reply = {"a": 1, "b": 2}
+                    del reply["b"]
+                    return reply
+        """))])
+        ms = infer_schemas(program)["Stats"]
+        assert not ms.reply_open
+        assert ms.reply_guaranteed == {"a"}
+
+    def test_aliased_incremental_dict_stays_open(self):
+        # `other[k] = reply` leaks the dict through an alias that may
+        # be mutated elsewhere — not provable, stays open
+        program = build_program([Module("srv.py", textwrap.dedent("""
+            class S:
+                def _handlers(self):
+                    return {"Stats": self.handle_stats}
+
+                async def handle_stats(self, conn, header, bufs):
+                    reply = {}
+                    reply["count"] = 3
+                    cache["x"] = reply
+                    return reply
+        """))])
+        assert infer_schemas(program)["Stats"].reply_open
+
+    def test_prior_non_dict_binding_stays_open(self):
+        # `reply = cached(); if x: reply = {"a": 1}; return reply` —
+        # the non-literal first binding means the literal branch alone
+        # proves nothing; a falsely-closed schema would land a wrong
+        # contract in the golden
+        program = build_program([Module("srv.py", textwrap.dedent("""
+            class S:
+                def _handlers(self):
+                    return {"Stats": self.handle_stats}
+
+                async def handle_stats(self, conn, header, bufs):
+                    reply = self.cached_reply()
+                    if header.get("fresh"):
+                        reply = {"a": 1}
+                    return reply
+        """))])
+        assert infer_schemas(program)["Stats"].reply_open
+
+    def test_norm_path_anchors_on_last_package_component(self):
+        # a checkout under an ancestor dir named ray_tpu must not leak
+        # its prefix into the golden's handler paths
+        norm = schemagen._norm_path
+        assert norm("/home/u/ray_tpu/repo/ray_tpu/_private/gcs.py") == \
+            "ray_tpu/_private/gcs.py"
+        assert norm("ray_tpu/_private/gcs.py") == "ray_tpu/_private/gcs.py"
+        assert norm("/tmp/other/srv.py") == "/tmp/other/srv.py"
+
+    def test_multi_target_rebinding_stays_open(self):
+        # `reply = other = {}` rebinds AND aliases in one statement —
+        # the bound-exactly-once guard must not be evaded
+        program = build_program([Module("srv.py", textwrap.dedent("""
+            class S:
+                def _handlers(self):
+                    return {"Stats": self.handle_stats}
+
+                async def handle_stats(self, conn, header, bufs):
+                    reply = {"a": 1}
+                    reply = other = {}
+                    reply["b"] = 2
+                    return reply
+        """))])
+        assert infer_schemas(program)["Stats"].reply_open
+
+    def test_rebound_incremental_dict_stays_open(self):
+        program = build_program([Module("srv.py", textwrap.dedent("""
+            class S:
+                def _handlers(self):
+                    return {"Stats": self.handle_stats}
+
+                async def handle_stats(self, conn, header, bufs):
+                    reply = {}
+                    reply["count"] = 3
+                    reply = compute()
+                    return reply
+        """))])
+        assert infer_schemas(program)["Stats"].reply_open
+
+
+# ---------------------------------------------------------------------------
+# snapshot -> old protocol module (the --from-snapshot path)
+# ---------------------------------------------------------------------------
+
+class TestSnapshotBuild:
+    def test_v1_fixture_compiles_without_version_keys(self):
+        old = load_protocol_snapshot()
+        assert old.PROTOCOL_VERSION == 1
+        assert "protocol_version" not in old.RegisterNodeRequest._REQUIRED
+        # v1 stub decodes a v2 reply: the version keys are unknown to
+        # it and must be tolerated
+        rep = old.RegisterNodeReply.from_header({
+            "ok": True, "num_nodes": 2,
+            "protocol_version": protocol.PROTOCOL_VERSION,
+            "negotiated_protocol_version": 1})
+        assert rep.ok and rep.num_nodes == 2
+
+    def test_current_stub_decodes_v1_frame_via_compat(self):
+        old = load_protocol_snapshot()
+        v1_frame = old.RegisterNodeRequest(
+            node_id=b"n", address="tcp://x", resources={}).to_header()
+        assert "protocol_version" not in v1_frame
+        req = protocol.RegisterNodeRequest.from_header(v1_frame)
+        assert req.protocol_version == 1
+
+    def test_bool_and_none_compat_defaults_emit_valid_python(self):
+        # json-style emission would write true/false/null into the
+        # generated source and break `import protocol` cluster-wide
+        spec = schemagen.build_spec(_fixture_program(FIXTURE_SRC))
+        spec = schemagen.apply_overlays(spec, {
+            "Frob": {"request": {"require": {
+                "retriable": False, "hint": None}}}})
+        src = schemagen.emit_protocol(spec, generate=["Frob"])
+        mod = schemagen.compile_protocol(src, "proto_booldefaults")
+        req = mod.FrobRequest.from_header({"alpha": 1})
+        assert req.retriable is False
+        assert req.hint is None
+
+    def test_fixture_snapshot_matches_golden_format(self):
+        snap = json.load(open(V1_SNAPSHOT_PATH))
+        assert snap["protocol_version"] == 1
+        for method, ms in snap["methods"].items():
+            assert set(ms) == {"handlers", "request", "reply"}, method
+
+
+# ---------------------------------------------------------------------------
+# two-version rolling-restart interop (acceptance)
+# ---------------------------------------------------------------------------
+
+def test_newer_peer_advertised_vs_negotiated(tmp_path):
+    """A node advertising a FUTURE version registers fine; node info
+    records what it advertised (v99 stays visible as 99) while the
+    negotiated version clamps to ours — the rolling-upgrade dashboard
+    must show both."""
+    import asyncio
+
+    from ray_tpu._private import rpc
+    from ray_tpu._private.config import RayTpuConfig
+    from ray_tpu._private.gcs import GcsServer
+
+    async def drive():
+        gcs = GcsServer(RayTpuConfig.create({}))
+        addr = await gcs.start("tcp://127.0.0.1:0")
+        try:
+            conn = await rpc.connect(addr, peer_name="future-raylet")
+            reply, _ = await conn.call("RegisterNode", {
+                "node_id": b"future-node-0000", "address": "tcp://x",
+                "resources": {}, "protocol_version": 99})
+            rep = protocol.RegisterNodeReply.from_header(reply)
+            assert rep.ok
+            assert rep.negotiated_protocol_version == \
+                protocol.PROTOCOL_VERSION
+            entry = gcs.nodes[b"future-node-0000"]
+            assert entry.protocol_version == 99          # advertised
+            assert entry.negotiated_protocol_version == \
+                protocol.PROTOCOL_VERSION                # spoken
+            info, _ = await conn.call("GetAllNodeInfo", {})
+            (node,) = info["nodes"]
+            assert node["protocol_version"] == 99
+            assert node["negotiated_protocol_version"] == \
+                protocol.PROTOCOL_VERSION
+            await conn.close()
+        finally:
+            await gcs.stop()
+
+    asyncio.run(drive())
+
+
+def test_rolling_restart_two_version_interop(tmp_path):
+    """Old-schema raylet + current raylet against the current GCS,
+    through a GCS restart: everyone re-registers, the negotiation is
+    visible per node (1 vs PROTOCOL_VERSION), and v1 lease/task-event
+    frames decode on the current handlers."""
+    import asyncio
+
+    harness = MixedVersionHarness(seed=3, tmp=tmp_path, rounds=3)
+    summary = asyncio.run(harness.run())
+    assert summary["old_reregisters"] >= 1
+    assert summary["restart_round"] >= 1
