@@ -226,6 +226,86 @@ def main():
             "ring_bounded": len(ring) == 1024 and ring.dropped == 3072,
         }
 
+    def bench_object_events_overhead():
+        """Object-lifecycle recording cost (ISSUE 13 acceptance): the
+        same put+get workload with every object-plane recorder this
+        process reaches (driver buffer + the in-process head raylet's
+        store buffer) on vs off, interleaved best-of like the task row
+        (this shared box drifts more between back-to-back blocks than
+        the recorder costs). Gate: <5% put/get overhead with recording
+        ON — the default. Plus the honest-cap proof: a buffer filled
+        past capacity stays bounded with an accurate drop counter, and
+        the GCS table's per-job FIFO stays capped with counted
+        eviction."""
+        import numpy as np
+
+        core = ray_tpu.worker.global_worker.core
+        recorders = [core.object_events]
+        node = ray_tpu.worker.global_worker.node
+        if node is not None and node.raylet is not None:
+            recorders.append(node.raylet.object_events)
+        orig = [b.enabled for b in recorders]
+        chunk = np.ones(256 * 1024 // 8)  # 256 KiB -> plasma path
+        n_put = 64
+
+        def put_get_block():
+            refs = [ray_tpu.put(chunk) for _ in range(n_put)]
+            for r in refs:
+                ray_tpu.get(r)
+            del refs
+            return n_put
+
+        def set_enabled(v):
+            for b in recorders:
+                b.enabled = v
+
+        on_rates, off_rates = [], []
+        try:
+            put_get_block()  # warm (recycle pool, map cache)
+            for _ in range(6):
+                set_enabled(True)
+                t0 = time.perf_counter()
+                k = put_get_block()
+                on_rates.append(k / (time.perf_counter() - t0))
+                set_enabled(False)
+                t0 = time.perf_counter()
+                k = put_get_block()
+                off_rates.append(k / (time.perf_counter() - t0))
+        finally:
+            for b, v in zip(recorders, orig):
+                b.enabled = v
+        on_rate, off_rate = max(on_rates), max(off_rates)
+        overhead_pct = max(0.0, off_rate / on_rate - 1.0) * 100
+        from ray_tpu._private.object_events import (
+            CREATED, ObjectEventBuffer, ObjectTable, SEALED,
+        )
+        ring = ObjectEventBuffer(capacity=1024, enabled=True)
+        oid = b"\x00" * 28
+        for _ in range(4096):
+            ring.record(oid, CREATED)
+        table = ObjectTable(max_objects_per_job=256)
+        for i in range(1024):
+            # constant 4-byte job prefix: all 1024 land in ONE job
+            table.ingest([{"object_id": b"jb00" + i.to_bytes(24, "little"),
+                           "state": SEALED, "ts": float(i)}])
+        ts = table.summary()
+        return {
+            "recording_on_putget_per_s": round(on_rate, 1),
+            "recording_off_putget_per_s": round(off_rate, 1),
+            "putget_overhead_pct": round(overhead_pct, 2),
+            "within_5pct": overhead_pct < 5.0,
+            "ring_capacity": 1024,
+            "ring_len_after_4096": len(ring),
+            "ring_dropped": ring.dropped,
+            "ring_bounded": len(ring) == 1024 and ring.dropped == 3072,
+            "table_cap": 256,
+            "table_objects_after_1024": ts["num_objects"],
+            "table_evictions_counted":
+                sum(ts["evicted_objects"].values()),
+            "table_bounded": ts["num_objects"] == 256 and
+                sum(ts["evicted_objects"].values()) == 768,
+        }
+
     def bench_faultpoints_overhead():
         """Disarmed fault-injection plane cost (ISSUE 8 acceptance):
         every wired site pays one ``if faultpoints.armed:`` module-
@@ -404,6 +484,11 @@ def main():
         task_events_row = bench_task_events_overhead()
     except Exception as e:  # noqa: BLE001 — secondary row
         task_events_row = {"error": str(e)}
+    _trace("object_events_overhead")
+    try:
+        object_events_row = bench_object_events_overhead()
+    except Exception as e:  # noqa: BLE001 — secondary row
+        object_events_row = {"error": str(e)}
     _trace("faultpoints_overhead")
     try:
         faultpoints_row = bench_faultpoints_overhead()
@@ -574,6 +659,7 @@ def main():
             "put_vs_memcpy_ceiling": round(put_gbps / mem_gbps, 4),
             "zero_copy_put": zero_copy_put,
             "task_events_overhead": task_events_row,
+            "object_events_overhead": object_events_row,
             "faultpoints_overhead": faultpoints_row,
             "memory_monitor_overhead": memory_monitor_row,
             "worker_spawn": worker_spawn_row,

@@ -11,8 +11,9 @@
 # seeded gcs_restart — version negotiation recorded in node info).
 # Runs the slow-marked schedules too (tier-1 carries only
 # the 2-schedule smoke); any invariant violation (pull hang, admission
-# budget leak, segment-lease leak, fd leak, unresurrected partitioned
-# node, dishonest task-event history) fails CI.
+# budget leak, segment-lease leak, a leak-detector-flagged object
+# [summary_objects()["leaked"] != 0], fd leak, unresurrected
+# partitioned node, dishonest task-event history) fails CI.
 #
 # Determinism contract: a schedule is fully determined by its (kind,
 # seed) pair — a failure here replays locally with exactly

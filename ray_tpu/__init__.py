@@ -30,6 +30,7 @@ from ray_tpu.worker import (  # noqa: F401
     init,
     is_initialized,
     kill,
+    memory_summary,
     nodes,
     put,
     shutdown,
@@ -69,6 +70,7 @@ from ray_tpu._private.task_executor import exit_actor  # noqa: E402,F401
 __all__ = [
     "ObjectRef", "available_resources", "cancel", "cluster_resources",
     "exceptions", "exit_actor", "get", "get_actor", "get_runtime_context",
-    "init", "is_initialized", "kill", "list_named_actors", "method", "nodes",
+    "init", "is_initialized", "kill", "list_named_actors",
+    "memory_summary", "method", "nodes",
     "put", "remote", "shutdown", "timeline", "wait",
 ]

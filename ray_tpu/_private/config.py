@@ -254,6 +254,37 @@ class RayTpuConfig:
     # and the eviction is COUNTED per job (GetTaskSummary
     # evicted_tasks), so a truncated view always reports as truncated.
     task_events_max_tasks_per_job: int = 8192
+    # Object-lifecycle event recording (object_events.py): the
+    # object-plane twin of task_events — every plasma/borrowed/
+    # contained object's lifecycle (CREATED -> SEALED/PINNED ->
+    # BORROWED/PULLED/locations -> OUT_OF_SCOPE/FREED, plus
+    # eviction/spill/restore and the leak-detector verdicts) recorded
+    # at the layer that owns each transition and surfaced by
+    # ray_tpu.state.list_objects() / summary_objects() /
+    # memory_summary() / timeline(). ON by default; bench.py's
+    # object_events_overhead row pins the put/get cost under 5%.
+    object_events_enabled: bool = True
+    # Per-process object-event buffer capacity (events, not bytes).
+    # Same honest-truncation contract as task_events_buffer_size: when
+    # full, NEW transitions are dropped and counted — memory stays
+    # flat, the put/free hot paths never block on observability.
+    object_events_buffer_size: int = 16384
+    # GCS object-table cap per job (the job is read off the object id
+    # prefix): oldest-seen objects are evicted first and the eviction
+    # is COUNTED per job (GetObjectSummary evicted_objects) — a
+    # truncated view always reports as truncated.
+    object_events_max_objects_per_job: int = 8192
+    # Leak-detector sweep cadence (seconds; 0 disables). Each sweep the
+    # raylet cross-checks store-held segments against live owner
+    # references (one batched ProbeObjectLiveness per owner): an object
+    # whose owner holds no reference — a dropped FreeObject, a
+    # SIGKILLed owner — is flagged LEAKED (objects_leaked gauge,
+    # leaked=True in list_objects()) on its second dead verdict and
+    # reclaimed (freed + LEAK_RECLAIMED, counter back to 0) one sweep
+    # later. Objects younger than one interval, and objects whose
+    # owner cannot be judged (probe unsupported / transient error),
+    # are never touched.
+    leak_sweep_interval_s: float = 5.0
     # Cluster-KV span cap for util/tracing.py exports: beyond this many
     # stored spans the GCS evicts the OLDEST whole trace (and counts
     # the drop in the __rtpu_trace_dropped__ KV key /

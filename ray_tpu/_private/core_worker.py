@@ -37,6 +37,9 @@ from ray_tpu._private.ids import (
     WorkerID, make_task_id_bytes, return_object_id_bytes,
 )
 from ray_tpu._private.memory_store import IN_PLASMA, MemoryStore
+from ray_tpu._private.object_events import (
+    LINEAGE_RELEASED, ObjectEventBuffer,
+)
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.reference_count import Reference, ReferenceCounter
 from ray_tpu._private.serialization import (
@@ -303,6 +306,14 @@ class CoreWorker:
         self.task_events = TaskEventBuffer(
             config.task_events_buffer_size,
             enabled=config.task_events_enabled)
+        # Object-lifecycle recorder (object_events.py): the reference
+        # counter stamps CREATED/BORROWED/CONTAINED/location/
+        # OUT_OF_SCOPE transitions into this buffer; flushed with the
+        # same metrics-report cadence (AddObjectEvents).
+        self.object_events = ObjectEventBuffer(
+            config.object_events_buffer_size,
+            enabled=config.object_events_enabled)
+        self.reference_counter.events = self.object_events
         self._task_events: List[dict] = []
         self._profile_flush_task = None
         self._metrics_report_task = None
@@ -391,10 +402,18 @@ class CoreWorker:
         if self.gcs_conn and not self.gcs_conn.closed:
             # last task-event flush: terminal transitions observed since
             # the previous periodic flush should outlive this process
+            # independent try blocks: a hung task-event flush must not
+            # also cost the object-event batch (and vice versa)
             try:
                 await asyncio.wait_for(self._flush_task_events(), timeout=2)
             except (asyncio.TimeoutError, ConnectionError):
                 pass
+            try:
+                await asyncio.wait_for(self._flush_object_events(),
+                                       timeout=2)
+            except Exception:  # noqa: BLE001 — shutdown must reach MarkJobFinished
+                logger.debug("object-event flush at shutdown failed",
+                             exc_info=True)
         if self.mode == "driver" and self.gcs_conn and not self.gcs_conn.closed:
             try:
                 await self.gcs_conn.call("MarkJobFinished",
@@ -548,6 +567,7 @@ class CoreWorker:
             "AddBorrower": self._handle_add_borrower,
             "RemoveBorrower": self._handle_remove_borrower,
             "WorkerOOMKilled": self._handle_worker_oom_killed,
+            "ProbeObjectLiveness": self._handle_probe_object_liveness,
             "GrantLeaseCredits": self._handle_grant_lease_credits,
             "RevokeLeaseCredits": self._handle_revoke_lease_credits,
             "Ping": self._handle_ping,
@@ -727,6 +747,17 @@ class CoreWorker:
     async def _handle_ping(self, conn, header, bufs):
         return {"ok": True, "mode": self.mode}
 
+    async def _handle_probe_object_liveness(self, conn, header, bufs):
+        """Raylet leak-detector probe: for each object id, does this
+        owner still hold ANY reference (local/submitted/borrowed)?
+        ``False`` means the owner released it — a store still holding
+        its segment missed the FreeObject and is leaking. One batched
+        call per (raylet, owner) per sweep; has_reference is a
+        GIL-atomic dict probe, so a large batch is cheap."""
+        has = self.reference_counter.has_reference
+        return {"live": [bool(has(ObjectID(b)))
+                         for b in header.get("object_ids", ())]}
+
     async def _handle_get_object(self, conn, header, bufs):
         oid = ObjectID(header["object_id"])
         timeout = header.get("timeout", 60.0)
@@ -783,6 +814,14 @@ class CoreWorker:
             att.close()
         if record.owned:
             self._release_lineage(oid)
+            if record.in_plasma and record.pinned_lineage and \
+                    self.object_events.enabled:
+                # lineage-pin transition, plasma returns only (a 1M
+                # drain of small returns must not flood the buffer):
+                # the creating task's lineage retention just ended
+                self.object_events.record(
+                    oid.binary(), LINEAGE_RELEASED,
+                    {"task": oid.binary()[:TASK_ID_SIZE].hex()})
         if record.owned and record.in_plasma:
             locations = sorted(record.locations or ())
             self._fire_and_forget(self._free_remote(oid, locations))
@@ -869,9 +908,12 @@ class CoreWorker:
             self.memory_store.put(oid, serialized)
             return
         segment, size = await self._write_segment_async(serialized)
+        # owner_address feeds the raylet's leak detector: the sweep
+        # probes this owner's live references against the stored
+        # segment (object_events.py).
         reply, _ = await self.raylet_conn.call("SealObject", {
             "object_id": oid.binary(), "segment": segment, "size": size,
-            "pin": pin})
+            "pin": pin, "owner_address": self.address})
         if not reply.get("ok"):
             raise exc.ObjectStoreFullError(
                 f"object {oid.hex()} ({size} bytes) does not fit in the store")
@@ -2637,6 +2679,28 @@ class CoreWorker:
                 except (ConnectionError, asyncio.TimeoutError):
                     pass  # GCS restarting; next period retries
             await self._flush_task_events()
+            await self._flush_object_events()
+
+    async def _flush_object_events(self):
+        """Drain the object-event buffer to the GCS object table (same
+        contract as _flush_task_events: bounded batch, a flush lost to
+        a restarting GCS is bounded loss by design)."""
+        events, dropped = self.object_events.drain_wire()
+        if not events and not dropped:
+            return
+        try:
+            await self._gcs_call(
+                "AddObjectEvents",
+                protocol.AddObjectEventsRequest(
+                    events=events, dropped=dropped).to_header())
+        except (ConnectionError, asyncio.TimeoutError):
+            pass  # GCS restarting; bounded loss
+        except Exception:  # noqa: BLE001
+            # e.g. a not-yet-upgraded GCS without the AddObjectEvents
+            # handler (rolling upgrade): the error re-raised off the
+            # wire must not escape the metrics-report loop and kill
+            # metrics + task-event shipping for the worker's lifetime
+            logger.debug("AddObjectEvents flush failed", exc_info=True)
 
     async def _flush_task_events(self):
         """Drain the task-event buffer to the GCS task table (the

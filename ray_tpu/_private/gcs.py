@@ -24,6 +24,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 from ray_tpu._private import faultpoints, protocol, rpc
 from ray_tpu._private.config import RayTpuConfig
 from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID
+from ray_tpu._private.object_events import ObjectTable
 from ray_tpu._private.task_events import TaskEventTable
 
 # Exported tracing spans live under this KV prefix (util/tracing.py);
@@ -65,6 +66,7 @@ _STATUS_PAGE = b"""<!doctype html>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Object stores / hosts</h2><table id="stores"></table>
 <h2>Actors</h2><table id="actors"></table>
+<h2>Objects</h2><table id="objects"></table>
 <h2>Tasks</h2><table id="tasks"></table>
 <h2>Jobs</h2><table id="jobs"></table>
 <h2>Placement groups</h2><table id="pgs"></table>
@@ -112,11 +114,18 @@ async function tick() {
            text: 'stacks'}]}]; }));
     var mb = function(b){ return b==null ? '' : (b/1048576).toFixed(1); };
     fill('stores', ['node_id','workers','pending','store_mb','objects',
+                    'pinned','recycle_mb','lent','pull_mb','leaked',
                     'spills','evictions','host_cpu%','host_mem_mb'],
       nodes.map(function(n){ var s = n.stats || {};
         return [n.node_id.slice(0,12), s.num_workers,
-          s.num_pending_leases, mb(s.store_used_bytes),
-          s.store_num_objects, s.store_num_spills,
+          s.num_pending_leases,
+          mb(s.store_used_bytes) + '/' + mb(s.store_capacity_bytes),
+          s.store_num_objects, s.store_num_pinned,
+          mb(s.store_recycle_bytes), s.store_lent_segments,
+          mb(s.data_plane_inflight_bytes),
+          {v: s.objects_leaked || 0,
+           cls: s.objects_leaked ? 'dead' : ''},
+          s.store_num_spills,
           s.store_num_evictions, s.host_cpu_percent,
           mb(s.host_mem_used_bytes) + '/' +
           mb(s.host_mem_total_bytes)]; }));
@@ -125,6 +134,13 @@ async function tick() {
       actors.map(function(a){ return [a.actor_id.slice(0,12), a.name,
         a.class_name, a.state, a.num_restarts+'/'+a.max_restarts,
         a.node_id.slice(0,12)]; }));
+    var ob = await j('/api/objects');
+    fill('objects', ['object_id','owner','size_mb','state','leaked',
+                     'transitions'],
+      ob.objects.slice(-25).reverse().map(function(o){ return [
+        o.object_id.slice(0,12), o.owner, mb(o.size), o.state,
+        {v: o.leaked ? 'LEAKED' : '', cls: o.leaked ? 'dead' : ''},
+        o.events.length]; }));
     var tk = await j('/api/tasks');
     fill('tasks', ['task_id','name','state','attempt','transitions'],
       tk.tasks.slice(-25).reverse().map(function(t){ return [
@@ -230,6 +246,12 @@ class GcsServer:
         # timeline export and the /api/tasks dashboard route.
         self.task_events = TaskEventTable(
             config.task_events_max_tasks_per_job)
+        # Object-lifecycle table (object_events.py): the object-plane
+        # twin — fed by AddObjectEvents batches and heartbeat
+        # piggybacks, read by state.list_objects()/summary_objects()/
+        # memory_summary(), timeline() and /api/objects.
+        self.object_events = ObjectTable(
+            config.object_events_max_objects_per_job)
         # Tracing-span KV cap bookkeeping: trace_id -> {key: True}
         # (insertion-ordered = first-span-seen order, the eviction
         # order), plus honest drop accounting.
@@ -273,6 +295,9 @@ class GcsServer:
             "AddTaskEvents": self.handle_add_task_events,
             "GetTaskEvents": self.handle_get_task_events,
             "GetTaskSummary": self.handle_get_task_summary,
+            "AddObjectEvents": self.handle_add_object_events,
+            "GetObjectEvents": self.handle_get_object_events,
+            "GetObjectSummary": self.handle_get_object_summary,
             "AddClusterEvent": self.handle_add_cluster_event,
             "GetClusterEvents": self.handle_get_cluster_events,
             "ReportMetrics": self.handle_report_metrics,
@@ -490,6 +515,23 @@ class GcsServer:
                     limit=limit),
                 "summary": self.task_events.summary(),
             })
+        if route == "/api/objects":
+            try:
+                limit = int(params.get("limit", "200"))
+            except ValueError:
+                limit = 200
+            leaked = params.get("leaked")
+            return dump({
+                "objects": self.object_events.list(
+                    state=params.get("state"),
+                    owner=params.get("owner"),
+                    node=params.get("node"),
+                    leaked={"1": True, "true": True, "0": False,
+                            "false": False}.get(str(leaked).lower())
+                    if leaked is not None else None,
+                    limit=limit),
+                "summary": self.object_events.summary(),
+            })
         if route == "/api/metrics":
             return dump(self._merged_metrics())
         if route == "/api/events":
@@ -544,6 +586,24 @@ class GcsServer:
              "Objects spilled to external storage"),
             ("store_num_evictions", "ray_tpu_object_store_evictions_total",
              "Objects evicted from the store"),
+            # object-plane occupancy truth (ISSUE 13): recycle pool,
+            # lent (AllocSegment) leases, pinned primaries, data-plane
+            # admission in flight, and the leak-detector verdicts
+            ("store_recycle_bytes", "ray_tpu_object_store_recycle_bytes",
+             "Segment recycle-pool bytes parked"),
+            ("store_lent_segments", "ray_tpu_object_store_lent_segments",
+             "Segments lent to writers (unsealed AllocSegment leases)"),
+            ("store_lent_bytes", "ray_tpu_object_store_lent_bytes",
+             "Bytes lent to writers (unsealed AllocSegment leases)"),
+            ("store_num_pinned", "ray_tpu_object_store_pinned",
+             "Pinned primary copies resident in the store"),
+            ("data_plane_inflight_bytes",
+             "ray_tpu_data_plane_pull_inflight_bytes",
+             "Cross-node pull bytes admitted and in flight"),
+            ("objects_leaked", "ray_tpu_objects_leaked",
+             "Store-held objects whose owner holds no reference"),
+            ("leak_reclaims", "ray_tpu_objects_leak_reclaims_total",
+             "Leaked objects reclaimed by the sweep"),
             # host stats collected by the raylet via psutil (reference:
             # reporter_agent.py:126)
             ("host_cpu_percent", "ray_tpu_node_cpu_percent",
@@ -837,6 +897,11 @@ class GcsServer:
         if req.get("task_events") or req.get("task_events_dropped"):
             self.task_events.ingest(req.get("task_events") or (),
                                     req.get("task_events_dropped", 0))
+        # Object-lifecycle piggybacks ingest under the same contract.
+        if req.get("object_events") or req.get("object_events_dropped"):
+            self.object_events.ingest(
+                req.get("object_events") or (),
+                req.get("object_events_dropped", 0))
         entry = self.nodes.get(req.node_id)
         if entry is None:
             return protocol.HeartbeatReply(
@@ -1484,6 +1549,44 @@ class GcsServer:
 
     async def handle_get_task_summary(self, conn, header, bufs):
         return {"summary": self.task_events.summary()}
+
+    async def handle_add_object_events(self, conn, header, bufs):
+        """One reporter's batch of object-lifecycle transitions
+        (workers/drivers flush on the metrics-report cadence; raylets
+        ride the heartbeat instead — see handle_heartbeat)."""
+        req = protocol.AddObjectEventsRequest.from_header(header)
+        self.object_events.ingest(req.get("events") or (),
+                                  req.get("dropped", 0))
+        return protocol.AddObjectEventsReply(ok=True).to_header()
+
+    async def handle_get_object_events(self, conn, header, bufs):
+        """Filterable object-table dump for state.list_objects() /
+        timeline(): per-object ordered lifecycle histories plus the
+        segment-level recycle-pool events, with honest truncation
+        counters. Same slicing contract as GetTaskEvents:
+        ``segment_limit`` <= 0 (or absent) means NO segment events."""
+        t = self.object_events
+        try:
+            segment_limit = int(header.get("segment_limit") or 0)
+        except (TypeError, ValueError):
+            segment_limit = 0
+        leaked = header.get("leaked")
+        return {
+            "objects": t.list(state=header.get("state"),
+                              owner=header.get("owner"),
+                              node=header.get("node"),
+                              job_id=header.get("job_id"),
+                              leaked=leaked if isinstance(leaked, bool)
+                              else None,
+                              limit=header.get("limit", 1000)),
+            "segment_events": t.segment_events[-segment_limit:]
+            if segment_limit > 0 else [],
+            "summary": t.summary(),
+        }
+
+    async def handle_get_object_summary(self, conn, header, bufs):
+        return protocol.GetObjectSummaryReply(
+            summary=self.object_events.summary()).to_header()
 
     async def handle_add_profile_events(self, conn, header, bufs):
         self._profile_events.extend(header["events"])

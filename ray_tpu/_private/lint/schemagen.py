@@ -64,7 +64,9 @@ MIN_PROTOCOL_VERSION = 1
 # migrating the call sites the protocol-stub rule then flags is the
 # whole mechanical migration recipe.
 GENERATE = (
+    "AddObjectEvents",
     "AddTaskEvents",
+    "GetObjectSummary",
     "GrantLeaseCredits",
     "Heartbeat",
     "RegisterNode",

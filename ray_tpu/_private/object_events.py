@@ -1,0 +1,297 @@
+"""Object-lifecycle event recording: the object-plane twin of
+task_events.py.
+
+Role parity: the reference's object-state surface — ``ray memory``
+dumping the ownership table plus the GCS-backed ``list_objects`` state
+API (reference: python/ray/util/state over GcsObjectManager, and
+src/ray/core_worker/reference_count.h's per-ref bookkeeping). Before
+this module the object plane was a black box: the zero-copy data
+plane, the segment recycle pool, the memory watchdog and the borrow
+protocol all mutate object state, but none of it was observable except
+through private-field peeks (``store._lent``, ``_pull_inflight_bytes``)
+in chaos tests.
+
+Every object gets a recorded lifecycle, stamped AT THE LAYER THAT OWNS
+the transition:
+
+* reference_count.py / core_worker.py — CREATED (ownership
+  registered), BORROWED (owner records a borrower / borrower adopts a
+  deserialized ref), BORROW_RELEASED, CONTAINED (contained-ref
+  adoption), LOCATION_ADDED / LOCATION_DROPPED (the owner-resident
+  object directory), OUT_OF_SCOPE (the reference table released the
+  object), LINEAGE_RELEASED (a plasma return's creating-task lineage
+  unpinned).
+* shm_store.py — SEALED, PINNED (primary copy), EXPOSED (a foreign
+  mmap may now outlive the free: the segment can never be recycled),
+  EVICTED, SPILLED, RESTORED, FREED (data dropped on this node), plus
+  the SEGMENT-level events RECYCLED and LEASE_ABORTED (object_id-less;
+  they describe the recycle pool, not an object).
+* raylet.py — PULLED (a cross-node pull sealed a replica) and the
+  leak-detector verdicts LEAKED / LEAK_RECLAIMED / LEAK_CLEARED.
+
+High-volume discipline: the in-process memory store's small objects
+(every task return in a 1M-task drain) deliberately do NOT emit
+per-release events — the reference counter only records OUT_OF_SCOPE
+for refs that ever touched plasma, borrowing, containment or the
+location index (see ``reference_count._interesting``). The event
+pipeline exists for the objects the store layers fight over; small
+in-process values stay visible through the live driver-side ref table
+(``ray_tpu.state.memory_summary()`` dumps it; ``list_objects()``
+merges its counts into the records the table does carry).
+
+Transitions accumulate in bounded per-process buffers
+(``ObjectEventBuffer`` — the same honest-truncation discipline as
+``TaskEventBuffer``: drop-newest + counted, never unbounded memory,
+never a hot-path RPC) and ship to the GCS ``ObjectTable`` piggybacked
+on the existing cadences: workers/drivers flush with the metrics
+report loop (``AddObjectEvents``), raylets ride the heartbeat
+(``object_events`` header keys). The GCS keeps a capped per-job index
+with honest eviction counts; an object's job is read straight off its
+id (``ObjectID`` embeds TaskID -> ActorID -> JobID, ids.py), so no
+job-upgrade dance is needed.
+
+Recording is ON by default (``object_events_enabled``); bench.py's
+``object_events_overhead`` row pins the put/get cost under 5%. All
+timestamps are ``time.time()`` so object slices merge with tasks,
+spans and pulls on ONE clock in ``ray_tpu.state.timeline()``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ray_tpu._private.ids import JOB_ID_SIZE
+from ray_tpu._private.task_events import TaskEventBuffer, _hex, _norm_attrs
+
+# Canonical lifecycle states. CREATED/BORROWED/CONTAINED/OUT_OF_SCOPE
+# are reference-counter transitions; SEALED..FREED are store
+# transitions; PULLED and the LEAK_* verdicts are raylet-stamped.
+CREATED = "CREATED"
+SEALED = "SEALED"
+PINNED = "PINNED"
+# The segment name left the store server (a consumer will mmap it):
+# zero-copy views may outlive the free, so it can never be recycled.
+EXPOSED = "EXPOSED"
+BORROWED = "BORROWED"
+BORROW_RELEASED = "BORROW_RELEASED"
+CONTAINED = "CONTAINED"
+LOCATION_ADDED = "LOCATION_ADDED"
+LOCATION_DROPPED = "LOCATION_DROPPED"
+PULLED = "PULLED"
+EVICTED = "EVICTED"
+SPILLED = "SPILLED"
+RESTORED = "RESTORED"
+OUT_OF_SCOPE = "OUT_OF_SCOPE"
+LINEAGE_RELEASED = "LINEAGE_RELEASED"
+FREED = "FREED"
+# Leak-detector verdicts: a store-held segment whose owner no longer
+# holds any reference (a lost FreeObject, a SIGKILLed owner).
+LEAKED = "LEAKED"
+LEAK_RECLAIMED = "LEAK_RECLAIMED"
+# A later live verdict retracted a LEAKED flag (the owner was only
+# transiently unreachable) — without this the GCS record would report
+# a phantom leak until the object is actually freed.
+LEAK_CLEARED = "LEAK_CLEARED"
+# Segment-level events (empty object_id — they describe the recycle
+# pool, not an object): routed into ObjectTable.segment_events.
+RECYCLED = "RECYCLED"
+LEASE_ABORTED = "LEASE_ABORTED"
+
+SEGMENT_STATES = (RECYCLED, LEASE_ABORTED)
+TERMINAL_STATES = (OUT_OF_SCOPE, FREED, LEAK_RECLAIMED)
+
+
+class ObjectEventBuffer(TaskEventBuffer):
+    """Bounded per-process object-event buffer — the TaskEventBuffer
+    contract verbatim (GIL-atomic deque append, drop-newest + monotonic
+    counted, popleft drain), keyed by object id on the wire."""
+
+    WIRE_KEY = "object_id"
+
+
+class ObjectTable:
+    """GCS-side object table: per-object ordered lifecycle history with
+    a capped per-job index (same honest-truncation discipline as
+    TaskEventTable — eviction is FIFO per job, COUNTED per job, and
+    reporter-side ring drops aggregate into ``dropped_events``).
+
+    The job bucket is derived from the object id itself (the first
+    JOB_ID_SIZE bytes: ObjectID embeds TaskID embeds ActorID embeds
+    JobID), so raylet-reported events need no job-upgrade pass.
+    """
+
+    MAX_SEGMENT_EVENTS = 10_000
+    # Per-record event cap: unlike a task's acyclic lifecycle, object
+    # transitions CYCLE (evict/restore, borrow/release, location
+    # add/drop) — one hot object under sustained pressure would grow
+    # its history without bound. Oldest events roll off (the newest
+    # carry the current state) and the loss is COUNTED per record.
+    MAX_EVENTS_PER_OBJECT = 512
+
+    def __init__(self, max_objects_per_job: int = 8192):
+        self.max_objects_per_job = max(1, int(max_objects_per_job))
+        # object_id -> record, insertion-ordered (dict semantics).
+        self._objects: Dict[bytes, dict] = {}
+        # job prefix -> object ids in first-seen order (eviction queue).
+        self._per_job: Dict[bytes, List[bytes]] = {}
+        self.evicted_objects: Dict[bytes, int] = {}
+        self.dropped_events = 0
+        self.segment_events: List[dict] = []
+        self.segment_events_dropped = 0
+
+    def num_objects(self) -> int:
+        return len(self._objects)
+
+    def ingest(self, events, dropped: int = 0) -> None:
+        """Fold one reporter batch in (owner metrics-loop flushes and
+        raylet heartbeat piggybacks both land here)."""
+        self.dropped_events += int(dropped or 0)
+        for e in events:
+            state = e.get("state")
+            attrs = _norm_attrs(e.get("attrs"))
+            oid = e.get("object_id") or b""
+            if not oid or state in SEGMENT_STATES:
+                if len(self.segment_events) >= self.MAX_SEGMENT_EVENTS:
+                    self.segment_events_dropped += 1
+                else:
+                    rec = {"state": state, "ts": e.get("ts", 0.0)}
+                    rec.update(attrs or {})
+                    self.segment_events.append(rec)
+                continue
+            rec = self._objects.get(oid)
+            if rec is None:
+                rec = {"object_id": oid, "owner": "", "size": 0,
+                       "events": [], "events_dropped": 0,
+                       "state": "", "state_key": (-1.0, False)}
+                self._objects[oid] = rec
+                self._index(oid)
+            if attrs:
+                if attrs.get("owner") and not rec["owner"]:
+                    rec["owner"] = attrs["owner"]
+                size = attrs.get("size") or attrs.get("bytes") or 0
+                if size and size > rec["size"]:
+                    rec["size"] = size
+            ts = e.get("ts", 0.0)
+            history = rec["events"]
+            history.append((state, ts, attrs))
+            # current state maintained incrementally (one key compare
+            # per event) so summary()/list() never rescan every event
+            # of every record per dashboard poll; same ordering rule
+            # as _current_state, and eviction below only ever removes
+            # the OLDEST event so the cached newest stays correct
+            key = (ts, state in TERMINAL_STATES)
+            if key >= rec["state_key"]:
+                rec["state"] = state
+                rec["state_key"] = key
+            if len(history) > self.MAX_EVENTS_PER_OBJECT:
+                # drop the OLDEST-by-timestamp event (arrival order
+                # can interleave reporters) so the current state stays
+                # truthful; honest per-record counter
+                history.remove(min(history, key=lambda ev: ev[1]))
+                rec["events_dropped"] += 1
+
+    def _index(self, oid: bytes) -> None:
+        job = oid[:JOB_ID_SIZE]
+        order = self._per_job.setdefault(job, [])
+        order.append(oid)
+        while len(order) > self.max_objects_per_job:
+            old = order.pop(0)
+            if self._objects.pop(old, None) is not None:
+                self.evicted_objects[job] = \
+                    self.evicted_objects.get(job, 0) + 1
+
+    def list(self, state: Optional[str] = None,
+             owner: Optional[str] = None, node: Optional[str] = None,
+             job_id: Optional[str] = None,
+             leaked: Optional[bool] = None,
+             limit: int = 1000) -> List[dict]:
+        """Public-form records, newest-first-seen last. Filters run on
+        the RAW records and only the post-limit tail is converted (the
+        per-record event sort must not scan the whole table per
+        dashboard poll); ``limit`` <= 0 returns nothing — a negative
+        limit must never alias to 'the entire table'."""
+        try:
+            limit = int(limit if limit is not None else 0)
+        except (TypeError, ValueError):
+            limit = 0
+        if limit <= 0:
+            return []
+        matched = []
+        for rec in self._objects.values():
+            if owner and owner not in rec["owner"]:
+                continue
+            if job_id and rec["object_id"][:JOB_ID_SIZE].hex() != job_id:
+                continue
+            if state or leaked is not None:
+                cur = rec.get("state") or _current_state(rec["events"])
+                if state and cur != state:
+                    continue
+                if leaked is not None and (cur == LEAKED) != leaked:
+                    continue
+            if node and not any(
+                    isinstance(e[2], dict) and
+                    str(e[2].get("node", "")).startswith(node)
+                    for e in rec["events"]):
+                continue
+            matched.append(rec)
+        return [object_record_to_public(r) for r in matched[-limit:]]
+
+    def summary(self) -> dict:
+        """Aggregate view for ``summary_objects()`` / the dashboard.
+        ``leaked`` counts records CURRENTLY in the LEAKED state — a
+        reclaimed (or late-freed) orphan leaves the count, so the chaos
+        invariant ``summary_objects()["leaked"] == 0`` asserts a clean
+        steady state, not "no leak ever happened" (by_state keeps the
+        LEAK_RECLAIMED history)."""
+        by_state: Dict[str, int] = {}
+        leaked = 0
+        total_bytes = 0
+        for rec in self._objects.values():
+            st = rec.get("state") or _current_state(rec["events"])
+            by_state[st] = by_state.get(st, 0) + 1
+            if st == LEAKED:
+                leaked += 1
+            total_bytes += rec["size"]
+        return {
+            "num_objects": len(self._objects),
+            "by_state": by_state,
+            "leaked": leaked,
+            "total_size_bytes": total_bytes,
+            "evicted_objects": {_hex(k): v
+                                for k, v in self.evicted_objects.items()},
+            "dropped_events": self.dropped_events,
+            "num_segment_events": len(self.segment_events),
+            "segment_events_dropped": self.segment_events_dropped,
+        }
+
+
+def _current_state(events) -> str:
+    """State of the latest-by-timestamp transition; a terminal state
+    wins wall-clock ties (a FREED and the sweeping raylet's bookkeeping
+    can share a microsecond)."""
+    if not events:
+        return ""
+    best = max(events, key=lambda e: (e[1], e[0] in TERMINAL_STATES))
+    return best[0]
+
+
+def object_record_to_public(rec: dict) -> dict:
+    """GCS-internal record -> API/JSON form: hex ids, ts-sorted events
+    with per-hop durations, current state and the leaked flag."""
+    events = sorted(rec["events"], key=lambda e: e[1])
+    out_events = []
+    for i, (state, ts, attrs) in enumerate(events):
+        dur = events[i + 1][1] - ts if i + 1 < len(events) else None
+        out_events.append({"state": state, "ts": ts, "dur": dur,
+                           "attrs": attrs})
+    cur = rec.get("state") or _current_state(events)
+    return {
+        "object_id": _hex(rec["object_id"]),
+        "job_id": rec["object_id"][:JOB_ID_SIZE].hex(),
+        "owner": rec["owner"],
+        "size": rec["size"],
+        "state": cur,
+        "leaked": cur == LEAKED,
+        "events": out_events,
+        "events_dropped": rec.get("events_dropped", 0),
+    }

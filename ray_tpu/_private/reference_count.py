@@ -22,9 +22,29 @@ import logging
 import threading
 from typing import Callable, Dict, List, Optional, Set
 
+from ray_tpu._private import object_events as oev
 from ray_tpu._private.ids import ObjectID, id_key as _key
 
 logger = logging.getLogger(__name__)
+
+
+def _interesting(r: "Reference") -> bool:
+    """Whether a released ref earns an OUT_OF_SCOPE object event. The
+    event pipeline covers the objects the store layers fight over —
+    plasma residents, borrows, containment edges, location entries.
+    Trivial owned in-process values (every task return of a 1M-task
+    drain) are deliberately silent here: recording each would only spin
+    the drop counter and FIFO-evict the interesting records out of the
+    GCS table; the live driver-side ref table still lists them.
+
+    Set-valued fields test ``is not None`` (ever-allocated), not
+    truthiness: the release walk empties ``contained_in`` (and borrow
+    release empties ``borrowers``) BEFORE the ref is judged — an
+    object that ever had those edges is exactly the kind whose release
+    must be visible."""
+    return bool(r.in_plasma or not r.owned or r.borrowers is not None
+                or r.contains is not None or r.contained_in is not None
+                or r.locations is not None)
 
 
 class Reference:
@@ -67,6 +87,12 @@ class ReferenceCounter:
         self._lock = threading.RLock()
         self._refs: Dict[bytes, Reference] = {}
         self.own_address = own_address
+        # Object-lifecycle recorder (object_events.ObjectEventBuffer),
+        # installed by the CoreWorker. The reference counter owns the
+        # CREATED / BORROWED / CONTAINED / location / OUT_OF_SCOPE
+        # transitions, so it stamps them (cold paths only — see
+        # _interesting; the lock-free submit fast path never records).
+        self.events = None
         # Fired when an owned object becomes releasable: storage layers
         # delete data; lineage unpins.
         self._on_release: List[Callable[[ObjectID, "Reference"], None]] = []
@@ -94,6 +120,9 @@ class ReferenceCounter:
             ref.owner_address = self.own_address
             ref.in_plasma = in_plasma
             ref.pinned_lineage = pin_lineage
+        ev = self.events
+        if ev is not None and ev.enabled:
+            ev.record(k, oev.CREATED, {"owner": self.own_address})
 
     def add_owned_with_local_ref(self, object_id,
                                  pin_lineage: bool = False) -> None:
@@ -131,7 +160,13 @@ class ReferenceCounter:
                 ref = self._refs[k] = Reference()
             if not ref.owned:
                 ref.owner_address = owner_address
-            return first
+        ev = self.events
+        if first and ev is not None and ev.enabled:
+            # borrower-side adoption (the owner's own BORROWED event —
+            # stamped in add_borrower — carries the borrower address)
+            ev.record(k, oev.BORROWED, {"owner": owner_address,
+                                        "by": self.own_address})
+        return first
 
     def owner_address_of(self, object_id) -> str:
         with self._lock:
@@ -180,62 +215,102 @@ class ReferenceCounter:
                     inner_ref.contained_in = set()
                 inner_ref.contained_in.add(ko)
                 outer_ref.contains.add(ki)
+        ev = self.events
+        if ev is not None and ev.enabled:
+            # contained-ref adoption: the INNER objects gain a pinning
+            # containment edge (one event each, cold path — values
+            # carrying ObjectRefs are serialized, never the raw submit)
+            outer_hex = ko.hex()
+            for oid in inner:
+                ev.record(_key(oid), oev.CONTAINED, {"in": outer_hex})
 
     # -- borrowers (owner side) ---------------------------------------------
 
     def add_borrower(self, object_id, borrower_address: str) -> None:
+        k = _key(object_id)
+        recorded = False
         with self._lock:
-            ref = self._refs.setdefault(_key(object_id), Reference())
+            ref = self._refs.setdefault(k, Reference())
             if borrower_address != self.own_address:
                 if ref.borrowers is None:
                     ref.borrowers = set()
-                ref.borrowers.add(borrower_address)
+                if borrower_address not in ref.borrowers:
+                    ref.borrowers.add(borrower_address)
+                    recorded = True
+        ev = self.events
+        if recorded and ev is not None and ev.enabled:
+            ev.record(k, oev.BORROWED, {"borrower": borrower_address})
 
     def remove_borrower(self, object_id, borrower_address: str) -> None:
         k = _key(object_id)
+        removed = False
         with self._lock:
             ref = self._refs.get(k)
             if ref is None:
                 return
-            if ref.borrowers:
+            if ref.borrowers and borrower_address in ref.borrowers:
                 ref.borrowers.discard(borrower_address)
+                removed = True
+        ev = self.events
+        if removed and ev is not None and ev.enabled:
+            ev.record(k, oev.BORROW_RELEASED,
+                      {"borrower": borrower_address})
         self._maybe_release(k)
 
     # -- locations (owner-resident object directory) ------------------------
 
     def add_location(self, object_id, node_id: bytes,
                      size: int = 0) -> None:
+        k = _key(object_id)
         with self._lock:
-            ref = self._refs.setdefault(_key(object_id), Reference())
+            ref = self._refs.setdefault(k, Reference())
             if ref.locations is None:
                 ref.locations = set()
+            new = node_id not in ref.locations
             ref.locations.add(node_id)
             ref.in_plasma = True
             if size:
                 ref.size = size
+        ev = self.events
+        if new and ev is not None and ev.enabled:
+            ev.record(k, oev.LOCATION_ADDED,
+                      {"node": node_id.hex()[:12], "size": size})
 
     def add_location_if_tracked(self, object_id, node_id: bytes,
                                 size: int = 0) -> bool:
         """Like ``add_location`` but refuses to resurrect a released
         ref (a late replica report racing the owner's final release
         must not re-create the entry — the replica would leak)."""
+        k = _key(object_id)
         with self._lock:
-            ref = self._refs.get(_key(object_id))
+            ref = self._refs.get(k)
             if ref is None:
                 return False
             if ref.locations is None:
                 ref.locations = set()
+            new = node_id not in ref.locations
             ref.locations.add(node_id)
             ref.in_plasma = True
             if size:
                 ref.size = size
-            return True
+        ev = self.events
+        if new and ev is not None and ev.enabled:
+            ev.record(k, oev.LOCATION_ADDED,
+                      {"node": node_id.hex()[:12], "size": size})
+        return True
 
     def remove_location(self, object_id, node_id: bytes) -> None:
+        k = _key(object_id)
+        dropped = False
         with self._lock:
-            ref = self._refs.get(_key(object_id))
-            if ref and ref.locations:
+            ref = self._refs.get(k)
+            if ref and ref.locations and node_id in ref.locations:
                 ref.locations.discard(node_id)
+                dropped = True
+        ev = self.events
+        if dropped and ev is not None and ev.enabled:
+            ev.record(k, oev.LOCATION_DROPPED,
+                      {"node": node_id.hex()[:12]})
 
     def get_locations(self, object_id) -> Set[bytes]:
         with self._lock:
@@ -305,7 +380,13 @@ class ReferenceCounter:
                         stack.append((inner, iref))
             for ki, _ in to_release:
                 self._refs.pop(ki, None)
+        ev = self.events
         for ki, r in to_release:
+            if ev is not None and ev.enabled and _interesting(r):
+                ev.record(ki, oev.OUT_OF_SCOPE,
+                          {"owned": r.owned} if r.owned
+                          else {"owned": False,
+                                "owner": r.owner_address})
             oid = ObjectID(ki)
             for cb in self._on_release:
                 try:

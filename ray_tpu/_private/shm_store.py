@@ -40,6 +40,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import msgpack
 
 from ray_tpu._private import faultpoints, native
+from ray_tpu._private import object_events as oev
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.serialization import SerializedObject
 
@@ -656,6 +657,21 @@ class ShmStoreServer:
         self._lent: Dict[str, Tuple[int, float]] = {}
         self.num_recycle_hits = 0
         self.num_recycle_misses = 0
+        # Object-lifecycle recorder (object_events.ObjectEventBuffer),
+        # installed by the raylet: the store owns the SEALED / PINNED /
+        # EXPOSED / EVICTED / SPILLED / RESTORED / FREED transitions
+        # and the segment-level RECYCLED / LEASE_ABORTED events, so it
+        # stamps them. None (and cost-free) in writer processes.
+        self.events = None
+        self.node_tag = ""
+
+    def _rec(self, object_id, state: str, attrs: dict) -> None:
+        ev = self.events
+        if ev is None or not ev.enabled:
+            return
+        attrs["node"] = self.node_tag
+        ev.record(object_id.binary() if object_id is not None else b"",
+                  state, attrs)
 
     # -- write path ---------------------------------------------------------
 
@@ -709,6 +725,7 @@ class ShmStoreServer:
         entry = self._lent.pop(name, None)
         if entry is None:
             return  # already sealed, swept, or never leased here
+        self._rec(None, oev.LEASE_ABORTED, {"segment": name})
         self._park_segment(name, entry[0])
 
     def _park_segment(self, name: str, size_hint: int) -> None:
@@ -730,6 +747,7 @@ class ShmStoreServer:
             return
         self._recycle[name] = fsize
         self.recycle_bytes += fsize
+        self._rec(None, oev.RECYCLED, {"segment": name, "bytes": fsize})
 
     def _drain_recycle(self, need_bytes: int) -> int:
         """Unlink parked segments oldest-first until ``need_bytes`` are
@@ -774,6 +792,8 @@ class ShmStoreServer:
         self._last_access[object_id] = time.time()
         self._exposed.discard(object_id)  # fresh segment, no foreign maps
         self.used += size
+        self._rec(object_id, oev.SEALED,
+                  {"size": size, "segment": segment_name})
         return True
 
     # -- read path ----------------------------------------------------------
@@ -806,9 +826,23 @@ class ShmStoreServer:
         e = self._objects.get(object_id)
         return (name, e[1]) if e is not None else None
 
+    def held_objects(self) -> List[Tuple[ObjectID, float]]:
+        """Snapshot of everything this store is accountable for, as
+        (object_id, sealed_ts) — the leak detector's sweep input (and a
+        public alternative to peeking ``_objects``). SPILLED objects
+        are included (ts 0.0: their seal time is long past): an
+        orphaned spill file is a disk leak exactly like an orphaned
+        segment, and ``free()`` reclaims both."""
+        out = [(oid, e[2]) for oid, e in list(self._objects.items())]
+        out.extend((oid, 0.0) for oid in list(self._spilled)
+                   if oid not in self._objects)
+        return out
+
     # -- pinning (primary copies; owner-driven) ------------------------------
 
     def pin(self, object_id: ObjectID) -> None:
+        if object_id not in self._pinned:
+            self._rec(object_id, oev.PINNED, {})
         self._pinned[object_id] = self._pinned.get(object_id, 0) + 1
 
     def unpin(self, object_id: ObjectID) -> None:
@@ -824,6 +858,10 @@ class ShmStoreServer:
         """The object's segment name left the store server (a worker
         will mmap it): its segment must never be recycled — consumers
         may hold zero-copy views past the free."""
+        if object_id not in self._exposed:
+            # once per object, not per chunk serve: EXPOSED marks the
+            # recycling waiver, which is a one-way transition
+            self._rec(object_id, oev.EXPOSED, {})
         self._exposed.add(object_id)
 
     def free(self, object_id: ObjectID) -> None:
@@ -844,6 +882,8 @@ class ShmStoreServer:
         spilled = self._spilled.pop(object_id, None)
         if spilled is not None:
             self._delete_spilled(spilled[0])
+        if entry is not None or spilled is not None:
+            self._rec(object_id, oev.FREED, {})
 
     def _delete_spilled(self, location: str) -> None:
         if location.startswith("ext:"):
@@ -902,6 +942,7 @@ class ShmStoreServer:
             self.used -= size
             freed += size
             self.num_evictions += 1
+            self._rec(oid, oev.EVICTED, {"size": size})
             self._unlink(name)  # pressure path: actually release pages
         if freed < need_bytes and self.spilling_enabled:
             pinned_victims = sorted(
@@ -938,6 +979,7 @@ class ShmStoreServer:
         self.used -= size
         self.num_spills += 1
         self._spilled[object_id] = (location, size)
+        self._rec(object_id, oev.SPILLED, {"size": size})
         self._unlink(name)
         return size
 
@@ -975,6 +1017,7 @@ class ShmStoreServer:
         self._last_access[object_id] = time.time()
         self.used += size
         self.num_restores += 1
+        self._rec(object_id, oev.RESTORED, {"size": size})
         return name
 
     @staticmethod
@@ -1018,6 +1061,8 @@ class ShmStoreServer:
             "recycle_pool_segments": len(self._recycle),
             "recycle_pool_bytes": self.recycle_bytes,
             "recycle_lent_segments": len(self._lent),
+            "recycle_lent_bytes": sum(sz for sz, _ in
+                                      self._lent.values()),
             "num_recycle_hits": self.num_recycle_hits,
             "num_recycle_misses": self.num_recycle_misses,
             # consumer-pinned mappings awaiting their views' GC (normal)

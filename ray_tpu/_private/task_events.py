@@ -102,6 +102,11 @@ class TaskEventBuffer:
     __slots__ = ("capacity", "enabled", "dropped", "_dropped_flushed",
                  "_buf")
 
+    # Wire-dict key the drained id lands under. The object-plane twin
+    # (object_events.ObjectEventBuffer) subclasses with "object_id" —
+    # everything else about the buffer contract is shared.
+    WIRE_KEY = "task_id"
+
     def __init__(self, capacity: int = 65536, enabled: bool = True):
         self.capacity = max(1, int(capacity))
         self.enabled = enabled
@@ -170,12 +175,13 @@ class TaskEventBuffer:
         if max_events:
             n = min(n, max_events)
         out = []
+        key = self.WIRE_KEY
         for _ in range(n):
             try:
                 t, s, ts, a = buf.popleft()
             except IndexError:  # raced another drainer; nothing lost
                 break
-            out.append({"task_id": t, "state": s, "ts": ts, "attrs": a})
+            out.append({key: t, "state": s, "ts": ts, "attrs": a})
         total = self.dropped
         dropped = total - self._dropped_flushed
         self._dropped_flushed = total

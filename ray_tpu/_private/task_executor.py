@@ -604,10 +604,14 @@ class TaskExecutor:
                 returns.append([oid_b, 0, meta, start, len(frames), contained])
             else:
                 segment, size = self.core.write_segment_sync(serialized)
+                # owner_address = the task's CALLER (the return's
+                # owner), not this executing worker — the raylet's
+                # leak detector probes the owner's live references
                 reply, _ = self.core._run(self.core.raylet_conn.call(
                     "SealObject", {"object_id": oid_b,
                                    "segment": segment, "size": size,
-                                   "pin": True}))
+                                   "pin": True,
+                                   "owner_address": spec.owner_address}))
                 if not reply.get("ok"):
                     return self._error_reply(spec, exc.ObjectStoreFullError(
                         f"return {i} of {spec.name} ({size}B) doesn't fit"))

@@ -23,6 +23,7 @@ from typing import Dict, List, Optional
 
 import ray_tpu
 from ray_tpu import worker as worker_mod
+from ray_tpu._private import protocol
 
 
 def _core():
@@ -64,6 +65,21 @@ def summary_nodes() -> List[dict]:
             "memory_monitor_kills": s.get("memory_monitor_kills", 0),
             "lease_backpressure_rejects":
                 s.get("lease_backpressure_rejects", 0),
+            # object-plane rollups (heartbeat-carried, ISSUE 13): the
+            # memory truth GetNodeStats always computed, now dashboard-
+            # visible without a per-node RPC
+            "store_capacity_bytes": s.get("store_capacity_bytes", 0),
+            "store_num_pinned": s.get("store_num_pinned", 0),
+            "store_recycle_bytes": s.get("store_recycle_bytes", 0),
+            "store_recycle_segments": s.get("store_recycle_segments", 0),
+            "store_lent_segments": s.get("store_lent_segments", 0),
+            "store_lent_bytes": s.get("store_lent_bytes", 0),
+            "map_cache_bytes": s.get("map_cache_bytes", 0),
+            "map_cache_entries": s.get("map_cache_entries", 0),
+            "data_plane_inflight_bytes":
+                s.get("data_plane_inflight_bytes", 0),
+            "objects_leaked": s.get("objects_leaked", 0),
+            "leak_reclaims": s.get("leak_reclaims", 0),
         })
     return out
 
@@ -143,18 +159,83 @@ def summary_tasks() -> dict:
     return reply.get("summary", {})
 
 
+def list_objects(state: Optional[str] = None, owner: Optional[str] = None,
+                 node: Optional[str] = None, job_id: Optional[str] = None,
+                 leaked: Optional[bool] = None,
+                 limit: int = 1000) -> List[dict]:
+    """Per-object lifecycle records from the GCS object table, merged
+    with this driver's live reference counts.
+
+    Each record carries the object's ``owner``, ``size``, current
+    ``state``, the ``leaked`` verdict, and the full ordered transition
+    history (CREATED -> SEALED/PINNED -> BORROWED/PULLED/locations ->
+    OUT_OF_SCOPE/FREED, object_events.py)::
+
+        {"object_id": hex, "job_id": hex, "owner": str, "size": int,
+         "state": str, "leaked": bool,
+         "events": [{"state", "ts", "dur", "attrs"}, ...],
+         # for objects this driver still tracks:
+         "ref_counts": {"local", "submitted", "borrowers", "contains",
+                        "lineage_pinned"}, "locations": [hex12, ...]}
+
+    Filters: ``state`` exact, ``owner`` substring, ``node``
+    node-id-hex prefix, ``job_id`` hex, ``leaked`` exact. The table is
+    capped per job with counted eviction — ``summary_objects()``
+    reports the truncation. Small in-process values that never touched
+    plasma/borrowing emit no events by design and do NOT appear here;
+    ``memory_summary()`` dumps the live driver ref table that covers
+    them."""
+    core = _core()
+    reply = core.gcs_call_sync("GetObjectEvents", {
+        "state": state, "owner": owner, "node": node, "job_id": job_id,
+        "leaked": leaked, "limit": limit})
+    records = reply.get("objects", [])
+    rc = core.reference_counter
+    with rc._lock:  # noqa: SLF001 — read-only snapshot under the lock
+        live = dict(rc._refs)
+    for rec in records:
+        ref = live.get(bytes.fromhex(rec["object_id"]))
+        if ref is None:
+            continue
+        rec["ref_counts"] = {
+            "local": ref.local_refs,
+            "submitted": ref.submitted_refs,
+            "borrowers": len(ref.borrowers or ()),
+            "contains": len(ref.contains or ()),
+            "lineage_pinned": ref.pinned_lineage,
+        }
+        rec["locations"] = [n.hex()[:12]
+                            for n in sorted(ref.locations or ())]
+    return records
+
+
+def summary_objects() -> dict:
+    """Aggregate object counts by state plus the honest loss
+    accounting (per-job eviction counts, reporter drops) and the
+    leak-detector verdict: ``leaked`` counts store-held objects whose
+    owner holds no reference RIGHT NOW — the chaos schedules assert it
+    returns to 0 after every soak."""
+    reply = _core().gcs_call_sync(
+        "GetObjectSummary", protocol.GetObjectSummaryRequest().to_header())
+    return reply.get("summary", {})
+
+
 def timeline(path: Optional[str] = None) -> List[dict]:
     """Chrome-trace export (chrome://tracing / Perfetto "trace event"
-    JSON) merging THREE sources onto one wall clock:
+    JSON) merging FOUR sources onto one wall clock:
 
     * task state intervals from the GCS task table (one "X" slice per
       transition, lasting until the next one),
+    * object lifecycle intervals from the GCS object table (cat
+      "object": allocation/seal, pin/borrow/pull, free — same clock as
+      the tasks that produced and consumed them),
     * tracing spans exported by util/tracing.py (RAY_TPU_TRACE=1),
     * data-plane pull/transfer intervals recorded by the raylets.
 
-    So a single trace shows submit -> lease wait -> pull -> execute.
-    Returns the event list; with ``path`` also writes it as JSON (load
-    the file directly in chrome://tracing or ui.perfetto.dev)."""
+    So a single trace shows submit -> lease wait -> pull -> execute
+    with the objects' lifetimes underneath. Returns the event list;
+    with ``path`` also writes it as JSON (load the file directly in
+    chrome://tracing or ui.perfetto.dev)."""
     from ray_tpu.util import tracing
 
     reply = _core().gcs_call_sync("GetTaskEvents", {
@@ -187,6 +268,23 @@ def timeline(path: Optional[str] = None) -> List[dict]:
                          "attempt": task["attempt"],
                          **(e.get("attrs") or {})},
             })
+    obj_reply = _core().gcs_call_sync("GetObjectEvents",
+                                      {"limit": 100_000})
+    for oidx, obj in enumerate(obj_reply.get("objects", []), start=1):
+        pid = pid_of("objects")
+        events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                       "tid": oidx, "ts": 0,
+                       "args": {"name": obj["object_id"][:8]}})
+        for e in obj["events"]:
+            events.append({
+                "name": e["state"], "cat": "object", "ph": "X",
+                "ts": e["ts"] * 1e6,
+                "dur": max(0.0, e["dur"] or 0.0) * 1e6,
+                "pid": pid, "tid": oidx,
+                "args": {"object_id": obj["object_id"],
+                         "owner": obj["owner"], "size": obj["size"],
+                         **(e.get("attrs") or {})},
+            })
     for tr in reply.get("transfers", []):
         pid = pid_of(f"data-plane {tr.get('node', '?')}")
         events.append({
@@ -205,10 +303,15 @@ def timeline(path: Optional[str] = None) -> List[dict]:
 
 
 def memory_summary() -> str:
-    """Ref-table + store dump (the ``ray memory`` analog).
+    """Cluster object-memory dump (the ``ray memory`` analog).
 
-    Covers this driver's ownership table (local refs, submitted-task
-    refs, borrows, pinned bytes) and every node's store occupancy."""
+    Three sections: this driver's live ownership table (local refs,
+    submitted-task refs, borrows, plasma residency), the cluster-wide
+    object table's state/leak summary (object_events.py — honest
+    truncation counters included), and the per-node store rollups the
+    heartbeat carries: occupancy, recycle pool, lent (AllocSegment)
+    leases, writer map cache, data-plane in-flight bytes, and the
+    leak-detector verdicts."""
     core = _core()
     rc = core.reference_counter
     lines = ["======== Object references (this driver) ========",
@@ -227,14 +330,45 @@ def memory_summary() -> str:
         lines.append(f"... and {total - n_shown} more")
     lines.append(f"Total tracked references: {total}")
     lines.append("")
+    lines.append("======== Object table (cluster) ========")
+    try:
+        s = summary_objects()
+    except Exception:  # noqa: BLE001 — summary must degrade, not raise
+        s = {}
+    by_state = s.get("by_state", {})
+    lines.append(
+        f"{s.get('num_objects', 0)} objects tracked, "
+        f"{s.get('total_size_bytes', 0) / (1024 ** 2):.1f} MiB, "
+        f"leaked: {s.get('leaked', 0)}")
+    if by_state:
+        lines.append("  by state: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(by_state.items())))
+    dropped = s.get("dropped_events", 0)
+    evicted = sum(s.get("evicted_objects", {}).values())
+    if dropped or evicted:
+        lines.append(f"  truncation: {evicted} records evicted, "
+                     f"{dropped} events dropped (honest counters)")
+    lines.append("")
     lines.append("======== Object store (per node) ========")
     for n in node_stats():
         s = n.get("stats", {})
         nid = n["node_id"].hex()[:12] if isinstance(n["node_id"], bytes) \
             else str(n["node_id"])[:12]
+        mib = 1024 ** 2
         lines.append(
-            f"node {nid}: {s.get('store_num_objects', 0)} objects, "
-            f"{s.get('store_used_bytes', 0) / (1024 ** 2):.1f} MiB, "
+            f"node {nid}: {s.get('store_num_objects', 0)} objects "
+            f"({s.get('store_num_pinned', 0)} pinned), "
+            f"{s.get('store_used_bytes', 0) / mib:.1f}/"
+            f"{s.get('store_capacity_bytes', 0) / mib:.0f} MiB, "
             f"{s.get('store_num_spills', 0)} spilled, "
             f"{s.get('store_num_evictions', 0)} evicted")
+        lines.append(
+            f"  recycle pool {s.get('store_recycle_bytes', 0) / mib:.1f}"
+            f" MiB/{s.get('store_recycle_segments', 0)} segs, "
+            f"{s.get('store_lent_segments', 0)} lent, map cache "
+            f"{s.get('map_cache_bytes', 0) / mib:.1f} MiB/"
+            f"{s.get('map_cache_entries', 0)} entries, pull in-flight "
+            f"{s.get('data_plane_inflight_bytes', 0) / mib:.1f} MiB, "
+            f"leaked {s.get('objects_leaked', 0)} "
+            f"(reclaimed {s.get('leak_reclaims', 0)})")
     return "\n".join(lines)

@@ -378,3 +378,13 @@ def timeline() -> List[dict]:
             "dur": (e.get("end", 0) - e.get("start", 0)) * 1e6,
         })
     return events
+
+
+def memory_summary() -> str:
+    """Cluster object-memory dump (the ``ray memory`` analog): this
+    driver's ref table, the GCS object table's state/leak summary, and
+    every node's store/recycle/map-cache/leak rollups. Delegates to
+    ``ray_tpu.state.memory_summary()``."""
+    from ray_tpu import state as state_mod
+
+    return state_mod.memory_summary()

@@ -23,7 +23,10 @@ schedule (the acceptance bar for all recovery paths):
 
 1. no pull/get hangs past its bound — it returns or raises typed;
 2. pull-admission budgets return to zero;
-3. no leaked segment leases (``store._lent`` drains);
+3. no leaked segment leases (lent AllocSegment leases drain) and the
+   leak detector reports ZERO leaked objects — both read through the
+   PUBLIC object-plane surface (``Raylet.object_plane_stats()`` /
+   ``state.summary_objects()``), not private-field peeks;
 4. chaos-created shm segments are unlinked by teardown;
 5. the process fd count returns to its pre-run level (small slack) —
    the task soak brackets the REAL cluster too, which pins the
@@ -366,12 +369,15 @@ class DataPlaneChaos:
 
     def _check_round_invariants(self, step: int):
         for i, r in self._live():
-            assert r._pull_inflight_bytes == 0, \
+            ostats = r.object_plane_stats()
+            assert ostats["pull_inflight_bytes"] == 0, \
                 f"admission budget leaked on r{i} at step {step}: " \
-                f"{r._pull_inflight_bytes}"
-            assert not r.store._lent, \
-                f"segment lease leaked on r{i} at step {step}: " \
-                f"{dict(r.store._lent)}"
+                f"{ostats}"
+            assert ostats["lent_segments"] == 0, \
+                f"segment lease leaked on r{i} at step {step}: {ostats}"
+            assert ostats["leaked"] == 0, \
+                f"leak detector flagged objects on r{i} at step " \
+                f"{step}: {ostats}"
 
     async def _check_partition_healed(self):
         """Every partitioned (but never crashed) node must be ALIVE in
@@ -406,6 +412,11 @@ class DataPlaneChaos:
                 await self._workload_round(rng, step)
                 self._check_round_invariants(step)
             await self._check_partition_healed()
+            # standing leak-detector invariant: the soak's seals,
+            # pulls and frees left no orphan the object table flags
+            assert self.gcs.object_events.summary()["leaked"] == 0, \
+                f"object table reports leaks after {self.kind} " \
+                f"seed={self.seed}"
         finally:
             faultpoints.reset()
             await self._teardown()
@@ -542,6 +553,11 @@ def run_task_schedule(seed: int, kill_nth: int = 6,
         assert n_retry > 0, \
             "workers died but the task-event table shows no " \
             "RETRY/FAILED history"
+        # standing leak-detector invariant (ISSUE 13): worker-death
+        # chaos must not leave orphaned store segments behind
+        leaked = state_mod.summary_objects().get("leaked", 0)
+        assert leaked == 0, \
+            f"leak detector flagged {leaked} objects after the soak"
         summary = {"tasks": n_tasks, "ok": n_ok, "crashed": n_crashed,
                    "bumps": bumps, "retry_or_failed_events": n_retry}
     finally:
@@ -655,10 +671,13 @@ def run_credit_revoke_schedule(seed: int, rounds: int = 4,
                     f"wrong value under {disruption} at round {round_no}"
                 summary["ok"] += 1
             faultpoints.reset()
-            # per-round invariants (the standard chaos bar)
-            assert raylet._pull_inflight_bytes == 0
-            assert not raylet.store._lent, \
-                f"segment lease leaked at round {round_no}"
+            # per-round invariants (the standard chaos bar, public API)
+            ostats = raylet.object_plane_stats()
+            assert ostats["pull_inflight_bytes"] == 0
+            assert ostats["lent_segments"] == 0, \
+                f"segment lease leaked at round {round_no}: {ostats}"
+            assert ostats["leaked"] == 0, \
+                f"leak detector flagged objects at round {round_no}"
 
         # non-vacuous: the stream must actually have engaged
         stats = raylet._credit_stats()
@@ -729,6 +748,11 @@ def run_credit_revoke_schedule(seed: int, rounds: int = 4,
         # no hung submits: the surviving driver still gets work done
         assert ray_tpu.get(slow_double.remote(21, 0.01), timeout=60) == 42
         summary["owner_kill"] = "reclaimed"
+        # standing leak-detector invariant (ISSUE 13)
+        import ray_tpu.state as state_mod
+        leaked = state_mod.summary_objects().get("leaked", 0)
+        assert leaked == 0, \
+            f"leak detector flagged {leaked} objects after the soak"
     finally:
         faultpoints.reset()
         ray_tpu.shutdown()
@@ -817,6 +841,11 @@ def run_credit_raylet_kill_schedule(seed: int) -> dict:
         assert head_stats["resources_available"] == \
             head_stats["resources_total"], \
             f"head pool leaked after raylet kill: {head_stats}"
+        # standing leak-detector invariant (ISSUE 13), via the public
+        # GetNodeStats object-plane block
+        assert head_stats["object_plane"]["leaked"] == 0, \
+            f"leak detector flagged objects after raylet kill: " \
+            f"{head_stats['object_plane']}"
     finally:
         ray_tpu.shutdown()
         c.shutdown()
@@ -928,11 +957,14 @@ def run_oom_storm_schedule(seed: int, rounds: int = 4,
                     n_oom += 1
                 except exc_mod.WorkerCrashedError:
                     n_crashed += 1  # lost-notify fallback path: typed too
-            # per-round invariants (the standard chaos bar)
-            assert raylet._pull_inflight_bytes == 0, \
+            # per-round invariants (the standard chaos bar, public API)
+            ostats = raylet.object_plane_stats()
+            assert ostats["pull_inflight_bytes"] == 0, \
                 f"admission budget leaked at round {round_no}"
-            assert not raylet.store._lent, \
+            assert ostats["lent_segments"] == 0, \
                 f"segment lease leaked at round {round_no}"
+            assert ostats["leaked"] == 0, \
+                f"leak detector flagged objects at round {round_no}"
             # raylet + GCS survive every event: both still serve (the
             # in-process head shares the driver pid), the GCS still
             # shows the node alive, and every watchdog kill named a
@@ -947,6 +979,11 @@ def run_oom_storm_schedule(seed: int, rounds: int = 4,
             f"OOM storm starved the workload: {n_ok} ok"
         assert mon.kills + mon.backpressure_rejects > 0, \
             "storm never engaged the watchdog (vacuous soak)"
+        # standing leak-detector invariant (ISSUE 13): watchdog kills
+        # and pressure relief must not strand orphaned segments
+        leaked = state_mod.summary_objects().get("leaked", 0)
+        assert leaked == 0, \
+            f"leak detector flagged {leaked} objects after the storm"
         summary = {"seed": seed, "ok": n_ok, "oom": n_oom,
                    "crashed": n_crashed, "kills": mon.kills,
                    "backpressure_rejects": mon.backpressure_rejects,
