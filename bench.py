@@ -355,6 +355,57 @@ def main():
             "within_2pct": guard_pct < 2.0,
         }
 
+    def bench_rpc_telemetry_overhead():
+        """Control-plane flight-recorder cost (ISSUE 14 acceptance):
+        the same submit+execute microbench with the per-method RPC
+        telemetry (rpc.py RpcTelemetry — server queue/exec reservoirs,
+        client notes, byte accounting) ON vs OFF, interleaved best-of
+        like the task/object rows (this shared box drifts more between
+        back-to-back blocks than the recorder costs). Toggling the
+        module flag flips every note path in THIS process (driver +
+        in-process head); worker-side recording stays on in both runs,
+        so the delta isolates the owner-side submit/dispatch path the
+        <2% gate protects. Batching makes this cheap by construction:
+        one client note per PushTasks batch, never per task."""
+        from ray_tpu._private import rpc as rpc_mod
+
+        tel = rpc_mod.telemetry
+        orig = tel.enabled
+        on_rates, off_rates = [], []
+        try:
+            bench_tasks_async()  # warm
+            for _ in range(6):
+                tel.enabled = True
+                t0 = time.perf_counter()
+                k = bench_tasks_async()
+                on_rates.append(k / (time.perf_counter() - t0))
+                tel.enabled = False
+                t0 = time.perf_counter()
+                k = bench_tasks_async()
+                off_rates.append(k / (time.perf_counter() - t0))
+        finally:
+            tel.enabled = orig
+        on_rate, off_rate = max(on_rates), max(off_rates)
+        overhead_pct = max(0.0, off_rate / on_rate - 1.0) * 100
+        # bounded-reservoir proof: 4096 notes into a 512 reservoir
+        # stay bounded with an honest drop count
+        probe = rpc_mod.RpcTelemetry()
+        probe.reservoir = 512
+        for _ in range(4096):
+            probe.note_server("BenchProbe", 0.0, 0.001, 0, False)
+        d = probe.snapshot()["server"]["BenchProbe"]
+        return {
+            "telemetry_on_tasks_per_s": round(on_rate, 1),
+            "telemetry_off_tasks_per_s": round(off_rate, 1),
+            "submit_overhead_pct": round(overhead_pct, 2),
+            "within_2pct": overhead_pct < 2.0,
+            "reservoir_capacity": 512,
+            "reservoir_samples_after_4096": d["exec"]["count"],
+            "reservoir_dropped": d["dropped_samples"],
+            "reservoir_bounded": d["exec"]["count"] == 512 and
+                d["dropped_samples"] == 3584,
+        }
+
     def bench_memory_monitor_overhead():
         """Memory-watchdog cost (ISSUE 10 acceptance, same pattern as
         faultpoints_overhead): the watchdog rides the raylet heartbeat
@@ -494,6 +545,11 @@ def main():
         faultpoints_row = bench_faultpoints_overhead()
     except Exception as e:  # noqa: BLE001 — secondary row
         faultpoints_row = {"error": str(e)}
+    _trace("rpc_telemetry_overhead")
+    try:
+        rpc_telemetry_row = bench_rpc_telemetry_overhead()
+    except Exception as e:  # noqa: BLE001 — secondary row
+        rpc_telemetry_row = {"error": str(e)}
     _trace("memory_monitor_overhead")
     try:
         memory_monitor_row = bench_memory_monitor_overhead()
@@ -661,6 +717,7 @@ def main():
             "task_events_overhead": task_events_row,
             "object_events_overhead": object_events_row,
             "faultpoints_overhead": faultpoints_row,
+            "rpc_telemetry_overhead": rpc_telemetry_row,
             "memory_monitor_overhead": memory_monitor_row,
             "worker_spawn": worker_spawn_row,
             "cross_node_transfer": xnode_row,

@@ -285,6 +285,51 @@ class RayTpuConfig:
     # owner cannot be judged (probe unsupported / transient error),
     # are never touched.
     leak_sweep_interval_s: float = 5.0
+    # Per-method RPC telemetry (rpc.py RpcTelemetry): the control-plane
+    # flight recorder. ON by default — server side records exec-time
+    # percentiles, queueing delay (frame arrival -> handler start),
+    # bytes in/out, in-flight and error counts per method; client side
+    # records per-method call latency, timeout/redial counts and push
+    # bytes; the loop-lag probe rides the existing periodic loops. All
+    # bounded and drop-counted; surfaced by ray_tpu.state.list_rpc() /
+    # summary_rpc(), /api/rpc, Prometheus per-method histograms, and
+    # timeline() cat="rpc" slices. bench.py's rpc_telemetry_overhead
+    # row pins the submit-path cost under 2%. Off = no recording at
+    # all (the note paths are one bool check).
+    rpc_telemetry_enabled: bool = True
+    # Bounded per-(side, method) latency reservoir size (samples, not
+    # bytes). Reservoirs drop OLDEST when full — percentiles are
+    # recency-biased by design — and the drop count is reported
+    # honestly (count - samples) in every snapshot.
+    rpc_telemetry_reservoir: int = 512
+    # Width (seconds) of the rotating max window behind every reported
+    # max_ms (RPC telemetry AND the legacy rpc_handlers block): the max
+    # covers the worst of the last one-to-two windows, so dashboards
+    # reflect recent behavior instead of an all-time high-water mark
+    # from a cold start a week ago.
+    rpc_stats_window_s: float = 60.0
+    # Slow-callback / slow-call threshold (milliseconds), the
+    # instrumented-io-context analog: an RPC handler exceeding it logs
+    # a WARNING naming the handler and counts into slow_callbacks; a
+    # loop-lag probe sample exceeding it logs the loop occupancy; and
+    # any server/client call above it becomes a bounded slow-call
+    # record that timeline() renders as a cat="rpc" slice on the same
+    # wall clock as tasks/objects/pulls.
+    loop_slow_callback_threshold_ms: float = 200.0
+    # Per-process cluster-event buffer capacity (events, not bytes):
+    # EventEmitter emissions (node/worker death, OOM kills, leak
+    # reclaims, credit revokes, backpressure engage/clear, zygote
+    # fallbacks...) buffer here and ship piggybacked on the heartbeat
+    # (raylets) or the metrics-report loop (workers/drivers). When
+    # full, NEW events are dropped and counted — the hot path never
+    # blocks on observability.
+    cluster_event_buffer_size: int = 4096
+    # GCS ClusterEventTable cap: beyond it the OLDEST events are
+    # evicted and the eviction is COUNTED (GetClusterEvents summary) —
+    # a truncated event feed always reports as truncated. Events carry
+    # a GCS-assigned monotonic seq, so ordering survives reporter
+    # clock skew.
+    cluster_events_max: int = 10_000
     # Cluster-KV span cap for util/tracing.py exports: beyond this many
     # stored spans the GCS evicts the OLDEST whole trace (and counts
     # the drop in the __rtpu_trace_dropped__ KV key /

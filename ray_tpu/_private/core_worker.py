@@ -314,6 +314,20 @@ class CoreWorker:
             config.object_events_buffer_size,
             enabled=config.object_events_enabled)
         self.reference_counter.events = self.object_events
+        # Cluster-event plane (events.py): this process's emitter feeds
+        # a bounded buffer flushed on the metrics-report cadence
+        # (AddClusterEvents) — driver/worker-side structured events
+        # reach the GCS table without their own RPC.
+        from ray_tpu._private.events import ClusterEventBuffer, EventEmitter
+        self.cluster_events = ClusterEventBuffer(
+            getattr(config, "cluster_event_buffer_size", 4096))
+        self.events = EventEmitter(
+            mode, os.path.join(session_dir, "logs")
+            if config.event_log_enabled else None,
+            buffer=self.cluster_events)
+        # Control-plane flight recorder config for this process
+        # (per-method RPC telemetry + loop-lag probe, rpc.py).
+        rpc.telemetry.configure(config)
         self._task_events: List[dict] = []
         self._profile_flush_task = None
         self._metrics_report_task = None
@@ -380,6 +394,12 @@ class CoreWorker:
         if self.config.profiling_enabled:
             self._profile_flush_task = self.loop.create_task(
                 self._profile_flush_loop())
+        # Claim the process's shipper role BEFORE the first report
+        # period elapses: an in-process raylet's early heartbeats would
+        # otherwise ship the shared process telemetry/registry under a
+        # second (node-) reporter id for the first period.
+        from ray_tpu._private import metrics as metrics_mod
+        metrics_mod.mark_core_reporter()
         self._metrics_report_task = self.loop.create_task(
             self._metrics_report_loop())
 
@@ -413,6 +433,12 @@ class CoreWorker:
                                        timeout=2)
             except Exception:  # noqa: BLE001 — shutdown must reach MarkJobFinished
                 logger.debug("object-event flush at shutdown failed",
+                             exc_info=True)
+            try:
+                await asyncio.wait_for(self._flush_cluster_events(),
+                                       timeout=2)
+            except Exception:  # noqa: BLE001 — shutdown must reach MarkJobFinished
+                logger.debug("cluster-event flush at shutdown failed",
                              exc_info=True)
         if self.mode == "driver" and self.gcs_conn and not self.gcs_conn.closed:
             try:
@@ -2671,15 +2697,67 @@ class CoreWorker:
         metrics_mod.mark_core_reporter()
         while not self._shutdown:
             await asyncio.sleep(period)
+            # loop-lag probe rides this existing cadence (the
+            # instrumented_io_context tick for worker/driver loops)
+            rpc.telemetry.loop_probe("core").tick()
             snap = metrics_mod.global_registry().snapshot()
+            if rpc.telemetry.enabled:
+                # per-method RPC latency histograms merge into the same
+                # registry shipment (real Prometheus histograms on the
+                # GCS endpoint, no new transport)
+                snap.update(rpc.telemetry.prom_snapshot())
             if snap:
                 try:
                     await self._gcs_call("ReportMetrics", {
                         "reporter_id": reporter, "snapshot": snap})
                 except (ConnectionError, asyncio.TimeoutError):
                     pass  # GCS restarting; next period retries
+            await self._flush_rpc_telemetry(reporter)
             await self._flush_task_events()
             await self._flush_object_events()
+            await self._flush_cluster_events()
+
+    async def _flush_rpc_telemetry(self, reporter: str):
+        """Ship this process's flight-recorder snapshot + drained slow
+        calls (claiming the process's reporter role — an in-process
+        raylet skips its heartbeat copy via metrics.core_reporter, the
+        same single-shipper rule the metric registry uses)."""
+        if not rpc.telemetry.enabled:
+            return
+        slow, dropped = rpc.telemetry.drain_slow_calls()
+        try:
+            await self._gcs_call(
+                "ReportRpcTelemetry",
+                protocol.ReportRpcTelemetryRequest(
+                    reporter_id=reporter,
+                    snapshot=rpc.telemetry.wire(probe="core"),
+                    slow_calls=slow,
+                    slow_calls_dropped=dropped).to_header())
+        except (ConnectionError, asyncio.TimeoutError):
+            pass  # GCS restarting; gauges re-ship next period
+        except Exception:  # noqa: BLE001
+            # a not-yet-upgraded GCS without the handler (rolling
+            # upgrade): the wire error must not kill the metrics loop
+            logger.debug("ReportRpcTelemetry flush failed", exc_info=True)
+
+    async def _flush_cluster_events(self):
+        """Drain the cluster-event buffer to the GCS event table (same
+        contract as _flush_task_events: bounded batch, a flush lost to
+        a restarting GCS is bounded loss by design)."""
+        events, dropped = self.cluster_events.drain()
+        if not events and not dropped:
+            return
+        try:
+            await self._gcs_call(
+                "AddClusterEvents",
+                protocol.AddClusterEventsRequest(
+                    events=events, dropped=dropped).to_header())
+        except (ConnectionError, asyncio.TimeoutError):
+            pass  # GCS restarting; bounded loss
+        except Exception:  # noqa: BLE001
+            # a not-yet-upgraded GCS without the AddClusterEvents
+            # handler must not kill the metrics-report loop
+            logger.debug("AddClusterEvents flush failed", exc_info=True)
 
     async def _flush_object_events(self):
         """Drain the object-event buffer to the GCS object table (same

@@ -1,11 +1,35 @@
-"""Structured event framework: JSON event lines with severity + labels.
+"""Structured event framework: JSON event lines with severity + labels,
+and the CLUSTER EVENT PLANE they flow into.
 
 Parity target: the reference's event framework (reference:
 src/ray/util/event.h:40 RAY_EVENT macro, EventManager :97,
 LogEventReporter :62 — structured JSON events appended to per-
-component files under the session log dir). Each process gets one
-emitter; events also flow to the GCS cluster-event table so
-``ray_tpu.state``/dashboards see them without scraping files.
+component files under the session log dir, aggregated by the
+dashboard's event module). Each process gets one emitter; events ALSO
+flow to the GCS :class:`ClusterEventTable` so ``ray_tpu.state.
+list_cluster_events()`` / ``/api/events`` / the status page see them
+without scraping files:
+
+* :class:`EventEmitter` — per-process file emitter; when given a
+  ``buffer``, every emit also lands in that bounded
+  :class:`ClusterEventBuffer`.
+* :class:`ClusterEventBuffer` — bounded per-process buffer with the
+  series' honest-truncation contract (drop-newest + monotonic drop
+  counter, GIL-atomic popleft drain — same shape as TaskEventBuffer).
+  Drained piggybacked on the existing shipping cadences: raylets on
+  the heartbeat (``cluster_events`` header keys), workers/drivers on
+  the metrics-report loop (``AddClusterEvents``). Never its own RPC.
+* :class:`ClusterEventTable` — the GCS-side capped, eviction-counted,
+  queryable table (filters: severity / label / source / node). Each
+  event gets a GCS-assigned monotonic ``seq`` at ingest so "what
+  happened in what order" reads straight off the list even when
+  reporter wall clocks disagree.
+
+Event catalogue (labels emitted by the runtime itself): NODE_DIED,
+GCS_RESTARTED, RAYLET_STARTED, WORKER_DIED, WORKER_OOM_KILLED,
+MEMORY_PRESSURE / MEMORY_PRESSURE_CLEARED (lease backpressure
+engage/clear, reject counts attached), LEASE_CREDITS_REVOKED (memory-
+pressure window zeroing), ZYGOTE_FALLBACK, OBJECT_LEAK_RECLAIMED.
 """
 
 from __future__ import annotations
@@ -14,16 +38,136 @@ import json
 import os
 import threading
 import time
-from typing import Any, Dict, Optional
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.task_events import TaskEventBuffer
 
 SEVERITIES = ("DEBUG", "INFO", "WARNING", "ERROR", "FATAL")
 
 
-class EventEmitter:
-    """Appends JSON event lines to ``<log_dir>/events/event_<source>.log``."""
+class ClusterEventBuffer(TaskEventBuffer):
+    """Bounded per-process cluster-event buffer (the shipping half of
+    the plane). Inherits the TaskEventBuffer contract — capacity
+    check + GIL-atomic deque append, drop-newest with a MONOTONIC
+    counter, popleft drain reporting the drop DELTA since the last
+    drain — but stores ready wire dicts (events are structured at
+    emit time), so ``add``/``drain`` replace the tuple-shaped
+    ``record``/``drain_wire``."""
 
-    def __init__(self, source: str, log_dir: Optional[str] = None):
+    __slots__ = ()
+
+    def __init__(self, capacity: int = 4096, enabled: bool = True):
+        super().__init__(capacity, enabled)
+
+    def add(self, event: dict) -> None:
+        if not self.enabled:
+            return
+        if len(self._buf) >= self.capacity:
+            self.dropped += 1
+            return
+        self._buf.append(event)
+
+    def drain(self) -> Tuple[List[dict], int]:
+        return self._drain_raw()
+
+
+class ClusterEventTable:
+    """GCS-side cluster-event table — the queryable plane the
+    ``_private/events.py`` docstring always promised. Capped with
+    COUNTED eviction (oldest first; a truncated view always reports as
+    truncated), aggregating reporter-side buffer drops the same way the
+    task/object tables do. Every ingested event gets a monotonic
+    ``seq`` so ordering is total and stable under equal timestamps."""
+
+    def __init__(self, capacity: int = 10_000):
+        self.capacity = max(1, int(capacity))
+        self._events: "deque[dict]" = deque()
+        self._seq = 0
+        self.evicted = 0
+        self.dropped_reporter_events = 0
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    def add(self, event: dict) -> None:
+        self._seq += 1
+        event = dict(event)
+        event["seq"] = self._seq
+        if len(self._events) >= self.capacity:
+            self._events.popleft()
+            self.evicted += 1
+        self._events.append(event)
+
+    def ingest(self, events, dropped: int = 0) -> None:
+        """Fold one reporter batch in (heartbeat piggyback or
+        AddClusterEvents)."""
+        self.dropped_reporter_events += int(dropped or 0)
+        for ev in events:
+            if isinstance(ev, dict):
+                self.add(ev)
+
+    @staticmethod
+    def _node_of(ev: dict) -> str:
+        node = ev.get("node") or \
+            (ev.get("custom_fields") or {}).get("node") or ""
+        return str(node)
+
+    def list(self, severity: Optional[str] = None,
+             label: Optional[str] = None,
+             source: Optional[str] = None,
+             node: Optional[str] = None,
+             limit: int = 1000) -> List[dict]:
+        """Filtered tail, ingest-ordered (``seq`` ascending). Filters:
+        ``severity`` exact, ``label`` substring, ``source`` exact,
+        ``node`` node-id-hex prefix. ``limit`` <= 0 returns nothing
+        (same no-alias contract as the task/object tables)."""
+        try:
+            limit = int(limit if limit is not None else 0)
+        except (TypeError, ValueError):
+            limit = 0
+        if limit <= 0:
+            return []
+        out = []
+        for ev in self._events:
+            if severity and ev.get("severity") != severity:
+                continue
+            if label and label not in (ev.get("label") or ""):
+                continue
+            if source and ev.get("source_type") != source:
+                continue
+            if node and not self._node_of(ev).startswith(node):
+                continue
+            out.append(ev)
+        return out[-limit:]
+
+    def summary(self) -> dict:
+        by_severity: Dict[str, int] = {}
+        by_label: Dict[str, int] = {}
+        for ev in self._events:
+            sv = ev.get("severity") or "?"
+            by_severity[sv] = by_severity.get(sv, 0) + 1
+            lb = ev.get("label") or "?"
+            by_label[lb] = by_label.get(lb, 0) + 1
+        return {
+            "num_events": len(self._events),
+            "by_severity": by_severity,
+            "by_label": by_label,
+            "evicted": self.evicted,
+            "dropped_reporter_events": self.dropped_reporter_events,
+        }
+
+
+class EventEmitter:
+    """Appends JSON event lines to ``<log_dir>/events/event_<source>.log``
+    and, when constructed with a ``buffer``, feeds every event into the
+    cluster-event plane (shipped to the GCS on the process's existing
+    reporting cadence)."""
+
+    def __init__(self, source: str, log_dir: Optional[str] = None,
+                 buffer: Optional[ClusterEventBuffer] = None):
         self.source = source
+        self.buffer = buffer
         self._lock = threading.Lock()
         self._file = None
         if log_dir:
@@ -47,6 +191,8 @@ class EventEmitter:
             "pid": os.getpid(),
             "custom_fields": fields,
         }
+        if self.buffer is not None:
+            self.buffer.add(event)
         if self._path is not None:
             line = json.dumps(event) + "\n"
             with self._lock:

@@ -18,11 +18,13 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import os
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import faultpoints, protocol, rpc
 from ray_tpu._private.config import RayTpuConfig
+from ray_tpu._private.events import ClusterEventTable
 from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID
 from ray_tpu._private.object_events import ObjectTable
 from ray_tpu._private.task_events import TaskEventTable
@@ -70,6 +72,7 @@ _STATUS_PAGE = b"""<!doctype html>
 <h2>Tasks</h2><table id="tasks"></table>
 <h2>Jobs</h2><table id="jobs"></table>
 <h2>Placement groups</h2><table id="pgs"></table>
+<h2>RPC methods (cluster-wide)</h2><table id="rpc"></table>
 <h2>Recent events</h2><table id="events"></table>
 <script>
 function row(tr, cells, tag) {
@@ -153,11 +156,19 @@ async function tick() {
     fill('pgs', ['pg_id','name','strategy','state','bundles'],
       pgs.map(function(p){ return [p.pg_id.slice(0,12), p.name||'',
         p.strategy, p.state, p.bundles]; }));
+    var rpc = await j('/api/rpc');
+    var meths = Object.keys(rpc.summary).sort();
+    fill('rpc', ['method','count','errors','inflight','max_ms',
+                 'exec_p99_ms','queue_p99_ms','mb_in','mb_out'],
+      meths.map(function(m){ var d = rpc.summary[m];
+        return [m, d.count, d.errors, d.inflight, d.max_ms,
+          d.exec_p99_ms, d.queue_p99_ms, mb(d.bytes_in),
+          mb(d.bytes_out)]; }));
     var evs = await j('/api/events');
-    fill('events', ['time','severity','source','message'],
-      evs.slice(-25).reverse().map(function(e){ return [
-        new Date(e.timestamp*1000).toLocaleTimeString(),
-        e.severity, e.source_type, e.message]; }));
+    fill('events', ['seq','time','severity','label','source','message'],
+      (evs.events||[]).slice(-25).reverse().map(function(e){ return [
+        e.seq, new Date(e.timestamp*1000).toLocaleTimeString(),
+        e.severity, e.label, e.source_type, e.message]; }));
     document.getElementById('ts').textContent =
       '- ' + new Date().toLocaleTimeString();
     document.getElementById('err').textContent = '';
@@ -229,7 +240,21 @@ class GcsServer:
         self._node_rr = 0
         self._monitor_task: Optional[asyncio.Task] = None
         self._profile_events: List[dict] = []
-        self._cluster_events: List[dict] = []
+        # Cluster-event plane (events.py): the capped, eviction-counted
+        # queryable table behind state.list_cluster_events() and
+        # /api/events. Fed by heartbeat piggybacks (raylets),
+        # AddClusterEvents batches (workers/drivers) and the GCS's own
+        # emissions (node death, restarts).
+        self.cluster_events = ClusterEventTable(
+            getattr(config, "cluster_events_max", 10_000))
+        # Per-reporter RPC telemetry (rpc.py flight recorder): raylets
+        # ship on the heartbeat, workers/drivers via
+        # ReportRpcTelemetry; read by state.list_rpc()/summary_rpc(),
+        # /api/rpc and timeline()'s cat="rpc" slices.
+        self.rpc_telemetry = rpc.RpcTelemetryTable()
+        # Process-wide telemetry config (shared module state: an
+        # in-process head shares it with the raylet/driver anyway).
+        rpc.telemetry.configure(config)
         # Optional append-only journal (reference: GcsTableStorage +
         # GcsInitData reload) — enabled via config.gcs_journal_path.
         self.journal = None
@@ -299,7 +324,10 @@ class GcsServer:
             "GetObjectEvents": self.handle_get_object_events,
             "GetObjectSummary": self.handle_get_object_summary,
             "AddClusterEvent": self.handle_add_cluster_event,
+            "AddClusterEvents": self.handle_add_cluster_events,
             "GetClusterEvents": self.handle_get_cluster_events,
+            "ReportRpcTelemetry": self.handle_report_rpc_telemetry,
+            "GetRpcTelemetry": self.handle_get_rpc_telemetry,
             "ReportMetrics": self.handle_report_metrics,
             "GetNodeStatsSummary": self.handle_get_node_stats_summary,
         }
@@ -307,12 +335,20 @@ class GcsServer:
     async def start(self, address: str = "") -> str:
         journal_path = getattr(self.config, "gcs_journal_path", "")
         if journal_path:
-            self._replay_journal(journal_path)
+            replayed = self._replay_journal(journal_path)
             from ray_tpu._private.gcs_storage import GcsJournal
             self.journal = GcsJournal(journal_path)
             # Boot-time compaction: replaying history once is enough —
             # snapshot the rebuilt tables so the next restart is O(state).
             self._compact_journal()
+            if replayed:
+                # a non-empty replay means this GCS came back from a
+                # previous incarnation: record the restart in the (new,
+                # in-memory — bounded loss by design) event table
+                self._emit_cluster_event(
+                    "WARNING", "GCS_RESTARTED",
+                    f"GCS restarted: replayed {replayed} journal "
+                    f"records", replayed_records=replayed)
         addr = await self._server.listen(address)
         self._monitor_task = asyncio.get_running_loop().create_task(
             self._liveness_monitor())
@@ -535,9 +571,37 @@ class GcsServer:
         if route == "/api/metrics":
             return dump(self._merged_metrics())
         if route == "/api/events":
-            # last 200 structured cluster events (reference: the
-            # dashboard's event module over event_*.log aggregation)
-            return dump(self._cluster_events[-200:])
+            # structured cluster events off the capped table (the
+            # dashboard's event module analog), filterable like
+            # state.list_cluster_events()
+            try:
+                limit = int(params.get("limit", "200"))
+            except ValueError:
+                limit = 200
+            return dump({
+                "events": self.cluster_events.list(
+                    severity=params.get("severity"),
+                    label=params.get("label"),
+                    source=params.get("source"),
+                    node=params.get("node"),
+                    limit=limit),
+                "summary": self.cluster_events.summary(),
+            })
+        if route == "/api/rpc":
+            # the control-plane flight recorder: per-(reporter, side,
+            # method) rows + cluster-wide per-method aggregate + the
+            # slow-call ring
+            self._rpc_telemetry_self_row()
+            t = self.rpc_telemetry
+            return dump({
+                "rpc": t.rows(method=params.get("method"),
+                              reporter=params.get("reporter"),
+                              side=params.get("side")),
+                "summary": t.summary(),
+                "loops": t.loops(),
+                "slow_calls": list(t.slow_calls)[-200:],
+                "slow_calls_dropped": t.slow_dropped,
+            })
         return (json.dumps({"error": f"unknown route {route!r}"}).encode(),
                 b"404 Not Found")
 
@@ -604,6 +668,16 @@ class GcsServer:
              "Store-held objects whose owner holds no reference"),
             ("leak_reclaims", "ray_tpu_objects_leak_reclaims_total",
              "Leaked objects reclaimed by the sweep"),
+            # instrumented-event-loop truth (rpc.py _LoopProbe): lag a
+            # READY callback waits on each node's raylet loop
+            ("loop_lag_p50_ms", "ray_tpu_loop_lag_p50_ms",
+             "Event-loop scheduling delay p50 (ms)"),
+            ("loop_lag_p99_ms", "ray_tpu_loop_lag_p99_ms",
+             "Event-loop scheduling delay p99 (ms)"),
+            ("loop_lag_max_ms", "ray_tpu_loop_lag_max_ms",
+             "Event-loop scheduling delay windowed max (ms)"),
+            ("loop_slow_callbacks", "ray_tpu_loop_slow_callbacks_total",
+             "Handlers/callbacks over loop_slow_callback_threshold_ms"),
             # host stats collected by the raylet via psutil (reference:
             # reporter_agent.py:126)
             ("host_cpu_percent", "ray_tpu_node_cpu_percent",
@@ -637,8 +711,22 @@ class GcsServer:
                     if ts < cutoff]:
             del self._metric_snapshots[key]
         snaps = [s for _, s in self._metric_snapshots.values()]
+        if not metrics_mod.core_reporter():
+            # standalone GCS process: no CoreWorker ships this
+            # process's registry or RPC histograms — merge its own
+            # per-method latency histograms here (an in-process head's
+            # driver ships the shared snapshot under its reporter id)
+            snaps = snaps + [rpc.telemetry.prom_snapshot()]
         merged = metrics_mod.merge_snapshots(snaps)
         merged.update(self._builtin_metrics())
+        # the GCS process's own loop lag (per-node raylet lag rides the
+        # heartbeat stats -> node gauges above)
+        lp = rpc.telemetry.loop_probe("gcs").snapshot()
+        merged["ray_tpu_gcs_loop_lag_p99_ms"] = {
+            "kind": "gauge",
+            "description": "GCS event-loop scheduling delay p99 (ms)",
+            "boundaries": [],
+            "values": [[[], float(lp["lag"].get("p99_ms", 0.0))]]}
         return merged
 
     def _render_metrics(self) -> str:
@@ -806,6 +894,7 @@ class GcsServer:
             logger.info("GCS journal replay: %d records -> %d jobs, "
                         "%d actors, %d kv keys", n, len(self.jobs),
                         len(self.actors), len(self.kv))
+        return n
 
     # --------------------------------------------------------------- pubsub
 
@@ -902,6 +991,21 @@ class GcsServer:
             self.object_events.ingest(
                 req.get("object_events") or (),
                 req.get("object_events_dropped", 0))
+        # Cluster-event piggybacks (events.py plane): the raylet's
+        # emitter buffer rides the beat — ingest before any early
+        # return, same honest-truncation contract as task events.
+        if header.get("cluster_events") or \
+                header.get("cluster_events_dropped"):
+            self.cluster_events.ingest(
+                header.get("cluster_events") or (),
+                header.get("cluster_events_dropped", 0))
+        # RPC-telemetry piggyback (rpc.py flight recorder): standalone
+        # raylet processes ship their per-method stats here (an
+        # in-process head's CoreWorker ships via ReportRpcTelemetry).
+        if header.get("rpc_telemetry"):
+            self.rpc_telemetry.ingest(
+                f"node-{req.node_id.hex()[:12]}",
+                header.get("rpc_telemetry"))
         entry = self.nodes.get(req.node_id)
         if entry is None:
             return protocol.HeartbeatReply(
@@ -982,6 +1086,13 @@ class GcsServer:
         entry.alive = False
         log = logger.info if reason == "drained" else logger.warning
         log("node %s marked dead: %s", node_id.hex()[:8], reason)
+        # node death is a first-class cluster event: ordered (GCS seq),
+        # queryable via state.list_cluster_events() — the SIGKILLed-
+        # raylet acceptance reads exactly this record
+        self._emit_cluster_event(
+            "INFO" if reason == "drained" else "ERROR", "NODE_DIED",
+            f"node {node_id.hex()[:12]} marked dead: {reason}",
+            node=node_id.hex()[:12], reason=reason)
         await self._publish("NODE", {"event": "dead", "node_id": node_id,
                                      "reason": reason})
         # Actors on the dead node die / restart (reference:
@@ -998,6 +1109,10 @@ class GcsServer:
         timeout = period * self.config.num_heartbeats_timeout
         while True:
             await asyncio.sleep(period)
+            # loop-lag probe rides this existing cadence (no new
+            # thread/timer): the GCS loop's scheduling delay is the
+            # one every handler on this process pays
+            rpc.telemetry.loop_probe("gcs").tick()
             now = time.time()
             for node in list(self.nodes.values()):
                 if node.alive and now - node.last_heartbeat > timeout:
@@ -1598,10 +1713,83 @@ class GcsServer:
         return {"events": self._profile_events}
 
     async def handle_add_cluster_event(self, conn, header, bufs):
-        self._cluster_events.append(header["event"])
-        if len(self._cluster_events) > 10_000:
-            self._cluster_events = self._cluster_events[-5_000:]
+        """Single-event compat shim (pre-flight-recorder reporters);
+        batched reporters use AddClusterEvents."""
+        self.cluster_events.add(header["event"])
+        return {"ok": True}
+
+    async def handle_add_cluster_events(self, conn, header, bufs):
+        """One reporter's batch of cluster events (workers/drivers
+        flush on the metrics-report cadence; raylets ride the heartbeat
+        instead — see handle_heartbeat)."""
+        self.cluster_events.ingest(header.get("events") or (),
+                                   header.get("dropped", 0))
         return {"ok": True}
 
     async def handle_get_cluster_events(self, conn, header, bufs):
-        return {"events": self._cluster_events}
+        """Filterable cluster-event feed for state.list_cluster_events()
+        / /api/events, with the honest truncation summary."""
+        return {
+            "events": self.cluster_events.list(
+                severity=header.get("severity"),
+                label=header.get("label"),
+                source=header.get("source"),
+                node=header.get("node"),
+                limit=header.get("limit", 1000)),
+            "summary": self.cluster_events.summary(),
+        }
+
+    def _emit_cluster_event(self, severity: str, label: str,
+                            message: str, **fields) -> None:
+        """GCS-local emission straight into the table (node death, GCS
+        restarts — control-plane truths only the GCS witnesses)."""
+        self.cluster_events.add({
+            "timestamp": time.time(), "severity": severity,
+            "label": label, "message": message, "source_type": "gcs",
+            "pid": os.getpid(), "custom_fields": fields,
+        })
+
+    # ------------------------------------------------------ rpc telemetry
+
+    async def handle_report_rpc_telemetry(self, conn, header, bufs):
+        """One reporter's RPC-telemetry payload (workers/drivers on the
+        metrics-report cadence; raylets piggyback on the heartbeat —
+        see handle_heartbeat)."""
+        self.rpc_telemetry.ingest(header["reporter_id"],
+                                  {"snapshot": header.get("snapshot"),
+                                   "slow_calls": header.get("slow_calls"),
+                                   "slow_calls_dropped":
+                                       header.get("slow_calls_dropped", 0)})
+        return {"ok": True}
+
+    def _rpc_telemetry_self_row(self) -> None:
+        """Fold this GCS process's OWN telemetry in at read time. An
+        in-process head skips it: the driver CoreWorker ships the
+        (shared, process-wide) snapshot under its reporter id already —
+        two rows would double every count (same rule as
+        metrics.core_reporter)."""
+        from ray_tpu._private import metrics as metrics_mod
+
+        if metrics_mod.core_reporter():
+            return
+        slow, dropped = rpc.telemetry.drain_slow_calls()
+        self.rpc_telemetry.ingest("gcs", {
+            "snapshot": rpc.telemetry.wire(probe="gcs"),
+            "slow_calls": slow, "slow_calls_dropped": dropped})
+
+    async def handle_get_rpc_telemetry(self, conn, header, bufs):
+        """Queryable per-method RPC telemetry for state.list_rpc() /
+        summary_rpc() / timeline(): flat per-(reporter, side, method)
+        rows, per-reporter loop-lag blocks, and the bounded slow-call
+        ring (drained into cat="rpc" timeline slices)."""
+        self._rpc_telemetry_self_row()
+        t = self.rpc_telemetry
+        return {
+            "rows": t.rows(method=header.get("method"),
+                           reporter=header.get("reporter"),
+                           side=header.get("side")),
+            "summary": t.summary(),
+            "loops": t.loops(),
+            "slow_calls": list(t.slow_calls),
+            "slow_calls_dropped": t.slow_dropped,
+        }

@@ -64,13 +64,18 @@ MIN_PROTOCOL_VERSION = 1
 # migrating the call sites the protocol-stub rule then flags is the
 # whole mechanical migration recipe.
 GENERATE = (
+    "AddClusterEvents",
     "AddObjectEvents",
     "AddTaskEvents",
+    "GetClusterEvents",
+    "GetNodeStats",
     "GetObjectSummary",
+    "GetRpcTelemetry",
     "GrantLeaseCredits",
     "Heartbeat",
     "RegisterNode",
     "ReportLeaseDemand",
+    "ReportRpcTelemetry",
     "RequestWorkerLease",
     "ReturnWorker",
     "RevokeLeaseCredits",

@@ -177,11 +177,21 @@ class Raylet:
             spilling_enabled=config.object_spilling_enabled,
             external_storage_url=config.spill_external_storage_url)
 
-        # Structured event log (reference: util/event.h RAY_EVENT)
-        from ray_tpu._private.events import EventEmitter
+        # Structured event log (reference: util/event.h RAY_EVENT).
+        # Emissions ALSO land in the bounded cluster-event buffer and
+        # ride the heartbeat into the GCS ClusterEventTable — the
+        # queryable event plane (events.py); the file tier alone is
+        # gated by event_log_enabled.
+        from ray_tpu._private.events import ClusterEventBuffer, EventEmitter
+        self.cluster_events = ClusterEventBuffer(
+            getattr(config, "cluster_event_buffer_size", 4096))
         self.events = EventEmitter(
             "raylet", os.path.join(session_dir, "logs")
-            if config.event_log_enabled else None)
+            if config.event_log_enabled else None,
+            buffer=self.cluster_events)
+        # Control-plane flight recorder (rpc.py): per-method telemetry
+        # + loop-lag probe config for this process.
+        rpc.telemetry.configure(config)
 
         self.workers: Dict[bytes, WorkerHandle] = {}
         self.leases: Dict[int, LeaseEntry] = {}
@@ -569,13 +579,25 @@ class Raylet:
             # raylint: disable=exception-hygiene — host stats are best-effort decoration
             except Exception:
                 pass
-        # NOTE: latency percentiles are deliberately NOT computed here —
-        # sorting a 64k reservoir 4x/s on the event loop would stall
-        # heartbeats under load; GetNodeStats computes them on demand.
-        # Per-handler RPC latency (C4 instrumented-asio parity) IS
-        # carried: the snapshot is a dozen small dict entries.
-        from ray_tpu._private.rpc import handler_stats
+        # NOTE: scheduler latency percentiles are deliberately NOT
+        # computed here — sorting a 64k reservoir 4x/s on the event
+        # loop would stall heartbeats under load; GetNodeStats computes
+        # them on demand. Per-handler RPC latency (C4 instrumented-asio
+        # parity) IS carried: the snapshot is a dozen small dict
+        # entries, and the loop-lag flat keys below feed the per-node
+        # Prometheus gauges (the RPC reservoirs ship separately in the
+        # throttled rpc_telemetry beat key).
+        from ray_tpu._private.rpc import handler_stats, telemetry
         out["rpc_handlers"] = handler_stats.snapshot()
+        # this raylet loop's OWN probe (named: an in-process head's
+        # driver loop stalls must never read as this node's lag)
+        lp = telemetry.loop_probe("raylet").snapshot()
+        lag = lp.get("lag") or {}
+        out["loop_lag_p50_ms"] = lag.get("p50_ms", 0.0)
+        out["loop_lag_p99_ms"] = lag.get("p99_ms", 0.0)
+        out["loop_lag_max_ms"] = lp.get("lag_max_ms", 0.0)
+        out["loop_slow_callbacks"] = lp.get("slow_callbacks", 0)
+        out["loop_ticks"] = lp.get("ticks", 0)
         return out
 
     async def _heartbeat_loop(self):
@@ -592,9 +614,34 @@ class Raylet:
                 # must degrade to a missed poll, never take down the
                 # heartbeat loop — that would convert memory pressure
                 # into the node death the watchdog exists to prevent.
+                # Loop-lag probe rides this existing cadence (the
+                # instrumented_io_context tick): one call_soon, no new
+                # thread/timer.
+                rpc.telemetry.loop_probe("raylet").tick()
                 try:
                     was_pressure = self.memory_monitor.pressure
                     self.memory_monitor.poll()
+                    if was_pressure != self.memory_monitor.pressure:
+                        # pressure transitions are cluster events (the
+                        # per-reject counter rides the stats; emitting
+                        # per reject would storm the bounded buffer)
+                        if self.memory_monitor.pressure:
+                            self.events.emit(
+                                "WARNING", "MEMORY_PRESSURE",
+                                f"memory pressure engaged at "
+                                f"{self.memory_monitor.usage_fraction:.2f}"
+                                f" usage; lease backpressure active",
+                                node=self._nid12,
+                                usage_fraction=round(
+                                    self.memory_monitor.usage_fraction,
+                                    4))
+                        else:
+                            self.events.emit(
+                                "INFO", "MEMORY_PRESSURE_CLEARED",
+                                "memory pressure cleared",
+                                node=self._nid12,
+                                backpressure_rejects=self.memory_monitor
+                                .backpressure_rejects)
                     if was_pressure and not self.memory_monitor.pressure:
                         # pressure cleared: re-evaluate whatever the
                         # backpressure window parked (PG leases stay
@@ -653,13 +700,36 @@ class Raylet:
                 if oevents or odropped:
                     beat.object_events = oevents
                     beat.object_events_dropped = odropped
+                # Cluster events (events.py plane) ride the beat too:
+                # node-local emissions (worker death, OOM kills, leak
+                # reclaims, zygote fallbacks...) reach the GCS table
+                # without their own RPC.
+                cevents, cdropped = self.cluster_events.drain()
+                if cevents or cdropped:
+                    beat.cluster_events = cevents
+                    beat.cluster_events_dropped = cdropped
                 if not metrics_mod.core_reporter():
                     # standalone raylet process (worker node / headless
                     # head): no CoreWorker ships this process's metric
-                    # registry, so the heartbeat carries it
+                    # registry, so the heartbeat carries it — with the
+                    # per-method RPC latency histograms merged in
                     snap = metrics_mod.global_registry().snapshot()
+                    if rpc.telemetry.enabled:
+                        snap.update(rpc.telemetry.prom_snapshot())
                     if snap:
                         beat.metrics = snap
+                    # full flight-recorder snapshot + drained slow
+                    # calls (an in-process head's CoreWorker ships the
+                    # shared process snapshot via ReportRpcTelemetry
+                    # instead — one reporter per process, never two)
+                    if rpc.telemetry.enabled:
+                        slow, sdropped = \
+                            rpc.telemetry.drain_slow_calls()
+                        beat.rpc_telemetry = {
+                            "snapshot": rpc.telemetry.wire(
+                                probe="raylet"),
+                            "slow_calls": slow,
+                            "slow_calls_dropped": sdropped}
                 reply, _ = await self.gcs_conn.call(
                     "Heartbeat", beat.to_header())
                 if not protocol.HeartbeatReply.from_header(reply).ok:
@@ -786,6 +856,11 @@ class Raylet:
                 self._zygote = None
                 logger.warning("zygote launch failed (%r); cold-Popen "
                                "fallback engaged", e)
+                self.events.emit(
+                    "WARNING", "ZYGOTE_FALLBACK",
+                    f"zygote launch failed ({e!r}); cold-Popen "
+                    f"fallback engaged for the session",
+                    node=self._nid12)
                 self._popen_worker(handle, worker_id.hex(), log_path)
                 return
             asyncio.get_event_loop().create_task(
@@ -930,6 +1005,10 @@ class Raylet:
             self._zygote = None
             logger.warning("zygote spawn failed (%r); cold-Popen "
                            "fallback engaged", e)
+            self.events.emit(
+                "WARNING", "ZYGOTE_FALLBACK",
+                f"zygote spawn failed ({e!r}); cold-Popen fallback "
+                f"engaged for the session", node=self._nid12)
             if zygote is not None:
                 await zygote.close()
             if self._closing or handle.state == WORKER_DEAD or \
@@ -1773,6 +1852,15 @@ class Raylet:
                 # reply and spill/back off) instead of waiting on a
                 # stream that will not flow
                 w.target = 0
+                # a pressure-driven window zeroing is a recovery action
+                # worth a cluster event (per window, beat-paced —
+                # routine stale-window resizes are not)
+                self.events.emit(
+                    "WARNING", "LEASE_CREDITS_REVOKED",
+                    f"memory pressure zeroed a credit window "
+                    f"({len(w.lease_ids)} credits outstanding)",
+                    node=self._nid12, sched_class=w.sched_class,
+                    outstanding=len(w.lease_ids))
                 try:
                     w.conn.push_nowait(
                         "GrantLeaseCredits",
@@ -2976,6 +3064,11 @@ class Raylet:
                 self.object_events.record(
                     k, LEAK_RECLAIMED,
                     {"node": self._nid12, "owner": owner})
+            self.events.emit(
+                "WARNING", "OBJECT_LEAK_RECLAIMED",
+                f"leak detector reclaimed object {oid.hex()[:16]} "
+                f"(owner {owner} held no reference)",
+                node=self._nid12, object_id=oid.hex()[:16])
 
     def object_plane_stats(self) -> dict:
         """Public object-plane snapshot — the chaos invariants assert
@@ -2995,7 +3088,7 @@ class Raylet:
     async def handle_get_node_stats(self, conn, header, bufs):
         from ray_tpu._private import native
         from ray_tpu._private.data_channel import pull_stats, serve_stats
-        from ray_tpu._private.rpc import handler_stats
+        from ray_tpu._private.rpc import handler_stats, telemetry
         return {
             "data_plane": {
                 "data_address": self.data_address,
@@ -3011,6 +3104,10 @@ class Raylet:
             },
             "schedule_latency": self._latency_percentiles(),
             "rpc_handlers": handler_stats.snapshot(),
+            # the full flight recorder: per-method server/client
+            # reservoir percentiles, queue-vs-exec split, bytes,
+            # errors, in-flight — plus THIS raylet loop's lag probe
+            "rpc": telemetry.snapshot(probe="raylet"),
             "node_id": self.node_id.binary(),
             "address": self.address,
             "resources_total": self.resources_total,

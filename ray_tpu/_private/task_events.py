@@ -170,18 +170,25 @@ class TaskEventBuffer:
         clobber a concurrent record()'s increment. The per-flush
         payload is bounded by ``capacity`` (events accumulated between
         two flush periods): the default sizes a ~1.5 MB worst case."""
+        raw, dropped = self._drain_raw(max_events)
+        key = self.WIRE_KEY
+        return [{key: t, "state": s, "ts": ts, "attrs": a}
+                for t, s, ts, a in raw], dropped
+
+    def _drain_raw(self, max_events: int = 0):
+        """-> (raw_records, dropped): the popleft + drop-delta half of
+        the drain contract, shared with subclasses whose records are
+        already wire-shaped (events.ClusterEventBuffer)."""
         buf = self._buf
         n = len(buf)
         if max_events:
             n = min(n, max_events)
         out = []
-        key = self.WIRE_KEY
         for _ in range(n):
             try:
-                t, s, ts, a = buf.popleft()
+                out.append(buf.popleft())
             except IndexError:  # raced another drainer; nothing lost
                 break
-            out.append({key: t, "state": s, "ts": ts, "attrs": a})
         total = self.dropped
         dropped = total - self._dropped_flushed
         self._dropped_flushed = total

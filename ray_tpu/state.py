@@ -80,6 +80,14 @@ def summary_nodes() -> List[dict]:
                 s.get("data_plane_inflight_bytes", 0),
             "objects_leaked": s.get("objects_leaked", 0),
             "leak_reclaims": s.get("leak_reclaims", 0),
+            # control-plane rollups (heartbeat-carried, ISSUE 14): the
+            # instrumented-event-loop truth per node — scheduling
+            # delay of a ready callback on the raylet loop, and how
+            # many handlers/callbacks crossed the slow threshold
+            "loop_lag_p50_ms": s.get("loop_lag_p50_ms", 0.0),
+            "loop_lag_p99_ms": s.get("loop_lag_p99_ms", 0.0),
+            "loop_lag_max_ms": s.get("loop_lag_max_ms", 0.0),
+            "loop_slow_callbacks": s.get("loop_slow_callbacks", 0),
         })
     return out
 
@@ -209,6 +217,94 @@ def list_objects(state: Optional[str] = None, owner: Optional[str] = None,
     return records
 
 
+def list_rpc(method: Optional[str] = None,
+             reporter: Optional[str] = None,
+             side: Optional[str] = None) -> List[dict]:
+    """Per-method RPC telemetry rows from the GCS flight-recorder table
+    (rpc.py RpcTelemetry; the instrumented-io-context analog).
+
+    One row per (reporter, side, method)::
+
+        {"reporter": "node-ab12…|driver-…|worker-…|gcs",
+         "side": "server"|"client", "method": str,
+         "count", "errors", "timeouts", "inflight",
+         "bytes_in", "bytes_out", "mean_ms", "queue_mean_ms",
+         "max_ms",                     # WINDOWED max (recent behavior)
+         "exec":  {"count","p50_ms","p90_ms","p99_ms","max_ms"},
+         "queue": {"count","p50_ms","p90_ms","p99_ms","max_ms"},
+         "dropped_samples": int}       # honest reservoir truncation
+
+    ``queue`` is frame-arrival -> handler-start (loop scheduling
+    delay), ``exec`` is handler run time — reported apart so "the loop
+    was busy" and "the handler was slow" are distinguishable. Client
+    rows carry call latency under ``exec`` plus ``timeouts`` and push
+    counts/bytes. Filters: ``method`` substring, ``reporter`` prefix,
+    ``side`` exact. Raylets ship on the heartbeat, workers/drivers on
+    the metrics cadence; reporters age out after 60 s of silence."""
+    reply = _core().gcs_call_sync(
+        "GetRpcTelemetry",
+        protocol.GetRpcTelemetryRequest(
+            method=method, reporter=reporter, side=side).to_header())
+    return reply.get("rows", [])
+
+
+def summary_rpc() -> dict:
+    """Cluster-wide per-method aggregate of the RPC telemetry,
+    computed GCS-side (rpc.py RpcTelemetryTable.summary — the same
+    block /api/rpc serves): counts/bytes/errors/in-flight from the
+    SERVER rows (one observation per call — client rows of the same
+    method would double-count it; client-only methods such as one-way
+    pushes fall back to their client rows), ``timeouts`` from the
+    client rows, percentiles from the WORST reporter of either side
+    (a "slowest node" view, since raw reservoirs never leave their
+    process) — plus per-reporter event-loop lag blocks and the bounded
+    slow-call ring's size."""
+    reply = _core().gcs_call_sync(
+        "GetRpcTelemetry",
+        protocol.GetRpcTelemetryRequest().to_header())
+    return {
+        "methods": reply.get("summary", {}),
+        "loops": reply.get("loops", {}),
+        "slow_calls": len(reply.get("slow_calls", [])),
+        "slow_calls_dropped": reply.get("slow_calls_dropped", 0),
+    }
+
+
+def list_cluster_events(severity: Optional[str] = None,
+                        label: Optional[str] = None,
+                        source: Optional[str] = None,
+                        node: Optional[str] = None,
+                        limit: int = 1000) -> List[dict]:
+    """Structured cluster events from the GCS ClusterEventTable
+    (events.py): node death, GCS restarts, worker/OOM kills, leak
+    reclaims, credit revokes, backpressure engage/clear, zygote
+    fallbacks — each with a GCS-assigned monotonic ``seq`` so ordering
+    is total even across reporter clock skew::
+
+        {"seq": int, "timestamp": float, "severity": str,
+         "label": str, "message": str, "source_type": str,
+         "pid": int, "custom_fields": {...}}
+
+    Filters: ``severity`` exact, ``label`` substring, ``source`` exact,
+    ``node`` node-id-hex prefix. The table is capped with counted
+    eviction; ``summary_cluster_events()`` reports the truncation."""
+    reply = _core().gcs_call_sync(
+        "GetClusterEvents",
+        protocol.GetClusterEventsRequest(
+            severity=severity, label=label, source=source, node=node,
+            limit=limit).to_header())
+    return reply.get("events", [])
+
+
+def summary_cluster_events() -> dict:
+    """Event counts by severity/label plus the honest truncation
+    counters (table evictions, reporter-side buffer drops)."""
+    reply = _core().gcs_call_sync(
+        "GetClusterEvents",
+        protocol.GetClusterEventsRequest(limit=1).to_header())
+    return reply.get("summary", {})
+
+
 def summary_objects() -> dict:
     """Aggregate object counts by state plus the honest loss
     accounting (per-job eviction counts, reporter drops) and the
@@ -222,7 +318,7 @@ def summary_objects() -> dict:
 
 def timeline(path: Optional[str] = None) -> List[dict]:
     """Chrome-trace export (chrome://tracing / Perfetto "trace event"
-    JSON) merging FOUR sources onto one wall clock:
+    JSON) merging FIVE sources onto one wall clock:
 
     * task state intervals from the GCS task table (one "X" slice per
       transition, lasting until the next one),
@@ -230,7 +326,13 @@ def timeline(path: Optional[str] = None) -> List[dict]:
       "object": allocation/seal, pin/borrow/pull, free — same clock as
       the tasks that produced and consumed them),
     * tracing spans exported by util/tracing.py (RAY_TPU_TRACE=1),
-    * data-plane pull/transfer intervals recorded by the raylets.
+    * data-plane pull/transfer intervals recorded by the raylets,
+    * SLOW RPC calls (cat "rpc"): every server handler or client call
+      that exceeded ``loop_slow_callback_threshold_ms``, attributed by
+      method name with its queueing vs exec split — bounded records
+      from the control-plane flight recorder (rpc.py), so a straggler
+      trace shows whether the CONTROL PLANE (not the task) was the
+      slow part.
 
     So a single trace shows submit -> lease wait -> pull -> execute
     with the objects' lifetimes underneath. Returns the event list;
@@ -293,6 +395,17 @@ def timeline(path: Optional[str] = None) -> List[dict]:
             "ts": tr.get("ts", 0.0) * 1e6,
             "dur": max(0.0, tr.get("dur", 0.0)) * 1e6,
             "pid": pid, "tid": 0, "args": dict(tr),
+        })
+    rpc_reply = _core().gcs_call_sync(
+        "GetRpcTelemetry", protocol.GetRpcTelemetryRequest().to_header())
+    for sc in rpc_reply.get("slow_calls", []):
+        pid = pid_of(f"rpc ({sc.get('reporter', '?')})")
+        events.append({
+            "name": f"{sc.get('side', '?')} {sc.get('method', '?')}",
+            "cat": "rpc", "ph": "X",
+            "ts": sc.get("ts", 0.0) * 1e6,
+            "dur": max(0.0, sc.get("dur_ms", 0.0)) * 1e3,
+            "pid": pid, "tid": 0, "args": dict(sc),
         })
     events.extend(tracing.to_chrome_trace(tracing.all_spans()))
     events.sort(key=lambda e: e.get("ts", 0))

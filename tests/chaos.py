@@ -88,7 +88,11 @@ _KIND_OPS = {
     "stripe_sever": ["sever_serve"],
     "corrupt_chunk": ["corrupt_serve"],
     "short_read": ["short_serve"],
-    "delay_storm": ["delay_fetch", "delay_serve"],
+    # delay_rpc: a sync delay INSIDE an RPC handler's task (the
+    # rpc.handler seam) — the handler shows slow exec and everything
+    # queued behind it shows queueing delay, which the flight recorder
+    # (ISSUE 14) must attribute by method name
+    "delay_storm": ["delay_fetch", "delay_serve", "delay_rpc"],
     "raylet_kill": ["kill_raylet"],
     "heartbeat_partition": ["partition"],
     "gcs_restart": ["gcs_restart"],
@@ -141,6 +145,12 @@ def make_schedule(kind: str, seed: int, rounds: int = 8,
             elif op in ("delay_fetch", "delay_serve"):
                 ev["delay_s"] = round(rng.uniform(0.01, 0.08), 3)
                 ev["times"] = rng.randrange(4, 16)
+            elif op == "delay_rpc":
+                # sync in-handler delay blocks the shared loop: keep it
+                # short and bounded (the attribution, not the stall, is
+                # what the schedule pins)
+                ev["delay_s"] = round(rng.uniform(0.02, 0.06), 3)
+                ev["times"] = rng.randrange(2, 6)
             elif op == "partition":
                 # long enough that the GCS declares the node dead
                 # (period 50 ms x timeout 4 beats), short enough that
@@ -151,6 +161,15 @@ def make_schedule(kind: str, seed: int, rounds: int = 8,
                     continue  # keep >= 2 nodes alive, let the run warm up
                 kills += 1
             events.append(ev)
+    if kind == "delay_storm" and not any(
+            e["op"] == "delay_rpc" for e in events):
+        # the storm must exercise the RPC-handler seam at least once:
+        # the flight-recorder attribution invariant (ISSUE 14) is
+        # asserted non-vacuously for every delay_storm seed
+        events.append({"step": 1, "op": "delay_rpc", "target": 0,
+                       "delay_s": round(rng.uniform(0.02, 0.06), 3),
+                       "times": rng.randrange(2, 6)})
+        events.sort(key=lambda e: e["step"])
     return events
 
 
@@ -266,6 +285,13 @@ class DataPlaneChaos:
         elif op == "delay_fetch":
             faultpoints.arm("data.fetch_chunk", "delay",
                             delay_s=ev["delay_s"], times=ev["times"])
+        elif op == "delay_rpc":
+            # slow-RPC injection on the pull path's control probe: the
+            # flight recorder must attribute it by METHOD NAME
+            # (asserted as a standing invariant in run())
+            faultpoints.arm("rpc.handler", "delay",
+                            delay_s=ev["delay_s"], times=ev["times"],
+                            match={"method": "FetchObjectMeta"})
         elif op == "partition":
             faultpoints.arm("raylet.heartbeat", "drop",
                             times=ev["beats"],
@@ -405,6 +431,12 @@ class DataPlaneChaos:
         for ev in self.schedule:
             by_step.setdefault(ev["step"], []).append(ev)
         await self._boot()
+        # loop-lag probe baseline (ISSUE 14 standing invariant): the
+        # probes ride the heartbeat/liveness loops and must keep
+        # ticking through raylet kills and GCS restarts (in-process
+        # cluster: sum across this process's named probes)
+        ticks_at_boot = sum(
+            p.ticks for p in rpc.telemetry.probes.values())
         try:
             for step in range(self.rounds):
                 for ev in by_step.get(step, ()):
@@ -417,10 +449,46 @@ class DataPlaneChaos:
             assert self.gcs.object_events.summary()["leaked"] == 0, \
                 f"object table reports leaks after {self.kind} " \
                 f"seed={self.seed}"
+            self._check_telemetry_invariants(ticks_at_boot)
         finally:
             faultpoints.reset()
             await self._teardown()
         return self.log
+
+    def _check_telemetry_invariants(self, ticks_at_boot: int):
+        """ISSUE 14 standing invariants: the telemetry/event tables
+        stay bounded under chaos, the loop-lag probe survives raylet
+        kills and GCS restarts, and an injected slow RPC is attributed
+        by method name."""
+        ce = self.gcs.cluster_events
+        assert len(ce) <= ce.capacity, \
+            f"cluster-event table over cap after {self.kind}"
+        ce.summary()  # must not raise
+        tt = self.gcs.rpc_telemetry
+        assert len(tt.slow_calls) <= tt.SLOW_CALLS_MAX, \
+            f"slow-call ring over cap after {self.kind}"
+        assert len(rpc.telemetry._slow) <= rpc.telemetry.SLOW_CALLS_MAX
+        # the probes kept ticking through every event (the surviving
+        # heartbeat/liveness loops live in this process)
+        ticks_now = sum(p.ticks for p in rpc.telemetry.probes.values())
+        assert ticks_now > ticks_at_boot, \
+            f"loop-lag probe died during {self.kind} seed={self.seed}"
+        # a killed raylet must leave an ordered, queryable NODE_DIED
+        # event (the GCS emits on connection loss/heartbeat timeout)
+        if any(e["op"] == "kill_raylet" for e in self.log):
+            assert ce.list(label="NODE_DIED"), \
+                f"no NODE_DIED event after kill_raylet ({self.kind})"
+        # the injected slow RPC shows up attributed by METHOD NAME with
+        # its exec time (the delay_storm acceptance)
+        rpc_delays = [e for e in self.log if e["op"] == "delay_rpc"]
+        if rpc_delays:
+            snap = rpc.telemetry.snapshot()["server"]
+            meta = snap.get("FetchObjectMeta")
+            assert meta is not None, \
+                "injected slow RPC never attributed (no FetchObjectMeta)"
+            min_delay_ms = min(e["delay_s"] for e in rpc_delays) * 1e3
+            assert meta["exec"]["max_ms"] >= min_delay_ms * 0.8, \
+                f"slow FetchObjectMeta not visible in exec stats: {meta}"
 
     async def _teardown(self):
         if self.owner is not None:

@@ -13,7 +13,7 @@ deliberately removed for the hot path):
 - CoreWorker._submit_buffer / _decref_buffer (lock-free deque + flag)
 - task_executor.StealableQueue (exec thread pops head, thief pops tail)
 - task_executor._BatchState (slot countdown from two threads)
-- rpc._HandlerStats (unlocked counters)
+- rpc RpcTelemetry/_MethodStats (unlocked flight-recorder cells)
 - memory_store waiter handoff under concurrent put/get
 """
 
@@ -106,24 +106,36 @@ def test_batch_state_slots_resolve_once():
 
 
 def test_handler_stats_unlocked_counters_monotonic():
-    from ray_tpu._private.rpc import _HandlerStats
+    """The audited single-writer contract on the flight recorder's
+    cells (rpc.py _MethodStats, which replaced _HandlerStats): in
+    production every mutator runs on the IO-loop thread, but the
+    cells must stay TORN-FREE when a foreign thread storms them
+    anyway — counts bounded by the true total, exact for uncontended
+    keys, reservoirs bounded, windowed max never corrupted."""
+    from ray_tpu._private.rpc import RpcTelemetry
 
-    st = _HandlerStats()
+    tel = RpcTelemetry()
     N = 30_000
 
     def pump(tag):
         for i in range(N):
-            st.note("m", 0.001)
-            st.note(tag, 0.002)
+            tel.note_server("m", 0.0, 0.001, 0, False)
+            tel.note_server(tag, 0.0, 0.002, 0, False)
 
     _run_threads([lambda: pump("a"), lambda: pump("b")])
-    snap = st.snapshot()
+    snap = tel.snapshot()["server"]
     # GIL-atomic increments may interleave but may not corrupt: counts
     # bounded by the true total and per-tag counts exact for the
     # uncontended keys
     assert snap["a"]["count"] == N and snap["b"]["count"] == N
     assert 0 < snap["m"]["count"] <= 2 * N
+    # windowed max (both notes land in the current window): the spike
+    # value itself, never a torn float
     assert snap["m"]["max_ms"] == 1.0
+    assert snap["a"]["max_ms"] == 2.0
+    # bounded reservoirs under the storm, honest drop accounting
+    assert snap["a"]["exec"]["count"] <= tel.reservoir
+    assert snap["a"]["dropped_samples"] == N - snap["a"]["exec"]["count"]
 
 
 def test_submit_and_decref_buffers_under_thread_storm(ray_start_regular):

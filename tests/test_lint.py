@@ -159,25 +159,43 @@ class TestLockDiscipline:
         assert vs == []
 
     def test_handler_stats_benign_race_contract(self):
-        # The audited _HandlerStats decision (rpc.py): single-writer
-        # loop-thread mutation + snapshot-copy reads needs NO lock, and
-        # raylint agrees — unlocked counter cells are outside every
-        # rule's scope by design. This fixture pins that decision: if a
-        # future rule starts flagging the pattern, the allowlist
-        # conversation must happen here, not in CI triage.
+        # The audited RPC-telemetry decision (rpc.py _MethodStats):
+        # single-writer loop-thread mutation + snapshot-copy reads
+        # needs NO lock, and raylint agrees — unlocked counter cells,
+        # GIL-atomic bounded-deque reservoir appends and the rotating
+        # windowed-max cells (which replaced the all-time max: a
+        # one-tick-stale read is fine, a dashboard stuck on a cold-
+        # start spike forever was not) are outside every rule's scope
+        # by design. This fixture pins that decision: if a future rule
+        # starts flagging the pattern, the allowlist conversation must
+        # happen here, not in CI triage.
         vs = run("""
-            class HandlerStats:
-                def __init__(self):
-                    self._stats = {}
-                def note(self, method, dt):
-                    e = self._stats.get(method)
-                    if e is None:
-                        e = self._stats[method] = [0, 0.0, 0.0]
-                    e[0] += 1
-                    e[1] += dt
+            import time
+            from collections import deque
+            class MethodStats:
+                def __init__(self, reservoir, window_s):
+                    self.count = 0
+                    self.total = 0.0
+                    self.win_max = 0.0
+                    self.prev_max = 0.0
+                    self.win_start = time.monotonic()
+                    self.window_s = window_s
+                    self.lat_res = deque(maxlen=reservoir)
+                def note(self, dt):
+                    self.count += 1
+                    self.total += dt
+                    self.lat_res.append(dt)
+                    now = time.monotonic()
+                    if now - self.win_start >= self.window_s:
+                        self.prev_max = self.win_max
+                        self.win_max = 0.0
+                        self.win_start = now
+                    if dt > self.win_max:
+                        self.win_max = dt
                 def snapshot(self):
-                    return {m: list(v) for m, v in
-                            list(self._stats.items())}
+                    return {"count": self.count,
+                            "max": max(self.win_max, self.prev_max),
+                            "samples": sorted(list(self.lat_res))}
         """)
         assert vs == []
 

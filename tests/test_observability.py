@@ -330,4 +330,8 @@ def test_status_page_stores_and_events(obs_cluster):
     w.core._run(w.core._gcs_call("AddClusterEvent", {"event": ev}))
     evs = json.loads(fetch("/api/events"))
     assert any(e.get("message") == "dashboard event probe"
-               for e in evs)
+               for e in evs["events"])
+    # the table assigns a monotonic seq at ingest (ordering survives
+    # reporter clock skew) and reports honest truncation counters
+    assert all("seq" in e for e in evs["events"])
+    assert "evicted" in evs["summary"]

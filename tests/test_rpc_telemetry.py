@@ -1,0 +1,584 @@
+"""Control-plane flight recorder (ISSUE 14): per-method RPC telemetry,
+instrumented event loops, and the cluster-event plane.
+
+Covers the satellite checklist: queueing-delay attribution (frame
+arrival -> handler start separated from exec), reservoir bounds with
+honest drop counters, cross-process shipping on BOTH cadences
+(heartbeat for raylets, metrics loop for workers/drivers), the
+``/api/rpc`` and ``/api/events`` dashboard routes, the slow-callback
+WARNING naming the handler, and the ClusterEventTable cap/eviction
+contract — plus the acceptance scenario: an injected slow RPC
+attributed by method name in ``state.list_rpc()`` and as a cat="rpc"
+slice in ``timeline()``, and a killed raylet producing an ordered,
+queryable NODE_DIED event in ``state.list_cluster_events()``.
+"""
+
+import asyncio
+import json
+import logging
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import state
+from ray_tpu._private import faultpoints, rpc
+from ray_tpu._private.config import RayTpuConfig
+from ray_tpu._private.events import ClusterEventBuffer, ClusterEventTable
+from ray_tpu._private.gcs import GcsServer
+from ray_tpu._private.raylet import Raylet
+
+
+# ------------------------------------------------------------- unit: stats
+
+
+def test_windowed_max_decays():
+    """Satellite fix: max_ms reflects RECENT behavior — a spike rolls
+    out of the reported max after two windows instead of pinning the
+    dashboard at an all-time high-water mark."""
+    tel = rpc.RpcTelemetry()
+    tel.window_s = 0.05
+    tel.note_server("WinMax", 0.0, 0.5, 0, False)
+    snap = tel.snapshot()["server"]["WinMax"]
+    assert snap["max_ms"] >= 499.0
+    time.sleep(0.06)
+    # a note in the NEXT window rolls the spike into prev_max — still
+    # visible (worst of last 1-2 windows)...
+    tel.note_server("WinMax", 0.0, 0.001, 0, False)
+    assert tel.snapshot()["server"]["WinMax"]["max_ms"] >= 499.0
+    time.sleep(0.11)
+    # ...but two windows later only recent samples count
+    tel.note_server("WinMax", 0.0, 0.002, 0, False)
+    assert tel.snapshot()["server"]["WinMax"]["max_ms"] < 100.0
+
+
+def test_windowed_max_stale_read_decays_without_notes():
+    """A method that goes quiet must not keep reporting its last spike
+    forever: the read side also ages the window out."""
+    tel = rpc.RpcTelemetry()
+    tel.window_s = 0.05
+    tel.note_server("Quiet", 0.0, 0.5, 0, False)
+    time.sleep(0.11)
+    assert tel.snapshot()["server"]["Quiet"]["max_ms"] == 0.0
+
+
+def test_reservoir_bounds_and_honest_drop_counter():
+    tel = rpc.RpcTelemetry()
+    tel.reservoir = 32
+    for i in range(100):
+        tel.note_server("Bounded", 0.0, 0.001 * i, 0, False)
+    d = tel.snapshot()["server"]["Bounded"]
+    assert d["count"] == 100
+    assert d["exec"]["count"] == 32          # bounded
+    assert d["dropped_samples"] == 68        # honest
+    # drop-OLDEST: percentiles are recency-biased — the newest samples
+    # (largest here) survive
+    assert d["exec"]["p50_ms"] >= 80.0
+
+
+def test_client_outcome_counters():
+    tel = rpc.RpcTelemetry()
+
+    class _F:
+        def __init__(self, cancelled=False, exc=None):
+            self._c, self._e = cancelled, exc
+
+        def cancelled(self):
+            return self._c
+
+        def exception(self):
+            return self._e
+
+    tel.note_client("C", 0.001, _F())
+    tel.note_client("C", 0.001, _F(cancelled=True))
+    tel.note_client("C", 0.001, _F(exc=RuntimeError("x")))
+    tel.note_push("C", 100)
+    d = tel.snapshot()["client"]["C"]
+    assert d["count"] == 3 and d["timeouts"] == 1 and d["errors"] == 1
+    assert d["push_count"] == 1 and d["push_bytes"] == 100
+    assert d["bytes_out"] == 100
+
+
+def test_slow_call_ring_bounded_and_drained():
+    tel = rpc.RpcTelemetry()
+    tel.slow_ms = 0.0001
+    for _ in range(tel.SLOW_CALLS_MAX + 50):
+        tel.note_client("Slow", 0.01, type("F", (), {
+            "cancelled": lambda self: False,
+            "exception": lambda self: None})())
+    records, dropped = tel.drain_slow_calls()
+    assert len(records) == tel.SLOW_CALLS_MAX
+    assert dropped == 50
+    records2, dropped2 = tel.drain_slow_calls()
+    assert records2 == [] and dropped2 == 0
+
+
+# -------------------------------------------------- unit: live loop + server
+
+
+def test_queueing_vs_exec_attribution():
+    """The instrumented-io-context scenario: a loop-occupying handler
+    shows EXEC time; a request queued behind it shows QUEUEING delay —
+    the two are attributed separately, per method."""
+    tel = rpc.telemetry
+    tel.server.pop("TeleSlowQ", None)
+    tel.server.pop("TeleFastQ", None)
+
+    async def scenario():
+        async def slow(conn, header, bufs):
+            time.sleep(0.08)  # sync: occupies the loop (GIL-stall model)
+            return {"ok": True}
+
+        async def fast(conn, header, bufs):
+            return {"ok": True}
+
+        server = rpc.RpcServer({"TeleSlowQ": slow, "TeleFastQ": fast},
+                               name="tele")
+        addr = await server.listen("tcp://127.0.0.1:0")
+        conn = await rpc.connect(addr)
+        # both requests coalesce into ONE flush -> one chunk at the
+        # server -> one shared arrival stamp; the slow handler's task
+        # runs first and blocks the loop, so the fast one QUEUES
+        f1 = conn.call_nowait("TeleSlowQ", {})
+        f2 = conn.call_nowait("TeleFastQ", {})
+        await asyncio.gather(f1, f2)
+        await conn.close()
+        await server.close()
+
+    asyncio.run(scenario())
+    snap = tel.snapshot()["server"]
+    slow_d, fast_d = snap["TeleSlowQ"], snap["TeleFastQ"]
+    assert slow_d["exec"]["max_ms"] >= 70.0, slow_d
+    assert slow_d["queue"]["max_ms"] < 50.0, slow_d
+    assert fast_d["exec"]["max_ms"] < 50.0, fast_d
+    assert fast_d["queue"]["max_ms"] >= 60.0, fast_d
+    # bytes accounting rode along on both sides
+    assert slow_d["bytes_in"] > 0 and slow_d["bytes_out"] > 0
+    assert tel.snapshot()["client"]["TeleSlowQ"]["count"] >= 1
+
+
+def test_slow_handler_warning_names_the_handler(caplog):
+    tel = rpc.telemetry
+    orig = tel.slow_ms
+    tel.slow_ms = 30.0
+    tel.server.pop("TeleSlowWarn", None)
+    try:
+        async def scenario():
+            async def slow(conn, header, bufs):
+                time.sleep(0.05)
+                return {"ok": True}
+
+            server = rpc.RpcServer({"TeleSlowWarn": slow}, name="tele")
+            addr = await server.listen("tcp://127.0.0.1:0")
+            conn = await rpc.connect(addr)
+            await conn.call("TeleSlowWarn", {})
+            await conn.close()
+            await server.close()
+
+        with caplog.at_level(logging.WARNING,
+                             logger="ray_tpu._private.rpc"):
+            asyncio.run(scenario())
+        msgs = [r.getMessage() for r in caplog.records
+                if "slow RPC handler" in r.getMessage()]
+        assert any("TeleSlowWarn" in m for m in msgs), msgs
+        # the slow handler fed the slow-call ring (timeline source)
+        # and the loop probe's slow_callbacks counter
+        records, _ = tel.drain_slow_calls()
+        assert any(r["method"] == "TeleSlowWarn" and
+                   r["side"] == "server" for r in records)
+    finally:
+        tel.slow_ms = orig
+
+
+def test_errors_and_unknown_method_counted():
+    tel = rpc.telemetry
+    tel.server.pop("TeleBoom", None)
+    tel.server.pop("TeleNoSuch", None)
+
+    async def scenario():
+        async def boom(conn, header, bufs):
+            raise ValueError("boom")
+
+        server = rpc.RpcServer({"TeleBoom": boom}, name="tele")
+        addr = await server.listen("tcp://127.0.0.1:0")
+        conn = await rpc.connect(addr)
+        with pytest.raises(ValueError):
+            await conn.call("TeleBoom", {})
+        with pytest.raises(RuntimeError):
+            await conn.call("TeleNoSuch", {})
+        await conn.close()
+        await server.close()
+
+    asyncio.run(scenario())
+    snap = tel.snapshot()
+    assert snap["server"]["TeleBoom"]["errors"] == 1
+    assert snap["server"]["TeleBoom"]["inflight"] == 0
+    assert snap["server"]["TeleNoSuch"]["errors"] == 1
+    assert snap["client"]["TeleBoom"]["errors"] == 1
+
+
+# ------------------------------------------------- unit: cluster event plane
+
+
+def test_cluster_event_table_cap_and_eviction():
+    t = ClusterEventTable(capacity=100)
+    for i in range(250):
+        t.add({"timestamp": float(i), "severity": "INFO",
+               "label": f"L{i % 3}", "message": f"m{i}",
+               "source_type": "test"})
+    assert len(t) == 100
+    assert t.evicted == 150
+    s = t.summary()
+    assert s["num_events"] == 100 and s["evicted"] == 150
+    # seq is monotonic and survives eviction: the tail is the newest
+    evs = t.list(limit=1000)
+    seqs = [e["seq"] for e in evs]
+    assert seqs == sorted(seqs) and seqs[-1] == 250
+    # filters
+    assert all(e["label"] == "L0" for e in t.list(label="L0"))
+    assert t.list(severity="ERROR") == []
+    assert t.list(limit=0) == [] and t.list(limit=-5) == []
+    # reporter-side drops aggregate honestly
+    t.ingest([], dropped=7)
+    assert t.summary()["dropped_reporter_events"] == 7
+
+
+def test_cluster_event_buffer_bounded_with_drop_delta():
+    buf = ClusterEventBuffer(capacity=16)
+    for i in range(40):
+        buf.add({"i": i})
+    assert len(buf) == 16 and buf.dropped == 24
+    events, dropped = buf.drain()
+    assert len(events) == 16 and dropped == 24
+    # delta contract: a second drain reports only NEW drops
+    events, dropped = buf.drain()
+    assert events == [] and dropped == 0
+    buf.add({"i": 99})
+    events, dropped = buf.drain()
+    assert len(events) == 1 and dropped == 0
+
+
+def test_summary_is_side_aware_no_double_count():
+    """counts/bytes come from the SERVER rows (one observation per
+    call — a client reporter watching the same method must not double
+    it); timeouts come from the client rows; client-only methods
+    (one-way pushes) fall back to their client rows."""
+    t = rpc.RpcTelemetryTable()
+    t.ingest("gcs", {"snapshot": {"server": {
+        "M": {"count": 5, "errors": 1, "inflight": 2, "bytes_in": 500,
+              "bytes_out": 100, "max_ms": 3.0,
+              "exec": {"p99_ms": 2.0}, "queue": {"p99_ms": 0.5}}},
+        "client": {}, "loop": {}}})
+    t.ingest("driver-x", {"snapshot": {"server": {}, "client": {
+        "M": {"count": 5, "errors": 0, "timeouts": 2, "bytes_out": 500,
+              "max_ms": 9.0, "exec": {"p99_ms": 8.0}},
+        "PushOnly": {"count": 7, "bytes_out": 70, "push_count": 7}},
+        "loop": {}}})
+    s = t.summary()
+    m = s["M"]
+    assert m["count"] == 5, m            # not 10
+    assert m["errors"] == 1 and m["inflight"] == 2
+    assert m["bytes_in"] == 500 and m["bytes_out"] == 100
+    assert m["timeouts"] == 2            # client-side truth
+    # percentiles: worst row of either side (client includes the wire)
+    assert m["max_ms"] == 9.0 and m["exec_p99_ms"] == 8.0
+    assert m["reporters"] == 2 and m["sides"] == ["client", "server"]
+    # a method nothing serves still shows up via its client rows
+    assert s["PushOnly"]["count"] == 7 and s["PushOnly"]["sides"] == \
+        ["client"]
+
+
+def test_inflight_balanced_when_toggled_off_mid_flight():
+    """note_request increments while enabled; if recording is flipped
+    off before the handler completes, note_done still balances the
+    in-flight count — the toggle can never strand phantom inflight."""
+    tel = rpc.RpcTelemetry()
+    tel.note_request("Toggled", 100)
+    assert tel.server["Toggled"].inflight == 1
+    tel.enabled = False
+    tel.note_done("Toggled")
+    assert tel.server["Toggled"].inflight == 0
+    # and the dispatch path routes through it: a request that ARRIVED
+    # with telemetry on but completed with it off leaves inflight 0
+    prev = rpc.telemetry.enabled
+
+    async def scenario():
+        async def h(conn, header, bufs):
+            rpc.telemetry.enabled = False
+            return {"ok": True}
+
+        server = rpc.RpcServer({"TeleToggle": h}, name="tele")
+        addr = await server.listen("tcp://127.0.0.1:0")
+        conn = await rpc.connect(addr)
+        rpc.telemetry.enabled = True
+        rpc.telemetry.server.pop("TeleToggle", None)
+        await conn.call("TeleToggle", {})
+        await conn.close()
+        await server.close()
+
+    try:
+        asyncio.run(scenario())
+        assert rpc.telemetry.server["TeleToggle"].inflight == 0
+    finally:
+        rpc.telemetry.enabled = prev
+
+
+def test_loop_probes_are_per_component():
+    """Named probes isolate loops: an in-process head's driver-loop
+    stall must never be shipped as the raylet loop's lag (the probes
+    share only the process-wide slow_callbacks counter)."""
+    tel = rpc.RpcTelemetry()
+    a, b = tel.loop_probe("raylet"), tel.loop_probe("core")
+    assert a is not b and a is tel.loop_probe("raylet")
+
+    async def scenario():
+        a.tick()
+        time.sleep(0.05)  # loop busy while the tick callback is queued
+        await asyncio.sleep(0)
+
+    asyncio.run(scenario())
+    assert a.ticks == 1 and b.ticks == 0
+    assert a.snapshot()["lag"]["count"] == 1
+    assert b.snapshot()["lag"] == {"count": 0}
+    # the shipped snapshot carries the NAMED probe's block
+    assert tel.snapshot(probe="raylet")["loop"]["ticks"] == 1
+    assert tel.snapshot(probe="core")["loop"]["ticks"] == 0
+
+
+def test_rpc_telemetry_table_bounded_and_ttl():
+    t = rpc.RpcTelemetryTable()
+    t.ingest("r1", {"snapshot": {"server": {"M": {"count": 1}},
+                                 "client": {}, "loop": {}},
+                    "slow_calls": [{"method": "M", "ts": 0.0,
+                                    "dur_ms": 1.0}] *
+                    (t.SLOW_CALLS_MAX + 10),
+                    "slow_calls_dropped": 3})
+    assert len(t.slow_calls) == t.SLOW_CALLS_MAX
+    assert t.slow_dropped == 13
+    assert t.rows(method="M")[0]["reporter"] == "r1"
+    # TTL prune: age the reporter out
+    t._reporters["r1"] = (time.time() - t.TTL_S - 1,
+                          t._reporters["r1"][1])
+    assert t.rows() == []
+
+
+# ----------------------------------------------------------- e2e: shipping
+
+
+@pytest.fixture
+def telemetry_cluster():
+    info = ray_tpu.init(num_cpus=2, _system_config={
+        "metrics_report_period_ms": 200,
+        "loop_slow_callback_threshold_ms": 100.0,
+    })
+    yield info
+    ray_tpu.shutdown()
+
+
+def _fetch(route):
+    addr = state.metrics_address()
+    with urllib.request.urlopen(f"http://{addr}{route}",
+                                timeout=20) as resp:
+        assert resp.status == 200
+        return json.loads(resp.read())
+
+
+def test_cross_process_shipping_routes_and_acceptance(telemetry_cluster):
+    """One cluster, the full surface: worker/driver telemetry ships on
+    the metrics cadence, server+client sides both present, /api/rpc +
+    /api/events serve the tables, a faultpoint-injected slow RPC is
+    attributed by METHOD NAME with queueing vs exec separated in
+    state.list_rpc(), and timeline() carries it as a cat="rpc" slice
+    on the shared wall clock (the delay_storm acceptance scenario,
+    driven deterministically)."""
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get([f.remote(i) for i in range(40)]) == \
+        list(range(1, 41))
+
+    # --- both sides, multiple processes, on the metrics cadence
+    deadline = time.time() + 30
+    rows = []
+    while time.time() < deadline:
+        rows = state.list_rpc()
+        sides = {r["side"] for r in rows}
+        reps = {r["reporter"].split("-")[0] for r in rows}
+        if {"server", "client"} <= sides and \
+                {"driver", "worker"} <= reps:
+            break
+        time.sleep(0.3)
+    assert {"server", "client"} <= {r["side"] for r in rows}, rows
+    assert {"driver", "worker"} <= \
+        {r["reporter"].split("-")[0] for r in rows}
+    push = [r for r in rows if r["method"] == "PushTasks" and
+            r["side"] == "client"]
+    assert push and push[0]["count"] >= 1 and push[0]["bytes_out"] > 0
+    serve = [r for r in rows if r["method"] == "PushTasks" and
+             r["side"] == "server"]
+    assert serve and serve[0]["bytes_in"] > 0
+
+    # --- filters are server-side too
+    only = state.list_rpc(method="PushTasks")
+    assert only and all("PushTasks" in r["method"] for r in only)
+
+    # --- loop-lag probe shipped per reporter
+    sr = state.summary_rpc()
+    assert sr["loops"] and any(
+        lp.get("ticks", 0) > 0 for lp in sr["loops"].values())
+    assert sr["methods"]["PushTasks"]["count"] >= 1
+
+    # --- the acceptance scenario: inject a slow RPC, see it attributed
+    faultpoints.arm("rpc.handler", "delay", delay_s=0.15, times=1,
+                    match={"method": "GetClusterResources"})
+    try:
+        reply = telemetry_cluster  # noqa: F841 — cluster fixture held
+        core = ray_tpu.worker.global_worker.core
+        core.gcs_call_sync("GetClusterResources", {})
+    finally:
+        faultpoints.reset()
+    deadline = time.time() + 30
+    slow_row = None
+    while time.time() < deadline:
+        for r in state.list_rpc(method="GetClusterResources",
+                                side="server"):
+            if (r.get("exec") or {}).get("max_ms", 0) >= 140.0:
+                slow_row = r
+                break
+        if slow_row:
+            break
+        time.sleep(0.3)
+    assert slow_row, state.list_rpc(method="GetClusterResources")
+    # queueing vs exec separated: the injected delay is EXEC time
+    assert slow_row["exec"]["max_ms"] >= 140.0
+    assert "queue" in slow_row and slow_row["queue"]["count"] >= 1
+    # ...and a cat="rpc" slice lands on the shared timeline clock
+    tl = state.timeline()
+    rpc_slices = [e for e in tl if e.get("cat") == "rpc"]
+    assert any("GetClusterResources" in e["name"] for e in rpc_slices), \
+        [e["name"] for e in rpc_slices]
+    sl = next(e for e in rpc_slices
+              if "GetClusterResources" in e["name"])
+    assert sl["dur"] >= 140_000  # microseconds
+    assert abs(sl["ts"] / 1e6 - time.time()) < 120  # same wall clock
+
+    # --- dashboard routes
+    api = _fetch("/api/rpc")
+    assert api["rpc"] and "summary" in api and api["loops"]
+    assert any(r["method"] == "PushTasks" for r in api["rpc"])
+    assert any("GetClusterResources" in s.get("method", "")
+               for s in api["slow_calls"])
+    evs = _fetch("/api/events")
+    assert "events" in evs and "summary" in evs
+
+    # --- cluster events: driver emitter -> metrics cadence -> table
+    core = ray_tpu.worker.global_worker.core
+    core.events.emit("WARNING", "TEST_PROBE", "driver event probe",
+                     node="driverside")
+    deadline = time.time() + 20
+    got = []
+    while time.time() < deadline:
+        got = state.list_cluster_events(label="TEST_PROBE")
+        if got:
+            break
+        time.sleep(0.3)
+    assert got and got[0]["message"] == "driver event probe"
+    assert got[0]["seq"] > 0
+    assert state.summary_cluster_events()["num_events"] >= 1
+
+
+# ------------------------------------- e2e: node death + heartbeat shipping
+
+
+def test_node_death_event_and_heartbeat_telemetry(tmp_path, monkeypatch):
+    """In-process GCS + 2 raylets: a SIGKILL-equivalent raylet crash
+    produces an ORDERED, queryable NODE_DIED cluster event (after that
+    node's own RAYLET_STARTED), a standalone raylet ships RPC telemetry
+    + cluster events on the HEARTBEAT cadence, and the surviving
+    node's loop-lag probe keeps ticking through the death."""
+    from ray_tpu._private import metrics as metrics_mod
+
+    # in-process raylets ship on the heartbeat only when no CoreWorker
+    # claims the process reporter role; other tests in this pytest
+    # process may have init()ed before us — undo the sticky mark
+    monkeypatch.setattr(metrics_mod, "_CORE_REPORTER", False)
+
+    cfg = RayTpuConfig.create({
+        "num_prestart_workers": 0,
+        "raylet_heartbeat_period_ms": 50,
+        "num_heartbeats_timeout": 4,
+        "data_plane_stripes": 0,
+    })
+
+    async def scenario():
+        gcs = GcsServer(cfg)
+        addr = await gcs.start("tcp://127.0.0.1:0")
+        raylets = [Raylet(cfg, 1, session_dir=str(tmp_path),
+                          node_name=f"tele-r{i}") for i in range(2)]
+        for r in raylets:
+            await r.start(addr)
+        victim, survivor = raylets
+        try:
+            # beats flow: telemetry + events arrive on the heartbeat
+            deadline = asyncio.get_running_loop().time() + 10
+            while asyncio.get_running_loop().time() < deadline:
+                started = gcs.cluster_events.list(label="RAYLET_STARTED")
+                if len(started) >= 2 and gcs.rpc_telemetry.rows():
+                    break
+                await asyncio.sleep(0.05)
+            started = gcs.cluster_events.list(label="RAYLET_STARTED")
+            assert len(started) >= 2, gcs.cluster_events.list()
+            rows = gcs.rpc_telemetry.rows()
+            assert any(r["reporter"].startswith("node-") for r in rows)
+
+            ticks_before = survivor._nid12 and (
+                gcs.nodes[survivor.node_id.binary()]
+                .stats.get("loop_ticks", 0))
+
+            # SIGKILL-equivalent: no DrainNode, connections just die
+            victim._closing = True
+            victim._hb_task.cancel()
+            victim._log_monitor_task.cancel()
+            await victim._server.close()
+            await victim.gcs_conn.close()
+
+            deadline = asyncio.get_running_loop().time() + 10
+            death = []
+            while asyncio.get_running_loop().time() < deadline:
+                death = gcs.cluster_events.list(
+                    label="NODE_DIED",
+                    node=victim.node_id.hex()[:12])
+                if death:
+                    break
+                await asyncio.sleep(0.05)
+            assert death, gcs.cluster_events.list()
+            assert death[0]["severity"] == "ERROR"
+            # ORDERED: the death seq follows the victim's own start
+            victim_started = [
+                e for e in started
+                if e.get("custom_fields", {}).get("node") ==
+                victim.node_id.hex()[:12]]
+            assert victim_started and \
+                death[0]["seq"] > victim_started[0]["seq"]
+
+            # the survivor's loop-lag probe rides through the death
+            deadline = asyncio.get_running_loop().time() + 10
+            while asyncio.get_running_loop().time() < deadline:
+                ticks = gcs.nodes[survivor.node_id.binary()] \
+                    .stats.get("loop_ticks", 0)
+                if ticks > (ticks_before or 0):
+                    break
+                await asyncio.sleep(0.05)
+            assert gcs.nodes[survivor.node_id.binary()] \
+                .stats.get("loop_ticks", 0) > (ticks_before or 0)
+            # event table stays bounded with honest accounting
+            s = gcs.cluster_events.summary()
+            assert s["num_events"] <= gcs.cluster_events.capacity
+        finally:
+            victim.store.shutdown()
+            await survivor.stop()
+            await gcs.stop()
+
+    asyncio.run(scenario())
