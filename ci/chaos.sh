@@ -5,10 +5,12 @@
 # delay storm, raylet crash, heartbeat partition, GCS restart, mixed,
 # worker kill, OOM storm (seeded simulated-RSS ramps through the node
 # memory watchdog: kills, OOM retries, lease backpressure — asserting
-# the raylet/GCS survive every event), and the mixed_version rolling-
+# the raylet/GCS survive every event), the mixed_version rolling-
 # upgrade smoke (an old-schema raylet speaking v1 stubs compiled from
 # tests/fixtures/rpc_schemas_v1.json against the current GCS through a
-# seeded gcs_restart — version negotiation recorded in node info).
+# seeded gcs_restart — version negotiation recorded in node info), and
+# the gang_kill soak (SIGKILL an SPMD gang member mid-step: typed
+# failure, epoch-fenced reform, pool reclaim, zero leaked objects).
 # Runs the slow-marked schedules too (tier-1 carries only
 # the 2-schedule smoke); any invariant violation (pull hang, admission
 # budget leak, segment-lease leak, a leak-detector-flagged object
@@ -49,5 +51,6 @@ exec env RAY_TPU_LEASE_CREDITS_ENABLED=0 python -m pytest \
     tests/test_chaos.py::test_chaos_soak_worker_kill \
     tests/test_chaos.py::test_chaos_soak_oom_storm \
     tests/test_chaos.py::test_chaos_soak_credit_raylet_kill \
+    tests/test_chaos.py::test_chaos_soak_gang_kill \
     "tests/test_chaos.py::test_chaos_soak[raylet_kill]" \
     -q -p no:cacheprovider -m ''

@@ -67,12 +67,13 @@ static const char *OI_NAMES[OI_N] = {"_bytes", "_hash"};
 enum {
     RF_owned, RF_owner_address, RF_local_refs, RF_submitted_refs,
     RF_contained_in, RF_contains, RF_borrowers, RF_locations,
-    RF_in_plasma, RF_pinned_lineage, RF_freed, RF_size, RF_N
+    RF_in_plasma, RF_pinned_lineage, RF_freed, RF_size,
+    RF_shard_group, RF_N
 };
 static const char *RF_NAMES[RF_N] = {
     "owned", "owner_address", "local_refs", "submitted_refs",
     "contained_in", "contains", "borrowers", "locations",
-    "in_plasma", "pinned_lineage", "freed", "size"
+    "in_plasma", "pinned_lineage", "freed", "size", "shard_group"
 };
 
 enum { OR_object_id, OR_owner_address, OR__worker, OR_call_site, OR_N };
@@ -381,6 +382,7 @@ FastCtx_submit(FastCtx *self, PyObject *const *argv, Py_ssize_t nargs)
     }
     Py_INCREF(Py_False); SLOT(ref, self->rf_off[RF_freed]) = Py_False;
     Py_INCREF(self->long0); SLOT(ref, self->rf_off[RF_size]) = self->long0;
+    Py_INCREF(Py_None); SLOT(ref, self->rf_off[RF_shard_group]) = Py_None;
 
     /* bytes key: ReferenceCounter._refs hashes raw id bytes in C */
     if (PyDict_SetItem(self->refs_dict, oid_b, ref) < 0) goto fail;

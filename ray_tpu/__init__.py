@@ -14,25 +14,37 @@ Public API parity target: reference python/ray/__init__.py.
 __version__ = "0.1.0"
 
 from ray_tpu import exceptions  # noqa: F401
+from ray_tpu._private.distributed_array import (  # noqa: F401
+    DistributedArray,
+    Mesh,
+    PartitionSpec,
+)
 from ray_tpu._private.object_ref import ObjectRef  # noqa: F401
 from ray_tpu.actor import get_actor, list_named_actors  # noqa: F401
 from ray_tpu.remote_function import make_remote
 from ray_tpu.worker import (  # noqa: F401
+    all_gather,
+    all_reduce,
+    assemble,
     available_resources,
     cancel,
     cluster_resources,
+    create_gang,
     experimental_internal_kv_del,
     experimental_internal_kv_get,
     experimental_internal_kv_list,
     experimental_internal_kv_put,
     get,
     get_runtime_context,
+    get_shard,
     init,
     is_initialized,
     kill,
     memory_summary,
     nodes,
     put,
+    put_sharded,
+    reshard,
     shutdown,
     timeline,
     wait,
@@ -68,9 +80,12 @@ def method(num_returns: int = 1):
 from ray_tpu._private.task_executor import exit_actor  # noqa: E402,F401
 
 __all__ = [
-    "ObjectRef", "available_resources", "cancel", "cluster_resources",
+    "DistributedArray", "Mesh", "ObjectRef", "PartitionSpec",
+    "all_gather", "all_reduce", "assemble", "available_resources",
+    "cancel", "cluster_resources", "create_gang",
     "exceptions", "exit_actor", "get", "get_actor", "get_runtime_context",
-    "init", "is_initialized", "kill", "list_named_actors",
+    "get_shard", "init", "is_initialized", "kill", "list_named_actors",
     "memory_summary", "method", "nodes",
-    "put", "remote", "shutdown", "timeline", "wait",
+    "put", "put_sharded", "remote", "reshard", "shutdown", "timeline",
+    "wait",
 ]

@@ -140,6 +140,32 @@ class RayTpuConfig:
     # park pool slots it no longer needs.
     lease_credit_stale_s: float = 2.0
 
+    # --- SPMD gangs & distributed arrays ---
+    # How many times the driver re-asks for a gang lease after an
+    # all-or-nothing booking round came back short (retry_later). Each
+    # rejection prestarts workers toward the deficit on the raylets
+    # that ran dry, so retries converge instead of re-probing the same
+    # empty pool; the wait between rounds follows the shared
+    # exponential-jitter policy (backoff.py) starting from
+    # gang_lease_retry_backoff_s. 0 = a single attempt, fail fast.
+    gang_lease_retry_attempts: int = 20
+    # BASE delay between gang-lease booking rounds (exponential-jitter
+    # up to retry_backoff_cap_s). Short by default: the common cause of
+    # a short round is workers still forking, which resolves in tens of
+    # milliseconds.
+    gang_lease_retry_backoff_s: float = 0.1
+    # Per-member worker-socket dial timeout when the driver adopts a
+    # freshly granted gang. A member that cannot be dialed inside this
+    # window fails the formation (the whole gang is released — all-or-
+    # nothing extends to adoption, not just booking).
+    gang_member_dial_timeout_s: float = 5.0
+    # Per-run override of the striped chunk size for GatherShards
+    # collective transfers (reshard / all-gather / all-reduce). 0 (the
+    # default) keeps the pull path's adaptive sizing:
+    # object_manager_chunk_size floor, data_plane_max_chunk_size
+    # ceiling, ~8 chunks per stripe lane.
+    reshard_chunk_bytes: int = 0
+
     # --- worker pool ---
     # Hard cap on workers started per node (0 = num_cpus).
     max_workers_per_node: int = 0

@@ -111,7 +111,7 @@ class PendingTaskEntry:
 class LeasedWorker:
     __slots__ = ("address", "lease_id", "node_id", "conn", "inflight",
                  "raylet_address", "worker_id", "idle_timer",
-                 "via_credit", "on_drop")
+                 "via_credit", "on_drop", "gang")
 
     def __init__(self, address, lease_id, node_id, conn, raylet_address, worker_id):
         self.address = address
@@ -133,6 +133,10 @@ class LeasedWorker:
         # unregister it — a revoked credit must not fire the
         # worker-died retry path against a healthy worker
         self.on_drop = None
+        # owning SpmdGang when this lease is a gang member (rank-pinned
+        # dispatch; never idle-returned — the gang release path owns
+        # the lease lifetime, see _schedule_idle_return)
+        self.gang = None
 
 
 class SchedulingKeyState:
@@ -207,6 +211,225 @@ class ActorQueueState:
         # ActorDiedError this queue raises
         self.death_info: dict = {}
         self.max_pending = -1
+
+
+class SpmdGang:
+    """Driver handle to a gang-scheduled SPMD worker group.
+
+    Formation books ``world_size`` workers across the cluster in ONE
+    all-or-nothing lease round (``RequestGangLease`` — the home raylet
+    fans peer bookings out itself, so rpc telemetry shows exactly one
+    gang-lease call, never N ``RequestWorkerLease``s). Members are
+    adopted as rank-pinned :class:`LeasedWorker`s: ``run()`` pushes one
+    ``max_retries=0`` task per rank straight to its member, so a step
+    is deterministic in placement and a dead member fails its task with
+    :class:`~ray_tpu.exceptions.WorkerCrashedError` instead of silently
+    re-running elsewhere. Incarnations are epoch-fenced like actors: a
+    member death marks the gang broken; ``reform()`` books epoch+1 and
+    the raylet rejects any stale push from the previous incarnation."""
+
+    def __init__(self, core: "CoreWorker", world_size: int,
+                 resources: Dict[str, float], runtime_env):
+        self.core = core
+        self.gang_id = os.urandom(16)
+        self.world_size = world_size
+        self.resources = resources
+        self.runtime_env = runtime_env
+        self.epoch = 0
+        self.broken = False
+        self.members: List[LeasedWorker] = []  # rank-ordered
+        self._released = False
+        # private dispatch state, NEVER registered in scheduling_keys:
+        # the pump must not see gang members as general-purpose breadth
+        self._state = SchedulingKeyState(dict(resources))
+
+    # -- formation ------------------------------------------------------
+
+    async def _form(self) -> "SpmdGang":
+        from ray_tpu._private import backoff as backoff_mod
+
+        core = self.core
+        cfg = core.config
+        epoch = self.epoch + 1
+        base = max(cfg.gang_lease_retry_backoff_s, 1e-3)
+        bo = backoff_mod.Backoff(
+            base_s=base, cap_s=max(cfg.retry_backoff_cap_s, base),
+            multiplier=cfg.retry_backoff_multiplier)
+        reply: dict = {}
+        for attempt in range(1 + max(0, cfg.gang_lease_retry_attempts)):
+            if attempt:
+                await bo.sleep()
+            reply, _ = await core.raylet_conn.call(
+                "RequestGangLease",
+                protocol.RequestGangLeaseRequest(
+                    gang_id=self.gang_id, epoch=epoch,
+                    count=self.world_size,
+                    resources=dict(self.resources),
+                    runtime_env=self.runtime_env).to_header())
+            if reply.get("granted"):
+                break
+            if reply.get("stale_epoch"):
+                # another incarnation of this gang_id advanced past us
+                # — unreachable through the public API (epochs only
+                # move through this handle) but fenced anyway
+                raise exc.GangPlacementError(
+                    f"gang epoch {epoch} is stale (raylet has "
+                    f"{reply.get('current_epoch')})")
+        else:
+            raise exc.GangPlacementError(
+                f"could not book {self.world_size} workers in one "
+                f"round after {1 + max(0, cfg.gang_lease_retry_attempts)}"
+                f" attempts: {reply.get('reason', 'unknown')}")
+
+        async def _dial(m: dict) -> LeasedWorker:
+            conn = await rpc.connect(
+                m["worker_address"], peer_name="gang-member",
+                timeout=cfg.gang_member_dial_timeout_s)
+            lw = LeasedWorker(m["worker_address"], m["lease_id"],
+                              m["node_id"], conn, core.raylet_address,
+                              m["worker_id"])
+            lw.gang = self
+
+            def _on_drop(c, _lw=lw):
+                self._member_died(_lw)
+
+            lw.on_drop = _on_drop
+            conn.on_disconnect.append(_on_drop)
+            return lw
+
+        members = sorted(reply["members"], key=lambda m: m["rank"])
+        dials = [asyncio.ensure_future(_dial(m)) for m in members]
+        results = await asyncio.gather(*dials, return_exceptions=True)
+        failed = [r for r in results if isinstance(r, BaseException)]
+        if failed:
+            # all-or-nothing extends to adoption: kill-release the
+            # whole booking (a member that died before its first dial
+            # may be mid-fork wreckage) and close the dials that DID
+            # land
+            for r in results:
+                if isinstance(r, LeasedWorker):
+                    await self._close_member(r)
+            try:
+                await core.raylet_conn.call(
+                    "ReleaseGangLease",
+                    protocol.ReleaseGangLeaseRequest(
+                        gang_id=self.gang_id, epoch=epoch,
+                        kill=True).to_header())
+            except ConnectionError:
+                pass  # raylet gone; owner-liveness watch reclaims
+            raise exc.GangPlacementError(
+                f"gang member adoption failed: {failed[0]}")
+        self.epoch = epoch
+        self.broken = False
+        self._released = False
+        self.members = list(results)
+        self._state.workers = list(self.members)
+        return self
+
+    def _member_died(self, lw: LeasedWorker) -> None:
+        # a dead member invalidates the WHOLE step: in-flight push
+        # futures on its conn error out and fail their tasks with
+        # WorkerCrashedError (max_retries=0); surviving ranks' results
+        # still land, but the epoch fence stops any further steps
+        if not self._released:
+            self.broken = True
+
+    # -- steps ----------------------------------------------------------
+
+    def run(self, fn, args_per_rank: Optional[Sequence] = None,
+            name: Optional[str] = None) -> List[ObjectRef]:
+        """Run ``fn`` once per rank, pinned to the gang's members.
+
+        ``args_per_rank[rank]`` (a tuple/list) becomes the call args for
+        that rank; with the default None each rank is called as
+        ``fn(rank)``. Returns the rank-ordered list of result refs.
+        Step tasks run with ``max_retries=0``: a dead member fails its
+        slot with WorkerCrashedError and breaks the gang."""
+        if args_per_rank is not None and \
+                len(args_per_rank) != self.world_size:
+            raise ValueError(
+                f"args_per_rank has {len(args_per_rank)} entries for a "
+                f"{self.world_size}-rank gang")
+        # export on the CALLER thread (export_prepickled round-trips
+        # the GCS through the sync KV facade, illegal from the loop) —
+        # exactly where remote_function does it for pumped tasks
+        fn_key, pickled = self.core.function_manager.prepare(fn)
+        self.core.function_manager.export_prepickled(fn_key, pickled, fn)
+        return self.core._run(
+            self._run_step(fn, fn_key, args_per_rank, name))
+
+    async def _run_step(self, fn, fn_key, args_per_rank, name):
+        if self._released:
+            raise exc.GangBrokenError("gang already released")
+        if self.broken:
+            raise exc.GangBrokenError(
+                f"gang epoch {self.epoch} lost a member; reform() "
+                f"books a fresh incarnation")
+        core = self.core
+        per_rank = [list(args_per_rank[r]) if args_per_rank is not None
+                    else [r] for r in range(self.world_size)]
+        # owned-arg readiness, as _submit_when_ready does for pumped
+        # tasks (borrowed args resolve at the executing worker)
+        for args in per_rank:
+            for a in args:
+                if isinstance(a, ObjectRef) and \
+                        core.reference_counter.is_owned(a.object_id):
+                    try:
+                        await core.memory_store.get(a.object_id)
+                    # raylint: disable=exception-hygiene — errored deps surface at the executing worker
+                    except Exception:
+                        pass
+        return core._submit_gang_step(
+            self, fn_key, name or getattr(fn, "__name__", "gang_step"),
+            per_rank)
+
+    # -- teardown / re-formation ---------------------------------------
+
+    async def _close_member(self, lw: LeasedWorker) -> None:
+        if lw.on_drop is not None and not lw.conn.closed and \
+                lw.on_drop in lw.conn.on_disconnect:
+            lw.conn.on_disconnect.remove(lw.on_drop)
+        if not lw.conn.closed:
+            await lw.conn.close()
+
+    def reform(self) -> "SpmdGang":
+        """Book a fresh incarnation at epoch+1. The raylet releases the
+        previous incarnation's bookings first (kill-releasing broken
+        members — they may be mid-step wreckage) and fences every stale
+        push from the old epoch."""
+        return self.core._run(self._reform_async())
+
+    async def _reform_async(self) -> "SpmdGang":
+        for lw in self.members:
+            await self._close_member(lw)
+        self.members = []
+        self._state.workers = []
+        return await self._form()
+
+    def release(self) -> None:
+        """Tear the gang down: one ReleaseGangLease to the home raylet
+        releases every member cluster-wide (kill when broken — a
+        possibly mid-step worker must not be recycled as idle)."""
+        self.core._run(self._release_async())
+
+    shutdown = release
+
+    async def _release_async(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        for lw in self.members:
+            await self._close_member(lw)
+        try:
+            await self.core.raylet_conn.call(
+                "ReleaseGangLease",
+                protocol.ReleaseGangLeaseRequest(
+                    gang_id=self.gang_id, epoch=self.epoch,
+                    kill=self.broken).to_header())
+        except ConnectionError:
+            pass  # raylet gone; its teardown reclaimed everything
+        self.members = []
+        self._state.workers = []
 
 
 class CoreWorker:
@@ -481,30 +704,46 @@ class CoreWorker:
 
     async def _gcs_call(self, method: str, header=None, bufs=(),
                         timeout=None):
-        """GCS RPC with one transparent redial: a restarted GCS (journal
+        """GCS RPC with transparent redial: a restarted GCS (journal
         replay) drops every connection; callers should not fail for that
         (reference: workers re-resolve the GCS address on failover,
         core_worker/gcs_server_address_updater.cc). Retried methods must
-        be idempotent server-side (RegisterActor dedupes by actor id)."""
+        be idempotent server-side (RegisterActor dedupes by actor id).
+        Redial attempts repeat within ``gcs_reconnect_timeout_s``: a
+        SIGKILLed GCS's listen socket can still accept for a beat, so a
+        single reconnect may land on the dying process and lose its
+        retried call too — keep going until the budget, not one shot."""
         try:
             return await self.gcs_conn.call(method, header, bufs=bufs,
                                             timeout=timeout)
         except ConnectionError:
             if self._shutdown:
                 raise
-            # One reconnect at a time: concurrent failures reuse the
-            # winner's connection instead of each dialing (and double-
-            # subscribing) their own.
-            async with self._gcs_reconnect_lock:
-                if self.gcs_conn is None or self.gcs_conn.closed:
-                    conn = await rpc.connect(
-                        self.gcs_address,
-                        handlers={"Published": self._handle_published},
-                        peer_name="gcs")
-                    await conn.call("Subscribe", {"channel": "ACTOR"})
-                    self.gcs_conn = conn
-            return await self.gcs_conn.call(method, header, bufs=bufs,
-                                            timeout=timeout)
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + max(
+                self.config.gcs_reconnect_timeout_s, 0.1)
+            while True:
+                try:
+                    # One reconnect at a time: concurrent failures reuse
+                    # the winner's connection instead of each dialing
+                    # (and double-subscribing) their own.
+                    async with self._gcs_reconnect_lock:
+                        if self.gcs_conn is None or self.gcs_conn.closed:
+                            conn = await rpc.connect(
+                                self.gcs_address,
+                                handlers={
+                                    "Published": self._handle_published},
+                                peer_name="gcs")
+                            await conn.call("Subscribe",
+                                            {"channel": "ACTOR"})
+                            self.gcs_conn = conn
+                    return await self.gcs_conn.call(method, header,
+                                                    bufs=bufs,
+                                                    timeout=timeout)
+                except ConnectionError:
+                    if self._shutdown or loop.time() >= deadline:
+                        raise
+                    await asyncio.sleep(0.1)
 
     # ------------------------------------------------------------ KV helpers
 
@@ -1207,6 +1446,316 @@ class CoreWorker:
         await conn.call("GetObject", {"object_id": oid.binary(),
                                       "timeout": 3600.0})
 
+    # ------------------------------------------------------- SPMD gangs
+
+    def create_gang(self, world_size: int,
+                    resources: Optional[Dict[str, float]] = None,
+                    runtime_env: Optional[Dict] = None) -> SpmdGang:
+        """Book an SPMD gang: ``world_size`` workers across the cluster
+        in ONE all-or-nothing lease round. See :class:`SpmdGang`."""
+        if world_size < 1:
+            raise ValueError("world_size must be >= 1")
+        gang = SpmdGang(self, world_size, resources or {"CPU": 1.0},
+                        self._resolve_runtime_env(runtime_env))
+        return self._run(gang._form())
+
+    def _submit_gang_step(self, gang: SpmdGang, fn_key: str, name: str,
+                          per_rank_args: List[list]) -> List[ObjectRef]:
+        """Loop thread: register + push one rank-pinned spec per gang
+        member. Bypasses the scheduling pump entirely — placement was
+        decided at gang formation, so each spec goes straight to its
+        rank's worker conn with max_retries=0 (a dead member is a step
+        failure, never a silent re-placement)."""
+        if self.mode == "driver":
+            prefix = self._task_lineage_prefix
+        else:
+            prefix = (self._current_task_id or
+                      self._driver_task_id.binary())[:ACTOR_ID_SIZE]
+        refs: List[ObjectRef] = []
+        ev = self.task_events
+        for rank, (lw, args) in enumerate(
+                zip(gang.members, per_rank_args)):
+            prepared_args, arg_holds = self._prepare_args(args) \
+                if args else ((), None)
+            spec = TaskSpec(
+                task_id=make_task_id_bytes(prefix), job_id=self.job_id,
+                task_type=TASK_NORMAL, name=f"{name}:{rank}",
+                fn_key=fn_key, args=prepared_args, num_returns=1,
+                resources=dict(gang.resources), max_retries=0,
+                retry_exceptions=False, owner_address=self.address,
+                owner_worker_id=self.worker_id,
+                runtime_env=gang.runtime_env, trace_ctx=_trace_ctx())
+            refs.extend(self._register_task(spec, arg_holds))
+            if ev.enabled:
+                ev.record(spec.task_id, SUBMITTED,
+                          {"name": spec.name,
+                           "gang": gang.gang_id.hex()[:12],
+                           "rank": rank, "epoch": gang.epoch})
+            lw.inflight += 1
+            self._push_task_batch_nowait(
+                spec.scheduling_class, gang._state, lw, [spec])
+        return refs
+
+    # ------------------------------------------------ distributed arrays
+
+    def put_sharded(self, array, mesh, spec):
+        """Shard ``array`` over ``mesh`` with ``spec`` and put every
+        shard as a first-class shm object carrying placement metadata.
+        Returns a :class:`~ray_tpu._private.distributed_array
+        .DistributedArray`; the shard set is registered as ONE lineage
+        unit (ReferenceCounter.add_shard_group) — dropping the handle
+        frees every shard segment together or not at all."""
+        return self._run(self._put_sharded_async(array, mesh, spec))
+
+    async def _put_sharded_async(self, array, mesh, spec):
+        import numpy as np
+
+        from ray_tpu._private import distributed_array as da
+
+        arr = np.ascontiguousarray(array)
+        if arr.dtype == object:
+            raise TypeError("object-dtype arrays cannot be sharded")
+        da._validate(arr.shape, mesh, spec)
+        shards: List[da.ShardInfo] = []
+        for rank in range(mesh.nranks):
+            box = da._rank_box(arr.shape, mesh, spec, rank)
+            shard = np.ascontiguousarray(
+                arr[tuple(slice(a, b) for a, b in box)])
+            serialized = self.serialization_context.serialize(shard)
+            _hdr, raw_frames, offsets, _total = plan_segment(serialized)
+            if len(raw_frames) != 2:
+                raise TypeError(
+                    "sharded put requires the 2-frame ndarray wire "
+                    f"shape, got {len(raw_frames)} frames")
+            oid = self._next_put_id()
+            attrs = {"rank": rank, "coords": list(mesh.coords(rank)),
+                     "mesh": list(mesh.shape),
+                     "array_shape": list(arr.shape)}
+            node_id = await self._put_shard_async(oid, serialized, attrs)
+            shards.append(da.ShardInfo(
+                ref=ObjectRef(oid, owner_address=self.address,
+                              worker=self, call_site="put_sharded"),
+                rank=rank, node_id=node_id, data_offset=offsets[1],
+                nbytes=raw_frames[1].nbytes, shape=shard.shape))
+        self.reference_counter.add_shard_group(
+            [s.ref.object_id for s in shards])
+        return da.DistributedArray(mesh, spec, arr.shape, str(arr.dtype),
+                                   shards)
+
+    async def _put_shard_async(self, oid: ObjectID,
+                               serialized: SerializedObject,
+                               shard_attrs: dict) -> bytes:
+        """Always-plasma put for one shard: shard-group lineage and the
+        GatherShards collectives need a real segment even when the
+        shard is small enough for the in-process store. ``shard_attrs``
+        ride the SealObject frame into the SEALED object-plane record
+        (state.list_objects() placement surface)."""
+        self.reference_counter.add_owned_object(oid)
+        segment, size = await self._write_segment_async(serialized)
+        reply, _ = await self.raylet_conn.call("SealObject", {
+            "object_id": oid.binary(), "segment": segment, "size": size,
+            "pin": True, "owner_address": self.address,
+            "shard": shard_attrs})
+        if not reply.get("ok"):
+            raise exc.ObjectStoreFullError(
+                f"shard {oid.hex()} ({size} bytes) does not fit in the "
+                f"store")
+        self.reference_counter.add_location(oid, reply["node_id"], size)
+        self.memory_store.put(oid, IN_PLASMA)
+        return reply["node_id"]
+
+    def get_shard(self, darr, rank: int):
+        """Fetch one shard's value (zero-copy attach when local)."""
+        return self.get([darr.shards[rank].ref])[0]
+
+    def assemble(self, darr):
+        """Materialize the full array driver-side by pasting every
+        shard into place (pulls remote shards through the normal
+        striped pull path)."""
+        return self._run(self._assemble_async(darr))
+
+    async def _assemble_async(self, darr):
+        import numpy as np
+
+        from ray_tpu._private import distributed_array as da
+
+        out = np.empty(darr.shape, dtype=np.dtype(darr.dtype_str))
+        slices = da.shard_slices(darr.shape, darr.mesh, darr.spec)
+        for shard in darr.shards:
+            val = await self._get_one(shard.ref, None)
+            out[slices[shard.rank]] = val
+        return out
+
+    def reshard(self, darr, mesh_dst, spec_dst):
+        """Re-partition a DistributedArray onto a new mesh/spec. Every
+        destination shard is built by ONE GatherShards collective whose
+        bulk bytes ride the striped data plane straight into the
+        destination segment (zero intermediate copies); on any typed
+        collective failure the slice falls back to the naive
+        get+assemble+put path (fallback matrix in the README)."""
+        return self._run(self._reshard_async(darr, mesh_dst, spec_dst))
+
+    async def _reshard_async(self, darr, mesh_dst, spec_dst):
+        import numpy as np
+
+        from ray_tpu._private import distributed_array as da
+
+        dtype = np.dtype(darr.dtype_str)
+        da._validate(darr.shape, mesh_dst, spec_dst)
+        plan = da.gather_plan(darr.shape, dtype.itemsize, darr.mesh,
+                              darr.spec, mesh_dst, spec_dst)
+        shards: List[da.ShardInfo] = []
+        for dst_rank in range(mesh_dst.nranks):
+            shape = da.shard_shape(darr.shape, mesh_dst, spec_dst,
+                                   dst_rank)
+            attrs = {"rank": dst_rank,
+                     "coords": list(mesh_dst.coords(dst_rank)),
+                     "mesh": list(mesh_dst.shape),
+                     "array_shape": list(darr.shape)}
+            sources = [{
+                "oid": darr.shards[src_rank].ref.object_id.binary(),
+                "node_id": darr.shards[src_rank].node_id,
+                "data_offset": darr.shards[src_rank].data_offset,
+                "runs": runs,
+            } for src_rank, runs in plan[dst_rank]]
+            info = await self._gather_shard(shape, dtype, attrs, sources)
+            if info is None:
+                # fallback matrix: any dest slice the collective can't
+                # build routes the WHOLE reshard through the naive path
+                # (the already-built slices' refs drop with this list —
+                # no group was registered yet, so they free normally)
+                del shards
+                arr = await self._assemble_async(darr)
+                return await self._put_sharded_async(arr, mesh_dst,
+                                                     spec_dst)
+            shards.append(da.ShardInfo(
+                ref=info[0], rank=dst_rank, node_id=info[1],
+                data_offset=info[2], nbytes=info[3], shape=shape))
+        self.reference_counter.add_shard_group(
+            [s.ref.object_id for s in shards])
+        return da.DistributedArray(mesh_dst, spec_dst, darr.shape,
+                                   darr.dtype_str, shards)
+
+    async def _gather_shard(self, shape, dtype, attrs: dict,
+                            sources: List[dict], reduce_spec=None):
+        """Ask the local raylet to build one destination shard via
+        GatherShards. Returns (ref, node_id, data_offset, nbytes) or
+        None on a typed collective failure (caller falls back)."""
+        import numpy as np
+
+        from ray_tpu._private import distributed_array as da
+
+        # zeros template: np.zeros never touches the calloc'd pages, so
+        # this payload is byte-identical to the real shard's regardless
+        # of content — the destination raylet lays the segment out from
+        # (meta, payload, data_nbytes) alone
+        template = np.zeros(shape, dtype=dtype)
+        serialized = self.serialization_context.serialize(template)
+        _hdr, raw_frames, offsets, total = plan_segment(serialized)
+        if len(raw_frames) != 2:
+            return None
+        oid = self._next_put_id()
+        try:
+            reply, _ = await self.raylet_conn.call(
+                "GatherShards",
+                protocol.GatherShardsRequest(
+                    object_id=oid.binary(),
+                    meta=serialized.metadata,
+                    payload=bytes(raw_frames[0]),
+                    data_nbytes=raw_frames[1].nbytes,
+                    owner_address=self.address,
+                    shard=attrs, sources=sources,
+                    reduce=reduce_spec).to_header())
+        except ConnectionError:
+            reply = {"ok": False, "reason": "raylet unreachable"}
+        if not reply.get("ok"):
+            # nothing sealed, nothing registered: the minted id simply
+            # goes unused and the caller takes the fallback path
+            logger.warning("GatherShards for %s failed (%s); falling "
+                           "back to naive path", oid.hex()[:16],
+                           reply.get("reason"))
+            return None
+        self.reference_counter.add_owned_object(oid)
+        self.reference_counter.add_location(oid, reply["node_id"], total)
+        self.memory_store.put(oid, IN_PLASMA)
+        ref = ObjectRef(oid, owner_address=self.address, worker=self,
+                        call_site="reshard")
+        return ref, reply["node_id"], offsets[1], raw_frames[1].nbytes
+
+    def all_gather(self, darr) -> ObjectRef:
+        """Materialize the FULL array as one new object via a single
+        GatherShards collective (striped data plane); returns its ref.
+        Falls back to assemble+put when the collective fails."""
+        return self._run(self._all_gather_async(darr))
+
+    async def _all_gather_async(self, darr):
+        import numpy as np
+
+        from ray_tpu._private import distributed_array as da
+
+        dtype = np.dtype(darr.dtype_str)
+        mesh1 = da.Mesh((1,), ("gather",))
+        plan = da.gather_plan(darr.shape, dtype.itemsize, darr.mesh,
+                              darr.spec, mesh1, da.PartitionSpec())
+        sources = [{
+            "oid": darr.shards[src_rank].ref.object_id.binary(),
+            "node_id": darr.shards[src_rank].node_id,
+            "data_offset": darr.shards[src_rank].data_offset,
+            "runs": runs,
+        } for src_rank, runs in plan[0]]
+        info = await self._gather_shard(
+            darr.shape, dtype, {"gather": True}, sources)
+        if info is None:
+            arr = await self._assemble_async(darr)
+            oid = self._next_put_id()
+            await self._put_serialized(
+                oid, self.serialization_context.serialize(arr))
+            return ObjectRef(oid, owner_address=self.address,
+                             worker=self, call_site="all_gather")
+        return info[0]
+
+    def all_reduce(self, darr, op: str = "sum") -> ObjectRef:
+        """Fold every rank's full-shape partial into one summed array
+        (each shard must be the full global shape — a replicated spec).
+        The destination raylet streams the first partial straight into
+        the result segment and folds the rest through one reused
+        scratch buffer; returns the result's ref. Falls back to
+        get-all + np.sum when the collective fails."""
+        return self._run(self._all_reduce_async(darr, op))
+
+    async def _all_reduce_async(self, darr, op: str):
+        import numpy as np
+
+        from ray_tpu._private import distributed_array as da
+
+        dtype = np.dtype(darr.dtype_str)
+        nbytes = int(np.prod(darr.shape, dtype=np.int64)) * dtype.itemsize
+        for s in darr.shards:
+            if tuple(s.shape) != tuple(darr.shape):
+                raise ValueError(
+                    "all_reduce needs full-shape partials on every rank "
+                    f"(rank {s.rank} holds {s.shape}, global is "
+                    f"{darr.shape})")
+        sources = [{
+            "oid": s.ref.object_id.binary(), "node_id": s.node_id,
+            "data_offset": s.data_offset,
+            "runs": [[0, 0, nbytes]],
+        } for s in darr.shards]
+        info = await self._gather_shard(
+            darr.shape, dtype, {"reduce": op}, sources,
+            reduce_spec={"op": op, "dtype": darr.dtype_str})
+        if info is not None:
+            return info[0]
+        vals = [await self._get_one(s.ref, None) for s in darr.shards]
+        out = vals[0].copy()
+        for v in vals[1:]:
+            np.add(out, v, out)
+        oid = self._next_put_id()
+        await self._put_serialized(
+            oid, self.serialization_context.serialize(out))
+        return ObjectRef(oid, owner_address=self.address, worker=self,
+                         call_site="all_reduce")
+
     # -------------------------------------------------------- runtime envs
 
     def set_job_runtime_env(self, runtime_env: Optional[Dict]) -> None:
@@ -1399,6 +1948,14 @@ class CoreWorker:
     def _register_and_submit(self, spec: TaskSpec,
                              arg_holds: Optional[List[ObjectRef]] = None
                              ) -> List[ObjectRef]:
+        refs = self._register_task(spec, arg_holds)
+        # SUBMITTED recorded loop-side by _drain_submit_buffer
+        self._enqueue_submit("task", spec)
+        return refs
+
+    def _register_task(self, spec: TaskSpec,
+                       arg_holds: Optional[List[ObjectRef]] = None
+                       ) -> List[ObjectRef]:
         tid_b = spec.task_id
         if spec.num_returns == 1:
             # Hot path (the reference's microbenchmarks are all
@@ -1428,8 +1985,6 @@ class CoreWorker:
                 entry.dep_ids)
         del arg_holds  # promoted args now pinned by submitted-ref counts
         self.stats["tasks_submitted"] += 1
-        # SUBMITTED recorded loop-side by _drain_submit_buffer
-        self._enqueue_submit("task", spec)
         return refs
 
     def queue_local_decref(self, object_id: ObjectID):
@@ -1483,20 +2038,24 @@ class CoreWorker:
             except IndexError:
                 break
         ev = self.task_events
-        if ev.enabled and items:
-            # SUBMITTED stamps for the whole burst, grouped by task
-            # name (one record_many per distinct template): the caller
-            # thread pays nothing, the loop pays one bulk append per
-            # burst instead of one record() per task.
-            by_name: Dict[str, list] = {}
-            for _kind, spec in items:
-                by_name.setdefault(spec.name, []).append(spec.task_id)
-            now = time.time()
-            for tname, tids in by_name.items():
-                ev.record_many(tids, SUBMITTED, tname, ts=now)
+        # SUBMITTED stamps for the whole burst, grouped by task name
+        # (one record_many per distinct template): the caller thread
+        # pays nothing, and the grouping is FUSED into the routing loop
+        # below — one pass over the burst, not a separate stamping pass
+        # (bench.py task_events_overhead pins the submit-path cost).
+        recording = bool(ev.enabled and items)
+        # Stamp ts taken BEFORE the loop: PENDING_ARGS records fired
+        # mid-loop must sort after their task's SUBMITTED event.
+        now = time.time() if recording else 0.0
+        by_name: Dict[str, list] = {}
         touched_keys: Dict[int, SchedulingKeyState] = {}
         touched_actors: Dict[bytes, ActorQueueState] = {}
         for kind, spec in items:
+            if recording:
+                tids = by_name.get(spec.name)
+                if tids is None:
+                    tids = by_name[spec.name] = []
+                tids.append(spec.task_id)
             if kind == "task":
                 # args check first: the dominant argless submit skips
                 # the dependency_ids() call entirely
@@ -1532,6 +2091,9 @@ class CoreWorker:
                 q.seqno += 1
                 q.buffer.append((spec, seqno))
                 touched_actors[spec.actor_id] = q
+        if by_name:
+            for tname, tids in by_name.items():
+                ev.record_many(tids, SUBMITTED, tname, ts=now)
         for sc, state in touched_keys.items():
             self._pump_scheduling_key(sc, state)
         for q in touched_actors.values():
@@ -1888,6 +2450,12 @@ class CoreWorker:
         cancellable timer per worker: re-arming replaces the old timer,
         and the pump cancels it when work lands, so a stale timer can
         never return a lease that went back into use."""
+        if lw.gang is not None:
+            # gang-pinned lease: rank identity must survive between
+            # steps — only the gang's release/teardown path (or the
+            # raylet's owner-liveness watch) ends it
+            return
+
         def _maybe_return():
             lw.idle_timer = None
             if lw not in state.workers or lw.inflight > 0 or state.queue:

@@ -67,6 +67,8 @@ GENERATE = (
     "AddClusterEvents",
     "AddObjectEvents",
     "AddTaskEvents",
+    "BookGangMembers",
+    "GatherShards",
     "GetClusterEvents",
     "GetNodeStats",
     "GetObjectSummary",
@@ -74,8 +76,11 @@ GENERATE = (
     "GrantLeaseCredits",
     "Heartbeat",
     "RegisterNode",
+    "ReleaseGangLease",
+    "ReleaseGangMembers",
     "ReportLeaseDemand",
     "ReportRpcTelemetry",
+    "RequestGangLease",
     "RequestWorkerLease",
     "ReturnWorker",
     "RevokeLeaseCredits",

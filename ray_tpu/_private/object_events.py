@@ -171,6 +171,16 @@ class ObjectTable:
                 size = attrs.get("size") or attrs.get("bytes") or 0
                 if size and size > rec["size"]:
                     rec["size"] = size
+                # DistributedArray shards: SEALED events carry flat
+                # placement attrs (rank / mesh coords); pin them on the
+                # record so list_objects() shows shard placement.
+                if "rank" in attrs and "coords" in attrs:
+                    rec["shard"] = {
+                        "rank": attrs["rank"],
+                        "coords": attrs.get("coords"),
+                        "mesh": attrs.get("mesh"),
+                        "array_shape": attrs.get("array_shape"),
+                    }
             ts = e.get("ts", 0.0)
             history = rec["events"]
             history.append((state, ts, attrs))
@@ -285,7 +295,7 @@ def object_record_to_public(rec: dict) -> dict:
         out_events.append({"state": state, "ts": ts, "dur": dur,
                            "attrs": attrs})
     cur = rec.get("state") or _current_state(events)
-    return {
+    out = {
         "object_id": _hex(rec["object_id"]),
         "job_id": rec["object_id"][:JOB_ID_SIZE].hex(),
         "owner": rec["owner"],
@@ -295,3 +305,6 @@ def object_record_to_public(rec: dict) -> dict:
         "events": out_events,
         "events_dropped": rec.get("events_dropped", 0),
     }
+    if rec.get("shard") is not None:
+        out["shard"] = rec["shard"]
+    return out

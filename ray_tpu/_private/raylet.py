@@ -66,6 +66,31 @@ from ray_tpu._private.task_events import (
 
 logger = logging.getLogger(__name__)
 
+# Prometheus counters for the SPMD layer (distributed_array.py verbs
+# executed by this raylet). Lazily registered like data_channel's
+# _plane_metrics: the counters exist only in processes that actually
+# run gathers/gang leases, and ride the existing metric reporters —
+# no new transport.
+_spmd_prom = None
+
+
+def _spmd_metrics() -> dict:
+    global _spmd_prom
+    if _spmd_prom is None:
+        from ray_tpu._private import metrics as m
+        _spmd_prom = {
+            "reshard_bytes": m.Counter(
+                "ray_tpu_reshard_bytes_total",
+                "DistributedArray bytes moved by GatherShards "
+                "(reshard/all-gather/all-reduce destinations)"),
+            "gang_leases": m.Counter(
+                "ray_tpu_gang_leases_total",
+                "SPMD gang leases granted (one per all-or-nothing "
+                "N-worker booking round)"),
+        }
+    return _spmd_prom
+
+
 def _read_file_chunk(path: str, pos: int, limit: int = 256 * 1024) -> bytes:
     """Bounded read at an offset — executor-thread helper so the log
     monitor never does file I/O on the event loop."""
@@ -267,6 +292,18 @@ class Raylet:
         self._credit_topup_scheduled = False
         self.num_credit_grants = 0
         self.num_credit_revoked = 0
+        # SPMD gang leases (distributed_array.py): gang_id -> record
+        # {epoch, members, broken, dead_members, created, owner_drop}
+        # on the HOME raylet (the one the owner asked), plus the member
+        # bookings THIS node holds for gangs homed on a peer raylet
+        # (gang_id -> {epoch, lease_ids}). Epoch-fenced like actor
+        # incarnations: any gang frame carrying an older epoch is
+        # rejected, and a re-formation at a higher epoch releases the
+        # previous incarnation's members before booking.
+        self.gangs: Dict[bytes, dict] = {}
+        self._gang_members: Dict[bytes, dict] = {}
+        self.num_gang_leases = 0
+        self.num_gang_rejects = 0
         # Schedule latency (request arrival -> decision dispatched), a
         # bounded reservoir for percentile reporting (reference: the
         # north-star p50/p99 schedule-latency metric, BASELINE.json).
@@ -339,6 +376,11 @@ class Raylet:
             "RequestWorkerLease": self.handle_request_worker_lease,
             "ReportLeaseDemand": self.handle_report_lease_demand,
             "ReturnWorker": self.handle_return_worker,
+            "RequestGangLease": self.handle_request_gang_lease,
+            "BookGangMembers": self.handle_book_gang_members,
+            "ReleaseGangMembers": self.handle_release_gang_members,
+            "ReleaseGangLease": self.handle_release_gang_lease,
+            "GatherShards": self.handle_gather_shards,
             "ScheduleActorCreation": self.handle_schedule_actor_creation,
             "KillActorWorker": self.handle_kill_actor_worker,
             "ActorExited": self.handle_actor_exited,
@@ -1656,6 +1698,23 @@ class Raylet:
             for k, v in lease.resources.items():
                 self.resources_available[k] = \
                     self.resources_available.get(k, 0.0) + v
+        gang_id = getattr(lease, "gang_id", None)
+        if gang_id is not None:
+            # A member lease dying out from under a LIVE gang breaks the
+            # whole incarnation (observability mirror of the owner-side
+            # epoch fence: the owner sees the member conn drop and fails
+            # the step; this keeps GetNodeStats truthful about it).
+            rec = self.gangs.get(gang_id)
+            if rec is not None and \
+                    rec["epoch"] == getattr(lease, "gang_epoch", -1) \
+                    and not worker_alive:
+                rec["broken"] = True
+                rec["dead_members"] += 1
+            mem = self._gang_members.get(gang_id)
+            if mem is not None:
+                mem["lease_ids"].discard(lease_id)
+                if not mem["lease_ids"]:
+                    self._gang_members.pop(gang_id, None)
         w = lease.worker
         w.lease_id = None
         if worker_alive and w.state == WORKER_LEASED:
@@ -1957,6 +2016,305 @@ class Raylet:
                 self.num_credit_grants / total, 4) if total else 0.0,
         }
 
+    # ---------------------------------------------------- SPMD gang leases
+
+    def _book_gang_local(self, gang_id: bytes, epoch: int, count: int,
+                         resources: Dict[str, float], env_hash: str,
+                         client) -> List[dict]:
+        """Book up to ``count`` members from THIS node's idle pool —
+        immediately, never waiting: gang placement is all-or-nothing,
+        so a shortfall is reported (and rolled back) rather than parked.
+        Each booking is an ordinary LeaseEntry (owner-liveness reclaim,
+        ReturnWorker, the memory watchdog's victim ordering and the
+        resource ledger all see it like any lease), tagged with the
+        gang id + epoch so releases keep the gang record honest."""
+        members: List[dict] = []
+        while len(members) < count:
+            if not all(self.resources_available.get(k, 0.0) + 1e-9 >= v
+                       for k, v in resources.items() if v > 0):
+                break
+            worker = self._pop_idle_worker(env_hash)
+            if worker is None:
+                break
+            worker.env_hash = env_hash
+            lease_id = next(self._lease_counter)
+            for k, v in resources.items():
+                self.resources_available[k] = \
+                    self.resources_available.get(k, 0.0) - v
+            worker.state = WORKER_LEASED
+            worker.lease_id = lease_id
+            worker.leased_at = time.monotonic()
+            # gang steps run with max_retries=0 (a dead member fails
+            # the whole step) — never a watchdog retriable victim
+            worker.lease_retriable = False
+            lease = LeaseEntry(lease_id, worker, dict(resources), client)
+            lease.gang_id = gang_id      # type: ignore[attr-defined]
+            lease.gang_epoch = epoch     # type: ignore[attr-defined]
+            self.leases[lease_id] = lease
+            self._watch_lease_client(lease)
+            self.num_leases_granted += 1
+            members.append({"lease_id": lease_id,
+                            "worker_address": worker.address,
+                            "worker_id": worker.worker_id,
+                            "node_id": self.node_id.binary()})
+        return members
+
+    async def _release_gang_remote(self, node_id: bytes, gang_id: bytes,
+                                   epoch: int, lease_ids: List[int],
+                                   kill: bool) -> None:
+        info = await self._lookup_node(node_id)
+        if info is None:
+            return
+        try:
+            peer = await self._peer_conn(info["address"])
+            await peer.call(
+                "ReleaseGangMembers",
+                protocol.ReleaseGangMembersRequest(
+                    gang_id=gang_id, epoch=epoch,
+                    lease_ids=lease_ids, kill=kill).to_header())
+        # raylint: disable=exception-hygiene — best-effort: a dead peer's bookings die with it (owner-liveness reclaim)
+        except Exception:
+            pass
+
+    async def _release_gang(self, gang_id: bytes, rec: dict,
+                            kill: bool = False) -> None:
+        """Release every member of one gang incarnation: local leases
+        through _release_lease, remote bookings via ReleaseGangMembers
+        fan-out. Pops the record first so a re-entrant release (owner
+        drop racing an explicit ReleaseGangLease) is a no-op."""
+        if self.gangs.get(gang_id) is rec:
+            self.gangs.pop(gang_id, None)
+        drop = rec.pop("owner_drop", None)
+        conn = rec.pop("owner_conn", None)
+        if drop is not None and conn is not None and \
+                drop in conn.on_disconnect:
+            conn.on_disconnect.remove(drop)
+        me = self.node_id.binary()
+        remote: Dict[bytes, List[int]] = {}
+        for m in rec["members"]:
+            if m["node_id"] == me:
+                lease = self.leases.get(m["lease_id"])
+                if lease is not None:
+                    if kill:
+                        self._kill_worker(lease.worker)
+                    self._release_lease(m["lease_id"],
+                                        worker_alive=not kill)
+            else:
+                remote.setdefault(m["node_id"], []).append(m["lease_id"])
+        if remote:
+            await asyncio.gather(*(
+                self._release_gang_remote(nid, gang_id, rec["epoch"],
+                                          lids, kill)
+                for nid, lids in remote.items()))
+
+    async def _rollback_gang_booking(self, gang_id: bytes, epoch: int,
+                                     members: List[dict],
+                                     peer_bookings: List[Tuple[bytes,
+                                                               List[int]]]
+                                     ) -> None:
+        me = self.node_id.binary()
+        for m in members:
+            if m["node_id"] == me:
+                self._release_lease(m["lease_id"], worker_alive=True)
+        if peer_bookings:
+            await asyncio.gather(*(
+                self._release_gang_remote(nid, gang_id, epoch, lids,
+                                          kill=False)
+                for nid, lids in peer_bookings))
+
+    async def handle_request_gang_lease(self, conn, header, bufs):
+        """ONE lease round books N workers across the cluster, or none:
+        the home raylet takes what its own pool serves, fans
+        BookGangMembers out to peers for the remainder, and rolls the
+        whole booking back on any shortfall (all-or-nothing — Tesserae-
+        style gang placement on the PR11 lease machinery). Epoch-fenced
+        like actor incarnations: a request at or below the live
+        incarnation's epoch is rejected; a higher epoch releases the
+        old incarnation before booking the new one."""
+        req = protocol.RequestGangLeaseRequest.from_header(header)
+        gang_id = req.gang_id
+        epoch = int(req.epoch)
+        count = int(req.count)
+        resources = dict(req.get("resources") or {"CPU": 1.0})
+        env_hash = runtime_env_mod.hash_runtime_env(
+            req.get("runtime_env"))
+        rec = self.gangs.get(gang_id)
+        if rec is not None and epoch <= rec["epoch"]:
+            self.num_gang_rejects += 1
+            return {"granted": False, "stale_epoch": True,
+                    "current_epoch": rec["epoch"]}
+        if rec is not None:
+            # re-formation: the new epoch invalidates the previous
+            # incarnation BEFORE any booking, so a stale member can
+            # never survive into the new gang
+            await self._release_gang(gang_id, rec)
+        if self.memory_monitor.pressure:
+            return {"granted": False, "retry_later": True,
+                    "reason": "node memory pressure"}
+        members = self._book_gang_local(gang_id, epoch, count, resources,
+                                        env_hash, conn)
+        peer_bookings: List[Tuple[bytes, List[int]]] = []
+        try:
+            if len(members) < count:
+                # widest-pool peers first: fewer fan-out hops per round
+                candidates = sorted(
+                    self.remote_nodes.items(),
+                    key=lambda kv: -kv[1]["resources_available"].get(
+                        "CPU", 0.0))
+                for nid, info in candidates:
+                    if len(members) >= count:
+                        break
+                    try:
+                        peer = await self._peer_conn(info["address"])
+                        reply, _ = await peer.call(
+                            "BookGangMembers",
+                            protocol.BookGangMembersRequest(
+                                gang_id=gang_id, epoch=epoch,
+                                count=count - len(members),
+                                resources=resources,
+                                env_hash=env_hash).to_header())
+                    except (ConnectionError, asyncio.TimeoutError):
+                        continue
+                    got = reply.get("members") or []
+                    if got:
+                        peer_bookings.append(
+                            (nid, [m["lease_id"] for m in got]))
+                        members.extend(got)
+        except asyncio.CancelledError:
+            await self._rollback_gang_booking(gang_id, epoch, members,
+                                              peer_bookings)
+            raise
+        if len(members) < count:
+            deficit = count - len(members)
+            await self._rollback_gang_booking(gang_id, epoch, members,
+                                              peer_bookings)
+            # prestart toward the deficit so a retry converges instead
+            # of rediscovering the same empty pool
+            for _ in range(deficit):
+                if self._alive_worker_count() + self._num_starting < \
+                        self.max_workers:
+                    self._start_worker_process()
+            self.num_gang_rejects += 1
+            return {"granted": False, "retry_later": True,
+                    "reason": f"booked {len(members)}/{count} workers"}
+        for rank, m in enumerate(members):
+            m["rank"] = rank
+        rec = {"epoch": epoch, "members": members,
+               "created": time.time(), "broken": False,
+               "dead_members": 0}
+        self.gangs[gang_id] = rec
+
+        def _on_owner_drop(c, gid=gang_id, r=rec):
+            if self.gangs.get(gid) is r:
+                asyncio.get_event_loop().create_task(
+                    self._release_gang(gid, r, kill=True))
+
+        rec["owner_conn"] = conn
+        rec["owner_drop"] = _on_owner_drop
+        conn.on_disconnect.append(_on_owner_drop)
+        self.num_gang_leases += 1
+        _spmd_metrics()["gang_leases"].inc()
+        self.events.emit(
+            "INFO", "GANG_LEASE_GRANTED",
+            f"gang {gang_id.hex()[:12]} epoch {epoch}: booked "
+            f"{count} workers across "
+            f"{len({m['node_id'] for m in members})} node(s)",
+            node=self._nid12, size=count, epoch=epoch)
+        return {"granted": True, "epoch": epoch,
+                "members": [dict(m) for m in members]}
+
+    async def handle_book_gang_members(self, conn, header, bufs):
+        """Peer side of the gang fan-out: book what this node's idle
+        pool serves RIGHT NOW (the home raylet enforces all-or-nothing
+        globally and rolls back on shortfall). The booking's lease
+        client is the home raylet's connection — a dead home raylet
+        reclaims its bookings through the ordinary owner-liveness
+        watch."""
+        req = protocol.BookGangMembersRequest.from_header(header)
+        gang_id = req.gang_id
+        epoch = int(req.epoch)
+        mem = self._gang_members.get(gang_id)
+        if mem is not None and epoch < mem["epoch"]:
+            return {"members": [], "stale_epoch": True}
+        if self.memory_monitor.pressure:
+            return {"members": [], "reason": "node memory pressure"}
+        members = self._book_gang_local(
+            gang_id, epoch, int(req.count),
+            dict(req.get("resources") or {}),
+            req.get("env_hash", ""), conn)
+        if members:
+            mem = self._gang_members.get(gang_id)
+            if mem is None or epoch > mem["epoch"]:
+                mem = self._gang_members[gang_id] = {
+                    "epoch": epoch, "lease_ids": set()}
+            mem["lease_ids"].update(m["lease_id"] for m in members)
+        elif self._alive_worker_count() + self._num_starting < \
+                self.max_workers:
+            self._start_worker_process()  # converge a retry's shortfall
+        return {"members": members}
+
+    async def handle_release_gang_members(self, conn, header, bufs):
+        req = protocol.ReleaseGangMembersRequest.from_header(header)
+        gang_id = req.gang_id
+        epoch = int(req.epoch)
+        mem = self._gang_members.get(gang_id)
+        if mem is not None and epoch < mem["epoch"]:
+            # stale release from a previous incarnation must not tear
+            # down a newer booking
+            return {"ok": False, "stale_epoch": True}
+        kill = bool(req.get("kill", False))
+        for lid in req.get("lease_ids") or []:
+            lease = self.leases.get(lid)
+            if lease is None or \
+                    getattr(lease, "gang_id", None) != gang_id:
+                continue
+            if kill:
+                # broken-gang teardown: the member may be mid-step for
+                # the dead incarnation — recycling it as "idle" would
+                # poison its next lease
+                self._kill_worker(lease.worker)
+            self._release_lease(lid, worker_alive=not kill)
+        return {"ok": True}
+
+    async def handle_release_gang_lease(self, conn, header, bufs):
+        """Owner -> home raylet gang teardown, epoch-fenced: a release
+        carrying an older epoch than the live incarnation is the stale
+        member's push after re-formation — rejected, never applied."""
+        req = protocol.ReleaseGangLeaseRequest.from_header(header)
+        gang_id = req.gang_id
+        epoch = int(req.epoch)
+        rec = self.gangs.get(gang_id)
+        if rec is None:
+            return {"ok": True, "already_released": True}
+        if epoch < rec["epoch"]:
+            self.num_gang_rejects += 1
+            return {"ok": False, "stale_epoch": True,
+                    "current_epoch": rec["epoch"]}
+        await self._release_gang(gang_id, rec,
+                                 kill=bool(req.get("kill", False)))
+        return {"ok": True}
+
+    def _gang_stats(self) -> dict:
+        return {
+            "homed": [{
+                "gang_id": gid.hex(),
+                "epoch": rec["epoch"],
+                "size": len(rec["members"]),
+                "nodes": sorted({m["node_id"].hex()[:12]
+                                 for m in rec["members"]}),
+                "broken": rec["broken"],
+                "dead_members": rec["dead_members"],
+                "created": rec["created"],
+            } for gid, rec in self.gangs.items()],
+            "member_bookings": [{
+                "gang_id": gid.hex(),
+                "epoch": mem["epoch"],
+                "leases": len(mem["lease_ids"]),
+            } for gid, mem in self._gang_members.items()],
+            "num_gang_leases": self.num_gang_leases,
+            "num_gang_rejects": self.num_gang_rejects,
+        }
+
     # -------------------------------------------------------------- actors
 
     async def handle_schedule_actor_creation(self, conn, header, bufs):
@@ -2097,7 +2455,11 @@ class Raylet:
 
     async def handle_seal_object(self, conn, header, bufs):
         oid = ObjectID(header["object_id"])
-        ok = self.store.seal(oid, header["segment"], header["size"])
+        # "shard": DistributedArray placement attrs (rank / mesh
+        # coords), folded into the SEALED object-plane record so
+        # state.list_objects() shows where each shard landed
+        ok = self.store.seal(oid, header["segment"], header["size"],
+                             attrs=header.get("shard"))
         if ok and header.get("pin", False):
             self.store.pin(oid)
         if ok and header.get("owner_address"):
@@ -2186,6 +2548,39 @@ class Raylet:
         return {"found": True, "total_size": entry[1],
                 "data_address": self.data_address}
 
+    async def _attach_serve_segment(self, segment: str):
+        """Cached shared-memory attachment of a LOCAL segment for read
+        serving (control-plane chunk serves + gather local-source
+        copies). _QuietSharedMemory: cache eviction may race an
+        in-flight chunk send whose memoryview still pins the mapping —
+        deferred release absorbs that instead of leaking the fd on
+        BufferError. Attached in an executor: the MAP_POPULATE remap of
+        a GiB-scale segment must not stall the raylet loop."""
+        shm = self._serve_attachments.get(segment)
+        if shm is not None:
+            return shm
+        from ray_tpu._private.shm_store import _QuietSharedMemory
+        new_shm = await asyncio.get_running_loop().run_in_executor(
+            None, _QuietSharedMemory, segment)
+        shm = self._serve_attachments.get(segment)
+        if shm is not None:  # raced a concurrent first attach
+            try:
+                new_shm.close()
+            except BufferError:
+                pass
+            return shm
+        shm = new_shm
+        # bounded cache: drop the oldest attachment beyond 16
+        while len(self._serve_attachments) >= 16:
+            oldest = next(iter(self._serve_attachments))
+            old = self._serve_attachments.pop(oldest)
+            try:
+                old.close()
+            except BufferError:
+                pass  # a concurrent chunk read still holds it
+        self._serve_attachments[segment] = shm
+        return shm
+
     async def handle_fetch_object_chunk(self, conn, header, bufs):
         """Serve one chunk of a remote raylet's pull over the CONTROL
         plane (reference: the chunked Push path,
@@ -2204,34 +2599,7 @@ class Raylet:
         self.store.mark_exposed(oid)
         offset = header["offset"]
         length = header["length"]
-        shm = self._serve_attachments.get(segment)
-        if shm is None:
-            from ray_tpu._private.shm_store import _QuietSharedMemory
-            # _QuietSharedMemory: cache eviction below may race an
-            # in-flight chunk send whose memoryview still pins the
-            # mapping — deferred release absorbs that instead of
-            # leaking the fd on BufferError. Attached in an executor:
-            # the MAP_POPULATE remap of a GiB-scale segment must not
-            # stall the raylet loop.
-            new_shm = await asyncio.get_running_loop().run_in_executor(
-                None, _QuietSharedMemory, segment)
-            shm = self._serve_attachments.get(segment)
-            if shm is not None:  # raced a concurrent first chunk
-                try:
-                    new_shm.close()
-                except BufferError:
-                    pass
-            else:
-                shm = new_shm
-                # bounded cache: drop the oldest attachment beyond 16
-                while len(self._serve_attachments) >= 16:
-                    oldest = next(iter(self._serve_attachments))
-                    old = self._serve_attachments.pop(oldest)
-                    try:
-                        old.close()
-                    except BufferError:
-                        pass  # a concurrent chunk read still holds it
-                self._serve_attachments[segment] = shm
+        shm = await self._attach_serve_segment(segment)
         entry = self.store._objects.get(oid)  # noqa: SLF001
         total = entry[1] if entry is not None else shm.size
         end = min(offset + length, total)
@@ -2674,6 +3042,271 @@ class Raylet:
         finally:
             self._pull_inflight_bytes -= total
             self._notify_pull_done()
+
+    # ---------------------------------------------- shard collectives
+
+    async def handle_gather_shards(self, conn, header, bufs):
+        """Build ONE destination shard locally by scatter-gathering byte
+        runs out of source shards cluster-wide — the collective data
+        path behind DistributedArray reshard / all-gather / all-reduce.
+        The header carries only the plan (per-source ``runs`` are
+        [src_off, dst_off, length] triples relative to each shard's raw
+        data frame); the bulk bytes ride the striped data plane with
+        ``recv_into`` landing every chunk DIRECTLY in the destination
+        segment — zero intermediate copies end to end. Local sources
+        are GIL-releasing memcpys in the executor. Shares the pull
+        path's admission budget, chunk sizing and discard discipline."""
+        from ray_tpu._private.distributed_array import frame_plan
+        from ray_tpu._private.shm_store import (
+            RECYCLE_MIN_BYTES, _U32, _close_segment_owner, acquire_segment)
+
+        req = protocol.GatherShardsRequest.from_header(header)
+        oid = ObjectID(req.object_id)
+        if self.store.contains(oid):
+            segment = self.store.lookup(oid)
+            if segment is not None:  # idempotent retry: already built
+                self.store.mark_exposed(oid)
+                return {"ok": True, "segment": segment,
+                        "node_id": self.node_id.binary()}
+        meta = req.meta
+        payload = req.payload
+        data_nbytes = int(req.data_nbytes)
+        sources = req.sources
+        # destination layout from sizes alone: [payload frame, data
+        # frame], byte-identical to what plan_segment would produce
+        hdr, offsets, total = frame_plan(
+            meta, [len(payload), data_nbytes])
+        me = self.node_id.binary()
+        n_remote = len({s["node_id"] for s in sources
+                        if s["node_id"] != me})
+        chunk = self.config.reshard_chunk_bytes or \
+            self._pull_chunk_size(data_nbytes, max(1, n_remote))
+        await self._admit_pull(total, chunk)
+        t0 = time.monotonic()
+        try:
+            alloc = self.store.take_recycled(total) \
+                if total >= RECYCLE_MIN_BYTES else None
+            loop = asyncio.get_running_loop()
+            name, owner, buf = await loop.run_in_executor(
+                None, acquire_segment, alloc, max(total, 1))
+
+            def _discard():
+                _close_segment_owner(owner, buf)
+                self.store.release_lease(name)
+                self._unlink_segment(name)
+
+            try:
+                buf[0:4] = _U32.pack(len(hdr))
+                buf[4:4 + len(hdr)] = hdr
+                buf[offsets[0]:offsets[0] + len(payload)] = payload
+                reduce_spec = req.get("reduce")
+                if reduce_spec:
+                    moved = await self._gather_reduce(
+                        buf, offsets[1], data_nbytes, chunk, sources,
+                        reduce_spec)
+                else:
+                    moved = await self._gather_runs(
+                        buf, offsets[1], chunk, sources)
+            except asyncio.CancelledError:
+                # every gather job was cancelled AND awaited before the
+                # re-raise reached here (see _gather_runs), so no
+                # orphan receive can land in the unlinked mapping
+                _discard()
+                raise
+            except (ConnectionError, OSError, ValueError) as e:
+                # typed failure back to the driver: it falls back to
+                # the naive get+assemble+put path (fallback matrix)
+                _discard()
+                return {"ok": False, "reason": str(e)}
+            _close_segment_owner(owner, buf)
+            self.store.release_lease(name)
+            if not self.store.seal(oid, name, total,
+                                   attrs=req.get("shard")):
+                return {"ok": False,
+                        "reason": "local store refused seal (capacity)"}
+            if req.get("owner_address"):
+                # leak-detector owner index, same as the seal/pull paths
+                self._object_owners[oid.binary()] = \
+                    req.owner_address
+            self.store.mark_exposed(oid)  # a sibling gather may read it
+            _spmd_metrics()["reshard_bytes"].inc(moved)
+            wall = time.monotonic() - t0
+            if self.object_events.enabled:
+                self.object_events.record(
+                    oid.binary(), PULLED,
+                    {"bytes": moved, "dur": wall, "node": self._nid12,
+                     "sources": len(sources), "gather": True},
+                    ts=time.time() - wall)
+            return {"ok": True, "segment": name,
+                    "node_id": self.node_id.binary()}
+        finally:
+            self._pull_inflight_bytes -= total
+            self._notify_pull_done()
+
+    async def _gather_runs(self, buf, data_off: int, chunk: int,
+                           sources: List[dict]) -> int:
+        """Execute a gather plan into ``buf``: per-source byte runs
+        rebased to segment-absolute on the source side (``data_offset +
+        src_off``) and destination-buffer-absolute on ours (``data_off
+        + dst_off``). Remote nodes stream concurrently over every
+        stripe of their data channel (or the legacy control lane);
+        failure unwinds with every sibling job cancelled AND awaited,
+        so the caller may unlink the destination mapping immediately.
+        Returns total bytes moved."""
+        from collections import deque
+
+        from ray_tpu._private import data_channel, native
+
+        me = self.node_id.binary()
+        local: List[dict] = []
+        by_node: Dict[bytes, List[dict]] = {}
+        moved = 0
+        for src in sources:
+            for run in src["runs"]:
+                moved += run[2]
+            if src["node_id"] == me:
+                local.append(src)
+            else:
+                by_node.setdefault(src["node_id"], []).append(src)
+        loop = asyncio.get_running_loop()
+
+        async def _local_job():
+            for src in local:
+                s_oid = ObjectID(src["oid"])
+                segment = self.store.lookup(s_oid)
+                if segment is None:
+                    raise ConnectionError(
+                        f"local shard {s_oid.hex()[:12]} vanished")
+                # the gather reads this segment via a foreign-style
+                # mapping: it must never enter the recycle pool mid-copy
+                self.store.mark_exposed(s_oid)
+                shm = await self._attach_serve_segment(segment)
+                base = src["data_offset"]
+
+                def _copy(runs=src["runs"], base=base, sbuf=shm.buf):
+                    for s, d, ln in runs:
+                        native.copy_into(buf, data_off + d,
+                                         sbuf[base + s:base + s + ln])
+                # one executor batch per source: GIL-releasing memcpys
+                # off the raylet loop
+                await loop.run_in_executor(None, _copy)
+
+        async def _remote_job(nid: bytes, srcs: List[dict]):
+            info = await self._lookup_node(nid)
+            if info is None:
+                raise ConnectionError(
+                    f"shard holder node {nid.hex()[:12]} unknown")
+            peer = await self._peer_conn(info["address"])
+            work: deque = deque()
+            data_address = ""
+            for src in srcs:
+                # the meta probe pins the source segment serve-side
+                # (mark_exposed) and yields the bulk endpoint
+                reply, _ = await peer.call(
+                    "FetchObjectMeta", {"object_id": src["oid"]})
+                if not reply.get("found"):
+                    raise ConnectionError(
+                        "source shard "
+                        f"{src['oid'].hex()[:12]} not found on holder")
+                data_address = reply.get("data_address") or \
+                    info.get("data_address", "")
+                base = src["data_offset"]
+                for s, d, ln in src["runs"]:
+                    off = 0
+                    while off < ln:
+                        n = min(chunk, ln - off)
+                        work.append((src["oid"], base + s + off,
+                                     data_off + d + off, n))
+                        off += n
+            channel = None
+            if data_address and self.config.data_plane_stripes > 0:
+                try:
+                    channel = await self._data_channel(data_address)
+                except ConnectionError:
+                    channel = None  # data port dead; control conn lives
+            fetchers = []
+            if channel is not None:
+                for stripe in channel.stripes:
+                    async def _fetch(item, _s=stripe, _ch=channel):
+                        ob, s_abs, d_abs, n = item
+                        await _ch.fetch_chunk(_s, ob, s_abs, n,
+                                              buf, d_abs)
+                    fetchers.append(_fetch)
+            else:
+                async def _legacy(item, _conn=peer):
+                    ob, s_abs, d_abs, n = item
+                    floor = self.config.object_manager_chunk_size
+                    sub = 0
+                    while sub < n:
+                        want = min(floor, n - sub)
+                        r, bufs2 = await _conn.call(
+                            "FetchObjectChunk", {
+                                "object_id": ob, "offset": s_abs + sub,
+                                "length": want})
+                        if not r.get("found") or len(bufs2[0]) != want:
+                            raise ConnectionError(
+                                "short/missing chunk from shard holder")
+                        native.copy_into(buf, d_abs + sub, bufs2[0])
+                        data_channel.note_control_chunk(want)
+                        sub += want
+                fetchers.extend([_legacy] * 8)
+            if work:
+                await data_channel.run_striped(work, fetchers)
+
+        jobs = []
+        if local:
+            jobs.append(loop.create_task(_local_job()))
+        jobs.extend(loop.create_task(_remote_job(nid, srcs))
+                    for nid, srcs in by_node.items())
+        try:
+            await asyncio.gather(*jobs)
+        except BaseException:
+            # cancel-and-AWAIT every sibling before unwinding: the
+            # caller unlinks the destination mapping right after, and
+            # an orphan recv_into must not land in a closed mmap
+            for j in jobs:
+                j.cancel()
+            await asyncio.gather(*jobs, return_exceptions=True)
+            raise
+        return moved
+
+    async def _gather_reduce(self, buf, data_off: int, data_nbytes: int,
+                             chunk: int, sources: List[dict],
+                             reduce_spec: dict) -> int:
+        """All-reduce destination build: the first source streams
+        straight into the destination data frame; each further source
+        streams into ONE reused scratch buffer and is folded in with a
+        vectorized executor-side ``np.add`` — peak extra memory is one
+        shard regardless of fan-in."""
+        import numpy as np
+
+        op = reduce_spec.get("op", "sum")
+        if op != "sum":
+            raise ValueError(f"unsupported reduce op: {op!r}")
+        dtype = np.dtype(reduce_spec["dtype"])
+        count = data_nbytes // dtype.itemsize
+
+        def _fold(scr):
+            # the frombuffer view EXPORTS buf's mapping, so it is
+            # created AND dropped inside this executor call — an array
+            # passed through (or returned from) run_in_executor lingers
+            # in the work-item/future plumbing and makes the caller's
+            # _close_segment_owner fail with BufferError
+            dest = np.frombuffer(buf, dtype=dtype, count=count,
+                                 offset=data_off)
+            np.add(dest, scr, dest)
+            del dest
+
+        moved = await self._gather_runs(buf, data_off, chunk,
+                                        sources[:1])
+        if len(sources) > 1:
+            scratch = np.empty(count, dtype=dtype)
+            sbuf = memoryview(scratch).cast("B")
+            loop = asyncio.get_running_loop()
+            for src in sources[1:]:
+                moved += await self._gather_runs(sbuf, 0, chunk, [src])
+                await loop.run_in_executor(None, _fold, scratch)
+        return moved
 
     @staticmethod
     def _unlink_segment(name: str):
@@ -3125,6 +3758,9 @@ class Raylet:
             "num_spillbacks": self.num_spillbacks,
             # streaming-lease window state + credit hit-rate
             "lease_credits": self._credit_stats(),
+            # SPMD gang leases: incarnations homed here + member
+            # bookings this node holds for gangs homed elsewhere
+            "gangs": self._gang_stats(),
             "store": self.store.stats(),
             # per-process writer mapping cache (zero-copy put tier;
             # meaningful where writers share this process, i.e. the

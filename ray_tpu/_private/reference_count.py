@@ -55,7 +55,8 @@ class Reference:
 
     __slots__ = ("owned", "owner_address", "local_refs", "submitted_refs",
                  "contained_in", "contains", "borrowers", "locations",
-                 "in_plasma", "pinned_lineage", "freed", "size")
+                 "in_plasma", "pinned_lineage", "freed", "size",
+                 "shard_group")
 
     def __init__(self):
         self.owned = False
@@ -73,6 +74,11 @@ class Reference:
         self.freed = False
         # Data size in bytes (plasma objects; feeds locality scheduling).
         self.size = 0
+        # DistributedArray shard set: a SHARED set of member keys, the
+        # same set object on every member ref. The shard set is ONE
+        # lineage unit — no member releases until every member is
+        # releasable (see _maybe_release).
+        self.shard_group: Optional[Set[bytes]] = None
 
     def is_releasable(self) -> bool:
         return (self.local_refs == 0 and self.submitted_refs == 0
@@ -224,6 +230,34 @@ class ReferenceCounter:
             for oid in inner:
                 ev.record(_key(oid), oev.CONTAINED, {"in": outer_hex})
 
+    # -- shard groups (DistributedArray lineage units) -----------------------
+
+    def add_shard_group(self, object_ids) -> None:
+        """Bind the shard refs of one DistributedArray into a single
+        lineage unit. Every member ref points at the SAME shared set of
+        member keys; ``_maybe_release`` refuses to release any member
+        while a sibling is still reachable, then releases the whole set
+        at once — so a half-dropped array never strands shard segments
+        on remote nodes, and the leak detector sees one coherent
+        out-of-scope wave instead of a ragged trickle."""
+        keys = [_key(oid) for oid in object_ids]
+        group = set(keys)
+        with self._lock:
+            for k in keys:
+                ref = self._refs.setdefault(k, Reference())
+                ref.shard_group = group
+
+    def _shard_group_releasable(self, group: Set[bytes]) -> bool:
+        """All members gone-or-releasable? Caller holds the lock. A key
+        missing from the table counts as released (already freed)."""
+        for mk in group:
+            mref = self._refs.get(mk)
+            if mref is None or mref.freed:
+                continue
+            if not mref.is_releasable():
+                return False
+        return True
+
     # -- borrowers (owner side) ---------------------------------------------
 
     def add_borrower(self, object_id, borrower_address: str) -> None:
@@ -360,10 +394,31 @@ class ReferenceCounter:
             ref = self._refs.get(k)
             if ref is None or ref.freed or not ref.is_releasable():
                 return
+            stack: List[tuple] = []
+
+            def expand(ki, r) -> None:
+                # Shard-group gate: a releasable member DEFERS until every
+                # sibling is releasable; the last drop then releases the
+                # whole set in one wave (each member cleared of its group
+                # tag so the normal walk below handles it — containment
+                # edges included).
+                group = r.shard_group
+                if group is None:
+                    stack.append((ki, r))
+                    return
+                if not self._shard_group_releasable(group):
+                    return
+                for mk in group:
+                    mref = self._refs.get(mk)
+                    if mref is None or mref.freed:
+                        continue
+                    mref.shard_group = None
+                    stack.append((mk, mref))
+
+            expand(k, ref)
             # Transitive containment walk: releasing an outer object drops
             # the containment edges on its inner objects, which may free
             # them — and their own contained objects, to any depth.
-            stack = [(k, ref)]
             while stack:
                 ki, r = stack.pop()
                 if r.freed:
@@ -377,7 +432,7 @@ class ReferenceCounter:
                     if iref.contained_in:
                         iref.contained_in.discard(ki)
                     if iref.is_releasable() and not iref.freed:
-                        stack.append((inner, iref))
+                        expand(inner, iref)
             for ki, _ in to_release:
                 self._refs.pop(ki, None)
         ev = self.events

@@ -760,7 +760,12 @@ class ShmStoreServer:
         self.recycle_bytes -= freed
         return freed
 
-    def seal(self, object_id: ObjectID, segment_name: str, size: int) -> bool:
+    def seal(self, object_id: ObjectID, segment_name: str, size: int,
+             attrs: Optional[dict] = None) -> bool:
+        # ``attrs``: extra keys folded into the SEALED object-plane
+        # record (e.g. DistributedArray shard placement — rank / mesh
+        # coords — so state.list_objects() can show WHERE each shard of
+        # a sharded array landed without a second event).
         if faultpoints.armed and faultpoints.fire(
                 "shm.seal", oid=object_id.hex(), size=size) == "refuse":
             # seal fault: the store refuses the segment (capacity-style
@@ -792,8 +797,10 @@ class ShmStoreServer:
         self._last_access[object_id] = time.time()
         self._exposed.discard(object_id)  # fresh segment, no foreign maps
         self.used += size
-        self._rec(object_id, oev.SEALED,
-                  {"size": size, "segment": segment_name})
+        ev_attrs = {"size": size, "segment": segment_name}
+        if attrs:
+            ev_attrs.update(attrs)
+        self._rec(object_id, oev.SEALED, ev_attrs)
         return True
 
     # -- read path ----------------------------------------------------------

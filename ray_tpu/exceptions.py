@@ -179,3 +179,20 @@ class PendingCallsLimitExceeded(RayTpuError):
 
 class AsyncioActorExit(RayTpuError):
     """Raised inside an async actor to exit it gracefully."""
+
+
+class GangPlacementError(RayTpuError):
+    """An all-or-nothing SPMD gang lease could not be satisfied.
+
+    Raised when the home raylet's booking round (RequestGangLease) came
+    back short after every configured retry — no partial gang is ever
+    adopted, so nothing was leased when this surfaces."""
+
+
+class GangBrokenError(RayTpuError):
+    """The SPMD gang lost a member and the incarnation is invalid.
+
+    A dead member invalidates the WHOLE step (epoch fence, like actor
+    incarnations): in-flight step tasks fail with
+    :class:`WorkerCrashedError`, and further ``run()`` calls raise this
+    until ``reform()`` books a fresh incarnation at epoch+1."""

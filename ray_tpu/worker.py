@@ -257,6 +257,60 @@ def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
                        fetch_local=fetch_local)
 
 
+def put_sharded(array, mesh, spec):
+    """Shard ``array`` (a numpy ndarray) over ``mesh`` according to
+    ``spec`` (a PartitionSpec): one first-class object per shard, placed
+    round-robin across the cluster's shm stores. Returns a
+    ``DistributedArray`` handle whose shard refs free as one unit."""
+    w = _require_connected()
+    return w.core.put_sharded(array, mesh, spec)
+
+
+def get_shard(darr, rank: int):
+    """Fetch one shard of a DistributedArray by mesh rank."""
+    w = _require_connected()
+    return w.core.get_shard(darr, rank)
+
+
+def assemble(darr):
+    """Gather every shard and paste into one local ndarray."""
+    w = _require_connected()
+    return w.core.assemble(darr)
+
+
+def reshard(darr, mesh, spec):
+    """Re-partition a DistributedArray onto a new mesh/spec. Bulk bytes
+    ride the striped data plane straight into the destination shards'
+    segments (zero intermediate copies); falls back to get+put if a
+    gather fails."""
+    w = _require_connected()
+    return w.core.reshard(darr, mesh, spec)
+
+
+def all_gather(darr):
+    """Collective: gather all shards into ONE replicated object and
+    return its ObjectRef."""
+    w = _require_connected()
+    return w.core.all_gather(darr)
+
+
+def all_reduce(darr, op: str = "sum"):
+    """Collective: element-wise reduce full-shape partials (one per
+    rank) into one object; reduction folds chunk-by-chunk on the
+    destination raylet."""
+    w = _require_connected()
+    return w.core.all_reduce(darr, op=op)
+
+
+def create_gang(world_size: int, *, resources=None, runtime_env=None):
+    """Gang-schedule ``world_size`` workers across the cluster in ONE
+    all-or-nothing lease round. Returns an ``SpmdGang`` whose ``run(fn)``
+    launches one epoch-fenced SPMD step per member."""
+    w = _require_connected()
+    return w.core.create_gang(world_size, resources=resources,
+                              runtime_env=runtime_env)
+
+
 def kill(actor_handle, *, no_restart: bool = True):
     from ray_tpu.actor import ActorHandle
     w = _require_connected()

@@ -80,6 +80,7 @@ SCHEDULE_KINDS = (
     "stripe_sever", "corrupt_chunk", "short_read", "delay_storm",
     "raylet_kill", "heartbeat_partition", "gcs_restart", "mixed",
     "worker_kill", "oom_storm", "credit_revoke", "mixed_version",
+    "gang_kill",
 )
 
 # Event vocabulary for the data-plane harness. Each entry generates a
@@ -112,7 +113,7 @@ def make_schedule(kind: str, seed: int, rounds: int = 8,
     still alive at run time)."""
     if kind not in _KIND_OPS and kind not in (
             "worker_kill", "oom_storm", "credit_revoke",
-            "mixed_version"):
+            "mixed_version", "gang_kill"):
         raise ValueError(f"unknown schedule kind {kind!r}")
     if kind == "worker_kill":
         # the worker-kill schedule is carried by the RAY_TPU_FAULTPOINTS
@@ -129,6 +130,10 @@ def make_schedule(kind: str, seed: int, rounds: int = 8,
     if kind == "mixed_version":
         # the rolling-upgrade soak draws its restart round and beat
         # cadence inside MixedVersionHarness from the seed
+        return []
+    if kind == "gang_kill":
+        # the SPMD-gang schedule draws its victim rank and kill step
+        # inside run_gang_kill_schedule from the seed
         return []
     rng = random.Random(seed)
     events: List[dict] = []
@@ -1300,4 +1305,172 @@ def run_mixed_version_schedule(seed: int, tmp, rounds: int = 5) -> dict:
     fd_after = _fd_count()
     assert fd_after <= fd_before + 8, \
         f"fd leak across mixed-version soak: {fd_before} -> {fd_after}"
+    return summary
+
+
+# ---------------------------------------------------------------------------
+# SPMD gang-kill soak (real cluster: SIGKILL a gang member mid-step)
+# ---------------------------------------------------------------------------
+
+
+def run_gang_kill_schedule(seed: int, steps: int = 4) -> dict:
+    """Soak the gang-scheduled SPMD failure paths against a REAL
+    cluster: a seeded plan picks a step and a victim rank, SIGKILLs
+    that member while its step task is in flight, and asserts the
+    chaos bar end to end —
+
+    * the victim rank's ref fails with a TYPED error
+      (``WorkerCrashedError`` — gang steps run ``max_retries=0``, a
+      dead member is an honest step failure, never a silent
+      re-placement);
+    * the gang marks itself broken and further steps raise
+      ``GangBrokenError`` until ``reform()``;
+    * ``reform()`` books a fresh incarnation at epoch+1 in ONE gang
+      lease round and steps run again;
+    * pool/credit reclaim: after ``release()`` the raylet's available
+      resources return to total and plain tasks schedule;
+    * the DistributedArray sharded through the chaos assembles
+      correctly afterwards and the leak detector reports ZERO leaked
+      objects once the handle drops;
+    * fd and zombie brackets hold across the whole soak.
+    """
+    import signal
+    import time as time_mod
+
+    import ray_tpu
+    import ray_tpu.state as state_mod
+    from ray_tpu import exceptions as exc_mod
+
+    fd_before = _fd_count()
+    rng = random.Random(seed)
+    kill_step = rng.randrange(1, steps)  # never the warm-up step 0
+    victim_rank = rng.randrange(2)
+    summary: Dict[str, Any] = {"kill_step": kill_step,
+                               "victim_rank": victim_rank}
+    ray_tpu.init(num_cpus=2, _system_config={
+        "metrics_report_period_ms": 200,
+        "raylet_heartbeat_period_ms": 100,
+        "leak_sweep_interval_s": 0.3,
+        "gang_lease_retry_backoff_s": 0.05,
+    })
+    try:
+        # a sharded array rides along: its shard segments must survive
+        # the member kill untouched and free cleanly at the end
+        mesh = ray_tpu.Mesh((2,), ("x",))
+        arr = np.arange(64, dtype=np.float64).reshape(8, 8)
+        darr = ray_tpu.put_sharded(arr, mesh,
+                                   ray_tpu.PartitionSpec("x"))
+
+        # warm the pool so formation grants in its first round
+        @ray_tpu.remote
+        def warm():
+            return 1
+
+        assert ray_tpu.get([warm.remote() for _ in range(2)]) == [1, 1]
+
+        gang = ray_tpu.create_gang(2)
+        epoch0 = gang.epoch
+
+        def pid_of(rank):
+            import os as os_mod
+            return os_mod.getpid()
+
+        pids = ray_tpu.get(gang.run(pid_of))
+        assert len(set(pids)) == 2, "gang ranks share a process"
+
+        def slow_step(rank):
+            import time as t
+            t.sleep(1.5)
+            return rank * 10
+
+        n_ok_steps = 0
+        for step in range(steps):
+            if step == kill_step:
+                refs = gang.run(slow_step, name="chaos_step")
+                time_mod.sleep(0.3)  # step provably in flight
+                os.kill(pids[victim_rank], signal.SIGKILL)
+                try:
+                    ray_tpu.get(refs[victim_rank], timeout=PULL_BOUND_S)
+                    raise AssertionError(
+                        "SIGKILLed rank returned a value")
+                except exc_mod.WorkerCrashedError:
+                    pass  # typed, honest: the chaos bar
+                # the gang noticed: broken, and further steps refuse
+                deadline = time_mod.time() + 10
+                while not gang.broken and time_mod.time() < deadline:
+                    time_mod.sleep(0.05)
+                assert gang.broken, "member death never broke the gang"
+                try:
+                    gang.run(lambda r: r)
+                    raise AssertionError(
+                        "broken gang accepted a new step")
+                except exc_mod.GangBrokenError:
+                    pass
+                # re-formation: fresh incarnation, epoch advanced, the
+                # old epoch fenced at the raylet
+                gang = gang.reform()
+                assert gang.epoch == epoch0 + 1, \
+                    f"reform() kept epoch {gang.epoch}"
+                pids = ray_tpu.get(gang.run(pid_of))
+                assert len(set(pids)) == 2
+            else:
+                vals = ray_tpu.get(gang.run(lambda r: r * 10),
+                                   timeout=PULL_BOUND_S)
+                assert sorted(vals) == [0, 10]
+                n_ok_steps += 1
+        summary["ok_steps"] = n_ok_steps
+        summary["reformed_epoch"] = gang.epoch
+        gang.release()
+
+        # the sharded array survived the chaos bit-exact
+        assert np.array_equal(ray_tpu.assemble(darr), arr)
+        del darr
+
+        # pool/credit reclaim: resources drain back to total and a
+        # plain task schedules on the recycled pool
+        head_addr = ray_tpu.worker.global_worker.core.raylet_address
+        stats = {}
+        deadline = time_mod.time() + 30
+        while time_mod.time() < deadline:
+            stats = _raylet_stats_sync(head_addr)
+            if stats["resources_available"] == stats["resources_total"]:
+                break
+            time_mod.sleep(0.1)
+        assert stats["resources_available"] == \
+            stats["resources_total"], \
+            f"pool leaked after gang chaos: {stats}"
+        gangs = stats.get("gangs") or {}
+        assert not gangs.get("homed"), \
+            f"released gang still homed: {gangs}"
+        assert gangs.get("num_gang_leases", 0) >= 2, \
+            "formation + reform should book two gang leases"
+        assert ray_tpu.get(warm.remote(), timeout=PULL_BOUND_S) == 1
+
+        # standing leak-detector invariant (ISSUE 13): the shard group
+        # freed as one unit, nothing flagged
+        leaked = 0
+        deadline = time_mod.time() + 10
+        while time_mod.time() < deadline:
+            leaked = state_mod.summary_objects().get("leaked", 0)
+            if state_mod.summary_objects().get("out_of_scope", 0) or \
+                    leaked:
+                break
+            time_mod.sleep(0.2)
+        assert leaked == 0, \
+            f"leak detector flagged {leaked} objects after gang chaos"
+    finally:
+        ray_tpu.shutdown()
+
+    # process hygiene: the SIGKILLed member must be reaped, and no fd
+    # may leak across formation/kill/reform/release
+    deadline = time_mod.time() + 5.0
+    zombies = _zombie_children()
+    while zombies and time_mod.time() < deadline:
+        time_mod.sleep(0.1)
+        zombies = _zombie_children()
+    assert not zombies, \
+        f"unreaped gang-member zombies survive shutdown: {zombies}"
+    fd_after = _fd_count()
+    assert fd_after <= fd_before + 8, \
+        f"fd leak across the gang soak: {fd_before} -> {fd_after}"
     return summary

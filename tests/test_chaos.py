@@ -17,8 +17,8 @@ import pytest
 from chaos import (
     make_schedule, run_credit_raylet_kill_schedule,
     run_credit_revoke_schedule, run_data_plane_schedule,
-    run_mixed_version_schedule, run_oom_storm_schedule,
-    run_task_schedule, schedules_equal,
+    run_gang_kill_schedule, run_mixed_version_schedule,
+    run_oom_storm_schedule, run_task_schedule, schedules_equal,
 )
 
 # Pinned seeds: chosen once, frozen forever. Changing a seed is
@@ -36,6 +36,7 @@ SEEDS = {
     "oom_storm": 2010,
     "credit_revoke": 2111,
     "mixed_version": 2212,
+    "gang_kill": 2313,
 }
 
 
@@ -44,7 +45,7 @@ def test_schedule_generation_is_deterministic():
     different schedules (the RNG actually reaches the events)."""
     for kind, seed in SEEDS.items():
         if kind in ("worker_kill", "oom_storm", "credit_revoke",
-                    "mixed_version"):
+                    "mixed_version", "gang_kill"):
             continue
         a = make_schedule(kind, seed)
         b = make_schedule(kind, seed)
@@ -135,6 +136,19 @@ def test_chaos_soak_credit_raylet_kill():
     pool capacity is fully restored."""
     summary = run_credit_raylet_kill_schedule(SEEDS["credit_revoke"])
     assert summary["ok"] == 24
+
+
+@pytest.mark.slow
+def test_chaos_soak_gang_kill():
+    """SPMD gang-member SIGKILL mid-step (seeded victim rank + kill
+    step): the victim's ref fails TYPED (WorkerCrashedError), the gang
+    breaks and fences, reform() books epoch+1 in one lease round and
+    steps run again, the pool reclaims every slot, the riding
+    DistributedArray assembles bit-exact, and the leak detector
+    reports zero leaked objects after the handle drops."""
+    summary = run_gang_kill_schedule(SEEDS["gang_kill"])
+    assert summary["ok_steps"] >= 1
+    assert summary["reformed_epoch"] >= 2
 
 
 @pytest.mark.slow

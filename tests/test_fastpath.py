@@ -70,7 +70,8 @@ def test_state_parity_with_python_path(ray_start_regular):
             "ref_fields": (r.owned, r.owner_address, r.local_refs,
                            r.submitted_refs, r.contained_in, r.contains,
                            r.borrowers, r.locations, r.in_plasma,
-                           r.pinned_lineage, r.freed, r.size),
+                           r.pinned_lineage, r.freed, r.size,
+                           r.shard_group),
             "entry": (entry.num_retries_left, len(entry.return_ids),
                       entry.dep_ids == () or entry.dep_ids == [],
                       entry.lineage_pinned, entry.recovery_waiter),
