@@ -617,11 +617,30 @@ def main():
         _t1 = time.perf_counter()
         _drift = schemagen_mod.check_program(_program)
         _gen_wall = time.perf_counter() - _t1
+        # per-pass cost of the v4 concurrency rules, isolated on the
+        # already-built program (setup + collect + finalize per rule) so
+        # a regressing pass is attributable instead of hiding in wall_s
+        from ray_tpu._private.lint.engine import all_rules
+        _registry = all_rules()
+        _pass_s = {}
+        for _rn in ("await-atomicity", "cancel-safety",
+                    "orphan-task", "rpc-deadlock"):
+            if _rn not in _registry:
+                continue
+            _tp = time.perf_counter()
+            _rule = _registry[_rn]()
+            _rule.setup(_program)
+            for _m in _mods:
+                if _m.syntax_error is None:
+                    _rule.collect(_m)
+            _rule.finalize()
+            _pass_s[_rn] = round(time.perf_counter() - _tp, 3)
         lint_row = {"files": len(_mods),
                     "violations": len(_lint_violations),
                     "rpc_methods_inferred": len(infer_schemas(_program)),
                     "protocol_version": schemagen_mod.PROTOCOL_VERSION,
                     "schemagen_s": round(_gen_wall, 3),
+                    "pass_s": _pass_s,
                     "drift_clean": not _drift,
                     "wall_s": round(_lint_wall + _gen_wall, 2),
                     "budget_s": 10.0,

@@ -2,16 +2,23 @@
 # raylint hard gate: whole-program static analysis over the package
 # (async-blocking incl. transitive call-graph escalation,
 # lock-discipline, rpc-contract, rpc-schema, exception-hygiene,
-# shm-lifecycle — see ray_tpu/_private/lint/RULES.md). Runs next to
-# ci/sanitize.sh on every round; any violation fails CI.
+# shm-lifecycle, plus the concurrency-hazard pass: await-atomicity,
+# cancel-safety, orphan-task, rpc-deadlock — see
+# ray_tpu/_private/lint/RULES.md). Runs next to ci/sanitize.sh on
+# every round; any violation fails CI.
 #
 # Local runs get the text report; CI (CI=1 or --json) also writes a
 # machine-readable artifact for the build system to attach. The JSON
 # artifact carries the inferred per-method RPC schema table
 # ("rpc_schemas": method -> required/optional/reply keys) for protocol
-# debugging, "protocol_version" (what the generated stubs speak), plus
-# "stale_pragmas". --stale-pragmas is warn-only by design: dead
-# `# raylint: disable=` anchors are reported but never fail the gate.
+# debugging, "protocol_version" (what the generated stubs speak),
+# "violation_counts" (per-rule totals, zeros included), the
+# cross-process RPC wait-for graph ("rpc_wait_for_graph": every
+# synchronous-wait edge with its boundedness, plus cycle verdicts —
+# the rpc-deadlock rule's audit surface), and "stale_pragmas".
+# Stale pragmas are a HARD ERROR in CI (--stale-pragmas-error): a
+# `# raylint: disable=` anchor that suppresses nothing is a fixed bug
+# whose waiver must be deleted. Local runs keep them warn-only.
 #
 # The schema DRIFT GATE rides the same run (--drift-check, one parse +
 # one program build for both): lint/schemagen.py re-infers every RPC
@@ -25,7 +32,7 @@ ARTIFACT="${RAYLINT_ARTIFACT:-/tmp/raylint-report.json}"
 
 if [ "${CI:-}" = "1" ] || [ "${1:-}" = "--json" ]; then
     # JSON artifact + human summary; the gate is the exit code either way.
-    if python -m ray_tpu._private.lint --format json --stale-pragmas \
+    if python -m ray_tpu._private.lint --format json --stale-pragmas-error \
             --drift-check ray_tpu/ > "$ARTIFACT"; then
         echo "raylint: clean, schemas in sync (artifact: $ARTIFACT)"
         python - "$ARTIFACT" <<'PY'
@@ -33,17 +40,23 @@ import json, sys
 r = json.load(open(sys.argv[1]))
 print(f"raylint: {len(r['rpc_schemas'])} RPC method schemas inferred "
       f"(protocol version {r['protocol_version']})")
-for v in r["stale_pragmas"]:
-    print(f"warning: {v['path']}:{v['line']}: {v['rule']}: {v['message']}")
+g = r.get("rpc_wait_for_graph", {})
+unbounded = sum(1 for e in g.get("edges", []) if not e["bounded"])
+print(f"raylint: RPC wait-for graph: {len(g.get('edges', []))} edge(s) "
+      f"({unbounded} unbounded), {len(g.get('cycles', []))} cycle(s)")
 PY
     else
         rc=$?
-        echo "raylint: violations or schema drift (artifact: $ARTIFACT)" >&2
+        echo "raylint: violations, stale pragmas or schema drift" \
+             "(artifact: $ARTIFACT)" >&2
         python - "$ARTIFACT" <<'PY'
 import json, sys
 r = json.load(open(sys.argv[1]))
 for v in r["violations"]:
     print(f"{v['path']}:{v['line']}:{v['col']}: {v['rule']}: {v['message']}",
+          file=sys.stderr)
+for v in r["stale_pragmas"]:
+    print(f"error: {v['path']}:{v['line']}: {v['rule']}: {v['message']}",
           file=sys.stderr)
 for line in r.get("schema_drift", []):
     print(line, file=sys.stderr)

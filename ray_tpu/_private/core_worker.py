@@ -869,9 +869,10 @@ class CoreWorker:
             self.stats["lease_credits_received"] += 1
             self._activating_credits.add(cr["lease_id"])
             state.activating += 1
-            asyncio.get_running_loop().create_task(
+            rpc.spawn_logged(
                 self._activate_credit(sc, state, cr,
-                                      req.raylet_address))
+                                      req.raylet_address),
+                "worker-activate-credit")
         return {}
 
     async def _activate_credit(self, sc: int, state: SchedulingKeyState,
@@ -2063,7 +2064,9 @@ class CoreWorker:
                     # Owned args may be pending: resolve asynchronously.
                     if self.task_events.enabled:
                         self.task_events.record(spec.task_id, PENDING_ARGS)
-                    self.loop.create_task(self._submit_when_ready(spec))
+                    rpc.spawn_logged(self._submit_when_ready(spec),
+                                     "worker-submit-when-ready",
+                                     loop=self.loop)
                     continue
                 sc = spec._sched  # interned at template creation
                 if sc < 0:
@@ -2241,8 +2244,9 @@ class CoreWorker:
                     # stream must not block the remote-spill band
                     break
                 state.pending_lease += 1
-                self.loop.create_task(
-                    self._request_lease(sc, state, self.raylet_address))
+                rpc.spawn_logged(
+                    self._request_lease(sc, state, self.raylet_address),
+                    "worker-request-lease", loop=self.loop)
             worker = min((w for w in state.workers if w.inflight < cap),
                          key=lambda w: w.inflight, default=None)
             if worker is None:
@@ -2259,9 +2263,10 @@ class CoreWorker:
                                 sc, state)
                     else:
                         state.pending_lease += 1
-                        self.loop.create_task(
+                        rpc.spawn_logged(
                             self._request_lease(sc, state,
-                                                self.raylet_address))
+                                                self.raylet_address),
+                            "worker-request-lease", loop=self.loop)
                 return
             # Batch sizing: fair share over current+expected workers
             # while grants are ARRIVING (breadth phase); once they stop
@@ -2313,9 +2318,13 @@ class CoreWorker:
                 reply, _ = await self._gcs_call("GetAllNodeInfo", {})
             except (ConnectionError, asyncio.TimeoutError):
                 return ""
-            self._node_table = {n["node_id"]: n["address"]
-                                for n in reply["nodes"] if n["alive"]}
-            self._node_table_ts = now
+            # Re-sample after the await: a concurrent refresher may
+            # have landed a NEWER table during our RPC — overwriting it
+            # with this (older) reply would roll the cache backwards.
+            if self._node_table_ts <= now:
+                self._node_table = {n["node_id"]: n["address"]
+                                    for n in reply["nodes"] if n["alive"]}
+                self._node_table_ts = now
         return self._node_table.get(node_id, "")
 
     async def _best_locality_raylet(self, dep_info: List[dict]) -> str:
@@ -2391,7 +2400,13 @@ class CoreWorker:
                 # cancelled) — its task-events and retriable flag must
                 # not be stamped onto whatever runs next
                 summary = _build_summary()
-        except (ConnectionError, asyncio.CancelledError):
+        except asyncio.CancelledError:
+            # settle the ledger, but stay cancelled: swallowing here
+            # made `task.cancel(); await task` report success with the
+            # lease request half-done
+            state.pending_lease -= 1
+            raise
+        except ConnectionError:
             state.pending_lease -= 1
             return
         if reply.get("granted"):
@@ -2461,7 +2476,8 @@ class CoreWorker:
             if lw not in state.workers or lw.inflight > 0 or state.queue:
                 return  # back in use
             state.workers.remove(lw)
-            self.loop.create_task(self._return_lease(lw))
+            rpc.spawn_logged(self._return_lease(lw),
+                             "worker-return-lease", loop=self.loop)
 
         if lw.idle_timer is not None:
             lw.idle_timer.cancel()
@@ -2481,7 +2497,8 @@ class CoreWorker:
                 w is not victim and w.inflight == 0 for w in state.workers):
             return False
         state.steal_pending = True
-        self.loop.create_task(self._steal_tasks(sc, state, victim))
+        rpc.spawn_logged(self._steal_tasks(sc, state, victim),
+                         "worker-steal-tasks", loop=self.loop)
         return True
 
     async def _steal_tasks(self, sc: int, state: SchedulingKeyState,
@@ -3039,7 +3056,8 @@ class CoreWorker:
         if q.conn is None or q.conn.closed:
             if not q.resolving:
                 q.resolving = True
-                self.loop.create_task(self._resolve_actor(q))
+                rpc.spawn_logged(self._resolve_actor(q),
+                                 "worker-resolve-actor", loop=self.loop)
             return
         if not q.buffer:
             return
@@ -3380,7 +3398,8 @@ class CoreWorker:
             if msg["state"] == "ALIVE" and msg["incarnation"] != q.incarnation:
                 if not q.resolving:
                     q.resolving = True
-                    asyncio.get_running_loop().create_task(self._resolve_actor(q))
+                    rpc.spawn_logged(self._resolve_actor(q),
+                                     "worker-resolve-actor")
             elif msg["state"] == "DEAD":
                 q.state = "DEAD"
                 q.death_cause = msg.get("reason", "actor died")

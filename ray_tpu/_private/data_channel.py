@@ -310,7 +310,10 @@ class DataPlaneServer:
             try:
                 conn, _ = await loop.sock_accept(self._sock)
             except asyncio.CancelledError:
-                return
+                # close() cancels this task and awaits it: stay
+                # cancelled so the canceller sees the loop actually
+                # stop instead of a phantom clean exit
+                raise
             except OSError as e:
                 if self._closing:
                     return

@@ -363,8 +363,8 @@ class GcsServer:
         # re-register within the loop's retry window.
         for actor in self.actors.values():
             if actor.state in (ACTOR_PENDING, ACTOR_RESTARTING):
-                asyncio.get_running_loop().create_task(
-                    self._schedule_actor(actor))
+                rpc.spawn_logged(self._schedule_actor(actor),
+                                 "gcs-schedule-actor")
         logger.info("GCS listening at %s", addr)
         return addr
 
@@ -477,7 +477,10 @@ class GcsServer:
                         reply["node"] = node.node_id.hex()
                     return dump(reply)
                 finally:
-                    await conn.close()
+                    # shield: a cancelled dashboard request must still
+                    # finish closing the one-shot raylet conn, or the
+                    # socket and its recv task leak
+                    await asyncio.shield(conn.close())
             except (ConnectionError, asyncio.TimeoutError) as e:
                 return dump({"error": f"raylet unreachable: {e}"})
         if route == "/api/nodes":
@@ -964,8 +967,8 @@ class GcsServer:
             def _on_drop(c):
                 e = c.tags.get("node_entry")
                 if e is not None:
-                    asyncio.get_event_loop().create_task(
-                        self._on_node_connection_lost(e))
+                    rpc.spawn_logged(self._on_node_connection_lost(e),
+                                     "gcs-node-connection-lost")
 
             conn.on_disconnect.append(_on_drop)
         await self._publish("NODE", self._node_alive_msg(entry))
@@ -1172,7 +1175,8 @@ class GcsServer:
             "frames": actor.spec_frames, "name": actor.name,
             "namespace": actor.namespace,
             "max_restarts": actor.max_restarts, "job_id": actor.job_id})
-        asyncio.get_running_loop().create_task(self._schedule_actor(actor))
+        rpc.spawn_logged(self._schedule_actor(actor),
+                         "gcs-schedule-actor")
         return {"ok": True}
 
     async def _schedule_actor(self, actor: ActorEntry):
@@ -1273,7 +1277,8 @@ class GcsServer:
             logger.info("restarting actor %s (%d/%s)", actor.actor_id.hex()[:8],
                         actor.num_restarts,
                         "inf" if actor.max_restarts == -1 else actor.max_restarts)
-            asyncio.get_running_loop().create_task(self._schedule_actor(actor))
+            rpc.spawn_logged(self._schedule_actor(actor),
+                             "gcs-schedule-actor")
         else:
             cause = dict(cause or {})
             kind = cause.get("kind") or "WORKER_DIED"

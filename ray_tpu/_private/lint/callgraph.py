@@ -49,7 +49,8 @@ class FunctionInfo:
     rules need and (after resolution) its outgoing call edges."""
 
     __slots__ = ("path", "qualname", "node", "class_name", "is_async",
-                 "params", "has_var_pos", "has_var_kw", "calls")
+                 "params", "has_var_pos", "has_var_kw", "calls",
+                 "spawned_calls")
 
     def __init__(self, path: str, qualname: str, node: ast.AST,
                  class_name: str):
@@ -64,6 +65,11 @@ class FunctionInfo:
         self.has_var_kw = args.kwarg is not None
         # (call node, callee FunctionInfo) — filled by _resolve_edges
         self.calls: List[Tuple[ast.Call, "FunctionInfo"]] = []
+        # ids of call nodes handed to create_task/ensure_future/
+        # spawn_logged: the edge exists (the code runs) but it is a
+        # DETACHED task, not part of this function's synchronous
+        # continuation — wait-for analyses must not follow it
+        self.spawned_calls: Set[int] = set()
 
     @property
     def name(self) -> str:
@@ -129,6 +135,16 @@ class ClientCall:
     lineno: int
     col: int
     header: Optional[ast.AST]             # None when no header was passed
+    # Enclosing def, when the call sits inside one (rpc-deadlock walks
+    # the wait-for graph from handler FunctionInfos to these sites).
+    in_function: Optional[FunctionInfo] = None
+    # True when the caller synchronously waits for the reply: the call
+    # is under an ``await`` in the same expression statement (directly
+    # or through an ``asyncio.wait_for`` wrapper).
+    awaited: bool = False
+    # True when the wait is provably bounded: ``timeout=`` passed to the
+    # call itself or an enclosing ``wait_for`` with a timeout.
+    bounded: bool = False
 
 
 class RpcIndex:
@@ -163,6 +179,9 @@ class Program:
         # same-named stub classes declare DIFFERENT schemas: ambiguity
         # resolves to "not provable", like every other layer here)
         self._stub_classes: Dict[str, Optional[StubClassInfo]] = {}
+        # id(def node) -> FunctionInfo, for ancestor walks that land on
+        # a FunctionDef and need its info back
+        self.fi_by_node: Dict[int, FunctionInfo] = {}
         self.rpc = RpcIndex()
 
     # -------------------------------------------------------------- lookup
@@ -293,6 +312,7 @@ def _collect_symbols(program: Program, module: Module):
     for func, qualname, cls in walk_functions(module.tree):
         fi = FunctionInfo(path, qualname, func, cls)
         program.functions[(path, qualname)] = fi
+        program.fi_by_node[id(func)] = fi
         if "." not in qualname:
             program.module_level[path][qualname] = fi
         if cls and qualname.endswith("." + func.name) and \
@@ -407,6 +427,12 @@ def _resolve_edges(program: Program, module: Module,
             callee = _resolve_callable(program, path, node.func, cls)
             if callee is not None and callee is not fi:
                 fi.calls.append((node, callee))
+                parent = parents.get(id(node))
+                if isinstance(parent, ast.Call) and node in parent.args \
+                        and dotted_name(parent.func).rsplit(".", 1)[-1] \
+                        in ("create_task", "ensure_future",
+                            "spawn_logged"):
+                    fi.spawned_calls.add(id(node))
 
 
 def _is_registration(node: ast.Dict, parents: Dict[int, ast.AST]) -> bool:
@@ -439,6 +465,41 @@ def _is_registration(node: ast.Dict, parents: Dict[int, ast.AST]) -> bool:
             return False
         anc = parents.get(id(anc))
     return False
+
+
+def _call_context(program: Program, node: ast.Call,
+                  parents: Dict[int, ast.AST]
+                  ) -> Tuple[Optional[FunctionInfo], bool, bool]:
+    """(enclosing def, awaited, bounded) for a client-call site.
+
+    ``awaited`` only looks within the call's own expression statement:
+    ``await conn.call(...)`` and ``await wait_for(conn.call(...), t)``
+    both count; a task spawned from the call does not. ``bounded``
+    needs a ``timeout=`` on the call itself or a wrapping ``wait_for``
+    with a timeout argument."""
+    in_fn: Optional[FunctionInfo] = None
+    awaited = False
+    bounded = any(kw.arg == "timeout" and not (
+        isinstance(kw.value, ast.Constant) and kw.value.value is None)
+        for kw in node.keywords)
+    crossed_stmt = False
+    anc = parents.get(id(node))
+    while anc is not None:
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            in_fn = program.fi_by_node.get(id(anc))
+            break
+        if not crossed_stmt:
+            if isinstance(anc, ast.Await):
+                awaited = True
+            elif isinstance(anc, ast.Call) and \
+                    dotted_name(anc.func).rsplit(".", 1)[-1] == "wait_for":
+                if len(anc.args) > 1 or any(
+                        kw.arg == "timeout" for kw in anc.keywords):
+                    bounded = True
+            elif isinstance(anc, ast.stmt):
+                crossed_stmt = True
+        anc = parents.get(id(anc))
+    return in_fn, awaited, bounded
 
 
 def _index_rpc(program: Program, module: Module,
@@ -480,9 +541,11 @@ def _index_rpc(program: Program, module: Module,
                 for kw in node.keywords:
                     if kw.arg == "header":
                         header = kw.value
+            in_fn, awaited, bounded = _call_context(program, node, parents)
             program.rpc.client_calls.append(ClientCall(
                 method, node.func.attr, path, node.lineno,
-                node.col_offset, header))
+                node.col_offset, header, in_function=in_fn,
+                awaited=awaited, bounded=bounded))
 
 
 def build_program(modules: List[Module]) -> Program:

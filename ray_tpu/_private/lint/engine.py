@@ -367,6 +367,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="also report `# raylint: disable=` anchors "
                              "that suppress nothing (warn-only: never "
                              "affects the exit code)")
+    parser.add_argument("--stale-pragmas-error", action="store_true",
+                        help="like --stale-pragmas, but stale anchors "
+                             "FAIL the run (exit 1) — the CI posture: "
+                             "a pragma that suppresses nothing is a "
+                             "fixed bug whose waiver must be deleted")
     parser.add_argument("--dump-schemas", action="store_true",
                         help="print the inferred RPC header schema for "
                              "every registered method as JSON and exit "
@@ -412,20 +417,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
     stale = find_stale_pragmas(modules, rule_names) \
-        if args.stale_pragmas else []
+        if (args.stale_pragmas or args.stale_pragmas_error) else []
     drift: List[str] = []
     if args.drift_check:
         from ray_tpu._private.lint.schemagen import check_program
         drift = check_program(program)
 
     if args.format == "json":
+        from ray_tpu._private.lint.rules.rpc_deadlock import \
+            wait_graph_report
         from ray_tpu._private.lint.rules.rpc_schema import schemas_as_dict
         from ray_tpu._private.lint.schemagen import PROTOCOL_VERSION
+        active = rule_names or sorted(all_rules())
+        counts = {name: 0 for name in active}
+        for v in violations:
+            counts[v.rule] = counts.get(v.rule, 0) + 1
         print(json.dumps({
             "violations": [v.as_dict() for v in violations],
+            # Per-rule finding totals, zeros included: a rule that ran
+            # and found nothing is distinguishable from one not run.
+            "violation_counts": counts,
             "stale_pragmas": [v.as_dict() for v in stale],
             "files_scanned": len(modules),
-            "rules": rule_names or sorted(all_rules()),
+            "rules": active,
             # The wire version the generated stubs speak (see
             # _private/protocol.py + lint/schemagen.py).
             "protocol_version": PROTOCOL_VERSION,
@@ -436,19 +450,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             # what each handler requires/accepts and what its replies
             # can carry — the protocol-debugging companion table.
             "rpc_schemas": schemas_as_dict(program),
+            # Cross-process synchronous-wait edges + cycle verdicts
+            # (the rpc-deadlock rule's full graph): the reviewer's
+            # audit surface for every blocking RPC dependency.
+            "rpc_wait_for_graph": wait_graph_report(program),
         }, indent=2, sort_keys=True))
     else:
         for v in violations:
             print(v.render())
         for v in stale:
-            print(f"warning: {v.render()}")
+            sev = "error" if args.stale_pragmas_error else "warning"
+            print(f"{sev}: {v.render()}")
         for line in drift:
             print(line, file=sys.stderr)
         status = "clean" if not violations else \
             f"{len(violations)} violation(s)"
         if stale:
-            status += f", {len(stale)} stale pragma(s) [warn-only]"
+            qual = "" if args.stale_pragmas_error else " [warn-only]"
+            status += f", {len(stale)} stale pragma(s){qual}"
         if args.drift_check:
             status += ", schema drift" if drift else ", schemas in sync"
         print(f"raylint: {len(modules)} file(s), {status}")
+    if args.stale_pragmas_error and stale:
+        return 1
     return 1 if violations or drift else 0

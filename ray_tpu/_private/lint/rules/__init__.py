@@ -3,10 +3,14 @@ with the engine registry (each module calls ``engine.register``)."""
 
 from ray_tpu._private.lint.rules import (  # noqa: F401
     async_blocking,
+    await_atomicity,
+    cancel_safety,
     exception_hygiene,
     lock_discipline,
+    orphan_task,
     protocol_stub,
     rpc_contract,
+    rpc_deadlock,
     rpc_schema,
     shm_lifecycle,
 )

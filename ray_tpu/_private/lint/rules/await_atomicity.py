@@ -1,0 +1,329 @@
+"""await-atomicity: decisions about shared state must not span awaits.
+
+A single-threaded asyncio loop interleaves ONLY at await points — the
+whole reason the control plane can mutate ``self.`` state without
+locks. The flip side: any check-then-act or read-modify-write on
+``self.`` state that spans an ``await`` is a race. Another task runs
+during the suspension, the checked value is stale by the time the act
+lands, and the bug reproduces only under concurrency (double worker
+starts, duplicate pulls, lost counter updates).
+
+Two flagged shapes, inside ``async def`` methods on ``_private/``:
+
+1. **check-then-act** — an ``if``/``while`` test reads ``self.A``, and
+   the guarded suite writes ``self.A`` (assignment or subscript store)
+   after an ``await``. Includes TRANSITIVE writes: a call after the
+   await whose callee (same class / same module, up to 3 resolved
+   hops) performs the write.
+2. **stale read-modify-write** — ``v = self.A``, an ``await``, then
+   ``self.A = <expr using v>``: a lost update for every task that
+   wrote ``self.A`` during the suspension.
+
+Sanctioned idioms, recognized as safe:
+
+  * **re-sample after await** — any read of ``self.A`` between the
+    last await and the write re-bases the decision on fresh state; a
+    TRANSITIVE writer that itself reads the attribute (a reconnect
+    helper checking the live connection before replacing it) counts
+    as a callee-side re-sample;
+  * **lock-guarded sections** — the whole sequence inside one
+    ``async with`` over a lock/Condition/Semaphore (name containing
+    lock/cond/sem/mutex): mutators serialize on the lock;
+  * **single-assignment latch** — writing a constant
+    (``self._broken = True``): last-writer-wins is idempotent;
+  * **augmented writes** (``self.A += x``) are never the *act* of
+    shape 1: they re-read at write time (still flagged as shape 2
+    when based on a stale bound read — they aren't, by construction).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Set, Tuple
+
+from ray_tpu._private.lint.engine import (
+    Module, Rule, Violation, body_nodes, dotted_name, register,
+    walk_functions,
+)
+
+_LOCKISH = re.compile(r"lock|cond|sem|mutex", re.IGNORECASE)
+
+
+def _block_range(stmts) -> Tuple[int, int]:
+    return (stmts[0].lineno,
+            max(getattr(s, "end_lineno", None) or s.lineno for s in stmts))
+
+
+def _self_attr(node: ast.AST) -> str:
+    """'A' when node is exactly ``self.A``, else ''."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return ""
+
+
+@register
+class AwaitAtomicityRule(Rule):
+    name = "await-atomicity"
+    description = ("check-then-act / read-modify-write on shared self. "
+                   "state spanning an await (incl. transitive writes "
+                   "through resolved self/module calls): the checked "
+                   "value is stale after the suspension")
+
+    def __init__(self):
+        self._program = None
+        self._direct_cache: Dict[int, Dict[str, Set[str]]] = {}
+        self._read_cache: Dict[int, Set[str]] = {}
+
+    def setup(self, program) -> None:
+        self._program = program
+        self._direct_cache = {}
+        self._read_cache = {}
+
+    def collect(self, module: Module) -> Iterable[Violation]:
+        if "_private" not in module.path.replace("\\", "/"):
+            return ()
+        out: List[Violation] = []
+        for func, qualname, cls in walk_functions(module.tree):
+            if not isinstance(func, ast.AsyncFunctionDef):
+                continue
+            args = func.args.posonlyargs + func.args.args
+            if not (cls and args and args[0].arg == "self"):
+                continue
+            self._check_function(module, func, qualname, out)
+        return out
+
+    def _check_function(self, module, func, qualname, out):
+        nodes = list(body_nodes(func))
+        await_lines = sorted(n.lineno for n in nodes
+                             if isinstance(n, ast.Await))
+        if not await_lines:
+            return
+
+        # ids of nodes that sit inside an assignment TARGET — the
+        # self.A inside `self.A[k] = v` is ctx=Load but is the store,
+        # not a re-sample
+        target_ids: Set[int] = set()
+        writes: List[Tuple[int, str, str, ast.AST]] = []
+        binds: List[Tuple[int, str, str]] = []
+        for n in nodes:
+            if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = n.targets if isinstance(n, ast.Assign) \
+                    else [n.target]
+                for t in targets:
+                    for sub in ast.walk(t):
+                        target_ids.add(id(sub))
+            if isinstance(n, ast.Assign):
+                for t in targets:
+                    for el in (t.elts if isinstance(t, (ast.Tuple,
+                                                        ast.List))
+                               else [t]):
+                        attr = _self_attr(el)
+                        if attr:
+                            writes.append((n.lineno, attr, "assign",
+                                           n.value))
+                        elif isinstance(el, ast.Subscript):
+                            attr = _self_attr(el.value)
+                            if attr:
+                                writes.append((n.lineno, attr, "sub",
+                                               n.value))
+                if len(n.targets) == 1 and \
+                        isinstance(n.targets[0], ast.Name):
+                    attr = _self_attr(n.value)
+                    if attr:
+                        binds.append((n.lineno, n.targets[0].id, attr))
+
+        reads: Dict[str, List[int]] = {}
+        for n in nodes:
+            attr = _self_attr(n)
+            if attr and isinstance(n.ctx, ast.Load) and \
+                    id(n) not in target_ids:
+                reads.setdefault(attr, []).append(n.lineno)
+
+        lock_ranges: List[Tuple[int, int]] = []
+        for n in nodes:
+            if isinstance(n, ast.AsyncWith) and any(
+                    _LOCKISH.search(dotted_name(it.context_expr))
+                    for it in n.items):
+                lock_ranges.append(
+                    (n.lineno, getattr(n, "end_lineno", None) or n.lineno))
+
+        def locked(*lines) -> bool:
+            return any(all(a <= ln <= b for ln in lines)
+                       for a, b in lock_ranges)
+
+        def resampled(attr, last_await, wl) -> bool:
+            return any(last_await < r <= wl
+                       for r in reads.get(attr, []))
+
+        fi = None
+        if self._program is not None:
+            fi = self._program.functions.get((module.path, qualname))
+
+        flagged: Set[Tuple[int, str]] = set()
+
+        # ---- shape 1: check-then-act -------------------------------
+        for stmt in nodes:
+            if not isinstance(stmt, (ast.If, ast.While)):
+                continue
+            test_attrs = {a for a in (
+                _self_attr(n) for n in ast.walk(stmt.test)) if a}
+            if not test_attrs or locked(stmt.lineno):
+                continue
+            b_start, b_end = _block_range(stmt.body)
+            for wl, attr, kind, value in writes:
+                if attr not in test_attrs or not (b_start <= wl <= b_end):
+                    continue
+                if kind == "assign" and isinstance(value, ast.Constant):
+                    continue    # single-assignment latch
+                between = [a for a in await_lines
+                           if stmt.lineno < a <= wl]
+                if not between or resampled(attr, max(between), wl) or \
+                        (wl, attr) in flagged:
+                    continue
+                flagged.add((wl, attr))
+                out.append(Violation(
+                    self.name, module.path, wl, 0,
+                    f"`self.{attr}` checked at line {stmt.lineno} in "
+                    f"`{qualname}` but written here after an await "
+                    f"(line {max(between)}): the check is stale by "
+                    f"the time the write lands — re-sample after the "
+                    f"await, hold one async lock across the section, "
+                    f"or make this a constant latch"))
+            if fi is None:
+                continue
+            for call_node, callee in fi.calls:
+                cl = call_node.lineno
+                if id(call_node) in fi.spawned_calls:
+                    continue    # detached task, not this continuation
+                if not (b_start <= cl <= b_end) or \
+                        not (callee.class_name == fi.class_name or
+                             callee.path == fi.path):
+                    continue
+                before = [a for a in await_lines
+                          if stmt.lineno < a < cl]
+                if not before:
+                    continue
+                wmap = self._writes_trans(callee, 3, {
+                    (fi.path, fi.qualname): 99})
+                reads_there = self._reads_trans(callee, 3, {
+                    (fi.path, fi.qualname): 99})
+                for attr in test_attrs:
+                    kinds = wmap.get(attr, set())
+                    if not (kinds & {"assign", "sub"}) or \
+                            resampled(attr, max(before), cl) or \
+                            attr in reads_there or \
+                            (cl, attr) in flagged:
+                        continue
+                    flagged.add((cl, attr))
+                    out.append(Violation(
+                        self.name, module.path, cl, call_node.col_offset,
+                        f"`self.{attr}` checked at line {stmt.lineno} "
+                        f"in `{qualname}` but `{callee.qualname}` "
+                        f"(called here, after the await at line "
+                        f"{max(before)}) writes it: the check is "
+                        f"stale — re-sample before the call or "
+                        f"serialize the section"))
+
+        # ---- shape 2: stale read-modify-write ----------------------
+        for wl, attr, kind, value in writes:
+            if kind != "assign":
+                continue
+            rhs_names = {n.id for n in ast.walk(value)
+                         if isinstance(n, ast.Name)}
+            for bl, var, battr in binds:
+                if battr != attr or var not in rhs_names or bl >= wl:
+                    continue
+                between = [a for a in await_lines if bl < a <= wl]
+                if not between or resampled(attr, max(between), wl) or \
+                        locked(bl, wl) or (wl, attr) in flagged:
+                    continue
+                flagged.add((wl, attr))
+                out.append(Violation(
+                    self.name, module.path, wl, 0,
+                    f"`{var} = self.{attr}` (line {bl}) in "
+                    f"`{qualname}` is written back here across an "
+                    f"await (line {max(between)}): every write to "
+                    f"`self.{attr}` during the suspension is lost — "
+                    f"re-read after the await or fold into one "
+                    f"augmented/locked update"))
+
+    # ------------------------------------------------- transitive writes
+
+    def _writes_trans(self, fi, depth: int, visited: dict
+                      ) -> Dict[str, Set[str]]:
+        """self-attributes written by ``fi`` or same-class/same-module
+        callees within ``depth`` hops: attr -> {'assign','sub','aug'}.
+        Budget-keyed ``visited`` as in async-blocking."""
+        key = (fi.path, fi.qualname)
+        if visited.get(key, 0) >= depth:
+            return {}
+        visited[key] = depth
+        agg = {a: set(k) for a, k in self._direct_writes(fi).items()}
+        if depth > 1:
+            for node, callee in fi.calls:
+                if id(node) in fi.spawned_calls or \
+                        not (callee.class_name == fi.class_name or
+                             callee.path == fi.path):
+                    continue
+                for a, k in self._writes_trans(
+                        callee, depth - 1, visited).items():
+                    agg.setdefault(a, set()).update(k)
+        return agg
+
+    def _reads_trans(self, fi, depth: int, visited: dict) -> Set[str]:
+        """self-attributes the callee closure READS — a callee that
+        re-reads the attribute before acting has re-sampled it on the
+        fresh side of the await (e.g. a reconnect helper that checks
+        the live connection under its own lock before replacing it)."""
+        key = (fi.path, fi.qualname)
+        if visited.get(key, 0) >= depth:
+            return set()
+        visited[key] = depth
+        agg = set(self._direct_reads(fi))
+        if depth > 1:
+            for node, callee in fi.calls:
+                if id(node) in fi.spawned_calls or \
+                        not (callee.class_name == fi.class_name or
+                             callee.path == fi.path):
+                    continue
+                agg |= self._reads_trans(callee, depth - 1, visited)
+        return agg
+
+    def _direct_writes(self, fi) -> Dict[str, Set[str]]:
+        cached = self._direct_cache.get(id(fi))
+        if cached is not None:
+            return cached
+        out: Dict[str, Set[str]] = {}
+        for n in body_nodes(fi.node):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    for el in (t.elts if isinstance(t, (ast.Tuple,
+                                                        ast.List))
+                               else [t]):
+                        attr = _self_attr(el)
+                        if attr:
+                            out.setdefault(attr, set()).add("assign")
+                        elif isinstance(el, ast.Subscript):
+                            attr = _self_attr(el.value)
+                            if attr:
+                                out.setdefault(attr, set()).add("sub")
+            elif isinstance(n, ast.AugAssign):
+                attr = _self_attr(n.target)
+                if attr:
+                    out.setdefault(attr, set()).add("aug")
+        self._direct_cache[id(fi)] = out
+        return out
+
+    def _direct_reads(self, fi) -> Set[str]:
+        cached = self._read_cache.get(id(fi))
+        if cached is not None:
+            return cached
+        out: Set[str] = set()
+        for n in body_nodes(fi.node):
+            attr = _self_attr(n)
+            if attr and isinstance(n.ctx, ast.Load):
+                out.add(attr)
+        self._read_cache[id(fi)] = out
+        return out

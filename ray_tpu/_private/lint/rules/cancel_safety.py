@@ -1,0 +1,318 @@
+"""cancel-safety: cancellation must not leak resources or be swallowed.
+
+Every control-plane process is one asyncio loop; ``Task.cancel`` can
+land at ANY await point. Three hazard classes, all shipped as real
+bugs before this rule existed:
+
+1. **Acquire-then-await without cleanup** (the PR6 admission-budget
+   leak class). A resource acquired — admission bytes, a recycled
+   segment lease, an mmap, remote gang bookings — followed by an await
+   with no protecting ``try`` that releases it: cancellation at that
+   await leaks the resource forever. Acquire/release pairs live in the
+   documented tables below (``ACQUIRES`` / ``RPC_ACQUIRES`` /
+   ``LEDGERS``), seeded from the real seams. An await after an acquire
+   is *protected* when an enclosing ``try`` releases on the
+   cancellation path: its ``finally`` — or an ``except`` catching
+   CancelledError/BaseException that RE-RAISES — references one of the
+   pair's release markers. ``during=True`` entries (strictly-ordered
+   exchange streams: a cancel mid-read desyncs request/reply framing,
+   the PR9 wrong-pid class) additionally require the acquiring await
+   itself to sit inside the protecting ``try``.
+
+2. **``await`` inside ``finally`` without ``asyncio.shield``**.
+   Cancellation during cleanup cancels the cleanup: the first await in
+   a ``finally`` raises CancelledError and everything after it is
+   skipped. Wrap the awaited cleanup in ``asyncio.shield(...)`` (or do
+   it synchronously).
+
+3. **``except CancelledError`` that doesn't re-raise**. Swallowing
+   CancelledError detaches the task from its canceller —
+   ``task.cancel(); await task`` hangs or the task "succeeds" while
+   half-done. Handlers may clean up, but must ``raise``.
+
+Scope: ``_private/`` control-plane paths. Deliberate exceptions carry
+a pragma with the reason.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable, List, Optional, Set, Tuple
+
+from ray_tpu._private.lint.engine import (
+    Module, Rule, Violation, body_nodes, dotted_name, first_str_arg,
+    register, walk_functions,
+)
+
+CLIENT_METHODS = {"call", "push", "call_nowait", "push_nowait", "_gcs_call"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Pair:
+    markers: Tuple[str, ...]    # names a releasing cleanup block mentions
+    leaks: str                  # what a cancelled task leaks
+    during: bool = False        # acquire await itself must be protected
+
+
+# Callable terminal name -> acquire/release pair. A call to one of
+# these (directly, or passed by reference into run_in_executor) is an
+# acquire; the markers are the function/attribute names whose presence
+# in a protecting finally / re-raising cancel-handler proves release.
+ACQUIRES = {
+    "_admit_pull": Pair(
+        ("_pull_inflight_bytes", "_notify_pull_done"),
+        "pull admission budget — every later pull queues behind bytes "
+        "that will never drain"),
+    "take_recycled": Pair(
+        ("release_lease", "abort_lease", "_discard", "_segment_reaper"),
+        "recycled segment lease (a store._lent entry pinned until the "
+        "600 s stale sweep)"),
+    "acquire_segment": Pair(
+        ("_close_segment_owner", "release_lease", "_discard",
+         "_segment_reaper"),
+        "shm segment mapping + lease (fd, mmap pages and the segment "
+        "file all outlive the pull)"),
+    "_read_frame": Pair(
+        ("_broken", "close"),
+        "strictly-ordered zygote exchange — a cancelled read desyncs "
+        "request/reply framing and the next caller adopts a stale "
+        "reply", during=True),
+}
+
+# RPC methods that BOOK remote state: conn.call("Method", ...) is the
+# acquire, release is proven the same way.
+RPC_ACQUIRES = {
+    "BookGangMembers": Pair(
+        ("_rollback_gang_booking", "ReleaseGangMembers"),
+        "remote gang lease bookings on peer raylets"),
+}
+
+# Paired counters: += before an await needs a protecting block that
+# references the same attribute (the -= lives there).
+LEDGERS = {
+    "_pull_inflight_bytes": "pull admission budget",
+    "pending_lease": "per-class pending-lease ledger",
+    "_num_starting": "starting-worker ledger",
+}
+
+_CANCELISH = {"CancelledError", "BaseException"}
+
+
+def _catches_cancel(type_node: Optional[ast.AST]) -> bool:
+    if type_node is None:
+        return True
+    if isinstance(type_node, ast.Tuple):
+        return any(_catches_cancel(e) for e in type_node.elts)
+    return dotted_name(type_node).rsplit(".", 1)[-1] in _CANCELISH
+
+
+def _subtree(stmts) -> Iterable[ast.AST]:
+    """Walk statements without descending into nested defs."""
+    stack = list(stmts)
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _refs(stmts) -> Set[str]:
+    """Terminal names referenced in ``stmts`` (not crossing defs)."""
+    out: Set[str] = set()
+    for n in _subtree(stmts):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+    return out
+
+
+def _has_raise(stmts) -> bool:
+    return any(isinstance(n, ast.Raise) for n in _subtree(stmts))
+
+
+def _block_range(stmts) -> Tuple[int, int]:
+    return (stmts[0].lineno,
+            max(getattr(s, "end_lineno", None) or s.lineno for s in stmts))
+
+
+@register
+class CancelSafetyRule(Rule):
+    name = "cancel-safety"
+    description = ("resources acquired before an await with no "
+                   "releasing try/finally, awaits inside finally "
+                   "without asyncio.shield, and except CancelledError "
+                   "that doesn't re-raise")
+
+    def collect(self, module: Module) -> Iterable[Violation]:
+        if "_private" not in module.path.replace("\\", "/"):
+            return ()
+        out: List[Violation] = []
+        for func, qualname, _cls in walk_functions(module.tree):
+            nodes = list(body_nodes(func))
+            self._check_finally_awaits(module, qualname, nodes, out)
+            self._check_cancel_swallow(module, qualname, nodes, out)
+            if isinstance(func, ast.AsyncFunctionDef):
+                self._check_acquires(module, qualname, nodes, out)
+        return out
+
+    # ------------------------------------------ (1) acquire-then-await
+
+    def _check_acquires(self, module, qualname, nodes, out):
+        awaits = sorted((n.lineno, n) for n in nodes
+                        if isinstance(n, ast.Await))
+        if not awaits:
+            return
+        tries = [n for n in nodes if isinstance(n, ast.Try)]
+        # (body_start, body_end, marker names proven released on the
+        # cancellation path)
+        try_infos = []
+        # (start, end, refs) of handler/finally blocks: release refs
+        # THERE protect, they don't end the live window — and an await
+        # INSIDE a releasing cleanup block (awaiting the rollback
+        # itself) is the release, not a new hazard
+        cleanup_blocks: List[Tuple[int, int, Set[str]]] = []
+        for t in tries:
+            prot: Set[str] = set()
+            if t.finalbody:
+                prot |= _refs(t.finalbody)
+                a, b = _block_range(t.finalbody)
+                cleanup_blocks.append((a, b, _refs(t.finalbody)))
+            for h in t.handlers:
+                a, b = _block_range(h.body)
+                cleanup_blocks.append((a, b, _refs(h.body)))
+                if _catches_cancel(h.type) and _has_raise(h.body):
+                    prot |= _refs(h.body)
+            start, end = _block_range(t.body)
+            try_infos.append((start, end, prot))
+
+        def in_cleanup(line: int) -> bool:
+            return any(a <= line <= b for a, b, _r in cleanup_blocks)
+
+        # every (line, marker-name) reference outside cleanup blocks —
+        # the first one after an acquire closes its hazard window (the
+        # code released / consumed the resource on the success path)
+        ref_lines: List[Tuple[int, str]] = []
+        for n in nodes:
+            name = None
+            if isinstance(n, ast.Name):
+                name = n.id
+            elif isinstance(n, ast.Attribute):
+                name = n.attr
+            if name is not None and not in_cleanup(n.lineno):
+                ref_lines.append((n.lineno, name))
+
+        events = self._acquire_events(nodes)
+        for line, end_line, what, pair in events:
+            markers = set(pair.markers)
+            window_end = min(
+                (ln for ln, nm in ref_lines if ln > end_line
+                 and nm in markers), default=10 ** 9)
+            hazard_from = line if pair.during else end_line + 1
+            for aline, _anode in awaits:
+                if not (hazard_from <= aline < window_end):
+                    continue
+                protected = any(
+                    s <= aline <= e and (prot & markers)
+                    for s, e, prot in try_infos)
+                if not protected:
+                    # awaiting the rollback inside a releasing cleanup
+                    # block IS the release
+                    protected = any(
+                        a <= aline <= b and (r & markers)
+                        for a, b, r in cleanup_blocks)
+                if protected:
+                    continue
+                need = "covering the acquire itself and " \
+                    if pair.during else ""
+                out.append(Violation(
+                    self.name, module.path, line, 0,
+                    f"`{what}` acquired in `{qualname}` but the await "
+                    f"at line {aline} has no protecting try "
+                    f"{need}releasing it (finally or re-raising "
+                    f"CancelledError handler referencing one of "
+                    f"{sorted(markers)}): cancellation there leaks "
+                    f"{pair.leaks}"))
+                break
+
+    def _acquire_events(self, nodes):
+        """(line, end_line, description, Pair) for every acquire in
+        the body: direct calls, function references handed to an
+        executor, booking RPCs, and ledger increments."""
+        events = []
+        for n in nodes:
+            if isinstance(n, ast.Call):
+                term = dotted_name(n.func).rsplit(".", 1)[-1]
+                end = getattr(n, "end_lineno", None) or n.lineno
+                if term in ACQUIRES:
+                    events.append((n.lineno, end, term, ACQUIRES[term]))
+                    continue
+                if term in CLIENT_METHODS:
+                    m = first_str_arg(n)
+                    if m in RPC_ACQUIRES:
+                        events.append((n.lineno, end, f'call("{m}")',
+                                       RPC_ACQUIRES[m]))
+                        continue
+                for arg in n.args:
+                    if isinstance(arg, (ast.Name, ast.Attribute)):
+                        ref = dotted_name(arg).rsplit(".", 1)[-1]
+                        if ref in ACQUIRES:
+                            events.append((n.lineno, end, ref,
+                                           ACQUIRES[ref]))
+            elif isinstance(n, ast.AugAssign) and \
+                    isinstance(n.op, ast.Add) and \
+                    isinstance(n.target, ast.Attribute) and \
+                    n.target.attr in LEDGERS:
+                attr = n.target.attr
+                events.append((
+                    n.lineno, n.lineno, f"{attr} += ...",
+                    Pair((attr,), f"the {LEDGERS[attr]} (never "
+                         f"decremented)")))
+        return events
+
+    # ------------------------------------------ (2) await in finally
+
+    def _check_finally_awaits(self, module, qualname, nodes, out):
+        for t in nodes:
+            if not (isinstance(t, ast.Try) and t.finalbody):
+                continue
+            for n in _subtree(t.finalbody):
+                if not isinstance(n, ast.Await):
+                    continue
+                shielded = any(
+                    isinstance(c, ast.Call) and
+                    dotted_name(c.func).rsplit(".", 1)[-1] == "shield"
+                    for c in ast.walk(n))
+                if shielded:
+                    continue
+                out.append(Violation(
+                    self.name, module.path, n.lineno, n.col_offset,
+                    f"await inside finally in `{qualname}`: "
+                    "cancellation during cleanup cancels the cleanup "
+                    "and skips everything after this line — wrap in "
+                    "asyncio.shield(...) or clean up synchronously"))
+
+    # --------------------------------- (3) swallowed CancelledError
+
+    def _check_cancel_swallow(self, module, qualname, nodes, out):
+        for t in nodes:
+            if not isinstance(t, ast.Try):
+                continue
+            for h in t.handlers:
+                if h.type is None:
+                    continue        # bare except: exception-hygiene's
+                names = [h.type] if not isinstance(h.type, ast.Tuple) \
+                    else list(h.type.elts)
+                if not any(dotted_name(e).rsplit(".", 1)[-1] ==
+                           "CancelledError" for e in names):
+                    continue
+                if _has_raise(h.body):
+                    continue
+                out.append(Violation(
+                    self.name, module.path, h.lineno, h.col_offset,
+                    f"except CancelledError in `{qualname}` does not "
+                    "re-raise: the task reports success to its "
+                    "canceller while half-done — clean up, then "
+                    "`raise`"))

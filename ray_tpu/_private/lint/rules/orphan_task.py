@@ -1,0 +1,68 @@
+"""orphan-task: fire-and-forget tasks must be tracked.
+
+asyncio keeps only a WEAK reference to running tasks: a task whose
+handle is dropped can be garbage-collected mid-flight, silently
+abandoning the work, and any exception it raises is swallowed with no
+log line and no metric (Python only mutters "Task exception was never
+retrieved" at GC time, often long after the cause). On a control plane
+where spawned tasks carry lease grants, pubsub pushes, and OOM-kill
+acks, a dropped spawn is a correctness bug twice over.
+
+Flags ``create_task(...)`` / ``ensure_future(...)`` whose result is
+discarded — i.e. the call is the whole expression statement. Handled
+shapes are NOT flagged:
+
+  * bound:          ``t = loop.create_task(c)``
+  * awaited:        ``await asyncio.create_task(c)``
+  * stored:         ``self._tasks.add(loop.create_task(c))`` or passed
+                    as any argument
+  * chained:        ``loop.create_task(c).add_done_callback(f)``
+  * sanctioned:     ``rpc.spawn_logged(c, what="...")`` — the tracked
+                    helper that holds a strong ref and logs + counts
+                    the exception via the metrics registry.
+
+The fix for a legit fire-and-forget is ``rpc.spawn_logged``; a spawn
+whose exception is provably impossible or handled elsewhere carries a
+pragma with the reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ray_tpu._private.lint.engine import (
+    Module, Rule, Violation, dotted_name, register,
+)
+
+SPAWNERS = {"create_task", "ensure_future"}
+
+
+@register
+class OrphanTaskRule(Rule):
+    name = "orphan-task"
+    description = ("create_task/ensure_future results dropped on the "
+                   "floor — the task can be GC'd mid-flight and its "
+                   "exception is swallowed; route through "
+                   "rpc.spawn_logged or track the handle")
+
+    def collect(self, module: Module) -> Iterable[Violation]:
+        path = module.path.replace("\\", "/")
+        if "/tests/" in path or path.startswith("tests/"):
+            return ()
+        out: List[Violation] = []
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Expr) and
+                    isinstance(node.value, ast.Call)):
+                continue
+            call = node.value
+            name = dotted_name(call.func).rsplit(".", 1)[-1]
+            if name not in SPAWNERS:
+                continue
+            out.append(Violation(
+                self.name, module.path, call.lineno, call.col_offset,
+                f"`{name}` result dropped: the task holds no strong "
+                "reference and its exception is swallowed — bind and "
+                "track the handle, or use rpc.spawn_logged(coro, what) "
+                "for fire-and-forget"))
+        return out

@@ -1,0 +1,215 @@
+"""rpc-deadlock: synchronous RPC wait cycles between single-threaded loops.
+
+Every component (gcs / raylet / worker / executor) is ONE asyncio
+loop. When a handler for method M on component A synchronously awaits
+``conn.call(M2)`` whose handler lives on component B, A's task blocks
+on B's loop. A cycle of such edges is a distributed deadlock armed by
+load: once every loop in the cycle is busy waiting on the next, no
+reply can ever be produced (the classic reason Ray's core keeps
+cross-component acks one-way or bounded).
+
+The rule builds the cross-process **wait-for graph** from the RPC
+index: nodes are ``component:Method`` handlers, and there is an edge
+``A:M -> B:M2`` when M's handler — or anything it calls through
+resolved call-graph edges, up to 3 hops — awaits a ``call``/
+``_gcs_call`` for M2 handled on a different component. One-way sends
+(``push``/``*_nowait``) never block, so they create no edge. An edge
+is **bounded** when every contributing call site carries a timeout
+(``timeout=`` on the call or an enclosing ``asyncio.wait_for``); a
+bounded leg eventually unwinds the cycle, which is how an existing
+cycle is *proven safe* (the raylet→owner ``WorkerOOMKilled`` ack is
+exactly this: 1 s timeout, grant path re-validates afterwards).
+
+Flags every cycle whose legs are ALL unbounded — fix by bounding one
+leg with a timeout, or turning one leg into a one-way push. The full
+graph ships in the ci/lint.sh JSON artifact (``rpc_wait_for_graph``)
+next to ``rpc_schemas``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Tuple
+
+from ray_tpu._private.lint.engine import Module, Rule, Violation, register
+
+# handler class -> process the handler's loop runs in
+COMPONENTS = {
+    "Raylet": "raylet",
+    "CoreWorker": "worker",
+    "GcsServer": "gcs",
+    "TaskExecutor": "executor",
+}
+
+WAITING_KINDS = {"call", "_gcs_call"}
+MAX_HOPS = 3
+MAX_CYCLE_LEN = 8
+
+
+def _component(fi) -> str:
+    if fi.class_name:
+        return COMPONENTS.get(fi.class_name, fi.class_name.lower())
+    base = fi.path.replace("\\", "/").rsplit("/", 1)[-1]
+    return base[:-3] if base.endswith(".py") else base
+
+
+def _reach(fi, depth: int, visited: Dict[Tuple[str, str], int]):
+    key = (fi.path, fi.qualname)
+    if visited.get(key, 0) >= depth:
+        return
+    visited[key] = depth
+    if depth > 1:
+        for node, callee in fi.calls:
+            if id(node) in fi.spawned_calls:
+                continue    # detached task: the handler does not wait
+            _reach(callee, depth - 1, visited)
+
+
+def build_wait_graph(program) -> List[dict]:
+    """Every cross-component synchronous-wait edge, deterministic
+    order. Edge: {from_component, from_method, to_component,
+    to_method, via, path, line, bounded}. ``bounded`` is True only
+    when EVERY contributing call site is bounded; path/line anchor an
+    unbounded site when one exists.
+
+    Roots are (a) every registered handler — cycle-capable nodes named
+    by their method — and (b) every spawned background task (the
+    callee of a ``create_task``-style edge), named ``task:<qualname>``
+    and attributed to the SPAWNER's loop. Task roots have no incoming
+    edges, so they can never fabricate a cycle, but their waits (the
+    raylet→owner ``WorkerOOMKilled`` ack, the owner→raylet lease
+    request) belong in the artifact — each is one bounded-or-not leg
+    a reviewer must be able to audit."""
+    calls_by_fn: Dict[Tuple[str, str], list] = {}
+    for cc in program.rpc.client_calls:
+        if cc.in_function is None or not cc.awaited or \
+                cc.kind not in WAITING_KINDS:
+            continue
+        key = (cc.in_function.path, cc.in_function.qualname)
+        calls_by_fn.setdefault(key, []).append(cc)
+
+    roots = []
+    for method in sorted(program.rpc.registrations):
+        for reg in program.rpc.registrations[method]:
+            if reg.handler is not None:
+                roots.append((method, _component(reg.handler),
+                              reg.handler))
+    seen_tasks = set()
+    for fi in sorted(program.functions.values(),
+                     key=lambda f: (f.path, f.qualname)):
+        for node, callee in fi.calls:
+            if id(node) not in fi.spawned_calls:
+                continue
+            tkey = (callee.path, callee.qualname)
+            if tkey in seen_tasks:
+                continue
+            seen_tasks.add(tkey)
+            roots.append((f"task:{callee.qualname}", _component(fi),
+                          callee))
+
+    edges: Dict[Tuple[str, str, str, str], dict] = {}
+    for label, comp, root_fi in roots:
+        visited: Dict[Tuple[str, str], int] = {}
+        _reach(root_fi, MAX_HOPS + 1, visited)
+        for key in visited:
+            for cc in calls_by_fn.get(key, []):
+                for treg in program.rpc.registrations.get(
+                        cc.method, []):
+                    if treg.handler is None:
+                        continue
+                    tcomp = _component(treg.handler)
+                    if tcomp == comp:
+                        continue
+                    ekey = (comp, label, tcomp, cc.method)
+                    e = edges.get(ekey)
+                    if e is None:
+                        edges[ekey] = {
+                            "from_component": comp,
+                            "from_method": label,
+                            "to_component": tcomp,
+                            "to_method": cc.method,
+                            "via": key[1],
+                            "path": cc.path,
+                            "line": cc.lineno,
+                            "bounded": bool(cc.bounded),
+                        }
+                    elif e["bounded"] and not cc.bounded:
+                        # one unbounded site makes the edge
+                        # unbounded; anchor it there
+                        e.update(bounded=False, via=key[1],
+                                 path=cc.path, line=cc.lineno)
+    return [edges[k] for k in sorted(edges)]
+
+
+def find_cycles(edge_list: List[dict]) -> List[List[dict]]:
+    """Elementary cycles over ``component:method`` nodes, each
+    returned as its edge list rotated so the smallest node leads."""
+    by_node: Dict[Tuple[str, str], List[dict]] = {}
+    for e in edge_list:
+        by_node.setdefault(
+            (e["from_component"], e["from_method"]), []).append(e)
+    cycles: List[List[dict]] = []
+    for start in sorted(by_node):
+        stack: List[Tuple[Tuple[str, str], List[dict]]] = [(start, [])]
+        while stack:
+            cur, trail = stack.pop()
+            for e in by_node.get(cur, []):
+                nxt = (e["to_component"], e["to_method"])
+                if nxt == start:
+                    cycles.append(trail + [e])
+                elif nxt > start and len(trail) < MAX_CYCLE_LEN and \
+                        all((t["from_component"], t["from_method"])
+                            != nxt for t in trail):
+                    stack.append((nxt, trail + [e]))
+    return cycles
+
+
+def wait_graph_report(program) -> dict:
+    """The JSON-artifact payload: the full edge list plus every cycle
+    with its safety verdict."""
+    edge_list = build_wait_graph(program)
+    cycles = []
+    for cyc in find_cycles(edge_list):
+        cycles.append({
+            "members": [f'{e["from_component"]}:{e["from_method"]}'
+                        for e in cyc],
+            "bounded": any(e["bounded"] for e in cyc),
+        })
+    return {"edges": edge_list, "cycles": cycles}
+
+
+@register
+class RpcDeadlockRule(Rule):
+    name = "rpc-deadlock"
+    description = ("cycles in the cross-process RPC wait-for graph "
+                   "where every leg is an unbounded synchronous await "
+                   "— a distributed deadlock armed by load")
+
+    def __init__(self):
+        self._program = None
+
+    def setup(self, program) -> None:
+        self._program = program
+
+    def collect(self, module: Module) -> Iterable[Violation]:
+        return ()
+
+    def finalize(self) -> Iterable[Violation]:
+        if self._program is None:
+            return ()
+        out: List[Violation] = []
+        edge_list = build_wait_graph(self._program)
+        for cyc in find_cycles(edge_list):
+            if any(e["bounded"] for e in cyc):
+                continue        # a bounded leg unwinds the cycle
+            chain = " -> ".join(
+                [f'{e["from_component"]}:{e["from_method"]}'
+                 for e in cyc] +
+                [f'{cyc[0]["from_component"]}:{cyc[0]["from_method"]}'])
+            anchor = cyc[0]
+            out.append(Violation(
+                self.name, anchor["path"], anchor["line"], 0,
+                f"synchronous RPC wait cycle {chain}: every leg is an "
+                f"unbounded await between single-threaded loops — "
+                f"bound one leg (call(..., timeout=...) or "
+                f"asyncio.wait_for) or make one leg a one-way push"))
+        return out

@@ -68,9 +68,12 @@ GENERATE = (
     "AddObjectEvents",
     "AddTaskEvents",
     "BookGangMembers",
+    "FetchObjectMeta",
     "GatherShards",
     "GetClusterEvents",
     "GetNodeStats",
+    "GetNodeStatsSummary",
+    "GetObjectLocations",
     "GetObjectSummary",
     "GetRpcTelemetry",
     "GrantLeaseCredits",
@@ -84,6 +87,7 @@ GENERATE = (
     "RequestWorkerLease",
     "ReturnWorker",
     "RevokeLeaseCredits",
+    "WorkerOOMKilled",
 )
 
 # Schema evolution overlays, applied on top of the inference. "require"

@@ -905,8 +905,9 @@ class Raylet:
                     node=self._nid12)
                 self._popen_worker(handle, worker_id.hex(), log_path)
                 return
-            asyncio.get_event_loop().create_task(
-                self._spawn_via_zygote(handle, worker_id.hex(), log_path))
+            rpc.spawn_logged(
+                self._spawn_via_zygote(handle, worker_id.hex(), log_path),
+                "raylet-spawn-via-zygote")
         else:
             self._popen_worker(handle, worker_id.hex(), log_path)
 
@@ -1138,7 +1139,7 @@ class Raylet:
                         "expected": False})
                 except ConnectionError:
                     pass
-            asyncio.get_event_loop().create_task(_report())
+            rpc.spawn_logged(_report(), "raylet-report-worker-death")
         self.workers.pop(worker_id, None)
         self._schedule_tick()
 
@@ -1283,8 +1284,8 @@ class Raylet:
         req.locality = locality
         if missing:
             req.deps_ready = False
-            asyncio.get_running_loop().create_task(
-                self._prefetch_deps(req, missing))
+            rpc.spawn_logged(self._prefetch_deps(req, missing),
+                             "raylet-prefetch-deps")
 
     async def _prefetch_deps(self, req: PendingRequest,
                              missing: List[Tuple[ObjectID, str, int]]):
@@ -1356,8 +1357,8 @@ class Raylet:
         """Watchdog kill (memory_monitor.py step 2), dispatched async:
         the SIGKILL must not land before the owner KNOWS this death is
         an OOM kill."""
-        asyncio.get_event_loop().create_task(
-            self._oom_kill_worker_async(handle, cause))
+        rpc.spawn_logged(self._oom_kill_worker_async(handle, cause),
+                         "raylet-oom-kill-worker")
 
     async def _oom_kill_worker_async(self, handle: WorkerHandle,
                                      cause: dict) -> None:
@@ -1380,9 +1381,9 @@ class Raylet:
                 not lease.client.closed:
             try:
                 await asyncio.wait_for(lease.client.call(
-                    "WorkerOOMKilled", {
-                        "worker_id": handle.worker_id,
-                        "cause": cause}), timeout=1.0)
+                    "WorkerOOMKilled", protocol.WorkerOOMKilledRequest(
+                        worker_id=handle.worker_id,
+                        cause=cause).to_header()), timeout=1.0)
             # raylint: disable=exception-hygiene — best-effort notify: an owner that can't ack still gets a typed (generic) worker-crash retry
             except Exception:
                 pass
@@ -1949,11 +1950,12 @@ class Raylet:
                     if (pressure or excess <= 0) else excess
                 w.last_revoke_ts = now
                 w.revoking = True
-                asyncio.get_event_loop().create_task(
+                rpc.spawn_logged(
                     self._revoke_credits(
                         w, list(w.lease_ids), max_release,
                         "memory_pressure" if pressure
-                        else "window_resize"))
+                        else "window_resize"),
+                    "raylet-revoke-credits")
             if excess < 0 and not pressure:
                 self._schedule_credit_topup()
 
@@ -2206,8 +2208,8 @@ class Raylet:
 
         def _on_owner_drop(c, gid=gang_id, r=rec):
             if self.gangs.get(gid) is r:
-                asyncio.get_event_loop().create_task(
-                    self._release_gang(gid, r, kill=True))
+                rpc.spawn_logged(self._release_gang(gid, r, kill=True),
+                                 "raylet-release-gang")
 
         rec["owner_conn"] = conn
         rec["owner_drop"] = _on_owner_drop
@@ -2690,7 +2692,7 @@ class Raylet:
                     # raylint: disable=exception-hygiene — owner may be gone; replica already dropped
                     except Exception:
                         pass
-                asyncio.get_running_loop().create_task(_report())
+                rpc.spawn_logged(_report(), "raylet-report-replica")
             self.store.mark_exposed(oid)  # caller is about to mmap
             return {"ok": True, "segment": name}
         return {"ok": False, "reason": reason}
@@ -2701,8 +2703,10 @@ class Raylet:
             return []
         try:
             owner = await self._owner_conn(owner_address)
-            reply, _ = await owner.call("GetObjectLocations",
-                                        {"object_id": oid.binary()})
+            reply, _ = await owner.call(
+                "GetObjectLocations",
+                protocol.GetObjectLocationsRequest(
+                    object_id=oid.binary()).to_header())
             return reply.get("locations", [])
         except ConnectionError:
             return []
@@ -2767,7 +2771,12 @@ class Raylet:
         finally:
             for t in tasks:
                 t.cancel()
-            await asyncio.gather(*tasks, return_exceptions=True)
+            # shield: if THIS task is cancelled during the reap, the
+            # children must still be awaited — an abandoned gather
+            # leaves their CancelledErrors unretrieved and any
+            # half-open connections unreaped
+            await asyncio.shield(
+                asyncio.gather(*tasks, return_exceptions=True))
         return [r for r in results if r]
 
     async def _pull_sources(self, locations: List[bytes]
@@ -2832,7 +2841,7 @@ class Raylet:
         async def _notify():
             async with self._pull_cond:
                 self._pull_cond.notify_all()
-        asyncio.get_running_loop().create_task(_notify())
+        rpc.spawn_logged(_notify(), "raylet-pull-done-notify")
 
     async def _data_channel(self, address: str):
         """Cached striped data-channel client for one peer (reference:
@@ -2926,6 +2935,30 @@ class Raylet:
             _source_fetchers(c, d) for c, d in found)
         return [f for lanes in per_source for f in lanes]
 
+    def _segment_reaper(self, alloc):
+        """Done-callback for a segment-mapping executor future whose
+        awaiter was cancelled. run_in_executor work cannot be
+        interrupted: the thread still maps (and holds the recycled
+        lease on) the segment after the cancel unwinds, so the
+        eventual result is reaped HERE — close the mapping, re-park a
+        recycled lease, unlink a fresh segment. Runs on the loop
+        thread (executor futures schedule callbacks there), so store
+        state is safe to touch."""
+        from ray_tpu._private.shm_store import _close_segment_owner
+
+        def _reap(fut):
+            if fut.cancelled() or fut.exception() is not None:
+                if alloc is not None:
+                    self.store.abort_lease(alloc[0])
+                return
+            name, owner, buf = fut.result()
+            _close_segment_owner(owner, buf)
+            if alloc is not None and name == alloc[0]:
+                self.store.abort_lease(name)
+            else:
+                self._unlink_segment(name)
+        return _reap
+
     async def _pull_chunked(self, oid: ObjectID,
                             sources: List[Tuple[rpc.Connection, str]]
                             ) -> Optional[Tuple[str, int]]:
@@ -2951,7 +2984,8 @@ class Raylet:
         async def _probe(conn, data_address):
             try:
                 reply, _ = await conn.call(
-                    "FetchObjectMeta", {"object_id": oid.binary()})
+                    "FetchObjectMeta", protocol.FetchObjectMetaRequest(
+                        object_id=oid.binary()).to_header())
             except ConnectionError:
                 return None
             if not reply.get("found"):
@@ -2986,12 +3020,17 @@ class Raylet:
                 if total >= RECYCLE_MIN_BYTES else None
             loop = asyncio.get_running_loop()
             # executor: a fresh multi-GiB MAP_POPULATE create would
-            # otherwise stall the raylet loop for the whole zero-fill
-            name, owner, buf = await loop.run_in_executor(
+            # otherwise stall the raylet loop for the whole zero-fill.
+            # Shielded: the mapping thread cannot be interrupted, so a
+            # cancel at this await must hand the eventual segment (and
+            # the recycled lease) to the reaper instead of leaking both.
+            fut = loop.run_in_executor(
                 None, acquire_segment, alloc, max(total, 1))
-            offsets = deque(range(0, total, chunk))
-            fetchers = await self._pull_fetchers(
-                oid, found, chunk, total, buf)
+            try:
+                name, owner, buf = await asyncio.shield(fut)
+            except asyncio.CancelledError:
+                fut.add_done_callback(self._segment_reaper(alloc))
+                raise
 
             def _discard():
                 # run_striped cancelled AND awaited every in-flight
@@ -3003,6 +3042,9 @@ class Raylet:
                 self._unlink_segment(name)
 
             try:
+                offsets = deque(range(0, total, chunk))
+                fetchers = await self._pull_fetchers(
+                    oid, found, chunk, total, buf)
                 if offsets:
                     await data_channel.run_striped(offsets, fetchers)
             except asyncio.CancelledError:
@@ -3087,8 +3129,16 @@ class Raylet:
             alloc = self.store.take_recycled(total) \
                 if total >= RECYCLE_MIN_BYTES else None
             loop = asyncio.get_running_loop()
-            name, owner, buf = await loop.run_in_executor(
+            # shielded for the same reason as _pull_chunked: the
+            # mapping thread survives the cancel, so its result must
+            # be reaped, not dropped
+            fut = loop.run_in_executor(
                 None, acquire_segment, alloc, max(total, 1))
+            try:
+                name, owner, buf = await asyncio.shield(fut)
+            except asyncio.CancelledError:
+                fut.add_done_callback(self._segment_reaper(alloc))
+                raise
 
             def _discard():
                 _close_segment_owner(owner, buf)
@@ -3203,7 +3253,8 @@ class Raylet:
                 # the meta probe pins the source segment serve-side
                 # (mark_exposed) and yields the bulk endpoint
                 reply, _ = await peer.call(
-                    "FetchObjectMeta", {"object_id": src["oid"]})
+                    "FetchObjectMeta", protocol.FetchObjectMetaRequest(
+                        object_id=src["oid"]).to_header())
                 if not reply.get("found"):
                     raise ConnectionError(
                         "source shard "

@@ -48,6 +48,54 @@ logger = logging.getLogger(__name__)
 RPC_LATENCY_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0,
                        5.0)
 
+# Strong references for fire-and-forget tasks: asyncio itself keeps
+# only a WEAK reference to running tasks, so a spawn whose handle is
+# dropped can be garbage-collected mid-flight with its exception
+# swallowed. Tasks park here until done (set mutation is GIL-atomic —
+# multiple loops in one process, e.g. a head node, share it safely).
+_SPAWNED: set = set()
+_spawn_errors = None
+
+
+def _spawn_error_counter():
+    global _spawn_errors
+    if _spawn_errors is None:
+        from ray_tpu._private.metrics import Counter
+        _spawn_errors = Counter(
+            "ray_tpu_background_task_errors_total",
+            "Exceptions raised by fire-and-forget background tasks "
+            "(rpc.spawn_logged), labeled by task name.")
+    return _spawn_errors
+
+
+def spawn_logged(coro, what: str, loop=None) -> "asyncio.Task":
+    """Tracked fire-and-forget: create a task, hold a strong reference
+    until it finishes, and turn an unhandled exception into a log line
+    plus a ``ray_tpu_background_task_errors_total`` count instead of a
+    silent GC-time mutter. Returns the task (callers may still await
+    or cancel it). ``what`` labels the spawn in logs and metrics."""
+    if loop is None:
+        loop = asyncio.get_event_loop()
+    task = loop.create_task(coro)
+    _SPAWNED.add(task)
+
+    def _done(t, _what=what):
+        _SPAWNED.discard(t)
+        if t.cancelled():
+            return
+        exc = t.exception()
+        if exc is not None:
+            try:
+                _spawn_error_counter().inc(labels={"what": _what})
+            except Exception as me:  # metrics must not mask the log line
+                logger.debug("background-task error counter "
+                             "unavailable: %r", me)
+            logger.error("background task %r died: %r", _what, exc,
+                         exc_info=exc)
+
+    task.add_done_callback(_done)
+    return task
+
 
 def _pct_block(samples: Sequence[float]) -> dict:
     """Percentile summary (ms) of a latency reservoir; ``{"count": 0}``
@@ -962,15 +1010,17 @@ class Connection:
                 self._handle_sync(handler, seq, method, header, bufs,
                                   arr_ts)
                 return
-            self._loop.create_task(
-                self._handle(seq, method, header, bufs, arr_ts))
+            spawn_logged(
+                self._handle(seq, method, header, bufs, arr_ts),
+                f"rpc-handle:{method}", loop=self._loop)
         elif kind == KIND_PUSH:
             handler = self.handlers.get(method)
             if handler is None:
                 logger.warning("no handler for push %s", method)
             else:
-                self._loop.create_task(
-                    self._run_push(handler, header, bufs))
+                spawn_logged(
+                    self._run_push(handler, header, bufs),
+                    f"rpc-push:{method}", loop=self._loop)
         elif kind == KIND_ERROR:
             fut = self._pending.get(seq)
             if fut is not None and not fut.done():

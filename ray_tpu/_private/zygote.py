@@ -360,6 +360,7 @@ class ZygoteClient:
 
     async def _ensure_stream(self) -> None:
         if self._reader is None:
+            # raylint: disable=await-atomicity — only reached under _call's self._lock; one caller at a time
             self._reader, self._writer = await asyncio.open_connection(
                 sock=self._sock)
             # the transport owns the fd now; drop our direct handle so

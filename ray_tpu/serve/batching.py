@@ -31,6 +31,8 @@ import asyncio
 import functools
 from typing import Any, Callable, List, Optional
 
+from ray_tpu._private import rpc
+
 
 class _BatchQueue:
     """Pending calls for one batched function (per bound instance)."""
@@ -60,7 +62,11 @@ class _BatchQueue:
         if not self.pending:
             return
         batch, self.pending = self.pending, []
-        asyncio.get_running_loop().create_task(self._run(batch))
+        # Tracked spawn: _run fans most errors out to caller futures,
+        # but anything it RAISES (wrong-length result bookkeeping, a
+        # BaseException re-raised after fan-out) died silently in a
+        # dropped task handle before — now it's logged and counted.
+        rpc.spawn_logged(self._run(batch), "serve-batch-run")
 
     async def _run(self, batch: List[tuple]) -> None:
         requests = [r for r, _ in batch]

@@ -1,0 +1,715 @@
+"""raylint v4 concurrency-hazard suite: await-atomicity, cancel-safety,
+orphan-task and rpc-deadlock fixtures, wait-for-graph unit pins, the
+spawn_logged runtime contract, and regression pins for the true
+positives the rules surfaced (and this PR fixed) in the control plane.
+
+The bad fixtures include the two historic bug shapes the rules were
+built to catch: the PR6 admission-budget leak (bytes admitted, then a
+cancellable await with no releasing finally) and the PR9 poisoned
+zygote exchange (a cancel mid-read desyncs request/reply framing).
+"""
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from ray_tpu._private import rpc
+from ray_tpu._private.lint import lint_sources
+from ray_tpu._private.lint.engine import Module
+from ray_tpu._private.lint.callgraph import build_program
+from ray_tpu._private.lint.rules.rpc_deadlock import (
+    build_wait_graph, find_cycles, wait_graph_report,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "ray_tpu")
+
+
+def run(src, rules=None, path="ray_tpu/_private/mod.py", extra=None):
+    sources = {path: textwrap.dedent(src)}
+    if extra:
+        sources.update({p: textwrap.dedent(s) for p, s in extra.items()})
+    return lint_sources(sources, rules)
+
+
+def rules_of(violations):
+    return [v.rule for v in violations]
+
+
+# ---------------------------------------------------------- await-atomicity
+
+class TestAwaitAtomicity:
+    def test_check_then_act_across_await(self):
+        vs = run("""
+            class Raylet:
+                async def claim(self, req):
+                    if self._owner is None:
+                        await self._spawn(req)
+                        self._owner = req
+        """, ["await-atomicity"])
+        assert rules_of(vs) == ["await-atomicity"]
+        assert "_owner" in vs[0].message
+
+    def test_stale_read_modify_write(self):
+        vs = run("""
+            class W:
+                async def bump(self):
+                    cur = self._total
+                    extra = await self._measure()
+                    self._total = cur + extra
+        """, ["await-atomicity"])
+        assert rules_of(vs) == ["await-atomicity"]
+        assert "lost" in vs[0].message
+
+    def test_resample_after_await_is_safe(self):
+        vs = run("""
+            class W:
+                async def bump(self):
+                    cur = self._total
+                    extra = await self._measure()
+                    if self._total == cur:
+                        self._total = cur + extra
+        """, ["await-atomicity"])
+        assert vs == []
+
+    def test_lock_guarded_section_is_safe(self):
+        vs = run("""
+            class W:
+                async def bump(self):
+                    async with self._lock:
+                        cur = self._total
+                        extra = await self._measure()
+                        self._total = cur + extra
+        """, ["await-atomicity"])
+        assert vs == []
+
+    def test_constant_latch_is_safe(self):
+        vs = run("""
+            class W:
+                async def close(self):
+                    if not self._closed:
+                        await self._drain()
+                        self._closed = True
+        """, ["await-atomicity"])
+        assert vs == []
+
+    def test_transitive_write_through_callee(self):
+        vs = run("""
+            class W:
+                async def refresh(self):
+                    if self._conn is None:
+                        await self._sleep()
+                        self._redial()
+                def _redial(self):
+                    self._conn = 1
+        """, ["await-atomicity"])
+        assert rules_of(vs) == ["await-atomicity"]
+        assert "_redial" in vs[0].message
+
+    def test_callee_side_resample_is_safe(self):
+        # the reconnect-helper shape: the callee re-reads the attribute
+        # before replacing it, so the decision is made on fresh state
+        vs = run("""
+            class W:
+                async def refresh(self):
+                    if self._conn is None:
+                        await self._sleep()
+                        self._redial()
+                def _redial(self):
+                    if self._conn is None:
+                        self._conn = 1
+        """, ["await-atomicity"])
+        assert vs == []
+
+    def test_spawned_callee_is_not_a_synchronous_write(self):
+        vs = run("""
+            import asyncio
+            class W:
+                async def refresh(self):
+                    if self._conn is None:
+                        await self._sleep()
+                        asyncio.get_event_loop().create_task(
+                            self._redial())
+                async def _redial(self):
+                    self._conn = 1
+        """, ["await-atomicity"])
+        assert vs == []
+
+
+# ------------------------------------------------------------ cancel-safety
+
+class TestCancelSafety:
+    def test_pr6_admission_leak_shape(self):
+        # the historic PR6 bug: budget incremented, then a cancellable
+        # await with no releasing finally — a cancelled pull leaks the
+        # admitted bytes forever
+        vs = run("""
+            class Raylet:
+                async def pull(self, total):
+                    self._pull_inflight_bytes += total
+                    await self._transfer(total)
+                    self._pull_inflight_bytes -= total
+        """, ["cancel-safety"])
+        assert rules_of(vs) == ["cancel-safety"]
+        assert "_pull_inflight_bytes" in vs[0].message
+
+    def test_admission_with_finally_is_safe(self):
+        vs = run("""
+            class Raylet:
+                async def pull(self, total):
+                    self._pull_inflight_bytes += total
+                    try:
+                        await self._transfer(total)
+                    finally:
+                        self._pull_inflight_bytes -= total
+        """, ["cancel-safety"])
+        assert vs == []
+
+    def test_acquire_table_lease_leak(self):
+        vs = run("""
+            class Raylet:
+                async def pull(self, total):
+                    alloc = self.store.take_recycled(total)
+                    await self._transfer(alloc)
+                    self.store.release_lease(alloc[0])
+        """, ["cancel-safety"])
+        assert rules_of(vs) == ["cancel-safety"]
+        assert "take_recycled" in vs[0].message
+
+    def test_acquire_with_releasing_cancel_handler_is_safe(self):
+        vs = run("""
+            class Raylet:
+                async def pull(self, total):
+                    alloc = self.store.take_recycled(total)
+                    try:
+                        await self._transfer(alloc)
+                    except asyncio.CancelledError:
+                        self.store.abort_lease(alloc[0])
+                        raise
+                    self.store.release_lease(alloc[0])
+        """, ["cancel-safety"])
+        assert vs == []
+
+    def test_pr9_poisoned_exchange_shape(self):
+        # the historic PR9 bug: a cancel mid-read desyncs the strictly
+        # ordered request/reply framing and the next caller adopts a
+        # stale reply — the acquiring await itself must sit inside the
+        # protecting try (during=True)
+        vs = run("""
+            class ZygoteClient:
+                async def _call(self, req):
+                    self._send(req)
+                    reply = await self._read_frame()
+                    return reply
+        """, ["cancel-safety"])
+        assert rules_of(vs) == ["cancel-safety"]
+        assert "_read_frame" in vs[0].message
+
+    def test_pr9_fixed_shape_is_safe(self):
+        vs = run("""
+            class ZygoteClient:
+                async def _call(self, req):
+                    self._send(req)
+                    try:
+                        reply = await self._read_frame()
+                    except asyncio.CancelledError:
+                        self._broken = True
+                        raise
+                    return reply
+        """, ["cancel-safety"])
+        assert vs == []
+
+    def test_rpc_booking_without_rollback(self):
+        vs = run("""
+            class Raylet:
+                async def book(self, conn, members):
+                    reply, _ = await conn.call("BookGangMembers",
+                                               {"members": members})
+                    await self._activate(reply)
+        """, ["cancel-safety"])
+        assert rules_of(vs) == ["cancel-safety"]
+        assert "BookGangMembers" in vs[0].message
+
+    def test_await_in_finally_without_shield(self):
+        vs = run("""
+            class G:
+                async def serve(self, conn):
+                    try:
+                        await conn.call("GetLogs", {})
+                    finally:
+                        await conn.close()
+        """, ["cancel-safety"])
+        assert rules_of(vs) == ["cancel-safety"]
+        assert "shield" in vs[0].message
+
+    def test_shielded_finally_await_is_safe(self):
+        vs = run("""
+            import asyncio
+            class G:
+                async def serve(self, conn):
+                    try:
+                        await conn.call("GetLogs", {})
+                    finally:
+                        await asyncio.shield(conn.close())
+        """, ["cancel-safety"])
+        assert vs == []
+
+    def test_swallowed_cancellederror(self):
+        vs = run("""
+            class S:
+                async def loop(self):
+                    try:
+                        await self._accept()
+                    except asyncio.CancelledError:
+                        return
+        """, ["cancel-safety"])
+        assert rules_of(vs) == ["cancel-safety"]
+        assert "re-raise" in vs[0].message
+
+    def test_cancel_handler_that_reraises_is_safe(self):
+        vs = run("""
+            class S:
+                async def loop(self):
+                    try:
+                        await self._accept()
+                    except asyncio.CancelledError:
+                        self._cleanup()
+                        raise
+        """, ["cancel-safety"])
+        assert vs == []
+
+
+# -------------------------------------------------------------- orphan-task
+
+class TestOrphanTask:
+    def test_dropped_create_task(self):
+        vs = run("""
+            import asyncio
+            def kick(loop, coro):
+                loop.create_task(coro)
+        """, ["orphan-task"])
+        assert rules_of(vs) == ["orphan-task"]
+        assert "spawn_logged" in vs[0].message
+
+    def test_dropped_ensure_future(self):
+        vs = run("""
+            import asyncio
+            def kick(coro):
+                asyncio.ensure_future(coro)
+        """, ["orphan-task"])
+        assert rules_of(vs) == ["orphan-task"]
+
+    def test_bound_handle_is_safe(self):
+        vs = run("""
+            import asyncio
+            class S:
+                def start(self, loop):
+                    self._task = loop.create_task(self._run())
+        """, ["orphan-task"])
+        assert vs == []
+
+    def test_spawn_logged_is_safe(self):
+        vs = run("""
+            from ray_tpu._private import rpc
+            def kick(coro):
+                rpc.spawn_logged(coro, "kick")
+        """, ["orphan-task"])
+        assert vs == []
+
+    def test_tests_are_exempt(self):
+        vs = run("""
+            import asyncio
+            def kick(loop, coro):
+                loop.create_task(coro)
+        """, ["orphan-task"], path="tests/test_x.py")
+        assert vs == []
+
+
+# ------------------------------------------------------------- rpc-deadlock
+
+# Two components whose handlers synchronously await each other — the
+# textbook distributed deadlock over single-threaded loops.
+_CYCLE_A = """
+    class Raylet:
+        def start(self):
+            self.server = RpcServer({
+                "LeaseInfo": self.handle_lease_info,
+            })
+        async def handle_lease_info(self, conn, header, bufs):
+            reply, _ = await self.gcs_conn.call("NodeInfo", {})
+            return reply
+"""
+_CYCLE_B = """
+    class GcsServer:
+        def start(self):
+            self.server = RpcServer({
+                "NodeInfo": self.handle_node_info,
+            })
+        async def handle_node_info(self, conn, header, bufs):
+            reply, _ = await self.raylet_conn.call("LeaseInfo", {})
+            return reply
+"""
+
+
+def _mods(**sources):
+    return [Module(p, textwrap.dedent(s)) for p, s in sources.items()]
+
+
+class TestRpcDeadlock:
+    def test_unbounded_handler_cycle_flagged(self):
+        vs = run(_CYCLE_A, ["rpc-deadlock"],
+                 extra={"ray_tpu/_private/gcs2.py": _CYCLE_B})
+        assert rules_of(vs) == ["rpc-deadlock"]
+        assert "wait cycle" in vs[0].message
+        assert "raylet:LeaseInfo" in vs[0].message
+        assert "gcs:NodeInfo" in vs[0].message
+
+    def test_bounded_leg_breaks_the_cycle(self):
+        bounded = _CYCLE_B.replace(
+            'call("LeaseInfo", {})',
+            'call("LeaseInfo", {}, timeout=5.0)')
+        vs = run(_CYCLE_A, ["rpc-deadlock"],
+                 extra={"ray_tpu/_private/gcs2.py": bounded})
+        assert vs == []
+
+    def test_one_way_push_creates_no_edge(self):
+        pushed = _CYCLE_B.replace(
+            'reply, _ = await self.raylet_conn.call("LeaseInfo", {})',
+            'reply = self.raylet_conn.push_nowait("LeaseInfo", {})')
+        vs = run(_CYCLE_A, ["rpc-deadlock"],
+                 extra={"ray_tpu/_private/gcs2.py": pushed})
+        assert vs == []
+
+    def test_wait_graph_edges_and_boundedness(self):
+        program = build_program(_mods(**{
+            "ray_tpu/_private/raylet.py": _CYCLE_A,
+            "ray_tpu/_private/gcs.py": _CYCLE_B.replace(
+                'call("LeaseInfo", {})',
+                'call("LeaseInfo", {}, timeout=5.0)'),
+        }))
+        edges = build_wait_graph(program)
+        assert len(edges) == 2
+        by_from = {e["from_method"]: e for e in edges}
+        assert by_from["LeaseInfo"]["to_component"] == "gcs"
+        assert by_from["LeaseInfo"]["bounded"] is False
+        assert by_from["NodeInfo"]["bounded"] is True
+        cycles = find_cycles(edges)
+        assert len(cycles) == 1
+        report = wait_graph_report(program)
+        assert report["cycles"] == [{
+            "members": ["gcs:NodeInfo", "raylet:LeaseInfo"],
+            "bounded": True}]
+
+    def test_wait_for_wrapper_counts_as_bounded(self):
+        src = _CYCLE_A.replace(
+            'reply, _ = await self.gcs_conn.call("NodeInfo", {})',
+            'reply, _ = await asyncio.wait_for('
+            'self.gcs_conn.call("NodeInfo", {}), 5.0)')
+        program = build_program(_mods(**{
+            "ray_tpu/_private/raylet.py": src}))
+        assert all(e["bounded"] for e in build_wait_graph(program))
+
+    def test_spawned_task_is_a_root_not_a_cycle_member(self):
+        # a handler that only SPAWNS the waiting coroutine never blocks
+        # its loop: the wait shows up as a task: root edge (audit
+        # surface) but can't close a cycle
+        detached = _CYCLE_A.replace(
+            "reply, _ = await self.gcs_conn.call(\"NodeInfo\", {})\n"
+            "            return reply",
+            "asyncio.get_event_loop().create_task(self._refresh())\n"
+            "            return {}") + """
+        async def _refresh(self):
+            await self.gcs_conn.call("NodeInfo", {})
+"""
+        vs = run(detached, ["rpc-deadlock"],
+                 extra={"ray_tpu/_private/gcs2.py": _CYCLE_B})
+        assert vs == []
+        program = build_program(_mods(**{
+            "ray_tpu/_private/raylet.py": textwrap.dedent(detached),
+            "ray_tpu/_private/gcs2.py": textwrap.dedent(_CYCLE_B)}))
+        edges = build_wait_graph(program)
+        task_edges = [e for e in edges
+                      if e["from_method"].startswith("task:")]
+        assert task_edges and task_edges[0]["from_component"] == "raylet"
+
+    def test_real_package_graph_has_no_unbounded_cycle(self):
+        """The ratchet for the real control plane: the cross-process
+        wait-for graph stays non-trivial, the proven-safe OOM-ack leg
+        stays bounded, and no all-unbounded cycle exists."""
+        mods = []
+        for name in ("raylet.py", "core_worker.py", "gcs.py",
+                     "task_executor.py"):
+            p = os.path.join(PKG, "_private", name)
+            if not os.path.exists(p):
+                continue
+            with open(p, encoding="utf-8") as f:
+                mods.append(Module(f"ray_tpu/_private/{name}", f.read()))
+        report = wait_graph_report(build_program(mods))
+        assert len(report["edges"]) >= 10
+        oom = [e for e in report["edges"]
+               if e["to_method"] == "WorkerOOMKilled"]
+        assert oom and all(e["bounded"] for e in oom)
+        assert all(c["bounded"] for c in report["cycles"])
+
+
+# ----------------------------------------------------- spawn_logged runtime
+
+class TestSpawnLogged:
+    def test_exception_is_logged_and_counted(self, caplog):
+        async def main():
+            async def boom():
+                raise ValueError("exploded")
+            t = rpc.spawn_logged(boom(), "unit-boom")
+            with pytest.raises(ValueError):
+                await t
+            await asyncio.sleep(0)  # let the done-callback run
+
+        before = rpc._spawn_error_counter().snapshot().get(
+            (("what", "unit-boom"),), 0.0)
+        with caplog.at_level(logging.ERROR, logger="ray_tpu._private.rpc"):
+            asyncio.run(main())
+        after = rpc._spawn_error_counter().snapshot().get(
+            (("what", "unit-boom"),), 0.0)
+        assert after == before + 1
+        assert any("unit-boom" in r.message and "died" in r.message
+                   for r in caplog.records)
+
+    def test_cancel_is_not_an_error(self, caplog):
+        async def main():
+            async def forever():
+                await asyncio.sleep(60)
+            t = rpc.spawn_logged(forever(), "unit-cancel")
+            await asyncio.sleep(0)
+            t.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await t
+            await asyncio.sleep(0)
+
+        with caplog.at_level(logging.ERROR, logger="ray_tpu._private.rpc"):
+            asyncio.run(main())
+        assert not any("unit-cancel" in r.message for r in caplog.records)
+
+    def test_strong_reference_until_done(self):
+        async def main():
+            started = asyncio.Event()
+
+            async def waiter():
+                started.set()
+                await asyncio.sleep(30)
+            t = rpc.spawn_logged(waiter(), "unit-ref")
+            await started.wait()
+            assert t in rpc._SPAWNED
+            t.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await t
+            await asyncio.sleep(0)
+            assert t not in rpc._SPAWNED
+        asyncio.run(main())
+
+    def test_batched_serve_failure_is_logged_and_counted(self, caplog):
+        """The satellite pin: a BaseException escaping a @serve.batch
+        run used to die silently in a dropped task handle — callers got
+        their futures resolved, but the re-raise that should surface
+        replica teardown vanished. Now it's logged AND counted."""
+        from ray_tpu import serve
+
+        class Boom(BaseException):
+            pass
+
+        @serve.batch(max_batch_size=1)
+        async def handler(requests):
+            raise Boom("replica teardown")
+
+        async def main():
+            with pytest.raises(Boom):
+                await handler(1)
+            await asyncio.sleep(0.05)  # spawned _run reaches its raise
+            await asyncio.sleep(0)
+
+        before = rpc._spawn_error_counter().snapshot().get(
+            (("what", "serve-batch-run"),), 0.0)
+        with caplog.at_level(logging.ERROR, logger="ray_tpu._private.rpc"):
+            asyncio.run(main())
+        after = rpc._spawn_error_counter().snapshot().get(
+            (("what", "serve-batch-run"),), 0.0)
+        assert after == before + 1
+        assert any("serve-batch-run" in r.message
+                   for r in caplog.records)
+
+
+# ----------------------------------------- runtime regression pins (fixes)
+
+class TestRuntimeFixes:
+    def test_request_lease_cancel_reraises_and_settles_ledger(self):
+        """core_worker._request_lease used to swallow CancelledError:
+        `task.cancel(); await task` saw a clean exit while the lease
+        request was half-done. It must now settle pending_lease AND
+        stay cancelled."""
+        from ray_tpu._private.core_worker import (
+            CoreWorker, SchedulingKeyState)
+
+        class NeverConn:
+            async def call(self, *a, **kw):
+                await asyncio.sleep(3600)
+
+        async def main():
+            cw = CoreWorker.__new__(CoreWorker)
+            cw.raylet_address = "127.0.0.1:1"
+            cw.raylet_conn = NeverConn()
+            state = SchedulingKeyState({"CPU": 1.0})
+            state.pending_lease = 1
+            t = asyncio.get_running_loop().create_task(
+                cw._request_lease(0, state, cw.raylet_address))
+            await asyncio.sleep(0.01)
+            t.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await t
+            assert t.cancelled()
+            assert state.pending_lease == 0
+        asyncio.run(main())
+
+    def test_accept_loop_stays_cancelled(self):
+        """data_channel._accept_loop used to turn cancellation into a
+        clean return — the canceller could not tell a stopped listener
+        from a still-running one."""
+        from ray_tpu._private.data_channel import DataPlaneServer
+
+        async def main():
+            srv = DataPlaneServer(store=None)
+            await srv.start()
+            task = srv._accept_task
+            await asyncio.sleep(0.01)
+            task.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await task
+            assert task.cancelled()
+            await srv.close()
+        asyncio.run(main())
+
+    def test_node_address_refresh_never_rolls_backwards(self):
+        """core_worker._node_address_of: a slow GetAllNodeInfo reply
+        used to overwrite a NEWER table a concurrent refresher had
+        already installed (check-then-act across the await). The write
+        is now guarded by a re-sample of _node_table_ts."""
+        from ray_tpu._private.core_worker import CoreWorker
+        import time as _time
+
+        async def main():
+            cw = CoreWorker.__new__(CoreWorker)
+            cw._node_table = {}
+            cw._node_table_ts = 0.0
+
+            async def slow_gcs_call(method, header):
+                # a concurrent refresher lands a fresher table while
+                # our RPC is in flight
+                cw._node_table = {b"n1": "fresh:1"}
+                cw._node_table_ts = _time.monotonic() + 100.0
+                return {"nodes": [{"node_id": b"n1",
+                                   "address": "stale:1",
+                                   "alive": True}]}, None
+            cw._gcs_call = slow_gcs_call
+            addr = await cw._node_address_of(b"n1")
+            assert addr == "fresh:1"
+            assert cw._node_table == {b"n1": "fresh:1"}
+        asyncio.run(main())
+
+    def test_segment_reaper_reparks_or_unlinks(self):
+        """raylet: a cancel during the shielded run_in_executor segment
+        mapping hands the thread's eventual result to the reaper —
+        recycled leases are re-parked, fresh segments unlinked, failed
+        mappings abort the lease. (Before the fix the mapping and the
+        lease both leaked until the 600 s stale sweep.)"""
+        from ray_tpu._private.raylet import Raylet
+
+        calls = []
+
+        class FakeStore:
+            def abort_lease(self, name):
+                calls.append(("abort", name))
+
+            def release_lease(self, name):
+                calls.append(("release", name))
+
+        class FakeFut:
+            def __init__(self, result=None, exc=None, cancelled=False):
+                self._result, self._exc = result, exc
+                self._cancelled = cancelled
+
+            def cancelled(self):
+                return self._cancelled
+
+            def exception(self):
+                return self._exc
+
+            def result(self):
+                return self._result
+
+        ry = Raylet.__new__(Raylet)
+        ry.store = FakeStore()
+        unlinked = []
+        ry._unlink_segment = unlinked.append
+
+        closed = []
+
+        class FakeOwner:
+            def close(self):
+                closed.append(True)
+
+        class FakeBuf:
+            def release(self):
+                pass
+
+        # recycled lease reused -> re-parked for the next pull
+        ry._segment_reaper(("seg_a", 64))(
+            FakeFut(result=("seg_a", FakeOwner(), FakeBuf())))
+        assert ("abort", "seg_a") in calls
+        assert closed == [True]
+
+        # fresh segment (no lease) -> unlinked
+        ry._segment_reaper(None)(
+            FakeFut(result=("seg_b", FakeOwner(), FakeBuf())))
+        assert unlinked == ["seg_b"]
+
+        # mapping failed -> the recycled lease is still aborted
+        ry._segment_reaper(("seg_c", 64))(FakeFut(exc=OSError("boom")))
+        assert ("abort", "seg_c") in calls
+
+    def test_gcs_dashboard_close_is_shielded_in_source(self):
+        """gcs._dashboard_api's one-shot conn close rides a finally; it
+        must stay shielded (a cancelled dashboard request leaked the
+        socket + recv task). Source-level pin: the cancel-safety rule
+        keeps the whole file clean, so an unshielded regression fails
+        the gate — assert the shield is really there."""
+        with open(os.path.join(PKG, "_private", "gcs.py")) as f:
+            src = f.read()
+        assert "await asyncio.shield(conn.close())" in src
+
+    def test_first_plus_grace_reap_is_shielded_in_source(self):
+        """raylet._first_plus_grace must reap its children even when
+        cancelled mid-reap (abandoned gather = unretrieved child
+        CancelledErrors + unreaped half-open connections)."""
+        with open(os.path.join(PKG, "_private", "raylet.py")) as f:
+            src = f.read()
+        assert "await asyncio.shield(\n" \
+               "                asyncio.gather(*tasks, " \
+               "return_exceptions=True))" in src
+
+
+# --------------------------------------------------------------- the ratchet
+
+class TestRealPackageClean:
+    def test_real_package_is_clean(self):
+        """All four concurrency rules enabled over the real tree: zero
+        findings. New hazards (or a pragma without a rationale) fail
+        here before they fail CI."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "ray_tpu._private.lint", "--rules",
+             "await-atomicity,cancel-safety,orphan-task,rpc-deadlock",
+             PKG],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -582,16 +582,20 @@ def test_cross_node_lifecycle_and_timeline(cluster2):
     import gc
     gc.collect()
     # FREED rides the raylet heartbeat, OUT_OF_SCOPE the driver's
-    # metrics flush — poll until BOTH cadences delivered
+    # metrics flush — poll until BOTH cadences delivered, and until the
+    # SECOND replica's FREED landed too (each node flushes on its own
+    # heartbeat; returning on the first FREED races the peer's)
+    def _freed_nodes(o):
+        return {(e.get("attrs") or {}).get("node")
+                for e in o["events"] if e["state"] == FREED}
     o = _find_object(
         lambda o: o["object_id"] == oid_hex and o["state"] == FREED and
-        OUT_OF_SCOPE in [e["state"] for e in o["events"]],
+        OUT_OF_SCOPE in [e["state"] for e in o["events"]] and
+        len(_freed_nodes(o)) >= 2,
         timeout=40)
     states = [e["state"] for e in o["events"]]
     # the free reached BOTH replicas (two FREED events, two nodes)
-    freed_nodes = {(e.get("attrs") or {}).get("node")
-                   for e in o["events"] if e["state"] == FREED}
-    assert len(freed_nodes) >= 2, o["events"]
+    assert len(_freed_nodes(o)) >= 2, o["events"]
 
     # timeline: object slices on the same clock as tasks
     deadline = time.monotonic() + 30
