@@ -708,6 +708,11 @@ def main():
         reshard_row = _reshard_bench()
     except Exception as e:  # noqa: BLE001 — secondary row
         reshard_row = {"error": str(e)}
+    _trace("all_reduce")
+    try:
+        allreduce_row = _all_reduce_bench()
+    except Exception as e:  # noqa: BLE001 — secondary row
+        allreduce_row = {"error": str(e)}
     _trace("model bench (subprocess)")
     model_perf = _model_bench()
     _trace("model bench done")
@@ -759,6 +764,7 @@ def main():
             "worker_spawn": worker_spawn_row,
             "cross_node_transfer": xnode_row,
             "reshard": reshard_row,
+            "all_reduce": allreduce_row,
             "lint_runtime": lint_row,
             "columnar_data_1m": columnar_row,
             "scalability": scalability,
@@ -1332,6 +1338,303 @@ def _reshard_bench() -> dict:
             await gcs.stop()
 
     return asyncio.run(run())
+
+
+def _all_reduce_bench() -> dict:
+    """Ring all_reduce (ISSUE 18 headline): three in-process raylets
+    each hold a full-size float64 partial (>= 1 GiB by default) and
+    reduce them two ways:
+
+    * ring — the driver's reduce-scatter + all-gather rounds issued
+      directly against the RingInit/RingStep/RingFinish handlers:
+      per-rank wire traffic 2*(P-1)/P * N (the bandwidth optimum),
+      every rank pulling AND folding concurrently, recv+reduce
+      pipelined through double-buffered scratch windows with the
+      native GIL-releasing ``reduce_into`` kernel;
+    * fold — the in-tree fallback path's movement for the SAME
+      result: ONE GatherShards sink pulls every peer partial
+      ((P-1) * N into a single node), folds serially as the windows
+      land, then every other rank pulls the reduced object from the
+      sink ((P-1) * N back out — the ring leg ends with the result
+      SEALED on all P nodes, so the fold leg must deliver the same
+      placement to compare like with like).
+
+    Gates: ring >= 2x fold wall clock, per-rank wire bytes within 10%
+    of the 2*(P-1)/P * N bound (from RingFinish telemetry), and
+    pull_stats ``intermediate_copies == 0`` across the ring leg.
+
+    Each raylet runs on its OWN event loop thread — the ring's whole
+    claim is per-node parallelism (every rank pulls, serves and folds
+    at once), and a shared loop would serialize exactly the work the
+    bench measures."""
+    import asyncio
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from ray_tpu._private import data_channel
+    from ray_tpu._private import distributed_array as da
+    from ray_tpu._private.config import RayTpuConfig
+    from ray_tpu._private.gcs import GcsServer
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.raylet import Raylet
+    from ray_tpu._private.serialization import SerializationContext
+    from ray_tpu._private.shm_store import (
+        _close_segment_owner, acquire_segment, plan_segment,
+        write_segment)
+
+    mb = int(os.environ.get("BENCH_ALLREDUCE_MB", "1024"))
+    reps = int(os.environ.get("BENCH_ALLREDUCE_REPS", "3"))
+    nranks = 3
+    rows = 1024
+    cols = mb * 1024 * 1024 // 8 // rows
+    shape = (rows, cols)
+
+    cfg = RayTpuConfig.create({
+        "num_prestart_workers": 0, "event_log_enabled": False,
+        # per raylet: its partial + its ring accumulator + the
+        # fold sink's result on node 0, with headroom
+        "object_store_memory": 4 * mb * 1024 * 1024,
+        # GiB-scale memcpys can still stall a raylet's own loop for
+        # stretches — don't let the GCS declare the fixture dead
+        "num_heartbeats_timeout": 2400})
+    tmp = tempfile.mkdtemp(prefix="rtpu_allreduce_")
+
+    def _spawn_loop(name):
+        loop = asyncio.new_event_loop()
+        thr = threading.Thread(target=loop.run_forever, daemon=True,
+                               name=name)
+        thr.start()
+        return loop, thr
+
+    def on(loop, coro, timeout=600):
+        return asyncio.run_coroutine_threadsafe(coro, loop) \
+            .result(timeout)
+
+    gcs_loop, gcs_thr = _spawn_loop("bench-gcs")
+    gcs = GcsServer(cfg)
+    gcs_addr = on(gcs_loop, gcs.start("tcp://127.0.0.1:0"))
+
+    # owner-location stubs for the fold leg's redistribution pulls
+    from ray_tpu._private import rpc as rpc_mod
+    holders: dict = {}
+
+    async def _locs(conn, header, bufs):
+        return {"locations": [holders[header["object_id"]]]}
+
+    async def _add(conn, header, bufs):
+        return {"ok": True}
+
+    owner = rpc_mod.RpcServer(
+        {"GetObjectLocations": _locs, "AddObjectLocation": _add},
+        name="owner")
+    owner_addr = on(gcs_loop, owner.listen("tcp://127.0.0.1:0"))
+    raylets, loops, threads = [], [], []
+
+    async def _boot(i):
+        r = Raylet(cfg, 1, session_dir=tmp, node_name=f"n{i}")
+        await r.start(gcs_addr)
+        return r
+
+    for i in range(nranks):
+        loop, thr = _spawn_loop(f"bench-raylet-{i}")
+        raylets.append(on(loop, _boot(i)))
+        loops.append(loop)
+        threads.append(thr)
+    ctx = SerializationContext()
+
+    def _seed_partials():
+        """One full-size partial per raylet; rank-ordered
+        (oid, data_offset, nbytes)."""
+        infos = []
+        for rank in range(nranks):
+            part = np.ones(shape, dtype=np.float64) * (rank + 1)
+            ser = ctx.serialize(part)
+            plan = plan_segment(ser)
+            name, size = write_segment(ser, plan=plan)
+            oid = ObjectID.from_random()
+
+            async def _seal(_r=raylets[rank], _o=oid, _n=name, _s=size):
+                assert _r.store.seal(_o, _n, _s)
+                _r.store.mark_exposed(_o)
+
+            on(loops[rank], _seal())
+            infos.append((oid, plan[2][1], plan[1][1].nbytes))
+            del part, ser, plan
+        return infos
+
+    # the zeros template every member lays its accumulator out from
+    template = np.zeros(shape, dtype=np.float64)
+    t_ser = ctx.serialize(template)
+    _h, t_raw, t_offsets, t_total = plan_segment(t_ser)
+    data_nbytes = t_raw[1].nbytes
+    meta, payload = t_ser.metadata, bytes(t_raw[0])
+    del template
+
+    def _park_warm(ranks):
+        """Fault in and park one accumulator-size segment in each
+        listed rank's recycle pool (untimed). Collective result
+        segments are exposed, so free() unlinks them — every rep
+        would otherwise re-pay the kernel's fresh-page cost for its
+        accumulator, which on a lazily-backed VM dwarfs the transfer
+        being measured. Parking puts BOTH legs in the store's designed
+        steady state (AllocSegment leases over warm pages), so the
+        timed region compares the algorithms' data movement, not the
+        box's first-touch fault rate. Symmetric: ring ranks and the
+        fold sink warm the same way."""
+        async def _park(_r):
+            lp = asyncio.get_running_loop()
+            name, owner, buf = await lp.run_in_executor(
+                None, acquire_segment, None, t_total)
+            _close_segment_owner(owner, buf)
+            _r.store._park_segment(name, t_total)
+
+        _round([(rank, _park(raylets[rank])) for rank in ranks])
+
+    def _round(calls):
+        """One barriered round: every (rank, coro) lands on its own
+        raylet's loop CONCURRENTLY, then the barrier joins them —
+        byte-for-byte the driver engine's asyncio.gather, with actual
+        per-node parallelism."""
+        futs = [asyncio.run_coroutine_threadsafe(coro, loops[rank])
+                for rank, coro in calls]
+        return [f.result(600) for f in futs]
+
+    def _ring_once(infos):
+        """One full ring all_reduce, driven exactly like the driver
+        engine: concurrent RingInit, 2*(P-1) barriered RingStep
+        rounds, concurrent RingFinish."""
+        segments = da.ring_segments(data_nbytes, 8, nranks)
+        schedules = [da.ring_reduce_schedule(r, nranks)
+                     for r in range(nranks)]
+        oid = ObjectID.from_random()
+        members = [{"mid": ObjectID.from_random().binary(),
+                    "addr": raylets[r].data_address}
+                   for r in range(nranks)]
+        t0 = time.perf_counter()
+        inits = _round([
+            (rank, raylets[rank].handle_ring_init(None, {
+                "collective_id": oid.binary(),
+                "member_id": m["mid"], "rank": rank,
+                "nranks": nranks, "object_id": oid.binary(),
+                "meta": meta, "payload": payload,
+                "data_nbytes": data_nbytes,
+                "source": {
+                    "oid": infos[rank][0].binary(),
+                    "node_id": raylets[rank].node_id.binary(),
+                    "data_offset": infos[rank][1],
+                    "runs": [[0, 0, data_nbytes]]},
+                "dtype": "float64", "op": "sum"}, None))
+            for rank, m in enumerate(members)])
+        assert all(r.get("ok") for r in inits), inits
+        for step in range(2 * (nranks - 1)):
+            replies = _round([
+                (rank, raylets[rank].handle_ring_step(None, {
+                    "member_id": m["mid"],
+                    "peer_member_id":
+                        members[sch[step]["recv_peer"]]["mid"],
+                    "peer_data_address":
+                        members[sch[step]["recv_peer"]]["addr"],
+                    "seg_off": segments[sch[step]["seg"]][0],
+                    "seg_len": segments[sch[step]["seg"]][1],
+                    "reduce": bool(sch[step]["reduce"]),
+                    "step": step}, None))
+                for rank, (m, sch) in
+                enumerate(zip(members, schedules))])
+            assert all(r.get("ok") for r in replies), replies
+        fins = _round([
+            (rank, raylets[rank].handle_ring_finish(
+                None, {"member_id": m["mid"]}, None))
+            for rank, m in enumerate(members)])
+        assert all(r.get("ok") for r in fins), fins
+        dt = time.perf_counter() - t0
+
+        async def _free(_r, _o=oid):
+            _r.store.free(_o)
+
+        _round([(rank, _free(r)) for rank, r in enumerate(raylets)])
+        return dt, [f["wire_bytes"] for f in fins]
+
+    def _fold_once(infos):
+        """The fold path's movement for a FULL all_reduce: one
+        GatherShards sink on node 0 pulls every partial and reduces,
+        then ranks 1..P-1 pull the result from the sink so every node
+        holds it — the placement the ring leg ends with."""
+        oid = ObjectID.from_random()
+        sources = [{"oid": s_oid.binary(),
+                    "node_id": raylets[rank].node_id.binary(),
+                    "data_offset": s_off,
+                    "runs": [[0, 0, data_nbytes]]}
+                   for rank, (s_oid, s_off, _n) in enumerate(infos)]
+        t0 = time.perf_counter()
+        reply = on(loops[0], raylets[0].handle_gather_shards(None, {
+            "object_id": oid.binary(), "meta": meta,
+            "payload": payload, "data_nbytes": data_nbytes,
+            "sources": sources,
+            "reduce": {"op": "sum", "dtype": "float64"}}, None))
+        assert reply.get("ok"), reply
+        holders[oid.binary()] = raylets[0].node_id.binary()
+        pulls = _round([
+            (rank, raylets[rank]._ensure_local(oid, owner_addr))
+            for rank in range(1, nranks)])
+        assert all(r.get("ok") for r in pulls), pulls
+        dt = time.perf_counter() - t0
+
+        async def _free(_r, _o=oid):
+            _r.store.free(_o)
+
+        _round([(rank, _free(r)) for rank, r in enumerate(raylets)])
+        return dt
+
+    try:
+        infos = _seed_partials()
+        data_channel.reset_stats()
+        ring_runs = []
+        for _ in range(reps):
+            _park_warm(range(nranks))
+            ring_runs.append(_ring_once(infos))
+        copies = data_channel.pull_stats["intermediate_copies"]
+        ring_best = min(dt for dt, _ in ring_runs)
+        wire_bytes = max(max(w) for _, w in ring_runs)
+        fold_runs = []
+        for _ in range(max(1, reps - 1)):
+            _park_warm(range(nranks))
+            fold_runs.append(_fold_once(infos))
+        fold_best = min(fold_runs)
+        speedup = fold_best / ring_best
+        bound = 2 * (nranks - 1) * data_nbytes // nranks
+        return {
+            "array_gib": round(mb / 1024, 2),
+            "shape": list(shape),
+            "nodes": nranks,
+            "ring_s": round(ring_best, 2),
+            "ring_gb_per_s": round(
+                mb / 1024 / ring_best * 1.0737, 2),
+            "fold_s": round(fold_best, 2),
+            "speedup": round(speedup, 2),
+            "per_rank_wire_bytes": wire_bytes,
+            "wire_bound_bytes": bound,
+            "intermediate_copies": copies,
+            "gate": (">=2x vs fold+redistribute, "
+                     "wire <= 1.1 * 2(P-1)/P * N, "
+                     "0 intermediate copies"),
+            "gate_ok": (speedup >= 2.0
+                        and wire_bytes <= 1.1 * bound
+                        and copies == 0),
+        }
+    finally:
+        for rank, r in enumerate(raylets):
+            try:
+                on(loops[rank], r.stop(), timeout=30)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        on(gcs_loop, owner.close(), timeout=30)
+        on(gcs_loop, gcs.stop(), timeout=30)
+        for loop, thr in zip(loops + [gcs_loop],
+                             threads + [gcs_thr]):
+            loop.call_soon_threadsafe(loop.stop)
+            thr.join(5)
 
 
 TPU_CACHE_PATH = os.environ.get(

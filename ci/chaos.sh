@@ -10,7 +10,10 @@
 # tests/fixtures/rpc_schemas_v1.json against the current GCS through a
 # seeded gcs_restart — version negotiation recorded in node info), and
 # the gang_kill soak (SIGKILL an SPMD gang member mid-step: typed
-# failure, epoch-fenced reform, pool reclaim, zero leaked objects).
+# failure, epoch-fenced reform, pool reclaim, zero leaked objects),
+# and the ring_kill soak (abruptly kill a ring-collective peer
+# mid-all_reduce: exact fallback value or typed error, RingAbort
+# drains every survivor, gang fence intact, zero leaked segments/fds).
 # Runs the slow-marked schedules too (tier-1 carries only
 # the 2-schedule smoke); any invariant violation (pull hang, admission
 # budget leak, segment-lease leak, a leak-detector-flagged object
@@ -52,5 +55,6 @@ exec env RAY_TPU_LEASE_CREDITS_ENABLED=0 python -m pytest \
     tests/test_chaos.py::test_chaos_soak_oom_storm \
     tests/test_chaos.py::test_chaos_soak_credit_raylet_kill \
     tests/test_chaos.py::test_chaos_soak_gang_kill \
+    tests/test_chaos.py::test_chaos_soak_ring_kill \
     "tests/test_chaos.py::test_chaos_soak[raylet_kill]" \
     -q -p no:cacheprovider -m ''

@@ -1062,6 +1062,114 @@ fastpath_recv_into(PyObject *module, PyObject *const *argv,
     return PyLong_FromSsize_t(got);
 }
 
+/* reduce_into(dst, dst_off, src, dtype_code, op_code) -> elements folded
+ *
+ * The fused fold of the ring-collective data path (raylet RingStep and
+ * the GatherShards reduce leg): element-wise dst[i] = dst[i] OP src[i]
+ * over a scratch window, straight against the mapped destination
+ * segment, with the GIL RELEASED for the whole fold.  This is what the
+ * old np.frombuffer-inside-executor hop paid for on every fold: a view
+ * construction per call whose export pins the segment mapping
+ * (BufferError on close if anything leaks) plus a GIL-held dispatch.
+ * Here the fold overlaps the next window's socket receive for real.
+ *
+ * dtype_code: 0=f32 1=f64 2=i32 3=i64; op_code: 0=sum 1=min 2=max.
+ * All of src folds; src.len must be a whole number of elements and fit
+ * in dst at dst_off (overflow-safe subtraction-form bounds, checked
+ * before the GIL drops).  Misaligned element pointers raise
+ * BufferError — the callers' buffers (8-aligned shm data frames,
+ * malloc'd scratch) never are, and the Python wrapper's numpy fallback
+ * handles an exotic one without UB here. */
+
+#define RTPU_REDUCE_LOOP(T)                                             \
+    do {                                                                \
+        T *dp = (T *)dptr;                                              \
+        const T *sp = (const T *)sptr;                                  \
+        Py_ssize_t i;                                                   \
+        switch (op_code) {                                              \
+        case 0:                                                         \
+            for (i = 0; i < n; i++) dp[i] = dp[i] + sp[i];              \
+            break;                                                      \
+        case 1:                                                         \
+            for (i = 0; i < n; i++)                                     \
+                if (sp[i] < dp[i]) dp[i] = sp[i];                       \
+            break;                                                      \
+        default:                                                        \
+            for (i = 0; i < n; i++)                                     \
+                if (sp[i] > dp[i]) dp[i] = sp[i];                       \
+            break;                                                      \
+        }                                                               \
+    } while (0)
+
+static PyObject *
+fastpath_reduce_into(PyObject *module, PyObject *const *argv,
+                     Py_ssize_t nargs)
+{
+    if (nargs != 5) {
+        PyErr_SetString(
+            PyExc_TypeError,
+            "reduce_into(dst, dst_off, src, dtype_code, op_code)");
+        return NULL;
+    }
+    Py_ssize_t dst_off = PyLong_AsSsize_t(argv[1]);
+    if (dst_off == -1 && PyErr_Occurred())
+        return NULL;
+    long dtype_code = PyLong_AsLong(argv[3]);
+    if (dtype_code == -1 && PyErr_Occurred())
+        return NULL;
+    long op_code = PyLong_AsLong(argv[4]);
+    if (op_code == -1 && PyErr_Occurred())
+        return NULL;
+    if (dtype_code < 0 || dtype_code > 3 || op_code < 0 || op_code > 2) {
+        PyErr_SetString(PyExc_ValueError,
+                        "reduce_into: unknown dtype/op code");
+        return NULL;
+    }
+    Py_ssize_t esize = (dtype_code == 0 || dtype_code == 2) ? 4 : 8;
+
+    Py_buffer dst, src;
+    if (PyObject_GetBuffer(argv[0], &dst, PyBUF_WRITABLE) < 0)
+        return NULL;
+    if (PyObject_GetBuffer(argv[2], &src, PyBUF_SIMPLE) < 0) {
+        PyBuffer_Release(&dst);
+        return NULL;
+    }
+    if (dst_off < 0 || dst_off > dst.len ||
+        src.len % esize != 0 || src.len > dst.len - dst_off) {
+        PyBuffer_Release(&src);
+        PyBuffer_Release(&dst);
+        PyErr_SetString(PyExc_ValueError,
+                        "reduce_into: offset/length out of bounds");
+        return NULL;
+    }
+    char *dptr = (char *)dst.buf + dst_off;
+    const char *sptr = (const char *)src.buf;
+    if (((uintptr_t)dptr % (uintptr_t)esize) != 0 ||
+        ((uintptr_t)sptr % (uintptr_t)esize) != 0) {
+        /* typed-pointer loops below would be UB on misaligned bases:
+         * hand this buffer back to the Python wrapper's numpy tier */
+        PyBuffer_Release(&src);
+        PyBuffer_Release(&dst);
+        PyErr_SetString(PyExc_BufferError,
+                        "reduce_into: misaligned element pointer");
+        return NULL;
+    }
+    Py_ssize_t n = src.len / esize;
+    if (n > 0) {
+        Py_BEGIN_ALLOW_THREADS
+        switch (dtype_code) {
+        case 0: RTPU_REDUCE_LOOP(float); break;
+        case 1: RTPU_REDUCE_LOOP(double); break;
+        case 2: RTPU_REDUCE_LOOP(int32_t); break;
+        default: RTPU_REDUCE_LOOP(int64_t); break;
+        }
+        Py_END_ALLOW_THREADS
+    }
+    PyBuffer_Release(&src);
+    PyBuffer_Release(&dst);
+    return PyLong_FromSsize_t(n);
+}
+
 static PyMethodDef FastCtx_methods[] = {
     {"submit", (PyCFunction)(void (*)(void))FastCtx_submit,
      METH_FASTCALL, "fused template-task submission"},
@@ -1101,6 +1209,10 @@ static PyMethodDef fastpath_functions[] = {
      METH_FASTCALL,
      "GIL-releasing recv(2) straight into a writable buffer at an "
      "offset; -1 = EAGAIN, 0 = EOF"},
+    {"reduce_into", (PyCFunction)(void (*)(void))fastpath_reduce_into,
+     METH_FASTCALL,
+     "GIL-releasing element-wise fold dst[i] = dst[i] OP src[i] "
+     "(f32/f64/i32/i64, sum/min/max)"},
     {NULL, NULL, 0, NULL},
 };
 

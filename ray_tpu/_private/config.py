@@ -165,6 +165,28 @@ class RayTpuConfig:
     # object_manager_chunk_size floor, data_plane_max_chunk_size
     # ceiling, ~8 chunks per stripe lane.
     reshard_chunk_bytes: int = 0
+    # Which algorithm all_reduce / all_gather use when every precondition
+    # holds: "ring" (the default — bandwidth-optimal reduce-scatter +
+    # all-gather, per-rank wire traffic 2*(P-1)/P*N bytes) or "fold"
+    # (the PR15 single-destination GatherShards path, (P-1)*N per
+    # destination). Ring silently falls back to fold when it cannot
+    # apply: fewer than 3 ranks, data plane off
+    # (data_plane_stripes=0), or a source layout whose segments the
+    # ring math cannot partition (see the README fallback matrix).
+    collective_algorithm: str = "ring"
+    # Per-member scratch WINDOW size for the pipelined ring fold: each
+    # reduce step double-buffers two windows of this size so segment
+    # bytes for window k+1 stream off the wire while window k folds in
+    # an executor thread. Bigger windows amortize per-window overhead;
+    # smaller ones overlap sooner and cap the fold's cache footprint.
+    # Segments smaller than the window use one exact-size buffer pair.
+    collective_scratch_bytes: int = 16 * 1024 * 1024
+    # How long a ring-collective member record (and its leased
+    # accumulator segment) may sit idle before the raylet's
+    # opportunistic sweep discards it. Members are normally freed by
+    # RingFinish/RingAbort; the TTL only catches a driver that died
+    # between rounds without aborting.
+    collective_member_ttl_s: float = 120.0
 
     # --- worker pool ---
     # Hard cap on workers started per node (0 = num_cpus).

@@ -498,6 +498,10 @@ class CoreWorker:
         self._server = rpc.RpcServer(self._owner_handlers(), name=f"cw-{mode}")
         self.address = ""
         self._owner_conns: Dict[str, rpc.Connection] = {}
+        # Cached control-plane connections to REMOTE raylets hosting ring
+        # collective members (the local raylet rides raylet_conn). Keyed
+        # by raylet address; closed with the owner connections.
+        self._ring_conns: Dict[str, rpc.Connection] = {}
         self._attached: Dict[ObjectID, AttachedObject] = {}
         self._attached_lock = threading.Lock()
         self.function_manager = FunctionManager(self._kv_put_sync, self._kv_get_sync)
@@ -679,6 +683,8 @@ class CoreWorker:
                                  exc_info=True)
         await self._server.close()
         for conn in list(self._owner_conns.values()):
+            await conn.close()
+        for conn in list(self._ring_conns.values()):
             await conn.close()
         if self.gcs_conn:
             await self.gcs_conn.close()
@@ -1683,6 +1689,221 @@ class CoreWorker:
                         call_site="reshard")
         return ref, reply["node_id"], offsets[1], raw_frames[1].nbytes
 
+    # ------------------------------------------------------ ring collectives
+    #
+    # Driver-orchestrated ring engine. The driver never moves array
+    # bytes: it mints one member identity per rank, asks each shard's
+    # raylet to stage a full-size accumulator (RingInit), then issues
+    # one RingStep RPC per (rank, step) — P concurrent calls per round
+    # with a barrier between rounds, so a rank only ever pulls a
+    # segment its upstream peer finished in the previous round. Bulk
+    # bytes move peer-to-peer over the striped data plane; per-rank
+    # wire traffic is 2*(P-1)/P * N for all_reduce (the bandwidth
+    # optimum) vs (P-1)*N for the fold path's single sink.
+
+    def _ring_applicable(self, darr) -> bool:
+        """Ring engages only when configured, with enough ranks for
+        the ring to beat the fold sink (P >= 3), and with a data plane
+        to carry the peer-to-peer segment traffic."""
+        return (self.config.collective_algorithm == "ring"
+                and darr.mesh.nranks >= 3
+                and self.config.data_plane_stripes > 0)
+
+    async def _collective_raylet_conn(self, node_id: bytes):
+        """Control-plane connection to the raylet hosting one ring
+        member (the local raylet for local shards; cached dials for
+        remote peers)."""
+        if not node_id or node_id == self.node_id:
+            return self.raylet_conn
+        addr = await self._node_address_of(node_id)
+        if not addr:
+            raise exc.CollectiveError(
+                f"no raylet address for node {node_id.hex()[:12]}")
+        if addr == self.raylet_address:
+            return self.raylet_conn
+        conn = self._ring_conns.get(addr)
+        if conn is None or conn.closed:
+            conn = await rpc.connect(
+                addr, peer_name=f"ring-raylet@{addr}",
+                timeout=self.config.rpc_connect_timeout_s)
+            self._ring_conns[addr] = conn
+        return conn
+
+    async def _ring_abort(self, members, reason: str):
+        """Best-effort RingAbort fan-out: every member's raylet drops
+        its accumulator segment and serve entry. Idempotent on the
+        raylet side, so members that never finished RingInit are fine."""
+        async def _one(m):
+            try:
+                await m["conn"].call(
+                    "RingAbort",
+                    protocol.RingAbortRequest(
+                        member_id=m["mid"],
+                        reason=reason[:200]).to_header(),
+                    timeout=5)
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                pass
+        await asyncio.gather(*(_one(m) for m in members),
+                             return_exceptions=True)
+
+    async def _ring_collective(self, darr, segments, schedules, sources,
+                               op, attrs: dict, call_site: str):
+        """Run one ring collective to completion and return the result
+        ObjectRef. ``segments`` is the [(seg_off, seg_len)] tiling of
+        the result's data frame, ``schedules[rank]`` the per-rank step
+        list from distributed_array.ring_*_schedule, ``sources[rank]``
+        the GatherShards-style source dict each member seeds its
+        accumulator from. Raises CollectiveError after aborting every
+        member on any round failure."""
+        import numpy as np
+
+        from ray_tpu._private import faultpoints
+
+        nranks = darr.mesh.nranks
+        dtype = np.dtype(darr.dtype_str)
+        # identical zeros template on every rank: all members share one
+        # frame layout, so a peer's absolute segment offset equals our
+        # own data_off + seg_off (the pull model depends on this)
+        template = np.zeros(darr.shape, dtype=dtype)
+        serialized = self.serialization_context.serialize(template)
+        _hdr, raw_frames, offsets, total = plan_segment(serialized)
+        if len(raw_frames) != 2:
+            raise exc.CollectiveError(
+                "template does not serialize to the 2-frame ndarray "
+                "wire shape")
+        data_nbytes = raw_frames[1].nbytes
+        oid = self._next_put_id()
+        members = []
+        try:
+            for rank in range(nranks):
+                conn = await self._collective_raylet_conn(
+                    darr.shards[rank].node_id)
+                # member ids ride the put-id minter: 28 bytes, globally
+                # unique, disjoint from any sealed object's id
+                members.append({"mid": self._next_put_id().binary(),
+                                "conn": conn, "data_address": ""})
+        except ConnectionError as e:
+            raise exc.CollectiveError(
+                f"ring peer raylet unreachable: {e}") from e
+        meta = serialized.metadata
+        payload = bytes(raw_frames[0])
+        try:
+            inits = await asyncio.gather(*(
+                m["conn"].call(
+                    "RingInit",
+                    protocol.RingInitRequest(
+                        collective_id=oid.binary(),
+                        member_id=m["mid"], rank=rank, nranks=nranks,
+                        object_id=oid.binary(), meta=meta,
+                        payload=payload, data_nbytes=data_nbytes,
+                        source=sources[rank], dtype=darr.dtype_str,
+                        op=op, owner_address=self.address,
+                        shard=attrs).to_header())
+                for rank, m in enumerate(members)),
+                return_exceptions=True)
+            for m, rep in zip(members, inits):
+                if isinstance(rep, BaseException):
+                    raise rep
+                reply, _ = rep
+                if not reply.get("ok"):
+                    raise exc.CollectiveError(
+                        f"RingInit failed: {reply.get('reason')}")
+                m["data_address"] = reply.get("data_address") or ""
+                if not m["data_address"]:
+                    raise exc.CollectiveError(
+                        "ring peer runs without a data plane")
+            nsteps = len(schedules[0])
+            for step in range(nsteps):
+                if faultpoints.armed:
+                    await faultpoints.async_fire(
+                        "collective.ring_step", step=step,
+                        nsteps=nsteps, collective=oid.hex())
+                calls = []
+                for rank, m in enumerate(members):
+                    st = schedules[rank][step]
+                    seg_off, seg_len = segments[st["seg"]]
+                    peer = members[st["recv_peer"]]
+                    calls.append(m["conn"].call(
+                        "RingStep",
+                        protocol.RingStepRequest(
+                            member_id=m["mid"],
+                            peer_member_id=peer["mid"],
+                            peer_data_address=peer["data_address"],
+                            seg_off=seg_off, seg_len=seg_len,
+                            reduce=bool(st["reduce"]),
+                            step=step).to_header()))
+                replies = await asyncio.gather(*calls,
+                                               return_exceptions=True)
+                for rep in replies:
+                    if isinstance(rep, BaseException):
+                        raise rep
+                    reply, _ = rep
+                    if not reply.get("ok"):
+                        raise exc.CollectiveError(
+                            f"ring step {step} failed: "
+                            f"{reply.get('reason')}")
+            fins = await asyncio.gather(*(
+                m["conn"].call(
+                    "RingFinish",
+                    protocol.RingFinishRequest(
+                        member_id=m["mid"]).to_header())
+                for m in members), return_exceptions=True)
+            node_ids = []
+            for rep in fins:
+                if isinstance(rep, BaseException):
+                    raise rep
+                reply, _ = rep
+                if not reply.get("ok"):
+                    raise exc.CollectiveError(
+                        f"RingFinish failed: {reply.get('reason')}")
+                node_ids.append(reply["node_id"])
+        except BaseException as e:
+            # abort EVERY member (not just survivors): RingAbort is
+            # idempotent and this is the only thing standing between a
+            # failed round and P leaked full-size segments
+            await self._ring_abort(members, str(e) or type(e).__name__)
+            if isinstance(e, (exc.CollectiveError,
+                              asyncio.CancelledError)):
+                raise
+            raise exc.CollectiveError(
+                f"ring collective {oid.hex()[:16]} failed: {e!r}") from e
+        self.reference_counter.add_owned_object(oid)
+        for nid in set(node_ids):
+            self.reference_counter.add_location(oid, nid, total)
+        self.memory_store.put(oid, IN_PLASMA)
+        return ObjectRef(oid, owner_address=self.address, worker=self,
+                         call_site=call_site)
+
+    def _ring_gather_layout(self, darr, contribs, data_nbytes: int):
+        """(segments, sources) for a ring all-gather, or None when the
+        source layout is not a rank-ordered contiguous tiling of the
+        destination (rank r's ring segment must be exactly its own
+        shard's bytes, laid out in rank order — true for every 1-D
+        sharding and for row-major leading-axis shardings; anything
+        else takes the fold path)."""
+        if len(contribs) != darr.mesh.nranks:
+            return None
+        segments, sources = [], []
+        expect = 0
+        for idx, (src_rank, runs) in enumerate(contribs):
+            if src_rank != idx or len(runs) != 1:
+                return None
+            s_off, d_off, length = runs[0]
+            if (s_off != 0 or d_off != expect
+                    or length != darr.shards[src_rank].nbytes):
+                return None
+            segments.append((d_off, length))
+            sources.append({
+                "oid": darr.shards[src_rank].ref.object_id.binary(),
+                "node_id": darr.shards[src_rank].node_id,
+                "data_offset": darr.shards[src_rank].data_offset,
+                "runs": [[0, d_off, length]],
+            })
+            expect += length
+        if expect != data_nbytes:
+            return None
+        return segments, sources
+
     def all_gather(self, darr) -> ObjectRef:
         """Materialize the FULL array as one new object via a single
         GatherShards collective (striped data plane); returns its ref.
@@ -1698,6 +1919,23 @@ class CoreWorker:
         mesh1 = da.Mesh((1,), ("gather",))
         plan = da.gather_plan(darr.shape, dtype.itemsize, darr.mesh,
                               darr.spec, mesh1, da.PartitionSpec())
+        if self._ring_applicable(darr):
+            nbytes = (int(np.prod(darr.shape, dtype=np.int64))
+                      * dtype.itemsize)
+            layout = self._ring_gather_layout(darr, plan[0], nbytes)
+            if layout is not None:
+                segments, ring_sources = layout
+                schedules = [
+                    da.ring_gather_schedule(r, darr.mesh.nranks)
+                    for r in range(darr.mesh.nranks)]
+                try:
+                    return await self._ring_collective(
+                        darr, segments, schedules, ring_sources, None,
+                        {"gather": True, "ring": True}, "all_gather")
+                except exc.CollectiveError as e:
+                    logger.warning(
+                        "ring all_gather failed (%s); falling back to "
+                        "the fold path", e)
         sources = [{
             "oid": darr.shards[src_rank].ref.object_id.binary(),
             "node_id": darr.shards[src_rank].node_id,
@@ -1729,7 +1967,18 @@ class CoreWorker:
 
         from ray_tpu._private import distributed_array as da
 
+        # typed rejection BEFORE any bytes move: both fold tiers and the
+        # native kernel only know these ops, and reducing non-numeric
+        # dtypes (strings, objects) is meaningless on raw frames
+        if op not in ("sum", "min", "max"):
+            raise ValueError(
+                f"all_reduce op must be 'sum', 'min' or 'max', got "
+                f"{op!r}")
         dtype = np.dtype(darr.dtype_str)
+        if dtype.kind not in "fiu":
+            raise TypeError(
+                "all_reduce supports float/int/uint dtypes only, got "
+                f"{darr.dtype_str}")
         nbytes = int(np.prod(darr.shape, dtype=np.int64)) * dtype.itemsize
         for s in darr.shards:
             if tuple(s.shape) != tuple(darr.shape):
@@ -1742,6 +1991,19 @@ class CoreWorker:
             "data_offset": s.data_offset,
             "runs": [[0, 0, nbytes]],
         } for s in darr.shards]
+        if self._ring_applicable(darr):
+            segments = da.ring_segments(nbytes, dtype.itemsize,
+                                        darr.mesh.nranks)
+            schedules = [da.ring_reduce_schedule(r, darr.mesh.nranks)
+                         for r in range(darr.mesh.nranks)]
+            try:
+                return await self._ring_collective(
+                    darr, segments, schedules, sources, op,
+                    {"reduce": op, "ring": True}, "all_reduce")
+            except exc.CollectiveError as e:
+                logger.warning(
+                    "ring all_reduce failed (%s); falling back to the "
+                    "fold path", e)
         info = await self._gather_shard(
             darr.shape, dtype, {"reduce": op}, sources,
             reduce_spec={"op": op, "dtype": darr.dtype_str})
@@ -1749,8 +2011,10 @@ class CoreWorker:
             return info[0]
         vals = [await self._get_one(s.ref, None) for s in darr.shards]
         out = vals[0].copy()
+        ufunc = {"sum": np.add, "min": np.minimum,
+                 "max": np.maximum}[op]
         for v in vals[1:]:
-            np.add(out, v, out)
+            ufunc(out, v, out)
         oid = self._next_put_id()
         await self._put_serialized(
             oid, self.serialization_context.serialize(out))

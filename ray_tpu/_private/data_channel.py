@@ -282,6 +282,16 @@ class DataPlaneServer:
         self._accept_task: Optional[asyncio.Task] = None
         self._conn_tasks: "set[asyncio.Task]" = set()
         self._sources: Dict[str, _Source] = {}
+        # Serve-side table for UNSEALED segments: ring-collective
+        # accumulators must be readable by ring peers mid-collective,
+        # before (and without) a store seal. Key = the 28-byte ring
+        # member id (same width as an ObjectID, disjoint key space —
+        # driver-minted per collective x rank), value = (segment_name,
+        # total_size). Entries are registered by RingInit and dropped
+        # by RingFinish/RingAbort; the segment is store-LEASED for the
+        # whole window, so it can never be recycled under a reader and
+        # needs no mark_exposed pin.
+        self.extra_entries: Dict[bytes, tuple] = {}
         self._closing = False
         # per-instance counter (module serve_stats aggregates every
         # server in the process; tests with several in-process raylets
@@ -385,9 +395,20 @@ class DataPlaneServer:
             await loop.sock_sendall(sock,
                                     _pack_frame([STATUS_NOT_FOUND, 0]))
             return
-        entry = self.store.entry(ObjectID(oid_b))
+        entry = self.extra_entries.get(oid_b)
+        if entry is None:
+            entry = self.store.entry(ObjectID(oid_b))
+            if entry is not None:
+                # a remote raylet is mid-pull: its future chunk reads
+                # must see this exact data — the segment must never
+                # enter the recycle pool while the transfer is in
+                # flight (same pin as the control-plane
+                # FetchObjectChunk serve path). Side-table entries
+                # (ring accumulators) skip this: they are store-LEASED,
+                # which already bars recycling.
+                self.store.mark_exposed(ObjectID(oid_b))
         if entry is None or offset < 0 or length < 0 \
-                or (entry is not None and offset > entry[1]):
+                or offset > entry[1]:
             # invalid range = hostile/corrupt peer: a negative offset
             # would inflate ``count`` past the real payload and either
             # hang the client stripe (short mapped slice) or EINVAL the
@@ -396,11 +417,6 @@ class DataPlaneServer:
                                     _pack_frame([STATUS_NOT_FOUND, 0]))
             return
         name, total = entry
-        # a remote raylet is mid-pull: its future chunk reads must see
-        # this exact data — the segment must never enter the recycle
-        # pool while the transfer is in flight (same pin as the
-        # control-plane FetchObjectChunk serve path).
-        self.store.mark_exposed(ObjectID(oid_b))
         end = min(offset + max(0, length), total)
         count = max(0, end - offset)
         if fault == "short" and count > 1:

@@ -45,6 +45,7 @@ __all__ = [
     "Mesh", "PartitionSpec", "ShardInfo", "DistributedArray",
     "shard_slices", "shard_shape", "byte_runs", "gather_plan",
     "frame_plan", "balanced_split",
+    "ring_segments", "ring_reduce_schedule", "ring_gather_schedule",
 ]
 
 
@@ -293,6 +294,86 @@ def frame_plan(metadata: bytes, frame_lens: Sequence[int]):
         offsets.append(total)
         total = _align8(total + n)
     return header, offsets, total
+
+
+# --------------------------------------------------------------------------
+# Ring collective plan math (pure, shared by driver and raylet).
+#
+# A ring all-reduce over P ranks partitions every rank's data frame into
+# P element-aligned segments and runs two phases of P-1 steps each
+# around the rank cycle r -> (r+1) % P:
+#
+#   reduce-scatter step s: rank r pulls segment (r-s-1) mod P from rank
+#     r-1 and FOLDS it into its own accumulator — after P-1 steps rank r
+#     owns the fully-reduced segment (r+1) mod P;
+#   all-gather step s: rank r pulls the finished segment (r-s) mod P
+#     from rank r-1 (pure copy).
+#
+# Every rank moves each segment at most twice, so per-rank wire traffic
+# is 2*(P-1)/P * N bytes — the bandwidth-optimal bound — versus the fold
+# path's (P-1)*N. Both wire ends derive the identical plan from (rank,
+# nranks) alone: the sender never needs to be told what the receiver
+# will ask for, and the receiving raylet never re-derives slice math —
+# the RPCs carry absolute (segment offset, length) byte runs computed
+# from these functions.
+# --------------------------------------------------------------------------
+
+
+def ring_segments(nbytes: int, itemsize: int,
+                  nranks: int) -> List[Tuple[int, int]]:
+    """Partition a ``nbytes`` data frame into ``nranks`` contiguous
+    element-aligned ``(offset, length)`` segments — ``balanced_split``
+    over the ELEMENT count scaled back to bytes, so a fold never
+    straddles an element boundary. Segments tile ``[0, nbytes)``
+    exactly; trailing segments may be empty when P > element count."""
+    if nbytes % itemsize:
+        raise ValueError(
+            f"frame of {nbytes} bytes is not a whole number of "
+            f"{itemsize}-byte elements")
+    return [(a * itemsize, (b - a) * itemsize)
+            for a, b in balanced_split(nbytes // itemsize, nranks)]
+
+
+def ring_reduce_schedule(rank: int, nranks: int) -> List[dict]:
+    """The 2*(P-1)-step ring all-reduce schedule for ``rank``: each step
+    names the segment this rank PULLS this round, the peer it pulls
+    from, the peer that will pull from it (telemetry/symmetry — the
+    pull model never contacts it), and whether the inbound bytes fold
+    into the accumulator (reduce-scatter) or land verbatim
+    (all-gather). Steps are globally barriered by the driver: step s
+    reads only data its peer finished in step s-1."""
+    if nranks < 2:
+        raise ValueError("ring schedules need at least 2 ranks")
+    prev = (rank - 1) % nranks
+    nxt = (rank + 1) % nranks
+    steps = []
+    for s in range(nranks - 1):
+        steps.append({"step": s, "phase": "rs",
+                      "seg": (rank - s - 1) % nranks,
+                      "recv_peer": prev, "send_peer": nxt,
+                      "reduce": True})
+    for s in range(nranks - 1):
+        steps.append({"step": nranks - 1 + s, "phase": "ag",
+                      "seg": (rank - s) % nranks,
+                      "recv_peer": prev, "send_peer": nxt,
+                      "reduce": False})
+    return steps
+
+
+def ring_gather_schedule(rank: int, nranks: int) -> List[dict]:
+    """The (P-1)-step all-gather-only ring for ``rank``: rank r starts
+    owning segment r and pulls segment (r-s-1) mod P from rank r-1 at
+    step s — pure copies, no folds. Per-rank wire traffic is
+    (P-1)/P * N bytes."""
+    if nranks < 2:
+        raise ValueError("ring schedules need at least 2 ranks")
+    prev = (rank - 1) % nranks
+    nxt = (rank + 1) % nranks
+    return [{"step": s, "phase": "ag",
+             "seg": (rank - s - 1) % nranks,
+             "recv_peer": prev, "send_peer": nxt,
+             "reduce": False}
+            for s in range(nranks - 1)]
 
 
 class ShardInfo:

@@ -87,6 +87,10 @@ GENERATE = (
     "RequestWorkerLease",
     "ReturnWorker",
     "RevokeLeaseCredits",
+    "RingAbort",
+    "RingFinish",
+    "RingInit",
+    "RingStep",
     "WorkerOOMKilled",
 )
 

@@ -109,6 +109,16 @@ copy_stats = {"native": 0, "striped": 0, "fallback": 0}
 # copy count (each receive is exactly one kernel->buffer copy).
 recv_stats = {"native": 0, "fallback": 0}
 
+# Fold half of the ring-collective path (raylet RingStep / GatherShards
+# reduce leg): how many scratch-window folds ran through the
+# GIL-releasing C kernel vs the numpy fallback.
+reduce_stats = {"native": 0, "fallback": 0}
+
+# Wire codes of cpp/fastpath.c reduce_into. Other numeric dtypes are
+# legal — they just always take the numpy tier.
+_REDUCE_DTYPE_CODES = {"float32": 0, "float64": 1, "int32": 2, "int64": 3}
+_REDUCE_OP_CODES = {"sum": 0, "min": 1, "max": 2}
+
 
 def have_native_copy() -> bool:
     mod = load_fastpath()
@@ -214,6 +224,58 @@ def sock_recv_into(sock, dst, dst_off: int, nbytes: int) -> int:
         return -1
     recv_stats["fallback"] += 1
     return n
+
+
+def reduce_into(dst, dst_off: int, src, dtype, op: str = "sum") -> int:
+    """Fold ALL of ``src`` element-wise into ``dst`` at byte offset
+    ``dst_off`` (``dst[i] = dst[i] op src[i]``); returns the element
+    count folded. The fold seam of the ring collectives: the raylet's
+    RingStep executor fold and the GatherShards reduce leg both land
+    here, so one call covers native tier, tier accounting and the
+    numpy fallback.
+
+    Native tier: the GIL-releasing C loop in cpp/fastpath.c for
+    {f32, f64, i32, i64} x {sum, min, max} (already-loaded module only
+    — same no-build-on-hot-path discipline as :func:`copy_into`).
+    Fallback: ``np.frombuffer`` views created AND dropped inside this
+    call, so no array export outlives it to pin the destination
+    mapping (the BufferError footgun the native kernel exists to
+    kill). Out-of-bounds offsets/lengths raise ValueError from either
+    tier; unknown ops raise ValueError; dtypes outside the native set
+    silently take the numpy tier."""
+    op_code = _REDUCE_OP_CODES.get(op)
+    if op_code is None:
+        raise ValueError(f"unsupported reduce op: {op!r}")
+    dtype_str = str(dtype)
+    mod = loaded_fastpath()
+    dtype_code = _REDUCE_DTYPE_CODES.get(dtype_str)
+    if mod is not None and dtype_code is not None and \
+            hasattr(mod, "reduce_into"):
+        try:
+            n = mod.reduce_into(dst, dst_off, src, dtype_code, op_code)
+        except (BufferError, TypeError):
+            pass  # exotic/misaligned buffer: numpy tier below
+        else:
+            reduce_stats["native"] += 1
+            return n
+    import numpy as np
+    dt = np.dtype(dtype_str)
+    sv = _as_byte_view(src)
+    if sv.nbytes % dt.itemsize:
+        raise ValueError(
+            f"reduce_into: {sv.nbytes} source bytes is not a whole "
+            f"number of {dt.itemsize}-byte elements")
+    count = sv.nbytes // dt.itemsize
+    dv = _as_byte_view(dst)
+    if dst_off < 0 or sv.nbytes > dv.nbytes - dst_off:
+        raise ValueError("reduce_into: offset/length out of bounds")
+    d = np.frombuffer(dv, dtype=dt, count=count, offset=dst_off)
+    s = np.frombuffer(sv, dtype=dt, count=count)
+    ufunc = {"sum": np.add, "min": np.minimum, "max": np.maximum}[op]
+    ufunc(d, s, d)
+    del d, s, dv, sv
+    reduce_stats["fallback"] += 1
+    return count
 
 
 def _build_and_load():

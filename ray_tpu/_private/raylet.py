@@ -87,6 +87,11 @@ def _spmd_metrics() -> dict:
                 "ray_tpu_gang_leases_total",
                 "SPMD gang leases granted (one per all-or-nothing "
                 "N-worker booking round)"),
+            "collective_bytes": m.Counter(
+                "ray_tpu_collective_bytes_total",
+                "DistributedArray collective wire bytes this node "
+                "pulled, by algorithm (ring reduce-scatter/all-gather "
+                "steps vs the fold GatherShards path)"),
         }
     return _spmd_prom
 
@@ -304,6 +309,15 @@ class Raylet:
         self._gang_members: Dict[bytes, dict] = {}
         self.num_gang_leases = 0
         self.num_gang_rejects = 0
+        # Ring-collective members this raylet hosts: member_id (28-byte
+        # driver-minted id, one per collective x rank — several ranks
+        # of ONE collective may live here in single-node runs) ->
+        # accumulator record {segment mapping, layout, reduce spec,
+        # per-step wire/fold counters}. Members are created by RingInit,
+        # stepped by RingStep, and freed by RingFinish/RingAbort (or
+        # the TTL sweep when a driver died between rounds).
+        self._ring_members: Dict[bytes, dict] = {}
+        self.num_ring_collectives = 0
         # Schedule latency (request arrival -> decision dispatched), a
         # bounded reservoir for percentile reporting (reference: the
         # north-star p50/p99 schedule-latency metric, BASELINE.json).
@@ -346,6 +360,10 @@ class Raylet:
         self._leak_sweep_task: Optional[asyncio.Task] = None
         # per-pull throughput reservoir (GB/s), reported by GetNodeStats
         self._pull_rates: Any = _deque(maxlen=4096)
+        # bounded history of finished/aborted ring-collective members,
+        # surfaced by GetNodeStats' collectives block (telemetry for
+        # the bench's bandwidth assertion: wire bytes per rank)
+        self._recent_collectives: Any = _deque(maxlen=64)
         # Host-stats collection handles, cached once: importing psutil
         # and constructing a fresh Process() every heartbeat wasted
         # ~100us/beat, and cpu_percent(interval=None) on a fresh
@@ -381,6 +399,10 @@ class Raylet:
             "ReleaseGangMembers": self.handle_release_gang_members,
             "ReleaseGangLease": self.handle_release_gang_lease,
             "GatherShards": self.handle_gather_shards,
+            "RingInit": self.handle_ring_init,
+            "RingStep": self.handle_ring_step,
+            "RingFinish": self.handle_ring_finish,
+            "RingAbort": self.handle_ring_abort,
             "ScheduleActorCreation": self.handle_schedule_actor_creation,
             "KillActorWorker": self.handle_kill_actor_worker,
             "ActorExited": self.handle_actor_exited,
@@ -488,6 +510,12 @@ class Raylet:
         for ch in list(self._data_channels.values()):
             await ch.close()
         self._data_channels.clear()
+        # in-flight ring collectives die with the node: release their
+        # leased accumulator segments (the driver's step RPC fails and
+        # it aborts the surviving members on the other nodes)
+        for mid, mrec in list(self._ring_members.items()):
+            self._ring_members.pop(mid, None)
+            self._discard_ring_member(mid, mrec, reason="raylet stopped")
         if self.data_server is not None:
             await self.data_server.close()
         for att in self._serve_attachments.values():
@@ -3180,6 +3208,17 @@ class Raylet:
                     req.owner_address
             self.store.mark_exposed(oid)  # a sibling gather may read it
             _spmd_metrics()["reshard_bytes"].inc(moved)
+            if reduce_spec:
+                # the fold twin of the ring path's per-step counter:
+                # the two labels together make the bandwidth claim
+                # assertable from telemetry alone
+                _spmd_metrics()["collective_bytes"].inc(
+                    moved, {"algo": "fold"})
+                self._recent_collectives.append({
+                    "collective": oid.hex()[:12], "rank": 0,
+                    "algo": "fold", "op": reduce_spec.get("op", "sum"),
+                    "wire_bytes": moved, "steps": len(sources),
+                    "folds": max(0, len(sources) - 1), "ok": True})
             wall = time.monotonic() - t0
             if self.object_events.enabled:
                 self.object_events.record(
@@ -3324,29 +3363,31 @@ class Raylet:
     async def _gather_reduce(self, buf, data_off: int, data_nbytes: int,
                              chunk: int, sources: List[dict],
                              reduce_spec: dict) -> int:
-        """All-reduce destination build: the first source streams
-        straight into the destination data frame; each further source
-        streams into ONE reused scratch buffer and is folded in with a
-        vectorized executor-side ``np.add`` — peak extra memory is one
-        shard regardless of fan-in."""
+        """All-reduce destination build, fold algorithm: the first
+        source streams straight into the destination data frame; each
+        further source streams into ONE reused scratch buffer and is
+        folded in by the GIL-releasing ``native.reduce_into`` kernel in
+        an executor — peak extra memory is one shard regardless of
+        fan-in. The ring path (handle_ring_*) supersedes this for
+        P >= 3; this stays as the ``collective_algorithm="fold"`` /
+        2-rank / ring-failure fallback."""
         import numpy as np
 
+        from ray_tpu._private import native
+
         op = reduce_spec.get("op", "sum")
-        if op != "sum":
+        if op not in ("sum", "min", "max"):
             raise ValueError(f"unsupported reduce op: {op!r}")
         dtype = np.dtype(reduce_spec["dtype"])
         count = data_nbytes // dtype.itemsize
 
-        def _fold(scr):
-            # the frombuffer view EXPORTS buf's mapping, so it is
-            # created AND dropped inside this executor call — an array
-            # passed through (or returned from) run_in_executor lingers
-            # in the work-item/future plumbing and makes the caller's
-            # _close_segment_owner fail with BufferError
-            dest = np.frombuffer(buf, dtype=dtype, count=count,
-                                 offset=data_off)
-            np.add(dest, scr, dest)
-            del dest
+        def _fold(sbuf):
+            # reduce_into's buffer exports live only inside this
+            # executor call — an array view passed through (or returned
+            # from) run_in_executor lingers in the work-item/future
+            # plumbing and makes the caller's _close_segment_owner
+            # fail with BufferError
+            native.reduce_into(buf, data_off, sbuf, dtype, op)
 
         moved = await self._gather_runs(buf, data_off, chunk,
                                         sources[:1])
@@ -3356,8 +3397,348 @@ class Raylet:
             loop = asyncio.get_running_loop()
             for src in sources[1:]:
                 moved += await self._gather_runs(sbuf, 0, chunk, [src])
-                await loop.run_in_executor(None, _fold, scratch)
+                await loop.run_in_executor(None, _fold, sbuf)
         return moved
+
+    # ------------------------------------------------ ring collectives
+    #
+    # Bandwidth-optimal ring reduce-scatter + all-gather over the
+    # striped data plane (plan math: distributed_array.ring_segments /
+    # ring_reduce_schedule). The DRIVER orchestrates: one RingInit per
+    # member, then one RingStep RPC per (member, schedule step) with a
+    # barrier between rounds — so a step only ever reads peer segment
+    # bytes its peer finished in the previous round — then RingFinish
+    # seals every accumulator as the same result object. Per-rank wire
+    # traffic: 2*(P-1)/P * N bytes (vs the fold path's (P-1)*N).
+    #
+    # A member's accumulator segment is store-LEASED (never sealed)
+    # while the collective runs; ring peers read it mid-collective via
+    # the data server's extra_entries side table, keyed by the 28-byte
+    # member id. Admission: RingInit deliberately does NOT take the
+    # pull-admission budget for the whole accumulator — P members of
+    # one collective may share a node (single-driver runs), and the
+    # driver's round barrier would deadlock against a held budget;
+    # capacity is enforced at RingFinish's seal instead. Each RingStep
+    # admits only its own segment's bytes (steps within a round are
+    # mutually independent, so they serialize at worst, never
+    # deadlock).
+
+    def _discard_ring_member(self, member_id: bytes, rec: dict,
+                             reason: str = "") -> None:
+        """Release everything a ring member holds: the data-server
+        serve entry, the segment mapping, the store lease and the
+        /dev/shm file. Idempotent per member (callers pop the record
+        first)."""
+        from ray_tpu._private.shm_store import _close_segment_owner
+        if self.data_server is not None:
+            self.data_server.extra_entries.pop(member_id, None)
+        try:
+            _close_segment_owner(rec["owner"], rec["buf"])
+        except BufferError:
+            pass  # a straggling serve view closes with its unpin
+        self.store.release_lease(rec["name"])
+        self._unlink_segment(rec["name"])
+        if reason:
+            self._recent_collectives.append({
+                "collective": rec["collective_id"].hex()[:12],
+                "rank": rec["rank"], "algo": "ring", "op": rec["op"],
+                "wire_bytes": rec["wire_bytes"], "steps": rec["steps"],
+                "folds": rec["folds"], "ok": False, "reason": reason})
+
+    def _sweep_ring_members(self) -> None:
+        """Opportunistic TTL sweep (rides RingInit, no periodic task):
+        discard members whose driver stopped stepping them — a crashed
+        driver cannot send RingAbort, and a leaked lease would pin
+        store capacity forever."""
+        ttl = self.config.collective_member_ttl_s
+        if ttl <= 0 or not self._ring_members:
+            return
+        now = time.monotonic()
+        for mid, rec in list(self._ring_members.items()):
+            if now - rec["touched"] > ttl:
+                self._ring_members.pop(mid, None)
+                self._discard_ring_member(mid, rec, reason="ttl expired")
+
+    async def handle_ring_init(self, conn, header, bufs):
+        """Create one ring member: lease + lay out the accumulator
+        segment (same frame math as GatherShards), stream this rank's
+        OWN source shard into it, and publish it to ring peers through
+        the data server's side table. Replies with this node's data
+        address so the driver can point the member's neighbours at it."""
+        from ray_tpu._private.distributed_array import frame_plan
+        from ray_tpu._private.shm_store import (
+            RECYCLE_MIN_BYTES, _U32, _close_segment_owner, acquire_segment)
+
+        self._sweep_ring_members()
+        req = protocol.RingInitRequest.from_header(header)
+        member_id = req.member_id
+        rec = self._ring_members.get(member_id)
+        if rec is not None:  # idempotent retry: member already built
+            rec["touched"] = time.monotonic()
+            return {"ok": True, "data_address": self.data_address,
+                    "node_id": self.node_id.binary()}
+        meta = req.meta
+        payload = req.payload
+        data_nbytes = int(req.data_nbytes)
+        source = req.source
+        hdr, offsets, total = frame_plan(
+            meta, [len(payload), data_nbytes])
+        chunk = self.config.reshard_chunk_bytes or \
+            self._pull_chunk_size(data_nbytes, 1)
+        alloc = self.store.take_recycled(total) \
+            if total >= RECYCLE_MIN_BYTES else None
+        loop = asyncio.get_running_loop()
+        # shielded like the gather path: the mapping thread survives a
+        # cancel, so its result must be reaped, not dropped
+        fut = loop.run_in_executor(None, acquire_segment, alloc,
+                                   max(total, 1))
+        try:
+            name, owner, buf = await asyncio.shield(fut)
+        except asyncio.CancelledError:
+            fut.add_done_callback(self._segment_reaper(alloc))
+            raise
+
+        def _discard():
+            _close_segment_owner(owner, buf)
+            self.store.release_lease(name)
+            self._unlink_segment(name)
+
+        try:
+            buf[0:4] = _U32.pack(len(hdr))
+            buf[4:4 + len(hdr)] = hdr
+            buf[offsets[0]:offsets[0] + len(payload)] = payload
+            await self._gather_runs(buf, offsets[1], chunk, [source])
+        except asyncio.CancelledError:
+            _discard()
+            raise
+        except (ConnectionError, OSError, ValueError) as e:
+            _discard()
+            return {"ok": False, "reason": str(e)}
+        now = time.monotonic()
+        self._ring_members[member_id] = {
+            "collective_id": req.collective_id,
+            "rank": int(req.rank),
+            "nranks": int(req.nranks),
+            "name": name, "owner": owner, "buf": buf,
+            "total": total, "data_off": offsets[1],
+            "data_nbytes": data_nbytes,
+            "dtype": req.dtype, "op": req.get("op"),
+            "oid": req.object_id,
+            "owner_address": req.get("owner_address") or "",
+            "shard": req.get("shard"),
+            "chunk": chunk, "scratch": None,
+            "wire_bytes": 0, "steps": 0, "folds": 0,
+            "created": now, "touched": now,
+        }
+        if self.data_server is not None:
+            self.data_server.extra_entries[member_id] = (name, total)
+        return {"ok": True, "data_address": self.data_address,
+                "node_id": self.node_id.binary()}
+
+    async def handle_ring_step(self, conn, header, bufs):
+        """Execute ONE ring step for one member: pull the named segment
+        from the ring predecessor over the striped data plane and
+        either fold it into the accumulator (reduce-scatter phase,
+        pipelined through double-buffered scratch windows) or land it
+        verbatim in the destination frame (all-gather phase — chunks
+        recv_into the segment directly, zero intermediate copies).
+        Layouts are identical on every rank, so the peer's absolute
+        segment offsets equal this member's own."""
+        from collections import deque
+
+        from ray_tpu._private import data_channel
+
+        req = protocol.RingStepRequest.from_header(header)
+        rec = self._ring_members.get(req.member_id)
+        if rec is None:
+            return {"ok": False, "reason": "unknown ring member"}
+        rec["touched"] = time.monotonic()
+        seg_off = int(req.seg_off)
+        seg_len = int(req.seg_len)
+        step = int(req.get("step") or 0)
+        if seg_len <= 0:  # P > element count: empty segment, no wire
+            rec["steps"] += 1
+            return {"ok": True}
+        if seg_off < 0 or seg_off + seg_len > rec["data_nbytes"]:
+            return {"ok": False,
+                    "reason": f"ring segment out of bounds at step "
+                              f"{step}"}
+        peer_key = req.peer_member_id
+        peer_addr = req.peer_data_address
+        abs_off = rec["data_off"] + seg_off
+        chunk = min(rec["chunk"], seg_len)
+        await self._admit_pull(seg_len, chunk)
+        try:
+            try:
+                channel = await self._data_channel(peer_addr)
+                if req.get("reduce"):
+                    rec["folds"] += await self._ring_reduce_fold(
+                        rec, channel, peer_key, abs_off, seg_len, chunk)
+                else:
+                    buf = rec["buf"]
+                    work: deque = deque()
+                    off = 0
+                    while off < seg_len:
+                        n = min(chunk, seg_len - off)
+                        work.append((abs_off + off, n))
+                        off += n
+                    fetchers = []
+                    for stripe in channel.stripes:
+                        async def _fetch(item, _s=stripe, _ch=channel):
+                            o, n = item
+                            await _ch.fetch_chunk(_s, peer_key, o, n,
+                                                  buf, o)
+                        fetchers.append(_fetch)
+                    await data_channel.run_striped(work, fetchers)
+            except asyncio.CancelledError:
+                raise
+            except (ConnectionError, OSError, ValueError) as e:
+                # typed failure to the driver: it RingAborts every
+                # member and falls back (fold, then naive)
+                return {"ok": False, "reason": str(e)}
+            rec["wire_bytes"] += seg_len
+            rec["steps"] += 1
+            _spmd_metrics()["collective_bytes"].inc(
+                seg_len, {"algo": "ring"})
+            return {"ok": True}
+        finally:
+            self._pull_inflight_bytes -= seg_len
+            self._notify_pull_done()
+
+    async def _ring_reduce_fold(self, rec: dict, channel, peer_key: bytes,
+                                abs_off: int, seg_len: int,
+                                chunk: int) -> int:
+        """Pipelined recv+reduce for one reduce-scatter step: the
+        segment streams through two scratch windows so window k folds
+        (GIL-releasing ``native.reduce_into`` in an executor) while
+        window k+1 is on the wire. Window reuse is safe by
+        construction: the fetch into a window starts only after the
+        previous fold FROM that window was awaited. Returns the number
+        of window folds executed."""
+        from collections import deque
+
+        from ray_tpu._private import data_channel, native
+
+        win = min(max(self.config.collective_scratch_bytes, chunk),
+                  seg_len)
+        scratch = rec.get("scratch")
+        if scratch is None or len(scratch[0]) < win:
+            scratch = rec["scratch"] = [bytearray(win), bytearray(win)]
+        loop = asyncio.get_running_loop()
+        nwin = -(-seg_len // win)
+        dtype, op = rec["dtype"], rec["op"] or "sum"
+        buf = rec["buf"]
+
+        async def _fetch_window(w_idx: int, sbuf) -> int:
+            w_off = w_idx * win
+            w_len = min(win, seg_len - w_off)
+            work: deque = deque()
+            off = 0
+            while off < w_len:
+                n = min(chunk, w_len - off)
+                work.append((w_off + off, n))
+                off += n
+            fetchers = []
+            for stripe in channel.stripes:
+                async def _fetch(item, _s=stripe, _ch=channel,
+                                 _w=w_off):
+                    o, n = item
+                    await _ch.fetch_chunk(_s, peer_key, abs_off + o, n,
+                                          sbuf, o - _w)
+                fetchers.append(_fetch)
+            await data_channel.run_striped(work, fetchers)
+            return w_len
+
+        folds = 0
+        fold_fut: List[Any] = [None, None]
+        fetch_task = loop.create_task(_fetch_window(0, scratch[0]))
+        try:
+            for k in range(nwin):
+                w_len = await fetch_task
+                if k + 1 < nwin:
+                    nb = (k + 1) % 2
+                    if fold_fut[nb] is not None:
+                        # the window we are about to overwrite must be
+                        # done folding before new bytes land in it
+                        await fold_fut[nb]
+                        fold_fut[nb] = None
+                    fetch_task = loop.create_task(
+                        _fetch_window(k + 1, scratch[nb]))
+
+                def _fold(_sbuf=scratch[k % 2], _off=abs_off + k * win,
+                          _n=w_len):
+                    # views live only inside the executor call (the
+                    # same BufferError discipline as _gather_reduce)
+                    native.reduce_into(buf, _off,
+                                       memoryview(_sbuf)[:_n],
+                                       dtype, op)
+                fold_fut[k % 2] = loop.run_in_executor(None, _fold)
+                folds += 1
+            for f in fold_fut:
+                if f is not None:
+                    await f
+        except BaseException:
+            # cancel-and-AWAIT before unwinding: an orphan recv/fold
+            # must not land in buffers the abort path is about to
+            # close (run_striped already awaits its own workers)
+            fetch_task.cancel()
+            await asyncio.gather(
+                fetch_task, *(f for f in fold_fut if f is not None),
+                return_exceptions=True)
+            raise
+        return folds
+
+    async def handle_ring_finish(self, conn, header, bufs):
+        """Seal one member's accumulator as the collective's result
+        object and return its per-rank telemetry (wire bytes / steps /
+        folds — the bench's bandwidth bound asserts on these)."""
+        member_id = protocol.RingFinishRequest.from_header(header).member_id
+        rec = self._ring_members.pop(member_id, None)
+        if rec is None:
+            return {"ok": False, "reason": "unknown ring member"}
+        from ray_tpu._private.shm_store import _close_segment_owner
+        if self.data_server is not None:
+            self.data_server.extra_entries.pop(member_id, None)
+        oid = ObjectID(rec["oid"])
+        _close_segment_owner(rec["owner"], rec["buf"])
+        self.store.release_lease(rec["name"])
+        if not self.store.seal(oid, rec["name"], rec["total"],
+                               attrs=rec["shard"]):
+            self._unlink_segment(rec["name"])
+            return {"ok": False,
+                    "reason": "local store refused seal (capacity)"}
+        if rec["owner_address"]:
+            self._object_owners[oid.binary()] = rec["owner_address"]
+        self.store.mark_exposed(oid)  # ring peers/gathers may read it
+        self.num_ring_collectives += 1
+        self._recent_collectives.append({
+            "collective": rec["collective_id"].hex()[:12],
+            "rank": rec["rank"], "algo": "ring", "op": rec["op"],
+            "wire_bytes": rec["wire_bytes"], "steps": rec["steps"],
+            "folds": rec["folds"], "ok": True})
+        wall = time.monotonic() - rec["created"]
+        if self.object_events.enabled:
+            self.object_events.record(
+                oid.binary(), PULLED,
+                {"bytes": rec["wire_bytes"], "dur": wall,
+                 "node": self._nid12, "sources": rec["nranks"],
+                 "ring": True},
+                ts=time.time() - wall)
+        return {"ok": True, "node_id": self.node_id.binary(),
+                "wire_bytes": rec["wire_bytes"], "steps": rec["steps"],
+                "folds": rec["folds"]}
+
+    async def handle_ring_abort(self, conn, header, bufs):
+        """Tear one member down without sealing (driver-side failure
+        fan-out, or cleanup after a peer died mid-collective).
+        Idempotent: aborting an unknown/already-finished member is ok."""
+        req = protocol.RingAbortRequest.from_header(header)
+        rec = self._ring_members.pop(req.member_id, None)
+        if rec is not None:
+            self._discard_ring_member(
+                req.member_id, rec,
+                reason=req.get("reason") or "aborted")
+        return {"ok": True}
 
     @staticmethod
     def _unlink_segment(name: str):
@@ -3812,6 +4193,15 @@ class Raylet:
             # SPMD gang leases: incarnations homed here + member
             # bookings this node holds for gangs homed elsewhere
             "gangs": self._gang_stats(),
+            # ring collectives: members currently accumulating on this
+            # node + the bounded per-member finish/abort history (wire
+            # bytes, steps, folds — the bench asserts its 2*(P-1)/P*N
+            # bandwidth bound from these, not from timing)
+            "collectives": {
+                "active_members": len(self._ring_members),
+                "finished": self.num_ring_collectives,
+                "recent": list(self._recent_collectives),
+            },
             "store": self.store.stats(),
             # per-process writer mapping cache (zero-copy put tier;
             # meaningful where writers share this process, i.e. the

@@ -196,3 +196,14 @@ class GangBrokenError(RayTpuError):
     incarnations): in-flight step tasks fail with
     :class:`WorkerCrashedError`, and further ``run()`` calls raise this
     until ``reform()`` books a fresh incarnation at epoch+1."""
+
+
+class CollectiveError(RayTpuError):
+    """A DistributedArray ring collective failed mid-flight.
+
+    Raised by the driver-side ring engine when any rank's RingInit /
+    RingStep / RingFinish round fails (peer raylet death, data-plane
+    failure, store capacity): every surviving member was sent RingAbort
+    first, so no partial accumulator segment outlives this. The
+    collective verbs catch it and take the fold/naive fallback; it
+    surfaces to user code only when every fallback is exhausted."""

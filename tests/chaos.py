@@ -80,7 +80,7 @@ SCHEDULE_KINDS = (
     "stripe_sever", "corrupt_chunk", "short_read", "delay_storm",
     "raylet_kill", "heartbeat_partition", "gcs_restart", "mixed",
     "worker_kill", "oom_storm", "credit_revoke", "mixed_version",
-    "gang_kill",
+    "gang_kill", "ring_kill",
 )
 
 # Event vocabulary for the data-plane harness. Each entry generates a
@@ -113,7 +113,7 @@ def make_schedule(kind: str, seed: int, rounds: int = 8,
     still alive at run time)."""
     if kind not in _KIND_OPS and kind not in (
             "worker_kill", "oom_storm", "credit_revoke",
-            "mixed_version", "gang_kill"):
+            "mixed_version", "gang_kill", "ring_kill"):
         raise ValueError(f"unknown schedule kind {kind!r}")
     if kind == "worker_kill":
         # the worker-kill schedule is carried by the RAY_TPU_FAULTPOINTS
@@ -134,6 +134,10 @@ def make_schedule(kind: str, seed: int, rounds: int = 8,
     if kind == "gang_kill":
         # the SPMD-gang schedule draws its victim rank and kill step
         # inside run_gang_kill_schedule from the seed
+        return []
+    if kind == "ring_kill":
+        # the ring-collective schedule draws its victim rank and kill
+        # step inside run_ring_kill_schedule from the seed
         return []
     rng = random.Random(seed)
     events: List[dict] = []
@@ -1473,4 +1477,225 @@ def run_gang_kill_schedule(seed: int, steps: int = 4) -> dict:
     fd_after = _fd_count()
     assert fd_after <= fd_before + 8, \
         f"fd leak across the gang soak: {fd_before} -> {fd_after}"
+    return summary
+
+
+# ---------------------------------------------------------------------------
+# Ring-collective peer kill (ring engine + fallback chain under chaos)
+# ---------------------------------------------------------------------------
+
+
+def run_ring_kill_schedule(seed: int) -> dict:
+    """Kill one ring peer MID-COLLECTIVE and assert the chaos bar:
+
+    A replicated DistributedArray's members live on THREE in-process
+    raylets joined to a real head (driver orchestrates over real RPC +
+    data-plane TCP). A seeded plan picks a step round and a victim
+    raylet; a ``collective.ring_step`` hook fired by the driver engine
+    right before that round abruptly closes the victim's rpc AND data
+    servers — SIGKILL semantics: no member cleanup, no goodbyes.
+
+    Asserted end to end:
+
+    * ``all_reduce`` returns or raises TYPED within ``PULL_BOUND_S`` —
+      the ring fails mid-flight, the driver RingAborts every surviving
+      member, and the fold/naive fallback either lands the correct
+      value or surfaces a typed error (never a hang, never garbage);
+    * every SURVIVING raylet drains: zero active ring members (the
+      abort fan-out reached them), ``object_plane_stats()`` shows no
+      lent leases / inflight pull bytes / leaked objects;
+    * the failure is visible in telemetry: survivors' collectives
+      block records the aborted members with ``ok: False``;
+    * the SPMD gang formed on the head BEFORE the chaos keeps its
+      fence: not broken, same epoch, still runs steps;
+    * fd bracket holds across the whole soak (the victim is stopped at
+      teardown — operator-restart semantics — so its segments free).
+    """
+    import threading
+    import time as time_mod
+    from concurrent.futures import ThreadPoolExecutor
+
+    import ray_tpu
+    from ray_tpu import exceptions as exc_mod
+    from ray_tpu._private import distributed_array as da
+
+    fd_before = _fd_count()
+    rng = random.Random(seed)
+    kill_step = rng.randrange(1, 4)   # P=3 -> rounds 0..3; never 0
+    victim_rank = rng.randrange(3)
+    summary: Dict[str, Any] = {"kill_step": kill_step,
+                               "victim_rank": victim_rank}
+    ray_tpu.init(num_cpus=2, _system_config={
+        "num_prestart_workers": 0,
+        "pull_location_refresh_backoff_s": 0.05,
+        "retry_backoff_base_s": 0.02,
+        "retry_backoff_cap_s": 0.25,
+        "rpc_connect_timeout_s": 1.0,
+        "leak_sweep_interval_s": 0.3,
+    })
+    core = ray_tpu.worker.global_worker.core
+    extra_loop = asyncio.new_event_loop()
+    loop_thread = threading.Thread(target=extra_loop.run_forever,
+                                   daemon=True, name="ring-chaos-raylets")
+    loop_thread.start()
+    cfg = RayTpuConfig.create({
+        "num_prestart_workers": 0, "event_log_enabled": False,
+        "collective_member_ttl_s": 5.0})
+
+    async def _boot():
+        out = []
+        for i in range(3):
+            r = Raylet(cfg, 0, session_dir=core.session_dir,
+                       node_name=f"ring-chaos-{i}")
+            await r.start(core.gcs_address)
+            out.append(r)
+        return out
+
+    raylets = asyncio.run_coroutine_threadsafe(
+        _boot(), extra_loop).result(30)
+    try:
+        # warm the pool so gang formation grants in its first round
+        @ray_tpu.remote
+        def warm():
+            return 1
+
+        assert ray_tpu.get([warm.remote() for _ in range(2)],
+                           timeout=PULL_BOUND_S) == [1, 1]
+
+        # gang fence sentinel: formed BEFORE the chaos, on the head
+        gang = ray_tpu.create_gang(2)
+        epoch0 = gang.epoch
+
+        # seed one replicated partial per extra raylet (the ring's
+        # members), owned by the driver like any put_sharded shard
+        from ray_tpu._private.core_worker import IN_PLASMA
+        from ray_tpu._private.object_ref import ObjectRef
+        from ray_tpu._private.shm_store import plan_segment
+        part_rng = np.random.default_rng(seed)
+        parts = [part_rng.integers(-1000, 1000, size=(256, 1024))
+                 .astype(np.int64) for _ in range(3)]
+        shards = []
+        for rank, part in enumerate(parts):
+            ser = core.serialization_context.serialize(part)
+            _h, raw, offsets, total = plan_segment(ser)
+
+            def _seed(_ser=ser, _raylet=raylets[rank],
+                      _plan=(_h, raw, offsets, total)):
+                name, size = write_segment(_ser, plan=_plan)
+                oid = core._next_put_id()
+                assert _raylet.store.seal(oid, name, size)
+                return oid, size
+
+            oid, size = asyncio.run_coroutine_threadsafe(
+                asyncio.to_thread(_seed), extra_loop).result(30)
+            core.reference_counter.add_owned_object(oid)
+            core.reference_counter.add_location(
+                oid, raylets[rank].node_id.binary(), size)
+            core.memory_store.put(oid, IN_PLASMA)
+            shards.append(da.ShardInfo(
+                ref=ObjectRef(oid, owner_address=core.address,
+                              worker=core, call_site="ring-chaos"),
+                rank=rank, node_id=raylets[rank].node_id.binary(),
+                data_offset=offsets[1], nbytes=raw[1].nbytes,
+                shape=part.shape))
+        darr = da.DistributedArray(
+            ray_tpu.Mesh((3,), ("r",)), ray_tpu.PartitionSpec(),
+            parts[0].shape, "int64", shards)
+
+        victim = raylets[victim_rank]
+
+        async def _abrupt_stop():
+            # SIGKILL semantics: sockets drop, nothing is cleaned up
+            await victim._server.close()
+            if victim.data_server is not None:
+                await victim.data_server.close()
+
+        def _kill(**ctx):
+            summary["killed_at_step"] = ctx.get("step")
+            # block the driver loop until the victim is provably down
+            # (the victim lives on ANOTHER loop, so this cannot
+            # deadlock) -- the very next round must hit dead sockets
+            asyncio.run_coroutine_threadsafe(
+                _abrupt_stop(), extra_loop).result(10)
+
+        faultpoints.arm("collective.ring_step", "hook",
+                        nth=kill_step + 1, hook=_kill)
+
+        t0 = time_mod.time()
+        with ThreadPoolExecutor(max_workers=1) as pool:
+            fut = pool.submit(ray_tpu.all_reduce, darr)
+            try:
+                ref = fut.result(timeout=PULL_BOUND_S)
+                # fallback chain survived the kill: the value must be
+                # EXACT (fold/naive reached the shards another way)
+                val = ray_tpu.get(ref, timeout=PULL_BOUND_S)
+                assert np.array_equal(
+                    val, parts[0] + parts[1] + parts[2]), \
+                    "fallback produced a wrong all_reduce value"
+                summary["outcome"] = "fallback_value"
+            except exc_mod.RayTpuError as e:
+                # the victim held the only copy of its partial: a typed
+                # error is the honest outcome
+                summary["outcome"] = f"typed:{type(e).__name__}"
+        summary["wall_s"] = round(time_mod.time() - t0, 2)
+        assert faultpoints.fires("collective.ring_step") == 1, \
+            "the seeded kill hook never fired"
+        assert summary.get("killed_at_step") == kill_step
+
+        # every SURVIVOR drains: RingAbort reached it, nothing leaks
+        survivors = [r for i, r in enumerate(raylets)
+                     if i != victim_rank]
+        deadline = time_mod.time() + 10
+        for r in survivors:
+            while time_mod.time() < deadline:
+                ops = r.object_plane_stats()
+                if (not r._ring_members
+                        and ops["lent_segments"] == 0
+                        and ops["pull_inflight_bytes"] == 0
+                        and ops["leaked"] == 0):
+                    break
+                time_mod.sleep(0.1)
+            ops = r.object_plane_stats()
+            assert not r._ring_members, \
+                f"survivor kept ring members: {list(r._ring_members)}"
+            assert ops["lent_segments"] == 0, ops
+            assert ops["pull_inflight_bytes"] == 0, ops
+            assert ops["leaked"] == 0, ops
+            # the abort is VISIBLE: a failure record with ok False
+            aborted = [c for c in r._recent_collectives
+                       if not c.get("ok")]
+            assert aborted, "no aborted-member record on a survivor"
+        summary["survivors_drained"] = True
+
+        # gang fence intact: untouched by the collective's failure
+        assert not gang.broken and gang.epoch == epoch0
+
+        def fence_probe(rank):
+            return rank + 100
+
+        assert sorted(ray_tpu.get(gang.run(fence_probe),
+                                  timeout=PULL_BOUND_S)) == [100, 101]
+        gang.release()
+        summary["gang_fence_intact"] = True
+        del darr, shards
+    finally:
+        faultpoints.reset()
+
+        async def _stop_all():
+            for r in raylets:
+                try:
+                    await r.stop()  # victim: operator-restart cleanup
+                except Exception:
+                    pass
+
+        asyncio.run_coroutine_threadsafe(
+            _stop_all(), extra_loop).result(30)
+        extra_loop.call_soon_threadsafe(extra_loop.stop)
+        loop_thread.join(5)
+        ray_tpu.shutdown()
+
+    fd_after = _fd_count()
+    assert fd_after <= fd_before + 8, \
+        f"fd leak across the ring-kill soak: {fd_before} -> {fd_after}"
+    assert not _zombie_children(), "zombie children after ring chaos"
     return summary

@@ -18,7 +18,8 @@ from chaos import (
     make_schedule, run_credit_raylet_kill_schedule,
     run_credit_revoke_schedule, run_data_plane_schedule,
     run_gang_kill_schedule, run_mixed_version_schedule,
-    run_oom_storm_schedule, run_task_schedule, schedules_equal,
+    run_oom_storm_schedule, run_ring_kill_schedule, run_task_schedule,
+    schedules_equal,
 )
 
 # Pinned seeds: chosen once, frozen forever. Changing a seed is
@@ -37,6 +38,7 @@ SEEDS = {
     "credit_revoke": 2111,
     "mixed_version": 2212,
     "gang_kill": 2313,
+    "ring_kill": 2414,
 }
 
 
@@ -45,7 +47,7 @@ def test_schedule_generation_is_deterministic():
     different schedules (the RNG actually reaches the events)."""
     for kind, seed in SEEDS.items():
         if kind in ("worker_kill", "oom_storm", "credit_revoke",
-                    "mixed_version", "gang_kill"):
+                    "mixed_version", "gang_kill", "ring_kill"):
             continue
         a = make_schedule(kind, seed)
         b = make_schedule(kind, seed)
@@ -149,6 +151,20 @@ def test_chaos_soak_gang_kill():
     summary = run_gang_kill_schedule(SEEDS["gang_kill"])
     assert summary["ok_steps"] >= 1
     assert summary["reformed_epoch"] >= 2
+
+
+@pytest.mark.slow
+def test_chaos_soak_ring_kill():
+    """Ring-collective peer kill mid-collective (seeded victim rank +
+    step round): the in-flight all_reduce either completes EXACT via
+    the fold/naive fallback or raises typed, never hangs; RingAbort
+    drains every surviving member and the abort is visible in
+    telemetry; the gang fence formed before the chaos stays intact;
+    object-plane stats and fd/zombie brackets hold."""
+    summary = run_ring_kill_schedule(SEEDS["ring_kill"])
+    assert summary["survivors_drained"]
+    assert summary["gang_fence_intact"]
+    assert summary["killed_at_step"] == summary["kill_step"]
 
 
 @pytest.mark.slow

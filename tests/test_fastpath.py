@@ -315,3 +315,131 @@ def test_copy_engine_chunking_and_fallback():
         native._mod, native._tried = saved
     assert a == b
     assert bytes(a[9:]) == src.tobytes()
+
+
+def test_reduce_into_native_ops_dtypes_and_values():
+    """The fused GIL-releasing reduce kernel behind ring reduce-scatter
+    (ASAN/UBSAN hit this via ci/sanitize.sh): every native dtype x op
+    folds correctly at an unaligned-but-element-aligned destination
+    offset, and non-native dtypes take the numpy tier with identical
+    results."""
+    _require_native()
+    import numpy as np
+
+    from ray_tpu._private import native
+
+    rng = np.random.default_rng(23)
+    native_dtypes = [np.float32, np.float64, np.int32, np.int64]
+    fallback_dtypes = [np.int16, np.uint32]
+    ufuncs = {"sum": np.add, "min": np.minimum, "max": np.maximum}
+    for dt in native_dtypes + fallback_dtypes:
+        dtype = np.dtype(dt)
+        a = rng.integers(-1000, 1000, 257).astype(dtype)
+        b = rng.integers(-1000, 1000, 257).astype(dtype)
+        for op, ufunc in ufuncs.items():
+            off = 2 * dtype.itemsize  # element-aligned, non-zero
+            dst = bytearray(off + a.nbytes + 7)
+            dst[off:off + a.nbytes] = a.tobytes()
+            before = dict(native.reduce_stats)
+            n = native.reduce_into(dst, off, b.tobytes(),
+                                   dtype.name, op)
+            assert n == 257
+            got = np.frombuffer(dst, dtype=dtype, count=257, offset=off)
+            assert np.array_equal(got, ufunc(a, b)), (dtype.name, op)
+            tier = ("native" if dtype.name in
+                    native._REDUCE_DTYPE_CODES else "fallback")
+            assert native.reduce_stats[tier] == before[tier] + 1
+
+
+def test_reduce_into_bounds_ops_and_overlap():
+    """Bounds are rejected with ValueError BEFORE any write (both
+    tiers), unknown ops with ValueError, and disjoint src/dst ranges
+    inside ONE backing buffer fold correctly (the kernel never needs
+    them disjoint across buffers, only across ranges)."""
+    _require_native()
+    import numpy as np
+
+    from ray_tpu._private import native
+
+    a = np.arange(16, dtype=np.float64)
+    dst = bytearray(a.nbytes)
+    dst[:] = a.tobytes()
+    src = np.ones(16, dtype=np.float64).tobytes()
+    for bad in [
+            (dst, -8, src),                  # negative dst offset
+            (dst, 8, src),                   # src overruns dst tail
+            (dst, a.nbytes + 8, b""),        # offset past the end
+            (dst, 0, src[:12]),              # src not element-aligned
+    ]:
+        before = bytes(dst)
+        with pytest.raises(ValueError):
+            native.reduce_into(bad[0], bad[1], bad[2], "float64", "sum")
+        assert bytes(dst) == before  # nothing was written
+    with pytest.raises(ValueError):
+        native.reduce_into(dst, 0, src, "float64", "mean")
+
+    # overlap: src and dst are disjoint ranges of the SAME bytearray
+    buf = bytearray(np.arange(32, dtype=np.int64).tobytes())
+    lo = np.frombuffer(buf, dtype=np.int64, count=16).copy()
+    hi = np.frombuffer(buf, dtype=np.int64, count=16, offset=128).copy()
+    n = native.reduce_into(buf, 0, memoryview(buf)[128:], "int64", "sum")
+    assert n == 16
+    assert np.array_equal(
+        np.frombuffer(buf, dtype=np.int64, count=16), lo + hi)
+    assert np.array_equal(  # src range untouched
+        np.frombuffer(buf, dtype=np.int64, count=16, offset=128), hi)
+
+
+def test_reduce_into_c_entry_alignment_and_readonly():
+    """Direct C-entry contract: a misaligned element pointer is handed
+    back as BufferError (the wrapper's cue to take the numpy tier —
+    typed loads on misaligned bases are UB under UBSAN), and readonly
+    destinations are refused outright."""
+    mod = _require_native()
+    import numpy as np
+
+    from ray_tpu._private import native
+
+    a = np.arange(8, dtype=np.float64)
+    src = np.ones(8, dtype=np.float64).tobytes()
+    dst = bytearray(3 + a.nbytes)
+    dst[3:] = a.tobytes()
+    # dtype_code 1 = float64, op_code 0 = sum (native.py's tables)
+    with pytest.raises(BufferError):
+        mod.reduce_into(dst, 3, src, 1, 0)
+    with pytest.raises((TypeError, BufferError)):
+        mod.reduce_into(bytes(dst), 0, src, 1, 0)
+    # the WRAPPER turns the misaligned BufferError into a correct
+    # numpy-tier fold
+    before = native.reduce_stats["fallback"]
+    assert native.reduce_into(dst, 3, src, "float64", "sum") == 8
+    got = np.frombuffer(bytes(dst), dtype=np.float64, count=8, offset=3)
+    assert np.array_equal(got, a + 1)
+    assert native.reduce_stats["fallback"] == before + 1
+
+
+def test_reduce_into_threaded_disjoint_segments():
+    """Concurrent GIL-released folds into disjoint segments of one
+    accumulator (exactly the ring's striped fetch+fold shape) — run
+    against the C entry under a thread pool so the sanitizer sees the
+    concurrency."""
+    mod = _require_native()
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    n = 1 << 18
+    rng = np.random.default_rng(5)
+    a = rng.integers(-1 << 30, 1 << 30, n).astype(np.int64)
+    b = rng.integers(-1 << 30, 1 << 30, n).astype(np.int64)
+    dst = bytearray(a.tobytes())
+    sbytes = b.tobytes()
+    seg = 4099 * 8  # odd element count per segment
+    with ThreadPoolExecutor(max_workers=4) as pool:
+        futs = [pool.submit(mod.reduce_into, dst, off,
+                            sbytes[off:off + min(seg, len(sbytes) - off)],
+                            3, 0)  # int64, sum
+                for off in range(0, len(sbytes), seg)]
+        for f in futs:
+            f.result()
+    assert np.array_equal(np.frombuffer(dst, dtype=np.int64), a + b)

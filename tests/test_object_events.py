@@ -394,8 +394,14 @@ def test_put_lifecycle_refcounts_and_memory_summary(obj_cluster):
     assert _node_has_stats(), state.summary_nodes()
 
     del ref
+    # OUT_OF_SCOPE ships from the driver's metrics loop, FREED from
+    # the raylet heartbeat — independent cadences, so state == FREED
+    # alone can be a partial merge with the driver event still in
+    # flight. Poll until BOTH landed.
     o = _find_object(lambda o: o["object_id"] == oid_hex and
-                     o["state"] == FREED)
+                     o["state"] == FREED and
+                     {OUT_OF_SCOPE, FREED} <=
+                     {e["state"] for e in o["events"]})
     states = [e["state"] for e in o["events"]]
     assert OUT_OF_SCOPE in states and FREED in states
     assert states.index(OUT_OF_SCOPE) <= states.index(FREED)
