@@ -617,16 +617,26 @@ def main():
         _t1 = time.perf_counter()
         _drift = schemagen_mod.check_program(_program)
         _gen_wall = time.perf_counter() - _t1
-        # per-pass cost of the v4 concurrency rules, isolated on the
-        # already-built program (setup + collect + finalize per rule) so
-        # a regressing pass is attributable instead of hiding in wall_s
+        # per-pass cost of the v4 concurrency rules and the v5
+        # exception-flow pass, isolated on the already-built program
+        # (setup + collect + finalize per rule) so a regressing pass is
+        # attributable instead of hiding in wall_s. exception-flow's
+        # sub-row times the whole excflow substrate (raise-set fixed
+        # point + error contracts), so its memoized caches are dropped
+        # first — the lint run above already warmed them.
         from ray_tpu._private.lint.engine import all_rules
         _registry = all_rules()
         _pass_s = {}
         for _rn in ("await-atomicity", "cancel-safety",
-                    "orphan-task", "rpc-deadlock"):
+                    "orphan-task", "rpc-deadlock", "exception-flow"):
             if _rn not in _registry:
                 continue
+            if _rn == "exception-flow":
+                for _attr in ("_excflow_cache", "_excflow_events",
+                              "_excflow_hierarchy",
+                              "_error_contract_cache"):
+                    if hasattr(_program, _attr):
+                        delattr(_program, _attr)
             _tp = time.perf_counter()
             _rule = _registry[_rn]()
             _rule.setup(_program)

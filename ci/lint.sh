@@ -2,8 +2,10 @@
 # raylint hard gate: whole-program static analysis over the package
 # (async-blocking incl. transitive call-graph escalation,
 # lock-discipline, rpc-contract, rpc-schema, exception-hygiene,
-# shm-lifecycle, plus the concurrency-hazard pass: await-atomicity,
-# cancel-safety, orphan-task, rpc-deadlock — see
+# shm-lifecycle, the concurrency-hazard pass: await-atomicity,
+# cancel-safety, orphan-task, rpc-deadlock, plus the v5
+# exception-flow pass (raise-set inference: dead handlers, swallowed
+# retriables, dropped retry signals, unexported raises) — see
 # ray_tpu/_private/lint/RULES.md). Runs next to ci/sanitize.sh on
 # every round; any violation fails CI.
 #
@@ -22,9 +24,16 @@
 #
 # The schema DRIFT GATE rides the same run (--drift-check, one parse +
 # one program build for both): lint/schemagen.py re-infers every RPC
-# schema and fails with a diff when _private/protocol.py or the
-# checked-in golden (lint/rpc_schemas_golden.json) no longer match —
-# editing a handler's wire schema without regenerating cannot land.
+# schema AND every error contract (excflow raise-set inference) and
+# fails with a diff when _private/protocol.py, the schema golden
+# (lint/rpc_schemas_golden.json) or the error-contract golden
+# (lint/error_contracts_golden.json) no longer match — editing a
+# handler's wire schema OR its escaping raise-set without regenerating
+# cannot land.
+#
+# --fault-coverage rides along warn-only: wired faultpoints that no
+# test/chaos schedule ever arms are reported in the artifact
+# ("fault_coverage") and the summary, never in the exit code.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -33,7 +42,7 @@ ARTIFACT="${RAYLINT_ARTIFACT:-/tmp/raylint-report.json}"
 if [ "${CI:-}" = "1" ] || [ "${1:-}" = "--json" ]; then
     # JSON artifact + human summary; the gate is the exit code either way.
     if python -m ray_tpu._private.lint --format json --stale-pragmas-error \
-            --drift-check ray_tpu/ > "$ARTIFACT"; then
+            --fault-coverage --drift-check ray_tpu/ > "$ARTIFACT"; then
         echo "raylint: clean, schemas in sync (artifact: $ARTIFACT)"
         python - "$ARTIFACT" <<'PY'
 import json, sys
@@ -44,6 +53,20 @@ g = r.get("rpc_wait_for_graph", {})
 unbounded = sum(1 for e in g.get("edges", []) if not e["bounded"])
 print(f"raylint: RPC wait-for graph: {len(g.get('edges', []))} edge(s) "
       f"({unbounded} unbounded), {len(g.get('cycles', []))} cycle(s)")
+c = r.get("error_contracts", {})
+raising = sum(1 for m in c.values() if m["raises"] or m["stored"]
+              or m["error_reply_keys"])
+print(f"raylint: {len(c)} RPC error contracts inferred "
+      f"({raising} with a non-empty error surface)")
+counts = r.get("violation_counts", {})
+ran = ", ".join(f"{k}={counts[k]}" for k in sorted(counts))
+print(f"raylint: per-rule counts: {ran}")
+fc = r.get("fault_coverage") or {}
+if fc:
+    print(f"raylint: fault coverage: {len(fc['armed'])}/"
+          f"{len(fc['wired'])} wired points armed"
+          + (f"; UNARMED: {', '.join(fc['unarmed'])}"
+         if fc["unarmed"] else ""))
 PY
     else
         rc=$?
@@ -64,5 +87,6 @@ PY
         exit "$rc"
     fi
 else
-    python -m ray_tpu._private.lint --stale-pragmas --drift-check ray_tpu/
+    python -m ray_tpu._private.lint --fault-coverage --stale-pragmas \
+        --drift-check ray_tpu/
 fi

@@ -759,10 +759,13 @@ class CoreWorker:
         return reply
 
     def _kv_put_sync(self, key: bytes, value: bytes):
-        self._run(self._gcs_call("KVPut", {"key": key}, bufs=[value]))
+        self._run(self._gcs_call(
+            "KVPut", protocol.KVPutRequest(key=key).to_header(),
+            bufs=[value]))
 
     def _kv_get_sync(self, key: bytes) -> Optional[bytes]:
-        header, bufs = self._run(self._gcs_call("KVGet", {"key": key}))
+        header, bufs = self._run(self._gcs_call(
+            "KVGet", protocol.KVGetRequest(key=key).to_header()))
         return bufs[0] if header.get("found") else None
 
     # --------------------------------------------------------- ref reducers
@@ -810,7 +813,9 @@ class CoreWorker:
         """Fire-and-forget internal-KV put (tracing/telemetry export —
         must never block or fail the caller's thread)."""
         self._fire_and_forget(self._gcs_call(
-            "KVPut", {"key": key, "overwrite": True}, bufs=[value]))
+            "KVPut",
+            protocol.KVPutRequest(key=key, overwrite=True).to_header(),
+            bufs=[value]))
 
     async def _get_owner_conn(self, address: str) -> rpc.Connection:
         if address == self.address:
@@ -1183,9 +1188,10 @@ class CoreWorker:
         # owner_address feeds the raylet's leak detector: the sweep
         # probes this owner's live references against the stored
         # segment (object_events.py).
-        reply, _ = await self.raylet_conn.call("SealObject", {
-            "object_id": oid.binary(), "segment": segment, "size": size,
-            "pin": pin, "owner_address": self.address})
+        reply, _ = await self.raylet_conn.call(
+            "SealObject", protocol.SealObjectRequest(
+                object_id=oid.binary(), segment=segment, size=size,
+                pin=pin, owner_address=self.address).to_header())
         if not reply.get("ok"):
             raise exc.ObjectStoreFullError(
                 f"object {oid.hex()} ({size} bytes) does not fit in the store")
@@ -1559,10 +1565,11 @@ class CoreWorker:
         (state.list_objects() placement surface)."""
         self.reference_counter.add_owned_object(oid)
         segment, size = await self._write_segment_async(serialized)
-        reply, _ = await self.raylet_conn.call("SealObject", {
-            "object_id": oid.binary(), "segment": segment, "size": size,
-            "pin": True, "owner_address": self.address,
-            "shard": shard_attrs})
+        reply, _ = await self.raylet_conn.call(
+            "SealObject", protocol.SealObjectRequest(
+                object_id=oid.binary(), segment=segment, size=size,
+                pin=True, owner_address=self.address,
+                shard=shard_attrs).to_header())
         if not reply.get("ok"):
             raise exc.ObjectStoreFullError(
                 f"shard {oid.hex()} ({size} bytes) does not fit in the "

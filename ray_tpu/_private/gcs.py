@@ -1410,15 +1410,16 @@ class GcsServer:
     # --------------------------------------------------------------- KV
 
     async def handle_kv_put(self, conn, header, bufs):
-        overwrite = header.get("overwrite", True)
-        key = header["key"]
+        req = protocol.KVPutRequest.from_header(header)
+        overwrite = req.get("overwrite", True)
+        key = req.key
         if not overwrite and key in self.kv:
-            return {"added": False}
+            return protocol.KVPutReply(added=False).to_header()
         self.kv[key] = bufs[0] if bufs else b""
         self._journal_append("kv_put", {"key": key, "value": self.kv[key]})
         if key.startswith(TRACE_KV_PREFIX):
             self._note_trace_span(key)
-        return {"added": True}
+        return protocol.KVPutReply(added=True).to_header()
 
     def _note_trace_span(self, key: bytes) -> None:
         """Bound exported tracing spans (config.tracing_max_spans):
@@ -1461,10 +1462,11 @@ class GcsServer:
                 str(self.trace_spans_dropped).encode()
 
     async def handle_kv_get(self, conn, header, bufs):
-        val = self.kv.get(header["key"])
+        req = protocol.KVGetRequest.from_header(header)
+        val = self.kv.get(req.key)
         if val is None:
-            return {"found": False}
-        return {"found": True}, [val]
+            return protocol.KVGetReply(found=False).to_header()
+        return protocol.KVGetReply(found=True).to_header(), [val]
 
     def _unindex_trace_key(self, key: bytes) -> None:
         """Keep the span-cap index consistent with deletions (explicit
@@ -1477,17 +1479,20 @@ class GcsServer:
                 del self._trace_keys[trace_id]
 
     async def handle_kv_del(self, conn, header, bufs):
-        key = header["key"]
+        req = protocol.KVDelRequest.from_header(header)
+        key = req.key
         existed = self.kv.pop(key, None) is not None
         if existed:
             self._journal_append("kv_del", {"key": key})
             if key.startswith(TRACE_KV_PREFIX):
                 self._unindex_trace_key(key)
-        return {"deleted": existed}
+        return protocol.KVDelReply(deleted=existed).to_header()
 
     async def handle_kv_keys(self, conn, header, bufs):
-        prefix = header.get("prefix", b"")
-        return {"keys": [k for k in self.kv if k.startswith(prefix)]}
+        req = protocol.KVKeysRequest.from_header(header)
+        prefix = req.get("prefix", b"")
+        return protocol.KVKeysReply(
+            keys=[k for k in self.kv if k.startswith(prefix)]).to_header()
 
     async def handle_kv_get_prefix(self, conn, header, bufs):
         """Bulk read of every key under a prefix in ONE round-trip.

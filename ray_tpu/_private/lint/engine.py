@@ -325,6 +325,41 @@ def find_stale_pragmas(modules: Sequence[Module],
     return out
 
 
+def fault_coverage(modules: Sequence[Module],
+                   tests_path: str = "tests") -> dict:
+    """Cross-reference the faultpoints wired into the scanned tree
+    (``fire("name")`` / ``async_fire("name")`` call sites) against the
+    chaos/test corpus under ``tests_path``: a point armed NOWHERE is
+    dead fault-injection surface — the failure path it guards has no
+    schedule driving it. Warn-only by contract: the report never
+    changes the exit code (a new faultpoint should not break CI, it
+    should show up here until a schedule adopts it)."""
+    wired = set()
+    for m in modules:
+        if m.tree is None:
+            continue
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Call) and \
+                    dotted_name(node.func).rsplit(".", 1)[-1] in (
+                        "fire", "async_fire"):
+                name = first_str_arg(node)
+                if name:
+                    wired.add(name)
+    corpus = []
+    if os.path.isdir(tests_path):
+        for f in iter_py_files([tests_path]):
+            with open(f, "r", encoding="utf-8", errors="replace") as fh:
+                corpus.append(fh.read())
+    blob = "\n".join(corpus)
+    armed = {name for name in wired if name in blob}
+    return {
+        "tests_path": tests_path,
+        "wired": sorted(wired),
+        "armed": sorted(armed),
+        "unarmed": sorted(wired - armed),
+    }
+
+
 def load_modules(paths: Sequence[str]) -> List[Module]:
     modules = []
     for f in iter_py_files(paths):
@@ -379,10 +414,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "contract)")
     parser.add_argument("--drift-check", action="store_true",
                         help="also run the schemagen drift gate "
-                             "(generated protocol.py + schema golden vs "
-                             "the current inference) on the SAME parsed "
-                             "program — the single-pass ci/lint.sh gate; "
-                             "drift fails the run like a violation")
+                             "(generated protocol.py + schema golden + "
+                             "error-contract golden vs the current "
+                             "inference) on the SAME parsed program — "
+                             "the single-pass ci/lint.sh gate; drift "
+                             "fails the run like a violation")
+    parser.add_argument("--fault-coverage", nargs="?", const="tests",
+                        default=None, metavar="TESTS_DIR",
+                        help="cross-reference wired faultpoints against "
+                             "the test corpus (default: tests/) and "
+                             "report points armed nowhere — warn-only, "
+                             "never affects the exit code")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -422,12 +464,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.drift_check:
         from ray_tpu._private.lint.schemagen import check_program
         drift = check_program(program)
+    coverage = fault_coverage(modules, args.fault_coverage) \
+        if args.fault_coverage else None
 
     if args.format == "json":
         from ray_tpu._private.lint.rules.rpc_deadlock import \
             wait_graph_report
         from ray_tpu._private.lint.rules.rpc_schema import schemas_as_dict
-        from ray_tpu._private.lint.schemagen import PROTOCOL_VERSION
+        from ray_tpu._private.lint.schemagen import (
+            PROTOCOL_VERSION, build_contracts)
         active = rule_names or sorted(all_rules())
         counts = {name: 0 for name in active}
         for v in violations:
@@ -454,6 +499,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             # (the rpc-deadlock rule's full graph): the reviewer's
             # audit surface for every blocking RPC dependency.
             "rpc_wait_for_graph": wait_graph_report(program),
+            # Per-RPC-method error contract (excflow raise-set
+            # inference): what awaiting this method can raise, what
+            # its handlers sink-store, and its error-signal reply
+            # keys. Frozen as error_contracts_golden.json and
+            # drift-gated alongside the schemas.
+            "error_contracts": build_contracts(program),
+            # --fault-coverage: wired faultpoints vs the test corpus
+            # (warn-only; null when the flag was not passed).
+            "fault_coverage": coverage,
         }, indent=2, sort_keys=True))
     else:
         for v in violations:
@@ -463,6 +517,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f"{sev}: {v.render()}")
         for line in drift:
             print(line, file=sys.stderr)
+        if coverage is not None:
+            for name in coverage["unarmed"]:
+                print(f"warning: fault-coverage: point `{name}` is "
+                      f"wired but armed nowhere under "
+                      f"{coverage['tests_path']}/ — no schedule drives "
+                      f"its failure path")
         status = "clean" if not violations else \
             f"{len(violations)} violation(s)"
         if stale:
@@ -470,6 +530,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             status += f", {len(stale)} stale pragma(s){qual}"
         if args.drift_check:
             status += ", schema drift" if drift else ", schemas in sync"
+        if coverage is not None:
+            status += (f", fault coverage {len(coverage['armed'])}/"
+                       f"{len(coverage['wired'])} armed")
         print(f"raylint: {len(modules)} file(s), {status}")
     if args.stale_pragmas_error and stale:
         return 1

@@ -1,0 +1,607 @@
+"""Exception-flow substrate: whole-program raise-set inference.
+
+The fault-tolerance story of this runtime rides TYPED errors —
+``OutOfMemoryError`` with owner-acked retry budgets, ``retry_later``
+lease backpressure, ``ActorDiedError``/``ObjectLostError`` with a
+structured ``cause_kind``, ``ProtocolError`` on wire drift — but the
+language gives exception flow no static surface: which ``except`` sites
+a raise can actually reach is invisible until a chaos seed happens to
+drive the path. This module gives raylint that surface, on the same
+``callgraph.Program`` substrate (and with the same conservative
+no-edge-on-ambiguity discipline) the rpc-schema inference runs on.
+
+Per function it infers a :class:`RaiseInfo`:
+
+* ``escapes`` — exception type NAMES the function can raise to its
+  caller: direct ``raise X(...)`` sites, re-raises out of ``except``
+  clauses, ``X.from_header(...)`` decodes through a generated protocol
+  stub (``ProtocolError`` on drift), and propagation through RESOLVED
+  call edges — each contribution filtered through the ``try`` frames
+  enclosing its site (a type whose first matching handler cannot
+  re-raise is subtracted). The set is a LOWER bound by construction:
+  an unresolved call contributes nothing, so every name in it is a
+  provable flow.
+* ``complete`` — True when ``escapes`` is ALSO an upper bound for the
+  project typed-error family (every call in the body resolved with
+  complete callees or provably benign, no dynamic ``raise <expr>``,
+  no bare ``await`` of a non-call, no dynamically-typed handler):
+  only then can "cannot raise T" be claimed. Benign means a site that
+  provably never re-enters tree code: an unshadowed builtin call, a
+  ``logger.<level>(...)`` call, or the CONSTRUCTION of a known
+  exception class.
+* ``stored`` — typed-error constructions routed through a store sink
+  (``_store_error_for_task(spec, XError(...))``): not a raise HERE,
+  but the error the task's caller gets at ``get`` — part of the
+  method's observable error surface.
+
+Exception identity is the terminal NAME (``exc.CollectiveError`` →
+``CollectiveError``), judged against a hierarchy merged from every
+``class X(Y)`` in the scanned tree plus the real builtin exception
+MRO. A name with no known ancestry is modeled as a direct
+``Exception`` subclass — the documented modeling assumption: it only
+widens what a broad handler catches, never what a narrow one does.
+
+From the handler side of the RPC index this yields per-method **error
+contracts** (:func:`error_contracts`): the handler family's escaping
+raise-set — exactly what the client's ``await conn.call(...)``
+re-raises when the dispatcher error-replies — plus its sink-stored
+errors and the ``ERROR_REPLY_KEYS`` subset of its reply schema
+(``retry_later`` lease backpressure, ``stale_epoch`` fences, in-band
+``error`` strings). ``schemagen`` freezes the table into a drift-gated
+golden; the ``exception-flow`` rule family judges handlers and call
+sites against it.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ray_tpu._private.lint.engine import dotted_name
+
+# Reply keys that signal an error/backpressure path rather than payload
+# (the vocabulary actually spoken on the wire: in-band error strings,
+# lease backpressure, epoch fences). A method's reply schema
+# intersected with this set is its error-reply surface.
+ERROR_REPLY_KEYS = frozenset({"error", "retry_later", "stale_epoch"})
+
+# Sinks that convert a constructed typed error into a stored task
+# result (re-raised at the caller's ``get``): the error never RAISES
+# here, but it is part of the path's observable error surface.
+ERROR_SINKS = frozenset({"_store_error_for_task"})
+
+# Bare-name builtin calls that provably never re-enter tree code.
+# They still raise builtins (ValueError from int(), KeyError…) —
+# completeness does not claim to bound those, only project-typed flow.
+_BENIGN_BUILTINS = frozenset({
+    "abs", "bool", "bytearray", "bytes", "callable", "chr", "dict",
+    "divmod", "enumerate", "filter", "float", "format", "frozenset",
+    "getattr", "hasattr", "hash", "hex", "id", "int", "isinstance",
+    "issubclass", "iter", "len", "list", "map", "max", "min", "next",
+    "oct", "ord", "print", "range", "repr", "reversed", "round", "set",
+    "setattr", "sorted", "str", "sum", "tuple", "type", "vars", "zip",
+})
+
+# ``logger.info(...)``-style method names treated as benign: logging
+# never raises project-typed errors back into the flow being judged.
+_LOG_METHODS = frozenset({
+    "debug", "info", "warning", "error", "exception", "critical", "log",
+})
+
+_PROJECT_ROOT_EXC = "RayTpuError"
+
+_TRY_TYPES = (ast.Try,) + ((ast.TryStar,) if hasattr(ast, "TryStar")
+                           else ())
+
+
+@dataclasses.dataclass
+class RaiseInfo:
+    """Per-function inference result (see module docstring)."""
+    escapes: Set[str] = dataclasses.field(default_factory=set)
+    complete: bool = True
+    stored: Set[str] = dataclasses.field(default_factory=set)
+
+
+class HandlerMeta:
+    """One ``except`` clause of a try frame, as the fold sees it."""
+
+    __slots__ = ("node", "types", "dynamic", "broad", "can_reraise",
+                 "bound_name")
+
+    def __init__(self, node: ast.ExceptHandler, star: bool = False):
+        self.node = node
+        self.types: List[str] = []
+        # type expr not statically a (tuple of) name(s) — or an
+        # ``except*`` clause, whose group-splitting semantics this
+        # model does not attempt
+        self.dynamic = star
+        self.broad = node.type is None
+        self.bound_name = node.name
+        if node.type is not None:
+            elts = node.type.elts if isinstance(node.type, ast.Tuple) \
+                else [node.type]
+            for e in elts:
+                name = dotted_name(e).rsplit(".", 1)[-1]
+                if name and name != "?":
+                    self.types.append(name)
+                else:
+                    self.dynamic = True
+        # A handler that can re-raise keeps its caught types escaping:
+        # bare ``raise`` or ``raise e`` of the bound name, at any depth
+        # (a conditional re-raise still CAN escape).
+        self.can_reraise = False
+        for inner in ast.walk(node):
+            if isinstance(inner, ast.Raise):
+                if inner.exc is None:
+                    self.can_reraise = True
+                elif self.bound_name and \
+                        isinstance(inner.exc, ast.Name) and \
+                        inner.exc.id == self.bound_name:
+                    self.can_reraise = True
+
+    def catches_broadly(self) -> bool:
+        """Bare ``except``, ``except Exception`` or ``BaseException``."""
+        return self.broad or bool(
+            {"Exception", "BaseException"} & set(self.types))
+
+
+# One try frame: (id(try node), [HandlerMeta, ...] clause-ordered).
+_Frame = Tuple[int, List[HandlerMeta]]
+
+
+class _Event:
+    """One raise-capable site with the try frames protecting it
+    (innermost first). ``kind`` is one of ``raise`` / ``stub_decode``
+    (``names`` carries the types), ``call`` (``callee`` carries the
+    function key), or ``unresolved`` (contributes nothing to the lower
+    bound, voids the upper)."""
+
+    __slots__ = ("kind", "names", "callee", "frames", "node")
+
+    def __init__(self, kind: str, node: ast.AST, names=(), callee=None,
+                 frames: Tuple[_Frame, ...] = ()):
+        self.kind = kind
+        self.node = node
+        self.names = frozenset(names)
+        self.callee = callee
+        self.frames = tuple(frames)
+
+
+class Hierarchy:
+    """Merged exception-class hierarchy: scanned-tree ``class X(Y)``
+    edges plus the real builtin exception MRO. Unknown names read as
+    direct Exception subclasses; two same-named tree classes with
+    different bases resolve to "not provable" (ancestry falls back to
+    the unknown-name modeling)."""
+
+    def __init__(self, program):
+        self.parents: Dict[str, Tuple[str, ...]] = {}
+        self._ambiguous: Set[str] = set()
+        for module in program.modules.values():
+            if module.tree is None:
+                continue
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                bases = tuple(
+                    n for n in
+                    (dotted_name(b).rsplit(".", 1)[-1]
+                     for b in node.bases)
+                    if n and n != "?")
+                if node.name in self._ambiguous:
+                    continue
+                prior = self.parents.get(node.name)
+                if prior is not None and prior != bases:
+                    self._ambiguous.add(node.name)
+                    del self.parents[node.name]
+                else:
+                    self.parents[node.name] = bases
+        self._ancestors_cache: Dict[str, frozenset] = {}
+
+    def ancestors(self, name: str) -> frozenset:
+        """Every ancestor name of ``name``, inclusive."""
+        cached = self._ancestors_cache.get(name)
+        if cached is not None:
+            return cached
+        out: Set[str] = set()
+        stack = [name]
+        seen: Set[str] = set()
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            out.add(cur)
+            parents = self.parents.get(cur)
+            if parents:
+                stack.extend(parents)
+                continue
+            b = getattr(builtins, cur, None)
+            if isinstance(b, type) and issubclass(b, BaseException):
+                out.update(c.__name__ for c in b.__mro__
+                           if issubclass(c, BaseException))
+            elif cur == name and cur not in self.parents:
+                # modeling assumption: an unknown exception name is a
+                # direct Exception subclass
+                out.update(("Exception", "BaseException"))
+        result = frozenset(out)
+        self._ancestors_cache[name] = result
+        return result
+
+    def is_exception(self, name: str) -> bool:
+        return "BaseException" in self.ancestors(name)
+
+    def catches(self, handler_type: str, raised: str) -> bool:
+        """True when ``except handler_type`` catches ``raised``."""
+        return handler_type in self.ancestors(raised)
+
+    def project_typed(self, name: str) -> bool:
+        """True when ``name`` is in the project typed-error family."""
+        return _PROJECT_ROOT_EXC in self.ancestors(name)
+
+
+def _raised_name(exc_node: ast.AST) -> Optional[str]:
+    """Terminal class name of a ``raise`` operand, or None when the
+    raised value is dynamic (``raise err``, ``raise make_error()``).
+    The lowercase gate reads ``raise err`` as a re-raise of a bound
+    value, not a construction — class names here are CapWords."""
+    node = exc_node
+    if isinstance(node, ast.Call):
+        node = node.func
+    name = dotted_name(node).rsplit(".", 1)[-1]
+    if not name or name == "?" or not name[0].isupper():
+        return None
+    return name
+
+
+def _stub_decode_call(program, node: ast.Call) -> bool:
+    """True for ``X.from_header(...)`` where X is a generated protocol
+    stub — the decode raises ProtocolError on a frame violating the
+    declared schema."""
+    if not isinstance(node.func, ast.Attribute) or \
+            node.func.attr != "from_header":
+        return False
+    cls_name = dotted_name(node.func.value).rsplit(".", 1)[-1]
+    return bool(cls_name) and cls_name != "?" and \
+        program.stub_class(cls_name) is not None
+
+
+class _Collector:
+    """Extracts the raise-capable events of ONE function body, with
+    the try frames protecting each site (innermost first). Nested
+    defs/lambdas/classes are other execution contexts and are not
+    descended into; a site inside a ``try`` is protected only when it
+    sits in the try's BODY (handlers, orelse and finalbody run outside
+    the frame)."""
+
+    def __init__(self, program, fi, hierarchy: Hierarchy):
+        self.program = program
+        self.fi = fi
+        self.hierarchy = hierarchy
+        self.edge_by_node = {id(node): callee for node, callee in fi.calls}
+        self.events: List[_Event] = []
+        self.stored: Set[str] = set()
+        self.shadowed = set(program.module_level.get(fi.path, {})) | \
+            set(program.import_names.get(fi.path, {}))
+
+    def run(self) -> Tuple[List[_Event], Set[str]]:
+        self._stmts(self.fi.node.body, (), frozenset())
+        return self.events, self.stored
+
+    # ---------------------------------------------------------- statements
+
+    def _stmts(self, stmts, frames: Tuple[_Frame, ...],
+               bound: frozenset):
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, _TRY_TYPES):
+                star = hasattr(ast, "TryStar") and \
+                    isinstance(st, ast.TryStar)
+                metas = [HandlerMeta(h, star=star) for h in st.handlers]
+                self._stmts(st.body, ((id(st), metas),) + frames, bound)
+                for h in st.handlers:
+                    inner_bound = bound | {h.name} if h.name else bound
+                    self._stmts(h.body, frames, inner_bound)
+                self._stmts(st.orelse, frames, bound)
+                self._stmts(st.finalbody, frames, bound)
+                continue
+            if isinstance(st, ast.Raise):
+                self._raise(st, frames, bound)
+                continue
+            if isinstance(st, ast.Assert):
+                # AssertionError is never project-typed and asserts
+                # vanish under -O: not an event either way
+                continue
+            for _, value in ast.iter_fields(st):
+                if isinstance(value, list):
+                    if value and isinstance(value[0], ast.stmt):
+                        self._stmts(value, frames, bound)
+                    else:
+                        for v in value:
+                            if hasattr(ast, "match_case") and \
+                                    isinstance(v, ast.match_case):
+                                if v.guard is not None:
+                                    self._expr(v.guard, frames)
+                                self._stmts(v.body, frames, bound)
+                            elif isinstance(v, ast.AST):
+                                self._expr(v, frames)
+                elif isinstance(value, ast.AST):
+                    self._expr(value, frames)
+
+    def _raise(self, st: ast.Raise, frames, bound: frozenset):
+        if st.exc is None:
+            # bare re-raise: modeled by the enclosing handler's
+            # can_reraise flag, nothing to record here
+            return
+        name = _raised_name(st.exc)
+        if name is not None:
+            self.events.append(_Event("raise", st, names=[name],
+                                      frames=frames))
+        elif not (isinstance(st.exc, ast.Name) and st.exc.id in bound):
+            # dynamic raise of something other than a handler-bound
+            # name: type unknowable, upper bound void
+            self.events.append(_Event("unresolved", st, frames=frames))
+        self._expr(st.exc, frames)
+        if st.cause is not None:
+            self._expr(st.cause, frames)
+
+    # --------------------------------------------------------- expressions
+
+    def _expr(self, node: ast.AST, frames):
+        stack = [node]
+        while stack:
+            cur = stack.pop()
+            if isinstance(cur, (ast.Lambda, ast.FunctionDef,
+                                ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            if isinstance(cur, ast.Await) and \
+                    not isinstance(cur.value, ast.Call):
+                # awaiting a stored future/coroutine: raises whatever
+                # the producer failed with — unknowable statically
+                self.events.append(_Event("unresolved", cur,
+                                          frames=frames))
+            elif isinstance(cur, ast.Call):
+                self._call(cur, frames)
+            stack.extend(ast.iter_child_nodes(cur))
+
+    def _call(self, node: ast.Call, frames):
+        term = dotted_name(node.func).rsplit(".", 1)[-1]
+        if term in ERROR_SINKS:
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Call):
+                        cname = dotted_name(sub.func).rsplit(".", 1)[-1]
+                        if cname and cname != "?" and \
+                                self.hierarchy.project_typed(cname):
+                            self.stored.add(cname)
+        if _stub_decode_call(self.program, node):
+            self.events.append(_Event("stub_decode", node,
+                                      names=["ProtocolError"],
+                                      frames=frames))
+            return
+        callee = self.edge_by_node.get(id(node))
+        if callee is not None:
+            if id(node) not in self.fi.spawned_calls:
+                # a spawned (create_task/…) call is a DETACHED task:
+                # its raises never propagate to this caller
+                self.events.append(_Event(
+                    "call", node, callee=(callee.path, callee.qualname),
+                    frames=frames))
+            return
+        if isinstance(node.func, ast.Name) and \
+                node.func.id in _BENIGN_BUILTINS and \
+                node.func.id not in self.shadowed:
+            return
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _LOG_METHODS and \
+                dotted_name(node.func.value).rsplit(".", 1)[-1] in (
+                    "logger", "log", "logging", "_logger"):
+            return
+        if term and term != "?" and term[0].isupper() and \
+                self.hierarchy.is_exception(term):
+            # constructing a known exception (raise operands, stored
+            # errors, reply payloads) never re-enters tree flow
+            return
+        self.events.append(_Event("unresolved", node, frames=frames))
+
+
+def _filter_through_frames(names: Set[str], frames,
+                           hierarchy: Hierarchy) -> Tuple[Set[str], bool]:
+    """(escaping subset, precise) after the try frames protecting a
+    site, innermost first. Per frame the FIRST matching clause
+    decides: caught without re-raise → subtracted; caught with a
+    possible re-raise → kept (CAN escape). A dynamically-typed clause
+    may or may not catch anything — the name drops from the lower
+    bound and ``precise`` flips False (no upper-bound claim through
+    it)."""
+    out = set(names)
+    precise = True
+    for _, metas in frames:
+        if not out:
+            break
+        survivors = set()
+        for r in out:
+            verdict = "escape"
+            for m in metas:
+                if m.dynamic:
+                    verdict = "caught"
+                    precise = False
+                    break
+                if m.broad or any(hierarchy.catches(t, r)
+                                  for t in m.types):
+                    verdict = "reraise" if m.can_reraise else "caught"
+                    break
+            if verdict != "caught":
+                survivors.add(r)
+        out = survivors
+    return out, precise
+
+
+def excflow_hierarchy(program) -> Hierarchy:
+    cached = getattr(program, "_excflow_hierarchy", None)
+    if cached is None:
+        cached = Hierarchy(program)
+        program._excflow_hierarchy = cached
+    return cached
+
+
+def infer_raise_sets(program) -> Dict[Tuple[str, str], RaiseInfo]:
+    """Fixed-point raise-set inference over every function in the
+    program. Memoized on the Program (like the rpc-schema table): the
+    rule pass, the error-contract table and the JSON reporter all read
+    one computation. ``escapes`` grows monotonically and ``complete``
+    only ever flips True→False, so the fold terminates."""
+    cached = getattr(program, "_excflow_cache", None)
+    if cached is not None:
+        return cached
+    hierarchy = excflow_hierarchy(program)
+    events: Dict[Tuple[str, str], List[_Event]] = {}
+    infos: Dict[Tuple[str, str], RaiseInfo] = {}
+    for key, fi in program.functions.items():
+        evs, stored = _Collector(program, fi, hierarchy).run()
+        events[key] = evs
+        infos[key] = RaiseInfo(stored=stored)
+    changed = True
+    while changed:
+        changed = False
+        for key, evs in events.items():
+            info = infos[key]
+            new_escapes = set(info.escapes)
+            complete = True
+            for ev in evs:
+                if ev.kind == "unresolved":
+                    complete = False
+                    continue
+                if ev.kind == "call":
+                    callee = infos.get(ev.callee)
+                    if callee is None:
+                        complete = False
+                        continue
+                    contributed = callee.escapes
+                    if not callee.complete:
+                        complete = False
+                else:
+                    contributed = ev.names
+                escaped, precise = _filter_through_frames(
+                    set(contributed), ev.frames, hierarchy)
+                if not precise:
+                    complete = False
+                new_escapes |= escaped
+            if new_escapes != info.escapes or \
+                    (info.complete and not complete):
+                info.escapes = new_escapes
+                info.complete = info.complete and complete
+                changed = True
+    program._excflow_cache = infos
+    program._excflow_events = events
+    return infos
+
+
+def handler_reach(program, fi) -> Iterator[
+        Tuple[HandlerMeta, Set[str], bool]]:
+    """Per ``except`` clause of ``fi``: ``(meta, reach, complete)``.
+
+    ``reach`` is the lower-bound set of exception names arriving at
+    that clause — everything the try BODY provably raises (sites at any
+    nesting depth, each filtered through the frames between the site
+    and this try) minus what EARLIER clauses of the same try catch.
+    ``complete`` is True when the try body's raise sources are fully
+    resolved — only then is "T cannot reach this clause" provable.
+    Clauses after a dynamically-typed clause are not yielded at all:
+    neither bound survives an unknowable earlier catch."""
+    infos = infer_raise_sets(program)
+    hierarchy = excflow_hierarchy(program)
+    events = getattr(program, "_excflow_events", {}).get(
+        (fi.path, fi.qualname), [])
+    reach: Dict[int, Set[str]] = {}
+    complete: Dict[int, bool] = {}
+    metas_by_try: Dict[int, List[HandlerMeta]] = {}
+    order: List[int] = []
+    for ev in events:
+        if ev.kind == "call":
+            callee = infos.get(ev.callee)
+            base = set(callee.escapes) if callee else set()
+            base_ok = callee is not None and callee.complete
+        elif ev.kind == "unresolved":
+            base, base_ok = set(), False
+        else:
+            base, base_ok = set(ev.names), True
+        for i, (tid, metas) in enumerate(ev.frames):
+            if tid not in reach:
+                reach[tid] = set()
+                complete[tid] = True
+                metas_by_try[tid] = metas
+                order.append(tid)
+            escaped, precise = _filter_through_frames(
+                base, ev.frames[:i], hierarchy)
+            reach[tid] |= escaped
+            if not (precise and base_ok):
+                complete[tid] = False
+    for tid in order:
+        remaining = set(reach[tid])
+        ok = complete[tid]
+        for meta in metas_by_try[tid]:
+            yield meta, set(remaining), ok
+            if meta.dynamic:
+                break
+            if meta.broad:
+                remaining = set()
+            else:
+                remaining = {r for r in remaining
+                             if not any(hierarchy.catches(t, r)
+                                        for t in meta.types)}
+
+
+def error_contracts(program) -> Dict[str, dict]:
+    """Per-RPC-method error contract over the registered handler
+    family (see module docstring). Deterministic: every collection
+    sorted, handler entries ``path:qualname`` with no line numbers —
+    the schemagen golden diffs this table."""
+    cached = getattr(program, "_error_contract_cache", None)
+    if cached is not None:
+        return cached
+    from ray_tpu._private.lint.rules.rpc_schema import infer_schemas
+    infos = infer_raise_sets(program)
+    schemas = infer_schemas(program)
+    out: Dict[str, dict] = {}
+    for method, regs in sorted(program.rpc.registrations.items()):
+        raises: Set[str] = set()
+        stored: Set[str] = set()
+        complete = True
+        handlers: Set[str] = set()
+        seen = set()
+        for reg in regs:
+            fi = reg.handler
+            if fi is None:
+                complete = False
+                continue
+            key = (fi.path, fi.qualname)
+            if key in seen:
+                continue
+            seen.add(key)
+            handlers.add(f"{fi.path}:{fi.qualname}")
+            info = infos.get(key)
+            if info is None:
+                complete = False
+                continue
+            raises |= info.escapes
+            stored |= info.stored
+            complete = complete and info.complete
+        if not handlers:
+            continue
+        ms = schemas.get(method)
+        error_keys = sorted(ERROR_REPLY_KEYS & ms.reply_keys) \
+            if ms is not None and ms.reply_keys is not None else []
+        out[method] = {
+            "raises": sorted(raises),
+            "raises_complete": complete,
+            "stored": sorted(stored),
+            "error_reply_keys": error_keys,
+            "handlers": sorted(handlers),
+        }
+    program._error_contract_cache = out
+    return out

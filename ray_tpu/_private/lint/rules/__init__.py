@@ -5,6 +5,7 @@ from ray_tpu._private.lint.rules import (  # noqa: F401
     async_blocking,
     await_atomicity,
     cancel_safety,
+    exception_flow,
     exception_hygiene,
     lock_discipline,
     orphan_task,

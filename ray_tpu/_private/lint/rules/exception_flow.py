@@ -1,0 +1,266 @@
+"""exception-flow: raise-set inference findings on real error paths.
+
+Five checks, all driven by the ``excflow`` substrate (whole-program
+raise-set inference + per-RPC error contracts) and all tuned to the
+same discipline as the rest of raylint: a finding must be PROVABLE
+from the static program, so ambiguity silences the check rather than
+widening it.
+
+* **dead-handler** — ``except T`` where T is a project typed error,
+  the try body's raise sources are fully resolved, and nothing the
+  body can raise is caught by T. The classic shape is a renamed or
+  re-homed exception: the handler compiles, matches nothing, and the
+  recovery path it used to guard silently stops existing.
+* **unknown-exc-attr** — ``exc.X`` where ``exc`` is an alias of the
+  public ``exceptions`` module and X is not defined there: an
+  AttributeError at the exact moment the code is trying to handle a
+  real failure.
+* **swallowed-retriable** — a broad ``except``/``except Exception``
+  clause provably reached by a typed RETRIABLE error
+  (OutOfMemoryError, ObjectLostError, WorkerCrashedError,
+  GangBrokenError) whose body neither re-raises nor classifies the
+  exception. This is the retry-budget-bypass class: the caller's
+  retry accounting never sees the failure.
+* **unconsumed-retry-signal** — an awaited ``conn.call`` of a method
+  whose error contract includes an in-band backpressure key
+  (``retry_later`` / ``stale_epoch``) in a function that never reads
+  any reply-signal key and does not pass the reply on. Unlike an
+  exception, an in-band signal propagates NOWHERE by default —
+  dropping the dict drops the signal.
+* **unexported-raise** — a ``raise`` of a tree-defined RayTpuError
+  subclass that ``exceptions.py`` does not export: callers cannot
+  name it in an ``except`` clause without importing private modules.
+
+The error contracts themselves are frozen by schemagen into
+``error_contracts_golden.json`` and drift-checked in CI; this rule
+family consumes them, it does not gate them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from ray_tpu._private.lint.engine import (
+    Module, Rule, Violation, body_nodes, dotted_name, register,
+)
+from ray_tpu._private.lint import excflow
+
+# Typed errors whose whole point is to be SEEN by retry accounting:
+# swallowing one in a broad except bypasses the budget that makes the
+# failure recoverable.
+RETRIABLE = frozenset({
+    "OutOfMemoryError", "ObjectLostError", "WorkerCrashedError",
+    "GangBrokenError",
+})
+
+# In-band reply keys that carry a backpressure/fencing signal the
+# caller must consume (an ignored reply dict silently drops them).
+_SIGNAL_KEYS = frozenset({"retry_later", "stale_epoch", "granted"})
+
+_EXC_MODULE_BASENAME = "exceptions"
+
+
+def _exceptions_exports(program) -> Optional[Set[str]]:
+    """Names defined at top level of the public exceptions module(s):
+    class defs plus alias assignments (``RayActorError =
+    ActorDiedError``). None when no exceptions module was scanned —
+    every check keyed on it goes silent rather than flagging the
+    world."""
+    paths = program.by_basename.get(_EXC_MODULE_BASENAME, [])
+    exports: Set[str] = set()
+    found = False
+    for path in paths:
+        module = program.modules.get(path)
+        if module is None or module.tree is None:
+            continue
+        found = True
+        for st in module.tree.body:
+            if isinstance(st, ast.ClassDef):
+                exports.add(st.name)
+            elif isinstance(st, ast.Assign):
+                for t in st.targets:
+                    if isinstance(t, ast.Name):
+                        exports.add(t.id)
+    return exports if found else None
+
+
+def _exc_aliases(program, path: str) -> Set[str]:
+    """Local names that refer to the exceptions module in ``path``
+    (``from ray_tpu import exceptions as exc`` / ``import
+    exceptions``)."""
+    out = set()
+    for local, target in program.import_modules.get(path, {}).items():
+        if target.rsplit(".", 1)[-1] == _EXC_MODULE_BASENAME:
+            out.add(local)
+    return out
+
+
+def _handler_classifies(meta: excflow.HandlerMeta,
+                        hierarchy: excflow.Hierarchy) -> bool:
+    """True when the handler body does anything that routes the typed
+    error onward: re-raises (bound or otherwise), isinstance-checks
+    the bound exception, or names a retriable type at all."""
+    if meta.can_reraise:
+        return True
+    for node in ast.walk(meta.node):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id == "isinstance" and meta.bound_name and \
+                any(isinstance(a, ast.Name) and a.id == meta.bound_name
+                    for a in node.args):
+            return True
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            term = dotted_name(node).rsplit(".", 1)[-1]
+            if term in RETRIABLE:
+                return True
+    return False
+
+
+@register
+class ExceptionFlowRule(Rule):
+    name = "exception-flow"
+    description = ("dead typed handlers, swallowed retriable errors, "
+                   "dropped in-band retry signals, unexported raises "
+                   "(whole-program raise-set inference)")
+
+    def setup(self, program) -> None:
+        self.program = program
+        self.hierarchy = excflow.excflow_hierarchy(program)
+        self.infos = excflow.infer_raise_sets(program)
+        self.exports = _exceptions_exports(program)
+        self.contracts = excflow.error_contracts(program)
+
+    # ------------------------------------------------------------ per-module
+
+    def collect(self, module: Module) -> Iterable[Violation]:
+        out: List[Violation] = []
+        if self.exports is not None:
+            aliases = _exc_aliases(self.program, module.path)
+            if aliases:
+                for node in ast.walk(module.tree):
+                    if isinstance(node, ast.Attribute) and \
+                            isinstance(node.value, ast.Name) and \
+                            node.value.id in aliases and \
+                            node.attr[0:1].isupper() and \
+                            node.attr not in self.exports:
+                        out.append(Violation(
+                            self.name, module.path, node.lineno,
+                            node.col_offset,
+                            f"[unknown-exc-attr] `{node.value.id}."
+                            f"{node.attr}` does not exist in the "
+                            f"exceptions module — this handler dies "
+                            f"with AttributeError the moment it fires"))
+        return out
+
+    # ------------------------------------------------------- whole-program
+
+    def finalize(self) -> Iterable[Violation]:
+        out: List[Violation] = []
+        for key in sorted(self.program.functions):
+            fi = self.program.functions[key]
+            out.extend(self._check_handlers(fi))
+            out.extend(self._check_unexported(fi))
+        out.extend(self._check_retry_signals())
+        return out
+
+    def _check_handlers(self, fi) -> Iterable[Violation]:
+        out: List[Violation] = []
+        for meta, reach, complete in excflow.handler_reach(
+                self.program, fi):
+            if meta.dynamic:
+                continue
+            if complete and not meta.broad:
+                for t in meta.types:
+                    if not self.hierarchy.project_typed(t):
+                        continue
+                    if not any(self.hierarchy.catches(t, r)
+                               for r in reach):
+                        out.append(Violation(
+                            self.name, fi.path, meta.node.lineno,
+                            meta.node.col_offset,
+                            f"[dead-handler] `except {t}` can never "
+                            f"fire: the try body provably cannot "
+                            f"raise it (raise-set: "
+                            f"{sorted(reach) or 'empty'}) — renamed "
+                            f"exception or stale recovery path"))
+            if meta.catches_broadly():
+                swallowed = sorted(
+                    r for r in reach
+                    if self.hierarchy.ancestors(r) & RETRIABLE)
+                if swallowed and not _handler_classifies(
+                        meta, self.hierarchy):
+                    out.append(Violation(
+                        self.name, fi.path, meta.node.lineno,
+                        meta.node.col_offset,
+                        f"[swallowed-retriable] broad except swallows "
+                        f"{', '.join(swallowed)} — the caller's retry "
+                        f"accounting never sees the failure; re-raise "
+                        f"or classify typed retriable errors"))
+        return out
+
+    def _check_unexported(self, fi) -> Iterable[Violation]:
+        if self.exports is None:
+            return ()
+        out: List[Violation] = []
+        events = getattr(self.program, "_excflow_events", {}).get(
+            (fi.path, fi.qualname), [])
+        for ev in events:
+            if ev.kind != "raise":
+                continue
+            for name in sorted(ev.names):
+                if name == excflow._PROJECT_ROOT_EXC:
+                    continue
+                if not self.hierarchy.project_typed(name):
+                    continue
+                if name in self.hierarchy.parents and \
+                        name not in self.exports:
+                    out.append(Violation(
+                        self.name, fi.path, ev.node.lineno,
+                        ev.node.col_offset,
+                        f"[unexported-raise] raises project-typed "
+                        f"`{name}` which exceptions.py does not "
+                        f"export — callers cannot catch it by name"))
+        return out
+
+    def _check_retry_signals(self) -> Iterable[Violation]:
+        out: List[Violation] = []
+        for cc in self.program.rpc.client_calls:
+            if cc.kind != "call" or not cc.awaited or \
+                    cc.in_function is None:
+                continue
+            contract = self.contracts.get(cc.method)
+            if contract is None or "retry_later" not in \
+                    contract["error_reply_keys"]:
+                continue
+            fi = cc.in_function
+            if self._consumes_signal(fi, cc):
+                continue
+            out.append(Violation(
+                self.name, cc.path, cc.lineno, cc.col,
+                f"[unconsumed-retry-signal] `{cc.method}` can reply "
+                f"retry_later (lease backpressure) but "
+                f"{fi.qualname} never reads a reply signal key and "
+                f"drops the reply — the backpressure signal is lost"))
+        return out
+
+    def _consumes_signal(self, fi, cc) -> bool:
+        """The enclosing function reads SOME in-band signal key, or
+        visibly hands the reply onward (returns/yields an expression
+        containing the call)."""
+        for node in body_nodes(fi.node):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and \
+                    node.value in _SIGNAL_KEYS:
+                return True
+        for node in ast.walk(fi.node):
+            if isinstance(node, (ast.Return, ast.Yield)) and \
+                    node.value is not None:
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Call) and \
+                            getattr(sub, "lineno", None) == cc.lineno \
+                            and sub.col_offset == cc.col:
+                        return True
+        return False

@@ -78,6 +78,10 @@ GENERATE = (
     "GetRpcTelemetry",
     "GrantLeaseCredits",
     "Heartbeat",
+    "KVDel",
+    "KVGet",
+    "KVKeys",
+    "KVPut",
     "RegisterNode",
     "ReleaseGangLease",
     "ReleaseGangMembers",
@@ -91,6 +95,7 @@ GENERATE = (
     "RingFinish",
     "RingInit",
     "RingStep",
+    "SealObject",
     "WorkerOOMKilled",
 )
 
@@ -110,6 +115,7 @@ OVERLAYS: Dict[str, dict] = {
 
 _LINT_DIR = os.path.dirname(os.path.abspath(__file__))
 GOLDEN_PATH = os.path.join(_LINT_DIR, "rpc_schemas_golden.json")
+CONTRACTS_PATH = os.path.join(_LINT_DIR, "error_contracts_golden.json")
 PROTOCOL_PATH = os.path.normpath(
     os.path.join(_LINT_DIR, os.pardir, "protocol.py"))
 
@@ -206,6 +212,30 @@ def spec_from_paths(paths: Sequence[str]) -> dict:
     from ray_tpu._private.lint.callgraph import build_program
     from ray_tpu._private.lint.engine import load_modules
     return build_spec(build_program(load_modules(paths)))
+
+
+def build_contracts(program) -> dict:
+    """The excflow error-contract table, path-normalized for golden
+    stability (same discipline as the schema golden: sorted, no line
+    numbers, checkout-relative handler paths)."""
+    from ray_tpu._private.lint.excflow import error_contracts
+    out = {}
+    for method, c in sorted(error_contracts(program).items()):
+        out[method] = {
+            "raises": list(c["raises"]),
+            "raises_complete": bool(c["raises_complete"]),
+            "stored": list(c["stored"]),
+            "error_reply_keys": list(c["error_reply_keys"]),
+            "handlers": sorted(_norm_path(h) for h in c["handlers"]),
+        }
+    return out
+
+
+def emit_contracts(contracts: dict,
+                   version: int = PROTOCOL_VERSION) -> str:
+    return json.dumps(
+        {"protocol_version": version, "contracts": contracts},
+        indent=2, sort_keys=True) + "\n"
 
 
 def spec_from_snapshot(snapshot: dict) -> dict:
@@ -535,7 +565,8 @@ def _diff(expected: str, actual: str, what: str) -> List[str]:
 
 def check_program(program, golden_path: str = GOLDEN_PATH,
                   protocol_path: str = PROTOCOL_PATH,
-                  generate: Optional[Sequence[str]] = None) -> List[str]:
+                  generate: Optional[Sequence[str]] = None,
+                  contracts_path: str = CONTRACTS_PATH) -> List[str]:
     """Drift findings for an already-built Program; [] = in sync."""
     findings: List[str] = []
     try:
@@ -556,6 +587,22 @@ def check_program(program, golden_path: str = GOLDEN_PATH,
             f"the schemas inferred from the handlers")
         findings.extend(_diff(expected_golden, golden_text,
                               os.path.basename(golden_path)))
+    # Third artifact: the error-contract table. Adding a raise to (or
+    # removing one from) a handler's escaping raise-set without
+    # regenerating is drift exactly like a schema edit.
+    expected_contracts = emit_contracts(build_contracts(program),
+                                        PROTOCOL_VERSION)
+    try:
+        with open(contracts_path, "r", encoding="utf-8") as f:
+            contracts_text = f.read()
+    except OSError:
+        contracts_text = ""
+    if contracts_text != expected_contracts:
+        findings.append(
+            f"error-contract golden is stale: {contracts_path} no "
+            f"longer matches the raise-sets inferred from the handlers")
+        findings.extend(_diff(expected_contracts, contracts_text,
+                              os.path.basename(contracts_path)))
     try:
         expected_proto = emit_protocol(
             spec, PROTOCOL_VERSION,
@@ -647,9 +694,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               f"(protocol version {PROTOCOL_VERSION})")
         return 0
 
-    spec = spec_from_paths(paths)
+    from ray_tpu._private.lint.callgraph import build_program
+    from ray_tpu._private.lint.engine import load_modules
+    program = build_program(load_modules(paths))
+    spec = build_spec(program)
     source = emit_protocol(spec)
     golden = emit_golden(spec)
+    contracts = emit_contracts(build_contracts(program))
     if args.stdout:
         sys.stdout.write(source)
         return 0
@@ -657,9 +708,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         f.write(source)
     with open(GOLDEN_PATH, "w", encoding="utf-8") as f:
         f.write(golden)
+    with open(CONTRACTS_PATH, "w", encoding="utf-8") as f:
+        f.write(contracts)
+    n_contracts = contracts.count('"handlers"')
     print(f"schemagen: wrote {PROTOCOL_PATH} "
-          f"({len([m for m in GENERATE if m in spec])} methods) and "
-          f"{GOLDEN_PATH} ({len(spec)} schemas, "
+          f"({len([m for m in GENERATE if m in spec])} methods), "
+          f"{GOLDEN_PATH} ({len(spec)} schemas) and "
+          f"{CONTRACTS_PATH} ({n_contracts} error contracts, "
           f"protocol version {PROTOCOL_VERSION})")
     return 0
 

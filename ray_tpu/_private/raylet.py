@@ -2484,19 +2484,22 @@ class Raylet:
     # --------------------------------------------------------- object plane
 
     async def handle_seal_object(self, conn, header, bufs):
-        oid = ObjectID(header["object_id"])
+        req = protocol.SealObjectRequest.from_header(header)
+        oid = ObjectID(req.object_id)
         # "shard": DistributedArray placement attrs (rank / mesh
         # coords), folded into the SEALED object-plane record so
         # state.list_objects() shows where each shard landed
-        ok = self.store.seal(oid, header["segment"], header["size"],
-                             attrs=header.get("shard"))
-        if ok and header.get("pin", False):
+        ok = self.store.seal(oid, req.segment, req.size,
+                             attrs=req.get("shard"))
+        if ok and req.get("pin", False):
             self.store.pin(oid)
-        if ok and header.get("owner_address"):
+        owner_address = req.get("owner_address")
+        if ok and owner_address:
             # leak-detector owner index: the sweep probes this owner's
             # live references against the stored segment
-            self._object_owners[oid.binary()] = header["owner_address"]
-        return {"ok": ok, "node_id": self.node_id.binary()}
+            self._object_owners[oid.binary()] = owner_address
+        return protocol.SealObjectReply(
+            ok=ok, node_id=self.node_id.binary()).to_header()
 
     async def handle_alloc_segment(self, conn, header, bufs):
         """Lease a recycled warm segment to a writing client (zero-copy

@@ -25,7 +25,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu import exceptions as exc
-from ray_tpu._private import faultpoints, rpc
+from ray_tpu._private import faultpoints, protocol, rpc
 from ray_tpu._private import runtime_env as runtime_env_mod
 from ray_tpu._private.core_worker import CoreWorker
 from ray_tpu._private.ids import ObjectID, TaskID
@@ -608,10 +608,10 @@ class TaskExecutor:
                 # owner), not this executing worker — the raylet's
                 # leak detector probes the owner's live references
                 reply, _ = self.core._run(self.core.raylet_conn.call(
-                    "SealObject", {"object_id": oid_b,
-                                   "segment": segment, "size": size,
-                                   "pin": True,
-                                   "owner_address": spec.owner_address}))
+                    "SealObject", protocol.SealObjectRequest(
+                        object_id=oid_b, segment=segment, size=size,
+                        pin=True,
+                        owner_address=spec.owner_address).to_header()))
                 if not reply.get("ok"):
                     return self._error_reply(spec, exc.ObjectStoreFullError(
                         f"return {i} of {spec.name} ({size}B) doesn't fit"))

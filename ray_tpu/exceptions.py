@@ -25,7 +25,11 @@ class RayTaskError(RayTpuError):
         self.function_name = function_name
         self.traceback_str = traceback_str
         self.cause = cause
-        super().__init__(function_name, traceback_str)
+        # Exception directly, NOT super(): as_instanceof_cause builds a
+        # (RayTaskError, cause_cls) diamond, and the cooperative chain
+        # would feed these two positional strings into cause_cls.__init__
+        # (ValueError from dict("traceback...") for cause-bearing types).
+        Exception.__init__(self, function_name, traceback_str)
 
     def __str__(self):
         msg = f"task {self.function_name} failed"
@@ -49,6 +53,12 @@ class RayTaskError(RayTpuError):
                 {"__init__": RayTaskError.__init__, "__str__": RayTaskError.__str__},
             )
             err = derived(self.function_name, self.traceback_str, cause)
+            # The wrapper IS an instance of the cause's type, so it
+            # must answer for its attributes too (cause_info /
+            # cause_kind / object_id_hex ...): cause_cls.__init__ never
+            # ran on it, so graft the cause's state across.
+            for k, v in vars(cause).items():
+                err.__dict__.setdefault(k, v)
             return err
         except TypeError:
             return self

@@ -17,7 +17,7 @@ import hashlib
 import threading
 from typing import Any, Dict, List, Optional, Sequence
 
-from ray_tpu._private import rpc
+from ray_tpu._private import protocol, rpc
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu.util.client.common import dumps_args
@@ -255,10 +255,13 @@ class ClientCore:
         return reply
 
     def _kv_put_sync(self, key: bytes, value: bytes):
-        self._run(self._gcs_call("KVPut", {"key": key}, bufs=[value]))
+        self._run(self._gcs_call(
+            "KVPut", protocol.KVPutRequest(key=key).to_header(),
+            bufs=[value]))
 
     def _kv_get_sync(self, key: bytes):
-        header, bufs = self._run(self._gcs_call("KVGet", {"key": key}))
+        header, bufs = self._run(self._gcs_call(
+            "KVGet", protocol.KVGetRequest(key=key).to_header()))
         return bufs[0] if header.get("found") else None
 
     # ------------------------------------------------------- lifecycle

@@ -16,6 +16,7 @@ import time
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 from ray_tpu import exceptions as exc
+from ray_tpu._private import protocol
 from ray_tpu._private.config import RayTpuConfig, get_config, set_config
 from ray_tpu._private.ids import ActorID, JobID, NodeID, TaskID, WorkerID
 from ray_tpu._private.object_ref import ObjectRef
@@ -341,25 +342,29 @@ def experimental_internal_kv_put(key: bytes, value: bytes,
     """Cluster-wide KV (reference: ray.experimental.internal_kv)."""
     w = _require_connected()
     reply, _ = w.core._run(w.core._gcs_call(
-        "KVPut", {"key": key, "overwrite": overwrite}, bufs=[value]))
+        "KVPut", protocol.KVPutRequest(
+            key=key, overwrite=overwrite).to_header(), bufs=[value]))
     return reply["added"]
 
 
 def experimental_internal_kv_get(key: bytes) -> Optional[bytes]:
     w = _require_connected()
-    reply, bufs = w.core._run(w.core._gcs_call("KVGet", {"key": key}))
+    reply, bufs = w.core._run(w.core._gcs_call(
+        "KVGet", protocol.KVGetRequest(key=key).to_header()))
     return bufs[0] if reply.get("found") else None
 
 
 def experimental_internal_kv_del(key: bytes) -> bool:
     w = _require_connected()
-    reply, _ = w.core._run(w.core._gcs_call("KVDel", {"key": key}))
+    reply, _ = w.core._run(w.core._gcs_call(
+        "KVDel", protocol.KVDelRequest(key=key).to_header()))
     return reply["deleted"]
 
 
 def experimental_internal_kv_list(prefix: bytes = b"") -> List[bytes]:
     w = _require_connected()
-    reply, _ = w.core._run(w.core._gcs_call("KVKeys", {"prefix": prefix}))
+    reply, _ = w.core._run(w.core._gcs_call(
+        "KVKeys", protocol.KVKeysRequest(prefix=prefix).to_header()))
     return reply["keys"]
 
 

@@ -162,28 +162,34 @@ def _fixture_program(src):
 
 
 class TestDriftGate:
-    def test_in_sync_fixture_tree_passes(self, tmp_path):
-        spec = schemagen.build_spec(_fixture_program(FIXTURE_SRC))
+    def _emit_artifacts(self, tmp_path, src=FIXTURE_SRC):
+        # All three generated artifacts, from the same fixture program
+        # (v5 added the error-contract golden next to the schema one).
+        prog = _fixture_program(src)
+        spec = schemagen.build_spec(prog)
         golden = tmp_path / "golden.json"
         proto = tmp_path / "protocol.py"
+        contracts = tmp_path / "contracts.json"
         golden.write_text(schemagen.emit_golden(spec))
         proto.write_text(schemagen.emit_protocol(spec, generate=["Frob"]))
+        contracts.write_text(
+            schemagen.emit_contracts(schemagen.build_contracts(prog)))
+        return str(golden), str(proto), str(contracts)
+
+    def test_in_sync_fixture_tree_passes(self, tmp_path):
+        golden, proto, contracts = self._emit_artifacts(tmp_path)
         findings = schemagen.check_program(
-            _fixture_program(FIXTURE_SRC), str(golden), str(proto),
-            generate=["Frob"])
+            _fixture_program(FIXTURE_SRC), golden, proto,
+            generate=["Frob"], contracts_path=contracts)
         assert findings == []
 
     def test_unregenerated_handler_edit_fails_with_diff(self, tmp_path):
-        spec = schemagen.build_spec(_fixture_program(FIXTURE_SRC))
-        golden = tmp_path / "golden.json"
-        proto = tmp_path / "protocol.py"
-        golden.write_text(schemagen.emit_golden(spec))
-        proto.write_text(schemagen.emit_protocol(spec, generate=["Frob"]))
+        golden, proto, contracts = self._emit_artifacts(tmp_path)
         edited = FIXTURE_SRC.replace('header["alpha"]',
                                      'header["gamma"]')
         findings = schemagen.check_program(
-            _fixture_program(edited), str(golden), str(proto),
-            generate=["Frob"])
+            _fixture_program(edited), golden, proto,
+            generate=["Frob"], contracts_path=contracts)
         text = "\n".join(findings)
         assert "stale" in text
         assert "gamma" in text          # the diff names the drifted key
