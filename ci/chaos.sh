@@ -13,7 +13,11 @@
 # failure, epoch-fenced reform, pool reclaim, zero leaked objects),
 # and the ring_kill soak (abruptly kill a ring-collective peer
 # mid-all_reduce: exact fallback value or typed error, RingAbort
-# drains every survivor, gang fence intact, zero leaked segments/fds).
+# drains every survivor, gang fence intact, zero leaked segments/fds),
+# and the replica_kill soak (SIGKILL a serve replica mid-request:
+# idempotent requests retry onto a peer, non-idempotent fail typed,
+# the controller's health loop restores the replica count, and the
+# in-flight zero-copy ingress segments leak nothing).
 # Runs the slow-marked schedules too (tier-1 carries only
 # the 2-schedule smoke); any invariant violation (pull hang, admission
 # budget leak, segment-lease leak, a leak-detector-flagged object

@@ -280,6 +280,50 @@ class RayTpuConfig:
     retry_backoff_cap_s: float = 2.0
     retry_backoff_multiplier: float = 2.0
 
+    # --- serving (ray_tpu/serve) ---
+    # Request/response bodies at or above this size (bytes) cross the
+    # proxy->replica boundary BY REFERENCE: the HTTP proxy writes the
+    # body straight into shm through the AllocSegment lease path
+    # (core_worker.put_async — the same recycled-segment pipeline as
+    # any large put) and ships an ObjectRef, so a 100 MB upload costs
+    # one shm fill instead of riding the pickle lane through the
+    # control plane. Bodies below the threshold stay inline (a ref
+    # round trip costs more than a small copy). 0 disables the shm
+    # ingress path entirely. Large replica RETURNS need no knob: the
+    # task-return plane already seals them into the store.
+    serve_ingress_shm_threshold: int = 64 * 1024
+    # Per-replica queue-depth cap, enforced replica-side on top of the
+    # router's max_concurrent_queries flow control: a replica that
+    # somehow accumulates more than max_concurrent_queries +
+    # serve_max_queue_depth in-flight calls (several independent
+    # routers, a handle that bypassed flow control) sheds the excess
+    # with the typed ServeOverloadedError instead of queueing without
+    # bound. Also the default queue cap of a DecodeScheduler built by
+    # a replica that doesn't pass its own.
+    serve_max_queue_depth: int = 16
+    # The proxy's admission-controller queue budget, as a multiple of
+    # the deployment's dispatch capacity (replicas x
+    # max_concurrent_queries): once waiting + in-flight requests reach
+    # capacity x this factor, new requests are shed at the door with
+    # 503 + Retry-After (the serving analog of the lease plane's
+    # retry_later) instead of joining a backlog the replicas can never
+    # drain. 2.0 = allow one full batch queued behind the one in
+    # flight. Must be >= 1; larger values trade shed rate for queueing
+    # latency.
+    serve_shed_queue_factor: float = 2.0
+    # Optional latency half of the SLO budget (seconds; 0 = queue-only
+    # shedding): when set, the proxy also sheds while the deployment's
+    # observed p99 (rolling per-proxy reservoir, fed to the metrics
+    # registry as ray_tpu_serve_request_seconds) exceeds this budget
+    # AND every replica slot is busy — a saturated deployment with
+    # degraded tails sheds before the backlog doubles the damage.
+    serve_shed_p99_budget_s: float = 0.0
+    # Floor (seconds) of the Retry-After hint on shed responses. The
+    # proxy scales the hint with the observed backlog (queue depth x
+    # mean latency / capacity, capped at 30 s); this knob is the
+    # minimum — and the whole hint when no latency samples exist yet.
+    serve_retry_after_s: float = 1.0
+
     # --- observability ---
     event_log_enabled: bool = True
     metrics_report_period_ms: int = 2000

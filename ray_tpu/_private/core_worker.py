@@ -20,6 +20,7 @@ asyncio IO loop, the analog of the reference core worker's io_service.
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import itertools
 import logging
 import os
@@ -1167,6 +1168,37 @@ class CoreWorker:
         self._run(self._put_serialized(oid, serialized))
         return ObjectRef(oid, owner_address=self.address, worker=self,
                          call_site="put")
+
+    def put_async(self, value: Any):
+        """Non-blocking put for async-actor callers — the HTTP proxy's
+        zero-copy ingress. ``put`` blocks its calling thread on the IO
+        loop's seal round trip, which inside an async actor would stall
+        the user loop and every other request coroutine on it; here the
+        serialize happens on the calling thread (bytes bodies are
+        META_RAW: no copy) and the AllocSegment-lease write + seal are
+        scheduled onto the IO loop. Returns ``(ref, done)`` where
+        ``done`` is a concurrent.futures.Future the caller must await
+        (``asyncio.wrap_future``) before shipping the ref — a failed
+        seal (store full) surfaces there, typed."""
+        serialized = self.serialization_context.serialize(value)
+        oid = self._next_put_id()
+        self.stats["puts"] += 1
+        if serialized.total_bytes() <= \
+                self.config.max_direct_call_object_size:
+            self.reference_counter.add_owned_with_local_ref(oid)
+            if serialized.contained_refs:
+                self.reference_counter.add_contained_refs(
+                    oid, serialized.contained_refs)
+            self.memory_store.put(oid, serialized)
+            done: "concurrent.futures.Future" = concurrent.futures.Future()
+            done.set_result(None)
+            return ObjectRef(oid, owner_address=self.address, worker=self,
+                             call_site="put",
+                             skip_adding_local_ref=True), done
+        done = asyncio.run_coroutine_threadsafe(
+            self._put_serialized(oid, serialized), self.loop)
+        return ObjectRef(oid, owner_address=self.address, worker=self,
+                         call_site="put"), done
 
     def _next_put_id(self) -> ObjectID:
         # Put ids live in the current task's index space after returns

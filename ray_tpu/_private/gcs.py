@@ -416,7 +416,8 @@ class GcsServer:
             else:
                 body = (b"ray_tpu head: status page at /; scrape /metrics; "
                         b"dashboard API under /api/ (nodes|actors|jobs|"
-                        b"cluster|placement_groups|metrics|logs|stacks)\n")
+                        b"cluster|placement_groups|metrics|logs|stacks|"
+                        b"serve)\n")
                 status, ctype = b"200 OK", b"text/plain"
             writer.write(b"HTTP/1.1 " + status +
                          b"\r\nContent-Type: " + ctype +
@@ -589,6 +590,43 @@ class GcsServer:
                     node=params.get("node"),
                     limit=limit),
                 "summary": self.cluster_events.summary(),
+            })
+        if route == "/api/serve":
+            # serving front door: the controller's published deployment
+            # view (GCS KV, see serve/controller.py SERVE_STATE_KEY)
+            # joined with the per-router serve metrics. Gauges sum
+            # across routers (each router owns its label set; the
+            # cluster view is the total queue/in-flight).
+            state = {}
+            raw = self.kv.get(b"serve:state")
+            if raw:
+                try:
+                    state = json.loads(raw)
+                except ValueError:
+                    state = {"error": "unparseable serve:state"}
+            merged = self._merged_metrics()
+            per_dep: Dict[str, Dict[str, float]] = {}
+            gauge_of = {"ray_tpu_serve_inflight": "inflight",
+                        "ray_tpu_serve_queue_depth": "queue_depth"}
+            counter_of = {"ray_tpu_serve_requests_total": "requests",
+                          "ray_tpu_serve_shed_total": "shed",
+                          "ray_tpu_serve_ingress_shm_total":
+                              "ingress_shm"}
+            for metric, field in {**gauge_of, **counter_of}.items():
+                m = merged.get(metric)
+                if not m:
+                    continue
+                for pairs, value in m["values"]:
+                    labels = dict(tuple(p) for p in pairs)
+                    dep = labels.get("deployment", "")
+                    row = per_dep.setdefault(dep, {})
+                    row[field] = row.get(field, 0.0) + value
+            lat = merged.get("ray_tpu_serve_request_seconds")
+            return dump({
+                "routes": state.get("routes", {}),
+                "deployments": state.get("deployments", {}),
+                "load": per_dep,
+                "latency_histogram": lat,
             })
         if route == "/api/rpc":
             # the control-plane flight recorder: per-(reporter, side,

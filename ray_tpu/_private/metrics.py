@@ -181,6 +181,55 @@ def core_reporter() -> bool:
     return _CORE_REPORTER
 
 
+# ---------------------------------------------------------- serve plane
+
+_SERVE: "Optional[Dict[str, Metric]]" = None
+_SERVE_LOCK = threading.Lock()
+
+
+def serve_metrics() -> Dict[str, Metric]:
+    """Serving-plane instruments, created lazily in whichever process
+    routes serve traffic (HTTP proxy actor, driver-side handles,
+    replicas) and shipped by that process's normal metrics loop.
+
+    Gauges carry a ``router`` label alongside ``deployment`` because
+    gauge merging is last-writer-wins per label set: two routers of the
+    same deployment must not overwrite each other's queue view. The
+    cluster rollup (``/api/serve``) sums across routers.
+    """
+    global _SERVE
+    with _SERVE_LOCK:
+        if _SERVE is None:
+            _SERVE = {
+                # requests dispatched to a replica, not yet replied
+                "inflight": Gauge(
+                    "ray_tpu_serve_inflight",
+                    "In-flight requests per deployment router"),
+                # requests waiting for a free replica slot
+                "queue_depth": Gauge(
+                    "ray_tpu_serve_queue_depth",
+                    "Requests queued for a free replica slot per "
+                    "deployment router"),
+                "requests": Counter(
+                    "ray_tpu_serve_requests_total",
+                    "HTTP requests accepted per deployment"),
+                "shed": Counter(
+                    "ray_tpu_serve_shed_total",
+                    "Requests shed at admission (503 + Retry-After) "
+                    "per deployment"),
+                "ingress_shm": Counter(
+                    "ray_tpu_serve_ingress_shm_total",
+                    "Request bodies ingested by shm reference instead "
+                    "of the pickle lane"),
+                "latency": Histogram(
+                    "ray_tpu_serve_request_seconds",
+                    "End-to-end proxy request latency (s)",
+                    boundaries=(0.005, 0.02, 0.05, 0.1, 0.25, 0.5,
+                                1.0, 2.5, 5.0, 10.0, 30.0)),
+            }
+        return _SERVE
+
+
 # ------------------------------------------------------------- rendering
 
 def _escape_label(value) -> str:

@@ -187,6 +187,26 @@ class PendingCallsLimitExceeded(RayTpuError):
     """Actor max_pending_calls exceeded."""
 
 
+class ServeOverloadedError(RayTpuError):
+    """Serve shed the request at admission instead of queueing it.
+
+    The serving plane's typed analog of the lease protocol's
+    ``retry_later`` backpressure verdict: a replica's queue-depth cap or
+    the proxy's SLO budget (queue depth x observed latency) was
+    exceeded, so the request was refused AT THE DOOR — the in-flight
+    decode batch keeps its cadence instead of collapsing under a
+    backlog it can never drain. ``retry_after_s`` is the server's
+    backoff hint; the HTTP proxy renders this error as
+    ``503 Service Unavailable`` with a ``Retry-After`` header.
+    """
+
+    def __init__(self, reason: str = "serve overloaded",
+                 retry_after_s: float = 1.0):
+        self.reason = reason
+        self.retry_after_s = float(retry_after_s)
+        super().__init__(reason)
+
+
 class AsyncioActorExit(RayTpuError):
     """Raised inside an async actor to exit it gracefully."""
 

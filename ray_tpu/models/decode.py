@@ -155,6 +155,133 @@ def _decode_step_jit(params, cache: Dict, token,
     return logits, {"k": ck, "v": cv, "pos": pos + 1}
 
 
+# ------------------------------------------------------------- slot cache
+# Continuous batching (serve/decode_scheduler.py) needs per-SLOT decode
+# offsets: one sequence prefills into an open batch row while the other
+# rows keep stepping, and a finished row frees immediately. The whole-
+# batch cache above carries a single scalar ``pos``; these variants
+# carry ``pos: [slots]`` and mask per row. Invariants the scheduler
+# relies on:
+#
+# * ``slot_prefill`` rewrites rows [0, T0) of its slot and resets that
+#   slot's pos, so a reused slot never sees its predecessor's K/V — the
+#   stale tail beyond T0 is always overwritten (step s writes position
+#   pos BEFORE attending it) and never attended.
+# * ``slot_decode_step`` writes every row's K/V unconditionally (a
+#   masked write would cost a gather per layer) but advances ``pos``
+#   only where ``active``: an inactive row's cache may take garbage at
+#   its frozen pos, which is sound because inactive rows are only ever
+#   re-entered through ``slot_prefill``.
+
+
+def init_slot_cache(cfg: TransformerConfig, slots: int,
+                    max_len: int) -> Dict:
+    """KV cache with an independent decode offset per batch row."""
+    shape = (cfg.n_layers, slots, max_len, cfg.n_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype),
+            "pos": jnp.zeros((slots,), jnp.int32)}
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def slot_prefill(params, tokens, cache: Dict, slot,
+                 cfg: TransformerConfig) -> Tuple[jnp.ndarray, Dict]:
+    """Run one prompt [1, T0] through the stack, writing each layer's
+    K/V into cache row ``slot`` (a traced index: one compiled program
+    serves every slot). Returns (last-token logits [1, V], cache).
+    Compiles once per distinct T0 — serving callers should bucket or
+    pad prompt lengths if retrace cost matters."""
+    _, T0 = tokens.shape
+    max_len = cache["k"].shape[2]
+    cos, sin = rope_frequencies(cfg.head_dim, max_len,
+                                theta=cfg.rope_theta)
+    positions = jnp.arange(T0)
+    x = params["embed"][tokens]
+
+    def body(x, layer_in):
+        lp, ck, cv = layer_in  # ck/cv: [slots, max_len, H, Dh]
+        h = rmsnorm(x, lp["attn_norm"])
+        q, k, v = _qkv(lp, h, cfg.head_dim)
+        q = apply_rotary(q, cos, sin, positions=positions)
+        k = apply_rotary(k, cos, sin, positions=positions)
+        o = flash_attention(q, k, v, causal=True).reshape(1, T0, -1)
+        x = x + (o @ lp["wo"]).astype(x.dtype)
+        x = _mlp(lp, x)
+        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                      (slot, 0, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                      (slot, 0, 0, 0))
+        return x, (ck, cv)
+
+    x, (ck, cv) = lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rmsnorm(x, params["final_norm"])
+    logits = (x[:, -1] @ params["embed"].T.astype(x.dtype)
+              ).astype(jnp.float32)
+    return logits, {"k": ck, "v": cv,
+                    "pos": cache["pos"].at[slot].set(T0)}
+
+
+def _rotary_rows(x, cos, sin, pos):
+    """apply_rotary for per-ROW positions: x [B, 1, H, D], pos [B].
+    (ops.rotary broadcasts one [T] position vector over the batch; a
+    continuous batch has every row at a different offset.)"""
+    c = cos[pos][:, None, None, :]
+    s = sin[pos][:, None, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def slot_decode_step(params, cache: Dict, token, active,
+                     cfg: TransformerConfig) -> Tuple[jnp.ndarray, Dict]:
+    """One continuous-batching step: token [B] in, next-token logits
+    [B, V] out; each ACTIVE row attends its own prefix (per-row
+    position mask) and advances its own pos. Inactive rows are free
+    riders — their logits are garbage and their pos is frozen."""
+    B = token.shape[0]
+    max_len = cache["k"].shape[2]
+    pos = cache["pos"]  # [B]
+    cos, sin = rope_frequencies(cfg.head_dim, max_len,
+                                theta=cfg.rope_theta)
+    x = params["embed"][token][:, None, :]  # [B, 1, D]
+    sm_scale = cfg.head_dim ** -0.5
+    # row r attends positions [0, pos[r]] (pos[r] is written this step)
+    valid = (jnp.arange(max_len)[None, None, :]
+             <= pos[:, None, None])  # [B, 1, Tmax]
+    rows = jnp.arange(B)
+
+    def body(x, layer_in):
+        lp, ck, cv = layer_in
+        h = rmsnorm(x, lp["attn_norm"])
+        q, k, v = _qkv(lp, h, cfg.head_dim)
+        q = _rotary_rows(q, cos, sin, pos)
+        k = _rotary_rows(k, cos, sin, pos)
+        ck = ck.at[rows, pos].set(k[:, 0].astype(ck.dtype))
+        cv = cv.at[rows, pos].set(v[:, 0].astype(cv.dtype))
+        s = jnp.einsum("bhd,bkhd->bhk", q[:, 0], ck,
+                       preferred_element_type=jnp.float32) * sm_scale
+        s = jnp.where(valid, s, -jnp.inf)
+        # accumulation dtypes bit-match _decode_step_jit so a batch of
+        # one slot reproduces the whole-batch decode exactly
+        p = jax.nn.softmax(s, axis=-1).astype(cv.dtype)
+        o = jnp.einsum("bhk,bkhd->bhd", p, cv,
+                       preferred_element_type=jnp.float32
+                       ).astype(q.dtype)
+        x = x + (o.reshape(B, 1, -1) @ lp["wo"]).astype(x.dtype)
+        x = _mlp(lp, x)
+        return x, (ck, cv)
+
+    x, (ck, cv) = lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rmsnorm(x[:, 0], params["final_norm"])
+    logits = (x @ params["embed"].T.astype(x.dtype)
+              ).astype(jnp.float32)
+    new_pos = jnp.where(active, pos + 1, pos)
+    return logits, {"k": ck, "v": cv, "pos": new_pos}
+
+
 @functools.partial(jax.jit,
                    static_argnames=("cfg", "steps", "sample"))
 def _decode_loop(params, logits, cache, key, temperature, *, cfg,

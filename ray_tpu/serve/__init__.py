@@ -34,6 +34,8 @@ from typing import Any, Callable, Dict, List, Optional
 import ray_tpu
 from ray_tpu.serve.batching import batch  # noqa: F401
 from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
+from ray_tpu.serve.decode_scheduler import (DecodeScheduler,  # noqa: F401
+                                            JaxSlotEngine)
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.http_proxy import (HTTPProxy, HTTPRequest, HTTPResponse,
                                       PROXY_NAME)
@@ -41,7 +43,7 @@ from ray_tpu.serve.http_proxy import (HTTPProxy, HTTPRequest, HTTPResponse,
 __all__ = [
     "start", "shutdown", "deployment", "get_deployment",
     "list_deployments", "DeploymentHandle", "HTTPRequest", "HTTPResponse",
-    "get_http_address", "batch",
+    "get_http_address", "batch", "DecodeScheduler", "JaxSlotEngine",
 ]
 
 _controller = None

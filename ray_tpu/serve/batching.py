@@ -32,28 +32,44 @@ import functools
 from typing import Any, Callable, List, Optional
 
 from ray_tpu._private import rpc
+from ray_tpu.exceptions import ServeOverloadedError
 
 
 class _BatchQueue:
     """Pending calls for one batched function (per bound instance)."""
 
     def __init__(self, fn: Callable, max_batch_size: int,
-                 timeout_s: float):
+                 timeout_s: float, max_pending: int = 0):
         self.fn = fn
         self.max_batch_size = max_batch_size
         self.timeout_s = timeout_s
+        self.max_pending = max_pending
+        self.outstanding = 0   # submitted, not yet resolved
+        self.num_shed = 0
         self.pending: List[tuple] = []  # (request, future)
         self._timer: Optional[asyncio.TimerHandle] = None
 
     async def submit(self, request: Any):
+        if self.max_pending and self.outstanding >= self.max_pending:
+            # Shed, typed, instead of queueing a request behind more
+            # batches than the SLO can absorb — the proxy renders this
+            # as 503 + Retry-After like every other overload signal.
+            self.num_shed += 1
+            raise ServeOverloadedError(
+                f"batch queue full ({self.outstanding} outstanding, cap "
+                f"{self.max_pending})")
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
         self.pending.append((request, fut))
+        self.outstanding += 1
         if len(self.pending) >= self.max_batch_size:
             self._flush()
         elif self._timer is None:
             self._timer = loop.call_later(self.timeout_s, self._flush)
-        return await fut
+        try:
+            return await fut
+        finally:
+            self.outstanding -= 1
 
     def _flush(self) -> None:
         if self._timer is not None:
@@ -94,13 +110,20 @@ class _BatchQueue:
 
 
 def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 10,
-          batch_wait_timeout_s: float = 0.01):
+          batch_wait_timeout_s: float = 0.01, max_pending: int = 0):
     """``@serve.batch`` / ``@serve.batch(max_batch_size=...,
-    batch_wait_timeout_s=...)`` on an async function or method."""
+    batch_wait_timeout_s=...)`` on an async function or method.
+
+    ``max_pending`` (0 = unbounded, the default) caps submitted-but-
+    unresolved calls per queue; past it ``submit`` sheds with the typed
+    :class:`~ray_tpu.exceptions.ServeOverloadedError` instead of
+    stacking batches the device can never drain inside the SLO."""
     if max_batch_size < 1:
         raise ValueError("max_batch_size must be >= 1")
     if batch_wait_timeout_s < 0:
         raise ValueError("batch_wait_timeout_s must be >= 0")
+    if max_pending < 0:
+        raise ValueError("max_pending must be >= 0")
 
     def decorate(fn: Callable) -> Callable:
         if not asyncio.iscoroutinefunction(fn):
@@ -128,7 +151,7 @@ def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 10,
                 bound = fn if instance is None \
                     else functools.partial(fn, instance)
                 q = _BatchQueue(bound, max_batch_size,
-                                batch_wait_timeout_s)
+                                batch_wait_timeout_s, max_pending)
                 setattr(holder, qattr, q)
             return await q.submit(request)
 

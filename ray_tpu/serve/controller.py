@@ -22,6 +22,13 @@ CONTROLLER_NAME = "SERVE_CONTROLLER"
 SNAPSHOT_KEY = "replicas:{name}"  # long-poll key per deployment
 ROUTES_KEY = "routes"             # long-poll key for the HTTP route table
 REPLICA_STARTUP_TIMEOUT_S = 60.0
+# Cadence of the replica health loop (a crashed replica is detected,
+# dropped from router membership, and replaced within ~one period).
+HEALTH_CHECK_PERIOD_S = 0.5
+# GCS internal-KV key the controller publishes its deployment/replica
+# view under, so the dashboard's /api/serve renders without an RPC to
+# this actor (the GCS process has no worker to call actors with).
+SERVE_STATE_KEY = b"serve:state"
 
 
 async def _as_coro(ref):
@@ -47,6 +54,7 @@ class ServeController:
         # (reference: autoscaling_policy.py BasicAutoscalingPolicy)
         self._scale_counters: Dict[str, int] = {}
         self._autoscale_task: Optional[asyncio.Task] = None
+        self._health_task: Optional[asyncio.Task] = None
 
     # ---- long-poll host passthrough (routers call this) ----
 
@@ -104,6 +112,9 @@ class ServeController:
             if self._autoscale_task is None or self._autoscale_task.done():
                 self._autoscale_task = asyncio.get_running_loop().\
                     create_task(self._autoscale_loop())
+        if self._health_task is None or self._health_task.done():
+            self._health_task = asyncio.get_running_loop().create_task(
+                self._health_loop())
         # Reconcile BEFORE announcing the route: when the proxy learns a
         # new route and bootstraps its replica snapshot, replicas must
         # already be serving (reference ordering: backend_state goal
@@ -128,6 +139,42 @@ class ServeController:
 
     async def _notify_routes(self) -> None:
         await self._host.notify_changed(ROUTES_KEY, await self.get_routes())
+        self._publish_state()
+
+    def _publish_state(self) -> None:
+        """Mirror the deployment/replica view into the GCS internal KV
+        (fire-and-forget). The dashboard's /api/serve reads it there and
+        joins it with the serve metrics — same pattern as tracing's span
+        export (util/tracing.py)."""
+        import json
+
+        try:
+            import ray_tpu.worker as worker_mod
+            core = worker_mod.global_worker.core
+        except Exception:  # noqa: BLE001 — unit harness without a
+            return         # worker: nothing to publish to
+        state = {
+            "routes": {cfg["route_prefix"]: name
+                       for name, cfg in self._configs.items()
+                       if cfg.get("route_prefix")},
+            "deployments": {
+                name: {
+                    "num_replicas": cfg["num_replicas"],
+                    "max_concurrent_queries":
+                        cfg["max_concurrent_queries"],
+                    "version": cfg["version"],
+                    "route_prefix": cfg.get("route_prefix"),
+                    "autoscaling": bool(cfg.get("autoscaling_config")),
+                    "replicas": [r["id"] for r in
+                                 self._replicas.get(name, [])],
+                } for name, cfg in self._configs.items()
+            },
+        }
+        try:
+            core.kv_put_nowait(SERVE_STATE_KEY,
+                               json.dumps(state).encode())
+        except Exception:  # noqa: BLE001 — telemetry export must never
+            pass           # fail a deploy/reconcile
 
     async def get_deployment_info(self, name: str) -> Optional[dict]:
         cfg = self._configs.get(name)
@@ -164,6 +211,7 @@ class ServeController:
     async def _notify(self, name: str) -> None:
         await self._host.notify_changed(
             SNAPSHOT_KEY.format(name=name), self._snapshot(name))
+        self._publish_state()
 
     async def _reconcile(self, name: str) -> None:
         # Serialize reconciles per deployment; concurrent deploy() calls
@@ -200,7 +248,8 @@ class ServeController:
             opts.setdefault("max_concurrency",
                             max(cfg["max_concurrent_queries"], 100))
             handle = ray_tpu.remote(Replica).options(**opts).remote(
-                cfg["callable_def"], cfg["init_args"], cfg["init_kwargs"])
+                cfg["callable_def"], cfg["init_args"], cfg["init_kwargs"],
+                max_concurrent_queries=cfg["max_concurrent_queries"])
             starting.append({"id": rid, "handle": handle,
                              "version": version})
         # Health-gate: route no traffic to a replica that can't init.
@@ -235,6 +284,44 @@ class ServeController:
         self._replicas[name] = current
         await self._notify(name)  # switch routers to the new set...
         await self._drain_and_kill(outdated + extra)  # ...then drain old
+
+    # ---- replica health (a crashed replica — SIGKILL, OOM — must come
+    # OUT of router membership and back UP to the replica goal without
+    # waiting for the next deploy; reference: backend_state.py's
+    # actor-death handling in the controller loop) ----
+
+    async def _health_loop(self) -> None:
+        from ray_tpu import exceptions as exc_mod
+
+        while self._configs:
+            await asyncio.sleep(HEALTH_CHECK_PERIOD_S)
+            for name in list(self._configs):
+                live = self._replicas.get(name, [])
+                if not live:
+                    continue
+                checks = await asyncio.gather(
+                    *[asyncio.wait_for(_as_coro(r["handle"].ready.remote()),
+                                       timeout=10.0) for r in live],
+                    return_exceptions=True)
+                dead = [r for r, c in zip(live, checks)
+                        if isinstance(c, exc_mod.ActorDiedError)]
+                # only a DEAD actor counts: a slow/timed-out ready()
+                # (replica busy under load) must not get it replaced
+                if not dead:
+                    continue
+                dead_ids = {r["id"] for r in dead}
+                logger.warning("replica(s) %s of %s died; replacing",
+                               sorted(dead_ids), name)
+                self._replicas[name] = [r for r in live
+                                        if r["id"] not in dead_ids]
+                await self._notify(name)  # routers stop picking it NOW
+                try:
+                    await self._reconcile(name)  # scale back to goal
+                except Exception:  # noqa: BLE001 — node still sick;
+                    # retry next period
+                    logger.exception("replacing dead replicas of %s "
+                                     "failed", name)
+        self._health_task = None
 
     # ---- autoscaling (reference: serve/autoscaling_policy.py
     # BasicAutoscalingPolicy driven from the controller loop) ----

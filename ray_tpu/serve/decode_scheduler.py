@@ -1,0 +1,297 @@
+"""Continuous batching for KV-cached decode: one in-flight batch,
+slot admission at step boundaries.
+
+The ``@serve.batch`` decorator forms batches with a static window —
+requests wait up to ``batch_wait_timeout_s`` for peers, the batch runs
+to completion, and a request arriving one tick after the flush waits a
+FULL generation before its tokens start. Under ragged arrivals that
+leaves most of the model's decode ceiling on the floor (the scheduling
+gap PAPERS.md [1] measures: batch-formation policy, not kernel speed,
+dominates accelerator goodput).
+
+:class:`DecodeScheduler` replaces the window with ONE long-lived decode
+batch over a per-slot KV cache (``models/decode.py``
+``init_slot_cache`` / ``slot_prefill`` / ``slot_decode_step``):
+
+* the loop runs one batched decode step per iteration for every
+  ACTIVE slot;
+* a newly arrived request is admitted into any open slot at the next
+  step boundary — its prompt prefills into that cache row while the
+  other rows' positions are untouched, and its first step joins the
+  very next batch;
+* a finished sequence (eos / max_tokens) frees its slot IMMEDIATELY
+  and the head of the queue takes it — the batch never drains to empty
+  just to let a waiter in;
+* past ``max_queue_depth`` waiting requests, ``submit`` sheds with the
+  typed :class:`~ray_tpu.exceptions.ServeOverloadedError` (the serving
+  analog of the lease plane's ``retry_later``) instead of queueing
+  work the decode loop can never catch up on.
+
+The scheduler is ENGINE-AGNOSTIC: anything with ``slots``,
+``prefill(slot, prompt) -> first_token`` and
+``step({slot: last_token}) -> {slot: next_token}`` drives it, so the
+admission policy is unit-testable without jax (tests/
+test_decode_scheduler.py uses a fake engine); :class:`JaxSlotEngine`
+adapts the real per-slot cache. Engine calls run in the default
+executor — a jitted decode step must not block the replica's asyncio
+loop, which keeps accepting/queueing requests mid-step.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import rpc
+from ray_tpu.exceptions import ServeOverloadedError
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class _Request:
+    prompt: Any
+    max_tokens: int
+    eos_token: Optional[int]
+    future: asyncio.Future
+    tokens: List[int] = field(default_factory=list)
+    joined_mid_batch: bool = False
+
+
+class DecodeScheduler:
+    """One in-flight decode batch; admission at step boundaries.
+
+    ``submit`` is awaited per request and resolves with the generated
+    token list. The background loop starts lazily on the first submit
+    and parks (zero cycles) whenever queue and batch are both empty.
+    """
+
+    def __init__(self, engine, *, max_queue_depth: int = 64,
+                 retry_after_s: float = 1.0):
+        if int(engine.slots) <= 0:
+            raise ValueError("engine must expose at least one slot")
+        self._engine = engine
+        self._free: List[int] = list(range(engine.slots))
+        self._queue: deque[_Request] = deque()
+        self._active: Dict[int, _Request] = {}
+        self._max_queue_depth = int(max_queue_depth)
+        self._retry_after_s = float(retry_after_s)
+        self._wakeup = asyncio.Event()
+        self._loop_task: Optional[asyncio.Task] = None
+        self._closed = False
+        # counters surfaced by stats() (and the replica's stats() ->
+        # autoscaler/admission view)
+        self.steps = 0
+        self.slot_steps = 0          # sum of batch occupancy per step
+        self.completed = 0
+        self.shed = 0
+        self.admitted = 0
+        self.admitted_mid_batch = 0
+        self.tokens_generated = 0
+
+    # ------------------------------------------------------------ public
+
+    async def submit(self, prompt, *, max_tokens: int,
+                     eos_token: Optional[int] = None) -> List[int]:
+        """Queue one prompt; resolves with its generated tokens.
+
+        Sheds (typed, never queues) once ``max_queue_depth`` requests
+        are already waiting for a slot — the per-replica half of the
+        SLO contract; the proxy's admission controller is the cluster
+        half."""
+        if self._closed:
+            raise ServeOverloadedError("decode scheduler is closed",
+                                       retry_after_s=self._retry_after_s)
+        if len(self._queue) >= self._max_queue_depth:
+            self.shed += 1
+            raise ServeOverloadedError(
+                f"decode queue full ({len(self._queue)} waiting, cap "
+                f"{self._max_queue_depth})",
+                retry_after_s=self._retry_after_s)
+        req = _Request(prompt, int(max_tokens), eos_token,
+                       asyncio.get_running_loop().create_future())
+        self._queue.append(req)
+        self._wakeup.set()
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = rpc.spawn_logged(self._run(),
+                                               "serve-decode-loop")
+        return await req.future
+
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def stats(self) -> dict:
+        return {
+            "queue_depth": len(self._queue),
+            "active_slots": len(self._active),
+            "free_slots": len(self._free),
+            "steps": self.steps,
+            "slot_steps": self.slot_steps,
+            "mean_occupancy": (self.slot_steps / self.steps
+                               if self.steps else 0.0),
+            "completed": self.completed,
+            "shed": self.shed,
+            "admitted": self.admitted,
+            "admitted_mid_batch": self.admitted_mid_batch,
+            "tokens_generated": self.tokens_generated,
+        }
+
+    async def aclose(self) -> None:
+        """Stop the loop; fail queued and in-flight requests typed."""
+        self._closed = True
+        task, self._loop_task = self._loop_task, None
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        err = ServeOverloadedError("decode scheduler closed",
+                                   retry_after_s=self._retry_after_s)
+        for req in list(self._queue) + list(self._active.values()):
+            if not req.future.done():
+                req.future.set_exception(err)
+        self._queue.clear()
+        self._active.clear()
+        self._free = list(range(self._engine.slots))
+
+    # ------------------------------------------------------------- loop
+
+    async def _prefill(self, slot: int, req: _Request) -> None:
+        loop = asyncio.get_running_loop()
+        if asyncio.iscoroutinefunction(self._engine.prefill):
+            first = await self._engine.prefill(slot, req.prompt)
+        else:
+            first = await loop.run_in_executor(
+                None, self._engine.prefill, slot, req.prompt)
+        req.tokens.append(int(first))
+        self.tokens_generated += 1
+
+    def _finish(self, slot: int, req: _Request) -> None:
+        del self._active[slot]
+        self._free.append(slot)
+        self.completed += 1
+        if not req.future.done():
+            req.future.set_result(req.tokens)
+
+    def _done(self, req: _Request) -> bool:
+        return (len(req.tokens) >= req.max_tokens or
+                (req.eos_token is not None and req.tokens and
+                 req.tokens[-1] == req.eos_token))
+
+    async def _admit(self) -> None:
+        """Fill open slots from the queue head (step boundary only)."""
+        while self._free and self._queue:
+            req = self._queue.popleft()
+            if req.future.done():   # caller gave up while queued
+                continue
+            slot = self._free.pop()
+            req.joined_mid_batch = bool(self._active)
+            self.admitted += 1
+            if req.joined_mid_batch:
+                self.admitted_mid_batch += 1
+            try:
+                await self._prefill(slot, req)
+            except Exception as e:  # noqa: BLE001 — one bad prompt
+                # must not kill the batch: fail ITS future, free the
+                # slot, keep decoding everyone else
+                self._free.append(slot)
+                if not req.future.done():
+                    req.future.set_exception(e)
+                continue
+            if self._done(req):
+                self._free.append(slot)
+                self.completed += 1
+                if not req.future.done():
+                    req.future.set_result(req.tokens)
+            else:
+                self._active[slot] = req
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        while not self._closed:
+            await self._admit()
+            if not self._active:
+                self._wakeup.clear()
+                if not self._queue:
+                    await self._wakeup.wait()
+                continue
+            tokens = {slot: req.tokens[-1]
+                      for slot, req in self._active.items()}
+            try:
+                if asyncio.iscoroutinefunction(self._engine.step):
+                    out = await self._engine.step(tokens)
+                else:
+                    out = await loop.run_in_executor(
+                        None, self._engine.step, tokens)
+            except Exception as e:  # noqa: BLE001 — a failed device
+                # step fails the IN-FLIGHT requests typed; the loop and
+                # the queue survive (shed at the door, never collapse)
+                logger.error("decode step failed: %r", e, exc_info=e)
+                for slot, req in list(self._active.items()):
+                    del self._active[slot]
+                    self._free.append(slot)
+                    if not req.future.done():
+                        req.future.set_exception(e)
+                continue
+            self.steps += 1
+            self.slot_steps += len(tokens)
+            for slot, tok in out.items():
+                req = self._active.get(slot)
+                if req is None:
+                    continue
+                req.tokens.append(int(tok))
+                self.tokens_generated += 1
+                if self._done(req):
+                    self._finish(slot, req)
+
+
+class JaxSlotEngine:
+    """Adapts the per-slot KV cache (models/decode.py) to the
+    scheduler's engine protocol. Greedy decoding; prompts are int
+    token-id sequences. One compiled prefill program per distinct
+    prompt length, one compiled step program total."""
+
+    def __init__(self, params, cfg, *, slots: int, max_len: int):
+        import jax.numpy as jnp  # deferred: scheduler users without a
+        from ray_tpu.models import decode as decode_mod  # model never pay
+
+        self._jnp = jnp
+        self._decode = decode_mod
+        self._params = params
+        self._cfg = cfg
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self._cache = decode_mod.init_slot_cache(cfg, slots, max_len)
+
+    def prefill(self, slot: int, prompt) -> int:
+        jnp = self._jnp
+        tokens = jnp.asarray(prompt, jnp.int32)[None, :]
+        if tokens.shape[1] >= self.max_len:
+            raise ValueError(
+                f"prompt ({tokens.shape[1]}) >= slot max_len "
+                f"({self.max_len})")
+        logits, self._cache = self._decode.slot_prefill(
+            self._params, tokens, self._cache, jnp.int32(slot),
+            self._cfg)
+        return int(jnp.argmax(logits[0]))
+
+    def step(self, tokens: Dict[int, int]) -> Dict[int, int]:
+        jnp = self._jnp
+        tok = [0] * self.slots
+        act = [False] * self.slots
+        for slot, t in tokens.items():
+            # a slot at capacity would silently clamp its cache write;
+            # refuse loudly (the scheduler's max_tokens bound plus the
+            # engine's prompt-length check make this unreachable)
+            if int(self._cache["pos"][slot]) >= self.max_len:
+                raise ValueError(f"slot {slot} KV cache full")
+            tok[slot], act[slot] = int(t), True
+        logits, self._cache = self._decode.slot_decode_step(
+            self._params, self._cache, jnp.asarray(tok, jnp.int32),
+            jnp.asarray(act), self._cfg)
+        nxt = jnp.argmax(logits, axis=-1)
+        return {slot: int(nxt[slot]) for slot in tokens}

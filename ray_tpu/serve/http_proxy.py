@@ -13,21 +13,46 @@ one loop without threads.
 Deployments receive an :class:`HTTPRequest`; they may return
 ``bytes`` / ``str`` / JSON-able objects or an :class:`HTTPResponse`
 for full control. ``GET /-/routes`` returns the live route table.
+
+Data path (the serving front door at speed):
+
+* **Zero-copy ingress** — a request body at or above
+  ``serve_ingress_shm_threshold`` is written straight into shm through
+  the AllocSegment lease path (``core_worker.put_async``, scheduled on
+  the core IO loop so the proxy's event loop never blocks on the seal)
+  and crosses proxy -> router -> replica as an ObjectRef riding
+  ``HTTPRequest.body_ref``; the replica resolves it before user code
+  runs. Large replica returns already travel by reference (the task
+  return plane seals them), so responses are symmetric for free.
+* **SLO-aware load shedding** — an admission controller sheds at the
+  door once waiting + in-flight requests exceed the deployment's queue
+  budget (capacity x ``serve_shed_queue_factor``), or its observed p99
+  exceeds ``serve_shed_p99_budget_s`` while every slot is busy.
+  Sheds reply ``503`` with a backlog-scaled ``Retry-After`` — the
+  typed :class:`~ray_tpu.exceptions.ServeOverloadedError` raised by a
+  replica's own queue cap or decode scheduler renders the same way.
+* **Tracing** — with ``RAY_TPU_TRACE=1`` every request runs inside an
+  accept->reply span (util/tracing.py), so ``state.timeline()`` shows
+  the HTTP edge on the same wall clock as the task/object/RPC planes.
 """
 
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import logging
 import os
+import time
 import traceback
 from typing import Any, Dict, List, Optional
 from urllib.parse import parse_qsl, unquote, urlsplit
 
 from ray_tpu import exceptions as exc
+from ray_tpu._private import metrics as metrics_mod
 from ray_tpu.serve.controller import ROUTES_KEY, SNAPSHOT_KEY
 from ray_tpu.serve.long_poll import LongPollClient
+from ray_tpu.util import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -35,16 +60,26 @@ PROXY_NAME = "SERVE_PROXY"
 IDLE_KEEPALIVE_S = 60.0
 MAX_HEADER_BYTES = 65536
 MAX_BODY_BYTES = 512 * 1024 * 1024
+# Rolling per-deployment latency reservoir behind the p99 half of the
+# admission decision (newest-biased: appends drop the oldest).
+LATENCY_SAMPLES = 256
 
 
 class HTTPRequest:
-    """What a deployment's callable receives for an HTTP-routed query."""
+    """What a deployment's callable receives for an HTTP-routed query.
+
+    ``body`` is the raw bytes for small requests. Past the shm ingress
+    threshold the proxy ships ``body_ref`` (an ObjectRef to the bytes)
+    instead and the Replica wrapper resolves it back into ``body``
+    before user code runs — deployment code never sees the difference.
+    """
 
     __slots__ = ("method", "path", "route_prefix", "query_string", "query",
-                 "headers", "body")
+                 "headers", "body", "body_ref")
 
     def __init__(self, method: str, path: str, route_prefix: str,
-                 query_string: str, headers: Dict[str, str], body: bytes):
+                 query_string: str, headers: Dict[str, str], body: bytes,
+                 body_ref: Any = None):
         self.method = method
         self.path = path
         self.route_prefix = route_prefix
@@ -52,6 +87,14 @@ class HTTPRequest:
         self.query = dict(parse_qsl(query_string))
         self.headers = headers
         self.body = body
+        self.body_ref = body_ref
+
+    def __getstate__(self):
+        return {s: getattr(self, s) for s in self.__slots__}
+
+    def __setstate__(self, state):
+        for s in self.__slots__:
+            setattr(self, s, state.get(s))
 
     @property
     def text(self) -> str:
@@ -118,6 +161,15 @@ class _AsyncReplicaSet:
         self._rr = 0
         self._changed = asyncio.Event()
         self._member_ids: set = set()
+        # assign() coroutines parked waiting for a free slot — the
+        # queue-depth half of the admission controller's view
+        self.num_waiting = 0
+
+    def inflight_count(self) -> int:
+        return sum(len(s) for s in self._inflight.values())
+
+    def capacity(self) -> int:
+        return len(self.replicas) * self.max_queries
 
     def update_membership(self, snapshot: dict) -> None:
         self.replicas = list(snapshot.get("replicas", []))
@@ -189,6 +241,7 @@ class _AsyncReplicaSet:
                     f"{self.name!r} ({len(self.replicas)} replicas at "
                     f"max_concurrent_queries={self.max_queries})")
             membership = asyncio.ensure_future(self._changed.wait())
+            self.num_waiting += 1
             try:
                 # Wake on any completion OR a membership change.
                 await asyncio.wait(
@@ -196,6 +249,7 @@ class _AsyncReplicaSet:
                     timeout=min(timeout, 1.0),
                     return_when=asyncio.FIRST_COMPLETED)
             finally:
+                self.num_waiting -= 1
                 membership.cancel()
 
     def _try_pick(self) -> Optional[dict]:
@@ -233,6 +287,18 @@ class HTTPProxy:
         self._changed: asyncio.Event = asyncio.Event()
         self.num_requests = 0
         self.num_errors = 0
+        self.num_shed = 0
+        self.num_ingress_shm = 0
+        # knobs resolved in ready() (the worker's config is wired up by
+        # the time the actor serves)
+        self._ingress_threshold = 64 * 1024
+        self._shed_queue_factor = 2.0
+        self._shed_p99_budget_s = 0.0
+        self._retry_after_floor_s = 1.0
+        # per-deployment rolling latency samples (seconds) feeding the
+        # p99 half of the admission decision
+        self._latency: Dict[str, List[float]] = {}
+        self._metrics = None  # serve_metrics(), bound in ready()
 
     def _signal_change(self) -> None:
         self._changed.set()
@@ -255,6 +321,19 @@ class HTTPProxy:
         """Start the server (idempotent); returns 'host:port'."""
         if self._server is None:
             self._loop = asyncio.get_running_loop()
+            try:
+                import ray_tpu.worker as worker_mod
+                cfg = worker_mod.global_worker.core.config
+                self._ingress_threshold = int(
+                    cfg.serve_ingress_shm_threshold)
+                self._shed_queue_factor = max(
+                    1.0, float(cfg.serve_shed_queue_factor))
+                self._shed_p99_budget_s = float(cfg.serve_shed_p99_budget_s)
+                self._retry_after_floor_s = max(
+                    0.0, float(cfg.serve_retry_after_s))
+            except Exception:  # noqa: BLE001 — standalone/unit harness:
+                pass           # keep the defaults
+            self._metrics = metrics_mod.serve_metrics()
             # Client first: _apply_routes registers per-deployment
             # membership callbacks on it, including for deployments
             # that predate the proxy.
@@ -353,6 +432,98 @@ class HTTPProxy:
                 if best is None or len(prefix) > len(best[0]):
                     best = (prefix, name)
         return best
+
+    # ---- admission control / shedding ----
+
+    def _note_latency(self, name: str, seconds: float) -> None:
+        samples = self._latency.setdefault(name, [])
+        samples.append(seconds)
+        if len(samples) > LATENCY_SAMPLES:
+            del samples[:len(samples) - LATENCY_SAMPLES]
+        if self._metrics is not None:
+            self._metrics["latency"].observe(
+                seconds, labels={"deployment": name})
+
+    def _latency_stats(self, name: str):
+        """(p99, mean) of the rolling reservoir, or (None, None)."""
+        samples = self._latency.get(name)
+        if not samples:
+            return None, None
+        s = sorted(samples)
+        return metrics_mod.percentile(s, 0.99), sum(s) / len(s)
+
+    def _set_queue_gauges(self, name: str, rs: _AsyncReplicaSet) -> None:
+        if self._metrics is None:
+            return
+        labels = {"deployment": name, "router": f"proxy:{self._port}"}
+        self._metrics["inflight"].set(rs.inflight_count(), labels=labels)
+        self._metrics["queue_depth"].set(rs.num_waiting, labels=labels)
+
+    def _admission_check(self, name: str,
+                         rs: _AsyncReplicaSet) -> Optional[int]:
+        """``None`` = admit; else the Retry-After hint (seconds) for a
+        shed. Two triggers, both sized off the deployment's dispatch
+        capacity (replicas x max_concurrent_queries):
+
+        * queue budget — waiting + in-flight past capacity x
+          ``serve_shed_queue_factor``: the backlog alone already costs
+          more latency than the budget allows;
+        * SLO budget — every slot busy AND observed p99 past
+          ``serve_shed_p99_budget_s`` (when configured): degraded
+          tails shed before the backlog doubles the damage.
+        """
+        cap = rs.capacity()
+        if cap <= 0:
+            return None  # bootstrap race: handled by the caller's wait
+        queued = rs.inflight_count() + rs.num_waiting
+        p99, mean = self._latency_stats(name)
+        over_queue = queued >= cap * self._shed_queue_factor
+        over_slo = (self._shed_p99_budget_s > 0 and queued >= cap
+                    and p99 is not None
+                    and p99 > self._shed_p99_budget_s)
+        if not over_queue and not over_slo:
+            return None
+        # Retry-After scales with how long the current backlog needs
+        # to drain; the floor covers the cold no-samples case.
+        hint = self._retry_after_floor_s
+        if mean:
+            hint = max(hint, queued * mean / cap)
+        return max(1, int(min(30.0, hint)))
+
+    def _shed(self, name: str) -> None:
+        self.num_shed += 1
+        if self._metrics is not None:
+            self._metrics["shed"].inc(labels={"deployment": name})
+
+    # ---- zero-copy ingress ----
+
+    async def _ingest_body_shm(self, body: bytes):
+        """Write the body into shm via the AllocSegment lease path.
+        ``put_async`` serializes on this thread (bytes are META_RAW —
+        no copy) and schedules the segment fill + seal on the core IO
+        loop, so the proxy loop keeps accepting while a huge body
+        lands. Returns the ObjectRef, or None to fall back to the
+        inline lane (no core worker yet, store full, ...)."""
+        try:
+            import ray_tpu.worker as worker_mod
+            w = worker_mod.global_worker
+            if w is None or w.core is None:
+                return None
+            ref, done = w.core.put_async(body)
+        except Exception as e:  # noqa: BLE001 — ingress must degrade,
+            # not fail: the inline lane is always correct
+            logger.warning("shm ingress unavailable (%r); body inline", e)
+            return None
+        try:
+            await asyncio.wrap_future(done)
+        except Exception as e:  # noqa: BLE001 — seal failed (store
+            # full): drop our ref, ship inline
+            logger.warning("shm ingress seal failed (%r); body inline", e)
+            return None
+        self.num_ingress_shm += 1
+        if self._metrics is not None:
+            self._metrics["ingress_shm"].inc()
+        return ref
 
     # ---- HTTP plumbing ----
 
@@ -463,24 +634,73 @@ class HTTPProxy:
                 keep_alive)
             return keep_alive
 
-        request = HTTPRequest(method, path, prefix, url.query, headers, body)
+        # Admission controller: shed at the door BEFORE touching shm or
+        # a replica slot — a shed must cost microseconds, not queueing.
+        retry_after = self._admission_check(name, rs)
+        if retry_after is not None:
+            self._shed(name)
+            self._set_queue_gauges(name, rs)
+            await self._write_response(
+                writer,
+                HTTPResponse(b"overloaded; retry later", status=503,
+                             headers={"retry-after": str(retry_after)}),
+                keep_alive)
+            return keep_alive
+
+        body_ref = None
+        if (self._ingress_threshold > 0
+                and len(body) >= self._ingress_threshold):
+            body_ref = await self._ingest_body_shm(body)
+            if body_ref is not None:
+                body = b""  # the bytes ride shm, not the pickle lane
+
+        request = HTTPRequest(method, path, prefix, url.query, headers,
+                              body, body_ref=body_ref)
+        if self._metrics is not None:
+            self._metrics["requests"].inc(labels={"deployment": name})
+        self._set_queue_gauges(name, rs)
+        span_cm = (tracing.trace(
+            f"http {method} {path}", kind="server",
+            attributes={"deployment": name,
+                        "shm_ingress": body_ref is not None})
+            if tracing.enabled() else contextlib.nullcontext())
+        t0 = time.perf_counter()
         try:
-            result = await rs.assign(
-                "__call__", (request,), {},
-                idempotent=method in ("GET", "HEAD", "OPTIONS"))
-            response = _encode_result(result)
-        except Exception:  # noqa: BLE001 — user code / replica failure
-            self.num_errors += 1
-            # tracebacks stay server-side: the ingress surface must not
-            # leak file paths / code structure to arbitrary clients
-            tb = traceback.format_exc()
-            logger.error("request to %s failed:\n%s", path, tb)
-            if os.environ.get("RAY_TPU_SERVE_DEBUG"):
-                body = tb.encode()
-            else:
-                body = b"internal error (see serve logs)"
-            response = HTTPResponse(body, status=500,
-                                    content_type="text/plain")
+            with span_cm as span:
+                try:
+                    result = await rs.assign(
+                        "__call__", (request,), {},
+                        idempotent=method in ("GET", "HEAD", "OPTIONS"))
+                    response = _encode_result(result)
+                except exc.ServeOverloadedError as e:
+                    # replica-side shed (queue cap / decode scheduler);
+                    # isinstance holds through as_instanceof_cause and
+                    # retry_after_s rides the grafted cause attributes
+                    self._shed(name)
+                    response = HTTPResponse(
+                        str(e).encode() or b"overloaded; retry later",
+                        status=503,
+                        headers={"retry-after": str(max(1, int(
+                            getattr(e, "retry_after_s", 1.0))))})
+                except Exception:  # noqa: BLE001 — user code / replica
+                    # failure
+                    self.num_errors += 1
+                    # tracebacks stay server-side: the ingress surface
+                    # must not leak file paths / code structure to
+                    # arbitrary clients
+                    tb = traceback.format_exc()
+                    logger.error("request to %s failed:\n%s", path, tb)
+                    if os.environ.get("RAY_TPU_SERVE_DEBUG"):
+                        body = tb.encode()
+                    else:
+                        body = b"internal error (see serve logs)"
+                    response = HTTPResponse(body, status=500,
+                                            content_type="text/plain")
+                if span is not None:
+                    span.attributes["status"] = response.status
+        finally:
+            self._note_latency(name, time.perf_counter() - t0)
+            self._set_queue_gauges(name, rs)
         await self._write_response(writer, response, keep_alive)
         return keep_alive
 
@@ -510,7 +730,21 @@ class HTTPProxy:
         await writer.drain()
 
     async def stats(self) -> dict:
+        deployments = {}
+        for name, rs in self._sets.items():
+            p99, mean = self._latency_stats(name)
+            deployments[name] = {
+                "replicas": len(rs.replicas),
+                "capacity": rs.capacity(),
+                "inflight": rs.inflight_count(),
+                "queue_depth": rs.num_waiting,
+                "p99_s": p99,
+                "mean_s": mean,
+            }
         return {"num_requests": self.num_requests,
                 "num_errors": self.num_errors,
+                "num_shed": self.num_shed,
+                "num_ingress_shm": self.num_ingress_shm,
                 "routes": dict(self._routes),
+                "deployments": deployments,
                 "address": f"{self._host}:{self._port}"}

@@ -4,7 +4,18 @@ Parity target: the reference's RayServeWrappedReplica / RayServeReplica
 (reference: python/ray/serve/backend_worker.py). An async actor so many
 requests interleave up to the deployment's max_concurrent_queries (the
 hard cap is enforced caller-side by the ReplicaSet; the replica-side
-counter exists for draining).
+counter exists for draining — plus a hard overload cap: multiple
+routers each honor max_concurrent_queries LOCALLY, so their sum can
+oversubscribe one replica. Past
+``max_concurrent_queries + serve_max_queue_depth`` concurrent requests
+the replica sheds with the typed
+:class:`~ray_tpu.exceptions.ServeOverloadedError`, which the proxy
+renders as ``503 + Retry-After``).
+
+Zero-copy ingress lands here too: an :class:`HTTPRequest` carrying
+``body_ref`` (shm ObjectRef) has its body resolved on the replica's
+event loop before user code runs — deployment code always sees
+``request.body`` as plain bytes.
 """
 
 from __future__ import annotations
@@ -13,18 +24,32 @@ import asyncio
 import inspect
 from typing import Any
 
+from ray_tpu.exceptions import ServeOverloadedError
+
 
 class Replica:
     """Generic wrapper instantiated by the controller for every replica."""
 
     def __init__(self, callable_def: Any, init_args: tuple,
-                 init_kwargs: dict):
+                 init_kwargs: dict, max_concurrent_queries: int = 100):
         if inspect.isclass(callable_def):
             self._obj = callable_def(*init_args, **init_kwargs)
         else:
             self._obj = callable_def  # plain function deployment
         self._inflight = 0
+        self._shed = 0
         self._draining = False
+        queue_depth = 16
+        retry_after = 1.0
+        try:
+            import ray_tpu.worker as worker_mod
+            cfg = worker_mod.global_worker.core.config
+            queue_depth = int(cfg.serve_max_queue_depth)
+            retry_after = float(cfg.serve_retry_after_s)
+        except Exception:  # noqa: BLE001 — unit harness without a
+            pass           # worker: keep the defaults
+        self._max_inflight = int(max_concurrent_queries) + max(0, queue_depth)
+        self._retry_after_s = max(0.0, retry_after)
 
     async def ready(self) -> str:
         """Health check the controller awaits before routing traffic."""
@@ -33,8 +58,18 @@ class Replica:
     async def stats(self) -> dict:
         """Load signal for the controller's autoscaler (reference:
         autoscaling_policy.py scale() consumes per-router queue lens —
-        here the replica self-reports concurrency)."""
-        return {"inflight": self._inflight}
+        here the replica self-reports concurrency). A deployment
+        hosting a continuous-batching decode loop exposes it as
+        ``self.decode_scheduler``; its occupancy/queue counters ride
+        along for /api/serve."""
+        out = {"inflight": self._inflight, "shed": self._shed}
+        sched = getattr(self._obj, "decode_scheduler", None)
+        if sched is not None:
+            try:
+                out["decode"] = sched.stats()
+            except Exception:  # noqa: BLE001 — stats must never fail
+                pass           # the autoscaler poll
+        return out
 
     async def handle_request(self, method: str, args: tuple,
                              kwargs: dict):
@@ -43,8 +78,21 @@ class Replica:
         # controller switched the snapshot, and failing them would
         # surface errors for requests the user did nothing wrong with.
         # Drain completion just waits a little longer.
+        if self._inflight >= self._max_inflight:
+            self._shed += 1
+            raise ServeOverloadedError(
+                f"replica at capacity ({self._inflight} in flight, cap "
+                f"{self._max_inflight})",
+                retry_after_s=self._retry_after_s)
         self._inflight += 1
         try:
+            # Zero-copy ingress: resolve a by-reference body before the
+            # user's callable sees the request.
+            for a in args:
+                ref = getattr(a, "body_ref", None)
+                if ref is not None and hasattr(a, "body"):
+                    a.body = bytes(await ref.as_future())
+                    a.body_ref = None  # borrow ends; shm seg can free
             # Class deployments: bound-method lookup; function
             # deployments: the function's own __call__.
             fn = getattr(self._obj, method)
@@ -65,6 +113,12 @@ class Replica:
         started_with = self._inflight
         while self._inflight > 0:
             await asyncio.sleep(0.005)
+        sched = getattr(self._obj, "decode_scheduler", None)
+        if sched is not None:
+            try:
+                await sched.aclose()
+            except Exception:  # noqa: BLE001 — a wedged decode loop
+                pass           # must not block the roll
         return started_with
 
     async def reconfigure(self, user_config: Any) -> None:

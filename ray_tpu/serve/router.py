@@ -11,10 +11,12 @@ its async flow-control loop).
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Dict, List, Optional
 
+from ray_tpu._private import metrics as metrics_mod
 from ray_tpu._private.object_ref import ObjectRef
 
 
@@ -32,6 +34,25 @@ class ReplicaSet:
         # pulsed on every membership push so flap-waiters wake on the
         # long-poll delivery, not a fixed sleep (r3 verdict weak #5)
         self._membership_changed = threading.Event()
+        # callers blocked in assign() backpressure; exported (with
+        # in-flight) as ray_tpu_serve_{queue_depth,inflight} so the
+        # dashboard sees handle-side routers next to the HTTP proxy.
+        # Gauge merge is last-writer-wins per label set, hence the
+        # per-router label (see metrics_mod.serve_metrics).
+        self._num_waiting = 0
+        self._metrics = metrics_mod.serve_metrics()
+        self._labels = {"deployment": deployment_name,
+                        "router": f"handle:{os.getpid()}"}
+
+    def _export_gauges(self) -> None:
+        """In-flight here counts bookkeeping refs, i.e. completed-but-
+        unpruned queries inflate it until the next prune — an upper
+        bound, matching what assign() backpressures on."""
+        with self._lock:
+            inflight = sum(len(v) for v in self._inflight.values())
+            waiting = self._num_waiting
+        self._metrics["inflight"].set(inflight, labels=self._labels)
+        self._metrics["queue_depth"].set(waiting, labels=self._labels)
 
     # ---- membership (long-poll callback + bootstrap) ----
 
@@ -73,6 +94,9 @@ class ReplicaSet:
                     ref = replica["handle"].handle_request.remote(
                         method, args, kwargs)
                     self._inflight.setdefault(replica["id"], []).append(ref)
+                    self._metrics["inflight"].set(
+                        sum(len(v) for v in self._inflight.values()),
+                        labels=self._labels)
                     return ref
                 all_inflight = [r for refs in self._inflight.values()
                                 for r in refs]
@@ -81,40 +105,48 @@ class ReplicaSet:
                 # applied after will set() after we cleared — no lost
                 # wakeup window between release and clear
                 self._membership_changed.clear()
+                self._num_waiting += 1
+            self._export_gauges()
             # Backpressure: every slot is busy. Wait for ANY in-flight
             # query to finish, then retry the pick. Only an actual
             # completion resets the timeout (progress); a wedged
             # replica must not block a caller that asked for a bound.
-            if all_inflight:
-                done, _ = ray_tpu.wait(all_inflight, num_returns=1,
-                                       timeout=1.0)
-                if done:
-                    deadline = time.monotonic() + timeout_s
-                elif time.monotonic() >= deadline:
-                    raise RuntimeError(
-                        f"timed out after {timeout_s}s waiting for a "
-                        f"free slot on deployment "
-                        f"{self.deployment_name!r} (all "
-                        f"{len(self._replicas)} replicas at "
-                        f"max_concurrent_queries={self._max_queries})")
-            else:
-                # No pickable slot and nothing in flight: membership
-                # flapped mid-roll. Sleep until the next long-poll push
-                # (bounded so the deadline still applies). A push
-                # landing at the wire earns exactly ONE post-deadline
-                # re-pick — so a replica restored at the buzzer is
-                # served, but continuous flapping (or another caller
-                # consuming the shared event) can't starve the timeout.
-                signaled = self._membership_changed.wait(
-                    timeout=min(1.0, max(0.01,
-                                         deadline - time.monotonic())))
-                if time.monotonic() >= deadline:
-                    if not signaled or grace_pick_used:
+            try:
+                if all_inflight:
+                    done, _ = ray_tpu.wait(all_inflight, num_returns=1,
+                                           timeout=1.0)
+                    if done:
+                        deadline = time.monotonic() + timeout_s
+                    elif time.monotonic() >= deadline:
                         raise RuntimeError(
-                            f"timed out after {timeout_s}s waiting for "
-                            f"a usable replica on deployment "
-                            f"{self.deployment_name!r}")
-                    grace_pick_used = True
+                            f"timed out after {timeout_s}s waiting for a "
+                            f"free slot on deployment "
+                            f"{self.deployment_name!r} (all "
+                            f"{len(self._replicas)} replicas at "
+                            f"max_concurrent_queries={self._max_queries})")
+                else:
+                    # No pickable slot and nothing in flight: membership
+                    # flapped mid-roll. Sleep until the next long-poll
+                    # push (bounded so the deadline still applies). A
+                    # push landing at the wire earns exactly ONE
+                    # post-deadline re-pick — so a replica restored at
+                    # the buzzer is served, but continuous flapping (or
+                    # another caller consuming the shared event) can't
+                    # starve the timeout.
+                    signaled = self._membership_changed.wait(
+                        timeout=min(1.0, max(0.01,
+                                             deadline - time.monotonic())))
+                    if time.monotonic() >= deadline:
+                        if not signaled or grace_pick_used:
+                            raise RuntimeError(
+                                f"timed out after {timeout_s}s waiting "
+                                f"for a usable replica on deployment "
+                                f"{self.deployment_name!r}")
+                        grace_pick_used = True
+            finally:
+                with self._lock:
+                    self._num_waiting -= 1
+                self._export_gauges()
 
     def _prune_locked(self, rid: str) -> List[ObjectRef]:
         """Drop completed refs from one replica's book (holds lock)."""

@@ -80,7 +80,7 @@ SCHEDULE_KINDS = (
     "stripe_sever", "corrupt_chunk", "short_read", "delay_storm",
     "raylet_kill", "heartbeat_partition", "gcs_restart", "mixed",
     "worker_kill", "oom_storm", "credit_revoke", "mixed_version",
-    "gang_kill", "ring_kill",
+    "gang_kill", "ring_kill", "replica_kill",
 )
 
 # Event vocabulary for the data-plane harness. Each entry generates a
@@ -113,7 +113,7 @@ def make_schedule(kind: str, seed: int, rounds: int = 8,
     still alive at run time)."""
     if kind not in _KIND_OPS and kind not in (
             "worker_kill", "oom_storm", "credit_revoke",
-            "mixed_version", "gang_kill", "ring_kill"):
+            "mixed_version", "gang_kill", "ring_kill", "replica_kill"):
         raise ValueError(f"unknown schedule kind {kind!r}")
     if kind == "worker_kill":
         # the worker-kill schedule is carried by the RAY_TPU_FAULTPOINTS
@@ -138,6 +138,10 @@ def make_schedule(kind: str, seed: int, rounds: int = 8,
     if kind == "ring_kill":
         # the ring-collective schedule draws its victim rank and kill
         # step inside run_ring_kill_schedule from the seed
+        return []
+    if kind == "replica_kill":
+        # the serve-replica schedule draws its victim replica inside
+        # run_replica_kill_schedule from the seed
         return []
     rng = random.Random(seed)
     events: List[dict] = []
@@ -1698,4 +1702,166 @@ def run_ring_kill_schedule(seed: int) -> dict:
     assert fd_after <= fd_before + 8, \
         f"fd leak across the ring-kill soak: {fd_before} -> {fd_after}"
     assert not _zombie_children(), "zombie children after ring chaos"
+    return summary
+
+
+# ---------------------------------------------------------------------------
+# Serve replica kill (HTTP front door under replica chaos)
+# ---------------------------------------------------------------------------
+
+
+def run_replica_kill_schedule(seed: int) -> dict:
+    """SIGKILL a serve replica MID-REQUEST and assert the chaos bar:
+
+    * idempotent (GET) requests that were riding the victim are retried
+      on a peer by the proxy's replica set — every one answers 200;
+    * non-idempotent (POST) requests either complete on a survivor or
+      surface a TYPED failure (500/503) — never a hang, never a silent
+      retry of side-effecting work;
+    * a large POST body rides the zero-copy shm ingress lane while the
+      kill lands — its segment must not leak whatever the outcome
+      (leak detector reports ZERO leaked objects after the soak);
+    * the controller's health loop notices the death and restores the
+      replica count, and the restored set serves;
+    * fd and zombie brackets hold across the whole soak.
+    """
+    import signal
+    import threading
+    import time as time_mod
+    import urllib.error
+    import urllib.request
+
+    import ray_tpu
+    import ray_tpu.state as state_mod
+    from ray_tpu import serve
+
+    fd_before = _fd_count()
+    rng = random.Random(seed)
+    summary: Dict[str, Any] = {}
+    ray_tpu.init(num_cpus=2, _system_config={
+        "metrics_report_period_ms": 200,
+        "raylet_heartbeat_period_ms": 100,
+        "leak_sweep_interval_s": 0.3,
+    })
+    try:
+        serve.start()
+
+        @serve.deployment(num_replicas=2, max_concurrent_queries=8)
+        class Victim:
+            def __call__(self, request):
+                import os as os_mod
+                import time as t
+                if request.query.get("slow"):
+                    t.sleep(1.2)
+                return str(os_mod.getpid())
+
+        Victim.deploy()
+        addr = serve.get_http_address()
+
+        def fetch(url, data=None, timeout=PULL_BOUND_S):
+            req = urllib.request.Request(
+                url, data=data, method="POST" if data else "GET")
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, resp.read()
+
+        # discover both replica pids through the round-robin
+        pids: set = set()
+        deadline = time_mod.time() + 15
+        while len(pids) < 2 and time_mod.time() < deadline:
+            status, body = fetch(f"http://{addr}/Victim")
+            assert status == 200
+            pids.add(int(body))
+        assert len(pids) == 2, f"never saw both replicas: {pids}"
+        victim = sorted(pids)[rng.randrange(2)]
+        summary["victim_pid"] = victim
+
+        # large enough for the shm ingress lane (default threshold 64k)
+        payload = bytes(rng.randrange(256) for _ in range(1024)) * 96
+        results: List[tuple] = []
+        lock = threading.Lock()
+
+        def client(i, post):
+            url = f"http://{addr}/Victim?slow=1"
+            try:
+                status, body = fetch(url, data=payload if post else None)
+                with lock:
+                    results.append(("ok", post, int(body)))
+            except urllib.error.HTTPError as e:
+                e.read()
+                with lock:
+                    results.append(("http", post, e.code))
+            except Exception as e:  # noqa: BLE001 — recorded, asserted
+                with lock:
+                    results.append(("exc", post, repr(e)))
+
+        threads = [threading.Thread(target=client, args=(i, i % 2 == 0))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        time_mod.sleep(0.4)  # requests provably in flight on both
+        os.kill(victim, signal.SIGKILL)
+        for t in threads:
+            t.join(PULL_BOUND_S * 2)
+        assert not any(t.is_alive() for t in threads), \
+            f"client hung past the bound: {results}"
+
+        gets = [r for r in results if not r[1]]
+        posts = [r for r in results if r[1]]
+        # idempotent requests all retried onto a live peer
+        assert all(r[0] == "ok" for r in gets), f"GET failed: {gets}"
+        assert all(r[2] != victim for r in gets if r[0] == "ok")
+        # non-idempotent: a survivor's answer or a typed HTTP failure
+        for r in posts:
+            assert (r[0] == "ok" and r[2] != victim) or \
+                (r[0] == "http" and r[2] in (500, 503)), \
+                f"POST outcome neither survivor nor typed: {r}"
+        summary["get_ok"] = len(gets)
+        summary["post_failed_typed"] = sum(
+            1 for r in posts if r[0] == "http")
+
+        # the controller's health loop restores the replica count and
+        # the restored set serves (the victim pid never comes back)
+        controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+        deadline = time_mod.time() + 30
+        healed: set = set()
+        while time_mod.time() < deadline:
+            snap = ray_tpu.get(
+                controller.get_replica_snapshot.remote("Victim"))
+            if len(snap["replicas"]) == 2:
+                status, body = fetch(f"http://{addr}/Victim")
+                if status == 200:
+                    healed.add(int(body))
+                if len(healed) == 2:
+                    break
+            time_mod.sleep(0.2)
+        assert len(healed) == 2, \
+            f"replica count never restored to 2 ({healed})"
+        assert victim not in healed
+        summary["healed_pids"] = sorted(healed)
+
+        # zero shm leaks from in-flight ingress segments
+        leaked = -1
+        deadline = time_mod.time() + 15
+        while time_mod.time() < deadline:
+            leaked = state_mod.summary_objects().get("leaked", 0)
+            if leaked == 0:
+                break
+            time_mod.sleep(0.3)
+        assert leaked == 0, \
+            f"leak detector flagged {leaked} objects after replica chaos"
+
+        serve.shutdown()
+    finally:
+        ray_tpu.shutdown()
+
+    deadline = time_mod.time() + 5.0
+    zombies = _zombie_children()
+    while zombies and time_mod.time() < deadline:
+        time_mod.sleep(0.1)
+        zombies = _zombie_children()
+    assert not zombies, \
+        f"unreaped replica zombies survive shutdown: {zombies}"
+    fd_after = _fd_count()
+    assert fd_after <= fd_before + 8, \
+        f"fd leak across the replica-kill soak: {fd_before} -> {fd_after}"
     return summary

@@ -18,8 +18,8 @@ from chaos import (
     make_schedule, run_credit_raylet_kill_schedule,
     run_credit_revoke_schedule, run_data_plane_schedule,
     run_gang_kill_schedule, run_mixed_version_schedule,
-    run_oom_storm_schedule, run_ring_kill_schedule, run_task_schedule,
-    schedules_equal,
+    run_oom_storm_schedule, run_replica_kill_schedule,
+    run_ring_kill_schedule, run_task_schedule, schedules_equal,
 )
 
 # Pinned seeds: chosen once, frozen forever. Changing a seed is
@@ -39,6 +39,7 @@ SEEDS = {
     "mixed_version": 2212,
     "gang_kill": 2313,
     "ring_kill": 2414,
+    "replica_kill": 2515,
 }
 
 
@@ -47,7 +48,8 @@ def test_schedule_generation_is_deterministic():
     different schedules (the RNG actually reaches the events)."""
     for kind, seed in SEEDS.items():
         if kind in ("worker_kill", "oom_storm", "credit_revoke",
-                    "mixed_version", "gang_kill", "ring_kill"):
+                    "mixed_version", "gang_kill", "ring_kill",
+                    "replica_kill"):
             continue
         a = make_schedule(kind, seed)
         b = make_schedule(kind, seed)
@@ -165,6 +167,19 @@ def test_chaos_soak_ring_kill():
     assert summary["survivors_drained"]
     assert summary["gang_fence_intact"]
     assert summary["killed_at_step"] == summary["kill_step"]
+
+
+@pytest.mark.slow
+def test_chaos_soak_replica_kill():
+    """Serve-replica SIGKILL mid-request (seeded victim): idempotent
+    requests retry onto a peer (all 200), non-idempotent requests
+    complete on a survivor or fail TYPED, the controller's health loop
+    restores the replica count, the restored set serves, and the
+    zero-copy ingress segments that were in flight leak nothing."""
+    summary = run_replica_kill_schedule(SEEDS["replica_kill"])
+    assert summary["get_ok"] == 3
+    assert len(summary["healed_pids"]) == 2
+    assert summary["victim_pid"] not in summary["healed_pids"]
 
 
 @pytest.mark.slow

@@ -1,0 +1,264 @@
+"""Continuous batching: slot admission at step boundaries.
+
+Unit half: a fake engine drives :class:`serve.DecodeScheduler` without
+jax — pinning the admission policy itself (join mid-batch at the next
+step, finished sequence frees its slot immediately, occupancy never
+exceeds the slot count, typed shed past the queue cap, step failure
+fails in-flight work but the loop survives).
+
+Oracle half: the per-slot KV cache (models/decode.py slot_prefill /
+slot_decode_step) must produce bit-identical greedy tokens to the
+whole-batch ``generate`` path, including through a slot freed and
+re-prefilled mid-flight.
+"""
+
+import asyncio
+
+import pytest
+
+from ray_tpu.exceptions import ServeOverloadedError
+from ray_tpu.serve.decode_scheduler import DecodeScheduler
+
+
+class FreeRunEngine:
+    """Deterministic sync engine: prefill emits prompt[0]+100, each step
+    increments. Records per-step occupancy."""
+
+    def __init__(self, slots):
+        self.slots = slots
+        self.step_slots = []       # sorted slot ids per step
+        self.prefills = []         # (slot, prompt) in admission order
+
+    def prefill(self, slot, prompt):
+        self.prefills.append((slot, tuple(prompt)))
+        return prompt[0] + 100
+
+    def step(self, tokens):
+        self.step_slots.append(sorted(tokens))
+        return {s: t + 1 for s, t in tokens.items()}
+
+
+class GatedEngine(FreeRunEngine):
+    """Async engine whose step() blocks on a semaphore — the test
+    releases one permit per decode step, so admission timing relative
+    to step boundaries is fully deterministic."""
+
+    def __init__(self, slots):
+        super().__init__(slots)
+        self.gate = asyncio.Semaphore(0)
+
+    async def step(self, tokens):
+        await self.gate.acquire()
+        self.step_slots.append(sorted(tokens))
+        return {s: t + 1 for s, t in tokens.items()}
+
+
+def test_single_request_generates_max_tokens():
+    async def run():
+        eng = FreeRunEngine(slots=2)
+        sched = DecodeScheduler(eng)
+        toks = await sched.submit([7], max_tokens=4)
+        assert toks == [107, 108, 109, 110]
+        st = sched.stats()
+        assert st["completed"] == 1 and st["active_slots"] == 0
+        assert st["free_slots"] == 2
+        await sched.aclose()
+    asyncio.run(run())
+
+
+def test_occupancy_never_exceeds_slots():
+    async def run():
+        eng = FreeRunEngine(slots=3)
+        sched = DecodeScheduler(eng)
+        outs = await asyncio.gather(
+            *[sched.submit([i], max_tokens=3) for i in range(10)])
+        for i, toks in enumerate(outs):
+            assert toks == [i + 100, i + 101, i + 102]
+        assert max(len(s) for s in eng.step_slots) <= 3
+        assert sched.stats()["completed"] == 10
+        await sched.aclose()
+    asyncio.run(run())
+
+
+def test_late_request_joins_next_step_not_batch_drain():
+    """The continuous-batching contract: a request arriving while a
+    batch decodes is admitted at the NEXT step boundary and decodes
+    alongside it — never parked until the batch drains."""
+    async def run():
+        eng = GatedEngine(slots=2)
+        sched = DecodeScheduler(eng)
+        a = asyncio.ensure_future(sched.submit([1], max_tokens=8))
+        # let A prefill and park at the gated step
+        while not eng.prefills:
+            await asyncio.sleep(0.001)
+        eng.gate.release()          # A decodes step 1 alone
+        while len(eng.step_slots) < 1:
+            await asyncio.sleep(0.001)
+        b = asyncio.ensure_future(sched.submit([2], max_tokens=2))
+        for _ in range(10):
+            eng.gate.release()
+        toks_b = await b
+        assert toks_b == [102, 103]
+        toks_a = await a
+        assert toks_a == [101, 102, 103, 104, 105, 106, 107, 108]
+        # B shared a step with A (mid-batch admission, not serial)
+        assert any(len(s) == 2 for s in eng.step_slots)
+        assert sched.stats()["admitted_mid_batch"] == 1
+        # ...and B finished while A was still decoding
+        assert b.done() and toks_b[-1] == 103
+        await sched.aclose()
+    asyncio.run(run())
+
+
+def test_finished_sequence_frees_slot_immediately():
+    async def run():
+        eng = GatedEngine(slots=1)
+        sched = DecodeScheduler(eng)
+        a = asyncio.ensure_future(sched.submit([1], max_tokens=2))
+        while not eng.prefills:
+            await asyncio.sleep(0.001)
+        b = asyncio.ensure_future(sched.submit([2], max_tokens=2))
+        for _ in range(4):
+            eng.gate.release()
+        assert await a == [101, 102]
+        assert await b == [102, 103]
+        # one slot served both: B's prefill reused slot 0 after A freed
+        assert [s for s, _ in eng.prefills] == [0, 0]
+        await sched.aclose()
+    asyncio.run(run())
+
+
+def test_eos_token_finishes_early():
+    async def run():
+        eng = FreeRunEngine(slots=1)
+        sched = DecodeScheduler(eng)
+        toks = await sched.submit([1], max_tokens=50, eos_token=103)
+        assert toks == [101, 102, 103]
+        await sched.aclose()
+    asyncio.run(run())
+
+
+def test_queue_cap_sheds_typed():
+    async def run():
+        eng = GatedEngine(slots=1)
+        sched = DecodeScheduler(eng, max_queue_depth=2)
+        a = asyncio.ensure_future(sched.submit([1], max_tokens=4))
+        while not eng.prefills:
+            await asyncio.sleep(0.001)
+        # slot busy: these two queue...
+        q = [asyncio.ensure_future(sched.submit([i], max_tokens=1))
+             for i in (2, 3)]
+        await asyncio.sleep(0)   # let them enqueue
+        # ...and the third sheds with the typed overload error
+        with pytest.raises(ServeOverloadedError) as ei:
+            await sched.submit([4], max_tokens=1)
+        assert ei.value.retry_after_s > 0
+        assert sched.stats()["shed"] == 1
+        for _ in range(8):
+            eng.gate.release()
+        await asyncio.gather(a, *q)
+        await sched.aclose()
+    asyncio.run(run())
+
+
+def test_step_failure_fails_inflight_but_loop_survives():
+    class FlakyEngine(FreeRunEngine):
+        def __init__(self):
+            super().__init__(slots=1)
+            self.boom = True
+
+        def step(self, tokens):
+            if self.boom:
+                self.boom = False
+                raise RuntimeError("device fell over")
+            return super().step(tokens)
+
+    async def run():
+        eng = FlakyEngine()
+        sched = DecodeScheduler(eng)
+        with pytest.raises(RuntimeError, match="device fell over"):
+            await sched.submit([1], max_tokens=3)
+        # the loop and the slot survive the failed step
+        assert await sched.submit([5], max_tokens=2) == [105, 106]
+        await sched.aclose()
+    asyncio.run(run())
+
+
+def test_bad_prompt_fails_only_its_request():
+    class PickyEngine(FreeRunEngine):
+        def prefill(self, slot, prompt):
+            if prompt[0] < 0:
+                raise ValueError("negative prompt")
+            return super().prefill(slot, prompt)
+
+    async def run():
+        eng = PickyEngine(slots=2)
+        sched = DecodeScheduler(eng)
+        good = asyncio.ensure_future(sched.submit([3], max_tokens=2))
+        with pytest.raises(ValueError, match="negative prompt"):
+            await sched.submit([-1], max_tokens=2)
+        assert await good == [103, 104]
+        assert sched.stats()["free_slots"] == 2
+        await sched.aclose()
+    asyncio.run(run())
+
+
+def test_aclose_fails_pending_typed():
+    async def run():
+        eng = GatedEngine(slots=1)
+        sched = DecodeScheduler(eng)
+        a = asyncio.ensure_future(sched.submit([1], max_tokens=4))
+        while not eng.prefills:
+            await asyncio.sleep(0.001)
+        await sched.aclose()
+        with pytest.raises(ServeOverloadedError):
+            await a
+        with pytest.raises(ServeOverloadedError):
+            await sched.submit([2], max_tokens=1)
+    asyncio.run(run())
+
+
+def test_zero_slot_engine_rejected():
+    eng = FreeRunEngine(slots=0)
+    with pytest.raises(ValueError, match="at least one slot"):
+        DecodeScheduler(eng)
+
+
+# ------------------------------------------------------------- jax oracle
+
+
+def test_slot_cache_matches_whole_batch_generate():
+    """Greedy tokens through the per-slot cache — including a slot
+    freed by one sequence and re-prefilled by another mid-flight —
+    bit-match the whole-batch generate() oracle per prompt."""
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from ray_tpu.models import decode
+    from ray_tpu.models.transformer import TransformerConfig, init_params
+
+    cfg = TransformerConfig(vocab=97, d_model=64, n_heads=4, n_layers=2,
+                            d_ff=128, max_seq=64, dtype=jnp.float32)
+    params = init_params(jax.random.key(0), cfg)
+    from ray_tpu.serve.decode_scheduler import JaxSlotEngine
+
+    prompts = [[5, 11, 23], [40, 2, 9], [88, 17, 3]]
+    steps = [6, 3, 4]   # seq1 finishes early; seq2 takes its slot
+
+    def oracle(prompt, n):
+        out = decode.generate(params, jnp.asarray([prompt], jnp.int32),
+                              cfg, steps=n, max_len=32)
+        return [int(t) for t in out[0]]
+
+    async def run():
+        eng = JaxSlotEngine(params, cfg, slots=2, max_len=32)
+        sched = DecodeScheduler(eng)
+        outs = await asyncio.gather(
+            *[sched.submit(p, max_tokens=n)
+              for p, n in zip(prompts, steps)])
+        await sched.aclose()
+        return outs
+
+    outs = asyncio.run(run())
+    for prompt, n, got in zip(prompts, steps, outs):
+        assert got == oracle(prompt, n), (prompt, n)

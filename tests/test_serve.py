@@ -185,6 +185,18 @@ def _http_get(url: str, timeout: float = 30.0):
         return e.code, e.read()
 
 
+def _http_get_full(url: str, timeout: float = 30.0):
+    """(status, headers, body) — sheds carry Retry-After."""
+    import urllib.request
+
+    req = urllib.request.Request(url)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
 def _http_post(url: str, data: bytes, timeout: float = 30.0):
     import urllib.request
 
@@ -394,3 +406,165 @@ def test_batch_decorator_coalesces_requests(serve_cluster):
         with pytest.raises(Exception, match="poisons the batch"):
             ray_tpu.get(r, timeout=30)
     assert ray_tpu.get(h.remote(5), timeout=30) == 50
+
+
+# ------------------------------------------- serving front door at speed
+
+
+def test_continuous_batching_late_request_no_batch_drain_wait(
+        serve_cluster):
+    """A request that arrives while a long generation decodes joins the
+    in-flight batch at the next step boundary and completes WITHOUT
+    waiting for the batch to drain — the contract the static
+    @serve.batch window cannot give."""
+
+    @serve.deployment(max_concurrent_queries=32)
+    class Generator:
+        def __init__(self):
+            class SlowEngine:
+                slots = 2
+
+                def prefill(self, slot, prompt):
+                    return prompt[0] + 100
+
+                def step(self, tokens):
+                    time.sleep(0.04)  # one "device" decode step
+                    return {s: t + 1 for s, t in tokens.items()}
+
+            self.decode_scheduler = serve.DecodeScheduler(SlowEngine())
+
+        async def __call__(self, prompt, max_tokens):
+            return await self.decode_scheduler.submit(
+                prompt, max_tokens=max_tokens)
+
+        async def decode_stats(self):
+            return self.decode_scheduler.stats()
+
+    Generator.deploy()
+    h = Generator.get_handle()
+    long_ref = h.remote([1], 60)        # ~2.4s of decode steps
+    time.sleep(0.3)                     # long batch is mid-decode
+    t0 = time.monotonic()
+    short = ray_tpu.get(h.remote([7], 3), timeout=30)
+    short_latency = time.monotonic() - t0
+    assert short == [107, 108, 109]
+    # the long generation is still going when the short one finished
+    done, _ = ray_tpu.wait([long_ref], num_returns=1, timeout=0)
+    assert not done, "short request waited for the batch to drain"
+    assert short_latency < 1.5, short_latency
+    assert ray_tpu.get(long_ref, timeout=30) == list(range(101, 161))
+    st = ray_tpu.get(h.decode_stats.remote(), timeout=30)
+    assert st["admitted_mid_batch"] >= 1
+    assert st["completed"] == 2
+
+
+def test_http_shm_ingress_roundtrip(serve_cluster):
+    """A body past serve_ingress_shm_threshold crosses proxy -> replica
+    as an shm ObjectRef; deployment code still sees plain bytes."""
+
+    @serve.deployment
+    class Sum:
+        def __call__(self, request):
+            assert request.body_ref is None  # resolved before user code
+            return {"len": len(request.body),
+                    "sum": sum(request.body) % 997}
+
+    Sum.deploy()
+    addr = serve.get_http_address()
+    payload = bytes(range(256)) * 1024          # 256 KiB > 64 KiB
+    status, body = _http_post(f"http://{addr}/Sum", payload)
+    import json as _json
+    assert status == 200
+    assert _json.loads(body) == {"len": len(payload),
+                                 "sum": sum(payload) % 997}
+    proxy = ray_tpu.get_actor("SERVE_PROXY")
+    stats = ray_tpu.get(proxy.stats.remote())
+    assert stats["num_ingress_shm"] >= 1
+    # small bodies stay on the inline lane
+    status, _ = _http_post(f"http://{addr}/Sum", b"tiny")
+    assert status == 200
+    assert ray_tpu.get(proxy.stats.remote())["num_ingress_shm"] == \
+        stats["num_ingress_shm"]
+
+
+def test_http_overload_sheds_503_with_retry_after(serve_cluster):
+    """Past the queue budget the proxy sheds at admission: 503 + a
+    Retry-After hint, while admitted requests still complete."""
+    import threading
+
+    @serve.deployment(num_replicas=1, max_concurrent_queries=1)
+    class Slow:
+        async def __call__(self, request):
+            import asyncio
+            await asyncio.sleep(0.4)
+            return "ok"
+
+    Slow.deploy()
+    addr = serve.get_http_address()
+    results = []
+    lock = threading.Lock()
+
+    def client():
+        status, headers, body = _http_get_full(
+            f"http://{addr}/Slow", timeout=60.0)
+        with lock:
+            results.append((status, headers, body))
+
+    threads = [threading.Thread(target=client) for _ in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    ok = [r for r in results if r[0] == 200]
+    shed = [r for r in results if r[0] == 503]
+    assert len(ok) + len(shed) == 12, results
+    assert ok, "everything shed — admission budget too tight"
+    assert shed, "nothing shed past the queue budget"
+    for _, headers, body in shed:
+        ra = {k.lower(): v for k, v in headers.items()}.get("retry-after")
+        assert ra is not None and int(ra) >= 1
+        assert b"retry" in body.lower()
+    proxy = ray_tpu.get_actor("SERVE_PROXY")
+    stats = ray_tpu.get(proxy.stats.remote())
+    assert stats["num_shed"] >= len(shed)
+
+
+def test_api_serve_dashboard_route(serve_cluster):
+    """/api/serve: controller-published deployment view joined with the
+    per-router serve gauges/counters."""
+    import json as _json
+    import urllib.request
+
+    from ray_tpu import state
+
+    @serve.deployment(num_replicas=2)
+    class Meter:
+        def __call__(self, request=None):
+            return "ok"
+
+    Meter.deploy()
+    addr = serve.get_http_address()
+    for _ in range(3):
+        status, _ = _http_get(f"http://{addr}/Meter")
+        assert status == 200
+    dash = state.metrics_address()
+
+    def api():
+        with urllib.request.urlopen(
+                f"http://{dash}/api/serve", timeout=5) as resp:
+            return _json.loads(resp.read())
+
+    view = api()
+    assert view["routes"] == {"/Meter": "Meter"}
+    dep = view["deployments"]["Meter"]
+    assert dep["num_replicas"] == 2 and len(dep["replicas"]) == 2
+    # metric snapshots ship on the report period; poll for the rollup
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        load = api().get("load", {})
+        if load.get("Meter", {}).get("requests", 0) >= 3:
+            break
+        time.sleep(0.5)
+    load = api()["load"]["Meter"]
+    assert load["requests"] >= 3
+    assert "inflight" in load and "queue_depth" in load
